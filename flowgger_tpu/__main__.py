@@ -1,0 +1,30 @@
+"""CLI entry: ``python -m flowgger_tpu [config.toml]``.
+
+Parity model: /root/reference/src/main.rs:9-26 (single positional config
+path, default ``flowgger.toml``).
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from . import __version__, start
+
+DEFAULT_CONFIG_FILE = "flowgger.toml"
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="flowgger-tpu",
+        description="A TPU-native data collector (flowgger-compatible)",
+    )
+    parser.add_argument("config_file", nargs="?", default=DEFAULT_CONFIG_FILE,
+                        help="Configuration file (default: flowgger.toml)")
+    parser.add_argument("--version", action="version", version=__version__)
+    args = parser.parse_args(argv)
+    print(f"Flowgger-TPU {__version__}")
+    start(args.config_file)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,50 @@
+"""Scalar (per-line) decoders: the exactness oracle and small-batch
+fallback for the batched TPU decode tier.
+
+Parity model: /root/reference/src/flowgger/decoder/ — trait
+``Decoder { decode(line: &str) -> Result<Record> }`` (decoder/mod.rs:44-46).
+Decode errors are raised as ``DecodeError(str)``; the pipeline treats them
+as per-message and non-fatal, matching the reference's stderr-and-drop
+behavior (splitter/line_splitter.rs:37-39).
+"""
+
+from __future__ import annotations
+
+from ..record import Record
+
+
+class DecodeError(Exception):
+    """Per-message decode failure; message text mirrors the reference's
+    ``&'static str`` errors."""
+
+
+class Decoder:
+    def decode(self, line: str) -> Record:
+        raise NotImplementedError
+
+
+class InvalidDecoder(Decoder):
+    """Placeholder paired with the capnp splitter, which never calls the
+    decoder (decoder/invalid_decoder.rs:14-18, mod.rs:413-416)."""
+
+    def __init__(self, config=None):
+        pass
+
+    def decode(self, line: str) -> Record:
+        raise RuntimeError("The capnp decoder cannot be used for this input format")
+
+
+from .rfc5424 import RFC5424Decoder  # noqa: E402
+from .rfc3164 import RFC3164Decoder  # noqa: E402
+from .gelf import GelfDecoder  # noqa: E402
+from .ltsv import LTSVDecoder  # noqa: E402
+
+__all__ = [
+    "Decoder",
+    "DecodeError",
+    "InvalidDecoder",
+    "RFC5424Decoder",
+    "RFC3164Decoder",
+    "GelfDecoder",
+    "LTSVDecoder",
+]

@@ -1,0 +1,23 @@
+"""Inputs (transports): drive the splitter → handler pipeline.
+
+Parity model: /root/reference/src/flowgger/input/ — trait
+``Input { accept(tx, decoder, encoder) }`` (input/mod.rs:33-40).  The
+redesigned signature takes a *handler factory* instead of decoder+encoder:
+each connection/worker asks for a fresh handler (the reference clones the
+boxed decoder/encoder per thread, tcp_input.rs:44); a factory lets the
+TPU batch handler own per-connection batch arenas the same way.
+"""
+
+from __future__ import annotations
+
+
+class Input:
+    def accept(self, handler_factory) -> None:
+        """Run the transport forever (blocking).  ``handler_factory()``
+        returns a fresh ``splitters.Handler`` per connection/worker."""
+        raise NotImplementedError
+
+
+from .stdin_input import StdinInput  # noqa: E402
+
+__all__ = ["Input", "StdinInput"]

@@ -1,0 +1,31 @@
+"""Stdin input.
+
+Parity model: /root/reference/src/flowgger/input/stdin_input.rs:11-66.
+Framing from ``input.framing`` (line/nul/syslen/capnp, default line).
+"""
+
+from __future__ import annotations
+
+import sys
+
+from . import Input
+from ..config import Config, ConfigError
+from ..splitters import get_splitter
+
+DEFAULT_FRAMING = "line"
+
+
+class StdinInput(Input):
+    def __init__(self, config: Config):
+        framing = config.lookup("input.framing")
+        if framing is None:
+            framing = DEFAULT_FRAMING
+        elif not isinstance(framing, str):
+            raise ConfigError(
+                'input.framing must be a string set to "line", "nul" or "syslen"'
+            )
+        self.framing = framing
+
+    def accept(self, handler_factory) -> None:
+        splitter = get_splitter(self.framing)
+        splitter.run(sys.stdin.buffer, handler_factory())

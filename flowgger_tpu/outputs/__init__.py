@@ -1,0 +1,45 @@
+"""Outputs (sinks): consumer threads draining the bounded queue.
+
+Parity model: /root/reference/src/flowgger/output/ — trait
+``Output { start(arx, merger) }`` (output/mod.rs:21-30): ``start`` spawns
+worker thread(s) competing on the shared receiver and returns immediately.
+Here the queue is a ``queue.Queue`` (already thread-safe, so no explicit
+``Arc<Mutex<...>>`` wrapper is needed); a ``None`` item is the shutdown
+sentinel used by tests and graceful stops.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from ..mergers import Merger
+
+SHUTDOWN = None
+
+
+class Output:
+    def start(self, arx, merger: Optional[Merger]):
+        raise NotImplementedError
+
+
+def spawn_worker(target, name: str) -> threading.Thread:
+    t = threading.Thread(target=target, name=name, daemon=True)
+    t.start()
+    return t
+
+
+from .debug_output import DebugOutput  # noqa: E402
+from .file_output import FileOutput  # noqa: E402
+from .tls_output import TlsOutput  # noqa: E402
+from .kafka_output import KafkaOutput  # noqa: E402
+
+__all__ = [
+    "Output",
+    "DebugOutput",
+    "FileOutput",
+    "TlsOutput",
+    "KafkaOutput",
+    "spawn_worker",
+    "SHUTDOWN",
+]

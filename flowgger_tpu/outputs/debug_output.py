@@ -1,0 +1,31 @@
+"""Debug output: print each framed message to stdout.
+
+Parity model: /root/reference/src/flowgger/output/debug_output.rs:17-36
+(lossy UTF-8, no added newline beyond the merger's framing, flush per
+message).
+"""
+
+from __future__ import annotations
+
+import sys
+
+from . import Output, SHUTDOWN, spawn_worker
+
+
+class DebugOutput(Output):
+    def __init__(self, config=None):
+        pass
+
+    def start(self, arx, merger):
+        def run():
+            while True:
+                item = arx.get()
+                if item is SHUTDOWN:
+                    arx.task_done()
+                    return
+                data = merger.frame(item) if merger is not None else item
+                sys.stdout.write(data.decode("utf-8", errors="replace"))
+                sys.stdout.flush()
+                arx.task_done()
+
+        return spawn_worker(run, "debug-output")

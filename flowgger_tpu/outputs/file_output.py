@@ -1,0 +1,102 @@
+"""File output with optional buffering and size/time rotation.
+
+Parity model: /root/reference/src/flowgger/output/file_output.rs:50-218.
+Config keys: output.file_path (required), file_buffer_size (0 = off),
+file_rotation_size (0 = off), file_rotation_time (minutes, 0 = off),
+file_rotation_maxfiles (default 50), file_rotation_timeformat
+(default ``[year][month][day]T[hour][minute][second]Z``).
+"""
+
+from __future__ import annotations
+
+import sys
+
+from . import Output, SHUTDOWN, spawn_worker
+from ..config import Config, ConfigError
+from ..encoders import validate_time_format_input
+from ..utils.rotating_file import BufferedWriter, RotatingFile
+
+FILE_DEFAULT_BUFFER_SIZE = 0
+FILE_DEFAULT_TIME_FORMAT = "[year][month][day]T[hour][minute][second]Z"
+FILE_DEFAULT_ROTATION_SIZE = 0
+FILE_DEFAULT_ROTATION_TIME = 0
+FILE_DEFAULT_ROTATION_MAXFILES = 50
+
+
+class FileOutput(Output):
+    def __init__(self, config: Config):
+        path = config.lookup("output.file_path")
+        if path is None:
+            raise ConfigError("output.file_path is missing")
+        if not isinstance(path, str):
+            raise ConfigError("output.file_path must be a string")
+        self.path = path
+        self.buffer_size = config.lookup_int(
+            "output.file_buffer_size",
+            "output.file_buffer_size should be an integer",
+            FILE_DEFAULT_BUFFER_SIZE,
+        )
+        self.rotation_size = config.lookup_int(
+            "output.file_rotation_size",
+            "output.file_rotation_size should be an integer",
+            FILE_DEFAULT_ROTATION_SIZE,
+        )
+        self.rotation_time = config.lookup_int(
+            "output.file_rotation_time",
+            "output.file_rotation_time should be an integer",
+            FILE_DEFAULT_ROTATION_TIME,
+        )
+        self.rotation_maxfiles = config.lookup_int(
+            "output.file_rotation_maxfiles",
+            "output.file_rotation_maxfiles should be an integer",
+            FILE_DEFAULT_ROTATION_MAXFILES,
+        )
+        time_format = config.lookup_str(
+            "output.file_rotation_timeformat",
+            "output.file_rotation_timeformat should be a string",
+            FILE_DEFAULT_TIME_FORMAT,
+        )
+        self.time_format = validate_time_format_input(
+            "file_rotation_timeformat", time_format, FILE_DEFAULT_TIME_FORMAT
+        )
+
+    def open_writer(self):
+        rotating = RotatingFile(
+            self.path, self.rotation_size, self.rotation_time,
+            self.rotation_maxfiles, self.time_format,
+        )
+        if rotating.is_enabled():
+            try:
+                rotating.open()
+                writer = rotating
+            except OSError as e:
+                print(f"Unable to open rotating file {self.path}: {e}", file=sys.stderr)
+                return None
+        else:
+            try:
+                writer = RotatingFile.open_file(self.path)
+            except OSError as e:
+                print(f"Unable to open file {self.path}: {e}", file=sys.stderr)
+                return None
+        if self.buffer_size > 0:
+            writer = BufferedWriter(writer, self.buffer_size)
+        return writer
+
+    def start(self, arx, merger):
+        writer = self.open_writer()
+        if writer is None:
+            raise RuntimeError(f"Cannot open file to {self.path}")
+
+        def run():
+            while True:
+                item = arx.get()
+                if item is SHUTDOWN:
+                    if hasattr(writer, "flush"):
+                        writer.flush()
+                    arx.task_done()
+                    return
+                data = merger.frame(item) if merger is not None else item
+                writer.write(data)
+                arx.task_done()
+
+        return spawn_worker(run, "file-output")

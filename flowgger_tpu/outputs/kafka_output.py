@@ -1,0 +1,17 @@
+"""Kafka producer output with message coalescing.
+
+Parity model: /root/reference/src/flowgger/output/kafka_output.rs:13-212.
+Implemented in the outputs milestone; see repo task list.
+"""
+
+from __future__ import annotations
+
+from . import Output
+
+
+class KafkaOutput(Output):  # pragma: no cover - placeholder, full impl pending
+    def __init__(self, config):
+        raise NotImplementedError("KafkaOutput: implementation lands with the outputs milestone")
+
+    def start(self, arx, merger):
+        raise NotImplementedError

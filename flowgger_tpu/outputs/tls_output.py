@@ -1,0 +1,18 @@
+"""TLS output: forward framed messages to a downstream syslog/TLS
+cluster with failover and backoff.
+
+Parity model: /root/reference/src/flowgger/output/tls_output.rs:21-361.
+Implemented in the outputs milestone; see repo task list.
+"""
+
+from __future__ import annotations
+
+from . import Output
+
+
+class TlsOutput(Output):  # pragma: no cover - placeholder, full impl pending
+    def __init__(self, config):
+        raise NotImplementedError("TlsOutput: implementation lands with the outputs milestone")
+
+    def start(self, arx, merger):
+        raise NotImplementedError

@@ -1,0 +1,238 @@
+"""Orchestrator: config → component factories → queue wiring → run.
+
+Parity model: /root/reference/src/flowgger/mod.rs:95-472 — defaults,
+factory match arms, output-framing inference table, bounded queue, output
+consumer startup, blocking input loop.
+
+TPU extension: ``input.format`` values suffixed ``_tpu`` (rfc5424_tpu,
+gelf_tpu, ltsv_tpu, auto_tpu) select the batched columnar decode path
+(flowgger_tpu.tpu): the scalar decoder for that format is still
+constructed as the per-line fallback oracle, and the handler factory
+returns a BatchHandler instead of a ScalarHandler.
+"""
+
+from __future__ import annotations
+
+import queue
+from typing import Optional
+
+from .config import Config, ConfigError
+from .decoders import (
+    GelfDecoder,
+    InvalidDecoder,
+    LTSVDecoder,
+    RFC3164Decoder,
+    RFC5424Decoder,
+)
+from .encoders import (
+    CapnpEncoder,
+    GelfEncoder,
+    LTSVEncoder,
+    PassthroughEncoder,
+    RFC3164Encoder,
+    RFC5424Encoder,
+)
+from .mergers import LineMerger, NulMerger, SyslenMerger
+from .splitters import ScalarHandler
+
+# mod.rs:101-109
+DEFAULT_INPUT_FORMAT = "rfc5424"
+DEFAULT_INPUT_TYPE = "syslog-tls"
+DEFAULT_OUTPUT_FORMAT = "gelf"
+DEFAULT_OUTPUT_FRAMING = "noop"
+DEFAULT_OUTPUT_TYPE = "kafka"
+DEFAULT_QUEUE_SIZE = 10_000_000
+
+
+def get_input(input_type: str, config: Config):
+    """Input factory (mod.rs:181-193)."""
+    if input_type == "redis":
+        from .inputs.redis_input import RedisInput
+
+        return RedisInput(config)
+    if input_type == "stdin":
+        from .inputs import StdinInput
+
+        return StdinInput(config)
+    if input_type in ("tcp", "syslog-tcp"):
+        from .inputs.tcp_input import TcpInput
+
+        return TcpInput(config)
+    if input_type in ("tcp_co", "tcpco", "syslog-tcp_co", "syslog-tcpco"):
+        from .inputs.tcp_input import TcpCoInput
+
+        return TcpCoInput(config)
+    if input_type in ("tls", "syslog-tls"):
+        from .inputs.tls_input import TlsInput
+
+        return TlsInput(config)
+    if input_type in ("tls_co", "tlsco", "syslog-tls_co", "syslog-tlsco"):
+        from .inputs.tls_input import TlsCoInput
+
+        return TlsCoInput(config)
+    if input_type == "udp":
+        from .inputs.udp_input import UdpInput
+
+        return UdpInput(config)
+    if input_type == "file":
+        from .inputs.file_input import FileInput
+
+        return FileInput(config)
+    raise ConfigError(f"Invalid input type: {input_type}")
+
+
+def get_output(output_type: str, config: Config):
+    """Output factory (mod.rs:235-243)."""
+    from .outputs import DebugOutput, FileOutput, KafkaOutput, TlsOutput
+
+    if output_type == "stdout":
+        return DebugOutput(config)
+    if output_type == "kafka":
+        return KafkaOutput(config)
+    if output_type in ("tls", "syslog-tls"):
+        return TlsOutput(config)
+    if output_type == "debug":
+        return DebugOutput(config)
+    if output_type == "file":
+        return FileOutput(config)
+    raise ConfigError(f"Invalid output type: {output_type}")
+
+
+_TPU_FORMATS = {
+    "rfc5424_tpu": "rfc5424",
+    "gelf_tpu": "gelf",
+    "ltsv_tpu": "ltsv",
+    "rfc3164_tpu": "rfc3164",
+    "auto_tpu": "auto",
+}
+
+
+def get_decoder(input_format: str, config: Config):
+    """Decoder factory (mod.rs:413-422), extended with the *_tpu formats."""
+    base = _TPU_FORMATS.get(input_format, input_format)
+    if input_format == "capnp":
+        return InvalidDecoder(config)
+    if base == "gelf":
+        return GelfDecoder(config)
+    if base == "ltsv":
+        return LTSVDecoder(config)
+    if base in ("rfc5424", "auto"):
+        return RFC5424Decoder(config)
+    if base == "rfc3164":
+        return RFC3164Decoder(config)
+    raise ConfigError(f"Unknown input format: {input_format}")
+
+
+def get_encoder(output_format: str, config: Config):
+    """Encoder factory (mod.rs:429-437)."""
+    if output_format == "capnp":
+        return CapnpEncoder(config)
+    if output_format in ("gelf", "json"):
+        return GelfEncoder(config)
+    if output_format == "ltsv":
+        return LTSVEncoder(config)
+    if output_format == "rfc3164":
+        return RFC3164Encoder(config)
+    if output_format == "rfc5424":
+        return RFC5424Encoder(config)
+    if output_format == "passthrough":
+        return PassthroughEncoder(config)
+    raise ConfigError(f"Unknown output format: {output_format}")
+
+
+def get_merger(output_framing: str, config: Config):
+    """Framing-name → merger (mod.rs:453-460)."""
+    if output_framing in ("noop", "nop", "none", "capnp"):
+        return None
+    if output_framing == "line":
+        return LineMerger(config)
+    if output_framing == "nul":
+        return NulMerger(config)
+    if output_framing == "syslen":
+        return SyslenMerger(config)
+    raise ConfigError(f"Invalid framing type: {output_framing}")
+
+
+def infer_output_framing(output_format: str, output_type: str) -> str:
+    """Framing inference when output.framing is absent (mod.rs:444-452)."""
+    if output_format == "capnp" or output_type == "kafka":
+        return "noop"
+    if output_type == "debug" or output_format == "ltsv":
+        return "line"
+    if output_format == "gelf":
+        return "nul"
+    return DEFAULT_OUTPUT_FRAMING
+
+
+class Pipeline:
+    """Wired-but-not-yet-running pipeline; ``run()`` blocks on the input.
+
+    Splitting construction from running keeps the pieces testable the way
+    the reference's tests poke at components with an in-memory channel
+    (udp_input.rs:182-233)."""
+
+    def __init__(self, config: Config):
+        input_format = config.lookup_str(
+            "input.format", "input.format must be a string", DEFAULT_INPUT_FORMAT
+        )
+        input_type = config.lookup_str(
+            "input.type", "input.type must be a string", DEFAULT_INPUT_TYPE
+        )
+        self.input = get_input(input_type, config)
+        self.decoder = get_decoder(input_format, config)
+        output_format = config.lookup_str(
+            "output.format", "output.format must be a string", DEFAULT_OUTPUT_FORMAT
+        )
+        self.encoder = get_encoder(output_format, config)
+        output_type = config.lookup_str(
+            "output.type", "output.type must be a string", DEFAULT_OUTPUT_TYPE
+        )
+        self.output = get_output(output_type, config)
+        output_framing = config.lookup_str(
+            "output.framing", "output.framing must be a string"
+        )
+        if output_framing is None:
+            output_framing = infer_output_framing(output_format, output_type)
+        self.merger = get_merger(output_framing, config)
+        queue_size = config.lookup_int(
+            "input.queuesize", "input.queuesize must be a size integer", DEFAULT_QUEUE_SIZE
+        )
+        self.tx: "queue.Queue[Optional[bytes]]" = queue.Queue(maxsize=queue_size)
+        self.input_format = input_format
+        self.config = config
+
+    def handler_factory(self):
+        if self.input_format in _TPU_FORMATS:
+            from .tpu.batch import BatchHandler
+
+            return BatchHandler(
+                self.tx, self.decoder, self.encoder, self.config,
+                fmt=_TPU_FORMATS[self.input_format],
+            )
+        return ScalarHandler(self.tx, self.decoder, self.encoder)
+
+    def start_output(self):
+        return self.output.start(self.tx, self.merger)
+
+    def run(self):
+        threads = self.start_output()
+        if not isinstance(threads, list):
+            threads = [threads]
+        self.input.accept(self.handler_factory)
+        # Input ended (EOF on stdin, etc.): drain the queue before exiting
+        # rather than killing the daemon consumers mid-write.
+        from .outputs import SHUTDOWN
+
+        for _ in threads:
+            self.tx.put(SHUTDOWN)
+        for t in threads:
+            t.join(timeout=30)
+
+
+def start(config_file: str):
+    """Library entry point (lib.rs:18-20, mod.rs:395-472): blocks forever."""
+    try:
+        config = Config.from_path(config_file)
+    except OSError as e:
+        raise ConfigError(f"Unable to read the config file [{config_file}]: {e}")
+    Pipeline(config).run()

@@ -1,0 +1,298 @@
+"""Splitters: turn a byte stream into framed messages and push them
+through a Handler (decode → encode → enqueue).
+
+Parity model: /root/reference/src/flowgger/splitter/ — trait
+``Splitter<T> { run(BufReader<T>, tx, decoder, encoder) }``
+(splitter/mod.rs:18-26).  Redesign for the batched TPU path: instead of
+baking ``decode→encode→send`` into each splitter (the reference's
+``handle_line``, line_splitter.rs:44-54), splitters feed a *Handler*.
+``ScalarHandler`` reproduces the reference's per-line semantics exactly;
+``flowgger_tpu.tpu.batch.BatchHandler`` accumulates lines into a packed
+byte tensor and decodes them on the TPU in bulk.  Handlers receive raw
+``bytes`` so the hot path never materializes per-line ``str`` objects.
+
+Stream contract: a binary file-like with ``read(n)`` returning ``b""`` on
+EOF; idle timeouts surface as ``TimeoutError`` and are treated like the
+reference's ``WouldBlock`` (close the idle connection).
+"""
+
+from __future__ import annotations
+
+import struct as _struct
+import sys
+from typing import Optional
+
+from .. import capnp_wire
+from ..decoders import DecodeError
+from ..encoders import EncodeError
+from ..record import FACILITY_MAX, Record, SEVERITY_MAX, StructuredData
+
+_CHUNK = 1 << 16
+
+
+class Handler:
+    """Sink for framed messages coming out of a splitter."""
+
+    quiet_empty = False  # NulSplitter sets this: suppress empty-frame errors
+
+    def handle_bytes(self, raw: bytes) -> None:
+        raise NotImplementedError
+
+    def handle_record(self, record: Record) -> None:
+        """Used by the capnp splitter, which bypasses the decoder."""
+        raise NotImplementedError
+
+    def flush(self) -> None:
+        """Called at end-of-stream (and by batching handlers on timers)."""
+
+
+class ScalarHandler(Handler):
+    """Reference-exact per-line path: utf-8 validate → decode → encode →
+    enqueue; errors go to stderr and drop the message
+    (line_splitter.rs:17-54)."""
+
+    def __init__(self, tx, decoder, encoder):
+        self.tx = tx
+        self.decoder = decoder
+        self.encoder = encoder
+        # set by NulSplitter.run: suppress error reports for empty frames
+        self.quiet_empty = False
+
+    def handle_bytes(self, raw: bytes) -> None:
+        try:
+            line = raw.decode("utf-8")
+        except UnicodeDecodeError:
+            print("Invalid UTF-8 input", file=sys.stderr)
+            return
+        self.handle_line(line)
+
+    def handle_line(self, line: str) -> None:
+        try:
+            record = self.decoder.decode(line)
+            encoded = self.encoder.encode(record)
+        except (DecodeError, EncodeError) as e:
+            stripped = line.strip()
+            if not (self.quiet_empty and not stripped):
+                print(f"{e}: [{stripped}]", file=sys.stderr)
+            return
+        self.tx.put(encoded)
+
+    def handle_record(self, record: Record) -> None:
+        try:
+            encoded = self.encoder.encode(record)
+        except EncodeError as e:
+            print(e, file=sys.stderr)
+            return
+        self.tx.put(encoded)
+
+
+class Splitter:
+    def run(self, stream, handler: Handler) -> None:
+        raise NotImplementedError
+
+
+def _read_chunks_split(stream, handler: Handler, sep: bytes, strip_cr: bool):
+    """Shared chunked scan for line/nul framing.  The reference's BufRead
+    loop is sequential per byte-window; here the split is a bulk
+    ``bytes.split`` per chunk (C speed) with carry-over of the partial
+    tail — the same carry the TPU batcher uses between batches."""
+    carry = b""
+    while True:
+        try:
+            chunk = stream.read(_CHUNK)
+        except TimeoutError:
+            print(
+                "Client hasn't sent any data for a while - Closing idle connection",
+                file=sys.stderr,
+            )
+            break
+        except OSError:
+            break
+        if not chunk:
+            break
+        parts = (carry + chunk).split(sep)
+        carry = parts.pop()
+        for part in parts:
+            if strip_cr and part.endswith(b"\r"):
+                part = part[:-1]
+            handler.handle_bytes(part)
+    if carry:
+        if strip_cr and carry.endswith(b"\r"):
+            carry = carry[:-1]
+        handler.handle_bytes(carry)
+    handler.flush()
+
+
+class LineSplitter(Splitter):
+    """``\\n`` framing with trailing-``\\r`` strip (line_splitter.rs:9-41)."""
+
+    def run(self, stream, handler: Handler) -> None:
+        _read_chunks_split(stream, handler, b"\n", strip_cr=True)
+
+
+class NulSplitter(Splitter):
+    """NUL framing; errors on all-whitespace frames are suppressed
+    (nul_splitter.rs:10-49)."""
+
+    def run(self, stream, handler: Handler) -> None:
+        handler.quiet_empty = True
+        _read_chunks_split(stream, handler, b"\0", strip_cr=False)
+
+
+class SyslenSplitter(Splitter):
+    """RFC5425-style octet counting: ASCII decimal length, one space, then
+    exactly that many bytes (syslen_splitter.rs:10-69)."""
+
+    def run(self, stream, handler: Handler) -> None:
+        buf = b""
+        while True:
+            # read length prefix up to the space
+            sp = buf.find(b" ")
+            while sp < 0:
+                try:
+                    chunk = stream.read(_CHUNK)
+                except TimeoutError:
+                    print(
+                        "Client hasn't sent any data for a while - Closing idle connection",
+                        file=sys.stderr,
+                    )
+                    handler.flush()
+                    return
+                except OSError:
+                    chunk = b""
+                if not chunk:
+                    if buf:
+                        print("Can't read message's length", file=sys.stderr)
+                    handler.flush()
+                    return
+                buf += chunk
+                sp = buf.find(b" ")
+            len_s = buf[:sp]
+            if not len_s.isdigit():
+                print("Can't read message's length", file=sys.stderr)
+                handler.flush()
+                return
+            size = int(len_s)
+            buf = buf[sp + 1:]
+            while len(buf) < size:
+                try:
+                    chunk = stream.read(_CHUNK)
+                except (TimeoutError, OSError):
+                    chunk = b""
+                if not chunk:
+                    print("failed to fill whole buffer", file=sys.stderr)
+                    handler.flush()
+                    return
+                buf += chunk
+            msg, buf = buf[:size], buf[size:]
+            handler.handle_bytes(msg)
+
+
+class CapnpSplitter(Splitter):
+    """Binary Cap'n Proto stream; builds Records directly from the wire
+    (bypassing the decoder) and hands them to the handler
+    (capnp_splitter.rs:15-167)."""
+
+    def run(self, stream, handler: Handler) -> None:
+        buf = b""
+
+        def read_exact(n: int) -> Optional[bytes]:
+            nonlocal buf
+            while len(buf) < n:
+                try:
+                    chunk = stream.read(_CHUNK)
+                except TimeoutError:
+                    print(
+                        "Client hasn't sent any data for a while - Closing idle connection",
+                        file=sys.stderr,
+                    )
+                    return None
+                except OSError:
+                    return None
+                if not chunk:
+                    return None
+                buf += chunk
+            out, buf = buf[:n], buf[n:]
+            return out
+
+        while True:
+            head = read_exact(4)
+            if head is None:
+                break
+            nseg = _struct.unpack("<I", head)[0] + 1
+            table_rest = read_exact(4 * nseg + (4 * nseg + 4) % 8)
+            if table_rest is None:
+                print("Capnp decoding error: truncated segment table", file=sys.stderr)
+                break
+            sizes = _struct.unpack_from(f"<{nseg}I", table_rest, 0)
+            body = read_exact(8 * sum(sizes))
+            if body is None:
+                print("Capnp decoding error: truncated message", file=sys.stderr)
+                break
+            try:
+                reader = capnp_wire.parse_message(head + table_rest + body)
+                record = _record_from_capnp(reader)
+            except _MessageError as e:
+                print(e, file=sys.stderr)
+                continue
+            except (capnp_wire.CapnpDecodeError, _struct.error, IndexError,
+                    ValueError, UnicodeDecodeError) as e:
+                # malformed wire data must not crash the input loop — the
+                # reference logs and closes (capnp_splitter.rs:27-31)
+                print(f"Capnp decoding error: {e}", file=sys.stderr)
+                break
+            handler.handle_record(record)
+        handler.flush()
+
+
+class _MessageError(Exception):
+    pass
+
+
+def _record_from_capnp(reader: "capnp_wire.RecordReader") -> Record:
+    """handle_message + get_sd + get_pairs (capnp_splitter.rs:65-167):
+    nan/non-positive ts rejected; facility/severity above their max read
+    as missing; pairs get the ``_`` prefix; extra pairs only keep string
+    values; sd is always present (capnp null text reads as "")."""
+    ts = reader.get_ts()
+    if ts != ts or ts <= 0.0:
+        raise _MessageError("Missing timestamp")
+    facility = reader.get_facility()
+    severity = reader.get_severity()
+    pairs = []
+    for name, value in reader.get_pairs():
+        if not name.startswith("_"):
+            name = f"_{name}"
+        pairs.append((name, value))
+    for name, value in reader.get_extra():
+        if value.kind == value.STRING:
+            pairs.append((name, value))
+    sd = StructuredData(reader.get_sd_id())
+    sd.pairs = pairs
+    return Record(
+        ts=ts,
+        hostname=reader.get_hostname(),
+        facility=facility if facility <= FACILITY_MAX else None,
+        severity=severity if severity <= SEVERITY_MAX else None,
+        appname=reader.get_appname(),
+        procid=reader.get_procid(),
+        msgid=reader.get_msgid(),
+        msg=reader.get_msg(),
+        full_msg=reader.get_full_msg(),
+        sd=[sd],
+    )
+
+
+def get_splitter(framing: str) -> Splitter:
+    """Framing-name → splitter (stdin_input.rs:56-63 match arms)."""
+    if framing == "capnp":
+        return CapnpSplitter()
+    if framing == "line":
+        return LineSplitter()
+    if framing == "syslen":
+        return SyslenSplitter()
+    if framing == "nul":
+        return NulSplitter()
+    from ..config import ConfigError
+
+    raise ConfigError("Unsupported framing scheme")

@@ -1,0 +1,30 @@
+"""Shared utilities: precise timestamps, Rust-compatible formatting,
+calendar math, rotating files."""
+
+from .rustfmt import display_f64, display_i64, json_f64
+from .timeparse import (
+    civil_from_days,
+    days_from_civil,
+    format_rfc3164_header_ts,
+    format_time_description,
+    now_precise,
+    parse_english_time,
+    parse_rfc3164_ts,
+    rfc3339_to_unix,
+    unix_to_rfc3339_ms,
+)
+
+__all__ = [
+    "display_f64",
+    "display_i64",
+    "json_f64",
+    "civil_from_days",
+    "days_from_civil",
+    "format_rfc3164_header_ts",
+    "format_time_description",
+    "now_precise",
+    "parse_english_time",
+    "parse_rfc3164_ts",
+    "rfc3339_to_unix",
+    "unix_to_rfc3339_ms",
+]

@@ -1,0 +1,12 @@
+"""Test environment: force JAX onto a virtual 8-device CPU mesh so the
+multi-chip sharding paths compile and execute without TPU hardware
+(SURVEY.md §7 / driver contract)."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

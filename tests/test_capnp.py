@@ -1,0 +1,104 @@
+"""Cap'n Proto wire-format conformance.
+
+The byte vector is the reference's golden test message
+(capnp_splitter.rs:192-208) — a canonical capnp serialization of a known
+Record; we must parse it to the same Record and re-serialize the same
+bytes (proving allocation-order compatibility with capnp's builder).
+"""
+
+from flowgger_tpu import capnp_wire
+from flowgger_tpu.record import Record, SDValue, StructuredData
+from flowgger_tpu.splitters import _record_from_capnp
+
+GOLDEN_MESSAGE = bytes([
+    0, 0, 0, 0, 38, 0, 0, 0, 0, 0, 0, 0, 2, 0, 9, 0, 42, 169, 147, 169, 143, 163, 212, 65,
+    255, 1, 0, 0, 0, 0, 0, 0, 33, 0, 0, 0, 98, 0, 0, 0, 37, 0, 0, 0, 66, 0, 0, 0, 37, 0, 0,
+    0, 26, 0, 0, 0, 37, 0, 0, 0, 10, 0, 0, 0, 37, 0, 0, 0, 202, 1, 0, 0, 65, 0, 0, 0, 218,
+    0, 0, 0, 77, 0, 0, 0, 58, 0, 0, 0, 77, 0, 0, 0, 39, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0,
+    101, 120, 97, 109, 112, 108, 101, 46, 111, 114, 103, 0, 0, 0, 0, 0, 97, 112, 112, 110,
+    97, 109, 101, 0, 52, 52, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 65, 32, 115, 104,
+    111, 114, 116, 32, 109, 101, 115, 115, 97, 103, 101, 32, 116, 104, 97, 116, 32, 104,
+    101, 108, 112, 115, 32, 121, 111, 117, 32, 105, 100, 101, 110, 116, 105, 102, 121, 32,
+    119, 104, 97, 116, 32, 105, 115, 32, 103, 111, 105, 110, 103, 32, 111, 110, 0, 0, 0, 0,
+    0, 0, 0, 0, 66, 97, 99, 107, 116, 114, 97, 99, 101, 32, 104, 101, 114, 101, 10, 10,
+    109, 111, 114, 101, 32, 115, 116, 117, 102, 102, 0, 0, 0, 0, 0, 0, 115, 111, 109, 101,
+    105, 100, 0, 0, 4, 0, 0, 0, 2, 0, 2, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0,
+    5, 0, 0, 0, 90, 0, 0, 0, 9, 0, 0, 0, 34, 0, 0, 0, 95, 115, 111, 109, 101, 95, 105, 110,
+    102, 111, 0, 0, 0, 0, 0, 0, 102, 111, 111, 0, 0, 0, 0, 0,
+])
+
+
+def test_parse_golden_message():
+    reader = capnp_wire.parse_message(GOLDEN_MESSAGE)
+    record = _record_from_capnp(reader)
+    assert record.ts == 1385053862.3072
+    assert record.hostname == "example.org"
+    assert record.facility is None       # encoded 0xff
+    assert record.severity == 1
+    assert record.appname == "appname"
+    assert record.procid == "44"
+    assert record.msgid == ""            # null pointer reads as ""
+    assert record.msg == "A short message that helps you identify what is going on"
+    assert record.full_msg == "Backtrace here\n\nmore stuff"
+    (sd,) = record.sd
+    assert sd.sd_id == "someid"
+    assert sd.pairs == [("_some_info", SDValue.string("foo"))]
+
+
+def test_encode_golden_roundtrip():
+    """Encoding the golden Record must reproduce capnp's exact bytes."""
+    record = Record(
+        ts=1385053862.3072,
+        hostname="example.org",
+        facility=None,
+        severity=1,
+        appname="appname",
+        procid="44",
+        msgid="",
+        msg="A short message that helps you identify what is going on",
+        full_msg="Backtrace here\n\nmore stuff",
+        sd=[StructuredData("someid", [("_some_info", SDValue.string("foo"))])],
+    )
+    assert capnp_wire.encode_record(record, []) == GOLDEN_MESSAGE
+
+
+def test_all_value_kinds_roundtrip():
+    record = Record(
+        ts=1.5,
+        hostname="h",
+        facility=3,
+        severity=2,
+        sd=[StructuredData("id", [
+            ("_s", SDValue.string("str")),
+            ("_b", SDValue.bool_(True)),
+            ("_f", SDValue.f64(-2.25)),
+            ("_i", SDValue.i64(-7)),
+            ("_u", SDValue.u64(1 << 60)),
+            ("_n", SDValue.null()),
+        ])],
+    )
+    data = capnp_wire.encode_record(record, [("xk", "xv")])
+    reader = capnp_wire.parse_message(data)
+    out = _record_from_capnp(reader)
+    assert out.ts == 1.5
+    assert out.facility == 3 and out.severity == 2
+    (sd,) = out.sd
+    assert ("_s", SDValue.string("str")) in sd.pairs
+    assert ("_b", SDValue.bool_(True)) in sd.pairs
+    assert ("_f", SDValue.f64(-2.25)) in sd.pairs
+    assert ("_i", SDValue.i64(-7)) in sd.pairs
+    assert ("_u", SDValue.u64(1 << 60)) in sd.pairs
+    assert ("_n", SDValue.null()) in sd.pairs
+    assert ("xk", SDValue.string("xv")) in sd.pairs
+
+
+def test_encoder_class():
+    from flowgger_tpu.config import Config
+    from flowgger_tpu.encoders import CapnpEncoder
+
+    enc = CapnpEncoder(Config.from_string(""))
+    data = enc.encode(Record(ts=2.0, hostname="h"))
+    reader = capnp_wire.parse_message(data)
+    assert reader.get_ts() == 2.0
+    assert reader.get_hostname() == "h"
+    assert reader.get_facility() == 0xFF

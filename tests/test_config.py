@@ -1,0 +1,38 @@
+"""Config tests (reference: config.rs:111-191 inline tests)."""
+
+import pytest
+
+from flowgger_tpu.config import Config, ConfigError
+
+
+def test_config_from_string():
+    config = Config.from_string('[section]\nfield = "This is only a test"\n')
+    assert config.lookup("section.field") == "This is only a test"
+
+
+def test_config_missing_key():
+    config = Config.from_string("[section]\nx = 1\n")
+    assert config.lookup("section.y") is None
+    assert config.lookup("other.x") is None
+
+
+def test_config_nested_lookup():
+    config = Config.from_string("[a.b.c]\nd = 42\n")
+    assert config.lookup("a.b.c.d") == 42
+    assert config.lookup("a.b.c") == {"d": 42}
+
+
+def test_config_bad_toml():
+    with pytest.raises(ConfigError, match="Syntax error"):
+        Config.from_string("this is { not toml")
+
+
+def test_typed_helpers():
+    config = Config.from_string('x = "s"\nn = 3\nb = true\n')
+    assert config.lookup_str("x", "err") == "s"
+    assert config.lookup_int("n", "err") == 3
+    assert config.lookup_bool("b", "err") is True
+    with pytest.raises(ConfigError, match="must be int"):
+        config.lookup_int("x", "must be int")
+    with pytest.raises(ConfigError, match="must be str"):
+        config.lookup_str("n", "must be str")

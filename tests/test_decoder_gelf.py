@@ -1,0 +1,90 @@
+"""GELF decoder golden tests (reference: gelf_decoder.rs:127-206)."""
+
+import pytest
+
+from flowgger_tpu.decoders import DecodeError, GelfDecoder
+from flowgger_tpu.record import SDValue
+
+D = GelfDecoder()
+
+
+def test_gelf_decoder():
+    msg = (
+        '{"version":"1.1", "host": "example.org",'
+        '"short_message": "A short message that helps you identify what is going on", '
+        '"full_message": "Backtrace here\\n\\nmore stuff", "timestamp": 1385053862.3072, '
+        '"level": 1, "_user_id": 9001, "_some_info": "foo", "_some_env_var": "bar"}'
+    )
+    res = D.decode(msg)
+    assert res.ts == 1385053862.3072
+    assert res.hostname == "example.org"
+    assert res.msg == "A short message that helps you identify what is going on"
+    assert res.full_msg == "Backtrace here\n\nmore stuff"
+    assert res.severity == 1
+    (sd,) = res.sd
+    assert ("_user_id", SDValue.u64(9001)) in sd.pairs
+    assert ("_some_info", SDValue.string("foo")) in sd.pairs
+    assert ("_some_env_var", SDValue.string("bar")) in sd.pairs
+
+
+def test_pairs_sorted_order():
+    # serde_json 0.8 object is a BTreeMap: keys iterate sorted
+    res = D.decode('{"host":"h","z":1,"a":2,"m":3}')
+    assert [k for k, _ in res.sd[0].pairs] == ["_a", "_m", "_z"]
+
+
+def test_underscore_not_doubled():
+    res = D.decode('{"host":"h","_x":1}')
+    assert res.sd[0].pairs == [("_x", SDValue.u64(1))]
+
+
+def test_negative_int_is_i64():
+    res = D.decode('{"host":"h","x":-3}')
+    assert res.sd[0].pairs == [("_x", SDValue.i64(-3))]
+
+
+def test_float_is_f64():
+    res = D.decode('{"host":"h","x":1.5}')
+    assert res.sd[0].pairs == [("_x", SDValue.f64(1.5))]
+
+
+def test_null_and_bool():
+    res = D.decode('{"host":"h","n":null,"b":true}')
+    assert ("_n", SDValue.null()) in res.sd[0].pairs
+    assert ("_b", SDValue.bool_(True)) in res.sd[0].pairs
+
+
+def test_missing_ts_defaults_to_now():
+    import time
+
+    res = D.decode('{"host":"h"}')
+    assert abs(res.ts - time.time()) < 5
+
+
+def test_newline_retry():
+    res = D.decode('{"host":"h","short_message":"a\nb"}')
+    assert res.msg == "a\nb"
+
+
+@pytest.mark.parametrize(
+    "bad,err",
+    [
+        ('{"some_key": []}', "Invalid value type in structured data"),
+        ('{"timestamp": "a string not a timestamp", "host": "h"}', "Invalid GELF timestamp"),
+        ('{some_key = "some_value"}', "Invalid GELF input"),
+        ('{"version":"42"}', "Unsupported GELF version"),
+        ('{"level": 8}', r"Invalid severity level \(too high\)"),
+        ('{"level": true}', "Invalid severity level$"),
+        ('{"host": 42}', "GELF host name must be a string"),
+        ('{"no_host": 1}', "Missing hostname"),
+        ("[1,2,3]", "Empty GELF input"),
+    ],
+)
+def test_errors(bad, err):
+    with pytest.raises(DecodeError, match=err):
+        D.decode(bad)
+
+
+def test_missing_hostname():
+    with pytest.raises(DecodeError, match="Missing hostname"):
+        D.decode('{"x": 1}')

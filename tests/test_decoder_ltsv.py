@@ -1,0 +1,121 @@
+"""LTSV decoder golden tests (reference: ltsv_decoder.rs:270-487)."""
+
+import pytest
+
+from flowgger_tpu.config import Config, ConfigError
+from flowgger_tpu.decoders import DecodeError, LTSVDecoder
+from flowgger_tpu.record import SDValue
+
+_SCHEMA_CFG = (
+    '[input]\n[input.ltsv_schema]\ncounter = "u64"\nscore = "i64"\n'
+    'mean = "f64"\ndone = "bool"\n'
+)
+
+
+def test_ltsv_full():
+    # ltsv_decoder.rs test_ltsv_3
+    decoder = LTSVDecoder(Config.from_string(_SCHEMA_CFG))
+    msg = (
+        "time:[10/Oct/2000:13:55:36.3 -0700]\tdone:true\tscore:-1\tmean:0.42\t"
+        "counter:42\tlevel:3\thost:testhostname\tname1:value1\t"
+        "name 2: value 2\tn3:v3\tmessage:this is a test"
+    )
+    res = decoder.decode(msg)
+    assert res.ts == 971211336.3
+    assert res.severity == 3
+    assert res.hostname == "testhostname"
+    assert res.msg == "this is a test"
+    assert res.full_msg == msg
+    (sd,) = res.sd
+    assert ("_name1", SDValue.string("value1")) in sd.pairs
+    assert ("_name 2", SDValue.string(" value 2")) in sd.pairs
+    assert ("_n3", SDValue.string("v3")) in sd.pairs
+    assert ("_counter", SDValue.u64(42)) in sd.pairs
+    assert ("_score", SDValue.i64(-1)) in sd.pairs
+    assert ("_done", SDValue.bool_(True)) in sd.pairs
+    mean = [v for k, v in sd.pairs if k == "_mean"][0]
+    assert mean.kind == SDValue.F64 and abs(mean.value - 0.42) < 1e-5
+
+
+def test_ltsv_unix_ts():
+    decoder = LTSVDecoder(Config.from_string(_SCHEMA_CFG))
+    res = decoder.decode("time:1438790025.99\thost:h\tname1:value1")
+    assert res.ts == 1438790025.99
+
+
+def test_ltsv_rfc3339_ts():
+    decoder = LTSVDecoder(Config.from_string(_SCHEMA_CFG))
+    res = decoder.decode("time:[2015-08-05T15:53:45.637824Z]\thost:h\tn:v")
+    assert res.ts == 1438790025.637824
+
+
+def test_ltsv_english_no_subsecond_offset():
+    decoder = LTSVDecoder(Config.from_string(_SCHEMA_CFG))
+    res = decoder.decode("time:[5/Aug/2015:15:53:45.637824 -0000]\thost:h\tn:v")
+    assert res.ts == 1438790025.637824
+
+
+def test_ltsv_suffixes():
+    config = Config.from_string(
+        _SCHEMA_CFG + '[input.ltsv_suffixes]\nu64 = "_u64"\ni64 = "_i64"\n'
+        'F64 = "_f64"\nBool = "_bool"\n'
+    )
+    decoder = LTSVDecoder(config)
+    msg = (
+        "time:[10/Oct/2000:13:55:36 -0700]\tdone:true\tscore:-1\tmean:0.42\t"
+        "counter:42\tlevel:3\thost:testhostname\tmessage:m"
+    )
+    res = decoder.decode(msg)
+    keys = {k for k, _ in res.sd[0].pairs}
+    assert keys == {"_counter_u64", "_score_i64", "_mean_f64", "_done_bool"}
+
+
+def test_ltsv_suffix_not_doubled():
+    config = Config.from_string(
+        '[input]\n[input.ltsv_schema]\ncounter_u64 = "U64"\n'
+        '[input.ltsv_suffixes]\nu64 = "_u64"\n'
+    )
+    decoder = LTSVDecoder(config)
+    res = decoder.decode("time:1.5\thost:h\tcounter_u64:42")
+    assert res.sd[0].pairs == [("_counter_u64", SDValue.u64(42))]
+
+
+def test_no_schema_all_strings():
+    decoder = LTSVDecoder(Config.from_string(""))
+    res = decoder.decode("time:1.5\thost:h\tx:42")
+    assert res.sd[0].pairs == [("_x", SDValue.string("42"))]
+
+
+@pytest.mark.parametrize(
+    "bad,err",
+    [
+        ("host:h\tx:1", "Missing timestamp"),
+        ("time:1.5\tx:1", "Missing hostname"),
+        ("time:1.5\thost:h\tlevel:9", "Severity level should be <= 7"),
+        ("time:1.5\thost:h\tlevel:abc", "Invalid severity level"),
+        ("time:bogus\thost:h", "Unable to parse the English to Unix timestamp"),
+    ],
+)
+def test_errors(bad, err):
+    decoder = LTSVDecoder(Config.from_string(""))
+    with pytest.raises(DecodeError, match=err):
+        decoder.decode(bad)
+
+
+def test_schema_type_errors():
+    decoder = LTSVDecoder(Config.from_string(_SCHEMA_CFG))
+    with pytest.raises(DecodeError, match="boolean was expected"):
+        decoder.decode("time:1.5\thost:h\tdone:yes")
+    with pytest.raises(DecodeError, match="u64 was expected"):
+        decoder.decode("time:1.5\thost:h\tcounter:-1")
+    with pytest.raises(DecodeError, match="i64 was expected"):
+        decoder.decode("time:1.5\thost:h\tscore:1.5")
+    with pytest.raises(DecodeError, match="f64 was expected"):
+        decoder.decode("time:1.5\thost:h\tmean:xyz")
+
+
+def test_bad_schema_config():
+    with pytest.raises(ConfigError, match="Unsupported type in input.ltsv_schema"):
+        LTSVDecoder(Config.from_string('[input.ltsv_schema]\nx = "u128"'))
+    with pytest.raises(ConfigError, match="Strings cannot be suffixed"):
+        LTSVDecoder(Config.from_string('[input.ltsv_suffixes]\nstring = "_s"'))

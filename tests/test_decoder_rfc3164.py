@@ -1,0 +1,82 @@
+"""RFC3164 decoder golden tests (reference: rfc3164_decoder.rs:215-425
+inline tests, with current-year-relative expectations computed like
+utils/test_utils.rs does)."""
+
+import pytest
+
+from flowgger_tpu.decoders import DecodeError, RFC3164Decoder
+from flowgger_tpu.utils.timeparse import current_year_utc, rfc3339_to_unix
+
+D = RFC3164Decoder()
+
+
+def _ts(month, day, h, m, s, year=None):
+    year = year if year is not None else current_year_utc()
+    return rfc3339_to_unix(f"{year:04d}-{month:02d}-{day:02d}T{h:02d}:{m:02d}:{s:02d}Z")
+
+
+def test_decode_nopri():
+    msg = "Aug  6 11:15:24 testhostname appname 69 42 some test message"
+    res = D.decode(msg)
+    assert res.facility is None and res.severity is None
+    assert res.ts == _ts(8, 6, 11, 15, 24)
+    assert res.hostname == "testhostname"
+    assert res.msg == "appname 69 42 some test message"
+    assert res.full_msg == msg
+
+
+def test_decode_with_pri():
+    msg = "<13>Aug  6 11:15:24 testhostname appname 69 42 msg"
+    res = D.decode(msg)
+    assert res.facility == 1 and res.severity == 5
+    assert res.hostname == "testhostname"
+
+
+def test_decode_with_year():
+    msg = "2019 Mar 27 12:09:39 testhostname msg text"
+    res = D.decode(msg)
+    assert res.ts == _ts(3, 27, 12, 9, 39, year=2019)
+    assert res.hostname == "testhostname"
+    assert res.msg == "msg text"
+
+
+def test_decode_with_tz():
+    msg = "2019 Mar 27 12:09:39 UTC testhostname msg text"
+    res = D.decode(msg)
+    assert res.ts == _ts(3, 27, 12, 9, 39, year=2019)
+    assert res.hostname == "testhostname"
+    assert res.msg == "msg text"
+
+
+def test_decode_custom_format():
+    # [<pri>]<hostname>: <datetime>: <message>
+    msg = "<34>mymachine: Mar 27 12:09:39: failed for lonvick on /dev/pts/8"
+    res = D.decode(msg)
+    assert res.facility == 4 and res.severity == 2
+    assert res.hostname == "mymachine"
+    assert res.ts == _ts(3, 27, 12, 9, 39)
+    assert res.msg == "failed for lonvick on /dev/pts/8"
+
+
+def test_custom_format_message_rejoined_with_colon_space():
+    msg = "host: Mar 27 12:09:39: part1: part2: part3"
+    res = D.decode(msg)
+    assert res.msg == "part1: part2: part3"
+
+
+def test_multiple_spaces_collapse():
+    msg = "Aug  6 11:15:24 host   appname  msg"
+    res = D.decode(msg)
+    assert res.msg == "appname msg"
+
+
+def test_errors(capsys):
+    with pytest.raises(DecodeError):
+        D.decode("not a syslog line at all")
+    captured = capsys.readouterr()
+    assert "Unable to parse the rfc3164 input" in captured.err
+
+
+def test_bad_pri():
+    with pytest.raises(DecodeError, match="Invalid priority"):
+        D.decode("<abc>Aug  6 11:15:24 host app msg")

@@ -1,0 +1,130 @@
+"""RFC5424 scalar decoder golden tests (reference:
+rfc5424_decoder.rs:244-314 plus error-path coverage)."""
+
+import pytest
+
+from flowgger_tpu.decoders import DecodeError, RFC5424Decoder
+from flowgger_tpu.record import SDValue
+
+D = RFC5424Decoder()
+
+GOLDEN = (
+    '<23>1 2015-08-05T15:53:45.637824Z testhostname appname 69 42 '
+    '[origin@123 software="te\\st sc\\"ript" swVersion="0.0.1"] test message'
+)
+
+
+def test_golden_decode():
+    res = D.decode(GOLDEN)
+    assert res.facility == 2
+    assert res.severity == 7
+    assert res.ts == 1438790025.637824
+    assert res.hostname == "testhostname"
+    assert res.appname == "appname"
+    assert res.procid == "69"
+    assert res.msgid == "42"
+    assert res.msg == "test message"
+    assert res.full_msg == GOLDEN
+    (sd,) = res.sd
+    assert sd.sd_id == "origin@123"
+    assert ("_software", SDValue.string('te\\st sc"ript')) in sd.pairs
+    assert ("_swVersion", SDValue.string("0.0.1")) in sd.pairs
+
+
+def test_golden_multiple_sd():
+    msg = (
+        '<23>1 2015-08-05T15:53:45.637824Z testhostname appname 69 42 '
+        '[origin@123 software="te\\st sc\\"ript" swVersion="0.0.1"]'
+        '[master@456 key="value" key2="value2"] test message'
+    )
+    res = D.decode(msg)
+    assert len(res.sd) == 2
+    assert res.sd[0].sd_id == "origin@123"
+    assert res.sd[1].sd_id == "master@456"
+    assert ("_key", SDValue.string("value")) in res.sd[1].pairs
+    assert ("_key2", SDValue.string("value2")) in res.sd[1].pairs
+    assert res.msg == "test message"
+
+
+def test_no_sd():
+    res = D.decode("<13>1 2015-08-05T15:53:45Z host app 1 2 - hello world")
+    assert res.sd is None
+    assert res.msg == "hello world"
+    assert res.facility == 1
+    assert res.severity == 5
+
+
+def test_no_msg():
+    res = D.decode("<13>1 2015-08-05T15:53:45Z host app 1 2 -")
+    assert res.msg is None
+    assert res.sd is None
+
+
+def test_empty_msg_after_dash():
+    res = D.decode("<13>1 2015-08-05T15:53:45Z host app 1 2 -   ")
+    assert res.msg is None
+    assert res.full_msg == "<13>1 2015-08-05T15:53:45Z host app 1 2 -"
+
+
+def test_bom():
+    res = D.decode("﻿<13>1 2015-08-05T15:53:45Z host app 1 2 - m")
+    assert res.hostname == "host"
+    assert res.full_msg == "<13>1 2015-08-05T15:53:45Z host app 1 2 - m"
+
+
+def test_sd_escape_rules():
+    # \" -> " ; \\ -> \ ; \] -> ] ; \x stays \x
+    res = D.decode(
+        '<13>1 2015-08-05T15:53:45Z h a p m [id k="a\\"b\\\\c\\]d\\xe"] -'
+    )
+    (sd,) = res.sd
+    assert sd.pairs == [("_k", SDValue.string('a"b\\c]d\\xe'))]
+
+
+def test_sd_value_with_spaces_and_brackets():
+    res = D.decode('<13>1 2015-08-05T15:53:45Z h a p m [id k="val [1] ok"] m')
+    (sd,) = res.sd
+    assert sd.pairs == [("_k", SDValue.string("val [1] ok"))]
+    assert res.msg == "m"
+
+
+@pytest.mark.parametrize(
+    "bad,err",
+    [
+        ("no-bracket", "Unsupported BOM"),
+        ("<13>2 2015-08-05T15:53:45Z h a p m - m", "Unsupported version"),
+        ("<999>1 2015-08-05T15:53:45Z h a p m - m", "Invalid priority"),
+        ("<abc>1 2015-08-05T15:53:45Z h a p m - m", "Invalid priority"),
+        ("<13>1 notadate h a p m - m", "Unable to parse the date"),
+        ("<13>1 2015-08-05T15:53:45Z h a p m x m", "Malformated RFC5424 message"),
+        ("<13>1 2015-08-05T15:53:45Z h a p", "Missing message id"),
+        ("<13>1 2015-08-05T15:53:45Z h", "Missing application name"),
+        ("<13>1", "Missing timestamp"),
+        ("<13>1 2015-08-05T15:53:45Z h a p m [id", "Missing structured data"),
+        ("<13>1 2015-08-05T15:53:45Z h a p m [id k=\"v\"", "Missing ] after structured data"),
+    ],
+)
+def test_errors(bad, err):
+    with pytest.raises(DecodeError, match=err.replace("[", "\\[").replace("]", "\\]")):
+        D.decode(bad)
+
+
+def test_sd_no_pairs_requires_space():
+    # "[id]" without pairs: the sd_id swallows ']' and the block never
+    # terminates -- reference behavior (splitn on ' ' in parse_sd_data)
+    with pytest.raises(DecodeError):
+        D.decode("<13>1 2015-08-05T15:53:45Z h a p m [id] m")
+
+
+def test_sd_empty_block_with_space():
+    res = D.decode("<13>1 2015-08-05T15:53:45Z h a p m [id ] m")
+    (sd,) = res.sd
+    assert sd.sd_id == "id"
+    assert sd.pairs == []
+    assert res.msg == "m"
+
+
+def test_trailing_whitespace_trimmed():
+    res = D.decode("<13>1 2015-08-05T15:53:45Z h a p m - msg here   ")
+    assert res.msg == "msg here"
+    assert res.full_msg == "<13>1 2015-08-05T15:53:45Z h a p m - msg here"

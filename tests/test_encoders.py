@@ -1,0 +1,207 @@
+"""Encoder golden tests (reference: gelf_encoder.rs:123-243,
+ltsv_encoder.rs tests, rfc5424_encoder.rs:103-206,
+rfc3164_encoder.rs/passthrough_encoder.rs tests)."""
+
+import pytest
+
+from flowgger_tpu.config import Config, ConfigError
+from flowgger_tpu.encoders import (
+    GelfEncoder,
+    LTSVEncoder,
+    PassthroughEncoder,
+    RFC3164Encoder,
+    RFC5424Encoder,
+)
+from flowgger_tpu.record import Record, SDValue, StructuredData
+
+
+def _record_full():
+    sd = StructuredData("someid", [("_some_info", SDValue.string("foo"))])
+    return Record(
+        ts=1385053862.3072,
+        hostname="example.org",
+        severity=1,
+        appname="appname",
+        procid="44",
+        msg="A short message that helps you identify what is going on",
+        full_msg="Backtrace here\n\nmore stuff",
+        sd=[sd],
+    )
+
+
+def test_gelf_encode():
+    # gelf_encoder.rs:123-148 byte-exact golden
+    expected = (
+        '{"_some_info":"foo","application_name":"appname","full_message":'
+        '"Backtrace here\\n\\nmore stuff","host":"example.org","level":1,'
+        '"process_id":"44","sd_id":"someid","secret-token":"secret",'
+        '"short_message":"A short message that helps you identify what is going on",'
+        '"timestamp":1385053862.3072,"version":"1.1"}'
+    )
+    config = Config.from_string('[output.gelf_extra]\nsecret-token = "secret"')
+    assert GelfEncoder(config).encode(_record_full()).decode() == expected
+
+
+def test_gelf_encode_empty_hostname():
+    expected = (
+        '{"host":"unknown","level":1,"short_message":'
+        '"A short message that helps you identify what is going on",'
+        '"timestamp":1385053862.3072,"version":"1.1"}'
+    )
+    record = Record(
+        ts=1385053862.3072, hostname="", severity=1,
+        msg="A short message that helps you identify what is going on",
+    )
+    assert GelfEncoder(Config.from_string("")).encode(record).decode() == expected
+
+
+def test_gelf_encode_replace_extra():
+    expected = (
+        '{"a_key":"bar","host":"unknown","level":1,"short_message":'
+        '"A short message that helps you identify what is going on",'
+        '"timestamp":1385053862.3072,"version":"1.1"}'
+    )
+    config = Config.from_string('[output.gelf_extra]\na_key = "bar"')
+    record = Record(
+        ts=1385053862.3072, hostname="", severity=1,
+        msg="A short message that helps you identify what is going on",
+        sd=[StructuredData(None, [("a_key", SDValue.string("foo"))])],
+    )
+    assert GelfEncoder(config).encode(record).decode() == expected
+
+
+def test_gelf_encode_multiple_sd():
+    # gelf_encoder.rs:216-243: later SD elements overwrite, sd_id = last
+    config = Config.from_string('[output.gelf_extra]\nsecret-token = "secret"')
+    record = _record_full()
+    record.sd.append(StructuredData("someid2", [("info", SDValue.f64(123.456))]))
+    out = GelfEncoder(config).encode(record).decode()
+    assert '"sd_id":"someid2"' in out
+    assert '"info":123.456' in out
+
+
+def test_gelf_extra_must_be_table():
+    with pytest.raises(ConfigError, match="output.gelf_extra must be a list of key/value pairs"):
+        GelfEncoder(Config.from_string('[output]\ngelf_extra = "bar"'))
+
+
+def test_gelf_extra_values_must_be_strings():
+    with pytest.raises(ConfigError, match="output.gelf_extra values must be strings"):
+        GelfEncoder(Config.from_string("[output.gelf_extra]\n_some_info = 42"))
+
+
+def test_ltsv_encode():
+    record = Record(
+        ts=1385053862.3072,
+        hostname="example.org",
+        severity=1,
+        msg="A short message",
+        sd=[StructuredData("someid", [
+            ("_some_info", SDValue.string("foo")),
+            ("_x", SDValue.u64(42)),
+            ("_f", SDValue.f64(0.5)),
+            ("_b", SDValue.bool_(True)),
+            ("_n", SDValue.null()),
+        ])],
+    )
+    out = LTSVEncoder(Config.from_string("")).encode(record).decode()
+    assert out == (
+        "some_info:foo\tx:42\tf:0.5\tb:true\tn:\t"
+        "host:example.org\ttime:1385053862.3072\tmessage:A short message\tlevel:1"
+    )
+
+
+def test_ltsv_escaping():
+    record = Record(
+        ts=1.5, hostname="h",
+        sd=[StructuredData(None, [("_k:ey\n", SDValue.string("va\tl\nue"))])],
+    )
+    out = LTSVEncoder(Config.from_string("")).encode(record).decode()
+    assert out == "k_ey :va l ue\thost:h\ttime:1.5"
+
+
+def test_ltsv_extra():
+    config = Config.from_string('[output.ltsv_extra]\nxk = "xv"')
+    record = Record(ts=2.0, hostname="h")
+    out = LTSVEncoder(config).encode(record).decode()
+    assert out == "xk:xv\thost:h\ttime:2"
+
+
+def test_rfc5424_encode_minimal():
+    # rfc5424_encoder.rs:103-125
+    from flowgger_tpu.utils.timeparse import rfc3339_to_unix
+
+    expected = "<13>1 2015-08-06T11:15:24.638Z testhostname - - - some test message"
+    record = Record(ts=rfc3339_to_unix("2015-08-06T11:15:24.638Z"),
+                    hostname="testhostname", msg="some test message")
+    assert RFC5424Encoder().encode(record).decode() == expected
+
+
+def test_rfc5424_encode_full():
+    expected = (
+        '<25>1 2015-08-05T15:53:45.382Z testhostname appname 69 42 '
+        '[origin@123 software="test sc\\"ript" swVersion="0.0.1"] test message'
+    )
+    record = Record(
+        ts=1438790025.382, hostname="testhostname", facility=3, severity=1,
+        appname="appname", procid="69", msgid="42", msg="test message",
+        sd=[StructuredData("origin@123", [
+            ("software", SDValue.string('test sc\\"ript')),
+            ("swVersion", SDValue.string("0.0.1")),
+        ])],
+    )
+    assert RFC5424Encoder().encode(record).decode() == expected
+
+
+def test_rfc5424_encode_multiple_sd():
+    record = Record(
+        ts=1438790025.382, hostname="h", facility=3, severity=1,
+        appname="a", procid="p", msgid="m", msg="msg",
+        sd=[
+            StructuredData("a@1", [("k1", SDValue.string("v1"))]),
+            StructuredData("b@2", [("k2", SDValue.string("v2"))]),
+        ],
+    )
+    out = RFC5424Encoder().encode(record).decode()
+    assert '[a@1 k1="v1"][b@2 k2="v2"]' in out
+
+
+def test_rfc3164_encode():
+    from flowgger_tpu.utils.timeparse import rfc3339_to_unix
+
+    record = Record(
+        ts=rfc3339_to_unix("2015-08-06T11:15:24Z"), hostname="testhostname",
+        facility=3, severity=1,
+        appname="appname", procid="69", msgid="42", msg="test message",
+    )
+    out = RFC3164Encoder(Config.from_string("")).encode(record).decode()
+    assert out == "<25>Aug  6 11:15:24 testhostname appname[69]: 42 test message"
+
+
+def test_rfc3164_encode_nopri():
+    from flowgger_tpu.utils.timeparse import rfc3339_to_unix
+
+    record = Record(ts=rfc3339_to_unix("2015-08-06T11:15:24Z"), hostname="h", msg="m")
+    out = RFC3164Encoder(Config.from_string("")).encode(record).decode()
+    assert out == "Aug  6 11:15:24 h m"
+
+
+def test_passthrough():
+    raw = "Aug  6 11:15:24 testhostname appname 69 42 test message"
+    record = Record(ts=1.2, hostname="abcd", full_msg=raw)
+    out = PassthroughEncoder(Config.from_string("")).encode(record).decode()
+    assert out == raw
+
+
+def test_passthrough_no_full_msg():
+    from flowgger_tpu.encoders import EncodeError
+
+    with pytest.raises(EncodeError, match="Cannot output empty raw message"):
+        PassthroughEncoder(Config.from_string("")).encode(Record(ts=1.0, hostname="h"))
+
+
+def test_prepend_timestamp():
+    config = Config.from_string('[output]\nsyslog_prepend_timestamp = "[year]-"')
+    record = Record(ts=1.2, hostname="h", full_msg="RAW")
+    out = PassthroughEncoder(config).encode(record).decode()
+    assert out.endswith("-RAW") and len(out) == len("YYYY-RAW")

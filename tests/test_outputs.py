@@ -1,0 +1,122 @@
+"""Output/rotation tests with tempdirs and mocked clocks (reference:
+file_output.rs:220-590, rotating_file.rs:374-543)."""
+
+import queue
+
+from flowgger_tpu.config import Config
+from flowgger_tpu.mergers import LineMerger
+from flowgger_tpu.outputs import SHUTDOWN
+from flowgger_tpu.outputs.file_output import FileOutput
+from flowgger_tpu.utils.rotating_file import BufferedWriter, RotatingFile
+
+
+def _drain(output, items, merger=None):
+    tx = queue.Queue()
+    thread = output.start(tx, merger)
+    for item in items:
+        tx.put(item)
+    tx.put(SHUTDOWN)
+    thread.join(timeout=10)
+    assert not thread.is_alive()
+
+
+def test_file_output_basic(tmp_path):
+    path = tmp_path / "out.log"
+    config = Config.from_string(f'[output]\nfile_path = "{path}"')
+    _drain(FileOutput(config), [b"one", b"two"], LineMerger())
+    assert path.read_bytes() == b"one\ntwo\n"
+
+
+def test_file_output_append(tmp_path):
+    path = tmp_path / "out.log"
+    path.write_bytes(b"pre\n")
+    config = Config.from_string(f'[output]\nfile_path = "{path}"')
+    _drain(FileOutput(config), [b"new"], LineMerger())
+    assert path.read_bytes() == b"pre\nnew\n"
+
+
+def test_file_output_missing_path():
+    import pytest
+
+    from flowgger_tpu.config import ConfigError
+
+    with pytest.raises(ConfigError, match="output.file_path is missing"):
+        FileOutput(Config.from_string("[output]"))
+
+
+def test_rotating_size(tmp_path):
+    path = tmp_path / "out.log"
+    rf = RotatingFile(str(path), max_size=10, max_time=0, max_files=3,
+                      time_format="[year]")
+    rf.open()
+    rf.write(b"123456789\n")   # fills current file exactly (10 bytes)
+    rf.write(b"abcdef\n")      # would exceed -> rotates first
+    rf.close()
+    assert (tmp_path / "out.0").read_bytes() == b"123456789\n"
+    assert path.read_bytes() == b"abcdef\n"
+
+
+def test_rotating_size_shift_chain(tmp_path):
+    path = tmp_path / "out.log"
+    rf = RotatingFile(str(path), max_size=4, max_time=0, max_files=2,
+                      time_format="[year]")
+    rf.open()
+    for payload in (b"aaaa", b"bbbb", b"cccc", b"dddd"):
+        rf.write(payload)
+    rf.close()
+    # maxfiles=2: out.0 and out.1 kept, oldest dropped
+    assert path.read_bytes() == b"dddd"
+    assert (tmp_path / "out.0").read_bytes() == b"cccc"
+    assert (tmp_path / "out.1").read_bytes() == b"bbbb"
+    assert not (tmp_path / "out.2").exists()
+
+
+def test_rotating_time(tmp_path):
+    clock = {"now": 1_000_000_000.0}
+    path = tmp_path / "out.log"
+    rf = RotatingFile(str(path), max_size=0, max_time=1, max_files=2,
+                      time_format="[hour][minute][second]",
+                      now_fn=lambda: clock["now"])
+    rf.open()
+    rf.write(b"first\n")
+    clock["now"] += 61  # past the 1-minute deadline
+    rf.write(b"second\n")
+    rf.close()
+    files = sorted(p.name for p in tmp_path.iterdir())
+    assert len(files) == 2
+    contents = sorted(p.read_bytes() for p in tmp_path.iterdir())
+    assert contents == [b"first\n", b"second\n"]
+
+
+def test_time_rotation_filename_format(tmp_path):
+    clock = {"now": 0.0}  # 1970-01-01T00:00:00
+    path = tmp_path / "base.log"
+    rf = RotatingFile(str(path), max_size=0, max_time=5, max_files=2,
+                      time_format="[year][month][day]T[hour][minute][second]Z",
+                      now_fn=lambda: clock["now"])
+    rf.open()
+    rf.write(b"x")
+    rf.close()
+    assert (tmp_path / "base-19700101T000000Z.log").exists()
+
+
+def test_buffered_writer(tmp_path):
+    path = tmp_path / "out.log"
+    f = RotatingFile.open_file(str(path))
+    bw = BufferedWriter(f, capacity=8)
+    bw.write(b"abc")
+    assert path.read_bytes() == b""       # still buffered
+    bw.write(b"defgh")                    # 3+5=8 <= 8 stays buffered
+    assert path.read_bytes() == b""
+    bw.write(b"i")                        # would exceed -> flush first
+    assert path.read_bytes() == b"abcdefgh"
+    bw.flush()
+    assert path.read_bytes() == b"abcdefghi"
+    bw.close()
+
+
+def test_debug_output(capsys):
+    from flowgger_tpu.outputs import DebugOutput
+
+    _drain(DebugOutput(Config.from_string("")), [b"hello"], LineMerger())
+    assert capsys.readouterr().out == "hello\n"

@@ -1,0 +1,35 @@
+"""Record model golden tests (reference: record.rs:93-132 inline tests)."""
+
+from flowgger_tpu.record import Record, SDValue, StructuredData
+
+
+def test_structured_data_display():
+    # record.rs:94 expected string
+    data = StructuredData(
+        "someid",
+        [
+            ("a", SDValue.string("a string")),
+            ("b", SDValue.u64(123456)),
+            ("c", SDValue.bool_(True)),
+            ("d", SDValue.f64(123.456)),
+            ("e", SDValue.i64(-123456)),
+            ("_f", SDValue.null()),
+        ],
+    )
+    assert data.to_string() == '[someid a="a string" b="123456" c="true" d="123.456" e="-123456" f]'
+
+
+def test_structured_data_strips_single_underscore():
+    data = StructuredData(None, [("__x", SDValue.string("v"))])
+    assert data.to_string() == '[ _x="v"]'
+
+
+def test_sd_display_integral_float():
+    # Rust Display renders 1.0f64 as "1"
+    data = StructuredData("id", [("k", SDValue.f64(1.0))])
+    assert data.to_string() == '[id k="1"]'
+
+
+def test_record_defaults():
+    r = Record(ts=123.456, hostname="hostname")
+    assert r.facility is None and r.sd is None and r.msg is None

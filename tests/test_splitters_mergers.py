@@ -1,0 +1,142 @@
+"""Splitter and merger tests using the in-memory queue harness the
+reference uses (udp_input.rs:182-233 pattern)."""
+
+import io
+import queue
+
+from flowgger_tpu.config import Config
+from flowgger_tpu.decoders import RFC5424Decoder
+from flowgger_tpu.encoders import GelfEncoder, PassthroughEncoder
+from flowgger_tpu.mergers import LineMerger, NulMerger, SyslenMerger
+from flowgger_tpu.splitters import (
+    CapnpSplitter,
+    LineSplitter,
+    NulSplitter,
+    ScalarHandler,
+    SyslenSplitter,
+)
+
+LINE = "<13>1 2015-08-05T15:53:45Z host app 1 2 - hello"
+
+
+def _scalar_handler(tx, encoder_cls=PassthroughEncoder):
+    return ScalarHandler(tx, RFC5424Decoder(), encoder_cls(Config.from_string("")))
+
+
+def test_line_splitter():
+    tx = queue.Queue()
+    stream = io.BytesIO(f"{LINE}\n{LINE}\r\n{LINE}".encode())
+    LineSplitter().run(stream, _scalar_handler(tx))
+    out = [tx.get_nowait() for _ in range(3)]
+    assert out == [LINE.encode()] * 3
+    assert tx.empty()
+
+
+def test_line_splitter_skips_invalid_utf8(capsys):
+    tx = queue.Queue()
+    stream = io.BytesIO(b"\xff\xfe bogus\n" + LINE.encode() + b"\n")
+    LineSplitter().run(stream, _scalar_handler(tx))
+    assert tx.get_nowait() == LINE.encode()
+    assert "Invalid UTF-8 input" in capsys.readouterr().err
+
+
+def test_line_splitter_reports_decode_errors(capsys):
+    tx = queue.Queue()
+    stream = io.BytesIO(b"garbage line\n")
+    LineSplitter().run(stream, _scalar_handler(tx))
+    assert tx.empty()
+    assert "Unsupported BOM: [garbage line]" in capsys.readouterr().err
+
+
+def test_nul_splitter():
+    tx = queue.Queue()
+    stream = io.BytesIO(f"{LINE}\0{LINE}\0".encode())
+    NulSplitter().run(stream, _scalar_handler(tx))
+    assert [tx.get_nowait() for _ in range(2)] == [LINE.encode()] * 2
+
+
+def test_syslen_splitter():
+    tx = queue.Queue()
+    framed = f"{len(LINE)} {LINE}".encode() * 1  # single message
+    framed += f"{len(LINE)} {LINE}".encode()
+    stream = io.BytesIO(framed)
+    SyslenSplitter().run(stream, _scalar_handler(tx))
+    assert [tx.get_nowait() for _ in range(2)] == [LINE.encode()] * 2
+
+
+def test_syslen_splitter_bad_length(capsys):
+    tx = queue.Queue()
+    stream = io.BytesIO(b"notanumber " + LINE.encode())
+    SyslenSplitter().run(stream, _scalar_handler(tx))
+    assert tx.empty()
+    assert "Can't read message's length" in capsys.readouterr().err
+
+
+def test_capnp_splitter():
+    from flowgger_tpu import capnp_wire
+    from flowgger_tpu.record import Record, SDValue, StructuredData
+
+    record = Record(ts=3.5, hostname="h", facility=2, severity=1, appname="a",
+                    procid="p", msgid="m", msg="msg", full_msg="full",
+                    sd=[StructuredData("sid", [("_k", SDValue.string("v"))])])
+    data = capnp_wire.encode_record(record, []) * 2  # two messages back to back
+    tx = queue.Queue()
+    CapnpSplitter().run(io.BytesIO(data), _scalar_handler(tx))
+    assert tx.get_nowait() == b"full"
+    assert tx.get_nowait() == b"full"
+    assert tx.empty()
+
+
+def test_capnp_splitter_gelf_encode():
+    """capnp input bypasses the decoder entirely (mod.rs:413-416)."""
+    from flowgger_tpu import capnp_wire
+    from flowgger_tpu.record import Record
+
+    record = Record(ts=3.5, hostname="h")
+    tx = queue.Queue()
+    CapnpSplitter().run(
+        io.BytesIO(capnp_wire.encode_record(record, [])),
+        _scalar_handler(tx, GelfEncoder),
+    )
+    out = tx.get_nowait().decode()
+    assert '"host":"h"' in out and '"timestamp":3.5' in out
+    # capnp null text reads as "": msg defaults, sd present with empty id
+    assert '"short_message":""' in out
+
+
+def test_mergers():
+    assert LineMerger().frame(b"abc") == b"abc\n"
+    assert NulMerger().frame(b"abc") == b"abc\0"
+    # syslen counts payload + newline (syslen_merger.rs:17)
+    assert SyslenMerger().frame(b"abc") == b"4 abc\n"
+
+
+def test_syslen_merger_roundtrip():
+    """syslen merger output must re-split through the syslen splitter
+    (the framed payload includes the trailing newline; rfc5424 decode
+    rstrips it into full_msg)."""
+    tx = queue.Queue()
+    framed = SyslenMerger().frame(LINE.encode())
+    SyslenSplitter().run(io.BytesIO(framed), _scalar_handler(tx))
+    assert tx.get_nowait() == LINE.encode()
+
+
+def test_nul_splitter_suppresses_empty_frame_errors(capsys):
+    # nul_splitter.rs:41-45: errors on all-whitespace frames are silent
+    tx = queue.Queue()
+    stream = io.BytesIO(f"{LINE}\0\0 \0{LINE}\0".encode())
+    NulSplitter().run(stream, _scalar_handler(tx))
+    assert [tx.get_nowait() for _ in range(2)] == [LINE.encode()] * 2
+    assert capsys.readouterr().err == ""
+
+
+def test_capnp_splitter_survives_malformed_message(capsys):
+    """Malformed wire data must not raise out of the input loop."""
+    import struct
+
+    # valid segment table pointing at garbage words
+    bogus = struct.pack("<II", 0, 4) + b"\xff" * 32
+    tx = queue.Queue()
+    CapnpSplitter().run(io.BytesIO(bogus), _scalar_handler(tx))
+    assert tx.empty()
+    assert "Capnp decoding error" in capsys.readouterr().err

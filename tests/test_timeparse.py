@@ -1,0 +1,118 @@
+"""Calendar/timestamp parity tests; expected values come from the
+reference's inline tests (rfc5424_decoder.rs:244-314, ltsv_decoder.rs
+tests, rfc5424_encoder.rs:103-125)."""
+
+import pytest
+
+from flowgger_tpu.utils.timeparse import (
+    civil_from_days,
+    days_from_civil,
+    format_rfc3164_header_ts,
+    format_time_description,
+    parse_english_time,
+    parse_rfc3164_ts,
+    rfc3339_to_unix,
+    unix_to_rfc3339_ms,
+)
+
+
+def test_rfc3339_reference_value():
+    # rfc5424_decoder.rs:253 asserts this exact f64
+    assert rfc3339_to_unix("2015-08-05T15:53:45.637824Z") == 1438790025.637824
+
+
+def test_rfc3339_offset():
+    assert rfc3339_to_unix("2015-08-05T15:53:45+02:00") == 1438790025.0 - 2 * 3600
+
+
+def test_rfc3339_negative_offset():
+    assert rfc3339_to_unix("2015-08-05T15:53:45-01:30") == 1438790025.0 + 90 * 60
+
+
+def test_rfc3339_lowercase_t_z():
+    assert rfc3339_to_unix("2015-08-05t15:53:45z") == 1438790025.0
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        "2015-08-05 15:53:45Z",      # space separator
+        "2015-13-05T15:53:45Z",      # bad month
+        "2015-02-30T15:53:45Z",      # bad day
+        "2015-08-05T24:00:00Z",      # bad hour
+        "2015-08-05T15:53:45",       # missing offset
+        "2015-08-05T15:53:45.Z",     # empty subsecond
+        "2015-08-05T15:53:45.0123456789Z",  # >9 subsecond digits
+        "not a date",
+        "",
+    ],
+)
+def test_rfc3339_rejects(bad):
+    with pytest.raises(ValueError):
+        rfc3339_to_unix(bad)
+
+
+def test_civil_roundtrip():
+    for z in (-719468, -1, 0, 1, 11016, 16651, 20000):
+        assert days_from_civil(*civil_from_days(z)) == z
+
+
+def test_unix_to_rfc3339_ms():
+    # rfc5424_encoder.rs:105 / :129 golden timestamps
+    assert unix_to_rfc3339_ms(rfc3339_to_unix("2015-08-06T11:15:24.638Z")) \
+        == "2015-08-06T11:15:24.638Z"
+    assert unix_to_rfc3339_ms(1438790025.382) == "2015-08-05T15:53:45.382Z"
+    assert unix_to_rfc3339_ms(1438790025.0) == "2015-08-05T15:53:45Z"
+    # trailing zeros trimmed
+    assert unix_to_rfc3339_ms(1438790025.5) == "2015-08-05T15:53:45.5Z"
+
+
+def test_english_time():
+    # ltsv_decoder.rs test_ltsv_3: [10/Oct/2000:13:55:36.3 -0700]
+    assert parse_english_time("10/Oct/2000:13:55:36.3 -0700") == 971211336.3
+    # ltsv4: 5/Aug/2015:15:53:45.637824 -0000
+    assert parse_english_time("5/Aug/2015:15:53:45.637824 -0000") == 1438790025.637824
+    assert parse_english_time("10/Oct/2000:13:55:36 -0700") == 971211336.0
+
+
+def test_rfc3164_ts_with_year():
+    ts, consumed = parse_rfc3164_ts(["2019", "Mar", "27", "12:09:39"], has_year=True)
+    assert ts == rfc3339_to_unix("2019-03-27T12:09:39Z")
+    assert consumed == 4
+
+
+def test_rfc3164_ts_with_tz():
+    ts, consumed = parse_rfc3164_ts(
+        ["2019", "Mar", "27", "12:09:39", "UTC", "host"], has_year=True
+    )
+    assert consumed == 5
+    assert ts == rfc3339_to_unix("2019-03-27T12:09:39Z")
+
+
+def test_rfc3164_ts_with_real_tz():
+    ts, consumed = parse_rfc3164_ts(
+        ["2019", "Jul", "27", "12:09:39", "Europe/Paris"], has_year=True
+    )
+    assert consumed == 5
+    # Paris in July is UTC+2
+    assert ts == rfc3339_to_unix("2019-07-27T12:09:39+02:00")
+
+
+def test_format_time_description():
+    ts = rfc3339_to_unix("2022-04-25T10:43:00Z")
+    assert format_time_description("[year][month][day]T[hour][minute][second]Z", ts) \
+        == "20220425T104300Z"
+    assert format_time_description("[month repr:short] [day padding:none]", ts) == "Apr 25"
+
+
+def test_format_rfc3164_header():
+    ts = rfc3339_to_unix("2015-08-06T11:15:24Z")
+    assert format_rfc3164_header_ts(ts) == "Aug  6 11:15:24 "
+
+
+def test_rejects_unicode_digits():
+    # Rust rejects non-ASCII digits; the oracle must match the TPU kernel
+    with pytest.raises(ValueError):
+        rfc3339_to_unix("٢٠٢٦-07-28T00:00:00Z")
+    with pytest.raises(ValueError):
+        parse_english_time("١٠/Oct/2000:13:55:36 -0700")
