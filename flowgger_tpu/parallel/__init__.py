@@ -1,0 +1,23 @@
+"""Multi-chip parallelism for the batched decode tier.
+
+The reference's parallelism is thread-per-connection on one host
+(SURVEY.md §2.8); log decode has no cross-record dependencies, so the
+TPU-native scale-out is sharding the batch over a device mesh:
+
+- ``dp`` (data parallel): rows (= log lines) split across chips; zero
+  communication — the embarrassingly-parallel axis.
+- ``sp`` (sequence parallel): the byte axis of the packed ``[N, L]``
+  tensor split across chips, for very long records (the analogue of the
+  reference's records-spanning-buffer-boundaries concern, SURVEY.md §5).
+  The kernel's cumulative scans and top_k reductions then span shards;
+  XLA inserts the ICI collectives (the "pick a mesh, annotate shardings,
+  let XLA insert collectives" recipe).
+
+Multi-host: the same mesh spans hosts (jax.distributed), dp traffic
+rides DCN trivially since there is none; sp stays intra-host by
+construction when ``sp`` ≤ chips-per-host.
+"""
+
+from .mesh import decode_sharded, make_decode_mesh, make_sharded_decode_fn
+
+__all__ = ["make_decode_mesh", "make_sharded_decode_fn", "decode_sharded"]

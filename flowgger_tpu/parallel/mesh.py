@@ -1,0 +1,52 @@
+"""Device-mesh sharding for the columnar decoders."""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..tpu import rfc5424
+
+
+def make_decode_mesh(devices: Optional[Sequence] = None,
+                     sp: int = 1) -> Mesh:
+    """Mesh over ``devices`` with axes (dp, sp).  ``sp`` > 1 enables
+    sequence-parallel decode of the packed byte axis."""
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if n % sp != 0:
+        raise ValueError(f"device count {n} not divisible by sp={sp}")
+    arr = np.asarray(devices).reshape(n // sp, sp)
+    return Mesh(arr, axis_names=("dp", "sp"))
+
+
+def make_sharded_decode_fn(mesh: Mesh, max_sd: int = rfc5424.DEFAULT_MAX_SD,
+                           max_pairs: int = rfc5424.DEFAULT_MAX_PAIRS):
+    """jit the columnar decoder over the mesh: rows over dp, bytes over
+    sp.  Outputs are row-sharded over dp (replicated over sp), ready for
+    a sharded columnar encode stage or host gather."""
+    batch_sharding = NamedSharding(mesh, P("dp", "sp"))
+    lens_sharding = NamedSharding(mesh, P("dp"))
+    out_sharding = NamedSharding(mesh, P("dp"))
+
+    @functools.partial(
+        jax.jit,
+        in_shardings=(batch_sharding, lens_sharding),
+        out_shardings=out_sharding,
+    )
+    def fn(batch, lens):
+        return rfc5424.decode_rfc5424(batch, lens, max_sd=max_sd,
+                                      max_pairs=max_pairs)
+
+    return fn
+
+
+def decode_sharded(mesh: Mesh, batch, lens):
+    """One-shot helper: shard inputs onto the mesh and decode."""
+    fn = make_sharded_decode_fn(mesh)
+    return fn(batch, lens)

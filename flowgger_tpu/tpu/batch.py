@@ -1,0 +1,122 @@
+"""BatchHandler: the TPU-path replacement for ScalarHandler.
+
+Accumulates framed lines into a batch arena, ships the arena to the
+device (pack + columnar decode in one jitted call), materializes Records,
+encodes, and enqueues — preserving input order and the reference's
+per-line error behavior (stderr + drop, line_splitter.rs:37-54).
+
+Latency bound (SURVEY.md §7 hard-parts): the batch flushes when it
+reaches ``input.tpu_batch_size`` lines (default 16384), when
+``input.tpu_flush_ms`` (default 50) elapses with data pending, or at end
+of stream — at most one batch-fill window of added latency vs the
+scalar path.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+from typing import List, Optional
+
+import numpy as np
+
+from ..config import Config
+from ..encoders import EncodeError
+from ..splitters import Handler, ScalarHandler
+from ..record import Record
+
+DEFAULT_BATCH_SIZE = 16384
+DEFAULT_FLUSH_MS = 50
+DEFAULT_MAX_LINE_LEN = 512
+
+
+class BatchHandler(Handler):
+    def __init__(self, tx, decoder, encoder, config: Optional[Config] = None,
+                 fmt: str = "rfc5424", start_timer: bool = True):
+        self.tx = tx
+        self.encoder = encoder
+        self.fmt = fmt
+        # scalar path for fallback rows and capnp handle_record
+        self.scalar = ScalarHandler(tx, decoder, encoder)
+        cfg = config or Config.from_string("")
+        self.batch_size = cfg.lookup_int(
+            "input.tpu_batch_size", "input.tpu_batch_size must be an integer",
+            DEFAULT_BATCH_SIZE)
+        self.flush_ms = cfg.lookup_int(
+            "input.tpu_flush_ms", "input.tpu_flush_ms must be an integer",
+            DEFAULT_FLUSH_MS)
+        self.max_len = cfg.lookup_int(
+            "input.tpu_max_line_len", "input.tpu_max_line_len must be an integer",
+            DEFAULT_MAX_LINE_LEN)
+        self._lines: List[bytes] = []
+        self._lock = threading.Lock()
+        # serializes batch decodes so a timer flush racing a size flush
+        # cannot reorder output
+        self._decode_lock = threading.Lock()
+        self._timer: Optional[threading.Timer] = None
+        self._start_timer = start_timer
+        self._has_kernel = fmt == "rfc5424"
+
+    # -- Handler interface -------------------------------------------------
+    def handle_bytes(self, raw: bytes) -> None:
+        with self._lock:
+            self._lines.append(raw)
+            full = len(self._lines) >= self.batch_size
+            if not full and self._timer is None and self._start_timer:
+                self._timer = threading.Timer(self.flush_ms / 1000.0, self.flush)
+                self._timer.daemon = True
+                self._timer.start()
+        if full:
+            self.flush()
+
+    def handle_record(self, record: Record) -> None:
+        self.scalar.handle_record(record)
+
+    def flush(self) -> None:
+        with self._lock:
+            lines, self._lines = self._lines, []
+            if self._timer is not None:
+                self._timer.cancel()
+                self._timer = None
+        if lines:
+            with self._decode_lock:
+                self._decode_batch(lines)
+
+    # -- batched decode ----------------------------------------------------
+    def _decode_batch(self, lines: List[bytes]) -> None:
+        if not self._has_kernel:
+            # formats without a columnar kernel yet: scalar per line
+            for raw in lines:
+                self.scalar.handle_bytes(raw)
+            return
+        results = _decode_rfc5424_batch(lines, self.max_len)
+        for res in results:
+            if res.record is None:
+                if res.error == "__utf8__":
+                    print("Invalid UTF-8 input", file=sys.stderr)
+                else:
+                    stripped = res.line.strip()
+                    if not (self.quiet_empty and not stripped):
+                        print(f"{res.error}: [{stripped}]", file=sys.stderr)
+                continue
+            try:
+                encoded = self.encoder.encode(res.record)
+            except EncodeError as e:
+                stripped = res.line.strip()
+                if not (self.quiet_empty and not stripped):
+                    print(f"{e}: [{stripped}]", file=sys.stderr)
+                continue
+            self.tx.put(encoded)
+
+
+def _decode_rfc5424_batch(lines, max_len):
+    import jax.numpy as jnp
+
+    from . import materialize, pack, rfc5424
+
+    batch, lens, chunk, starts, orig_lens, n_real = pack.pack_lines_2d(lines, max_len)
+    out = rfc5424.decode_rfc5424_jit(jnp.asarray(batch), jnp.asarray(lens))
+    host_out = {k: np.asarray(v) for k, v in out.items()}
+    return materialize.materialize(chunk, starts, lens, orig_lens, host_out,
+                                   n_real, max_len)
+

@@ -1,0 +1,111 @@
+"""Host-side batch packing: list-of-lines → (chunk, starts, lens) with
+static padded shapes.
+
+The arena replaces the reference's per-line ``Vec<u8>`` channel payloads
+(mod.rs:461-468): lines are concatenated into one contiguous chunk and
+described by offset/length vectors; the actual ``[N, L]`` gather happens
+on device (tpu/rfc5424.py pack_on_device), so the host's per-line work is
+one ``bytes.join``.  Shapes are bucketed to powers of two to bound XLA
+recompilations.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import numpy as np
+
+_MIN_ROWS = 256
+_MIN_BYTES = 1 << 14
+
+
+def _next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p <<= 1
+    return p
+
+
+def pack_lines(lines: List[bytes]) -> Tuple[np.ndarray, np.ndarray, np.ndarray, int]:
+    """Concatenate lines into a padded chunk.
+
+    Returns (chunk uint8[B], starts int32[Np], lens int32[Np], n_real)
+    where B and Np are bucketed; rows past n_real are zero-length padding.
+    """
+    n = len(lines)
+    chunk = b"".join(lines)
+    lens = np.fromiter((len(ln) for ln in lines), dtype=np.int32, count=n)
+    starts = np.zeros(n, dtype=np.int32)
+    if n > 1:
+        np.cumsum(lens[:-1], out=starts[1:])
+    np_rows = max(_MIN_ROWS, _next_pow2(n))
+    nb = max(_MIN_BYTES, _next_pow2(len(chunk)))
+    buf = np.zeros(nb, dtype=np.uint8)
+    if chunk:
+        buf[: len(chunk)] = np.frombuffer(chunk, dtype=np.uint8)
+    starts_p = np.zeros(np_rows, dtype=np.int32)
+    lens_p = np.zeros(np_rows, dtype=np.int32)
+    starts_p[:n] = starts
+    lens_p[:n] = lens
+    return buf, starts_p, lens_p, n
+
+
+def pack_lines_2d(lines: List[bytes], max_len: int
+                  ) -> Tuple[np.ndarray, np.ndarray, bytes, np.ndarray, np.ndarray, int]:
+    """Pack lines into a dense ``[N, max_len]`` uint8 batch on the host
+    (vectorized numpy gather — XLA's device gather lowers near-serially
+    on TPU, so the transpose-to-dense happens here).
+
+    Returns (batch, clipped_lens, chunk, starts, orig_lens, n_real) with
+    N bucketed to a power of two.
+    """
+    n = len(lines)
+    chunk = b"".join(lines)
+    orig_lens = np.fromiter((len(ln) for ln in lines), dtype=np.int32, count=n)
+    starts = np.zeros(n, dtype=np.int32)
+    if n > 1:
+        np.cumsum(orig_lens[:-1], out=starts[1:])
+    np_rows = max(_MIN_ROWS, _next_pow2(n))
+    buf = np.frombuffer(chunk, dtype=np.uint8)
+    lens_c = np.minimum(orig_lens, max_len)
+    batch = np.zeros((np_rows, max_len), dtype=np.uint8)
+    if n:
+        idx = starts[:, None] + np.arange(max_len, dtype=np.int32)[None, :]
+        np.clip(idx, 0, max(buf.size - 1, 0), out=idx)
+        mask = np.arange(max_len, dtype=np.int32)[None, :] < lens_c[:, None]
+        np.multiply(buf[idx], mask, out=batch[:n], casting="unsafe")
+    starts_p = np.zeros(np_rows, dtype=np.int32)
+    lens_p = np.zeros(np_rows, dtype=np.int32)
+    starts_p[:n] = starts
+    lens_p[:n] = lens_c
+    return batch, lens_p, chunk, starts_p, orig_lens, n
+
+
+def split_chunk(chunk: bytes, strip_cr: bool = True
+                ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, int, bytes]:
+    """Newline-split a raw chunk columnar-ly (no per-line Python): returns
+    (buf, starts, lens, n_real, carry) where carry is the trailing partial
+    line to prepend to the next chunk — the batcher's version of the
+    splitter's BufRead carry (SURVEY.md §5 long-context note)."""
+    buf = np.frombuffer(chunk, dtype=np.uint8)
+    nl = np.flatnonzero(buf == 10).astype(np.int32)
+    if nl.size == 0:
+        return buf, np.zeros(0, np.int32), np.zeros(0, np.int32), 0, chunk
+    starts = np.concatenate([np.zeros(1, np.int32), nl[:-1] + 1])
+    ends = nl.copy()
+    if strip_cr:
+        # drop one trailing \r per line (BufRead::lines semantics)
+        has_cr = (ends > starts) & (buf[np.maximum(ends - 1, 0)] == 13)
+        ends = ends - has_cr.astype(np.int32)
+    lens = ends - starts
+    carry = chunk[int(nl[-1]) + 1:]
+    n = int(nl.size)
+    np_rows = max(_MIN_ROWS, _next_pow2(n))
+    nb = max(_MIN_BYTES, _next_pow2(buf.size))
+    buf_p = np.zeros(nb, dtype=np.uint8)
+    buf_p[: buf.size] = buf
+    starts_p = np.zeros(np_rows, dtype=np.int32)
+    lens_p = np.zeros(np_rows, dtype=np.int32)
+    starts_p[:n] = starts
+    lens_p[:n] = lens
+    return buf_p, starts_p, lens_p, n, carry

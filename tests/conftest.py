@@ -1,6 +1,11 @@
 """Test environment: force JAX onto a virtual 8-device CPU mesh so the
 multi-chip sharding paths compile and execute without TPU hardware
-(SURVEY.md §7 / driver contract)."""
+(SURVEY.md §7 / driver contract).
+
+The axon TPU plugin's sitecustomize sets jax_platforms to "axon,cpu" at
+interpreter start, clobbering JAX_PLATFORMS=cpu from the environment —
+so re-assert the env var's intent on the config after importing jax.
+"""
 
 import os
 
@@ -10,3 +15,9 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+_want = os.environ.get("JAX_PLATFORMS", "")
+if _want and "axon" not in _want:
+    jax.config.update("jax_platforms", _want)
