@@ -1,0 +1,93 @@
+"""File input: tail files matching a glob, discovering new ones.
+
+Parity model: /root/reference/src/flowgger/input/file/{mod,discovery,worker}.rs.
+``input.src`` is a glob; matching files that exist at startup are tailed
+from EOF (worker.rs:89-91), files appearing later are read from the
+start.  The reference uses inotify; this implementation polls (stdlib
+has no inotify binding) — discovery rescans the glob and workers poll
+their file for growth, both on a short interval.  Truncation (size
+shrinks) rewinds to the new end, matching follow-reader behavior.
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import os
+import sys
+import threading
+import time
+
+from . import Input
+from ..config import Config, ConfigError
+
+POLL_INTERVAL_S = 0.05
+DISCOVERY_INTERVAL_S = 0.5
+
+
+class FileWorker:
+    def __init__(self, path: str, handler, from_tail: bool):
+        self.path = path
+        self.handler = handler
+        self.from_tail = from_tail
+        self.stop = threading.Event()
+
+    def run(self):
+        try:
+            fd = open(self.path, "rb")
+        except OSError as e:
+            print(f"Failed to open file {self.path}: {e}", file=sys.stderr)
+            return
+        if self.from_tail:
+            fd.seek(0, os.SEEK_END)
+        from ..splitters import LineAssembler
+
+        asm = LineAssembler(self.handler)
+        while not self.stop.is_set():
+            chunk = fd.read(1 << 16)
+            if chunk:
+                asm.push(chunk)
+                continue
+            # no growth: check for truncation/deletion
+            try:
+                size = os.path.getsize(self.path)
+            except OSError:
+                return  # file removed
+            if size < fd.tell():
+                fd.seek(0, os.SEEK_END)
+            if hasattr(self.handler, "flush"):
+                self.handler.flush()
+            time.sleep(POLL_INTERVAL_S)
+
+
+class FileInput(Input):
+    def __init__(self, config: Config):
+        src = config.lookup("input.src")
+        if src is None:
+            raise ConfigError("input.src is missing")
+        if not isinstance(src, str):
+            raise ConfigError("input.src must be a string")
+        self.src = src
+
+    def accept(self, handler_factory) -> None:
+        workers = {}
+
+        def start_worker(path: str, from_tail: bool):
+            worker = FileWorker(path, handler_factory(), from_tail)
+            t = threading.Thread(target=worker.run, daemon=True,
+                                 name=f"file-worker-{path}")
+            t.start()
+            workers[path] = (worker, t)
+
+        for path in _glob.glob(self.src):
+            if os.path.isfile(path):
+                start_worker(path, from_tail=True)
+        while True:
+            time.sleep(DISCOVERY_INTERVAL_S)
+            for path in _glob.glob(self.src):
+                if os.path.isfile(path) and path not in workers:
+                    start_worker(path, from_tail=False)
+            # reap workers whose files vanished so they can be re-tailed
+            for path in list(workers):
+                worker, t = workers[path]
+                if not t.is_alive() and not os.path.exists(path):
+                    del workers[path]

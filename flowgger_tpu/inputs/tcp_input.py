@@ -1,0 +1,149 @@
+"""TCP input: thread-per-connection (plus a coroutine variant).
+
+Parity model: /root/reference/src/flowgger/input/tcp/{mod,tcp_input}.rs
+(defaults: listen 0.0.0.0:514, read timeout 3600s, line framing;
+``input.framed = true`` selects syslen unless ``input.framing`` is set)
+and tcpco_input.rs for the coroutine tier (the reference uses `may`
+coroutines with ``input.tcp_threads`` workers; here: one asyncio event
+loop with cooperative connection handling).
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+
+from . import Input
+from ..config import Config, ConfigError
+from ..splitters import get_splitter
+
+DEFAULT_FRAMING = "line"
+DEFAULT_LISTEN = "0.0.0.0:514"
+DEFAULT_THREADS = 1
+DEFAULT_TIMEOUT = 3600
+
+
+def parse_listen(listen: str):
+    host, _, port = listen.rpartition(":")
+    if not host or not port.isdigit():
+        raise ConfigError("unable to parse ip:port string from input.listen")
+    return host, int(port)
+
+
+def tcp_config_parse(config: Config, threads_key: str = "input.tcp_threads"):
+    listen = config.lookup_str(
+        "input.listen", "input.listen must be an ip:port string", DEFAULT_LISTEN)
+    threads = config.lookup_int(
+        threads_key, f"{threads_key} must be an unsigned integer", DEFAULT_THREADS)
+    timeout = config.lookup_int(
+        "input.timeout", "input.timeout must be an unsigned integer", DEFAULT_TIMEOUT)
+    framed = config.lookup_bool(
+        "input.framed", "input.framed must be a boolean", False)
+    framing = "syslen" if framed else DEFAULT_FRAMING
+    framing = config.lookup_str(
+        "input.framing",
+        'input.framing must be a string set to "line", "nul" or "syslen"',
+        framing)
+    return framing, threads, listen, timeout
+
+
+class SocketStream:
+    """read(n) view over a socket; timeouts surface as TimeoutError
+    (the splitters treat that as the reference's WouldBlock idle-close)."""
+
+    def __init__(self, sock: socket.socket):
+        self.sock = sock
+
+    def read(self, n: int) -> bytes:
+        return self.sock.recv(n)
+
+
+class TcpInput(Input):
+    def __init__(self, config: Config):
+        self.framing, _, self.listen, self.timeout = tcp_config_parse(config)
+        self.bound_port = None
+
+    def accept(self, handler_factory) -> None:
+        self._handler_factory = handler_factory
+        host, port = parse_listen(self.listen)
+        listener = socket.create_server((host, port))
+        self.bound_port = listener.getsockname()[1]
+        while True:
+            try:
+                client, peer = listener.accept()
+            except OSError:
+                return
+            client.settimeout(self.timeout)
+            print(f"Connection over TCP from [{peer[0]}:{peer[1]}]")
+            threading.Thread(target=self._handle_client, args=(client,),
+                             daemon=True).start()
+
+    def _handle_client(self, client: socket.socket):
+        splitter = get_splitter(self.framing)
+        try:
+            splitter.run(SocketStream(client), self._handler_factory())
+        finally:
+            try:
+                client.close()
+            except OSError:
+                pass
+
+
+class TcpCoInput(TcpInput):
+    """Coroutine tier: cooperative handling on an asyncio loop
+    (tcpco_input.rs:25-47)."""
+
+    def __init__(self, config: Config):
+        self.framing, self.threads, self.listen, self.timeout = tcp_config_parse(config)
+        self.bound_port = None
+
+    def accept(self, handler_factory) -> None:
+        import asyncio
+
+        host, port = parse_listen(self.listen)
+        framing = self.framing
+        timeout = self.timeout
+
+        async def handle(reader: "asyncio.StreamReader", writer):
+            peer = writer.get_extra_info("peername")
+            if peer:
+                print(f"Connection over TCP from [{peer[0]}:{peer[1]}]")
+            handler = handler_factory()
+            splitter = get_splitter(framing)
+            stream = _AsyncBridgeStream(reader, timeout)
+            # splitters are synchronous; run each connection's split loop
+            # in the executor so the loop stays free for accepts while
+            # reads await in the bridge
+            loop = asyncio.get_running_loop()
+            await loop.run_in_executor(None, splitter.run, stream, handler)
+            writer.close()
+
+        async def serve():
+            server = await asyncio.start_server(handle, host, port)
+            self.bound_port = server.sockets[0].getsockname()[1]
+            async with server:
+                await server.serve_forever()
+
+        asyncio.run(serve())
+
+
+class _AsyncBridgeStream:
+    """Synchronous read() facade over an asyncio StreamReader."""
+
+    def __init__(self, reader, timeout):
+        import asyncio
+
+        self.reader = reader
+        self.timeout = timeout
+        self.loop = asyncio.get_running_loop()
+
+    def read(self, n: int) -> bytes:
+        import asyncio
+        import concurrent.futures
+
+        fut = asyncio.run_coroutine_threadsafe(
+            asyncio.wait_for(self.reader.read(n), self.timeout), self.loop)
+        try:
+            return fut.result()
+        except (asyncio.TimeoutError, concurrent.futures.TimeoutError):
+            raise TimeoutError

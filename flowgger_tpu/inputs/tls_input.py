@@ -1,0 +1,175 @@
+"""TLS input: TCP + TLS handshake per connection.
+
+Parity model: /root/reference/src/flowgger/input/tls/{mod,tls_input}.rs.
+Config keys: input.listen (default 0.0.0.0:6514), input.tls_cert /
+input.tls_key (default flowgger.pem), input.tls_ciphers,
+input.tls_compatibility_level ("default"/"any"/"intermediate" → TLS1.0+,
+"modern" → TLS1.2+), input.tls_verify_peer (+ input.tls_ca_file),
+input.tls_compression (Python's ssl always disables TLS compression; a
+``true`` here warns and proceeds), input.timeout, input.framing/framed.
+The reference's custom ffdhe DH parameters (tls/mod.rs:41-49) have no
+ssl-module equivalent; ECDHE suites cover forward secrecy.
+"""
+
+from __future__ import annotations
+
+import socket
+import ssl
+import sys
+import threading
+
+from . import Input
+from ..config import Config, ConfigError
+from ..splitters import get_splitter
+from .tcp_input import SocketStream, parse_listen
+
+DEFAULT_CERT = "flowgger.pem"
+DEFAULT_KEY = "flowgger.pem"
+DEFAULT_LISTEN = "0.0.0.0:6514"
+DEFAULT_TIMEOUT = 3600
+DEFAULT_FRAMING = "line"
+DEFAULT_COMPATIBILITY = "default"
+DEFAULT_VERIFY_PEER = False
+TLS_VERIFY_DEPTH = 6
+DEFAULT_CIPHERS = (
+    "ECDHE-ECDSA-AES128-GCM-SHA256:ECDHE-RSA-AES128-GCM-SHA256:"
+    "ECDHE-ECDSA-CHACHA20-POLY1305:ECDHE-RSA-CHACHA20-POLY1305:"
+    "ECDHE-ECDSA-AES256-GCM-SHA384:ECDHE-RSA-AES256-GCM-SHA384:"
+    "AES128-GCM-SHA256:AES256-GCM-SHA384:AES128-SHA256:AES256-SHA256"
+)
+
+
+def tls_config_parse(config: Config, side: str = "input"):
+    """Shared TLS context construction for the input (server) side; the
+    output (client) side mirrors this in outputs/tls_output.py."""
+    listen = config.lookup_str(
+        "input.listen", "input.listen must be an ip:port string", DEFAULT_LISTEN)
+    timeout = config.lookup_int(
+        "input.timeout", "input.timeout must be an unsigned integer", DEFAULT_TIMEOUT)
+    framed = config.lookup_bool(
+        "input.framed", "input.framed must be a boolean", False)
+    framing = "syslen" if framed else DEFAULT_FRAMING
+    framing = config.lookup_str(
+        "input.framing",
+        'input.framing must be a string set to "line", "nul" or "syslen"',
+        framing)
+    cert = config.lookup_str(
+        "input.tls_cert", "input.tls_cert must be a path to a .pem file", DEFAULT_CERT)
+    key = config.lookup_str(
+        "input.tls_key", "input.tls_key must be a path to a .pem file", DEFAULT_KEY)
+    ciphers = config.lookup_str(
+        "input.tls_ciphers", "input.tls_ciphers must be a string with a cipher suite",
+        DEFAULT_CIPHERS)
+    compat = config.lookup_str(
+        "input.tls_compatibility_level",
+        "input.tls_compatibility_level must be a string with the compatibility level",
+        DEFAULT_COMPATIBILITY)
+    verify_peer = config.lookup_bool(
+        "input.tls_verify_peer", "input.tls_verify_peer must be a boolean",
+        DEFAULT_VERIFY_PEER)
+    ca_file = config.lookup_str(
+        "input.tls_ca_file", "input.tls_ca_file must be a path to a file")
+    compression = config.lookup_bool(
+        "input.tls_compression", "input.tls_compression must be a boolean", False)
+
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    if compat.lower() in ("default", "any", "intermediate"):
+        ctx.minimum_version = ssl.TLSVersion.TLSv1
+    elif compat.lower() == "modern":
+        ctx.minimum_version = ssl.TLSVersion.TLSv1_2
+    else:
+        raise ConfigError(
+            "Unsupported TLS compatibility level. Supported levels are: default, any, intermediate and modern"
+        )
+    try:
+        ctx.load_cert_chain(certfile=cert, keyfile=key)
+    except (OSError, ssl.SSLError) as e:
+        raise ConfigError(f"Unable to load the TLS certificate/key [{cert}]: {e}")
+    try:
+        ctx.set_ciphers(ciphers)
+    except ssl.SSLError:
+        raise ConfigError("Unsupported TLS cipher suite")
+    if verify_peer:
+        ctx.verify_mode = ssl.CERT_REQUIRED
+        ctx.verify_flags |= ssl.VERIFY_X509_STRICT
+        if ca_file is not None:
+            ctx.load_verify_locations(cafile=ca_file)
+    else:
+        ctx.verify_mode = ssl.CERT_NONE
+    if compression:
+        print("WARNING: TLS compression is not supported by the ssl module; "
+              "continuing without it", file=sys.stderr)
+    return ctx, framing, listen, timeout
+
+
+class TlsInput(Input):
+    def __init__(self, config: Config):
+        self.ctx, self.framing, self.listen, self.timeout = tls_config_parse(config)
+        self.bound_port = None
+
+    def accept(self, handler_factory) -> None:
+        self._handler_factory = handler_factory
+        host, port = parse_listen(self.listen)
+        listener = socket.create_server((host, port))
+        self.bound_port = listener.getsockname()[1]
+        while True:
+            try:
+                client, peer = listener.accept()
+            except OSError:
+                return
+            client.settimeout(self.timeout)
+            print(f"Connection over TLS from [{peer[0]}:{peer[1]}]")
+            threading.Thread(target=self._handle_client, args=(client,),
+                             daemon=True).start()
+
+    def _handle_client(self, client: socket.socket):
+        try:
+            tls_sock = self.ctx.wrap_socket(client, server_side=True)
+        except (ssl.SSLError, OSError) as e:
+            print(f"TLS handshake failed: {e}", file=sys.stderr)
+            try:
+                client.close()
+            except OSError:
+                pass
+            return
+        splitter = get_splitter(self.framing)
+        try:
+            splitter.run(SocketStream(tls_sock), self._handler_factory())
+        finally:
+            try:
+                tls_sock.close()
+            except OSError:
+                pass
+
+
+class TlsCoInput(TlsInput):
+    """Coroutine tier over asyncio TLS (tlsco_input.rs:25-47)."""
+
+    def accept(self, handler_factory) -> None:
+        import asyncio
+
+        from .tcp_input import _AsyncBridgeStream
+
+        host, port = parse_listen(self.listen)
+        framing = self.framing
+        timeout = self.timeout
+        ctx = self.ctx
+
+        async def handle(reader, writer):
+            peer = writer.get_extra_info("peername")
+            if peer:
+                print(f"Connection over TLS from [{peer[0]}:{peer[1]}]")
+            handler = handler_factory()
+            splitter = get_splitter(framing)
+            stream = _AsyncBridgeStream(reader, timeout)
+            loop = asyncio.get_running_loop()
+            await loop.run_in_executor(None, splitter.run, stream, handler)
+            writer.close()
+
+        async def serve():
+            server = await asyncio.start_server(handle, host, port, ssl=ctx)
+            self.bound_port = server.sockets[0].getsockname()[1]
+            async with server:
+                await server.serve_forever()
+
+        asyncio.run(serve())

@@ -1,17 +1,117 @@
 """Kafka producer output with message coalescing.
 
 Parity model: /root/reference/src/flowgger/output/kafka_output.rs:13-212.
-Implemented in the outputs milestone; see repo task list.
+``output.kafka_brokers`` (required list), ``kafka_topic`` (required),
+``kafka_acks`` -1/0/1, ``kafka_timeout`` ms, ``kafka_threads``,
+``kafka_coalesce`` (buffer N messages then send_all), ``kafka_compression``
+none/gzip (snappy is rejected here — no snappy codec without deps).
+An unresponsive broker terminates the process (exit 1), matching the
+reference's supervisor-restart contract; output framing is ignored with
+a warning.  Transport: utils/kafka_wire.py, a from-scratch minimal
+protocol client.
 """
 
 from __future__ import annotations
 
-from . import Output
+import sys
+import threading
+
+from . import Output, SHUTDOWN
+from ..config import Config, ConfigError
+from ..utils.kafka_wire import KafkaError, KafkaProducer
+
+KAFKA_DEFAULT_ACKS = 0
+KAFKA_DEFAULT_COALESCE = 1
+KAFKA_DEFAULT_COMPRESSION = "none"
+KAFKA_DEFAULT_THREADS = 1
+KAFKA_DEFAULT_TIMEOUT = 60_000
 
 
-class KafkaOutput(Output):  # pragma: no cover - placeholder, full impl pending
-    def __init__(self, config):
-        raise NotImplementedError("KafkaOutput: implementation lands with the outputs milestone")
+class KafkaOutput(Output):
+    def __init__(self, config: Config):
+        self.acks = config.lookup_int(
+            "output.kafka_acks", "output.kafka_acks must be a 16-bit integer",
+            KAFKA_DEFAULT_ACKS)
+        if self.acks not in (-1, 0, 1):
+            raise ConfigError("Unsupported value for kafka_acks")
+        brokers = config.lookup("output.kafka_brokers")
+        if brokers is None:
+            raise ConfigError("output.kafka_brokers is required")
+        if not isinstance(brokers, list) or not all(isinstance(b, str) for b in brokers):
+            raise ConfigError("output.kafka_brokers must be a list of strings")
+        self.brokers = brokers
+        topic = config.lookup("output.kafka_topic")
+        if topic is None or not isinstance(topic, str):
+            raise ConfigError("output.kafka_topic must be a string")
+        self.topic = topic
+        self.timeout_ms = config.lookup_int(
+            "output.kafka_timeout", "output.kafka_timeout must be a 64-bit integer",
+            KAFKA_DEFAULT_TIMEOUT)
+        self.threads = config.lookup_int(
+            "output.kafka_threads", "output.kafka_threads must be a 32-bit integer",
+            KAFKA_DEFAULT_THREADS)
+        self.coalesce = config.lookup_int(
+            "output.kafka_coalesce", "output.kafka_coalesce must be a size integer",
+            KAFKA_DEFAULT_COALESCE)
+        compression = config.lookup_str(
+            "output.kafka_compression",
+            # sic: the reference's panic message has this typo
+            # (kafka_output.rs:169 "output.kafka_compresion must be a string")
+            "output.kafka_compresion must be a string",
+            KAFKA_DEFAULT_COMPRESSION).lower()
+        if compression not in ("none", "gzip", "snappy"):
+            raise ConfigError("Unsupported compression method")
+        if compression == "snappy":
+            raise ConfigError(
+                "Unsupported compression method: snappy needs an external codec; "
+                "use gzip or none")
+        self.compression = compression
+        self.exit_on_failure = True  # tests disable to keep pytest alive
+
+    def _worker(self, arx, merger):
+        try:
+            producer = KafkaProducer(self.brokers, self.acks, self.timeout_ms,
+                                     self.compression)
+            producer.refresh_metadata(self.topic)
+        except KafkaError as e:
+            print(f"Unable to connect to Kafka: [{e}]")
+            return self._die()
+        queue_buf = []
+        while True:
+            item = arx.get()
+            if item is SHUTDOWN:
+                try:
+                    producer.send_all(self.topic, queue_buf)
+                except KafkaError as e:
+                    print(f"Kafka not responsive: [{e}]")
+                    arx.task_done()
+                    return self._die()
+                arx.task_done()
+                return None
+            queue_buf.append(item)
+            if len(queue_buf) >= max(1, self.coalesce):
+                try:
+                    producer.send_all(self.topic, queue_buf)
+                except KafkaError as e:
+                    print(f"Kafka not responsive: [{e}]")
+                    arx.task_done()
+                    return self._die()
+                queue_buf = []
+            arx.task_done()
+
+    def _die(self):
+        if self.exit_on_failure:
+            import os
+
+            os._exit(1)
 
     def start(self, arx, merger):
-        raise NotImplementedError
+        if merger is not None:
+            print("Output framing is ignored with the Kafka output", file=sys.stderr)
+        threads = []
+        for _ in range(self.threads):
+            t = threading.Thread(target=self._worker, args=(arx, merger),
+                                 daemon=True, name="kafka-output")
+            t.start()
+            threads.append(t)
+        return threads

@@ -34,6 +34,8 @@ class Handler:
     """Sink for framed messages coming out of a splitter."""
 
     quiet_empty = False  # NulSplitter sets this: suppress empty-frame errors
+    bare_errors = False  # UdpInput sets this: errors print without the line
+                         # (udp_input.rs:84-86 vs line_splitter.rs:38)
 
     def handle_bytes(self, raw: bytes) -> None:
         raise NotImplementedError
@@ -71,6 +73,9 @@ class ScalarHandler(Handler):
             record = self.decoder.decode(line)
             encoded = self.encoder.encode(record)
         except (DecodeError, EncodeError) as e:
+            if self.bare_errors:
+                print(e, file=sys.stderr)
+                return
             stripped = line.strip()
             if not (self.quiet_empty and not stripped):
                 print(f"{e}: [{stripped}]", file=sys.stderr)
@@ -91,12 +96,40 @@ class Splitter:
         raise NotImplementedError
 
 
+class LineAssembler:
+    """Carry-over framing: split incoming chunks on a separator, holding
+    the partial tail until the next chunk — the same carry the TPU
+    batcher keeps between batches (SURVEY.md §5 long-context note).
+    Shared by the stream splitters and the file tailer."""
+
+    def __init__(self, handler: Handler, sep: bytes = b"\n", strip_cr: bool = True):
+        self.handler = handler
+        self.sep = sep
+        self.strip_cr = strip_cr
+        self.carry = b""
+
+    def push(self, chunk: bytes) -> None:
+        parts = (self.carry + chunk).split(self.sep)
+        self.carry = parts.pop()
+        for part in parts:
+            if self.strip_cr and part.endswith(b"\r"):
+                part = part[:-1]
+            self.handler.handle_bytes(part)
+
+    def finish(self) -> None:
+        """Emit the trailing partial line (BufRead::lines yields it too)."""
+        if self.carry:
+            part = self.carry
+            self.carry = b""
+            if self.strip_cr and part.endswith(b"\r"):
+                part = part[:-1]
+            self.handler.handle_bytes(part)
+
+
 def _read_chunks_split(stream, handler: Handler, sep: bytes, strip_cr: bool):
-    """Shared chunked scan for line/nul framing.  The reference's BufRead
-    loop is sequential per byte-window; here the split is a bulk
-    ``bytes.split`` per chunk (C speed) with carry-over of the partial
-    tail — the same carry the TPU batcher uses between batches."""
-    carry = b""
+    """Shared chunked scan for line/nul framing: bulk ``bytes.split`` per
+    chunk (C speed) instead of the reference's per-byte BufRead loop."""
+    asm = LineAssembler(handler, sep, strip_cr)
     while True:
         try:
             chunk = stream.read(_CHUNK)
@@ -110,16 +143,8 @@ def _read_chunks_split(stream, handler: Handler, sep: bytes, strip_cr: bool):
             break
         if not chunk:
             break
-        parts = (carry + chunk).split(sep)
-        carry = parts.pop()
-        for part in parts:
-            if strip_cr and part.endswith(b"\r"):
-                part = part[:-1]
-            handler.handle_bytes(part)
-    if carry:
-        if strip_cr and carry.endswith(b"\r"):
-            carry = carry[:-1]
-        handler.handle_bytes(carry)
+        asm.push(chunk)
+    asm.finish()
     handler.flush()
 
 

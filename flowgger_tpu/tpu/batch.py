@@ -94,6 +94,8 @@ class BatchHandler(Handler):
             if res.record is None:
                 if res.error == "__utf8__":
                     print("Invalid UTF-8 input", file=sys.stderr)
+                elif self.bare_errors:
+                    print(res.error, file=sys.stderr)
                 else:
                     stripped = res.line.strip()
                     if not (self.quiet_empty and not stripped):

@@ -72,10 +72,13 @@ def _days_from_civil(y, m, d):
 
 
 def _days_in_month(y, m):
-    lengths = jnp.array([31, 28, 31, 30, 31, 30, 31, 31, 30, 31, 30, 31], _I32)
-    base = lengths[jnp.clip(m - 1, 0, 11)]
+    # arithmetic form (no table lookup — gathers are banned, and Mosaic
+    # can't lower them anyway): 31 for odd months through July and even
+    # months from August, 30 otherwise, February special-cased
+    is31 = jnp.where(m >= 8, (m % 2) == 0, (m % 2) == 1)
+    base = jnp.where(is31, 31, 30)
     leap = (y % 4 == 0) & ((y % 100 != 0) | (y % 400 == 0))
-    return jnp.where((m == 2) & leap, 29, base)
+    return jnp.where(m == 2, jnp.where(leap, 29, 28), base)
 
 
 def _shift_right(arr, k, fill):
@@ -87,10 +90,41 @@ def _shift_left(arr, k, fill):
     return jnp.pad(arr[:, k:], ((0, 0), (0, k)), constant_values=fill)
 
 
+def _cumsum(x, impl: str):
+    """Inclusive prefix sum along axis 1.  ``impl='manual'`` uses a
+    Hillis–Steele log-shift ladder built only from pad/slice/add, which
+    Mosaic (Pallas TPU) lowers where lax's scan-based cumsum cannot."""
+    if impl == "lax":
+        return jnp.cumsum(x, axis=1)
+    x = x.astype(_I32)
+    L = x.shape[1]
+    k = 1
+    while k < L:
+        x = x + _shift_right(x, k, 0)
+        k <<= 1
+    return x
+
+
+def _cummax(x, impl: str):
+    if impl == "lax":
+        return jax.lax.cummax(x, axis=1)
+    L = x.shape[1]
+    k = 1
+    neg = jnp.iinfo(x.dtype).min
+    while k < L:
+        x = jnp.maximum(x, _shift_right(x, k, neg))
+        k <<= 1
+    return x
+
+
 def decode_rfc5424(batch: jnp.ndarray, lens: jnp.ndarray,
                    max_sd: int = DEFAULT_MAX_SD,
-                   max_pairs: int = DEFAULT_MAX_PAIRS) -> Dict[str, jnp.ndarray]:
-    """Decode a packed ``[N, L]`` uint8 batch (jit/pjit/shard_map safe)."""
+                   max_pairs: int = DEFAULT_MAX_PAIRS,
+                   scan_impl: str = "lax") -> Dict[str, jnp.ndarray]:
+    """Decode a packed ``[N, L]`` uint8 batch (jit/pjit/shard_map safe).
+
+    ``scan_impl='manual'`` makes all prefix scans Mosaic-lowerable so the
+    same body runs inside the Pallas block kernel."""
     N, L = batch.shape
     lens = lens.astype(_I32)
     iota = jax.lax.broadcasted_iota(_I32, (N, L), 1)
@@ -115,7 +149,7 @@ def decode_rfc5424(batch: jnp.ndarray, lens: jnp.ndarray,
 
     # ---- first six spaces → header field spans ---------------------------
     is_sp = (bb == 32) & valid
-    sp_ord = jnp.cumsum(is_sp, axis=1)  # int32 [N,L] — inclusive ordinal
+    sp_ord = _cumsum(is_sp, scan_impl)  # int32 [N,L] — inclusive ordinal
     sp = jnp.stack(
         [_min_where(is_sp & (sp_ord == k + 1), iota, L) for k in range(6)],
         axis=1,
@@ -222,13 +256,13 @@ def decode_rfc5424(batch: jnp.ndarray, lens: jnp.ndarray,
     # escaped[i]: odd run of backslashes immediately before i
     is_bs = (bb == 92) & valid
     non_bs_pos = jnp.where(~is_bs, iota, -1)
-    last_non_bs = jax.lax.cummax(non_bs_pos, axis=1)
+    last_non_bs = _cummax(non_bs_pos, scan_impl)
     prev_last = _shift_right(last_non_bs, 1, -1)
     escaped = ((iota - 1 - prev_last) % 2) == 1
 
     quote = (bb == ord('"')) & in_rest
     real_q = quote & ~escaped
-    q_excl = jnp.cumsum(real_q, axis=1) - real_q
+    q_excl = _cumsum(real_q, scan_impl) - real_q
     outside = (q_excl % 2) == 0
     open_q = real_q & outside
     close_q = real_q & ~outside
@@ -251,7 +285,7 @@ def decode_rfc5424(batch: jnp.ndarray, lens: jnp.ndarray,
         + ((next_bb == ord("[")) & _shift_left(valid, 1, False)).astype(_I32) * 2
         + ((next_bb == 32) & _shift_left(valid, 1, False)).astype(_I32) * 4
     )
-    rb_ord = jnp.cumsum(rbrack, axis=1)
+    rb_ord = _cumsum(rbrack, scan_impl)
     packed_pos = (iota << POS_SHIFT)
     rb_packed = [
         _min_where(rbrack & (rb_ord == k + 1), packed_pos + rb_payload, NOTF)
@@ -261,9 +295,14 @@ def decode_rfc5424(batch: jnp.ndarray, lens: jnp.ndarray,
     rb_flags = jnp.stack([p & 0xFFF for p in rb_packed], axis=1)
     rb_found = rb_pos < L
 
-    cont = jnp.cumprod(((rb_flags[:, :max_sd] & 2) != 0) & rb_found[:, :max_sd],
-                       axis=1)
-    sd_count_raw = 1 + cont.sum(axis=1)
+    # running AND over the (small, static) block axis
+    chain_alive = ((rb_flags[:, :max_sd] & 2) != 0) & rb_found[:, :max_sd]
+    sd_count_raw = jnp.ones_like(lens)
+    alive = chain_alive[:, 0]
+    for k in range(max_sd):
+        sd_count_raw = sd_count_raw + alive.astype(_I32)
+        if k + 1 < max_sd:
+            alive = alive & chain_alive[:, k + 1]
     sd_count = jnp.where(is_sd, sd_count_raw, 0)
     # sd_end / flags of the terminating ']' via a small where-chain
     last_idx = jnp.clip(sd_count - 1, 0, max_sd)
@@ -320,20 +359,20 @@ def decode_rfc5424(batch: jnp.ndarray, lens: jnp.ndarray,
     # ---- pair extraction -------------------------------------------------
     # lookback channels ride a cummax of pos<<8|byte over non-name bytes
     nn = ~name_struct
-    nn_packed = jax.lax.cummax(
-        jnp.where(nn, (iota << 8) | bb.astype(_I32), -1), axis=1)
+    nn_packed = _cummax(
+        jnp.where(nn, (iota << 8) | bb.astype(_I32), -1), scan_impl)
     # at an open quote q: name ran from lnn[q-2]+1 to q-2 (inclusive);
     # shift the channel right by 2 so the value is available *at* q
     lnn2 = _shift_right(nn_packed, 2, -1)
     lnn2_pos = jnp.where(lnn2 >= 0, lnn2 >> 8, -1)
     lnn2_ch = jnp.where(lnn2 >= 0, lnn2 & 0xFF, -1)
 
-    bs_csum = jnp.cumsum(is_bs, axis=1)
+    bs_csum = _cumsum(is_bs, scan_impl)
 
     oq_mask = open_q & sd_zone
     cq_mask = close_q & sd_zone
-    oq_ord = jnp.cumsum(oq_mask, axis=1)
-    cq_ord = jnp.cumsum(cq_mask, axis=1)
+    oq_ord = _cumsum(oq_mask, scan_impl)
+    cq_ord = _cumsum(cq_mask, scan_impl)
     pair_total = oq_ord[:, -1]
     pair_count = jnp.where(is_sd, pair_total, 0)
     ok &= jnp.where(is_sd, pair_count <= max_pairs, True)
@@ -380,7 +419,10 @@ def decode_rfc5424(batch: jnp.ndarray, lens: jnp.ndarray,
     ok &= jnp.where(pair_valid, name_ok, True).all(axis=1)
 
     # block assignment: number of block starts at or before the quote
-    pair_sd = (blk_start[:, None, :] <= oq_pos[:, :, None]).astype(_I32).sum(axis=2) - 1
+    # (python loop over the small static block axis; no 3-D tensors)
+    pair_sd = -jnp.ones_like(oq_pos)
+    for k in range(max_sd):
+        pair_sd = pair_sd + (blk_start[:, k:k + 1] <= oq_pos).astype(_I32)
     pair_sd = jnp.where(pair_valid, jnp.clip(pair_sd, 0, max_sd - 1), 0)
 
     # value escapes: backslashes strictly inside the value
@@ -446,3 +488,97 @@ def decode_chunk_jit(buf, starts, lens, max_len=DEFAULT_MAX_LEN,
     batch = pack_on_device(buf, starts, lens, max_len)
     return decode_rfc5424(batch, jnp.minimum(lens, max_len),
                           max_sd=max_sd, max_pairs=max_pairs)
+
+
+# ---------------------------------------------------------------------------
+# Pallas TPU block kernel
+# ---------------------------------------------------------------------------
+# The XLA version above materializes each masked reduction's operands in
+# HBM (~60 passes over [N, L] int32). The Pallas form tiles the batch into
+# [BLOCK_ROWS, L] VMEM blocks and runs the *same* decode body (with
+# Mosaic-lowerable manual scans) entirely on-chip: HBM traffic collapses
+# to one read of the bytes plus the compact span outputs.
+
+_KEYS_1D = (
+    "ok", "bom", "facility", "severity", "days", "sod", "off", "nanos",
+    "host_start", "host_end", "app_start", "app_end", "proc_start",
+    "proc_end", "msgid_start", "msgid_end", "msg_start", "sd_count",
+    "pair_count", "full_start",
+)
+_KEYS_SD = ("sid_start", "sid_end")
+_KEYS_PAIR = ("name_start", "name_end", "val_start", "val_end",
+              "pair_sd", "val_has_esc")
+_BOOL_KEYS = ("ok", "bom", "val_has_esc")
+
+DEFAULT_BLOCK_ROWS = 256
+
+
+def decode_rfc5424_pallas(batch, lens, max_sd: int = DEFAULT_MAX_SD,
+                          max_pairs: int = DEFAULT_MAX_PAIRS,
+                          block_rows: int = DEFAULT_BLOCK_ROWS,
+                          interpret: bool = False) -> Dict[str, jnp.ndarray]:
+    """Same contract as decode_rfc5424, executed as a Pallas TPU kernel.
+
+    ``interpret=True`` runs the kernel in Pallas interpreter mode so the
+    CPU-backend tests can differential-check this path too.
+    """
+    from jax.experimental import pallas as pl
+
+    N_orig, L = batch.shape
+    N = N_orig
+    br = min(block_rows, N)
+    if N % br:
+        pad = br - N % br
+        batch = jnp.pad(batch, ((0, pad), (0, 0)))
+        lens = jnp.pad(lens, (0, pad))
+        N += pad
+    lens2 = lens.astype(_I32).reshape(N, 1)
+
+    def kernel(b_ref, l_ref, *outs):
+        res = decode_rfc5424(b_ref[...], l_ref[...][:, 0],
+                             max_sd=max_sd, max_pairs=max_pairs,
+                             scan_impl="manual")
+        i = 0
+        for k in _KEYS_1D:
+            outs[i][...] = res[k].astype(_I32).reshape(br, 1)
+            i += 1
+        for k in _KEYS_SD:
+            outs[i][...] = res[k].astype(_I32)
+            i += 1
+        for k in _KEYS_PAIR:
+            outs[i][...] = res[k].astype(_I32)
+            i += 1
+
+    out_shape = (
+        [jax.ShapeDtypeStruct((N, 1), _I32) for _ in _KEYS_1D]
+        + [jax.ShapeDtypeStruct((N, max_sd), _I32) for _ in _KEYS_SD]
+        + [jax.ShapeDtypeStruct((N, max_pairs), _I32) for _ in _KEYS_PAIR]
+    )
+    out_specs = (
+        [pl.BlockSpec((br, 1), lambda i: (i, 0)) for _ in _KEYS_1D]
+        + [pl.BlockSpec((br, max_sd), lambda i: (i, 0)) for _ in _KEYS_SD]
+        + [pl.BlockSpec((br, max_pairs), lambda i: (i, 0)) for _ in _KEYS_PAIR]
+    )
+    outs = pl.pallas_call(
+        kernel,
+        grid=(N // br,),
+        in_specs=[
+            pl.BlockSpec((br, L), lambda i: (i, 0)),
+            pl.BlockSpec((br, 1), lambda i: (i, 0)),
+        ],
+        out_specs=out_specs,
+        out_shape=out_shape,
+        interpret=interpret,
+    )(batch, lens2)
+
+    res = {}
+    i = 0
+    for k in _KEYS_1D:
+        v = outs[i][:N_orig, 0]
+        res[k] = (v != 0) if k in _BOOL_KEYS else v
+        i += 1
+    for k in _KEYS_SD + _KEYS_PAIR:
+        v = outs[i][:N_orig]
+        res[k] = (v != 0) if k in _BOOL_KEYS else v
+        i += 1
+    return res

@@ -1,0 +1,240 @@
+"""Minimal Kafka wire-protocol producer (dependency-free).
+
+Implements just what the Kafka output needs: Metadata v0 to find topic
+partition leaders and Produce v0 with the classic message-set format
+(magic 0, CRC32), optional gzip-wrapped compressed sets — the same
+capability set the reference gets from the `kafka` crate
+(kafka_output.rs: required-acks -1/0/1, ack timeout, gzip compression).
+Messages are round-robined across the topic's led partitions.
+
+Protocol notes: every request is ``[i32 size][i16 api_key][i16 api_ver]
+[i32 correlation][str client_id]body``; strings are i16-length-prefixed,
+bytes i32-length-prefixed (-1 = null).
+"""
+
+from __future__ import annotations
+
+import gzip as _gzip
+import socket
+import struct
+import threading
+import zlib
+from typing import Dict, List, Optional, Tuple
+
+_API_PRODUCE = 0
+_API_METADATA = 3
+_CLIENT_ID = b"flowgger-tpu"
+
+
+class KafkaError(Exception):
+    pass
+
+
+def _str(s: bytes) -> bytes:
+    return struct.pack(">h", len(s)) + s
+
+
+def _bytes(b: Optional[bytes]) -> bytes:
+    if b is None:
+        return struct.pack(">i", -1)
+    return struct.pack(">i", len(b)) + b
+
+
+class _Reader:
+    def __init__(self, data: bytes):
+        self.data = data
+        self.off = 0
+
+    def i16(self) -> int:
+        v = struct.unpack_from(">h", self.data, self.off)[0]
+        self.off += 2
+        return v
+
+    def i32(self) -> int:
+        v = struct.unpack_from(">i", self.data, self.off)[0]
+        self.off += 4
+        return v
+
+    def i64(self) -> int:
+        v = struct.unpack_from(">q", self.data, self.off)[0]
+        self.off += 8
+        return v
+
+    def string(self) -> Optional[str]:
+        n = self.i16()
+        if n == -1:
+            return None
+        s = self.data[self.off:self.off + n]
+        self.off += n
+        return s.decode("utf-8")
+
+
+def _message(value: bytes, compression: int = 0) -> bytes:
+    # magic 0: crc over [magic][attrs][key][value]
+    body = struct.pack(">bb", 0, compression) + _bytes(None) + _bytes(value)
+    crc = zlib.crc32(body) & 0xFFFFFFFF
+    return struct.pack(">I", crc) + body
+
+
+def _message_set(values: List[bytes], compression: str) -> bytes:
+    msgs = b"".join(
+        struct.pack(">q", 0) + struct.pack(">i", len(m)) + m
+        for m in (_message(v) for v in values)
+    )
+    if compression == "gzip":
+        wrapped = _message(_gzip.compress(msgs), compression=1)
+        return struct.pack(">q", 0) + struct.pack(">i", len(wrapped)) + wrapped
+    return msgs
+
+
+class KafkaProducer:
+    """Synchronous producer: one connection per partition leader."""
+
+    def __init__(self, brokers: List[str], required_acks: int, timeout_ms: int,
+                 compression: str = "none", socket_timeout: float = 30.0):
+        if compression not in ("none", "gzip"):
+            raise KafkaError(f"Unsupported compression method: {compression}")
+        self.brokers = brokers
+        self.required_acks = required_acks
+        self.timeout_ms = timeout_ms
+        self.compression = compression
+        self.socket_timeout = socket_timeout
+        self._corr = 0
+        self._lock = threading.Lock()
+        self._conns: Dict[Tuple[str, int], socket.socket] = {}
+        self._leaders: Dict[str, List[Tuple[int, Tuple[str, int]]]] = {}
+        self._rr = 0
+
+    # -- plumbing ----------------------------------------------------------
+    def _connect(self, addr: Tuple[str, int]) -> socket.socket:
+        sock = self._conns.get(addr)
+        if sock is not None:
+            return sock
+        sock = socket.create_connection(addr, timeout=self.socket_timeout)
+        self._conns[addr] = sock
+        return sock
+
+    def _roundtrip(self, addr, api_key: int, body: bytes,
+                   expect_response: bool = True) -> Optional[_Reader]:
+        sock = self._connect(addr)
+        self._corr += 1
+        header = struct.pack(">hhi", api_key, 0, self._corr) + _str(_CLIENT_ID)
+        payload = header + body
+        try:
+            sock.sendall(struct.pack(">i", len(payload)) + payload)
+            if not expect_response:
+                return None
+            raw = b""
+            while len(raw) < 4:
+                chunk = sock.recv(4 - len(raw))
+                if not chunk:
+                    raise KafkaError("connection closed")
+                raw += chunk
+            size = struct.unpack(">i", raw)[0]
+            data = b""
+            while len(data) < size:
+                chunk = sock.recv(size - len(data))
+                if not chunk:
+                    raise KafkaError("connection closed")
+                data += chunk
+        except OSError as e:
+            self._conns.pop(addr, None)
+            try:
+                sock.close()
+            except OSError:
+                pass
+            raise KafkaError(str(e))
+        rd = _Reader(data)
+        rd.i32()  # correlation id
+        return rd
+
+    @staticmethod
+    def _parse_broker_addr(broker: str) -> Tuple[str, int]:
+        host, sep, port = broker.rpartition(":")
+        if not sep:
+            return broker, 9092
+        if not port.isdigit():
+            raise KafkaError(f"invalid broker address: {broker!r}")
+        return host, int(port)
+
+    # -- metadata ----------------------------------------------------------
+    def refresh_metadata(self, topic: str):
+        last_err = None
+        for broker in self.brokers:
+            try:
+                rd = self._roundtrip(
+                    self._parse_broker_addr(broker), _API_METADATA,
+                    struct.pack(">i", 1) + _str(topic.encode()))
+            except KafkaError as e:
+                last_err = e
+                continue
+            nodes = {}
+            for _ in range(rd.i32()):
+                node_id = rd.i32()
+                host = rd.string()
+                port = rd.i32()
+                nodes[node_id] = (host, port)
+            parts = []
+            for _ in range(rd.i32()):
+                rd.i16()  # topic error code
+                tname = rd.string()
+                for _ in range(rd.i32()):
+                    perr = rd.i16()
+                    pid = rd.i32()
+                    leader = rd.i32()
+                    for _ in range(rd.i32()):
+                        rd.i32()  # replicas
+                    for _ in range(rd.i32()):
+                        rd.i32()  # isr
+                    if tname == topic and perr in (0, 9) and leader in nodes:
+                        parts.append((pid, nodes[leader]))
+            if parts:
+                self._leaders[topic] = sorted(parts)
+                return
+            last_err = KafkaError(f"no leaders found for topic {topic}")
+        raise KafkaError(f"metadata refresh failed: {last_err}")
+
+    # -- produce -----------------------------------------------------------
+    def send_all(self, topic: str, values: List[bytes]):
+        if not values:
+            return
+        with self._lock:
+            if topic not in self._leaders:
+                self.refresh_metadata(topic)
+            parts = self._leaders[topic]
+            self._rr = (self._rr + 1) % len(parts)
+            pid, addr = parts[self._rr]
+            mset = _message_set(values, self.compression)
+            body = (
+                struct.pack(">hi", self.required_acks, self.timeout_ms)
+                + struct.pack(">i", 1) + _str(topic.encode())
+                + struct.pack(">i", 1) + struct.pack(">i", pid)
+                + struct.pack(">i", len(mset)) + mset
+            )
+            try:
+                rd = self._roundtrip(addr, _API_PRODUCE, body,
+                                     expect_response=self.required_acks != 0)
+            except KafkaError:
+                self._leaders.pop(topic, None)
+                raise
+            if rd is not None:
+                for _ in range(rd.i32()):
+                    rd.string()
+                    for _ in range(rd.i32()):
+                        rd.i32()  # partition
+                        err = rd.i16()
+                        rd.i64()  # offset
+                        if err != 0:
+                            self._leaders.pop(topic, None)
+                            raise KafkaError(f"produce error code {err}")
+
+    def send(self, topic: str, value: bytes):
+        self.send_all(topic, [value])
+
+    def close(self):
+        for sock in self._conns.values():
+            try:
+                sock.close()
+            except OSError:
+                pass
+        self._conns.clear()

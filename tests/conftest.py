@@ -9,7 +9,9 @@ so re-assert the env var's intent on the config after importing jax.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# hard override: the driver environment exports JAX_PLATFORMS=axon (the
+# real-TPU relay); tests must be hermetic on the virtual CPU mesh
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
