@@ -1,0 +1,88 @@
+"""Mixed-format auto-detect dispatch (BASELINE.json config #5).
+
+``input.format = "auto_tpu"`` accepts a stream mixing RFC5424, RFC3164,
+LTSV, and GELF records.  Each batch is partitioned by a cheap first-bytes
+signature and every class is decoded by its columnar kernel (rfc3164 —
+which has no fixed layout to vectorize — runs the scalar decoder);
+results reassemble in input order, so downstream ordering matches a
+single-format run.
+
+Signature rules (on the first bytes only):
+- ``{``                      → GELF JSON
+- ``<digits>1␣`` (opt. BOM)  → RFC5424 (version tag after the PRI)
+- ``<``            otherwise → RFC3164
+- TAB and ``:``  in the line → LTSV
+- anything else              → RFC3164 (the lenient legacy decoder —
+  also the reference's catch-all behavior class)
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..config import Config
+from ..decoders import DecodeError
+from ..decoders.ltsv import LTSVDecoder
+from ..decoders.rfc3164 import RFC3164Decoder
+from .materialize import LineResult
+
+F_RFC5424, F_RFC3164, F_LTSV, F_GELF = 0, 1, 2, 3
+
+_3164 = RFC3164Decoder()
+
+
+def classify(raw: bytes) -> int:
+    b = raw
+    if b.startswith(b"\xef\xbb\xbf"):
+        b = b[3:]
+    if b.startswith(b"{"):
+        return F_GELF
+    if b.startswith(b"<"):
+        gt = b.find(b">", 1, 6)
+        if gt > 1 and b[gt + 1:gt + 3] == b"1 " and b[1:gt].isdigit():
+            return F_RFC5424
+        return F_RFC3164
+    if b"\t" in b and b":" in b:
+        return F_LTSV
+    return F_RFC3164
+
+
+def decode_auto_batch(lines: List[bytes], max_len: int,
+                      ltsv_decoder: Optional[LTSVDecoder] = None
+                      ) -> List[LineResult]:
+    from .batch import _decode_gelf_batch, _decode_ltsv_batch, _decode_rfc5424_batch
+
+    if ltsv_decoder is None:
+        ltsv_decoder = LTSVDecoder(Config.from_string(""))
+    classes = [classify(ln) for ln in lines]
+    buckets: List[List[int]] = [[], [], [], []]
+    for i, c in enumerate(classes):
+        buckets[c].append(i)
+
+    results: List[LineResult] = [None] * len(lines)  # type: ignore
+
+    if buckets[F_RFC5424]:
+        sub = [lines[i] for i in buckets[F_RFC5424]]
+        for i, res in zip(buckets[F_RFC5424], _decode_rfc5424_batch(sub, max_len)):
+            results[i] = res
+    if buckets[F_LTSV]:
+        sub = [lines[i] for i in buckets[F_LTSV]]
+        for i, res in zip(buckets[F_LTSV],
+                          _decode_ltsv_batch(sub, max_len, ltsv_decoder)):
+            results[i] = res
+    if buckets[F_GELF]:
+        sub = [lines[i] for i in buckets[F_GELF]]
+        for i, res in zip(buckets[F_GELF], _decode_gelf_batch(sub, max_len)):
+            results[i] = res
+    for i in buckets[F_RFC3164]:
+        raw = lines[i]
+        try:
+            line = raw.decode("utf-8")
+        except UnicodeDecodeError:
+            results[i] = LineResult(None, "__utf8__", "")
+            continue
+        try:
+            results[i] = LineResult(_3164.decode(line), None, line)
+        except DecodeError as e:
+            results[i] = LineResult(None, str(e), line)
+    return results

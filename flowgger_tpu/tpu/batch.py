@@ -55,7 +55,16 @@ class BatchHandler(Handler):
         self._decode_lock = threading.Lock()
         self._timer: Optional[threading.Timer] = None
         self._start_timer = start_timer
-        self._has_kernel = fmt == "rfc5424"
+        # single source of truth for kernel dispatch: fmt -> batch decoder
+        auto_ltsv = self._auto_ltsv_decoder(cfg) if fmt == "auto" else None
+        self._kernel_fn = {
+            "rfc5424": lambda lines: _decode_rfc5424_batch(lines, self.max_len),
+            "ltsv": lambda lines: _decode_ltsv_batch(
+                lines, self.max_len, self.scalar.decoder),
+            "gelf": lambda lines: _decode_gelf_batch(lines, self.max_len),
+            "auto": lambda lines: _decode_auto_batch(
+                lines, self.max_len, auto_ltsv),
+        }.get(fmt)
 
     # -- Handler interface -------------------------------------------------
     def handle_bytes(self, raw: bytes) -> None:
@@ -83,13 +92,19 @@ class BatchHandler(Handler):
                 self._decode_batch(lines)
 
     # -- batched decode ----------------------------------------------------
+    @staticmethod
+    def _auto_ltsv_decoder(config):
+        from ..decoders.ltsv import LTSVDecoder
+
+        return LTSVDecoder(config)
+
     def _decode_batch(self, lines: List[bytes]) -> None:
-        if not self._has_kernel:
+        if self._kernel_fn is None:
             # formats without a columnar kernel yet: scalar per line
             for raw in lines:
                 self.scalar.handle_bytes(raw)
             return
-        results = _decode_rfc5424_batch(lines, self.max_len)
+        results = self._kernel_fn(lines)
         for res in results:
             if res.record is None:
                 if res.error == "__utf8__":
@@ -109,6 +124,36 @@ class BatchHandler(Handler):
                     print(f"{e}: [{stripped}]", file=sys.stderr)
                 continue
             self.tx.put(encoded)
+
+
+def _decode_gelf_batch(lines, max_len):
+    import jax.numpy as jnp
+
+    from . import gelf, materialize_gelf, pack
+
+    batch, lens, chunk, starts, orig_lens, n_real = pack.pack_lines_2d(lines, max_len)
+    out = gelf.decode_gelf_jit(jnp.asarray(batch), jnp.asarray(lens))
+    host_out = {k: np.asarray(v) for k, v in out.items()}
+    return materialize_gelf.materialize_gelf(chunk, starts, orig_lens, host_out,
+                                             n_real, max_len)
+
+
+def _decode_auto_batch(lines, max_len, ltsv_decoder=None):
+    from .autodetect import decode_auto_batch
+
+    return decode_auto_batch(lines, max_len, ltsv_decoder)
+
+
+def _decode_ltsv_batch(lines, max_len, decoder):
+    import jax.numpy as jnp
+
+    from . import ltsv, materialize_ltsv, pack
+
+    batch, lens, chunk, starts, orig_lens, n_real = pack.pack_lines_2d(lines, max_len)
+    out = ltsv.decode_ltsv_jit(jnp.asarray(batch), jnp.asarray(lens))
+    host_out = {k: np.asarray(v) for k, v in out.items()}
+    return materialize_ltsv.materialize_ltsv(chunk, starts, orig_lens, host_out,
+                                             n_real, max_len, decoder)
 
 
 def _decode_rfc5424_batch(lines, max_len):

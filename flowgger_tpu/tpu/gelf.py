@@ -1,0 +1,232 @@
+r"""Columnar GELF (flat JSON) tokenizer (BASELINE.json config #3).
+
+Scalar spec: flowgger_tpu/decoders/gelf.py (reference
+gelf_decoder.rs:34-125).  GELF messages are flat JSON objects of scalar
+values — exactly the shape a simdjson-style structural pass handles:
+
+stage 1 (device, this module): backslash-run parity marks escaped
+quotes; prefix parity classifies in/out-of-string; three scan channels
+answer every "what comes next/before" question without gathers —
+  ``P`` forward: last significant byte before each position,
+  ``C`` reverse: next significant byte at/after each position,
+  ``Q`` reverse: next real quote after each position —
+(significant = non-whitespace outside strings, plus quotes).  Key
+strings are strings whose preceding significant byte is ``{`` or ``,``;
+per-pair masked min-reductions then walk key-close → colon → value →
+value-end through the channels, emitting span tables and a value-type
+code per pair.  Arrays, nested objects, >max_fields keys, or any
+structural surprise flags the row for the scalar oracle.
+
+stage 2 (host, materialize_gelf.py): slices spans, json-parses only the
+tokens that need it (escaped strings, numbers), routes the special GELF
+keys in sorted order like serde's BTreeMap.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+from .rfc5424 import _cummax, _cumsum, _min_where, _shift_left, _shift_right
+
+DEFAULT_MAX_FIELDS = 24
+_I32 = jnp.int32
+
+VT_STRING, VT_NUMBER, VT_TRUE, VT_FALSE, VT_NULL = 0, 1, 2, 3, 4
+
+
+def _rev_next_min(packed, big, impl):
+    """Reverse scan: per position, the minimum of ``packed`` at or after
+    it (packed = pos<<8|byte so min == nearest)."""
+    flipped = jnp.flip(packed, axis=1)
+    acc = _cummax(-flipped, impl)
+    return jnp.flip(-acc, axis=1)
+
+
+def _match_token(bb, text: bytes):
+    """positions where ``text`` starts, via shifted byte planes."""
+    m = bb == text[0]
+    for i, ch in enumerate(text[1:], start=1):
+        m &= _shift_left(bb, i, 0) == ch
+    return m
+
+
+def decode_gelf(batch: jnp.ndarray, lens: jnp.ndarray,
+                max_fields: int = DEFAULT_MAX_FIELDS,
+                scan_impl: str = "lax") -> Dict[str, jnp.ndarray]:
+    N, L = batch.shape
+    lens = lens.astype(_I32)
+    iota = jax.lax.broadcasted_iota(_I32, (N, L), 1)
+    valid = iota < lens[:, None]
+    bb = jnp.where(valid, batch, jnp.uint8(0)).astype(jnp.int16)
+
+    is_ws = ((bb == 32) | (bb == 9) | (bb == 10) | (bb == 13)) & valid
+
+    # escaped quotes via backslash-run parity
+    is_bs = (bb == 92) & valid
+    non_bs_pos = jnp.where(~is_bs, iota, -1)
+    last_non_bs = _cummax(non_bs_pos, scan_impl)
+    prev_last = _shift_right(last_non_bs, 1, -1)
+    escaped = ((iota - 1 - prev_last) % 2) == 1
+
+    quote = (bb == ord('"')) & valid
+    real_q = quote & ~escaped
+    q_excl = _cumsum(real_q, scan_impl) - real_q
+    outside = (q_excl % 2) == 0
+    open_q = real_q & outside
+    close_q = real_q & ~outside
+    ok = (q_excl[:, -1] + real_q[:, -1]) % 2 == 0  # even quote count
+
+    significant = ((~is_ws & outside & valid) | real_q)
+
+    PACK = lambda: (iota << 8) | bb.astype(_I32)  # noqa: E731
+    BIG = jnp.int32((L + 1) << 8)
+
+    # channels
+    P = _shift_right(_cummax(jnp.where(significant, PACK(), -1), scan_impl), 1, -1)
+    C = _rev_next_min(jnp.where(significant, PACK(), BIG), BIG, scan_impl)
+    Q = _rev_next_min(jnp.where(real_q, PACK(), BIG), BIG, scan_impl)
+
+    def chan_at(chan, pos):
+        """chan[n, pos[n]] via masked reduction; (L+1)<<8 when pos >= L."""
+        hit = iota == jnp.clip(pos, 0, L)[:, None]
+        return jnp.min(jnp.where(hit, chan, BIG), axis=1)
+
+    # overall shape: first significant is '{', last is '}'
+    first_sig = C[:, 0]
+    ok &= (first_sig & 0xFF) == ord("{")
+    # no arrays / extra braces outside strings
+    brace_open = (bb == ord("{")) & outside & valid
+    ok &= jnp.sum(brace_open.astype(_I32), axis=1) == 1
+    ok &= ~jnp.any(((bb == ord("[")) | (bb == ord("]"))) & outside & valid, axis=1)
+    brace_close = (bb == ord("}")) & outside & valid
+    ok &= jnp.sum(brace_close.astype(_I32), axis=1) == 1
+    rb_pos = jnp.max(jnp.where(brace_close, iota, -1), axis=1)
+    # nothing significant after the closing brace
+    after_rb = chan_at(C, rb_pos + 1)
+    ok &= after_rb >= BIG
+
+    # every string must be a key (prev sig in {, ,) or a value (prev :)
+    prev_at_oq_ch = jnp.where(P >= 0, P & 0xFF, -1)
+    is_key_q = open_q & ((prev_at_oq_ch == ord("{")) | (prev_at_oq_ch == ord(",")))
+    is_val_q = open_q & (prev_at_oq_ch == ord(":"))
+    ok &= ~jnp.any(open_q & ~is_key_q & ~is_val_q, axis=1)
+
+    key_ord = _cumsum(is_key_q, scan_impl)
+    n_keys = key_ord[:, -1]
+    ok &= n_keys <= max_fields
+
+    POS = 8
+    key_open = jnp.stack(
+        [_min_where(is_key_q & (key_ord == k + 1), iota, L) for k in range(max_fields)],
+        axis=1)  # [N, F]
+
+    # walk the channels per key
+    key_close_pk = jnp.stack(
+        [chan_at(Q, key_open[:, k] + 1) for k in range(max_fields)], axis=1)
+    key_close = key_close_pk >> POS
+    colon_pk = jnp.stack(
+        [chan_at(C, key_close[:, k] + 1) for k in range(max_fields)], axis=1)
+    colon_ok = (colon_pk & 0xFF) == ord(":")
+    colon_pos = colon_pk >> POS
+    val_pk = jnp.stack(
+        [chan_at(C, colon_pos[:, k] + 1) for k in range(max_fields)], axis=1)
+    val_ch = val_pk & 0xFF
+    val_pos = val_pk >> POS
+
+    field_valid = (jnp.arange(max_fields, dtype=_I32)[None, :] < n_keys[:, None])
+    ok &= jnp.where(field_valid, colon_ok & (key_close[:, :] < L + 1), True).all(axis=1)
+
+    # value classification
+    is_vstr = val_ch == ord('"')
+    is_vnum = ((val_ch >= ord("0")) & (val_ch <= ord("9"))) | (val_ch == ord("-"))
+    true_at = _match_token(bb, b"true")
+    false_at = _match_token(bb, b"false")
+    null_at = _match_token(bb, b"null")
+
+    def mask_at(mask, pos):
+        hit = iota == jnp.clip(pos, 0, L - 1)[:, None]
+        return jnp.any(mask & hit, axis=1)
+
+    is_vtrue = jnp.stack([mask_at(true_at, val_pos[:, k]) for k in range(max_fields)], axis=1)
+    is_vfalse = jnp.stack([mask_at(false_at, val_pos[:, k]) for k in range(max_fields)], axis=1)
+    is_vnull = jnp.stack([mask_at(null_at, val_pos[:, k]) for k in range(max_fields)], axis=1)
+
+    val_type = jnp.where(
+        is_vstr, VT_STRING,
+        jnp.where(is_vnum, VT_NUMBER,
+                  jnp.where(is_vtrue, VT_TRUE,
+                            jnp.where(is_vfalse, VT_FALSE,
+                                      jnp.where(is_vnull, VT_NULL, -1)))))
+    ok &= jnp.where(field_valid, val_type >= 0, True).all(axis=1)
+
+    # value end + after-value check
+    # string: close quote; others: next ws/structural boundary
+    vclose = jnp.stack(
+        [chan_at(Q, val_pos[:, k] + 1) >> POS for k in range(max_fields)], axis=1)
+    boundary = (is_ws | (((bb == ord(",")) | (bb == ord("}")) | (bb == ord(":")))
+                         & outside)) & valid
+    Bc = _rev_next_min(jnp.where(boundary, PACK(), BIG), BIG, scan_impl)
+    vbound = jnp.stack(
+        [chan_at(Bc, val_pos[:, k] + 1) >> POS for k in range(max_fields)], axis=1)
+    vbound = jnp.minimum(vbound, lens[:, None])
+    val_end = jnp.where(val_type == VT_STRING, vclose, vbound)
+    # after-value char: strings end at their close quote (look past it);
+    # number/literal val_end is already the first boundary byte (C skips
+    # any whitespace from there to the structural ',' or '}')
+    after_pos = jnp.where(val_type == VT_STRING, val_end + 1, val_end)
+    after_pk = jnp.stack(
+        [chan_at(C, after_pos[:, k]) for k in range(max_fields)], axis=1)
+    after_ch = after_pk & 0xFF
+    ok &= jnp.where(field_valid, (after_ch == ord(",")) | (after_ch == ord("}")),
+                    True).all(axis=1)
+    # literal tokens must end exactly at the boundary
+    lit_len = jnp.where(val_type == VT_TRUE, 4,
+                        jnp.where(val_type == VT_FALSE, 5,
+                                  jnp.where(val_type == VT_NULL, 4, -1)))
+    ok &= jnp.where(field_valid & (lit_len > 0),
+                    vbound == val_pos + lit_len, True).all(axis=1)
+
+    # escapes inside string values / keys -> host json-decodes the span
+    bs_csum = _cumsum(is_bs, scan_impl)
+
+    def bs_between(a, b):
+        va = jnp.stack([chan_at(bs_csum[:, :] << 8, a[:, k]) >> 8
+                        for k in range(max_fields)], axis=1)
+        vb = jnp.stack([chan_at(bs_csum[:, :] << 8, jnp.maximum(b[:, k] - 1, 0)) >> 8
+                        for k in range(max_fields)], axis=1)
+        return (vb - va) > 0
+
+    key_esc = bs_between(key_open, key_close)
+    val_esc = bs_between(val_pos, val_end) & (val_type == VT_STRING)
+
+    # every structural comma must introduce another key, and comma count
+    # must match (rejects `{"a":1,}` and stray commas)
+    comma = (bb == ord(",")) & outside & valid
+    next_sig_ch = jnp.where(_shift_left(C, 1, BIG) < BIG,
+                            _shift_left(C, 1, BIG) & 0xFF, -1)
+    ok &= ~jnp.any(comma & (next_sig_ch != ord('"')), axis=1)
+    n_commas = jnp.sum(comma.astype(_I32), axis=1)
+    ok &= jnp.where(n_keys > 0, n_commas == n_keys - 1, n_commas == 0)
+
+    # empty object: '{' directly followed by '}'
+    ok &= jnp.where(n_keys == 0, (chan_at(C, (first_sig >> POS) + 1) & 0xFF)
+                    == ord("}"), True)
+
+    return {
+        "ok": ok,
+        "n_fields": jnp.where(ok, n_keys, 0),
+        "key_start": key_open + 1, "key_end": key_close,
+        "val_start": jnp.where(val_type == VT_STRING, val_pos + 1, val_pos),
+        "val_end": val_end,
+        "val_type": val_type,
+        "key_esc": key_esc, "val_esc": val_esc,
+    }
+
+
+@functools.partial(jax.jit, static_argnames=("max_fields",))
+def decode_gelf_jit(batch, lens, max_fields=DEFAULT_MAX_FIELDS):
+    return decode_gelf(batch, lens, max_fields=max_fields)

@@ -1,0 +1,254 @@
+r"""Columnar LTSV decoder (BASELINE.json config #2).
+
+Scalar spec: flowgger_tpu/decoders/ltsv.py (reference
+ltsv_decoder.rs:23-267).  Line shape: tab-separated ``key:value`` parts;
+special keys time/host/message/level; everything else becomes an SD pair
+(typed by the host-side schema).
+
+Columnar plan (same no-gather discipline as tpu/rfc5424.py):
+
+- tab cumsum segments the line into parts; the k-th part's span and its
+  first ``:`` come from payload-packed masked min-reductions;
+- the special keys are found *elementwise*: position p starts ``time:``
+  iff the five shifted byte-planes match ``t i m e :`` and p is a part
+  start (line start or preceded by a tab) — one vectorized pattern per
+  special key, last occurrence wins via a max-reduction (the scalar
+  decoder's assignments also overwrite);
+- ``time`` values parse on-device for the two fast-path forms: plain
+  unix float (optional sign/fraction) and (optionally ``[...]``-wrapped)
+  RFC3339; apache-english timestamps and other oddities flag the row to
+  the scalar oracle;
+- ``level`` parses as an int; out-of-range falls back (exact error text
+  comes from the oracle);
+- remaining parts are emitted as (key, value) span pairs; schema typing
+  (u64/i64/f64/bool + suffixes) happens at host materialization where
+  Python values are being built anyway.
+
+ts result is returned as integer pieces: unix float values as
+(mantissa, scale) can't cover the f64 domain, so the kernel only
+fast-paths RFC3339 (days/sod/off/nanos like rfc5424) and flags plain
+floats for a *vectorized host* parse (numpy float64 on the value spans
+is exact and cheap) — ``ts_kind`` 0=rfc3339, 1=float-span, else fallback.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+from .rfc5424 import (
+    _cummax,
+    _cumsum,
+    _days_from_civil,
+    _days_in_month,
+    _min_where,
+    _shift_left,
+    _shift_right,
+)
+
+DEFAULT_MAX_PARTS = 24
+_I32 = jnp.int32
+
+
+def _match_at(bb, text: bytes, valid):
+    """Elementwise: does ``text`` start at each position?  Uses shifted
+    byte planes only (no gathers)."""
+    m = (bb == text[0]) & valid
+    for i, ch in enumerate(text[1:], start=1):
+        m &= _shift_left(bb, i, 0) == ch
+    return m
+
+
+def decode_ltsv(batch: jnp.ndarray, lens: jnp.ndarray,
+                max_parts: int = DEFAULT_MAX_PARTS,
+                scan_impl: str = "lax") -> Dict[str, jnp.ndarray]:
+    N, L = batch.shape
+    lens = lens.astype(_I32)
+    iota = jax.lax.broadcasted_iota(_I32, (N, L), 1)
+    valid = iota < lens[:, None]
+    bb = jnp.where(valid, batch, jnp.uint8(0)).astype(jnp.int16)
+    is_digit = (bb >= 48) & (bb <= 57)
+    dig = (bb - 48).astype(_I32)
+
+    is_tab = (bb == 9) & valid
+    tab_ord = _cumsum(is_tab, scan_impl)
+    n_parts = tab_ord[:, -1] + 1
+    ok = n_parts <= max_parts
+
+    # part starts: 0 and tab+1; part ends: tab positions and len
+    POS = 12
+    NOTF = jnp.int32((L + 1) << POS)
+    tab_pos = [
+        _min_where(is_tab & (tab_ord == k + 1), iota << POS, NOTF) >> POS
+        for k in range(max_parts - 1)
+    ]
+    part_start = [jnp.zeros_like(lens)]
+    part_end = []
+    for k in range(max_parts - 1):
+        part_end.append(jnp.minimum(tab_pos[k], lens))
+        part_start.append(jnp.minimum(tab_pos[k] + 1, lens))
+    part_end.append(lens)
+    part_start = jnp.stack(part_start, axis=1)   # [N, max_parts]
+    part_end = jnp.stack(part_end, axis=1)
+
+    # first ':' in each part (or L)
+    is_colon = (bb == ord(":")) & valid
+    colon_pos = jnp.stack(
+        [_min_where(is_colon & (iota >= part_start[:, k:k + 1]), iota, L)
+         for k in range(max_parts)], axis=1)
+    has_colon = colon_pos < part_end
+
+    # ---- special keys, elementwise pattern matches ----------------------
+    at_part_start = (iota == 0) | (_shift_right(is_tab, 1, False))
+
+    def special(key: bytes):
+        pat = _match_at(bb, key + b":", valid) & at_part_start
+        # last occurrence wins (scalar decoder overwrites)
+        pos = jnp.max(jnp.where(pat, iota, -1), axis=1)
+        return pos  # -1 if absent; else position of key start
+
+    time_pos = special(b"time")
+    host_pos = special(b"host")
+    msg_pos = special(b"message")
+    level_pos = special(b"level")
+
+    def value_span(pos, key_len):
+        """[value_start, next tab or end) for a special key at pos."""
+        vstart = pos + key_len + 1
+        vend = _min_where(is_tab & (iota >= vstart[:, None]), iota, L)
+        vend = jnp.minimum(vend, lens)
+        return vstart, jnp.where(pos >= 0, vend, -1)
+
+    host_start, host_end = value_span(host_pos, 4)
+    msg_start, msg_end = value_span(msg_pos, 7)
+    level_start, level_end = value_span(level_pos, 5)
+    time_start, time_end = value_span(time_pos, 4)
+
+    has_time = time_pos >= 0
+    has_host = host_pos >= 0
+    ok &= has_time & has_host  # missing -> oracle for exact error text
+
+    # ---- level parse ----------------------------------------------------
+    has_level = level_pos >= 0
+    lv_r = iota - level_start[:, None]
+    lv_len = level_end - level_start
+    in_lv = (lv_r >= 0) & (lv_r < lv_len[:, None]) & has_level[:, None]
+    lv_digits_ok = ~jnp.any(in_lv & ~is_digit, axis=1)
+    lv_w = jnp.where(lv_r >= 0, 10 ** jnp.clip(lv_len[:, None] - 1 - lv_r, 0, 8), 0)
+    level_val = jnp.sum(jnp.where(in_lv, dig * lv_w, 0), axis=1)
+    lv_ok = (~has_level) | (lv_digits_ok & (lv_len >= 1) & (lv_len <= 3)
+                            & (level_val <= 7))
+    ok &= lv_ok  # >7 or junk -> oracle reproduces the exact error
+
+    # ---- time parse -----------------------------------------------------
+    # optional [ ... ] wrapper
+    t_first = jnp.where(has_time, jnp.sum(
+        jnp.where(iota == time_start[:, None], bb, 0), axis=1), 0)
+    t_last = jnp.where(has_time, jnp.sum(
+        jnp.where(iota == (time_end - 1)[:, None], bb, 0), axis=1), 0)
+    bracketed = (t_first == ord("[")) & (t_last == ord("]")) & \
+        (time_end - time_start >= 2)
+    ts_s = jnp.where(bracketed, time_start + 1, time_start)
+    ts_e = jnp.where(bracketed, time_end - 1, time_end)
+    tlen = ts_e - ts_s
+
+    r = iota - ts_s[:, None]
+    in_t = (r >= 0) & (r < tlen[:, None])
+
+    # float form: [+-]? digits [. digits]  (exponents/inf/nan -> fallback)
+    c0 = jnp.sum(jnp.where(in_t & (r == 0), bb, 0), axis=1)
+    has_sign = (c0 == ord("+")) | (c0 == ord("-"))
+    body_from = jnp.where(has_sign, 1, 0)
+    dot_pos = _min_where(in_t & (bb == ord(".")), r, 1 << 20)
+    is_float_body = ~jnp.any(
+        in_t & (r >= body_from[:, None]) & (r != dot_pos[:, None]) & ~is_digit,
+        axis=1)
+    n_dots = jnp.sum((in_t & (bb == ord("."))).astype(_I32), axis=1)
+    float_ok = (
+        is_float_body & (n_dots <= 1) & (tlen >= 1)
+        & (tlen - body_from >= 1)
+        # need at least one digit and, if dotted, digits around count free
+        & ~jnp.any(in_t & (r == body_from[:, None]) & (bb == ord(".")), axis=1)
+    )
+
+    # rfc3339 form: reuse the rfc5424 timestamp machinery inline
+    w_date = ((r == 0) * 1000 + (r == 1) * 100 + (r == 2) * 10 + (r == 3))
+    dz = jnp.where(in_t, dig, 0)
+    year = jnp.sum(dz * w_date, axis=1)
+    month = jnp.sum(dz * ((r == 5) * 10 + (r == 6)), axis=1)
+    day = jnp.sum(dz * ((r == 8) * 10 + (r == 9)), axis=1)
+    hour = jnp.sum(dz * ((r == 11) * 10 + (r == 12)), axis=1)
+    minute = jnp.sum(dz * ((r == 14) * 10 + (r == 15)), axis=1)
+    sec = jnp.sum(dz * ((r == 17) * 10 + (r == 18)), axis=1)
+    digit_off = ((r >= 0) & (r <= 18) &
+                 (r != 4) & (r != 7) & (r != 10) & (r != 13) & (r != 16))
+    rviol = jnp.any(in_t & digit_off & ~is_digit, axis=1)
+    rviol |= jnp.any(in_t & ((r == 4) | (r == 7)) & (bb != ord("-")), axis=1)
+    rviol |= jnp.any(in_t & (r == 10) & (bb != ord("T")) & (bb != ord("t")), axis=1)
+    rviol |= jnp.any(in_t & ((r == 13) | (r == 16)) & (bb != ord(":")), axis=1)
+    has_frac = jnp.sum(jnp.where(in_t & (r == 19), bb, 0), axis=1) == ord(".")
+    rd = r - 20
+    frac_run = _min_where(in_t & (rd >= 0) & (rd < 10) & ~is_digit, rd, 10)
+    frac_run = jnp.minimum(frac_run, jnp.maximum(tlen - 20, 0))
+    frac_len = jnp.where(has_frac, frac_run, 0)
+    w_frac = ((rd == 0) * 100000000 + (rd == 1) * 10000000 + (rd == 2) * 1000000
+              + (rd == 3) * 100000 + (rd == 4) * 10000 + (rd == 5) * 1000
+              + (rd == 6) * 100 + (rd == 7) * 10 + (rd == 8))
+    nanos = jnp.sum(jnp.where(in_t & (rd >= 0) & (rd < frac_len[:, None]),
+                              dig * w_frac, 0), axis=1)
+    opos = jnp.where(has_frac, 20 + frac_len, 19)
+    r2 = r - opos[:, None]
+    oc = jnp.sum(jnp.where(in_t & (r2 == 0), bb, 0), axis=1)
+    is_zulu = (oc == ord("Z")) | (oc == ord("z"))
+    is_num_off = (oc == ord("+")) | (oc == ord("-"))
+    off_ok = jnp.where(is_zulu, tlen == opos + 1, True)
+    oviol = jnp.any(in_t & ((r2 == 1) | (r2 == 2) | (r2 == 4) | (r2 == 5))
+                    & ~is_digit & is_num_off[:, None], axis=1)
+    oviol |= jnp.any(in_t & (r2 == 3) & (bb != ord(":")) & is_num_off[:, None],
+                     axis=1)
+    oh = jnp.sum(dz * ((r2 == 1) * 10 + (r2 == 2)), axis=1)
+    om = jnp.sum(dz * ((r2 == 4) * 10 + (r2 == 5)), axis=1)
+    off_ok &= jnp.where(is_num_off,
+                        ~oviol & (tlen == opos + 6) & (oh <= 23) & (om <= 59),
+                        True)
+    rfc_ok = (
+        (tlen >= 20) & ~rviol & (is_zulu | is_num_off) & off_ok
+        & (month >= 1) & (month <= 12) & (day >= 1)
+        & (day <= _days_in_month(year, month))
+        & (hour <= 23) & (minute <= 59) & (sec <= 59)
+        & jnp.where(has_frac, (frac_len >= 1) & (frac_len <= 9), True)
+    )
+    off_secs = jnp.where(is_num_off,
+                         jnp.where(oc == ord("-"), -1, 1) * (oh * 3600 + om * 60),
+                         0)
+    days = _days_from_civil(year, month, day)
+    sod = hour * 3600 + minute * 60 + sec
+
+    # ts_kind: 0 = rfc3339 (days/sod/off/nanos valid), 1 = float span
+    # (host parses the span), 2 = neither -> row fallback
+    ts_kind = jnp.where(rfc_ok, 0, jnp.where(float_ok, 1, 2))
+    ok &= ts_kind < 2
+
+    return {
+        "ok": ok,
+        "n_parts": n_parts,
+        "part_start": part_start,
+        "part_end": part_end,
+        "colon_pos": jnp.where(has_colon, colon_pos, -1),
+        "time_pos": time_pos, "host_pos": host_pos,
+        "msg_pos": msg_pos, "level_pos": level_pos,
+        "host_start": host_start, "host_end": host_end,
+        "msg_start": msg_start, "msg_end": msg_end,
+        "level_val": jnp.where(has_level, level_val, -1),
+        "ts_kind": ts_kind,
+        "ts_start": ts_s, "ts_end": ts_e,
+        "days": days, "sod": sod, "off": off_secs, "nanos": nanos,
+    }
+
+
+@functools.partial(jax.jit, static_argnames=("max_parts",))
+def decode_ltsv_jit(batch, lens, max_parts=DEFAULT_MAX_PARTS):
+    return decode_ltsv(batch, lens, max_parts=max_parts)

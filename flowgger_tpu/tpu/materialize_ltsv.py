@@ -1,0 +1,107 @@
+"""Materialize columnar LTSV decode output into Records.
+
+Schema typing (ltsv_decoder.rs:23-84 semantics) runs here via the scalar
+decoder's ``_typed_pair`` — the kernel hands over spans; this stage
+builds Python values, routes the special keys, and preserves the scalar
+path's side effects (the "Missing value for name" stdout notices, error
+precedence)."""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+from ..decoders import DecodeError
+from ..decoders.ltsv import LTSVDecoder
+from ..record import Record, StructuredData
+from .materialize import LineResult, compute_ts
+
+_SPECIAL = ("time", "host", "message", "level")
+
+
+def materialize_ltsv(
+    chunk_bytes: bytes,
+    starts: np.ndarray,
+    orig_lens: np.ndarray,
+    out: Dict[str, np.ndarray],
+    n_real: int,
+    max_len: int,
+    decoder: LTSVDecoder,
+) -> List[LineResult]:
+    ts_rfc = compute_ts(out)
+    ok = np.asarray(out["ok"])
+    results: List[LineResult] = []
+    for n in range(n_real):
+        s = int(starts[n])
+        ln = int(orig_lens[n])
+        raw = chunk_bytes[s:s + ln]
+        try:
+            line = raw.decode("utf-8")
+        except UnicodeDecodeError:
+            results.append(LineResult(None, "__utf8__", ""))
+            continue
+        if not ok[n] or ln > max_len:
+            results.append(_scalar_ltsv(decoder, line))
+            continue
+        byte_ok = len(line) == ln
+        results.append(_from_spans(line, raw, byte_ok, n, out, ts_rfc, decoder))
+    return results
+
+
+def _scalar_ltsv(decoder: LTSVDecoder, line: str) -> LineResult:
+    try:
+        return LineResult(decoder.decode(line), None, line)
+    except DecodeError as e:
+        return LineResult(None, str(e), line)
+
+
+def _from_spans(line: str, raw: bytes, byte_ok: bool, n: int,
+                o: Dict[str, np.ndarray], ts_rfc: np.ndarray,
+                decoder: LTSVDecoder) -> LineResult:
+    def take(a: int, b: int) -> str:
+        if a < 0 or b < a:
+            return ""
+        if byte_ok:
+            return line[a:b]
+        return raw[a:b].decode("utf-8")
+
+    # timestamp
+    if int(o["ts_kind"][n]) == 0:
+        ts = float(ts_rfc[n])
+    else:
+        ts = float(take(int(o["ts_start"][n]), int(o["ts_end"][n])))
+
+    hostname = take(int(o["host_start"][n]), int(o["host_end"][n])) \
+        if int(o["host_pos"][n]) >= 0 else None
+    msg = take(int(o["msg_start"][n]), int(o["msg_end"][n])) \
+        if int(o["msg_pos"][n]) >= 0 else None
+    level = int(o["level_val"][n])
+    severity = level if level >= 0 else None
+
+    sd = StructuredData(None)
+    try:
+        for k in range(int(o["n_parts"][n])):
+            ps, pe = int(o["part_start"][n, k]), int(o["part_end"][n, k])
+            cp = int(o["colon_pos"][n, k])
+            if cp < 0 or cp >= pe:
+                name = take(ps, pe)
+                print(f"Missing value for name '{name}'")
+                continue
+            key = take(ps, cp)
+            if key in _SPECIAL:
+                continue  # routed by the kernel
+            value = take(cp + 1, pe)
+            sd.pairs.append(decoder._typed_pair(key, value))
+    except DecodeError as e:
+        return LineResult(None, str(e), line)
+
+    record = Record(
+        ts=ts,
+        hostname=hostname,
+        severity=severity,
+        msg=msg,
+        full_msg=line,
+        sd=[sd] if sd.pairs else None,
+    )
+    return LineResult(record, None, line)
