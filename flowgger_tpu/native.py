@@ -1,0 +1,102 @@
+"""ctypes bindings for the C++ host tier (native/flowgger_host.cpp).
+
+Loads ``native/libflowgger_host.so``, building it on first use when a
+compiler is available; every entry degrades to the numpy implementation
+when the library is missing, so the package works without a toolchain.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                           "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libflowgger_host.so")
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+_DEFAULT_THREADS = min(8, os.cpu_count() or 1)
+
+
+def _load():
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        # always invoke make: it no-ops when the .so is fresh and rebuilds
+        # when flowgger_host.cpp changed (stale-binary protection)
+        try:
+            subprocess.run(["make", "-C", _NATIVE_DIR, "-s"],
+                           check=True, capture_output=True, timeout=120)
+        except (OSError, subprocess.SubprocessError):
+            if not os.path.exists(_LIB_PATH):
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError:
+            return None
+        lib.fg_split_lines.restype = ctypes.c_int64
+        lib.fg_split_lines.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_int64, ctypes.c_int, ctypes.POINTER(ctypes.c_int64)]
+        lib.fg_pack_lines.restype = None
+        lib.fg_pack_lines.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_int64, ctypes.c_int32, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_int]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def split_chunk_native(chunk: bytes, strip_cr: bool = True
+                       ) -> Optional[Tuple[np.ndarray, np.ndarray, int, bytes]]:
+    """(starts, lens, n, carry) via the native memchr scan; None when the
+    library is unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    cap = max(16, chunk.count(b"\n") + 1)
+    starts = np.empty(cap, dtype=np.int32)
+    lens = np.empty(cap, dtype=np.int32)
+    carry_start = ctypes.c_int64(0)
+    buf = np.frombuffer(chunk, dtype=np.uint8)
+    n = lib.fg_split_lines(
+        buf.ctypes.data, buf.size,
+        starts.ctypes.data, lens.ctypes.data, cap,
+        1 if strip_cr else 0, ctypes.byref(carry_start))
+    return starts[:n], lens[:n], int(n), chunk[carry_start.value:]
+
+
+def pack_chunk_native(chunk: bytes, starts: np.ndarray, lens: np.ndarray,
+                      max_len: int, n_rows: int
+                      ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """Dense [n_rows, max_len] batch + clipped lens from a contiguous
+    chunk; rows past len(starts) are zeroed."""
+    lib = _load()
+    if lib is None:
+        return None
+    n = len(starts)
+    batch = np.zeros((n_rows, max_len), dtype=np.uint8)
+    lens_out = np.zeros(n_rows, dtype=np.int32)
+    if n:
+        buf = np.frombuffer(chunk, dtype=np.uint8)
+        starts = np.ascontiguousarray(starts, dtype=np.int32)
+        in_lens = np.ascontiguousarray(lens, dtype=np.int32)
+        lib.fg_pack_lines(
+            buf.ctypes.data, buf.size,
+            starts.ctypes.data, in_lens.ctypes.data, n,
+            max_len, batch.ctypes.data, lens_out.ctypes.data,
+            _DEFAULT_THREADS)
+    return batch, lens_out

@@ -49,6 +49,8 @@ class BatchHandler(Handler):
             "input.tpu_max_line_len", "input.tpu_max_line_len must be an integer",
             DEFAULT_MAX_LINE_LEN)
         self._lines: List[bytes] = []
+        self._chunks: List[bytes] = []      # complete-line regions (fast path)
+        self._chunk_lines = 0
         self._lock = threading.Lock()
         # serializes batch decodes so a timer flush racing a size flush
         # cannot reorder output
@@ -67,6 +69,21 @@ class BatchHandler(Handler):
         }.get(fmt)
 
     # -- Handler interface -------------------------------------------------
+    def ingest_chunk(self, region: bytes) -> None:
+        """Fast path fed by LineSplitter: a region of *complete* newline-
+        terminated lines straight off the wire — no per-line Python
+        objects; native code does the framing at flush."""
+        with self._lock:
+            self._chunks.append(region)
+            self._chunk_lines += region.count(b"\n")
+            full = self._chunk_lines + len(self._lines) >= self.batch_size
+            if not full and self._timer is None and self._start_timer:
+                self._timer = threading.Timer(self.flush_ms / 1000.0, self.flush)
+                self._timer.daemon = True
+                self._timer.start()
+        if full:
+            self.flush()
+
     def handle_bytes(self, raw: bytes) -> None:
         with self._lock:
             self._lines.append(raw)
@@ -84,11 +101,15 @@ class BatchHandler(Handler):
     def flush(self) -> None:
         with self._lock:
             lines, self._lines = self._lines, []
+            chunks, self._chunks = self._chunks, []
+            self._chunk_lines = 0
             if self._timer is not None:
                 self._timer.cancel()
                 self._timer = None
-        if lines:
-            with self._decode_lock:
+        with self._decode_lock:
+            if chunks:
+                self._decode_chunks(chunks)
+            if lines:
                 self._decode_batch(lines)
 
     # -- batched decode ----------------------------------------------------
@@ -98,6 +119,24 @@ class BatchHandler(Handler):
 
         return LTSVDecoder(config)
 
+    def _decode_chunks(self, chunks: List[bytes]) -> None:
+        from . import pack
+
+        region = b"".join(chunks)
+        if self._kernel_fn is None or self.fmt == "auto":
+            # these paths want a per-line list; split once in C speed
+            lines = region.split(b"\n")
+            lines.pop()  # regions end with the separator
+            lines = [ln[:-1] if ln.endswith(b"\r") else ln for ln in lines]
+            if self.fmt != "auto":
+                for raw in lines:
+                    self.scalar.handle_bytes(raw)
+                return
+            self._emit(self._kernel_fn(lines))
+            return
+        packed = pack.pack_region_2d(region, self.max_len)
+        self._emit(_decode_packed(self.fmt, packed, self.scalar.decoder))
+
     def _decode_batch(self, lines: List[bytes]) -> None:
         if self._kernel_fn is None:
             # formats without a columnar kernel yet: scalar per line
@@ -105,6 +144,9 @@ class BatchHandler(Handler):
                 self.scalar.handle_bytes(raw)
             return
         results = self._kernel_fn(lines)
+        self._emit(results)
+
+    def _emit(self, results) -> None:
         for res in results:
             if res.record is None:
                 if res.error == "__utf8__":
@@ -126,16 +168,41 @@ class BatchHandler(Handler):
             self.tx.put(encoded)
 
 
-def _decode_gelf_batch(lines, max_len):
+def _decode_packed(fmt, packed, decoder=None):
+    """Run the columnar kernel + materializer for one packed tuple
+    (batch, lens, chunk, starts, orig_lens, n_real)."""
     import jax.numpy as jnp
 
-    from . import gelf, materialize_gelf, pack
+    batch, lens, chunk, starts, orig_lens, n_real = packed
+    jb, jl = jnp.asarray(batch), jnp.asarray(lens)
+    if fmt == "rfc5424":
+        from . import materialize, rfc5424
 
-    batch, lens, chunk, starts, orig_lens, n_real = pack.pack_lines_2d(lines, max_len)
-    out = gelf.decode_gelf_jit(jnp.asarray(batch), jnp.asarray(lens))
-    host_out = {k: np.asarray(v) for k, v in out.items()}
-    return materialize_gelf.materialize_gelf(chunk, starts, orig_lens, host_out,
-                                             n_real, max_len)
+        out = rfc5424.decode_rfc5424_jit(jb, jl)
+        host_out = {k: np.asarray(v) for k, v in out.items()}
+        return materialize.materialize(chunk, starts, lens, orig_lens, host_out,
+                                       n_real, max_len=batch.shape[1])
+    if fmt == "ltsv":
+        from . import ltsv, materialize_ltsv
+
+        out = ltsv.decode_ltsv_jit(jb, jl)
+        host_out = {k: np.asarray(v) for k, v in out.items()}
+        return materialize_ltsv.materialize_ltsv(chunk, starts, orig_lens, host_out,
+                                                 n_real, batch.shape[1], decoder)
+    if fmt == "gelf":
+        from . import gelf, materialize_gelf
+
+        out = gelf.decode_gelf_jit(jb, jl)
+        host_out = {k: np.asarray(v) for k, v in out.items()}
+        return materialize_gelf.materialize_gelf(chunk, starts, orig_lens, host_out,
+                                                 n_real, batch.shape[1])
+    raise ValueError(f"no kernel for format {fmt}")
+
+
+def _decode_gelf_batch(lines, max_len):
+    from . import pack
+
+    return _decode_packed("gelf", pack.pack_lines_2d(lines, max_len))
 
 
 def _decode_auto_batch(lines, max_len, ltsv_decoder=None):
@@ -145,25 +212,13 @@ def _decode_auto_batch(lines, max_len, ltsv_decoder=None):
 
 
 def _decode_ltsv_batch(lines, max_len, decoder):
-    import jax.numpy as jnp
+    from . import pack
 
-    from . import ltsv, materialize_ltsv, pack
-
-    batch, lens, chunk, starts, orig_lens, n_real = pack.pack_lines_2d(lines, max_len)
-    out = ltsv.decode_ltsv_jit(jnp.asarray(batch), jnp.asarray(lens))
-    host_out = {k: np.asarray(v) for k, v in out.items()}
-    return materialize_ltsv.materialize_ltsv(chunk, starts, orig_lens, host_out,
-                                             n_real, max_len, decoder)
+    return _decode_packed("ltsv", pack.pack_lines_2d(lines, max_len), decoder)
 
 
 def _decode_rfc5424_batch(lines, max_len):
-    import jax.numpy as jnp
+    from . import pack
 
-    from . import materialize, pack, rfc5424
-
-    batch, lens, chunk, starts, orig_lens, n_real = pack.pack_lines_2d(lines, max_len)
-    out = rfc5424.decode_rfc5424_jit(jnp.asarray(batch), jnp.asarray(lens))
-    host_out = {k: np.asarray(v) for k, v in out.items()}
-    return materialize.materialize(chunk, starts, lens, orig_lens, host_out,
-                                   n_real, max_len)
+    return _decode_packed("rfc5424", pack.pack_lines_2d(lines, max_len))
 

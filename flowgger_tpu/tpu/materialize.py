@@ -68,10 +68,12 @@ def materialize(
     ``orig_lens`` the true line lengths — rows longer than ``max_len``
     bypass the kernel result entirely.
     """
-    ts = compute_ts(out)
-    ok = np.asarray(out["ok"])
+    ts = compute_ts(out).tolist()
+    # plain-list views: C-speed bulk conversion once per batch instead of
+    # ~40 numpy scalar __getitem__/int() round-trips per record
+    o = {k: np.asarray(v).tolist() for k, v in out.items()}
+    ok = o["ok"]
     results: List[LineResult] = []
-    o = out  # brevity
     for n in range(n_real):
         s = int(starts[n])
         ln = int(orig_lens[n])
@@ -106,16 +108,16 @@ def _build_sd(n: int, o: Dict[str, np.ndarray], take) -> Optional[List[Structure
         return None
     blocks = []
     for k in range(sd_count):
-        blocks.append(StructuredData(take(int(o["sid_start"][n, k]),
-                                          int(o["sid_end"][n, k]))))
+        blocks.append(StructuredData(take(int(o["sid_start"][n][k]),
+                                          int(o["sid_end"][n][k]))))
     pair_count = int(o["pair_count"][n])
     has_esc = o["val_has_esc"]
     for j in range(pair_count):
-        name = take(int(o["name_start"][n, j]), int(o["name_end"][n, j]))
-        value = take(int(o["val_start"][n, j]), int(o["val_end"][n, j]))
-        if has_esc[n, j]:
+        name = take(int(o["name_start"][n][j]), int(o["name_end"][n][j]))
+        value = take(int(o["val_start"][n][j]), int(o["val_end"][n][j]))
+        if has_esc[n][j]:
             value = _unescape_sd_value(value)
-        blocks[int(o["pair_sd"][n, j])].pairs.append(("_" + name, SDValue.string(value)))
+        blocks[int(o["pair_sd"][n][j])].pairs.append(("_" + name, SDValue.string(value)))
     return blocks
 
 

@@ -37,7 +37,8 @@ def materialize_gelf(
     n_real: int,
     max_len: int,
 ) -> List[LineResult]:
-    ok = np.asarray(out["ok"])
+    out = {k: np.asarray(v).tolist() for k, v in out.items()}
+    ok = out["ok"]
     results: List[LineResult] = []
     for n in range(n_real):
         s = int(starts[n])
@@ -72,17 +73,17 @@ def _from_spans(line: str, raw: bytes, byte_ok: bool, n: int,
     obj = {}
     try:
         for k in range(int(o["n_fields"][n])):
-            ks, ke = int(o["key_start"][n, k]), int(o["key_end"][n, k])
+            ks, ke = int(o["key_start"][n][k]), int(o["key_end"][n][k])
             key = take(ks, ke)
-            if o["key_esc"][n, k]:
+            if o["key_esc"][n][k]:
                 key = json.loads(f'"{key}"')
             elif any(ord(c) < 0x20 for c in key):
                 raise ValueError("control char")
-            vt = int(o["val_type"][n, k])
-            vs, ve = int(o["val_start"][n, k]), int(o["val_end"][n, k])
+            vt = int(o["val_type"][n][k])
+            vs, ve = int(o["val_start"][n][k]), int(o["val_end"][n][k])
             if vt == VT_STRING:
                 value = take(vs, ve)
-                if o["val_esc"][n, k]:
+                if o["val_esc"][n][k]:
                     value = json.loads(f'"{value}"')
                 elif any(ord(c) < 0x20 for c in value):
                     raise ValueError("control char")  # oracle rejects too

@@ -29,8 +29,9 @@ def materialize_ltsv(
     max_len: int,
     decoder: LTSVDecoder,
 ) -> List[LineResult]:
-    ts_rfc = compute_ts(out)
-    ok = np.asarray(out["ok"])
+    ts_rfc = compute_ts(out).tolist()
+    out = {k: np.asarray(v).tolist() for k, v in out.items()}
+    ok = out["ok"]
     results: List[LineResult] = []
     for n in range(n_real):
         s = int(starts[n])
@@ -82,8 +83,8 @@ def _from_spans(line: str, raw: bytes, byte_ok: bool, n: int,
     sd = StructuredData(None)
     try:
         for k in range(int(o["n_parts"][n])):
-            ps, pe = int(o["part_start"][n, k]), int(o["part_end"][n, k])
-            cp = int(o["colon_pos"][n, k])
+            ps, pe = int(o["part_start"][n][k]), int(o["part_end"][n][k])
+            cp = int(o["colon_pos"][n][k])
             if cp < 0 or cp >= pe:
                 name = take(ps, pe)
                 print(f"Missing value for name '{name}'")

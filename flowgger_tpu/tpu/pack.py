@@ -1,12 +1,10 @@
-"""Host-side batch packing: list-of-lines → (chunk, starts, lens) with
-static padded shapes.
+"""Host-side batch packing: framed lines → dense [N, max_len] batches.
 
 The arena replaces the reference's per-line ``Vec<u8>`` channel payloads
-(mod.rs:461-468): lines are concatenated into one contiguous chunk and
-described by offset/length vectors; the actual ``[N, L]`` gather happens
-on device (tpu/rfc5424.py pack_on_device), so the host's per-line work is
-one ``bytes.join``.  Shapes are bucketed to powers of two to bound XLA
-recompilations.
+(mod.rs:461-468): lines live in one contiguous chunk described by
+offset/length vectors; the dense pack is a native threaded memcpy
+(flowgger_tpu/native.py) with a vectorized numpy fallback.  Shapes are
+bucketed to powers of two to bound XLA recompilations.
 """
 
 from __future__ import annotations
@@ -26,12 +24,92 @@ def _next_pow2(n: int) -> int:
     return p
 
 
-def pack_lines(lines: List[bytes]) -> Tuple[np.ndarray, np.ndarray, np.ndarray, int]:
-    """Concatenate lines into a padded chunk.
+def _split_np(chunk: bytes, strip_cr: bool = True
+              ) -> Tuple[np.ndarray, np.ndarray, int, bytes]:
+    """Numpy newline scan: (starts, lens, n, carry) —
+    BufRead::lines semantics (one trailing CR stripped)."""
+    buf = np.frombuffer(chunk, dtype=np.uint8)
+    nl = np.flatnonzero(buf == 10).astype(np.int32)
+    n = int(nl.size)
+    if n == 0:
+        return np.zeros(0, np.int32), np.zeros(0, np.int32), 0, chunk
+    starts = np.concatenate([np.zeros(1, np.int32), nl[:-1] + 1])
+    ends = nl.copy()
+    if strip_cr:
+        has_cr = (ends > starts) & (buf[np.maximum(ends - 1, 0)] == 13)
+        ends = ends - has_cr.astype(np.int32)
+    return starts, ends - starts, n, chunk[int(nl[-1]) + 1:]
 
-    Returns (chunk uint8[B], starts int32[Np], lens int32[Np], n_real)
-    where B and Np are bucketed; rows past n_real are zero-length padding.
-    """
+
+def _split(chunk: bytes, strip_cr: bool = True):
+    from .. import native
+
+    res = native.split_chunk_native(chunk, strip_cr)
+    return res if res is not None else _split_np(chunk, strip_cr)
+
+
+def _pack_dense(chunk: bytes, starts: np.ndarray, lens: np.ndarray,
+                max_len: int, np_rows: int) -> Tuple[np.ndarray, np.ndarray]:
+    """(batch [np_rows, max_len] u8, clipped lens [np_rows]) — native
+    threaded memcpy or the numpy clip/mask/gather fallback."""
+    from .. import native
+
+    packed = native.pack_chunk_native(chunk, starts, lens, max_len, np_rows)
+    if packed is not None:
+        return packed
+    n = len(starts)
+    buf = np.frombuffer(chunk, dtype=np.uint8)
+    lens_c = np.minimum(lens, max_len)
+    batch = np.zeros((np_rows, max_len), dtype=np.uint8)
+    if n:
+        idx = starts[:, None] + np.arange(max_len, dtype=np.int32)[None, :]
+        np.clip(idx, 0, max(buf.size - 1, 0), out=idx)
+        mask = np.arange(max_len, dtype=np.int32)[None, :] < lens_c[:, None]
+        np.multiply(buf[idx], mask, out=batch[:n], casting="unsafe")
+    lens_p = np.zeros(np_rows, dtype=np.int32)
+    lens_p[:n] = lens_c
+    return batch, lens_p
+
+
+def _finish(chunk: bytes, starts: np.ndarray, lens: np.ndarray, n: int,
+            max_len: int):
+    np_rows = max(_MIN_ROWS, _next_pow2(max(n, 1)))
+    batch, lens_p = _pack_dense(chunk, starts, lens, max_len, np_rows)
+    starts_p = np.zeros(np_rows, dtype=np.int32)
+    starts_p[:n] = starts
+    return batch, lens_p, chunk, starts_p, np.asarray(lens, dtype=np.int32), n
+
+
+def pack_lines_2d(lines: List[bytes], max_len: int):
+    """Pack a list of framed lines.  Returns
+    (batch, clipped_lens, chunk, starts, orig_lens, n_real) with row
+    count bucketed to a power of two."""
+    n = len(lines)
+    chunk = b"".join(lines)
+    orig_lens = np.fromiter((len(ln) for ln in lines), dtype=np.int32, count=n)
+    starts = np.zeros(n, dtype=np.int32)
+    if n > 1:
+        np.cumsum(orig_lens[:-1], out=starts[1:])
+    return _finish(chunk, starts, orig_lens, n, max_len)
+
+
+def pack_region_2d(region: bytes, max_len: int):
+    """Pack a region of complete newline-terminated lines straight into a
+    dense batch — the zero-per-line-Python fast path.  Same return
+    contract as pack_lines_2d."""
+    starts, lens, n, _carry = _split(region)
+    return _finish(region, starts, lens, n, max_len)
+
+
+# kept for callers that want raw framing metadata (tests, future C++ IO)
+def split_chunk(chunk: bytes, strip_cr: bool = True):
+    """(starts, lens, n, carry) over a raw chunk."""
+    return _split(chunk, strip_cr)
+
+
+def pack_lines(lines: List[bytes]) -> Tuple[np.ndarray, np.ndarray, np.ndarray, int]:
+    """Legacy 1-D layout: (padded chunk u8[B], starts, lens, n_real) for
+    the on-device pack path (graft entry / CPU backend)."""
     n = len(lines)
     chunk = b"".join(lines)
     lens = np.fromiter((len(ln) for ln in lines), dtype=np.int32, count=n)
@@ -48,64 +126,3 @@ def pack_lines(lines: List[bytes]) -> Tuple[np.ndarray, np.ndarray, np.ndarray, 
     starts_p[:n] = starts
     lens_p[:n] = lens
     return buf, starts_p, lens_p, n
-
-
-def pack_lines_2d(lines: List[bytes], max_len: int
-                  ) -> Tuple[np.ndarray, np.ndarray, bytes, np.ndarray, np.ndarray, int]:
-    """Pack lines into a dense ``[N, max_len]`` uint8 batch on the host
-    (vectorized numpy gather — XLA's device gather lowers near-serially
-    on TPU, so the transpose-to-dense happens here).
-
-    Returns (batch, clipped_lens, chunk, starts, orig_lens, n_real) with
-    N bucketed to a power of two.
-    """
-    n = len(lines)
-    chunk = b"".join(lines)
-    orig_lens = np.fromiter((len(ln) for ln in lines), dtype=np.int32, count=n)
-    starts = np.zeros(n, dtype=np.int32)
-    if n > 1:
-        np.cumsum(orig_lens[:-1], out=starts[1:])
-    np_rows = max(_MIN_ROWS, _next_pow2(n))
-    buf = np.frombuffer(chunk, dtype=np.uint8)
-    lens_c = np.minimum(orig_lens, max_len)
-    batch = np.zeros((np_rows, max_len), dtype=np.uint8)
-    if n:
-        idx = starts[:, None] + np.arange(max_len, dtype=np.int32)[None, :]
-        np.clip(idx, 0, max(buf.size - 1, 0), out=idx)
-        mask = np.arange(max_len, dtype=np.int32)[None, :] < lens_c[:, None]
-        np.multiply(buf[idx], mask, out=batch[:n], casting="unsafe")
-    starts_p = np.zeros(np_rows, dtype=np.int32)
-    lens_p = np.zeros(np_rows, dtype=np.int32)
-    starts_p[:n] = starts
-    lens_p[:n] = lens_c
-    return batch, lens_p, chunk, starts_p, orig_lens, n
-
-
-def split_chunk(chunk: bytes, strip_cr: bool = True
-                ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, int, bytes]:
-    """Newline-split a raw chunk columnar-ly (no per-line Python): returns
-    (buf, starts, lens, n_real, carry) where carry is the trailing partial
-    line to prepend to the next chunk — the batcher's version of the
-    splitter's BufRead carry (SURVEY.md §5 long-context note)."""
-    buf = np.frombuffer(chunk, dtype=np.uint8)
-    nl = np.flatnonzero(buf == 10).astype(np.int32)
-    if nl.size == 0:
-        return buf, np.zeros(0, np.int32), np.zeros(0, np.int32), 0, chunk
-    starts = np.concatenate([np.zeros(1, np.int32), nl[:-1] + 1])
-    ends = nl.copy()
-    if strip_cr:
-        # drop one trailing \r per line (BufRead::lines semantics)
-        has_cr = (ends > starts) & (buf[np.maximum(ends - 1, 0)] == 13)
-        ends = ends - has_cr.astype(np.int32)
-    lens = ends - starts
-    carry = chunk[int(nl[-1]) + 1:]
-    n = int(nl.size)
-    np_rows = max(_MIN_ROWS, _next_pow2(n))
-    nb = max(_MIN_BYTES, _next_pow2(buf.size))
-    buf_p = np.zeros(nb, dtype=np.uint8)
-    buf_p[: buf.size] = buf
-    starts_p = np.zeros(np_rows, dtype=np.int32)
-    lens_p = np.zeros(np_rows, dtype=np.int32)
-    starts_p[:n] = starts
-    lens_p[:n] = lens
-    return buf_p, starts_p, lens_p, n, carry

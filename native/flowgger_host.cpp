@@ -1,0 +1,81 @@
+// Native host tier: the hot host-side paths of the batched pipeline.
+//
+// The reference's host runtime is native (Rust) end to end; here the
+// host-side work that sits on the TPU ingest path — newline framing of
+// raw chunks and packing framed lines into the dense [N, max_len] batch
+// the kernels consume — is C++ with simple pthread fan-out, exposed via
+// a C ABI for ctypes (flowgger_tpu/native.py).  Python/numpy remains the
+// fallback when the library isn't built.
+//
+// Parity notes: split semantics match BufRead::lines (line_splitter.rs:
+// 17 — \n framing, one trailing \r stripped); the packer implements the
+// same clip-and-zero-pad contract as tpu/pack.py pack_lines_2d.
+
+#include <cstdint>
+#include <cstring>
+#include <algorithm>
+#include <thread>
+#include <vector>
+
+extern "C" {
+
+// Scan a raw chunk for newline-framed records.
+// Writes line start offsets and (CR-stripped) lengths; returns the number
+// of complete lines.  *carry_start receives the offset of the trailing
+// partial line (== size when the chunk ends exactly on a newline).
+int64_t fg_split_lines(const uint8_t* buf, int64_t size,
+                       int32_t* starts, int32_t* lens, int64_t cap,
+                       int strip_cr, int64_t* carry_start) {
+    int64_t n = 0;
+    int64_t pos = 0;
+    while (pos < size && n < cap) {
+        const void* nl = memchr(buf + pos, '\n', (size_t)(size - pos));
+        if (nl == nullptr) break;
+        int64_t end = (const uint8_t*)nl - buf;
+        int64_t len = end - pos;
+        if (strip_cr && len > 0 && buf[end - 1] == '\r') len -= 1;
+        starts[n] = (int32_t)pos;
+        lens[n] = (int32_t)len;
+        n += 1;
+        pos = end + 1;
+    }
+    *carry_start = pos;
+    return n;
+}
+
+// Pack n lines (described by starts/lens into chunk) into a dense
+// row-major [n_rows, max_len] uint8 batch, zero-padded; lens_out receives
+// the clipped lengths.  Rows beyond n are left untouched (caller zeroes).
+void fg_pack_lines(const uint8_t* chunk, int64_t chunk_size,
+                   const int32_t* starts, const int32_t* lens, int64_t n,
+                   int32_t max_len, uint8_t* out, int32_t* lens_out,
+                   int n_threads) {
+    if (n_threads < 1) n_threads = 1;
+    auto work = [&](int64_t lo, int64_t hi) {
+        for (int64_t i = lo; i < hi; i++) {
+            uint8_t* row = out + (size_t)i * (size_t)max_len;
+            int64_t start = starts[i];
+            int64_t len = lens[i];
+            if (len > max_len) len = max_len;
+            if (start < 0 || start + len > chunk_size) len = 0;
+            if (len > 0) memcpy(row, chunk + start, (size_t)len);
+            if (len < max_len) memset(row + len, 0, (size_t)(max_len - len));
+            lens_out[i] = (int32_t)len;
+        }
+    };
+    if (n_threads == 1 || n < 4096) {
+        work(0, n);
+        return;
+    }
+    std::vector<std::thread> threads;
+    int64_t per = (n + n_threads - 1) / n_threads;
+    for (int t = 0; t < n_threads; t++) {
+        int64_t lo = t * per;
+        int64_t hi = std::min<int64_t>(lo + per, n);
+        if (lo >= hi) break;
+        threads.emplace_back(work, lo, hi);
+    }
+    for (auto& th : threads) th.join();
+}
+
+}  // extern "C"

@@ -1,0 +1,122 @@
+"""Native host tier + chunked ingest fast-path tests."""
+
+import io
+import queue
+
+import numpy as np
+import pytest
+
+from flowgger_tpu import native
+from flowgger_tpu.config import Config
+from flowgger_tpu.decoders import RFC5424Decoder
+from flowgger_tpu.encoders import GelfEncoder
+from flowgger_tpu.splitters import LineSplitter, ScalarHandler
+from flowgger_tpu.tpu.batch import BatchHandler
+
+LINES = [
+    b"<13>1 2015-08-05T15:53:45Z host app 1 2 - hello one",
+    b'<23>1 2015-08-05T15:53:45.637824Z h a p m [id k="v"] two',
+    b"garbage line",
+    b"<13>1 2015-08-05T15:53:45Z host app 1 2 - three",
+]
+
+
+def test_native_split_matches_python():
+    if not native.available():
+        pytest.skip("native library not built")
+    chunk = b"aaa\r\nbb\n\nccc\npartial"
+    starts, lens, n, carry = native.split_chunk_native(chunk)
+    assert n == 4
+    assert carry == b"partial"
+    got = [chunk[starts[i]:starts[i] + lens[i]] for i in range(n)]
+    assert got == [b"aaa", b"bb", b"", b"ccc"]
+
+
+def test_native_pack_matches_numpy():
+    if not native.available():
+        pytest.skip("native library not built")
+    from flowgger_tpu.tpu import pack
+
+    lines = [bytes([65 + i % 26]) * (i % 70) for i in range(1000)]
+    b1 = pack.pack_lines_2d(lines, 48)
+    orig = native.pack_chunk_native
+    native.pack_chunk_native = lambda *a, **k: None
+    try:
+        b2 = pack.pack_lines_2d(lines, 48)
+    finally:
+        native.pack_chunk_native = orig
+    assert (b1[0] == b2[0]).all()
+    assert (b1[1] == b2[1]).all()
+
+
+def test_pack_region_matches_pack_lines():
+    from flowgger_tpu.tpu import pack
+
+    region = b"".join(ln + b"\n" for ln in LINES)
+    r1 = pack.pack_region_2d(region, 128)
+    r2 = pack.pack_lines_2d(LINES, 128)
+    assert r1[5] == r2[5]  # n_real
+    assert (r1[0][:4] == r2[0][:4]).all()
+    assert (r1[1][:4] == r2[1][:4]).all()
+    assert (r1[4][:4] == r2[4][:4]).all()
+
+
+def _run_handler(handler_cls_kwargs, data: bytes):
+    tx = queue.Queue()
+    handler = BatchHandler(tx, RFC5424Decoder(),
+                           GelfEncoder(Config.from_string("")),
+                           start_timer=False, **handler_cls_kwargs)
+    LineSplitter().run(io.BytesIO(data), handler)
+    out = []
+    while not tx.empty():
+        out.append(tx.get_nowait())
+    return out
+
+
+def test_chunked_ingest_equals_scalar_path(capsys):
+    data = b"".join(ln + b"\n" for ln in LINES)
+    got = _run_handler({}, data)
+
+    tx = queue.Queue()
+    scalar = ScalarHandler(tx, RFC5424Decoder(), GelfEncoder(Config.from_string("")))
+    for ln in LINES:
+        scalar.handle_bytes(ln)
+    want = []
+    while not tx.empty():
+        want.append(tx.get_nowait())
+    assert got == want
+    # the bad line was reported on both paths
+    assert capsys.readouterr().err.count("Unsupported BOM") == 2
+
+
+def test_chunked_ingest_crlf_and_partial_tail():
+    data = b"<13>1 2015-08-05T15:53:45Z h a p m - crlf\r\n" \
+           b"<13>1 2015-08-05T15:53:45Z h a p m - tail-no-newline"
+    got = _run_handler({}, data)
+    assert len(got) == 2
+    assert b'"short_message":"crlf"' in got[0]
+    assert b'"short_message":"tail-no-newline"' in got[1]
+
+
+def test_chunked_ingest_small_reads():
+    """Regions split across many tiny reads must reassemble correctly."""
+
+    class DribbleStream:
+        def __init__(self, data):
+            self.data = data
+            self.pos = 0
+
+        def read(self, n):
+            chunk = self.data[self.pos:self.pos + 7]
+            self.pos += len(chunk)
+            return chunk
+
+    data = b"".join(ln + b"\n" for ln in LINES)
+    tx = queue.Queue()
+    handler = BatchHandler(tx, RFC5424Decoder(),
+                           GelfEncoder(Config.from_string("")), start_timer=False)
+    LineSplitter().run(DribbleStream(data), handler)
+    out = []
+    while not tx.empty():
+        out.append(tx.get_nowait())
+    assert len(out) == 3  # three valid lines
