@@ -12,6 +12,7 @@ from __future__ import annotations
 import sys
 
 from . import Output, SHUTDOWN, spawn_worker
+from ..utils.metrics import registry as _metrics
 from ..config import Config, ConfigError
 from ..encoders import validate_time_format_input
 from ..utils.rotating_file import BufferedWriter, RotatingFile
@@ -97,6 +98,7 @@ class FileOutput(Output):
                     return
                 data = merger.frame(item) if merger is not None else item
                 writer.write(data)
+                _metrics.inc("output_written")
                 arx.task_done()
 
         return spawn_worker(run, "file-output")

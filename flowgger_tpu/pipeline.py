@@ -200,6 +200,9 @@ class Pipeline:
         self.tx: "queue.Queue[Optional[bytes]]" = queue.Queue(maxsize=queue_size)
         self.input_format = input_format
         self.config = config
+        from .utils import metrics as _metrics_mod
+
+        _metrics_mod.configure_from(config)
 
     def handler_factory(self):
         if self.input_format in _TPU_FORMATS:

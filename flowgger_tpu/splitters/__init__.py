@@ -26,6 +26,7 @@ from .. import capnp_wire
 from ..decoders import DecodeError
 from ..encoders import EncodeError
 from ..record import FACILITY_MAX, Record, SEVERITY_MAX, StructuredData
+from ..utils.metrics import registry as _metrics
 
 _CHUNK = 1 << 16
 
@@ -64,23 +65,35 @@ class ScalarHandler(Handler):
         try:
             line = raw.decode("utf-8")
         except UnicodeDecodeError:
+            _metrics.inc("invalid_utf8")
             print("Invalid UTF-8 input", file=sys.stderr)
             return
         self.handle_line(line)
 
     def handle_line(self, line: str) -> None:
+        _metrics.inc("input_lines")
         try:
             record = self.decoder.decode(line)
             encoded = self.encoder.encode(record)
-        except (DecodeError, EncodeError) as e:
-            if self.bare_errors:
-                print(e, file=sys.stderr)
-                return
-            stripped = line.strip()
-            if not (self.quiet_empty and not stripped):
-                print(f"{e}: [{stripped}]", file=sys.stderr)
+        except DecodeError as e:
+            _metrics.inc("decode_errors")
+            self._report_error(e, line)
             return
+        except EncodeError as e:
+            _metrics.inc("encode_errors")
+            self._report_error(e, line)
+            return
+        _metrics.inc("decoded_records")
+        _metrics.inc("enqueued")
         self.tx.put(encoded)
+
+    def _report_error(self, e, line: str) -> None:
+        if self.bare_errors:
+            print(e, file=sys.stderr)
+            return
+        stripped = line.strip()
+        if not (self.quiet_empty and not stripped):
+            print(f"{e}: [{stripped}]", file=sys.stderr)
 
     def handle_record(self, record: Record) -> None:
         try:

@@ -24,6 +24,7 @@ from ..config import Config
 from ..encoders import EncodeError
 from ..splitters import Handler, ScalarHandler
 from ..record import Record
+from ..utils.metrics import registry as _metrics
 
 DEFAULT_BATCH_SIZE = 16384
 DEFAULT_FLUSH_MS = 50
@@ -107,10 +108,17 @@ class BatchHandler(Handler):
                 self._timer.cancel()
                 self._timer = None
         with self._decode_lock:
+            import time as _time
+
+            t0 = _time.perf_counter()
+            n0 = _metrics.get("input_lines")
             if chunks:
                 self._decode_chunks(chunks)
             if lines:
                 self._decode_batch(lines)
+            _metrics.inc("batches")
+            _metrics.inc("batch_lines", _metrics.get("input_lines") - n0)
+            _metrics.batch_seconds.observe(_time.perf_counter() - t0)
 
     # -- batched decode ----------------------------------------------------
     @staticmethod
@@ -147,11 +155,15 @@ class BatchHandler(Handler):
         self._emit(results)
 
     def _emit(self, results) -> None:
+        _metrics.inc("input_lines", len(results))
         for res in results:
             if res.record is None:
                 if res.error == "__utf8__":
+                    _metrics.inc("invalid_utf8")
                     print("Invalid UTF-8 input", file=sys.stderr)
-                elif self.bare_errors:
+                    continue
+                _metrics.inc("decode_errors")
+                if self.bare_errors:
                     print(res.error, file=sys.stderr)
                 else:
                     stripped = res.line.strip()
@@ -161,10 +173,13 @@ class BatchHandler(Handler):
             try:
                 encoded = self.encoder.encode(res.record)
             except EncodeError as e:
+                _metrics.inc("encode_errors")
                 stripped = res.line.strip()
                 if not (self.quiet_empty and not stripped):
                     print(f"{e}: [{stripped}]", file=sys.stderr)
                 continue
+            _metrics.inc("decoded_records")
+            _metrics.inc("enqueued")
             self.tx.put(encoded)
 
 

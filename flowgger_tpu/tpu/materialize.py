@@ -84,6 +84,7 @@ def materialize(
             results.append(LineResult(None, "__utf8__", ""))
             continue
         if not ok[n] or ln > max_len:
+            from ..utils.metrics import registry as _m; _m.inc("fallback_rows")
             results.append(_scalar_line(line))
             continue
         ascii_line = len(line) == ln

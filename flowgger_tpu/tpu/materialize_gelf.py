@@ -50,6 +50,7 @@ def materialize_gelf(
             results.append(LineResult(None, "__utf8__", ""))
             continue
         if not ok[n] or ln > max_len:
+            from ..utils.metrics import registry as _m; _m.inc("fallback_rows")
             results.append(_scalar_gelf(line))
             continue
         results.append(_from_spans(line, raw, len(line) == ln, n, out))

@@ -43,6 +43,7 @@ def materialize_ltsv(
             results.append(LineResult(None, "__utf8__", ""))
             continue
         if not ok[n] or ln > max_len:
+            from ..utils.metrics import registry as _m; _m.inc("fallback_rows")
             results.append(_scalar_ltsv(decoder, line))
             continue
         byte_ok = len(line) == ln

@@ -1,0 +1,137 @@
+"""Per-stage counters and latency histograms.
+
+The reference has no observability at all — diagnostics are bare stderr
+writes and its declared ``log`` dependency is never used (SURVEY.md §5).
+This registry gives every pipeline stage cheap thread-safe counters and
+the batched decode path a latency histogram, reported as one JSON line
+on a configurable interval:
+
+    [metrics]
+    interval = 10            # seconds; 0/absent = disabled
+    path = "metrics.jsonl"   # default: stderr
+
+Counter names: input_lines, decoded_records, decode_errors,
+encode_errors, invalid_utf8, enqueued, output_written, output_errors,
+batches, batch_lines, fallback_rows.  ``batch_seconds`` is a histogram
+(count/sum/min/max/p50/p99 over a sliding window).
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import sys
+import threading
+import time
+from typing import Dict, Optional
+
+_COUNTERS = (
+    "input_lines", "decoded_records", "decode_errors", "encode_errors",
+    "invalid_utf8", "enqueued", "output_written", "output_errors",
+    "batches", "batch_lines", "fallback_rows",
+)
+
+
+class Histogram:
+    """Sliding-window latency histogram (last ``window`` samples)."""
+
+    def __init__(self, window: int = 1024):
+        self.window = window
+        self._samples = []
+        self._idx = itertools.count()
+        self.count = 0
+        self.sum = 0.0
+        self._lock = threading.Lock()
+
+    def observe(self, value: float):
+        with self._lock:
+            self.count += 1
+            self.sum += value
+            if len(self._samples) < self.window:
+                self._samples.append(value)
+            else:
+                self._samples[next(self._idx) % self.window] = value
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            samples = sorted(self._samples)
+            count, total = self.count, self.sum
+        if not samples:
+            return {"count": 0}
+        return {
+            "count": count,
+            "sum": round(total, 6),
+            "min": samples[0],
+            "p50": samples[len(samples) // 2],
+            "p99": samples[min(len(samples) - 1, int(len(samples) * 0.99))],
+            "max": samples[-1],
+        }
+
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, int] = {name: 0 for name in _COUNTERS}
+        self.batch_seconds = Histogram()
+        self._reporter: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    def inc(self, name: str, value: int = 1):
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + value
+
+    def get(self, name: str) -> int:
+        with self._lock:
+            return self._counters.get(name, 0)
+
+    def snapshot(self) -> Dict[str, object]:
+        with self._lock:
+            counters = dict(self._counters)
+        snap: Dict[str, object] = {"ts": round(time.time(), 3)}
+        snap.update(counters)
+        snap["batch_seconds"] = self.batch_seconds.snapshot()
+        return snap
+
+    def reset(self):
+        with self._lock:
+            for k in self._counters:
+                self._counters[k] = 0
+        self.batch_seconds = Histogram()
+
+    # -- periodic reporter -------------------------------------------------
+    def start_reporter(self, interval: float, path: Optional[str] = None):
+        if interval <= 0 or self._reporter is not None:
+            return
+
+        def run():
+            out = open(path, "a") if path else sys.stderr
+            try:
+                while not self._stop.wait(interval):
+                    print(json.dumps(self.snapshot()), file=out, flush=True)
+            finally:
+                if path:
+                    out.close()
+
+        self._reporter = threading.Thread(target=run, daemon=True,
+                                          name="metrics-reporter")
+        self._reporter.start()
+
+    def stop_reporter(self):
+        self._stop.set()
+        if self._reporter is not None:
+            self._reporter.join(timeout=2)
+            self._reporter = None
+        self._stop = threading.Event()
+
+
+# process-wide registry; pipeline stages import and increment this
+registry = Registry()
+
+
+def configure_from(config) -> None:
+    """Start the reporter if [metrics] is configured (pipeline boot)."""
+    interval = config.lookup_int(
+        "metrics.interval", "metrics.interval must be an integer", 0)
+    path = config.lookup_str("metrics.path", "metrics.path must be a string")
+    if interval and interval > 0:
+        registry.start_reporter(float(interval), path)

@@ -1,0 +1,70 @@
+"""Observability tests: per-stage counters and the periodic reporter."""
+
+import json
+import queue
+import time
+
+from flowgger_tpu.config import Config
+from flowgger_tpu.decoders import RFC5424Decoder
+from flowgger_tpu.encoders import GelfEncoder
+from flowgger_tpu.splitters import ScalarHandler
+from flowgger_tpu.utils.metrics import Registry, registry
+
+
+def test_scalar_handler_counters():
+    registry.reset()
+    tx = queue.Queue()
+    handler = ScalarHandler(tx, RFC5424Decoder(), GelfEncoder(Config.from_string("")))
+    handler.handle_bytes(b"<13>1 2015-08-05T15:53:45Z h a p m - ok")
+    handler.handle_bytes(b"bad line")
+    handler.handle_bytes(b"\xff\xfe")
+    assert registry.get("input_lines") == 2  # utf8 failure never reaches decode
+    assert registry.get("decoded_records") == 1
+    assert registry.get("decode_errors") == 1
+    assert registry.get("invalid_utf8") == 1
+    assert registry.get("enqueued") == 1
+
+
+def test_batch_handler_counters():
+    registry.reset()
+    from flowgger_tpu.tpu.batch import BatchHandler
+
+    tx = queue.Queue()
+    handler = BatchHandler(tx, RFC5424Decoder(),
+                           GelfEncoder(Config.from_string("")), start_timer=False)
+    handler.handle_bytes(b"<13>1 2015-08-05T15:53:45Z h a p m - one")
+    handler.handle_bytes(b"nope")
+    handler.flush()
+    assert registry.get("batches") == 1
+    assert registry.get("input_lines") == 2
+    assert registry.get("decoded_records") == 1
+    assert registry.get("decode_errors") == 1
+    assert registry.get("fallback_rows") >= 1  # the bad line fell back
+    snap = registry.snapshot()
+    assert snap["batch_seconds"]["count"] == 1
+
+
+def test_reporter_writes_json(tmp_path):
+    reg = Registry()
+    reg.inc("input_lines", 7)
+    path = tmp_path / "metrics.jsonl"
+    reg.start_reporter(0.05, str(path))
+    time.sleep(0.2)
+    reg.stop_reporter()
+    lines = path.read_text().strip().splitlines()
+    assert lines
+    snap = json.loads(lines[0])
+    assert snap["input_lines"] == 7
+    assert "batch_seconds" in snap
+
+
+def test_histogram_snapshot():
+    from flowgger_tpu.utils.metrics import Histogram
+
+    h = Histogram(window=8)
+    for v in (0.5, 0.1, 0.9, 0.3):
+        h.observe(v)
+    snap = h.snapshot()
+    assert snap["count"] == 4
+    assert snap["min"] == 0.1 and snap["max"] == 0.9
+    assert abs(snap["sum"] - 1.8) < 1e-9
