@@ -19,33 +19,14 @@ from ..config import Config, ConfigError
 from ..record import Record, SDValue
 from ..utils.rustfmt import json_f64
 
-_ESCAPES = {
-    '"': '\\"',
-    "\\": "\\\\",
-    "\b": "\\b",
-    "\f": "\\f",
-    "\n": "\\n",
-    "\r": "\\r",
-    "\t": "\\t",
-}
-
-
-def _json_escape(s: str) -> str:
-    out = []
-    for c in s:
-        e = _ESCAPES.get(c)
-        if e is not None:
-            out.append(e)
-        elif ord(c) < 0x20:
-            out.append(f"\\u{ord(c):04x}")
-        else:
-            out.append(c)
-    return "".join(out)
+# C-accelerated escape: quotes+escapes exactly like serde_json (",\\,
+# \b \f \n \r \t short forms, \u00xx for other controls, non-ASCII raw)
+from json.encoder import encode_basestring as _quote
 
 
 def _json_value(v) -> str:
     if isinstance(v, str):
-        return f'"{_json_escape(v)}"'
+        return _quote(v)
     if v is None:
         return "null"
     if isinstance(v, bool):
@@ -60,7 +41,7 @@ def _json_value(v) -> str:
 def serialize_sorted_json(obj: Dict[str, object]) -> bytes:
     """serde_json-compatible compact serialization with BTreeMap key order."""
     items = ",".join(
-        f'"{_json_escape(k)}":{_json_value(v)}' for k, v in sorted(obj.items())
+        f"{_quote(k)}:{_json_value(v)}" for k, v in sorted(obj.items())
     )
     return ("{" + items + "}").encode("utf-8")
 

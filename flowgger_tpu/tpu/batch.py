@@ -58,6 +58,10 @@ class BatchHandler(Handler):
         self._decode_lock = threading.Lock()
         self._timer: Optional[threading.Timer] = None
         self._start_timer = start_timer
+        # direct span->bytes encode for the flagship rfc5424->gelf route
+        from ..encoders.gelf import GelfEncoder
+
+        self._fast_encode = fmt == "rfc5424" and type(encoder) is GelfEncoder
         # single source of truth for kernel dispatch: fmt -> batch decoder
         auto_ltsv = self._auto_ltsv_decoder(cfg) if fmt == "auto" else None
         self._kernel_fn = {
@@ -143,6 +147,9 @@ class BatchHandler(Handler):
             self._emit(self._kernel_fn(lines))
             return
         packed = pack.pack_region_2d(region, self.max_len)
+        if self._fast_encode:
+            self._emit_encoded(_encode_packed_rfc5424_gelf(packed, self.encoder))
+            return
         self._emit(_decode_packed(self.fmt, packed, self.scalar.decoder))
 
     def _decode_batch(self, lines: List[bytes]) -> None:
@@ -151,8 +158,35 @@ class BatchHandler(Handler):
             for raw in lines:
                 self.scalar.handle_bytes(raw)
             return
+        if self._fast_encode:
+            from . import pack
+
+            packed = pack.pack_lines_2d(lines, self.max_len)
+            self._emit_encoded(_encode_packed_rfc5424_gelf(packed, self.encoder))
+            return
         results = self._kernel_fn(lines)
         self._emit(results)
+
+    def _emit_encoded(self, results) -> None:
+        """Emit pre-encoded bytes from the span->bytes fast path."""
+        _metrics.inc("input_lines", len(results))
+        for res in results:
+            if res.encoded is None:
+                if res.error == "__utf8__":
+                    _metrics.inc("invalid_utf8")
+                    print("Invalid UTF-8 input", file=sys.stderr)
+                    continue
+                _metrics.inc("decode_errors")
+                if self.bare_errors:
+                    print(res.error, file=sys.stderr)
+                else:
+                    stripped = res.line.strip()
+                    if not (self.quiet_empty and not stripped):
+                        print(f"{res.error}: [{stripped}]", file=sys.stderr)
+                continue
+            _metrics.inc("decoded_records")
+            _metrics.inc("enqueued")
+            self.tx.put(res.encoded)
 
     def _emit(self, results) -> None:
         _metrics.inc("input_lines", len(results))
@@ -181,6 +215,18 @@ class BatchHandler(Handler):
             _metrics.inc("decoded_records")
             _metrics.inc("enqueued")
             self.tx.put(encoded)
+
+
+def _encode_packed_rfc5424_gelf(packed, encoder):
+    import jax.numpy as jnp
+
+    from . import encode_gelf, rfc5424
+
+    batch, lens, chunk, starts, orig_lens, n_real = packed
+    out = rfc5424.decode_rfc5424_jit(jnp.asarray(batch), jnp.asarray(lens))
+    host_out = {k: np.asarray(v) for k, v in out.items()}
+    return encode_gelf.encode_rfc5424_gelf(chunk, starts, orig_lens, host_out,
+                                           n_real, batch.shape[1], encoder)
 
 
 def _decode_packed(fmt, packed, decoder=None):

@@ -1,0 +1,76 @@
+"""Differential test: span->bytes GELF fast path vs the Record path —
+output bytes must be identical for every line, fast path or fallback."""
+
+import queue
+
+import pytest
+
+from flowgger_tpu.config import Config
+from flowgger_tpu.decoders import RFC5424Decoder
+from flowgger_tpu.encoders import GelfEncoder
+from flowgger_tpu.splitters import ScalarHandler
+from flowgger_tpu.tpu.batch import BatchHandler
+
+CORPUS = [
+    "<13>1 2015-08-05T15:53:45Z host app 1 2 - plain message",
+    '<23>1 2015-08-05T15:53:45.637824Z testhostname appname 69 42 '
+    '[origin@123 software="te\\st sc\\"ript" swVersion="0.0.1"] test message',
+    "<13>1 2015-08-05T15:53:45Z  a p m - empty hostname",
+    "<13>1 2015-08-05T15:53:45Z h a p m -",
+    "<13>1 2015-08-05T15:53:45Z h a p m - msg with \"quotes\" and \\backslash",
+    '<13>1 2015-08-05T15:53:45Z h a p m [a@1 k="v"][b@2 k="v2" j="x"] dup sd keys',
+    "<13>1 2015-08-05T15:53:45Z h a p m - unicode mëssage",
+    "﻿<13>1 2015-08-05T15:53:45Z h a p m - bom line",
+    "<13>1 2015-08-05T15:53:45Z h a p m - trailing   ",
+    "not parseable at all",
+    '<13>1 2015-08-05T15:53:45Z h a p m [id one="1" two="2" three="3"] m',
+    "<191>1 2030-12-31T23:59:59.999999999+13:45 h a p m - extreme ts",
+]
+
+
+@pytest.mark.parametrize("extra_cfg", ["", '[output.gelf_extra]\nsecret = "s"\n'
+                                       'host = "overridden"\n'])
+def test_fast_encode_identical(extra_cfg, capsys):
+    def run(handler_cls, **kw):
+        tx = queue.Queue()
+        enc = GelfEncoder(Config.from_string(extra_cfg))
+        h = handler_cls(tx, RFC5424Decoder(), enc, **kw)
+        for ln in CORPUS:
+            h.handle_bytes(ln.encode("utf-8"))
+        if hasattr(h, "flush"):
+            h.flush()
+        out = []
+        while not tx.empty():
+            out.append(tx.get_nowait())
+        return out
+
+    fast = run(BatchHandler, start_timer=False)
+    ref = run(ScalarHandler)
+    assert fast == ref
+    # stderr errors doubled (both runs report the bad line)
+    assert capsys.readouterr().err.count("Unsupported BOM") == 2
+
+
+def test_fast_encode_via_chunks():
+    import io
+
+    from flowgger_tpu.splitters import LineSplitter
+
+    data = b"".join(ln.encode("utf-8") + b"\n" for ln in CORPUS)
+    tx = queue.Queue()
+    h = BatchHandler(tx, RFC5424Decoder(), GelfEncoder(Config.from_string("")),
+                     start_timer=False)
+    assert h._fast_encode
+    LineSplitter().run(io.BytesIO(data), h)
+    got = []
+    while not tx.empty():
+        got.append(tx.get_nowait())
+
+    tx2 = queue.Queue()
+    sc = ScalarHandler(tx2, RFC5424Decoder(), GelfEncoder(Config.from_string("")))
+    for ln in CORPUS:
+        sc.handle_bytes(ln.encode("utf-8"))
+    want = []
+    while not tx2.empty():
+        want.append(tx2.get_nowait())
+    assert got == want
