@@ -135,9 +135,6 @@ def decode_rfc5424(batch: jnp.ndarray, lens: jnp.ndarray,
     is_digit = (bb >= 48) & (bb <= 57)
     dig = (bb - 48).astype(_I32)
 
-    POS_SHIFT = 12          # payload bits below the position in packed mins
-    NOTF = jnp.int32((L + 1) << POS_SHIFT)
-
     # ---- BOM (rs:57-72) --------------------------------------------------
     bom = (
         (lens >= 3)
@@ -306,13 +303,28 @@ def decode_rfc5424(batch: jnp.ndarray, lens: jnp.ndarray,
         + ((next_bb == 32) & _shift_left(valid, 1, False)).astype(_I32) * 4
     )
     rb_ord = _cumsum(rbrack, scan_impl)
-    packed_pos = (iota << POS_SHIFT)
-    rb_packed = [
-        _min_where(rbrack & (rb_ord == k + 1), packed_pos + rb_payload, NOTF)
-        for k in range(max_sd + 1)
-    ]
-    rb_pos = jnp.stack([p >> POS_SHIFT for p in rb_packed], axis=1)   # [N, max_sd+1]
-    rb_flags = jnp.stack([p & 0xFFF for p in rb_packed], axis=1)
+    # sum-packed extraction of the first max_sd+1 structural ']' positions
+    # and their 3-bit payloads (unique masks per ordinal)
+    rb_pos_cols = []
+    rb_flag_cols = []
+    for base in range(0, max_sd + 1, 3):
+        hi = min(3, max_sd + 1 - base)
+        acc = 0
+        for slot in range(hi):
+            m = rbrack & (rb_ord == base + slot + 1)
+            acc = acc + (jnp.where(m, iota + 1, 0) << (10 * slot))
+        word = jnp.sum(acc, axis=1)
+        facc = 0
+        for slot in range(hi):
+            m = rbrack & (rb_ord == base + slot + 1)
+            facc = facc + (jnp.where(m, rb_payload, 0) << (3 * slot))
+        fword = jnp.sum(facc, axis=1)
+        for slot in range(hi):
+            p1 = (word >> (10 * slot)) & 0x3FF
+            rb_pos_cols.append(jnp.where(p1 == 0, L, p1 - 1))
+            rb_flag_cols.append((fword >> (3 * slot)) & 7)
+    rb_pos = jnp.stack(rb_pos_cols, axis=1)   # [N, max_sd+1]
+    rb_flags = jnp.stack(rb_flag_cols, axis=1)
     rb_found = rb_pos < L
 
     # running AND over the (small, static) block axis
@@ -397,36 +409,46 @@ def decode_rfc5424(batch: jnp.ndarray, lens: jnp.ndarray,
     pair_count = jnp.where(is_sd, pair_total, 0)
     ok &= jnp.where(is_sd, pair_count <= max_pairs, True)
 
-    # payload for open quotes: name_start (11b) | name_prev_is_space (1b)
+    # per-pair quantities come out via sum packing (like the header
+    # spaces): each pair ordinal is a unique mask, so a masked sum of
+    # (value+1) << (10*slot) extracts three pairs per reduction — 5
+    # quantities x ceil(P/3) sums + one 16-bit flag sum, replacing 3*P
+    # min-reductions.
+    def _sum_extract3(mask_of, value):
+        value1 = jnp.clip(value, 0, 1021) + 1
+        cols = []
+        for base in range(0, max_pairs, 3):
+            acc = jnp.where(mask_of(base), value1, 0)
+            if base + 1 < max_pairs:
+                acc = acc + (jnp.where(mask_of(base + 1), value1, 0) << 10)
+            if base + 2 < max_pairs:
+                acc = acc + (jnp.where(mask_of(base + 2), value1, 0) << 20)
+            word = jnp.sum(acc, axis=1)
+            for slot in range(min(3, max_pairs - base)):
+                cols.append((word >> (10 * slot)) & 0x3FF)
+        return jnp.stack(cols, axis=1)  # [N, P], 0 = not found else value+1
+
+    def _oq_at(k):
+        return oq_mask & (oq_ord == k + 1)
+
+    def _cq_at(k):
+        return cq_mask & (cq_ord == k + 1)
+
     name_start_ch = lnn2_pos + 1
-    oq_payload = (jnp.clip(name_start_ch, 0, (1 << 11) - 1) << 1) | (
-        (lnn2_ch == 32) | (lnn2_ch == -1)
-    ).astype(_I32)
-    OQS = 13  # position shift for open-quote packing (11b payload + 2)
-    oq_packed = [
-        _min_where(oq_mask & (oq_ord == k + 1),
-                   (iota << OQS) | oq_payload, jnp.int32(L << OQS))
-        for k in range(max_pairs)
-    ]
-    cq_packed = [
-        _min_where(cq_mask & (cq_ord == k + 1),
-                   (iota << OQS) | jnp.clip(bs_csum, 0, (1 << OQS) - 1),
-                   jnp.int32(L << OQS))
-        for k in range(max_pairs)
-    ]
-    oq_pos = jnp.stack([p >> OQS for p in oq_packed], axis=1)       # [N, P]
-    oq_name_start = jnp.stack([(p >> 1) & 0x7FF for p in oq_packed], axis=1)
-    oq_prev_sp = jnp.stack([p & 1 for p in oq_packed], axis=1)
-    cq_pos = jnp.stack([p >> OQS for p in cq_packed], axis=1)
-    cq_bs = jnp.stack([p & ((1 << OQS) - 1) for p in cq_packed], axis=1)
-    # bs_csum at the open quote, from a second payload channel
-    oq_bs_packed = [
-        _min_where(oq_mask & (oq_ord == k + 1),
-                   (iota << OQS) | jnp.clip(bs_csum, 0, (1 << OQS) - 1),
-                   jnp.int32(L << OQS))
-        for k in range(max_pairs)
-    ]
-    oq_bs = jnp.stack([p & ((1 << OQS) - 1) for p in oq_bs_packed], axis=1)
+    oq_pos_raw = _sum_extract3(_oq_at, iota)
+    oq_pos = jnp.where(oq_pos_raw == 0, L, oq_pos_raw - 1)
+    oq_name_start = _sum_extract3(_oq_at, name_start_ch) - 1
+    oq_bs = _sum_extract3(_oq_at, bs_csum) - 1
+    cq_pos_raw = _sum_extract3(_cq_at, iota)
+    cq_pos = jnp.where(cq_pos_raw == 0, L, cq_pos_raw - 1)
+    cq_bs = _sum_extract3(_cq_at, bs_csum) - 1
+    # prev-is-space flags: one bit per pair in a single sum
+    prev_sp_bit = ((lnn2_ch == 32) | (lnn2_ch == -1)).astype(_I32)
+    flag_word = jnp.sum(
+        sum(jnp.where(_oq_at(k) & (prev_sp_bit == 1), 1 << k, 0)
+            for k in range(max_pairs)), axis=1)
+    oq_prev_sp = jnp.stack(
+        [(flag_word >> k) & 1 for k in range(max_pairs)], axis=1)
 
     pair_valid = (jnp.arange(max_pairs, dtype=_I32)[None, :]
                   < pair_count[:, None])
