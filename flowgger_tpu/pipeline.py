@@ -230,6 +230,9 @@ class Pipeline:
             self.tx.put(SHUTDOWN)
         for t in threads:
             t.join(timeout=30)
+        from .utils.metrics import registry as _metrics
+
+        _metrics.final_flush()
 
 
 def start(config_file: str):

@@ -102,6 +102,7 @@ class Registry:
     def start_reporter(self, interval: float, path: Optional[str] = None):
         if interval <= 0 or self._reporter is not None:
             return
+        self._path = path
 
         def run():
             out = open(path, "a") if path else sys.stderr
@@ -122,6 +123,18 @@ class Registry:
             self._reporter.join(timeout=2)
             self._reporter = None
         self._stop = threading.Event()
+
+    def final_flush(self):
+        """One last snapshot at shutdown — short-lived runs would
+        otherwise exit between reporter ticks."""
+        if self._reporter is None:
+            return
+        path = getattr(self, "_path", None)
+        if path:
+            with open(path, "a") as out:
+                print(json.dumps(self.snapshot()), file=out, flush=True)
+        else:
+            print(json.dumps(self.snapshot()), file=sys.stderr, flush=True)
 
 
 # process-wide registry; pipeline stages import and increment this

@@ -257,3 +257,47 @@ def _parse_resp(buf):
         return parts, rest
     except (ValueError, IndexError):
         return None, buf
+
+
+def test_tcp_to_tpu_batch_pipeline_end_to_end(tmp_path):
+    """Full flagship path over a real socket: TCP -> chunked ingest ->
+    columnar decode -> span->gelf encode -> file sink."""
+    from flowgger_tpu.pipeline import Pipeline
+
+    out = tmp_path / "out.log"
+    config = Config.from_string(
+        f"""
+[input]
+type = "tcp"
+format = "rfc5424_tpu"
+listen = "127.0.0.1:0"
+timeout = 5
+tpu_flush_ms = 30
+[output]
+type = "file"
+format = "gelf"
+file_path = "{out}"
+"""
+    )
+    pipeline = Pipeline(config)
+    pipeline.start_output()
+    t = threading.Thread(target=pipeline.input.accept,
+                         args=(pipeline.handler_factory,), daemon=True)
+    t.start()
+    while pipeline.input.bound_port is None:
+        time.sleep(0.01)
+    lines = [f"<13>1 2015-08-05T15:53:45Z host{i} app {i} m - msg {i}"
+             for i in range(50)]
+    with socket.create_connection(("127.0.0.1", pipeline.input.bound_port)) as s:
+        s.sendall("".join(ln + "\n" for ln in lines).encode())
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        data = out.read_bytes() if out.exists() else b""
+        if data.count(b"\x00") >= 50:
+            break
+        time.sleep(0.05)
+    msgs = [m for m in out.read_bytes().split(b"\x00") if m]
+    assert len(msgs) == 50
+    # order preserved end to end
+    for i, m in enumerate(msgs):
+        assert f'"host":"host{i}"'.encode() in m, (i, m)
