@@ -47,14 +47,49 @@ def gen_lines(n: int) -> list:
     return out
 
 
+def _tpu_responsive(timeout_s: float = 180.0) -> bool:
+    """Probe device init in a subprocess: the axon relay can wedge
+    (observed after killed Mosaic compiles) and then jax.devices()
+    blocks forever — and it would also poison this process's backend
+    lock, so the probe must not run in-process."""
+    import subprocess
+
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout_s, capture_output=True)
+        return r.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
 def main():
+    cpu_fallback = not _tpu_responsive()
+    if cpu_fallback:
+        print(
+            "WARNING: TPU backend unreachable (relay wedged?); "
+            "benchmarking on the CPU backend instead",
+            file=sys.stderr,
+        )
+        import os
+
+        os.environ["JAX_PLATFORMS"] = "cpu"
+
     import jax
+
+    if cpu_fallback:
+        jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
 
     from flowgger_tpu.tpu import pack, rfc5424
 
     dev = jax.devices()[0]
     print(f"bench device: {dev}", file=sys.stderr)
+
+    global BATCH_LINES, CHAIN, TRIALS
+    if cpu_fallback:
+        # keep the degraded run bounded: smaller batch, shorter chain
+        BATCH_LINES, CHAIN, TRIALS = 262_144, 2, 1
 
     lines = gen_lines(BATCH_LINES)
     t0 = time.perf_counter()
