@@ -223,7 +223,8 @@ def _encode_packed_rfc5424_gelf(packed, encoder):
     from . import encode_gelf, rfc5424
 
     batch, lens, chunk, starts, orig_lens, n_real = packed
-    out = rfc5424.decode_rfc5424_jit(jnp.asarray(batch), jnp.asarray(lens))
+    out = rfc5424.decode_rfc5424_jit(jnp.asarray(batch), jnp.asarray(lens),
+                                     extract_impl=rfc5424.best_extract_impl())
     host_out = {k: np.asarray(v) for k, v in out.items()}
     return encode_gelf.encode_rfc5424_gelf(chunk, starts, orig_lens, host_out,
                                            n_real, batch.shape[1], encoder)
@@ -239,7 +240,8 @@ def _decode_packed(fmt, packed, decoder=None):
     if fmt == "rfc5424":
         from . import materialize, rfc5424
 
-        out = rfc5424.decode_rfc5424_jit(jb, jl)
+        out = rfc5424.decode_rfc5424_jit(
+            jb, jl, extract_impl=rfc5424.best_extract_impl())
         host_out = {k: np.asarray(v) for k, v in out.items()}
         return materialize.materialize(chunk, starts, lens, orig_lens, host_out,
                                        n_real, max_len=batch.shape[1])

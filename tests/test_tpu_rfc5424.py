@@ -264,3 +264,19 @@ def test_manual_scan_impl_matches_lax():
                                scan_impl="manual")
     for k in a:
         assert (np.asarray(a[k]) == np.asarray(b[k])).all(), k
+
+
+def test_scatter_extract_impl_matches_sum():
+    """extract_impl='scatter' (CPU fast path) must agree with the
+    bit-packed sums (TPU path) on every output."""
+    import jax.numpy as jnp
+
+    from flowgger_tpu.tpu import rfc5424
+
+    lines = [ln.encode("utf-8") for ln in CORPUS]
+    batch, lens, chunk, starts, orig, n = pack.pack_lines_2d(lines, 512)
+    a = rfc5424.decode_rfc5424(jnp.asarray(batch), jnp.asarray(lens))
+    b = rfc5424.decode_rfc5424(jnp.asarray(batch), jnp.asarray(lens),
+                               extract_impl="scatter")
+    for k in a:
+        assert (np.asarray(a[k]) == np.asarray(b[k])).all(), k
