@@ -2,9 +2,10 @@
 
 ``input.format = "auto_tpu"`` accepts a stream mixing RFC5424, RFC3164,
 LTSV, and GELF records.  Each batch is partitioned by a cheap first-bytes
-signature and every class is decoded by its columnar kernel (rfc3164 —
-which has no fixed layout to vectorize — runs the scalar decoder);
-results reassemble in input order, so downstream ordering matches a
+signature and every class is decoded by its columnar kernel (RFC3164
+rows go through the tpu/rfc3164.py standard-layout fast path, with the
+lenient cases falling back to the scalar decoder per row); results
+reassemble in input order, so downstream ordering matches a
 single-format run.
 
 Signature rules (on the first bytes only):
@@ -21,14 +22,10 @@ from __future__ import annotations
 from typing import List, Optional
 
 from ..config import Config
-from ..decoders import DecodeError
 from ..decoders.ltsv import LTSVDecoder
-from ..decoders.rfc3164 import RFC3164Decoder
 from .materialize import LineResult
 
 F_RFC5424, F_RFC3164, F_LTSV, F_GELF = 0, 1, 2, 3
-
-_3164 = RFC3164Decoder()
 
 
 def classify(raw: bytes) -> int:
@@ -74,15 +71,11 @@ def decode_auto_batch(lines: List[bytes], max_len: int,
         sub = [lines[i] for i in buckets[F_GELF]]
         for i, res in zip(buckets[F_GELF], _decode_gelf_batch(sub, max_len)):
             results[i] = res
-    for i in buckets[F_RFC3164]:
-        raw = lines[i]
-        try:
-            line = raw.decode("utf-8")
-        except UnicodeDecodeError:
-            results[i] = LineResult(None, "__utf8__", "")
-            continue
-        try:
-            results[i] = LineResult(_3164.decode(line), None, line)
-        except DecodeError as e:
-            results[i] = LineResult(None, str(e), line)
+    if buckets[F_RFC3164]:
+        from .batch import _decode_rfc3164_batch
+
+        sub = [lines[i] for i in buckets[F_RFC3164]]
+        for i, res in zip(buckets[F_RFC3164],
+                          _decode_rfc3164_batch(sub, max_len)):
+            results[i] = res
     return results

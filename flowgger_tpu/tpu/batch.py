@@ -69,6 +69,7 @@ class BatchHandler(Handler):
             "ltsv": lambda lines: _decode_ltsv_batch(
                 lines, self.max_len, self.scalar.decoder),
             "gelf": lambda lines: _decode_gelf_batch(lines, self.max_len),
+            "rfc3164": lambda lines: _decode_rfc3164_batch(lines, self.max_len),
             "auto": lambda lines: _decode_auto_batch(
                 lines, self.max_len, auto_ltsv),
         }.get(fmt)
@@ -259,6 +260,14 @@ def _decode_packed(fmt, packed, decoder=None):
         host_out = {k: np.asarray(v) for k, v in out.items()}
         return materialize_gelf.materialize_gelf(chunk, starts, orig_lens, host_out,
                                                  n_real, batch.shape[1])
+    if fmt == "rfc3164":
+        from ..utils.timeparse import current_year_utc
+        from . import materialize_rfc3164, rfc3164
+
+        out = rfc3164.decode_rfc3164_jit(jb, jl, np.int32(current_year_utc()))
+        host_out = {k: np.asarray(v) for k, v in out.items()}
+        return materialize_rfc3164.materialize_rfc3164(
+            chunk, starts, orig_lens, host_out, n_real, batch.shape[1])
     raise ValueError(f"no kernel for format {fmt}")
 
 
@@ -284,4 +293,10 @@ def _decode_rfc5424_batch(lines, max_len):
     from . import pack
 
     return _decode_packed("rfc5424", pack.pack_lines_2d(lines, max_len))
+
+
+def _decode_rfc3164_batch(lines, max_len):
+    from . import pack
+
+    return _decode_packed("rfc3164", pack.pack_lines_2d(lines, max_len))
 

@@ -1,0 +1,64 @@
+"""Materialize columnar RFC3164 fast-path output into Records.
+
+Fast-path rows are the standard single-spaced ``[<pri>]Mon d hh:mm:ss
+host msg`` layout (tpu/rfc3164.py); everything else re-runs the scalar
+decoder (flowgger_tpu/decoders/rfc3164.py) for byte-identical leniency.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+from ..decoders import DecodeError
+from ..decoders.rfc3164 import RFC3164Decoder
+from ..record import Record
+from .materialize import LineResult, compute_ts
+
+_SCALAR = RFC3164Decoder()
+
+
+def materialize_rfc3164(
+    chunk_bytes: bytes,
+    starts: np.ndarray,
+    orig_lens: np.ndarray,
+    out: Dict[str, np.ndarray],
+    n_real: int,
+    max_len: int,
+) -> List[LineResult]:
+    ts = compute_ts(out).tolist()
+    o = {k: np.asarray(v).tolist() for k, v in out.items()}
+    ok = o["ok"]
+    results: List[LineResult] = []
+    for n in range(n_real):
+        s = int(starts[n])
+        ln = int(orig_lens[n])
+        raw = chunk_bytes[s:s + ln]
+        try:
+            line = raw.decode("utf-8")
+        except UnicodeDecodeError:
+            results.append(LineResult(None, "__utf8__", ""))
+            continue
+        if not ok[n] or ln > max_len or len(line) != ln:
+            results.append(_scalar_3164(line))
+            continue
+        has_pri = o["has_pri"][n]
+        record = Record(
+            ts=float(ts[n]),
+            hostname=line[o["host_start"][n]:o["host_end"][n]],
+            facility=o["facility"][n] if has_pri else None,
+            severity=o["severity"][n] if has_pri else None,
+            msg=line[o["msg_start"][n]:],
+            full_msg=line,
+            sd=None,
+        )
+        results.append(LineResult(record, None, line))
+    return results
+
+
+def _scalar_3164(line: str) -> LineResult:
+    try:
+        return LineResult(_SCALAR.decode(line), None, line)
+    except DecodeError as e:
+        return LineResult(None, str(e), line)

@@ -1,0 +1,207 @@
+r"""Columnar RFC3164 fast path.
+
+Scalar spec: flowgger_tpu/decoders/rfc3164.py (reference
+rfc3164_decoder.rs:31-213).  RFC3164 is deliberately lenient — the
+scalar decoder tries two layouts, optional years, an IANA timezone
+token, and whitespace-run tokenization.  The kernel fast-paths only the
+overwhelmingly common shape:
+
+    [<pri>]Mon d hh:mm:ss host msg...
+
+with single spaces between tokens and no year/timezone token, because
+those are the cases whose decode is position-determined:
+
+- the month is matched with twelve shifted-byte-plane patterns at the
+  post-PRI offset (the technique from the LTSV special keys);
+- day (1-2 digits, no padding) picks between two fixed layouts for the
+  hh:mm:ss / host offsets;
+- any whitespace *run* (double space), trailing space, tab, or leading
+  space would change the reference's rebuilt-with-single-spaces message
+  — rows containing one in the message region fall back;
+- a fourth token that could plausibly be an IANA timezone name (all of
+  ``[A-Za-z0-9/_+-]`` — note digit-bearing zones like ``EST5EDT`` and
+  ``Etc/GMT+1`` exist) falls back, since the scalar path would consult
+  the tz database; a token with a byte outside that set (the ``.`` of an
+  FQDN or IP) can never be a tz name and stays on the fast path;
+- the current UTC year is a runtime argument (not baked into the jit
+  cache) — the reference assumes it at decode time
+  (rfc3164_decoder.rs:179-184).
+
+Everything flagged decodes via the scalar oracle, so output stays
+byte-identical (tests/test_tpu_rfc3164.py).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+from .rfc5424 import (
+    _at,
+    _days_from_civil,
+    _days_in_month,
+    _min_where,
+    _shift_left,
+)
+
+_I32 = jnp.int32
+_MONTHS = (b"Jan", b"Feb", b"Mar", b"Apr", b"May", b"Jun",
+           b"Jul", b"Aug", b"Sep", b"Oct", b"Nov", b"Dec")
+
+
+def decode_rfc3164(batch: jnp.ndarray, lens: jnp.ndarray, year,
+                   scan_impl: str = "lax") -> Dict[str, jnp.ndarray]:
+    N, L = batch.shape
+    lens = lens.astype(_I32)
+    year = jnp.asarray(year, _I32)
+    iota = jax.lax.broadcasted_iota(_I32, (N, L), 1)
+    valid = iota < lens[:, None]
+    bb = jnp.where(valid, batch, jnp.uint8(0)).astype(jnp.int16)
+    is_digit = (bb >= 48) & (bb <= 57)
+    dig = (bb - 48).astype(_I32)
+
+    # ---- optional <pri> --------------------------------------------------
+    has_pri = bb[:, 0] == ord("<")
+    gt = _min_where((bb == ord(">")) & valid, iota, L)
+    ndig = gt - 1
+    pri_zone = (iota >= 1) & (iota < gt[:, None]) & has_pri[:, None]
+    e = gt[:, None] - 1 - iota
+    w = jnp.where(e == 0, 1, jnp.where(e == 1, 10, jnp.where(e == 2, 100, 0)))
+    pri = jnp.sum(jnp.where(pri_zone, dig * w, 0), axis=1)
+    pri_ok = jnp.where(
+        has_pri,
+        (gt < L) & (ndig >= 1) & (ndig <= 3) & (pri <= 255)
+        & ~jnp.any(pri_zone & ~is_digit, axis=1),
+        True)
+    m0 = jnp.where(has_pri, gt + 1, 0)
+    ok = pri_ok
+
+    # ---- month via shifted-plane patterns at m0 --------------------------
+    month = jnp.zeros_like(lens)
+    for i, mon in enumerate(_MONTHS):
+        pat = (bb == mon[0])
+        pat &= _shift_left(bb, 1, 0) == mon[1]
+        pat &= _shift_left(bb, 2, 0) == mon[2]
+        hit = jnp.any(pat & (iota == m0[:, None]), axis=1)
+        month = jnp.where(hit, i + 1, month)
+    ok &= month > 0
+
+    # ---- day layouts after "Mon " -----------------------------------------
+    #   A: "Mon dd "  (two digits)           time at m0+7
+    #   B: "Mon d "   (single digit)         time at m0+6
+    #   C: "Mon  d "  (classic double-space single digit) time at m0+7
+    r = iota - m0[:, None]
+    c4 = _at(iota, m0 + 3, bb)
+    ok &= c4 == 32  # space after month
+    d0 = _at(iota, m0 + 4, bb)
+    d1 = _at(iota, m0 + 5, bb)
+    d2 = _at(iota, m0 + 6, bb)
+    d0_dig = (d0 >= 48) & (d0 <= 57)
+    d1_dig = (d1 >= 48) & (d1 <= 57)
+    case_a = d0_dig & d1_dig
+    case_b = d0_dig & (d1 == 32)
+    case_c = (d0 == 32) & d1_dig & (d2 == 32)
+    ok &= case_a | case_b | case_c
+    day = jnp.where(case_a, (d0 - 48) * 10 + (d1 - 48),
+                    jnp.where(case_b, d0 - 48, d1 - 48))
+    t0 = m0 + jnp.where(case_b, 6, 7)  # time start
+    ok &= _at(iota, t0 - 1, bb) == 32
+    rt = r - (t0 - m0)[:, None]
+    in_time = (rt >= 0) & (rt < 8)
+    dzt = jnp.where(in_time, dig, 0)
+    hour = jnp.sum(dzt * ((rt == 0) * 10 + (rt == 1)), axis=1)
+    minute = jnp.sum(dzt * ((rt == 3) * 10 + (rt == 4)), axis=1)
+    sec = jnp.sum(dzt * ((rt == 6) * 10 + (rt == 7)), axis=1)
+    tviol = jnp.any(in_time & ((rt == 2) | (rt == 5)) & (bb != ord(":")), axis=1)
+    tviol |= jnp.any(
+        in_time & (rt != 2) & (rt != 5) & ~is_digit, axis=1)
+    ok &= ~tviol & (hour <= 23) & (minute <= 59) & (sec <= 59)
+    ok &= (day >= 1) & (day <= _days_in_month(year, month))
+
+    # ---- host token -------------------------------------------------------
+    host_s = t0 + 9
+    ok &= _at(iota, t0 + 8, bb) == 32
+    is_sp = (bb == 32) & valid
+    host_e = _min_where(is_sp & (iota >= host_s[:, None]), iota, L)
+    host_e = jnp.minimum(host_e, lens)
+    ok &= host_e > host_s  # nonempty hostname token
+    # need >3 whitespace tokens overall: host + at least one msg token
+    # (reference standard layout requires tokens_vec.len() > 3 —
+    # month/day/time are 3, host is the 4th; message may then be empty)
+    msg_start = jnp.minimum(host_e + 1, lens)
+
+    # ---- strictness ------------------------------------------------------
+    # whitespace-run tokenization means any non-space whitespace, or a
+    # double space from the time token onward (the rebuilt-message
+    # region), or leading/trailing spaces would change the scalar output
+    # single-byte whitespace per str.split(): tab, VT, FF, CR, and the
+    # 0x1C-0x1F separator control bytes (0x0A can't survive framing;
+    # multi-byte unicode whitespace is caught by the materializer's
+    # byte-length-vs-char-length check)
+    ws_other = ((bb == 9) | (bb == 11) | (bb == 12) | (bb == 13)
+                | ((bb >= 28) & (bb <= 31))) & valid
+    dbl = is_sp & _shift_left(is_sp, 1, False) & (iota >= t0[:, None])
+    last_ch_sp = _at(iota, lens - 1, bb) == 32
+    first_ch_sp = bb[:, 0] == 32
+    ok &= ~jnp.any(ws_other | dbl, axis=1) & ~last_ch_sp & ~first_ch_sp
+    ok &= lens >= 1
+
+    # ---- timezone-lookalike guard for the token after the time ----------
+    # (that token is the hostname on the fast path; if every byte could
+    # appear in an IANA tz name, the scalar path might consult the tz db
+    # and consume it — fall back)
+    in_host = (iota >= host_s[:, None]) & (iota < host_e[:, None])
+    # IANA names use letters, digits (EST5EDT, Etc/GMT+1, GMT0), '/',
+    # '_', '+', '-'; every name starts with an uppercase letter except
+    # the system-zoneinfo oddities "localtime"/"posixrules" (verified
+    # against this system's tz database).  A token is provably NOT a
+    # timezone — and thus safely the hostname — when it contains a byte
+    # outside the tz set (the '.' of an FQDN/IP) or starts lowercase/
+    # digit and is not one of those two literals.
+    tz_char = (
+        ((bb >= ord("A")) & (bb <= ord("Z")))
+        | ((bb >= ord("a")) & (bb <= ord("z")))
+        | ((bb >= ord("0")) & (bb <= ord("9")))
+        | (bb == ord("/")) | (bb == ord("_"))
+        | (bb == ord("+")) | (bb == ord("-"))
+    )
+    has_non_tz_byte = jnp.any(in_host & ~tz_char, axis=1)
+    first_host = _at(iota, host_s, bb)
+    humble_first = ((first_host >= ord("a")) & (first_host <= ord("z"))) | (
+        (first_host >= ord("0")) & (first_host <= ord("9")))
+
+    def _literal_at(text: bytes, pos, tok_len):
+        pat = bb == text[0]
+        for i, ch in enumerate(text[1:], start=1):
+            pat &= _shift_left(bb, i, 0) == ch
+        return jnp.any(pat & (iota == pos[:, None]), axis=1) & (
+            tok_len == len(text))
+
+    host_len = host_e - host_s
+    is_tz_alias = (_literal_at(b"localtime", host_s, host_len)
+                   | _literal_at(b"posixrules", host_s, host_len))
+    ok &= has_non_tz_byte | (humble_first & ~is_tz_alias)
+
+    days = _days_from_civil(year, month, day)
+    sod = hour * 3600 + minute * 60 + sec
+
+    return {
+        "ok": ok,
+        "has_pri": has_pri,
+        "facility": pri >> 3,
+        "severity": pri & 7,
+        "days": days,
+        "sod": sod,
+        "off": jnp.zeros_like(sod),
+        "nanos": jnp.zeros_like(sod),
+        "host_start": host_s, "host_end": host_e,
+        "msg_start": msg_start,
+    }
+
+
+@functools.partial(jax.jit, static_argnames=())
+def decode_rfc3164_jit(batch, lens, year):
+    return decode_rfc3164(batch, lens, year)

@@ -1,0 +1,118 @@
+"""Differential tests: columnar RFC3164 fast path vs the scalar oracle."""
+
+import random
+
+from flowgger_tpu.decoders import DecodeError
+from flowgger_tpu.decoders.rfc3164 import RFC3164Decoder
+from flowgger_tpu.tpu.batch import _decode_rfc3164_batch
+
+ORACLE = RFC3164Decoder()
+
+CORPUS = [
+    "<34>Oct 11 22:14:15 mymachine1 su: 'su root' failed for lonvick",
+    "Oct 11 22:14:15 mymachine1 su: body",
+    "<13>Aug  6 11:15:24 host9 appname 69 42 some test message",   # classic dbl-space day
+    "Aug  6 11:15:24 host.example.com single message",
+    "<0>Jan  1 00:00:00 h1 x",
+    "<191>Dec 31 23:59:59 server-42 end of year",
+    "Feb 28 12:00:00 web01 ok",
+    "Feb 29 12:00:00 web01 leap-day-depends-on-year",
+    "Mar  5 07:08:09 10.0.0.1 numeric host",
+    "<34>Oct 11 22:14:15 host4 trailing spaces in msg  here",  # dbl space in msg
+    "<34>Oct 11 22:14:15 host4 msg with tab\there",
+    "Oct 11 22:14:15 UTC host-after-tz looks like tz",   # tz token -> scalar path
+    "Oct 11 22:14:15 Europe/Paris msg after tz",
+    "Oct 11 22:14:15 EST5EDT myhost hello",              # digit-bearing tz name
+    "Oct 11 22:14:15 Etc/GMT+1 myhost hello",
+    "Oct 11 22:14:15 GMT0 myhost hello",
+    "Oct 11 22:14:15 host6 a\x1cb",                      # FS separator byte
+    "Oct 11 22:14:15 host6 trailing-fs\x1d",
+    "Oct 11 22:14:15 localtime after-alias",             # zoneinfo oddity
+    "Oct 11 22:14:15 posixrules after-alias",
+    "Oct 11 22:14:15 SERVER01 uppercase host",           # conservative fallback
+    "2019 Mar 27 12:09:39 hostyear with year",
+    "mymachine: Mar 27 12:09:39: custom layout message",
+    "<34>mymachine: Mar 27 12:09:39: custom with pri",
+    "Oct 11 22:14:15 onlyhost",                           # 4 tokens, empty msg
+    "Oct 11 22:14:15",                                    # too few tokens
+    "Oct 32 22:14:15 h m",                                # bad day
+    "Oct 11 25:14:15 h m",                                # bad hour
+    "not a syslog line",
+    "",
+    "<abc>Oct 11 22:14:15 h m",
+    "<13>Oct 11 2:14:15 h m",                             # unpadded hour -> lenient?
+    "Oct 11 22:14:15 host msg ünïcode",
+    "\tOct 11 22:14:15 h m",
+]
+
+
+def run_both(lines):
+    raw = [ln.encode("utf-8") for ln in lines]
+    results = _decode_rfc3164_batch(raw, 512)
+    pairs = []
+    for ln, res in zip(lines, results):
+        kernel = ("rec", res.record) if res.record is not None else ("err", res.error)
+        try:
+            oracle = ("rec", ORACLE.decode(ln))
+        except DecodeError as e:
+            oracle = ("err", str(e))
+        pairs.append((ln, kernel, oracle))
+    return pairs
+
+
+def assert_identical(lines):
+    for ln, kernel, oracle in run_both(lines):
+        assert kernel == oracle, (
+            f"divergence on {ln!r}:\n  kernel: {kernel}\n  oracle: {oracle}")
+
+
+def test_corpus_differential(capsys):
+    assert_identical(CORPUS)
+
+
+def test_fast_path_coverage():
+    import jax.numpy as jnp
+    import numpy as np
+
+    from flowgger_tpu.tpu import pack, rfc3164
+    from flowgger_tpu.utils.timeparse import current_year_utc
+
+    clean = [ln for ln in CORPUS[:9]]
+    raw = [ln.encode() for ln in clean]
+    batch, lens, chunk, starts, orig, n = pack.pack_lines_2d(raw, 256)
+    out = rfc3164.decode_rfc3164_jit(jnp.asarray(batch), jnp.asarray(lens),
+                                     np.int32(current_year_utc()))
+    okf = np.asarray(out["ok"])[:n]
+    assert okf.mean() >= 0.7, list(zip(clean, okf))
+
+
+def test_fuzz_differential(capsys):
+    rng = random.Random(3164)
+    alphabet = list(" <>JanFebOct0123456789:.-host/U\t")
+    base = "<34>Oct 11 22:14:15 host.example.com su: body text here"
+    lines = []
+    for _ in range(300):
+        cs = list(base)
+        for _ in range(rng.randint(1, 6)):
+            i = rng.randrange(len(cs)) if cs else 0
+            op = rng.random()
+            if op < 0.4 and cs:
+                cs[i] = rng.choice(alphabet)
+            elif op < 0.7:
+                cs.insert(i, rng.choice(alphabet))
+            elif cs:
+                del cs[i]
+        lines.append("".join(cs))
+    assert_identical(lines)
+
+
+def test_autodetect_uses_rfc3164_kernel():
+    from flowgger_tpu.tpu.batch import _decode_auto_batch
+
+    mixed = [
+        b"<34>Oct 11 22:14:15 legacyhost1 su: legacy message",
+        b"<13>1 2015-08-05T15:53:45Z host5424 app 1 2 - new style",
+    ]
+    results = _decode_auto_batch(mixed, 512)
+    assert results[0].record.hostname == "legacyhost1"
+    assert results[1].record.hostname == "host5424"
