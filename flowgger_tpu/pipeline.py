@@ -200,6 +200,7 @@ class Pipeline:
         self.tx: "queue.Queue[Optional[bytes]]" = queue.Queue(maxsize=queue_size)
         self.input_format = input_format
         self.config = config
+        self._handlers: list = []
         from .utils import metrics as _metrics_mod
 
         _metrics_mod.configure_from(config)
@@ -208,22 +209,27 @@ class Pipeline:
         if self.input_format in _TPU_FORMATS:
             from .tpu.batch import BatchHandler
 
-            return BatchHandler(
+            handler = BatchHandler(
                 self.tx, self.decoder, self.encoder, self.config,
                 fmt=_TPU_FORMATS[self.input_format],
             )
-        return ScalarHandler(self.tx, self.decoder, self.encoder)
+        else:
+            handler = ScalarHandler(self.tx, self.decoder, self.encoder)
+        self._handlers.append(handler)
+        return handler
 
     def start_output(self):
         return self.output.start(self.tx, self.merger)
 
-    def run(self):
-        threads = self.start_output()
-        if not isinstance(threads, list):
-            threads = [threads]
-        self.input.accept(self.handler_factory)
-        # Input ended (EOF on stdin, etc.): drain the queue before exiting
-        # rather than killing the daemon consumers mid-write.
+    def _drain(self, threads):
+        """Flush pending batches and drain the queue through the sinks —
+        the reference loses in-flight queue contents on shutdown
+        (SURVEY.md §5 checkpoint/resume); we flush instead."""
+        for handler in self._handlers:
+            try:
+                handler.flush()
+            except Exception:  # noqa: BLE001 - best-effort during shutdown
+                pass
         from .outputs import SHUTDOWN
 
         for _ in threads:
@@ -233,6 +239,33 @@ class Pipeline:
         from .utils.metrics import registry as _metrics
 
         _metrics.final_flush()
+
+    def _install_signal_handlers(self, threads):
+        import os
+        import signal
+        import threading as _threading
+
+        if _threading.current_thread() is not _threading.main_thread():
+            return
+
+        def handle(signum, frame):
+            print(f"Received signal {signum}, draining and exiting",
+                  file=__import__("sys").stderr)
+            self._drain(threads)
+            os._exit(0)
+
+        signal.signal(signal.SIGTERM, handle)
+        signal.signal(signal.SIGINT, handle)
+
+    def run(self):
+        threads = self.start_output()
+        if not isinstance(threads, list):
+            threads = [threads]
+        self._install_signal_handlers(threads)
+        self.input.accept(self.handler_factory)
+        # Input ended (EOF on stdin, etc.): drain before exiting rather
+        # than killing the daemon consumers mid-write.
+        self._drain(threads)
 
 
 def start(config_file: str):

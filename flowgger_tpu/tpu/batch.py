@@ -58,10 +58,14 @@ class BatchHandler(Handler):
         self._decode_lock = threading.Lock()
         self._timer: Optional[threading.Timer] = None
         self._start_timer = start_timer
-        # direct span->bytes encode for the flagship rfc5424->gelf route
+        # direct span->bytes encodes for rfc5424 routes
         from ..encoders.gelf import GelfEncoder
+        from ..encoders.passthrough import PassthroughEncoder
 
-        self._fast_encode = fmt == "rfc5424" and type(encoder) is GelfEncoder
+        self._fast_encode = fmt == "rfc5424" and (
+            type(encoder) is GelfEncoder
+            or (type(encoder) is PassthroughEncoder
+                and encoder.header_time_format is None))
         # single source of truth for kernel dispatch: fmt -> batch decoder
         auto_ltsv = self._auto_ltsv_decoder(cfg) if fmt == "auto" else None
         self._kernel_fn = {
@@ -221,12 +225,16 @@ class BatchHandler(Handler):
 def _encode_packed_rfc5424_gelf(packed, encoder):
     import jax.numpy as jnp
 
-    from . import encode_gelf, rfc5424
+    from ..encoders.passthrough import PassthroughEncoder
+    from . import encode_gelf, encode_passthrough, rfc5424
 
     batch, lens, chunk, starts, orig_lens, n_real = packed
     out = rfc5424.decode_rfc5424_jit(jnp.asarray(batch), jnp.asarray(lens),
                                      extract_impl=rfc5424.best_extract_impl())
     host_out = {k: np.asarray(v) for k, v in out.items()}
+    if type(encoder) is PassthroughEncoder:
+        return encode_passthrough.encode_rfc5424_passthrough(
+            chunk, starts, orig_lens, host_out, n_real, batch.shape[1], encoder)
     return encode_gelf.encode_rfc5424_gelf(chunk, starts, orig_lens, host_out,
                                            n_real, batch.shape[1], encoder)
 

@@ -74,3 +74,27 @@ def test_fast_encode_via_chunks():
     while not tx2.empty():
         want.append(tx2.get_nowait())
     assert got == want
+
+
+def test_fast_passthrough_identical():
+    from flowgger_tpu.encoders import PassthroughEncoder
+
+    def run(handler_cls, **kw):
+        tx = queue.Queue()
+        enc = PassthroughEncoder(Config.from_string(""))
+        h = handler_cls(tx, RFC5424Decoder(), enc, **kw)
+        for ln in CORPUS:
+            h.handle_bytes(ln.encode("utf-8"))
+        if hasattr(h, "flush"):
+            h.flush()
+        out = []
+        while not tx.empty():
+            out.append(tx.get_nowait())
+        return out
+
+    fast = run(BatchHandler, start_timer=False)
+    assert BatchHandler(queue.Queue(), RFC5424Decoder(),
+                        PassthroughEncoder(Config.from_string("")),
+                        start_timer=False)._fast_encode
+    ref = run(ScalarHandler)
+    assert fast == ref
