@@ -236,9 +236,10 @@ class Pipeline:
             self.tx.put(SHUTDOWN)
         for t in threads:
             t.join(timeout=30)
-        from .utils.metrics import registry as _metrics
+        from .utils import metrics as _metrics_mod
 
-        _metrics.final_flush()
+        _metrics_mod.registry.final_flush()
+        _metrics_mod.stop_jax_profiler()
 
     def _install_signal_handlers(self, threads):
         import os

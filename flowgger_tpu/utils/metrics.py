@@ -142,9 +142,46 @@ registry = Registry()
 
 
 def configure_from(config) -> None:
-    """Start the reporter if [metrics] is configured (pipeline boot)."""
+    """Start the reporter (and optional XLA profiler trace) if [metrics]
+    is configured (pipeline boot)."""
     interval = config.lookup_int(
         "metrics.interval", "metrics.interval must be an integer", 0)
     path = config.lookup_str("metrics.path", "metrics.path must be a string")
     if interval and interval > 0:
         registry.start_reporter(float(interval), path)
+    profile_dir = config.lookup_str(
+        "metrics.jax_profile_dir", "metrics.jax_profile_dir must be a string")
+    if profile_dir:
+        start_jax_profiler(profile_dir)
+
+
+_profiling = False
+
+
+def start_jax_profiler(log_dir: str) -> None:
+    """Capture an XLA device trace of the batched decode path (viewable
+    with tensorboard/xprof).  Stopped by stop_jax_profiler at drain."""
+    global _profiling
+    if _profiling:
+        return
+    try:
+        import jax
+
+        jax.profiler.start_trace(log_dir)
+        _profiling = True
+        print(f"jax profiler tracing to {log_dir}", file=sys.stderr)
+    except Exception as e:  # noqa: BLE001 - profiling must never kill ingest
+        print(f"jax profiler unavailable: {e}", file=sys.stderr)
+
+
+def stop_jax_profiler() -> None:
+    global _profiling
+    if not _profiling:
+        return
+    try:
+        import jax
+
+        jax.profiler.stop_trace()
+    except Exception:  # noqa: BLE001
+        pass
+    _profiling = False
