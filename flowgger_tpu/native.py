@@ -52,12 +52,38 @@ def _load():
             ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p,
             ctypes.c_int64, ctypes.c_int32, ctypes.c_void_p, ctypes.c_void_p,
             ctypes.c_int]
+        if hasattr(lib, "fg_concat_segments"):
+            lib.fg_concat_segments.restype = None
+            lib.fg_concat_segments.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+                ctypes.c_int]
+        if hasattr(lib, "fg_gelf_lens"):
+            common = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_void_p, ctypes.c_int32,
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int32,
+                ctypes.c_int32,
+            ]
+            lib.fg_gelf_lens.restype = None
+            lib.fg_gelf_lens.argtypes = common + [ctypes.c_void_p,
+                                                  ctypes.c_int]
+            lib.fg_gelf_write.restype = None
+            lib.fg_gelf_write.argtypes = common + [ctypes.c_void_p,
+                                                   ctypes.c_void_p,
+                                                   ctypes.c_int]
         _lib = lib
         return _lib
 
 
 def available() -> bool:
     return _load() is not None
+
+
+def gelf_rows_available() -> bool:
+    lib = _load()
+    return lib is not None and hasattr(lib, "fg_gelf_lens")
 
 
 def split_chunk_native(chunk: bytes, strip_cr: bool = True
@@ -100,3 +126,59 @@ def pack_chunk_native(chunk: bytes, starts: np.ndarray, lens: np.ndarray,
             max_len, batch.ctypes.data, lens_out.ctypes.data,
             _DEFAULT_THREADS)
     return batch, lens_out
+
+
+def gelf_rows_native(chunk: bytes, meta: np.ndarray,
+                     pns: np.ndarray, pne: np.ndarray,
+                     pvs: np.ndarray, pve: np.ndarray,
+                     ts_scratch: bytes, suffix: bytes, syslen: bool
+                     ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """(framed buffer u8, row offsets int64[R+1]) for the tier rows
+    described by ``meta`` ([R, 17] int32, see flowgger_host.cpp) — the
+    native span→GELF assembly.  None when the library is unavailable."""
+    lib = _load()
+    if lib is None or not hasattr(lib, "fg_gelf_lens"):
+        return None
+    meta = np.ascontiguousarray(meta, dtype=np.int32)
+    R = meta.shape[0]
+    P = pns.shape[1] if pns.size else 0
+    pns = np.ascontiguousarray(pns, dtype=np.int32)
+    pne = np.ascontiguousarray(pne, dtype=np.int32)
+    pvs = np.ascontiguousarray(pvs, dtype=np.int32)
+    pve = np.ascontiguousarray(pve, dtype=np.int32)
+    cbuf = np.frombuffer(chunk, dtype=np.uint8)
+    tbuf = np.frombuffer(ts_scratch or b"\0", dtype=np.uint8)
+    sbuf = np.frombuffer(suffix or b"\0", dtype=np.uint8)
+    lens = np.empty(R, dtype=np.int64)
+    args = (cbuf.ctypes.data, meta.ctypes.data, R,
+            pns.ctypes.data, pne.ctypes.data, pvs.ctypes.data,
+            pve.ctypes.data, P, tbuf.ctypes.data,
+            sbuf.ctypes.data, len(suffix), 1 if syslen else 0)
+    lib.fg_gelf_lens(*args, lens.ctypes.data, _DEFAULT_THREADS)
+    off = np.empty(R + 1, dtype=np.int64)
+    off[0] = 0
+    np.cumsum(lens, out=off[1:])
+    out = np.empty(int(off[-1]), dtype=np.uint8)
+    lib.fg_gelf_write(*args, off.ctypes.data, out.ctypes.data,
+                      _DEFAULT_THREADS)
+    return out, off
+
+
+def concat_segments_native(src: np.ndarray, seg_src: np.ndarray,
+                           seg_len: np.ndarray, dst_off: np.ndarray,
+                           total: int) -> Optional[np.ndarray]:
+    """Threaded segment-gather memcpy; None when the library is missing
+    or lacks the symbol (stale build)."""
+    lib = _load()
+    if lib is None or not hasattr(lib, "fg_concat_segments"):
+        return None
+    out = np.empty(total, dtype=np.uint8)
+    seg_src = np.ascontiguousarray(seg_src, dtype=np.int64)
+    seg_len = np.ascontiguousarray(seg_len, dtype=np.int64)
+    dst_off = np.ascontiguousarray(dst_off, dtype=np.int64)
+    src = np.ascontiguousarray(src)
+    lib.fg_concat_segments(
+        src.ctypes.data, seg_src.ctypes.data, seg_len.ctypes.data,
+        dst_off.ctypes.data, seg_src.size, out.ctypes.data,
+        _DEFAULT_THREADS)
+    return out

@@ -13,9 +13,20 @@ from __future__ import annotations
 import threading
 from typing import Optional
 
+from ..block import EncodedBlock
 from ..mergers import Merger
 
 SHUTDOWN = None
+
+
+def stream_bytes(item, merger: Optional[Merger]):
+    """(wire bytes, message count) for byte-stream sinks.  EncodedBlock
+    items are pre-framed by the producer with the pipeline's merger, so
+    they are written wholesale; plain items get framed here, matching
+    the reference's consumer loop (file_output.rs:203-216)."""
+    if isinstance(item, EncodedBlock):
+        return item.data, len(item)
+    return (merger.frame(item) if merger is not None else item), 1
 
 
 class Output:

@@ -9,7 +9,7 @@ from __future__ import annotations
 
 import sys
 
-from . import Output, SHUTDOWN, spawn_worker
+from . import Output, SHUTDOWN, spawn_worker, stream_bytes
 
 
 class DebugOutput(Output):
@@ -23,7 +23,7 @@ class DebugOutput(Output):
                 if item is SHUTDOWN:
                     arx.task_done()
                     return
-                data = merger.frame(item) if merger is not None else item
+                data, _ = stream_bytes(item, merger)
                 sys.stdout.write(data.decode("utf-8", errors="replace"))
                 sys.stdout.flush()
                 arx.task_done()

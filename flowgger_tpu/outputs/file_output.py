@@ -11,7 +11,8 @@ from __future__ import annotations
 
 import sys
 
-from . import Output, SHUTDOWN, spawn_worker
+from . import Output, SHUTDOWN, spawn_worker, stream_bytes
+from ..block import EncodedBlock
 from ..utils.metrics import registry as _metrics
 from ..config import Config, ConfigError
 from ..encoders import validate_time_format_input
@@ -88,6 +89,8 @@ class FileOutput(Output):
         if writer is None:
             raise RuntimeError(f"Cannot open file to {self.path}")
 
+        rotating = self.rotation_size > 0 or self.rotation_time > 0
+
         def run():
             while True:
                 item = arx.get()
@@ -96,9 +99,16 @@ class FileOutput(Output):
                         writer.flush()
                     arx.task_done()
                     return
-                data = merger.frame(item) if merger is not None else item
-                writer.write(data)
-                _metrics.inc("output_written")
+                if isinstance(item, EncodedBlock) and rotating:
+                    # preserve the reference's per-message rotation
+                    # trigger granularity (rotating_file.rs:346-363)
+                    for framed in item.iter_framed():
+                        writer.write(framed)
+                    _metrics.inc("output_written", len(item))
+                else:
+                    data, count = stream_bytes(item, merger)
+                    writer.write(data)
+                    _metrics.inc("output_written", count)
                 arx.task_done()
 
         return spawn_worker(run, "file-output")

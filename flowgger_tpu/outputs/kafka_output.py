@@ -17,6 +17,7 @@ import sys
 import threading
 
 from . import Output, SHUTDOWN
+from ..block import EncodedBlock
 from ..config import Config, ConfigError
 from ..utils.kafka_wire import KafkaError, KafkaProducer
 
@@ -88,7 +89,10 @@ class KafkaOutput(Output):
                     return self._die()
                 arx.task_done()
                 return None
-            queue_buf.append(item)
+            if isinstance(item, EncodedBlock):
+                queue_buf.extend(item.iter_unframed())
+            else:
+                queue_buf.append(item)
             if len(queue_buf) >= max(1, self.coalesce):
                 try:
                     producer.send_all(self.topic, queue_buf)

@@ -24,7 +24,7 @@ import sys
 import threading
 import time
 
-from . import Output, SHUTDOWN
+from . import Output, SHUTDOWN, stream_bytes
 from ..config import Config, ConfigError
 
 DEFAULT_RECOVERY_DELAY_INIT = 1
@@ -147,7 +147,7 @@ class TlsOutput(Output):
                         tls.sendall(bytes(buf))
                     arx.task_done()
                     return True
-                data = merger.frame(item) if merger is not None else item
+                data, _ = stream_bytes(item, merger)
                 try:
                     if self.async_:
                         buf.extend(data)

@@ -211,7 +211,7 @@ class Pipeline:
 
             handler = BatchHandler(
                 self.tx, self.decoder, self.encoder, self.config,
-                fmt=_TPU_FORMATS[self.input_format],
+                fmt=_TPU_FORMATS[self.input_format], merger=self.merger,
             )
         else:
             handler = ScalarHandler(self.tx, self.decoder, self.encoder)

@@ -15,3 +15,25 @@ unusual sets a per-row fallback flag and is re-decoded by the scalar
 path, so the pipeline's observable behavior — including per-line error
 messages — is byte-identical with the reference's semantics.
 """
+
+import os
+
+
+def apply_platform_env() -> None:
+    """Re-assert the user's ``JAX_PLATFORMS`` choice on the live config.
+
+    Some site installs (the axon TPU relay plugin) override the platform
+    list from ``sitecustomize`` at interpreter start, clobbering the
+    environment variable the operator set.  Called before the first
+    kernel dispatch so ``JAX_PLATFORMS=cpu python -m flowgger_tpu ...``
+    behaves as written even under such plugins."""
+    want = os.environ.get("JAX_PLATFORMS", "")
+    if not want:
+        return
+    import jax
+
+    try:
+        if jax.config.jax_platforms != want:
+            jax.config.update("jax_platforms", want)
+    except Exception:  # noqa: BLE001 - platform pinning is best-effort
+        pass

@@ -31,12 +31,24 @@ DEFAULT_FLUSH_MS = 50
 DEFAULT_MAX_LINE_LEN = 512
 
 
+_NO_MERGER = object()  # sentinel: block mode only when the caller wires a merger
+
+
 class BatchHandler(Handler):
     def __init__(self, tx, decoder, encoder, config: Optional[Config] = None,
-                 fmt: str = "rfc5424", start_timer: bool = True):
+                 fmt: str = "rfc5424", start_timer: bool = True,
+                 merger=_NO_MERGER):
+        from . import apply_platform_env
+
+        apply_platform_env()
         self.tx = tx
         self.encoder = encoder
         self.fmt = fmt
+        # Block mode (one pre-framed EncodedBlock per batch) engages only
+        # when the pipeline hands us its merger, so standalone handlers
+        # keep the per-message queue contract.
+        self._block_mode = merger is not _NO_MERGER
+        self._merger = None if merger is _NO_MERGER else merger
         # scalar path for fallback rows and capnp handle_record
         self.scalar = ScalarHandler(tx, decoder, encoder)
         cfg = config or Config.from_string("")
@@ -153,7 +165,7 @@ class BatchHandler(Handler):
             return
         packed = pack.pack_region_2d(region, self.max_len)
         if self._fast_encode:
-            self._emit_encoded(_encode_packed_rfc5424_gelf(packed, self.encoder))
+            self._emit_fast(packed)
             return
         self._emit(_decode_packed(self.fmt, packed, self.scalar.decoder))
 
@@ -167,10 +179,54 @@ class BatchHandler(Handler):
             from . import pack
 
             packed = pack.pack_lines_2d(lines, self.max_len)
-            self._emit_encoded(_encode_packed_rfc5424_gelf(packed, self.encoder))
+            self._emit_fast(packed)
             return
         results = self._kernel_fn(lines)
         self._emit(results)
+
+    def _block_route_ok(self) -> bool:
+        """Cheap applicability check, evaluated before any kernel work so
+        an inapplicable route never pays a wasted device decode."""
+        if not self._block_mode:
+            return False
+        from ..encoders.gelf import GelfEncoder
+        from .encode_gelf_block import merger_suffix
+
+        return (type(self.encoder) is GelfEncoder
+                and not self.encoder.extra
+                and merger_suffix(self._merger) is not None)
+
+    def _emit_fast(self, packed) -> None:
+        """Span→bytes encode for one packed tuple: the columnar block
+        route when engaged, else the per-row fast path."""
+        if self._block_route_ok():
+            res = _encode_block_rfc5424_gelf(packed, self.encoder,
+                                             self._merger)
+            self._emit_block(res, packed[5])
+            return
+        self._emit_encoded(_encode_packed_rfc5424_gelf(packed, self.encoder))
+
+    def _emit_block(self, res, n_real: int) -> None:
+        _metrics.inc("input_lines", n_real)
+        if res.fallback_rows:
+            _metrics.inc("fallback_rows", res.fallback_rows)
+        for error, line in res.errors:
+            if error == "__utf8__":
+                _metrics.inc("invalid_utf8")
+                print("Invalid UTF-8 input", file=sys.stderr)
+                continue
+            _metrics.inc("decode_errors")
+            if self.bare_errors:
+                print(error, file=sys.stderr)
+            else:
+                stripped = line.strip()
+                if not (self.quiet_empty and not stripped):
+                    print(f"{error}: [{stripped}]", file=sys.stderr)
+        count = len(res.block)
+        if count:
+            _metrics.inc("decoded_records", count)
+            _metrics.inc("enqueued", count)
+            self.tx.put(res.block)
 
     def _emit_encoded(self, results) -> None:
         """Emit pre-encoded bytes from the span->bytes fast path."""
@@ -220,6 +276,22 @@ class BatchHandler(Handler):
             _metrics.inc("decoded_records")
             _metrics.inc("enqueued")
             self.tx.put(encoded)
+
+
+def _encode_block_rfc5424_gelf(packed, encoder, merger):
+    """Columnar block encode; returns BlockResult or None when the route
+    doesn't apply (gelf_extra, unsupported merger)."""
+    import jax.numpy as jnp
+
+    from . import encode_gelf_block, rfc5424
+
+    batch, lens, chunk, starts, orig_lens, n_real = packed
+    out = rfc5424.decode_rfc5424_jit(jnp.asarray(batch), jnp.asarray(lens),
+                                     extract_impl=rfc5424.best_extract_impl())
+    host_out = {k: np.asarray(v) for k, v in out.items()}
+    return encode_gelf_block.encode_rfc5424_gelf_block(
+        chunk, starts, orig_lens, host_out, n_real, batch.shape[1],
+        encoder, merger)
 
 
 def _encode_packed_rfc5424_gelf(packed, encoder):

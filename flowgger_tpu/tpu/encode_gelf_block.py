@@ -1,0 +1,469 @@
+"""Columnar RFC5424→GELF encoding: span tables → one framed output
+buffer per batch, with no per-row Python on the fast tier.
+
+Replaces the per-row dict/join fast path (encode_gelf.py, ~69K rows/s/
+core) for the flagship route.  Two engines produce identical bytes:
+
+- **native** (preferred): ``fg_gelf_lens``/``fg_gelf_write`` in
+  native/flowgger_host.cpp assemble each kernel-ok row's GELF JSON
+  directly from the chunk in two threaded passes (measure, prefix-sum,
+  write), including per-row SD-name sorting with dict last-wins
+  semantics and JSON escaping.
+- **numpy fallback**: the row layout is flattened into (source offset,
+  length) segments over a JSON-escaped chunk view, a constant bank and
+  a timestamp scratch, then gathered in one ``concat_segments`` call
+  (tpu/assemble.py).  This tier additionally excludes rows with
+  duplicate or >48-byte SD names (vectorized sort-key limits); those
+  rows re-run the scalar oracle instead.
+
+Rows outside the tier (kernel-flagged, oversized, non-ASCII, SD values
+needing unescape) re-run the scalar oracle (decoder → GelfEncoder), so
+observable bytes stay identical to the reference semantics
+(gelf_encoder.rs:51-116) in every case; differential tests drive both
+engines against the Record path.
+
+Framing (merger/mod.rs:30-32) is pre-applied: line/nul suffixes ride
+the tail constant and syslen's length prefix is rendered inline; the
+result is an EncodedBlock the sinks write wholesale.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..block import EncodedBlock
+from ..encoders import EncodeError
+from ..mergers import LineMerger, Merger, NulMerger, SyslenMerger
+from ..utils.rustfmt import json_f64
+from .assemble import (
+    build_source,
+    concat_segments,
+    decimal_segments,
+    escape_json,
+    exclusive_cumsum,
+    _DEC_WIDTH,
+)
+from .materialize import _scalar_line, compute_ts
+
+_NAME_KEY_MAX = 48   # numpy tier: SD names longer than this fall back
+_NATIVE_MAX_PAIRS = 64  # kMaxPairs in flowgger_host.cpp
+
+# constant bank --------------------------------------------------------------
+_C_OPEN = b"{"
+_C_P0 = b'"_'
+_C_P1 = b'":"'
+_C_P2 = b'",'
+_C_APP = b'"application_name":"'
+_C_FULL = b'","full_message":"'
+_C_HOST = b'","host":"'
+_C_LEVEL = b'","level":'
+_C_PROC = b',"process_id":"'
+_C_SDID = b'","sd_id":"'
+_C_SHORT = b'","short_message":"'
+_C_TS = b'","timestamp":'
+_C_TAIL = b',"version":"1.1"}'
+_C_UNKNOWN = b"unknown"
+_C_DASH = b"-"
+_C_SEVD = b"01234567"
+
+
+class BlockResult:
+    """The block plus per-row errors, in input order."""
+
+    __slots__ = ("block", "errors", "fallback_rows")
+
+    def __init__(self, block: EncodedBlock, errors: List[Tuple[str, str]],
+                 fallback_rows: int):
+        self.block = block
+        self.errors = errors
+        self.fallback_rows = fallback_rows
+
+
+def merger_suffix(merger: Optional[Merger]) -> Optional[Tuple[bytes, bool]]:
+    """(suffix bytes, needs syslen prefix) or None if the merger type is
+    not block-encodable."""
+    if merger is None:
+        return b"", False
+    t = type(merger)
+    if t is LineMerger:
+        return b"\n", False
+    if t is NulMerger:
+        return b"\0", False
+    if t is SyslenMerger:
+        return b"\n", True
+    return None
+
+
+def _ts_scratch(out: Dict[str, np.ndarray], n: int, ridx: np.ndarray
+                ) -> Tuple[bytes, np.ndarray, np.ndarray]:
+    """Deduplicated serde_json-format timestamps for the tier rows:
+    repetitive streams share few distinct stamps, and formatting is the
+    only remaining per-value Python."""
+    ts = compute_ts({k: np.asarray(v)[:n][ridx]
+                     for k, v in out.items()
+                     if k in ("days", "sod", "off", "nanos")})
+    uniq, inv = np.unique(ts, return_inverse=True)
+    strs = [json_f64(float(u)).encode("ascii") for u in uniq]
+    scratch = b"".join(strs)
+    ulen = np.fromiter((len(s) for s in strs), dtype=np.int64,
+                       count=len(strs))
+    uoff = exclusive_cumsum(ulen)[:-1]
+    return scratch, uoff[inv], ulen[inv]
+
+
+def _syslen_prefix_lens(framed_lens: np.ndarray) -> np.ndarray:
+    """Per-row syslen prefix width from framed lengths: the unique d
+    with decimal_digits(framed - d - 1) == d, plus one for the space."""
+    plens = np.zeros(framed_lens.size, dtype=np.int64)
+    pow10 = 10 ** np.arange(1, _DEC_WIDTH, dtype=np.int64)
+    for d in range(1, _DEC_WIDTH + 1):
+        body = framed_lens - d - 1
+        ndig = 1 + (body[:, None] >= pow10[None, :]).sum(axis=1)
+        plens = np.where((plens == 0) & (ndig == d), d + 1, plens)
+    return plens
+
+
+def encode_rfc5424_gelf_block(
+    chunk_bytes: bytes,
+    starts: np.ndarray,
+    orig_lens: np.ndarray,
+    out: Dict[str, np.ndarray],
+    n_real: int,
+    max_len: int,
+    encoder,
+    merger: Optional[Merger],
+) -> Optional[BlockResult]:
+    """Returns None when this route can't apply (gelf_extra configured or
+    an unknown merger type) — the caller then uses the per-row path."""
+    from .. import native
+
+    spec = merger_suffix(merger)
+    if spec is None or encoder.extra:
+        return None
+    suffix, syslen = spec
+
+    n = int(n_real)
+    starts64 = np.asarray(starts[:n], dtype=np.int64)
+    lens64 = np.asarray(orig_lens[:n], dtype=np.int64)
+    ok = np.asarray(out["ok"][:n], dtype=bool)
+    has_high = np.asarray(out["has_high"][:n], dtype=bool)
+    pair_count = np.asarray(out["pair_count"][:n])
+    sd_count = np.asarray(out["sd_count"][:n])
+    val_has_esc = np.asarray(out["val_has_esc"][:n], dtype=bool)
+    name_start = np.asarray(out["name_start"])[:n]
+    name_end = np.asarray(out["name_end"])[:n]
+
+    cand = ok & (lens64 <= max_len) & ~has_high
+    if val_has_esc.shape[1]:
+        cand &= ~val_has_esc.any(axis=1)
+
+    chunk_arr = np.frombuffer(chunk_bytes, dtype=np.uint8)
+    use_native = (native.gelf_rows_available()
+                  and name_start.shape[1] <= _NATIVE_MAX_PAIRS)
+
+    ns_s = ne_s = vs_s = ve_s = np.zeros(0, dtype=np.int64)
+    if not use_native:
+        # numpy tier limits: SD name length cap + no duplicate names
+        jmask = np.arange(name_start.shape[1])[None, :] < pair_count[:, None]
+        nlen = np.where(jmask, name_end - name_start, 0)
+        max_name = int(nlen.max(initial=0))
+        cand &= nlen.max(axis=1, initial=0) <= _NAME_KEY_MAX
+
+        # pair table sorted by (row, name bytes)
+        pc = np.where(cand & (sd_count > 0),
+                      pair_count.astype(np.int64), 0)
+        T = int(pc.sum())
+        if T:
+            rop = np.repeat(np.arange(n, dtype=np.int64), pc)
+            jop = np.arange(T, dtype=np.int64) - np.repeat(
+                exclusive_cumsum(pc)[:-1], pc)
+            ns_abs = starts64[rop] + name_start[rop, jop]
+            ne_abs = starts64[rop] + name_end[rop, jop]
+            vs_abs = starts64[rop] + np.asarray(out["val_start"])[:n][rop, jop]
+            ve_abs = starts64[rop] + np.asarray(out["val_end"])[:n][rop, jop]
+            # sort keys: name bytes packed big-endian into uint64 words
+            # via a contiguous view — width adapts to the longest name
+            K = max(8, min(_NAME_KEY_MAX, -(-max_name // 8) * 8))
+            gidx = (ns_abs[:, None]
+                    + np.arange(K, dtype=np.int64)[None, :]).astype(np.int32)
+            nm = np.where(gidx < ne_abs[:, None].astype(np.int32),
+                          chunk_arr[np.minimum(gidx, chunk_arr.size - 1)],
+                          np.uint8(0))
+            words = np.ascontiguousarray(nm).view(">u8")
+            order = np.lexsort(
+                tuple(words[:, w] for w in range(K // 8 - 1, -1, -1))
+                + (rop,))
+            srop = rop[order]
+            swords = words[order]
+            dup = ((srop[1:] == srop[:-1])
+                   & (swords[1:] == swords[:-1]).all(axis=1))
+            if dup.any():
+                cand[np.unique(srop[1:][dup])] = False
+                order = order[cand[srop]]
+                srop = rop[order]
+            ns_s, ne_s = ns_abs[order], ne_abs[order]
+            vs_s, ve_s = vs_abs[order], ve_abs[order]
+
+    ridx = np.flatnonzero(cand)
+    R = ridx.size
+    fb_idx = np.flatnonzero(~cand)
+
+    errors: List[Tuple[str, str]] = []
+    row_bytes_len = np.zeros(n, dtype=np.int64)
+    emit = np.zeros(n, dtype=bool)
+
+    final_buf = b""
+    row_off = np.zeros(1, dtype=np.int64)
+    prefix_lens_tier: Optional[np.ndarray] = None
+
+    if R and use_native:
+        scratch, ts_off, ts_len = _ts_scratch(out, n, ridx)
+        meta = np.empty((R, 17), dtype=np.int32)
+        meta[:, 0] = starts64[ridx]
+        for k, key in enumerate(("host_start", "host_end", "app_start",
+                                 "app_end", "proc_start", "proc_end",
+                                 "msg_trim_start", "trim_end", "full_start",
+                                 "severity")):
+            meta[:, 1 + k] = np.asarray(out[key])[:n][ridx]
+        nsd = (np.asarray(sd_count)[ridx] > 0)
+        meta[:, 11] = nsd
+        last = np.maximum(np.asarray(sd_count)[ridx] - 1, 0)
+        meta[:, 12] = np.asarray(out["sid_start"])[:n][ridx, last]
+        meta[:, 13] = np.asarray(out["sid_end"])[:n][ridx, last]
+        meta[:, 14] = ts_off
+        meta[:, 15] = ts_len
+        meta[:, 16] = np.asarray(pair_count)[ridx]
+        pns = np.asarray(out["name_start"])[:n][ridx]
+        pne = np.asarray(out["name_end"])[:n][ridx]
+        pvs = np.asarray(out["val_start"])[:n][ridx]
+        pve = np.asarray(out["val_end"])[:n][ridx]
+        res = native.gelf_rows_native(chunk_bytes, meta, pns, pne, pvs, pve,
+                                      scratch, suffix, syslen)
+        # gelf_rows_available() was checked above, so res cannot be None
+        buf, row_off = res
+        tier_lens = np.diff(row_off)
+        if syslen:
+            prefix_lens_tier = _syslen_prefix_lens(tier_lens)
+        final_buf = buf.tobytes()
+        row_bytes_len[ridx] = tier_lens
+        emit[ridx] = True
+
+    if R and not use_native:
+        emap = escape_json(chunk_arr)
+        esc = emap.esc
+
+        # per-row escaped spans ----------------------------------------
+        def espan(skey, ekey):
+            a = starts64[ridx] + np.asarray(out[skey])[:n][ridx]
+            b = starts64[ridx] + np.asarray(out[ekey])[:n][ridx]
+            ea = emap.map(a)
+            return ea, emap.map(b) - ea
+
+        app_src, app_len = espan("app_start", "app_end")
+        host_src, host_len = espan("host_start", "host_end")
+        proc_src, proc_len = espan("proc_start", "proc_end")
+        full_src, full_len = espan("full_start", "trim_end")
+        msg_src, msg_len = espan("msg_trim_start", "trim_end")
+
+        nsd = np.asarray(sd_count)[ridx] > 0
+        last = np.maximum(np.asarray(sd_count)[ridx] - 1, 0)
+        sid_a = starts64[ridx] + np.asarray(out["sid_start"])[:n][ridx, last]
+        sid_b = starts64[ridx] + np.asarray(out["sid_end"])[:n][ridx, last]
+        sid_src = emap.map(sid_a)
+        sid_len = emap.map(sid_b) - sid_src
+
+        sev = np.asarray(out["severity"])[:n][ridx].astype(np.int64)
+
+        scratch, ts_off, ts_len = _ts_scratch(out, n, ridx)
+        const_bank, coffs = build_source(
+            _C_OPEN, _C_P0, _C_P1, _C_P2, _C_APP, _C_FULL, _C_HOST,
+            _C_LEVEL, _C_PROC, _C_SDID, _C_SHORT, _C_TS, _C_TAIL + suffix,
+            _C_UNKNOWN, _C_DASH, _C_SEVD)
+        (o_open, o_p0, o_p1, o_p2, o_app, o_full, o_host, o_level, o_proc,
+         o_sdid, o_short, o_ts, o_tail, o_unknown, o_dash, o_sevd) = coffs
+        cbase = int(esc.size)
+        tbase = cbase + int(const_bank.size)
+        src = np.concatenate([
+            esc, const_bank, np.frombuffer(scratch or b"\0", dtype=np.uint8),
+        ])
+        ts_src = tbase + ts_off
+        # empty-field redirects
+        host_src = np.where(host_len == 0, cbase + o_unknown, host_src)
+        host_len = np.where(host_len == 0, len(_C_UNKNOWN), host_len)
+        msg_src = np.where(msg_len == 0, cbase + o_dash, msg_src)
+        msg_len = np.where(msg_len == 0, 1, msg_len)
+
+        # ---- segment stream (column-wise construction) ---------------
+        # every row gets 18 fixed segment slots (brace + 17 canonical
+        # tail parts, with the sd_id pair zero-length when absent) plus
+        # 5 slots per SD pair, so destinations are pure index arithmetic
+        # and each column is one R- or T-sized write — no S-sized masks.
+        pc2 = np.where(cand & (np.asarray(sd_count) > 0),
+                       np.asarray(pair_count).astype(np.int64), 0)
+        p = pc2[ridx]
+        T2 = ns_s.size
+        pb = exclusive_cumsum(p)
+        rstart = 18 * np.arange(R, dtype=np.int64) + 5 * pb[:-1]
+        S = 18 * R + 5 * T2
+        seg_src = np.empty(S, dtype=np.int64)
+        seg_len = np.empty(S, dtype=np.int64)
+
+        seg_src[rstart] = cbase + o_open
+        seg_len[rstart] = 1
+
+        if T2:
+            name_src = emap.map(ns_s)
+            name_len_e = emap.map(ne_s) - name_src
+            val_src = emap.map(vs_s)
+            val_len_e = emap.map(ve_s) - val_src
+            tord = np.repeat(np.arange(R, dtype=np.int64), p)
+            within = np.arange(T2, dtype=np.int64) - np.repeat(pb[:-1], p)
+            pd0 = rstart[tord] + 1 + 5 * within
+            pair_dest = pd0[:, None] + np.arange(5, dtype=np.int64)[None, :]
+            pair_src2 = np.empty((T2, 5), dtype=np.int64)
+            pair_len2 = np.empty((T2, 5), dtype=np.int64)
+            pair_src2[:, 0] = cbase + o_p0
+            pair_len2[:, 0] = 2
+            pair_src2[:, 1] = name_src
+            pair_len2[:, 1] = name_len_e
+            pair_src2[:, 2] = cbase + o_p1
+            pair_len2[:, 2] = 3
+            pair_src2[:, 3] = val_src
+            pair_len2[:, 3] = val_len_e
+            pair_src2[:, 4] = cbase + o_p2
+            pair_len2[:, 4] = 2
+            seg_src[pair_dest] = pair_src2
+            seg_len[pair_dest] = pair_len2
+
+        tail_dest = (rstart + 1 + 5 * p)[:, None] + np.arange(
+            17, dtype=np.int64)[None, :]
+        tsrc = np.empty((R, 17), dtype=np.int64)
+        tlen = np.empty((R, 17), dtype=np.int64)
+        cols = (
+            (cbase + o_app, len(_C_APP)),
+            (app_src, app_len),
+            (cbase + o_full, len(_C_FULL)),
+            (full_src, full_len),
+            (cbase + o_host, len(_C_HOST)),
+            (host_src, host_len),
+            (cbase + o_level, len(_C_LEVEL)),
+            (cbase + o_sevd + sev, 1),
+            (cbase + o_proc, len(_C_PROC)),
+            (proc_src, proc_len),
+            (cbase + o_sdid, np.where(nsd, len(_C_SDID), 0)),
+            (sid_src, np.where(nsd, sid_len, 0)),
+            (cbase + o_short, len(_C_SHORT)),
+            (msg_src, msg_len),
+            (cbase + o_ts, len(_C_TS)),
+            (ts_src, ts_len),
+            (cbase + o_tail, len(_C_TAIL) + len(suffix)),
+        )
+        for k, (s, ln) in enumerate(cols):
+            tsrc[:, k] = s
+            tlen[:, k] = ln
+        seg_src[tail_dest] = tsrc
+        seg_len[tail_dest] = tlen
+
+        dst0 = exclusive_cumsum(seg_len)
+        body = concat_segments(src, seg_src, seg_len, dst0)
+        row_off = np.concatenate([dst0[rstart], dst0[-1:]])
+        tier_lens = np.diff(row_off)
+
+        if syslen:
+            # prefix "{payload_len+newline} " — the payload already
+            # carries its trailing newline in the tail constant, so the
+            # framed length value is exactly the row length
+            # (syslen_merger.rs:14-31 counts payload + '\n')
+            deco, _ = build_source(b"0123456789 ")
+            src2 = np.concatenate([body, deco])
+            dbase = int(body.size)
+            dsrc, dlen = decimal_segments(tier_lens, dbase)
+            nseg2 = _DEC_WIDTH + 2
+            seg2_src = np.zeros(R * nseg2, dtype=np.int64)
+            seg2_len = np.zeros(R * nseg2, dtype=np.int64)
+            for w in range(_DEC_WIDTH):
+                seg2_src[w::nseg2] = dsrc[w::_DEC_WIDTH]
+                seg2_len[w::nseg2] = dlen[w::_DEC_WIDTH]
+            seg2_src[_DEC_WIDTH::nseg2] = dbase + 10      # the space
+            seg2_len[_DEC_WIDTH::nseg2] = 1
+            seg2_src[_DEC_WIDTH + 1::nseg2] = row_off[:-1]
+            seg2_len[_DEC_WIDTH + 1::nseg2] = tier_lens
+            framed = concat_segments(src2, seg2_src, seg2_len)
+            pow10 = 10 ** np.arange(1, _DEC_WIDTH, dtype=np.int64)
+            ndigits = 1 + (tier_lens[:, None] >= pow10[None, :]).sum(axis=1)
+            prefix_lens_tier = ndigits + 1
+            tier_lens = tier_lens + prefix_lens_tier
+            row_off = exclusive_cumsum(tier_lens)
+            final_buf = framed.tobytes()
+        else:
+            final_buf = body.tobytes()
+
+        row_bytes_len[ridx] = tier_lens
+        emit[ridx] = True
+
+    # ---- fallback rows (oracle per row; rare by construction) ------------
+    fallback_payload: Dict[int, bytes] = {}
+    fb_prefix: Dict[int, int] = {}
+    fallback_rows = 0  # parity with the per-row path: utf8 errors excluded
+    for i in fb_idx.tolist():
+        s = int(starts64[i])
+        ln = int(lens64[i])
+        raw = chunk_bytes[s:s + ln]
+        try:
+            line = raw.decode("utf-8")
+        except UnicodeDecodeError:
+            errors.append(("__utf8__", ""))
+            continue
+        fallback_rows += 1
+        res = _scalar_line(line)
+        if res.record is None:
+            errors.append((res.error, line))
+            continue
+        try:
+            payload = encoder.encode(res.record)
+        except EncodeError as e:
+            errors.append((str(e), line))
+            continue
+        framed_b = merger.frame(payload) if merger is not None else payload
+        fallback_payload[i] = framed_b
+        fb_prefix[i] = len(framed_b) - len(payload) - len(suffix)
+        row_bytes_len[i] = len(framed_b)
+        emit[i] = True
+
+    # ---- splice tier runs and fallback rows in input order ---------------
+    # fb_idx is exactly the non-tier rows, so every gap between
+    # consecutive fallback rows is a contiguous run of tier rows whose
+    # bytes are already contiguous in final_buf: one slice per run.
+    if fb_idx.size:
+        pieces: List[bytes] = []
+        tpos = np.cumsum(cand) - 1  # tier ordinal per row
+        prev = 0
+        for i in fb_idx.tolist():
+            if i > prev:
+                pieces.append(
+                    final_buf[int(row_off[tpos[prev]]):
+                              int(row_off[tpos[i - 1] + 1])])
+            fp = fallback_payload.get(i)
+            if fp is not None:
+                pieces.append(fp)
+            prev = i + 1
+        if prev < n:
+            pieces.append(final_buf[int(row_off[tpos[prev]]):])
+        data = b"".join(pieces)
+    else:
+        data = final_buf
+
+    bounds = exclusive_cumsum(row_bytes_len[emit])
+    prefix_lens = None
+    if syslen:
+        prefix_lens = np.zeros(n, dtype=np.int64)
+        if prefix_lens_tier is not None:
+            prefix_lens[ridx] = prefix_lens_tier
+        for i, v in fb_prefix.items():
+            prefix_lens[i] = v
+        prefix_lens = prefix_lens[emit]
+
+    block = EncodedBlock(data, bounds, prefix_lens, len(suffix))
+    return BlockResult(block, errors, fallback_rows)

@@ -443,6 +443,19 @@ def decode_rfc5424(batch: jnp.ndarray, lens: jnp.ndarray,
     ok &= jnp.where(is_sd, sd_msg_ok, True)
     msg_start = jnp.where(is_dash, rest_s + 1, after_sd_pos)
 
+    # ---- host-assembly aux channels --------------------------------------
+    # Python str whitespace over ASCII is {\t..\r, \x1c..\x1f, ' '}; these
+    # three reductions let the host build output bytes without re-scanning
+    # the batch (tpu/assemble.py): rstrip end of the full message, lstrip
+    # start of msg, and the ASCII-purity flag that gates the fast tier.
+    is_ws = ((bb >= 9) & (bb <= 13)) | ((bb >= 28) & (bb <= 32))
+    non_ws = valid & ~is_ws
+    trim_end = jnp.maximum(
+        jnp.max(jnp.where(non_ws, iota + 1, 0), axis=1), start0)
+    msg_a = _min_where(non_ws & (iota >= msg_start[:, None]), iota, L)
+    msg_trim_start = jnp.minimum(msg_a, trim_end)
+    has_high = jnp.any((bb >= 128) & valid, axis=1)
+
     # single reduction over every accumulated 2-D violation
     ok &= ~jnp.any(viol2d, axis=1)
 
@@ -468,6 +481,9 @@ def decode_rfc5424(batch: jnp.ndarray, lens: jnp.ndarray,
         "pair_sd": pair_sd,
         "val_has_esc": val_has_esc,
         "full_start": start0,
+        "trim_end": trim_end,
+        "msg_trim_start": msg_trim_start,
+        "has_high": has_high,
     }
 
 
@@ -525,12 +541,12 @@ _KEYS_1D = (
     "ok", "bom", "facility", "severity", "days", "sod", "off", "nanos",
     "host_start", "host_end", "app_start", "app_end", "proc_start",
     "proc_end", "msgid_start", "msgid_end", "msg_start", "sd_count",
-    "pair_count", "full_start",
+    "pair_count", "full_start", "trim_end", "msg_trim_start", "has_high",
 )
 _KEYS_SD = ("sid_start", "sid_end")
 _KEYS_PAIR = ("name_start", "name_end", "val_start", "val_end",
               "pair_sd", "val_has_esc")
-_BOOL_KEYS = ("ok", "bom", "val_has_esc")
+_BOOL_KEYS = ("ok", "bom", "val_has_esc", "has_high")
 
 DEFAULT_BLOCK_ROWS = 256
 
