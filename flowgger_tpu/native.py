@@ -52,6 +52,12 @@ def _load():
             ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p,
             ctypes.c_int64, ctypes.c_int32, ctypes.c_void_p, ctypes.c_void_p,
             ctypes.c_int]
+        if hasattr(lib, "fg_split_syslen"):
+            lib.fg_split_syslen.restype = ctypes.c_int64
+            lib.fg_split_syslen.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+                ctypes.c_void_p, ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int)]
         if hasattr(lib, "fg_concat_segments"):
             lib.fg_concat_segments.restype = None
             lib.fg_concat_segments.argtypes = [
@@ -126,6 +132,26 @@ def pack_chunk_native(chunk: bytes, starts: np.ndarray, lens: np.ndarray,
             max_len, batch.ctypes.data, lens_out.ctypes.data,
             _DEFAULT_THREADS)
     return batch, lens_out
+
+
+def split_syslen_native(chunk: bytes
+                        ) -> Optional[Tuple[np.ndarray, np.ndarray, int, int, bool]]:
+    """(starts, lens, n, consumed, bad_prefix) via the native octet-count
+    scan; None when the library is unavailable."""
+    lib = _load()
+    if lib is None or not hasattr(lib, "fg_split_syslen"):
+        return None
+    # worst case one frame per two bytes ("0 " repeated)
+    cap = max(16, len(chunk) // 2 + 1)
+    starts = np.empty(cap, dtype=np.int32)
+    lens = np.empty(cap, dtype=np.int32)
+    consumed = ctypes.c_int64(0)
+    err = ctypes.c_int(0)
+    buf = np.frombuffer(chunk, dtype=np.uint8)
+    n = lib.fg_split_syslen(
+        buf.ctypes.data, buf.size, starts.ctypes.data, lens.ctypes.data,
+        cap, ctypes.byref(consumed), ctypes.byref(err))
+    return starts[:n], lens[:n], int(n), int(consumed.value), bool(err.value)
 
 
 def gelf_rows_native(chunk: bytes, meta: np.ndarray,

@@ -37,6 +37,8 @@ class Handler:
     quiet_empty = False  # NulSplitter sets this: suppress empty-frame errors
     bare_errors = False  # UdpInput sets this: errors print without the line
                          # (udp_input.rs:84-86 vs line_splitter.rs:38)
+    ingest_sep = b"\n"   # set by the splitter when a chunk-capable handler
+    ingest_strip_cr = True  # receives regions framed on another separator
 
     def handle_bytes(self, raw: bytes) -> None:
         raise NotImplementedError
@@ -184,18 +186,21 @@ class LineSplitter(Splitter):
             _read_chunks_split(stream, handler, b"\n", strip_cr=True)
 
     @staticmethod
-    def _run_chunked(stream, handler: Handler) -> None:
+    def _run_chunked(stream, handler: Handler, sep: bytes = b"\n",
+                     strip_cr: bool = True) -> None:
+        handler.ingest_sep = sep
+        handler.ingest_strip_cr = strip_cr
         carry = b""
         for chunk in _read_stream(stream):
             data = carry + chunk if carry else chunk
-            cut = data.rfind(b"\n")
+            cut = data.rfind(sep)
             if cut < 0:
                 carry = data
                 continue
             handler.ingest_chunk(data[:cut + 1])
             carry = data[cut + 1:]
         if carry:
-            if carry.endswith(b"\r"):
+            if strip_cr and carry.endswith(b"\r"):
                 carry = carry[:-1]
             handler.handle_bytes(carry)
         handler.flush()
@@ -203,18 +208,120 @@ class LineSplitter(Splitter):
 
 class NulSplitter(Splitter):
     """NUL framing; errors on all-whitespace frames are suppressed
-    (nul_splitter.rs:10-49)."""
+    (nul_splitter.rs:10-49).  Chunk-capable handlers (the TPU
+    BatchHandler) get whole NUL-terminated regions, same zero-per-
+    message contract as LineSplitter."""
 
     def run(self, stream, handler: Handler) -> None:
         handler.quiet_empty = True
-        _read_chunks_split(stream, handler, b"\0", strip_cr=False)
+        if hasattr(handler, "ingest_chunk"):
+            LineSplitter._run_chunked(stream, handler, b"\0", strip_cr=False)
+        else:
+            _read_chunks_split(stream, handler, b"\0", strip_cr=False)
+
+
+def _scan_syslen_region(chunk: bytes):
+    """(starts, lens, n, consumed, bad_prefix): batched octet-count scan
+    — native memchr loop with a Python fallback."""
+    from .. import native
+
+    res = native.split_syslen_native(chunk)
+    if res is not None:
+        return res
+    import numpy as np
+
+    starts, lens = [], []
+    pos = 0
+    err = False
+    size = len(chunk)
+    while pos < size:
+        sp = chunk.find(b" ", pos)
+        if sp < 0:
+            break
+        len_s = chunk[pos:sp]
+        if not len_s.isdigit():
+            err = True
+            break
+        val = int(len_s)
+        if val > 2**31 - 1:
+            # same guard as the native scan: int32 span arrays cannot
+            # describe such frames, and buffering one unboundedly would
+            # never complete anyway
+            err = True
+            break
+        if sp + 1 + val > size:
+            break
+        starts.append(sp + 1)
+        lens.append(val)
+        pos = sp + 1 + val
+    return (np.array(starts, np.int32), np.array(lens, np.int32),
+            len(starts), pos, err)
 
 
 class SyslenSplitter(Splitter):
     """RFC5425-style octet counting: ASCII decimal length, one space, then
-    exactly that many bytes (syslen_splitter.rs:10-69)."""
+    exactly that many bytes (syslen_splitter.rs:10-69).
+
+    Span-capable handlers (the TPU BatchHandler) get whole regions with
+    pre-computed frame offset/length arrays from one native scan, so the
+    reference's ``framed=true`` production mode is zero-per-message too.
+    """
 
     def run(self, stream, handler: Handler) -> None:
+        if hasattr(handler, "ingest_spans"):
+            self._run_spans(stream, handler)
+            return
+        self._run_scalar(stream, handler)
+
+    @staticmethod
+    def _mid_body(buf: bytes) -> bool:
+        """True when the carry holds a valid length prefix awaiting its
+        body — the scalar loop would be in its read-body phase."""
+        sp = buf.find(b" ")
+        return sp > 0 and buf[:sp].isdigit()
+
+    @staticmethod
+    def _run_spans(stream, handler: Handler) -> None:
+        buf = b""
+        while True:
+            try:
+                chunk = stream.read(_CHUNK)
+            except TimeoutError:
+                # stderr parity with _run_scalar: idle in the prefix
+                # phase closes quietly; idle mid-body is a short read
+                if SyslenSplitter._mid_body(buf):
+                    print("failed to fill whole buffer", file=sys.stderr)
+                else:
+                    print(
+                        "Client hasn't sent any data for a while - "
+                        "Closing idle connection",
+                        file=sys.stderr,
+                    )
+                handler.flush()
+                return
+            except OSError:
+                chunk = b""
+            if not chunk:
+                break
+            buf = buf + chunk if buf else chunk
+            starts, lens, n, consumed, err = _scan_syslen_region(buf)
+            if n:
+                handler.ingest_spans(buf[:consumed], starts, lens)
+            if err:
+                print("Can't read message's length", file=sys.stderr)
+                handler.flush()
+                return
+            buf = buf[consumed:]
+        if buf:
+            # EOF mid-frame: incomplete body vs bad/absent length prefix
+            if SyslenSplitter._mid_body(buf):
+                print("failed to fill whole buffer", file=sys.stderr)
+            else:
+                print("Can't read message's length", file=sys.stderr)
+        handler.flush()
+
+    @staticmethod
+    def _run_scalar(stream, handler: Handler) -> None:
         buf = b""
         while True:
             # read length prefix up to the space

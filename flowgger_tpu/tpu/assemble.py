@@ -191,6 +191,24 @@ def decimal_segments(values: np.ndarray, digits_off: int
     return seg_src, seg_len
 
 
+def syslen_prefix_segments(body_lens: np.ndarray, digits_base: int
+                           ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Per-row syslen framing prefix ``"{body_len} "`` as 2D segment
+    columns: (src2d [R, W+1], len2d [R, W+1], prefix_lens [R]).  Callers
+    hstack their body columns and ravel; ``digits_base`` is the offset
+    of a ``b"0123456789 "`` table in the gather source.  The single
+    place the syslen prefix layout lives (syslen_merger.rs:14-31)."""
+    r = body_lens.size
+    dsrc, dlen = decimal_segments(body_lens, digits_base)
+    src2 = np.empty((r, _DEC_WIDTH + 1), dtype=np.int64)
+    len2 = np.empty((r, _DEC_WIDTH + 1), dtype=np.int64)
+    src2[:, :_DEC_WIDTH] = dsrc.reshape(r, _DEC_WIDTH)
+    len2[:, :_DEC_WIDTH] = dlen.reshape(r, _DEC_WIDTH)
+    src2[:, _DEC_WIDTH] = digits_base + 10  # the space
+    len2[:, _DEC_WIDTH] = 1
+    return src2, len2, len2.sum(axis=1)
+
+
 def build_source(*parts: bytes) -> Tuple[np.ndarray, List[int]]:
     """Concatenate byte strings into one u8 source array; returns the
     array and each part's base offset."""

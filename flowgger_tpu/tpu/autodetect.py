@@ -44,38 +44,98 @@ def classify(raw: bytes) -> int:
     return F_RFC3164
 
 
-def decode_auto_batch(lines: List[bytes], max_len: int,
-                      ltsv_decoder: Optional[LTSVDecoder] = None
-                      ) -> List[LineResult]:
-    from .batch import _decode_gelf_batch, _decode_ltsv_batch, _decode_rfc5424_batch
+def classify_packed(packed) -> "np.ndarray":
+    """Vectorized first-bytes classification on the packed batch — the
+    same decision table as ``classify`` with no per-line Python.  Rows
+    longer than max_len are re-classified from their raw bytes (their
+    tab/colon signature may lie beyond the clip)."""
+    import numpy as np
+
+    batch, lens, chunk, starts, orig_lens, n = packed
+    L = batch.shape[1]
+    if n == 0:
+        return np.zeros(0, dtype=np.int8)
+    if L < 19:
+        # pathological max_len: classify from the unclipped chunk bytes
+        st = np.asarray(starts)
+        ol = np.asarray(orig_lens)
+        return np.fromiter(
+            (classify(chunk[int(st[i]):int(st[i]) + int(ol[i])])
+             for i in range(n)),
+            dtype=np.int8, count=n)
+
+    head = batch[:n, :19]
+    bom = ((head[:, 0] == 0xEF) & (head[:, 1] == 0xBB)
+           & (head[:, 2] == 0xBF))
+    G = np.where(bom[:, None], batch[:n, 3:19], head[:, :16])
+
+    b0 = G[:, 0]
+    is_gelf = b0 == ord("{")
+    is_lt = b0 == ord("<")
+    # first '>' at offset 2..5 (classify: find('>', 1, 6) with gt > 1)
+    gt = np.zeros(n, dtype=np.int64)
+    for j in (2, 3, 4, 5):
+        gt = np.where((gt == 0) & (G[:, j] == ord(">")), j, gt)
+    digits_ok = np.ones(n, dtype=bool)
+    for j in (1, 2, 3, 4):
+        within = j < gt
+        dig = (G[:, j] >= 48) & (G[:, j] <= 57)
+        digits_ok &= ~within | dig
+    rows = np.arange(n)
+    v1 = G[rows, gt + 1]
+    v2 = G[rows, gt + 2]
+    is5424 = is_lt & (gt >= 2) & digits_ok & (v1 == ord("1")) & (v2 == 32)
+    has_tab = (batch[:n] == 9).any(axis=1)
+    has_col = (batch[:n] == 58).any(axis=1)
+
+    cls = np.full(n, F_RFC3164, dtype=np.int8)
+    cls[has_tab & has_col] = F_LTSV
+    cls[is_lt] = F_RFC3164
+    cls[is5424] = F_RFC5424
+    cls[is_gelf] = F_GELF
+
+    over = np.flatnonzero(np.asarray(orig_lens)[:n] > L)
+    for i in over.tolist():
+        s = int(np.asarray(starts)[i])
+        ln = int(np.asarray(orig_lens)[i])
+        cls[i] = classify(chunk[s:s + ln])
+    return cls
+
+
+def decode_auto_packed(packed, max_len: int,
+                       ltsv_decoder: Optional[LTSVDecoder] = None
+                       ) -> List[LineResult]:
+    """Partition a packed batch by vectorized class signature, run each
+    class's columnar kernel on a row subset, and reassemble results in
+    input order (BASELINE config #5, zero per-line Python pre-kernel)."""
+    import numpy as np
+
+    from . import pack as packmod
+    from .batch import _decode_packed
 
     if ltsv_decoder is None:
         ltsv_decoder = LTSVDecoder(Config.from_string(""))
-    classes = [classify(ln) for ln in lines]
-    buckets: List[List[int]] = [[], [], [], []]
-    for i, c in enumerate(classes):
-        buckets[c].append(i)
-
-    results: List[LineResult] = [None] * len(lines)  # type: ignore
-
-    if buckets[F_RFC5424]:
-        sub = [lines[i] for i in buckets[F_RFC5424]]
-        for i, res in zip(buckets[F_RFC5424], _decode_rfc5424_batch(sub, max_len)):
-            results[i] = res
-    if buckets[F_LTSV]:
-        sub = [lines[i] for i in buckets[F_LTSV]]
-        for i, res in zip(buckets[F_LTSV],
-                          _decode_ltsv_batch(sub, max_len, ltsv_decoder)):
-            results[i] = res
-    if buckets[F_GELF]:
-        sub = [lines[i] for i in buckets[F_GELF]]
-        for i, res in zip(buckets[F_GELF], _decode_gelf_batch(sub, max_len)):
-            results[i] = res
-    if buckets[F_RFC3164]:
-        from .batch import _decode_rfc3164_batch
-
-        sub = [lines[i] for i in buckets[F_RFC3164]]
-        for i, res in zip(buckets[F_RFC3164],
-                          _decode_rfc3164_batch(sub, max_len)):
-            results[i] = res
+    n = packed[5]
+    classes = classify_packed(packed)
+    results: List[LineResult] = [None] * n  # type: ignore
+    for cls, fmt in ((F_RFC5424, "rfc5424"), (F_RFC3164, "rfc3164"),
+                     (F_LTSV, "ltsv"), (F_GELF, "gelf")):
+        idx = np.flatnonzero(classes == cls)
+        if not idx.size:
+            continue
+        sub = packmod.subset_packed(packed, idx)
+        res = _decode_packed(fmt, sub,
+                             ltsv_decoder if fmt == "ltsv" else None)
+        for i, r in zip(idx.tolist(), res):
+            results[i] = r
     return results
+
+
+def decode_auto_batch(lines: List[bytes], max_len: int,
+                      ltsv_decoder: Optional[LTSVDecoder] = None
+                      ) -> List[LineResult]:
+    """List-of-lines entry: pack once, then the packed auto route."""
+    from . import pack as packmod
+
+    return decode_auto_packed(packmod.pack_lines_2d(lines, max_len),
+                              max_len, ltsv_decoder)

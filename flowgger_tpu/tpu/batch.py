@@ -64,6 +64,9 @@ class BatchHandler(Handler):
         self._lines: List[bytes] = []
         self._chunks: List[bytes] = []      # complete-line regions (fast path)
         self._chunk_lines = 0
+        self._span_chunks: List[bytes] = []  # syslen regions + frame spans
+        self._span_sets: List = []
+        self._span_count = 0
         self._lock = threading.Lock()
         # serializes batch decodes so a timer flush racing a size flush
         # cannot reorder output
@@ -80,6 +83,7 @@ class BatchHandler(Handler):
                 and encoder.header_time_format is None))
         # single source of truth for kernel dispatch: fmt -> batch decoder
         auto_ltsv = self._auto_ltsv_decoder(cfg) if fmt == "auto" else None
+        self._auto_ltsv = auto_ltsv
         self._kernel_fn = {
             "rfc5424": lambda lines: _decode_rfc5424_batch(lines, self.max_len),
             "ltsv": lambda lines: _decode_ltsv_batch(
@@ -92,13 +96,15 @@ class BatchHandler(Handler):
 
     # -- Handler interface -------------------------------------------------
     def ingest_chunk(self, region: bytes) -> None:
-        """Fast path fed by LineSplitter: a region of *complete* newline-
-        terminated lines straight off the wire — no per-line Python
-        objects; native code does the framing at flush."""
+        """Fast path fed by Line/NulSplitter: a region of *complete*
+        separator-terminated messages straight off the wire — no
+        per-message Python objects; native code does the framing at
+        flush (the separator rides ``ingest_sep``, set by the splitter).
+        """
         with self._lock:
             self._chunks.append(region)
-            self._chunk_lines += region.count(b"\n")
-            full = self._chunk_lines + len(self._lines) >= self.batch_size
+            self._chunk_lines += region.count(self.ingest_sep)
+            full = self._pending_locked() >= self.batch_size
             if not full and self._timer is None and self._start_timer:
                 self._timer = threading.Timer(self.flush_ms / 1000.0, self.flush)
                 self._timer.daemon = True
@@ -106,10 +112,29 @@ class BatchHandler(Handler):
         if full:
             self.flush()
 
+    def ingest_spans(self, chunk: bytes, starts, lens) -> None:
+        """Fast path fed by SyslenSplitter: a region plus pre-scanned
+        frame offset/length arrays — zero per-message Python for the
+        reference's ``framed=true`` mode."""
+        with self._lock:
+            self._span_chunks.append(chunk)
+            self._span_sets.append((starts, lens))
+            self._span_count += len(starts)
+            full = self._pending_locked() >= self.batch_size
+            if not full and self._timer is None and self._start_timer:
+                self._timer = threading.Timer(self.flush_ms / 1000.0, self.flush)
+                self._timer.daemon = True
+                self._timer.start()
+        if full:
+            self.flush()
+
+    def _pending_locked(self) -> int:
+        return self._chunk_lines + self._span_count + len(self._lines)
+
     def handle_bytes(self, raw: bytes) -> None:
         with self._lock:
             self._lines.append(raw)
-            full = len(self._lines) >= self.batch_size
+            full = self._pending_locked() >= self.batch_size
             if not full and self._timer is None and self._start_timer:
                 self._timer = threading.Timer(self.flush_ms / 1000.0, self.flush)
                 self._timer.daemon = True
@@ -125,6 +150,9 @@ class BatchHandler(Handler):
             lines, self._lines = self._lines, []
             chunks, self._chunks = self._chunks, []
             self._chunk_lines = 0
+            spans = (self._span_chunks, self._span_sets)
+            self._span_chunks, self._span_sets = [], []
+            self._span_count = 0
             if self._timer is not None:
                 self._timer.cancel()
                 self._timer = None
@@ -135,6 +163,8 @@ class BatchHandler(Handler):
             n0 = _metrics.get("input_lines")
             if chunks:
                 self._decode_chunks(chunks)
+            if spans[0]:
+                self._decode_spans(*spans)
             if lines:
                 self._decode_batch(lines)
             _metrics.inc("batches")
@@ -152,18 +182,40 @@ class BatchHandler(Handler):
         from . import pack
 
         region = b"".join(chunks)
-        if self._kernel_fn is None or self.fmt == "auto":
-            # these paths want a per-line list; split once in C speed
-            lines = region.split(b"\n")
+        sep = self.ingest_sep
+        if self._kernel_fn is None:
+            # formats without a columnar kernel: split once in C speed
+            lines = region.split(sep)
             lines.pop()  # regions end with the separator
-            lines = [ln[:-1] if ln.endswith(b"\r") else ln for ln in lines]
-            if self.fmt != "auto":
-                for raw in lines:
-                    self.scalar.handle_bytes(raw)
-                return
-            self._emit(self._kernel_fn(lines))
+            if self.ingest_strip_cr:
+                lines = [ln[:-1] if ln.endswith(b"\r") else ln
+                         for ln in lines]
+            for raw in lines:
+                self.scalar.handle_bytes(raw)
             return
-        packed = pack.pack_region_2d(region, self.max_len)
+        self._dispatch_packed(pack.pack_region_2d(
+            region, self.max_len, sep=sep[0],
+            strip_cr=self.ingest_strip_cr))
+
+    def _decode_spans(self, span_chunks, span_sets) -> None:
+        from . import pack
+
+        if self._kernel_fn is None:
+            for chunk, (starts, lens) in zip(span_chunks, span_sets):
+                for s, ln in zip(starts.tolist(), lens.tolist()):
+                    self.scalar.handle_bytes(chunk[s:s + ln])
+            return
+        self._dispatch_packed(pack.pack_spans_2d(span_chunks, span_sets,
+                                                 self.max_len))
+
+    def _dispatch_packed(self, packed) -> None:
+        """Route one packed tuple through the right decode/encode tier."""
+        if self.fmt == "auto":
+            from .autodetect import decode_auto_packed
+
+            self._emit(decode_auto_packed(packed, self.max_len,
+                                          self._auto_ltsv))
+            return
         if self._fast_encode:
             self._emit_fast(packed)
             return
@@ -190,18 +242,22 @@ class BatchHandler(Handler):
         if not self._block_mode:
             return False
         from ..encoders.gelf import GelfEncoder
-        from .encode_gelf_block import merger_suffix
+        from ..encoders.passthrough import PassthroughEncoder
+        from .block_common import merger_suffix
 
-        return (type(self.encoder) is GelfEncoder
-                and not self.encoder.extra
-                and merger_suffix(self._merger) is not None)
+        if merger_suffix(self._merger) is None:
+            return False
+        if type(self.encoder) is GelfEncoder:
+            return not self.encoder.extra
+        if type(self.encoder) is PassthroughEncoder:
+            return self.encoder.header_time_format is None
+        return False
 
     def _emit_fast(self, packed) -> None:
         """Span→bytes encode for one packed tuple: the columnar block
         route when engaged, else the per-row fast path."""
         if self._block_route_ok():
-            res = _encode_block_rfc5424_gelf(packed, self.encoder,
-                                             self._merger)
+            res = _encode_block_rfc5424(packed, self.encoder, self._merger)
             self._emit_block(res, packed[5])
             return
         self._emit_encoded(_encode_packed_rfc5424_gelf(packed, self.encoder))
@@ -278,17 +334,22 @@ class BatchHandler(Handler):
             self.tx.put(encoded)
 
 
-def _encode_block_rfc5424_gelf(packed, encoder, merger):
-    """Columnar block encode; returns BlockResult or None when the route
-    doesn't apply (gelf_extra, unsupported merger)."""
+def _encode_block_rfc5424(packed, encoder, merger):
+    """Columnar block encode for the rfc5424 kernel: decode once, then
+    dispatch on the encoder type (caller pre-checked applicability)."""
     import jax.numpy as jnp
 
-    from . import encode_gelf_block, rfc5424
+    from ..encoders.passthrough import PassthroughEncoder
+    from . import encode_gelf_block, encode_passthrough_block, rfc5424
 
     batch, lens, chunk, starts, orig_lens, n_real = packed
     out = rfc5424.decode_rfc5424_jit(jnp.asarray(batch), jnp.asarray(lens),
                                      extract_impl=rfc5424.best_extract_impl())
     host_out = {k: np.asarray(v) for k, v in out.items()}
+    if type(encoder) is PassthroughEncoder:
+        return encode_passthrough_block.encode_rfc5424_passthrough_block(
+            chunk, starts, orig_lens, host_out, n_real, batch.shape[1],
+            encoder, merger)
     return encode_gelf_block.encode_rfc5424_gelf_block(
         chunk, starts, orig_lens, host_out, n_real, batch.shape[1],
         encoder, merger)

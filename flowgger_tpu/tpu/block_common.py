@@ -1,0 +1,139 @@
+"""Shared machinery for columnar block encoders: framing specs, the
+scalar-oracle fallback loop, and the splice that interleaves vectorized
+tier runs with per-row fallback output in input order.
+
+Every block encoder (GELF, passthrough, ...) produces a contiguous
+``final_buf`` for its fast-tier rows plus ``row_off`` boundaries; this
+module turns that into an EncodedBlock with the reference's observable
+semantics — per-line errors in order (line_splitter.rs:37-54), framing
+pre-applied with the pipeline's merger (merger/mod.rs:30-32).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..block import EncodedBlock
+from ..encoders import EncodeError
+from ..mergers import LineMerger, Merger, NulMerger, SyslenMerger
+from .assemble import exclusive_cumsum
+from .materialize import _scalar_line
+
+
+class BlockResult:
+    """The block plus per-row errors, in input order."""
+
+    __slots__ = ("block", "errors", "fallback_rows")
+
+    def __init__(self, block: EncodedBlock, errors: List[Tuple[str, str]],
+                 fallback_rows: int):
+        self.block = block
+        self.errors = errors
+        self.fallback_rows = fallback_rows
+
+
+def merger_suffix(merger: Optional[Merger]) -> Optional[Tuple[bytes, bool]]:
+    """(suffix bytes, needs syslen prefix) or None if the merger type is
+    not block-encodable."""
+    if merger is None:
+        return b"", False
+    t = type(merger)
+    if t is LineMerger:
+        return b"\n", False
+    if t is NulMerger:
+        return b"\0", False
+    if t is SyslenMerger:
+        return b"\n", True
+    return None
+
+
+def finish_block(
+    chunk_bytes: bytes,
+    starts64: np.ndarray,
+    lens64: np.ndarray,
+    n: int,
+    cand: np.ndarray,
+    ridx: np.ndarray,
+    final_buf: bytes,
+    row_off: np.ndarray,
+    prefix_lens_tier: Optional[np.ndarray],
+    suffix: bytes,
+    syslen: bool,
+    merger: Optional[Merger],
+    encoder,
+) -> BlockResult:
+    """Fallback rows through the scalar oracle, splice in input order,
+    compute message bounds; returns the BlockResult."""
+    errors: List[Tuple[str, str]] = []
+    row_bytes_len = np.zeros(n, dtype=np.int64)
+    emit = np.zeros(n, dtype=bool)
+    if ridx.size:
+        row_bytes_len[ridx] = np.diff(row_off)
+        emit[ridx] = True
+
+    fb_idx = np.flatnonzero(~cand)
+    fallback_payload: Dict[int, bytes] = {}
+    fb_prefix: Dict[int, int] = {}
+    fallback_rows = 0  # parity with the per-row path: utf8 errors excluded
+    for i in fb_idx.tolist():
+        s = int(starts64[i])
+        ln = int(lens64[i])
+        raw = chunk_bytes[s:s + ln]
+        try:
+            line = raw.decode("utf-8")
+        except UnicodeDecodeError:
+            errors.append(("__utf8__", ""))
+            continue
+        fallback_rows += 1
+        res = _scalar_line(line)
+        if res.record is None:
+            errors.append((res.error, line))
+            continue
+        try:
+            payload = encoder.encode(res.record)
+        except EncodeError as e:
+            errors.append((str(e), line))
+            continue
+        framed_b = merger.frame(payload) if merger is not None else payload
+        fallback_payload[i] = framed_b
+        fb_prefix[i] = len(framed_b) - len(payload) - len(suffix)
+        row_bytes_len[i] = len(framed_b)
+        emit[i] = True
+
+    # splice tier runs and fallback rows in input order: fb_idx is
+    # exactly the non-tier rows, so every gap between consecutive
+    # fallback rows is a contiguous run of tier rows whose bytes are
+    # already contiguous in final_buf — one slice per run.
+    if fb_idx.size:
+        pieces: List[bytes] = []
+        tpos = np.cumsum(cand) - 1  # tier ordinal per row
+        prev = 0
+        for i in fb_idx.tolist():
+            if i > prev:
+                pieces.append(
+                    final_buf[int(row_off[tpos[prev]]):
+                              int(row_off[tpos[i - 1] + 1])])
+            fp = fallback_payload.get(i)
+            if fp is not None:
+                pieces.append(fp)
+            prev = i + 1
+        if prev < n:
+            pieces.append(final_buf[int(row_off[tpos[prev]]):])
+        data = b"".join(pieces)
+    else:
+        data = final_buf
+
+    bounds = exclusive_cumsum(row_bytes_len[emit])
+    prefix_lens = None
+    if syslen:
+        prefix_lens = np.zeros(n, dtype=np.int64)
+        if prefix_lens_tier is not None:
+            prefix_lens[ridx] = prefix_lens_tier
+        for i, v in fb_prefix.items():
+            prefix_lens[i] = v
+        prefix_lens = prefix_lens[emit]
+
+    block = EncodedBlock(data, bounds, prefix_lens, len(suffix))
+    return BlockResult(block, errors, fallback_rows)

@@ -33,19 +33,20 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from ..block import EncodedBlock
-from ..encoders import EncodeError
-from ..mergers import LineMerger, Merger, NulMerger, SyslenMerger
+from ..mergers import Merger
 from ..utils.rustfmt import json_f64
 from .assemble import (
     build_source,
     concat_segments,
-    decimal_segments,
     escape_json,
     exclusive_cumsum,
+    syslen_prefix_segments,
     _DEC_WIDTH,
 )
-from .materialize import _scalar_line, compute_ts
+from .block_common import BlockResult, finish_block, merger_suffix
+from .materialize import compute_ts
+
+__all__ = ["encode_rfc5424_gelf_block", "BlockResult", "merger_suffix"]
 
 _NAME_KEY_MAX = 48   # numpy tier: SD names longer than this fall back
 _NATIVE_MAX_PAIRS = 64  # kMaxPairs in flowgger_host.cpp
@@ -67,33 +68,6 @@ _C_TAIL = b',"version":"1.1"}'
 _C_UNKNOWN = b"unknown"
 _C_DASH = b"-"
 _C_SEVD = b"01234567"
-
-
-class BlockResult:
-    """The block plus per-row errors, in input order."""
-
-    __slots__ = ("block", "errors", "fallback_rows")
-
-    def __init__(self, block: EncodedBlock, errors: List[Tuple[str, str]],
-                 fallback_rows: int):
-        self.block = block
-        self.errors = errors
-        self.fallback_rows = fallback_rows
-
-
-def merger_suffix(merger: Optional[Merger]) -> Optional[Tuple[bytes, bool]]:
-    """(suffix bytes, needs syslen prefix) or None if the merger type is
-    not block-encodable."""
-    if merger is None:
-        return b"", False
-    t = type(merger)
-    if t is LineMerger:
-        return b"\n", False
-    if t is NulMerger:
-        return b"\0", False
-    if t is SyslenMerger:
-        return b"\n", True
-    return None
 
 
 def _ts_scratch(out: Dict[str, np.ndarray], n: int, ridx: np.ndarray
@@ -208,12 +182,6 @@ def encode_rfc5424_gelf_block(
 
     ridx = np.flatnonzero(cand)
     R = ridx.size
-    fb_idx = np.flatnonzero(~cand)
-
-    errors: List[Tuple[str, str]] = []
-    row_bytes_len = np.zeros(n, dtype=np.int64)
-    emit = np.zeros(n, dtype=bool)
-
     final_buf = b""
     row_off = np.zeros(1, dtype=np.int64)
     prefix_lens_tier: Optional[np.ndarray] = None
@@ -247,8 +215,6 @@ def encode_rfc5424_gelf_block(
         if syslen:
             prefix_lens_tier = _syslen_prefix_lens(tier_lens)
         final_buf = buf.tobytes()
-        row_bytes_len[ridx] = tier_lens
-        emit[ridx] = True
 
     if R and not use_native:
         emap = escape_json(chunk_arr)
@@ -378,92 +344,19 @@ def encode_rfc5424_gelf_block(
             # (syslen_merger.rs:14-31 counts payload + '\n')
             deco, _ = build_source(b"0123456789 ")
             src2 = np.concatenate([body, deco])
-            dbase = int(body.size)
-            dsrc, dlen = decimal_segments(tier_lens, dbase)
-            nseg2 = _DEC_WIDTH + 2
-            seg2_src = np.zeros(R * nseg2, dtype=np.int64)
-            seg2_len = np.zeros(R * nseg2, dtype=np.int64)
-            for w in range(_DEC_WIDTH):
-                seg2_src[w::nseg2] = dsrc[w::_DEC_WIDTH]
-                seg2_len[w::nseg2] = dlen[w::_DEC_WIDTH]
-            seg2_src[_DEC_WIDTH::nseg2] = dbase + 10      # the space
-            seg2_len[_DEC_WIDTH::nseg2] = 1
-            seg2_src[_DEC_WIDTH + 1::nseg2] = row_off[:-1]
-            seg2_len[_DEC_WIDTH + 1::nseg2] = tier_lens
+            psrc, plen, prefix_lens_tier = syslen_prefix_segments(
+                tier_lens, int(body.size))
+            seg2_src = np.concatenate(
+                [psrc, row_off[:-1, None]], axis=1).ravel()
+            seg2_len = np.concatenate(
+                [plen, tier_lens[:, None]], axis=1).ravel()
             framed = concat_segments(src2, seg2_src, seg2_len)
-            pow10 = 10 ** np.arange(1, _DEC_WIDTH, dtype=np.int64)
-            ndigits = 1 + (tier_lens[:, None] >= pow10[None, :]).sum(axis=1)
-            prefix_lens_tier = ndigits + 1
             tier_lens = tier_lens + prefix_lens_tier
             row_off = exclusive_cumsum(tier_lens)
             final_buf = framed.tobytes()
         else:
             final_buf = body.tobytes()
 
-        row_bytes_len[ridx] = tier_lens
-        emit[ridx] = True
-
-    # ---- fallback rows (oracle per row; rare by construction) ------------
-    fallback_payload: Dict[int, bytes] = {}
-    fb_prefix: Dict[int, int] = {}
-    fallback_rows = 0  # parity with the per-row path: utf8 errors excluded
-    for i in fb_idx.tolist():
-        s = int(starts64[i])
-        ln = int(lens64[i])
-        raw = chunk_bytes[s:s + ln]
-        try:
-            line = raw.decode("utf-8")
-        except UnicodeDecodeError:
-            errors.append(("__utf8__", ""))
-            continue
-        fallback_rows += 1
-        res = _scalar_line(line)
-        if res.record is None:
-            errors.append((res.error, line))
-            continue
-        try:
-            payload = encoder.encode(res.record)
-        except EncodeError as e:
-            errors.append((str(e), line))
-            continue
-        framed_b = merger.frame(payload) if merger is not None else payload
-        fallback_payload[i] = framed_b
-        fb_prefix[i] = len(framed_b) - len(payload) - len(suffix)
-        row_bytes_len[i] = len(framed_b)
-        emit[i] = True
-
-    # ---- splice tier runs and fallback rows in input order ---------------
-    # fb_idx is exactly the non-tier rows, so every gap between
-    # consecutive fallback rows is a contiguous run of tier rows whose
-    # bytes are already contiguous in final_buf: one slice per run.
-    if fb_idx.size:
-        pieces: List[bytes] = []
-        tpos = np.cumsum(cand) - 1  # tier ordinal per row
-        prev = 0
-        for i in fb_idx.tolist():
-            if i > prev:
-                pieces.append(
-                    final_buf[int(row_off[tpos[prev]]):
-                              int(row_off[tpos[i - 1] + 1])])
-            fp = fallback_payload.get(i)
-            if fp is not None:
-                pieces.append(fp)
-            prev = i + 1
-        if prev < n:
-            pieces.append(final_buf[int(row_off[tpos[prev]]):])
-        data = b"".join(pieces)
-    else:
-        data = final_buf
-
-    bounds = exclusive_cumsum(row_bytes_len[emit])
-    prefix_lens = None
-    if syslen:
-        prefix_lens = np.zeros(n, dtype=np.int64)
-        if prefix_lens_tier is not None:
-            prefix_lens[ridx] = prefix_lens_tier
-        for i, v in fb_prefix.items():
-            prefix_lens[i] = v
-        prefix_lens = prefix_lens[emit]
-
-    block = EncodedBlock(data, bounds, prefix_lens, len(suffix))
-    return BlockResult(block, errors, fallback_rows)
+    return finish_block(chunk_bytes, starts64, lens64, n, cand, ridx,
+                        final_buf, row_off, prefix_lens_tier, suffix,
+                        syslen, merger, encoder)

@@ -24,12 +24,13 @@ def _next_pow2(n: int) -> int:
     return p
 
 
-def _split_np(chunk: bytes, strip_cr: bool = True
+def _split_np(chunk: bytes, strip_cr: bool = True, sep: int = 10
               ) -> Tuple[np.ndarray, np.ndarray, int, bytes]:
-    """Numpy newline scan: (starts, lens, n, carry) —
-    BufRead::lines semantics (one trailing CR stripped)."""
+    """Numpy separator scan: (starts, lens, n, carry) —
+    BufRead::lines semantics for ``sep=\\n`` (one trailing CR stripped),
+    BufRead::split semantics for other separators (nul framing)."""
     buf = np.frombuffer(chunk, dtype=np.uint8)
-    nl = np.flatnonzero(buf == 10).astype(np.int32)
+    nl = np.flatnonzero(buf == sep).astype(np.int32)
     n = int(nl.size)
     if n == 0:
         return np.zeros(0, np.int32), np.zeros(0, np.int32), 0, chunk
@@ -41,11 +42,14 @@ def _split_np(chunk: bytes, strip_cr: bool = True
     return starts, ends - starts, n, chunk[int(nl[-1]) + 1:]
 
 
-def _split(chunk: bytes, strip_cr: bool = True):
+def _split(chunk: bytes, strip_cr: bool = True, sep: int = 10):
     from .. import native
 
-    res = native.split_chunk_native(chunk, strip_cr)
-    return res if res is not None else _split_np(chunk, strip_cr)
+    if sep == 10:
+        res = native.split_chunk_native(chunk, strip_cr)
+        if res is not None:
+            return res
+    return _split_np(chunk, strip_cr, sep)
 
 
 def _pack_dense(chunk: bytes, starts: np.ndarray, lens: np.ndarray,
@@ -93,12 +97,49 @@ def pack_lines_2d(lines: List[bytes], max_len: int):
     return _finish(chunk, starts, orig_lens, n, max_len)
 
 
-def pack_region_2d(region: bytes, max_len: int):
-    """Pack a region of complete newline-terminated lines straight into a
-    dense batch — the zero-per-line-Python fast path.  Same return
-    contract as pack_lines_2d."""
-    starts, lens, n, _carry = _split(region)
+def pack_region_2d(region: bytes, max_len: int, sep: int = 10,
+                   strip_cr: bool = True):
+    """Pack a region of complete separator-terminated messages straight
+    into a dense batch — the zero-per-line-Python fast path.  Same
+    return contract as pack_lines_2d."""
+    starts, lens, n, _carry = _split(region, strip_cr, sep)
     return _finish(region, starts, lens, n, max_len)
+
+
+def pack_spans_2d(chunks: List[bytes], span_sets: List[Tuple[np.ndarray, np.ndarray]],
+                  max_len: int):
+    """Pack pre-framed spans (syslen framing: the scanner already knows
+    every message's offset/length) from one or more chunk fragments.
+    Same return contract as pack_lines_2d."""
+    if len(chunks) == 1:
+        chunk = chunks[0]
+        starts, lens = span_sets[0]
+    else:
+        offs = np.cumsum([0] + [len(c) for c in chunks[:-1]])
+        chunk = b"".join(chunks)
+        starts = np.concatenate(
+            [s + np.int32(o) for (s, _), o in zip(span_sets, offs)]) \
+            if span_sets else np.zeros(0, np.int32)
+        lens = np.concatenate([l for _, l in span_sets]) \
+            if span_sets else np.zeros(0, np.int32)
+    return _finish(chunk, np.asarray(starts, dtype=np.int32),
+                   np.asarray(lens, dtype=np.int32), len(starts), max_len)
+
+
+def subset_packed(packed, idx: np.ndarray):
+    """Row-subset of a packed tuple (auto-detect partitioning): rows
+    re-bucketed to a power of two so kernel shapes stay cached."""
+    batch, lens, chunk, starts, orig_lens, _n = packed
+    m = int(idx.size)
+    rows = max(_MIN_ROWS, _next_pow2(max(m, 1)))
+    b2 = np.zeros((rows, batch.shape[1]), dtype=np.uint8)
+    l2 = np.zeros(rows, dtype=np.int32)
+    s2 = np.zeros(rows, dtype=np.int32)
+    if m:
+        b2[:m] = batch[idx]
+        l2[:m] = lens[idx]
+        s2[:m] = starts[idx]
+    return b2, l2, chunk, s2, np.asarray(orig_lens)[idx], m
 
 
 # kept for callers that want raw framing metadata (tests, future C++ IO)

@@ -45,6 +45,43 @@ int64_t fg_split_lines(const uint8_t* buf, int64_t size,
     return n;
 }
 
+// Scan a buffered stream region for RFC5425-style octet-counted frames:
+// ASCII decimal length, one space, then exactly that many bytes
+// (syslen_splitter.rs:10-69 semantics, batched).  Returns the number of
+// complete frames; *consumed receives the offset just past the last
+// complete frame (the caller keeps the remainder as carry); *err is set
+// to 1 when a malformed length prefix is found (non-digit before the
+// space) — framing past that point is undefined, matching the
+// reference's "Can't read message's length" abort.
+int64_t fg_split_syslen(const uint8_t* buf, int64_t size,
+                        int32_t* starts, int32_t* lens, int64_t cap,
+                        int64_t* consumed, int* err) {
+    int64_t n = 0;
+    int64_t pos = 0;
+    *err = 0;
+    while (pos < size && n < cap) {
+        int64_t p = pos;
+        int64_t val = 0;
+        int digits = 0;
+        while (p < size && buf[p] >= '0' && buf[p] <= '9') {
+            val = val * 10 + (buf[p] - '0');
+            if (val > INT32_MAX) { *err = 1; goto done; }
+            p++; digits++;
+        }
+        if (p >= size) break;              // prefix may continue next read
+        if (buf[p] != ' ' || digits == 0) { *err = 1; break; }
+        p++;
+        if (p + val > size) break;         // frame incomplete: carry
+        starts[n] = (int32_t)p;
+        lens[n] = (int32_t)val;
+        n++;
+        pos = p + val;
+    }
+done:
+    *consumed = pos;
+    return n;
+}
+
 // Pack n lines (described by starts/lens into chunk) into a dense
 // row-major [n_rows, max_len] uint8 batch, zero-padded; lens_out receives
 // the clipped lengths.  Rows beyond n are left untouched (caller zeroes).

@@ -1,0 +1,229 @@
+"""Differential tests for the zero-per-message chunked ingestion paths:
+nul-framed regions and syslen span scanning must flow through the
+BatchHandler identically to the scalar per-message path, and the auto
+format's vectorized classifier must agree with the per-line one."""
+
+import io
+import queue
+
+import numpy as np
+import pytest
+
+from flowgger_tpu.config import Config
+from flowgger_tpu.block import EncodedBlock
+from flowgger_tpu.decoders.rfc5424 import RFC5424Decoder
+from flowgger_tpu.encoders.gelf import GelfEncoder
+from flowgger_tpu.splitters import (
+    NulSplitter,
+    ScalarHandler,
+    SyslenSplitter,
+    _scan_syslen_region,
+)
+from flowgger_tpu.tpu.batch import BatchHandler
+
+from test_tpu_rfc5424 import CORPUS
+
+ORACLE = RFC5424Decoder()
+CFG = Config.from_string("")
+
+
+def collect(tx):
+    out = []
+    while not tx.empty():
+        item = tx.get_nowait()
+        if isinstance(item, EncodedBlock):
+            out.extend(item.iter_unframed())
+        else:
+            out.append(item)
+    return out
+
+
+def scalar_output(stream_bytes, splitter_cls):
+    tx = queue.Queue()
+    handler = ScalarHandler(tx, RFC5424Decoder(), GelfEncoder(CFG))
+    splitter_cls().run(io.BytesIO(stream_bytes), handler)
+    return collect(tx)
+
+
+def batch_output(stream_bytes, splitter_cls):
+    tx = queue.Queue()
+    handler = BatchHandler(tx, RFC5424Decoder(), GelfEncoder(CFG), CFG,
+                           fmt="rfc5424", start_timer=False, merger=None)
+    splitter_cls().run(io.BytesIO(stream_bytes), handler)
+    return collect(tx)
+
+
+def test_nul_chunked_matches_scalar(capsys):
+    msgs = [ln.encode("utf-8") for ln in CORPUS if "\x00" not in ln]
+    stream = b"\0".join(msgs) + b"\0" + b"\0"  # incl. an empty frame
+    want = scalar_output(stream, NulSplitter)
+    got = batch_output(stream, NulSplitter)
+    assert got == want
+
+
+def test_nul_embedded_newlines():
+    msgs = [b"<13>1 2015-08-05T15:53:45Z h a p m - line one\nline two",
+            b"<13>1 2015-08-05T15:53:45Z h a p m - ok"]
+    stream = b"\0".join(msgs) + b"\0"
+    want = scalar_output(stream, NulSplitter)
+    got = batch_output(stream, NulSplitter)
+    assert got == want and len(got) == 2
+
+
+def frame_syslen(msgs):
+    return b"".join(b"%d %s" % (len(m), m) for m in msgs)
+
+
+def test_syslen_chunked_matches_scalar():
+    msgs = [ln.encode("utf-8") for ln in CORPUS]
+    stream = frame_syslen(msgs)
+    want = scalar_output(stream, SyslenSplitter)
+    got = batch_output(stream, SyslenSplitter)
+    assert got == want
+
+
+def test_syslen_scan_region():
+    msgs = [b"hello", b"", b"x" * 1000]
+    stream = frame_syslen(msgs) + b"12 partial"
+    starts, lens, n, consumed, err = _scan_syslen_region(stream)
+    assert n == 3 and not err
+    got = [stream[s:s + l] for s, l in zip(starts.tolist(), lens.tolist())]
+    assert got == msgs
+    assert stream[consumed:] == b"12 partial"
+
+
+def test_syslen_scan_bad_prefix():
+    _, _, n, consumed, err = _scan_syslen_region(b"5 helloabc def")
+    assert n == 1 and err
+
+
+def test_syslen_bad_prefix_stops_stream(capsys):
+    stream = frame_syslen([b"<13>1 2015-08-05T15:53:45Z h a p m - ok"]) \
+        + b"junk prefix"
+    want = scalar_output(stream, SyslenSplitter)
+    err_scalar = capsys.readouterr().err
+    got = batch_output(stream, SyslenSplitter)
+    err_batch = capsys.readouterr().err
+    assert got == want and len(got) == 1
+    assert "Can't read message's length" in err_scalar
+    assert "Can't read message's length" in err_batch
+
+
+def test_syslen_split_reads():
+    """Frames split across tiny reads must reassemble identically."""
+
+    class DribbleStream:
+        def __init__(self, data, step=7):
+            self.data = data
+            self.pos = 0
+            self.step = step
+
+        def read(self, n):
+            chunk = self.data[self.pos:self.pos + self.step]
+            self.pos += self.step
+            return chunk
+
+    msgs = [ln.encode("utf-8") for ln in CORPUS[:10]]
+    stream = frame_syslen(msgs)
+    tx = queue.Queue()
+    handler = BatchHandler(tx, RFC5424Decoder(), GelfEncoder(CFG), CFG,
+                           fmt="rfc5424", start_timer=False, merger=None)
+    SyslenSplitter().run(DribbleStream(stream), handler)
+    got = collect(tx)
+    want = scalar_output(stream, SyslenSplitter)
+    assert got == want
+
+
+@pytest.mark.parametrize("tail,expect", [
+    (b"", "Closing idle connection"),
+    (b"123", "Closing idle connection"),       # prefix phase: idle close
+    (b"123 ab", "failed to fill whole buffer"),  # body phase: short read
+])
+def test_syslen_timeout_stderr_parity(tail, expect, capsys):
+    """Idle timeouts must print exactly what the scalar loop prints for
+    the same carry state — one line, phase-dependent."""
+
+    class TimeoutStream:
+        def __init__(self, data):
+            self.data = data
+
+        def read(self, n):
+            if self.data:
+                d, self.data = self.data, b""
+                return d
+            raise TimeoutError
+
+    frame = frame_syslen([b"<13>1 2015-08-05T15:53:45Z h a p m - ok"])
+    for handler_kind in ("scalar", "batch"):
+        tx = queue.Queue()
+        if handler_kind == "scalar":
+            h = ScalarHandler(tx, RFC5424Decoder(), GelfEncoder(CFG))
+        else:
+            h = BatchHandler(tx, RFC5424Decoder(), GelfEncoder(CFG), CFG,
+                             fmt="rfc5424", start_timer=False, merger=None)
+        SyslenSplitter().run(TimeoutStream(frame + tail), h)
+        err = capsys.readouterr().err
+        assert expect in err, (handler_kind, err)
+        assert len(collect(tx)) == 1
+
+
+def test_auto_classifier_vectorized_matches_python():
+    from flowgger_tpu.tpu import pack
+    from flowgger_tpu.tpu.autodetect import classify, classify_packed
+
+    lines = [ln.encode("utf-8") for ln in CORPUS]
+    lines += [
+        b"{\"version\":\"1.1\",\"host\":\"h\",\"short_message\":\"m\"}",
+        b"host:web1\ttime:2015-08-05T15:53:45Z\tmessage:hi",
+        b"\xef\xbb\xbf<13>1 2015-08-05T15:53:45Z h a p m - bom",
+        b"\xef\xbb\xbf{\"host\":\"h\"}",
+        b"<999>1 x",
+        b"<13>notpri",
+        b"plain text line",
+        b"col:on only",
+        b"tab\there only",
+    ]
+    packed = pack.pack_lines_2d(lines, 256)
+    got = classify_packed(packed)
+    want = [classify(ln) for ln in lines]
+    assert got.tolist() == want
+
+
+def test_auto_chunked_region_matches_per_line():
+    """auto_tpu through ingest_chunk must equal the scalar handlers."""
+    from flowgger_tpu.decoders.gelf import GelfDecoder
+    from flowgger_tpu.decoders.ltsv import LTSVDecoder
+    from flowgger_tpu.decoders.rfc3164 import RFC3164Decoder
+
+    lines = [
+        b"<13>1 2015-08-05T15:53:45Z h a p m - rfc5424 here",
+        b"{\"version\":\"1.1\",\"host\":\"h\",\"short_message\":\"m\","
+        b"\"timestamp\":1438790025.0}",
+        b"host:web1\ttime:2015-08-05T15:53:45Z\tmessage:hi",
+        b"<34>Aug  5 15:53:45 host app: legacy message",
+        b"not really anything",
+    ]
+    region = b"".join(ln + b"\n" for ln in lines)
+    tx = queue.Queue()
+    handler = BatchHandler(tx, RFC5424Decoder(CFG), GelfEncoder(CFG), CFG,
+                           fmt="auto", start_timer=False)
+    handler.ingest_chunk(region)
+    handler.flush()
+    got = collect(tx)
+
+    # expected: route each line to its scalar decoder by classify()
+    from flowgger_tpu.tpu.autodetect import (
+        F_GELF, F_LTSV, F_RFC3164, F_RFC5424, classify,
+    )
+
+    decoders = {F_RFC5424: RFC5424Decoder(CFG), F_RFC3164: RFC3164Decoder(CFG),
+                F_LTSV: LTSVDecoder(CFG), F_GELF: GelfDecoder(CFG)}
+    enc = GelfEncoder(CFG)
+    want = []
+    for ln in lines:
+        cls = classify(ln)
+        try:
+            want.append(enc.encode(decoders[cls].decode(ln.decode())))
+        except Exception:
+            pass
+    assert got == want

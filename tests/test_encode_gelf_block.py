@@ -161,6 +161,39 @@ def test_numpy_fallback_engine_matches(merger, monkeypatch):
     assert got == want
 
 
+@pytest.mark.parametrize("merger", [None, LineMerger(), NulMerger(),
+                                    SyslenMerger()],
+                         ids=["noop", "line", "nul", "syslen"])
+def test_passthrough_block_matches_scalar(merger):
+    from flowgger_tpu.encoders.passthrough import PassthroughEncoder
+
+    enc = PassthroughEncoder(Config.from_string(""))
+    lines = [ln.encode("utf-8") for ln in CORPUS]
+    want = []
+    for ln in lines:
+        try:
+            line = ln.decode("utf-8")
+            rec = ORACLE.decode(line)
+            payload = enc.encode(rec)
+        except Exception:
+            continue
+        want.append(merger.frame(payload) if merger is not None else payload)
+    tx = queue.Queue()
+    h = BatchHandler(tx, ORACLE, enc, Config.from_string(""),
+                     fmt="rfc5424", start_timer=False, merger=merger)
+    for ln in lines:
+        h.handle_bytes(ln)
+    h.flush()
+    got = []
+    while not tx.empty():
+        item = tx.get_nowait()
+        if isinstance(item, EncodedBlock):
+            got.extend(item.iter_framed())
+        else:
+            got.append(merger.frame(item) if merger is not None else item)
+    assert got == want
+
+
 def test_fuzz_block_vs_scalar():
     """Random mutations of valid lines through both paths."""
     import random
