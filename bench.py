@@ -140,15 +140,17 @@ def bench_e2e(lines, jax, jnp, extra):
 
 
 def main():
-    cpu_fallback = not _tpu_responsive()
-    if cpu_fallback:
-        print(
-            "WARNING: TPU backend unreachable (relay wedged?); "
-            "benchmarking on the CPU backend instead",
-            file=sys.stderr,
-        )
-        import os
+    import os
 
+    smoke = bool(os.environ.get("FLOWGGER_BENCH_SMOKE"))
+    cpu_fallback = True if smoke else not _tpu_responsive()
+    if cpu_fallback:
+        if not smoke:
+            print(
+                "WARNING: TPU backend unreachable (relay wedged?); "
+                "benchmarking on the CPU backend instead",
+                file=sys.stderr,
+            )
         os.environ["JAX_PLATFORMS"] = "cpu"
 
     import jax
@@ -163,7 +165,10 @@ def main():
     print(f"bench device: {dev}", file=sys.stderr)
 
     global BATCH_LINES, CHAIN, TRIALS, E2E_BATCH
-    if cpu_fallback:
+    if smoke:
+        # CI smoke: tiny shapes, just prove the full path runs
+        BATCH_LINES, CHAIN, TRIALS, E2E_BATCH = 8_192, 2, 1, 8_192
+    elif cpu_fallback:
         # keep the degraded run bounded: smaller batch, shorter chain
         BATCH_LINES, CHAIN, TRIALS, E2E_BATCH = 262_144, 2, 1, 131_072
 

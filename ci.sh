@@ -1,0 +1,28 @@
+#!/usr/bin/env bash
+# CI entry point: one command a reviewer can run.  Mirrors the
+# reference's workflow scope (fmt/test matrix, .github/workflows/ci.yml
+# there) with this repo's equivalents: the full pytest suite (hermetic,
+# virtual 8-device CPU mesh), the native tier built and self-checked
+# under ASan and TSan, a bounded CPU bench smoke, and config lint over
+# the in-repo configs.
+set -euo pipefail
+cd "$(dirname "$0")"
+
+echo "== python test suite (virtual 8-device CPU mesh) =="
+python -m pytest tests/ -q
+
+echo "== native build =="
+make -C native -s
+
+echo "== native sanitizer self-checks =="
+make -C native -s asan-check
+make -C native -s tsan-check
+
+echo "== config lint =="
+python -m flowgger_tpu --check flowgger.toml
+python -m flowgger_tpu --check examples/multihost-dp.toml
+
+echo "== bench smoke (CPU backend, bounded) =="
+JAX_PLATFORMS=cpu FLOWGGER_BENCH_SMOKE=1 timeout 600 python bench.py
+
+echo "CI OK"

@@ -20,8 +20,15 @@ def main(argv=None):
     )
     parser.add_argument("config_file", nargs="?", default=DEFAULT_CONFIG_FILE,
                         help="Configuration file (default: flowgger.toml)")
+    parser.add_argument("--check", action="store_true",
+                        help="Lint the config against the known key "
+                             "namespace and exit")
     parser.add_argument("--version", action="version", version=__version__)
     args = parser.parse_args(argv)
+    if args.check:
+        from .lint import check_file
+
+        raise SystemExit(check_file(args.config_file))
     print(f"Flowgger-TPU {__version__}")
     start(args.config_file)
 

@@ -3,10 +3,12 @@
 Parity model: /root/reference/src/flowgger/input/file/{mod,discovery,worker}.rs.
 ``input.src`` is a glob; matching files that exist at startup are tailed
 from EOF (worker.rs:89-91), files appearing later are read from the
-start.  The reference uses inotify; this implementation polls (stdlib
-has no inotify binding) — discovery rescans the glob and workers poll
-their file for growth, both on a short interval.  Truncation (size
-shrinks) rewinds to the new end, matching follow-reader behavior.
+start.  Discovery and tailing are inotify-driven (utils/inotify.py, the
+equivalent of the reference's notify-crate watchers: parent directories
+watched for Create/MovedTo — discovery.rs:44-87 — and each tailed file
+for Modify — worker.rs:37-78), with a polling fallback on platforms
+without inotify.  Truncation (size shrinks) rewinds to the new end,
+matching follow-reader behavior.
 """
 
 from __future__ import annotations
@@ -19,16 +21,20 @@ import time
 
 from . import Input
 from ..config import Config, ConfigError
+from ..utils import inotify as _ino
 
-POLL_INTERVAL_S = 0.05
-DISCOVERY_INTERVAL_S = 0.5
+POLL_INTERVAL_S = 0.05        # fallback tail poll (no inotify)
+DISCOVERY_INTERVAL_S = 0.5    # fallback discovery poll
+STOP_CHECK_S = 0.5            # bounded event waits keep stop responsive
 
 
 class FileWorker:
-    def __init__(self, path: str, handler, from_tail: bool):
+    def __init__(self, path: str, handler, from_tail: bool,
+                 use_inotify: bool):
         self.path = path
         self.handler = handler
         self.from_tail = from_tail
+        self.use_inotify = use_inotify
         self.stop = threading.Event()
 
     def run(self):
@@ -42,21 +48,41 @@ class FileWorker:
         from ..splitters import LineAssembler
 
         asm = LineAssembler(self.handler)
-        while not self.stop.is_set():
-            chunk = fd.read(1 << 16)
-            if chunk:
-                asm.push(chunk)
-                continue
-            # no growth: check for truncation/deletion
+        watcher = None
+        if self.use_inotify:
             try:
-                size = os.path.getsize(self.path)
+                watcher = _ino.Inotify()
+                watcher.add_watch(
+                    self.path,
+                    _ino.IN_MODIFY | _ino.IN_DELETE_SELF | _ino.IN_MOVE_SELF
+                    | _ino.IN_ATTRIB | _ino.IN_CLOSE_WRITE)
             except OSError:
-                return  # file removed
-            if size < fd.tell():
-                fd.seek(0, os.SEEK_END)
-            if hasattr(self.handler, "flush"):
-                self.handler.flush()
-            time.sleep(POLL_INTERVAL_S)
+                watcher = None
+        try:
+            while not self.stop.is_set():
+                chunk = fd.read(1 << 16)
+                if chunk:
+                    asm.push(chunk)
+                    continue
+                # drained: check for truncation/deletion
+                try:
+                    size = os.path.getsize(self.path)
+                except OSError:
+                    return  # file removed
+                if size < fd.tell():
+                    fd.seek(0, os.SEEK_END)
+                if hasattr(self.handler, "flush"):
+                    self.handler.flush()
+                if watcher is not None:
+                    events = watcher.read(STOP_CHECK_S)
+                    if any(m & (_ino.IN_DELETE_SELF | _ino.IN_MOVE_SELF)
+                           for _, m, _, _ in events):
+                        return
+                else:
+                    time.sleep(POLL_INTERVAL_S)
+        finally:
+            if watcher is not None:
+                watcher.close()
 
 
 class FileInput(Input):
@@ -67,27 +93,118 @@ class FileInput(Input):
         if not isinstance(src, str):
             raise ConfigError("input.src must be a string")
         self.src = src
+        self.use_inotify = _ino.available()
 
     def accept(self, handler_factory) -> None:
         workers = {}
 
         def start_worker(path: str, from_tail: bool):
-            worker = FileWorker(path, handler_factory(), from_tail)
+            worker = FileWorker(path, handler_factory(), from_tail,
+                                self.use_inotify)
             t = threading.Thread(target=worker.run, daemon=True,
                                  name=f"file-worker-{path}")
             t.start()
             workers[path] = (worker, t)
 
+        def reap() -> bool:
+            # drop every finished worker: its tail is over whether the
+            # file vanished or was atomically replaced (logrotate's
+            # rename+create), so a recreated path can start a fresh
+            # worker reading from the start
+            reaped = False
+            for path in list(workers):
+                _worker, t = workers[path]
+                if not t.is_alive():
+                    del workers[path]
+                    reaped = True
+            return reaped
+
         for path in _glob.glob(self.src):
             if os.path.isfile(path):
                 start_worker(path, from_tail=True)
+
+        if self.use_inotify:
+            self._discover_inotify(start_worker, workers, reap)
+        else:
+            while True:
+                time.sleep(DISCOVERY_INTERVAL_S)
+                for path in _glob.glob(self.src):
+                    if os.path.isfile(path) and path not in workers:
+                        start_worker(path, from_tail=False)
+                reap()
+
+    def _discover_inotify(self, start_worker, workers, reap) -> None:
+        """Event-driven discovery: watch every directory the glob's
+        parent pattern matches for Create/MovedTo (discovery.rs:44-87);
+        new directories matching the parent pattern are watched as they
+        appear, new files matching the glob start workers immediately."""
+        ino = _ino.Inotify()
+        dir_mask = (_ino.IN_CREATE | _ino.IN_MOVED_TO | _ino.IN_CLOSE_WRITE)
+        watched = {}  # wd -> dir path
+
+        # ancestor pattern chain: every wildcarded prefix of the glob's
+        # directory part plus the first concrete ancestor, so creation
+        # of an intermediate directory (e.g. the `*` in /logs/*/app.log)
+        # is itself observable before any matching file exists
+        dir_patterns = []
+        p = os.path.dirname(self.src) or "."
         while True:
-            time.sleep(DISCOVERY_INTERVAL_S)
+            dir_patterns.append(p)
+            if not _glob.has_magic(p):
+                break
+            parent = os.path.dirname(p)
+            if not parent or parent == p:
+                break
+            p = parent
+
+        def watch_dirs():
+            for pat in dir_patterns:
+                for d in _glob.glob(pat):
+                    if os.path.isdir(d) and d not in watched.values():
+                        try:
+                            wd = ino.add_watch(d, dir_mask)
+                            watched[wd] = d
+                        except OSError:
+                            pass
+
+        def rescan_files():
+            # race closure: files that appeared before a watch went live
             for path in _glob.glob(self.src):
                 if os.path.isfile(path) and path not in workers:
                     start_worker(path, from_tail=False)
-            # reap workers whose files vanished so they can be re-tailed
-            for path in list(workers):
-                worker, t = workers[path]
-                if not t.is_alive() and not os.path.exists(path):
-                    del workers[path]
+
+        watch_dirs()
+        rescan_files()
+
+        while True:
+            events = ino.read(STOP_CHECK_S)
+            for wd, mask, _cookie, name in events:
+                if mask & _ino.IN_IGNORED:
+                    # the kernel dropped this watch (directory deleted
+                    # or moved): forget it so a recreated directory gets
+                    # re-watched, and rescan for anything created in the
+                    # unwatched window
+                    watched.pop(wd, None)
+                    watch_dirs()
+                    rescan_files()
+                    continue
+                base = watched.get(wd)
+                if base is None or not name:
+                    continue
+                path = os.path.join(base, name)
+                if mask & _ino.IN_ISDIR:
+                    # a new directory may extend the watchable chain and
+                    # may already contain matching files
+                    watch_dirs()
+                    rescan_files()
+                    continue
+                if (path not in workers and os.path.isfile(path)
+                        and path in _glob.glob(self.src)):
+                    # glob (not fnmatch) so event-driven discovery keeps
+                    # glob's hidden-file semantics, same as the startup
+                    # scan and the poll fallback
+                    start_worker(path, from_tail=False)
+            if reap():
+                # a finished worker may have been replaced by a new file
+                # whose create event raced the old entry: rescan now
+                rescan_files()

@@ -1,0 +1,93 @@
+"""Config lint (``--check``): validate a TOML config against the known
+key namespace and report likely typos.
+
+The reference silently ignores unknown keys (config.rs lookup simply
+returns None), and this pipeline matches that at runtime — the lint
+flag is the cheap insurance layer on top: it walks every leaf key in
+the file, flags anything outside the known namespace, and suggests the
+nearest known key.  Table-valued free-form namespaces
+(ltsv_schema/ltsv_suffixes/*_extra) accept arbitrary sub-keys.
+"""
+
+from __future__ import annotations
+
+import difflib
+from typing import List
+
+from .config import Config
+
+KNOWN_KEYS = {
+    # [input] — mod.rs:101-109 + per-input config_parse sites
+    "input.type", "input.format", "input.framing", "input.framed",
+    "input.listen", "input.timeout", "input.queuesize", "input.src",
+    "input.tcp_threads", "input.tls_threads",
+    "input.tls_cert", "input.tls_key", "input.tls_ciphers",
+    "input.tls_compatibility_level", "input.tls_compression",
+    "input.tls_verify_peer", "input.tls_ca_file",
+    "input.redis_connect", "input.redis_queue_key", "input.redis_threads",
+    # TPU extensions
+    "input.tpu_batch_size", "input.tpu_flush_ms", "input.tpu_max_line_len",
+    "input.tpu_coordinator", "input.tpu_num_processes",
+    "input.tpu_process_id",
+    # [output] — per-output config sites
+    "output.type", "output.format", "output.framing", "output.connect",
+    "output.timeout", "output.file_path", "output.file_buffer_size",
+    "output.file_rotation_size", "output.file_rotation_time",
+    "output.file_rotation_maxfiles", "output.file_rotation_timeformat",
+    "output.kafka_brokers", "output.kafka_topic", "output.kafka_acks",
+    "output.kafka_timeout", "output.kafka_threads", "output.kafka_coalesce",
+    "output.kafka_compression",
+    "output.tls_cert", "output.tls_key", "output.tls_ciphers",
+    "output.tls_compatibility_level", "output.tls_compression",
+    "output.tls_verify_peer", "output.tls_ca_file", "output.tls_threads",
+    "output.tls_async", "output.tls_recovery_delay_init",
+    "output.tls_recovery_delay_max", "output.tls_recovery_probe_time",
+    "output.syslog_prepend_timestamp",
+    # [metrics] — observability extension
+    "metrics.interval", "metrics.path", "metrics.jsonl",
+    "metrics.jax_profile_dir",
+}
+
+# tables whose sub-keys are user-defined
+FREE_TABLES = {
+    "input.ltsv_schema", "input.ltsv_suffixes",
+    "output.gelf_extra", "output.ltsv_extra", "output.capnp_extra",
+}
+
+
+def _walk(table, prefix: str, out: List[str]):
+    for key, value in table.items():
+        path = f"{prefix}.{key}" if prefix else key
+        if path in FREE_TABLES:
+            continue
+        if isinstance(value, dict):
+            _walk(value, path, out)
+        else:
+            out.append(path)
+
+
+def lint_config(config: Config) -> List[str]:
+    """Warnings for unknown keys, with nearest-known suggestions."""
+    leaves: List[str] = []
+    _walk(config._table, "", leaves)
+    warnings = []
+    for path in leaves:
+        if path in KNOWN_KEYS:
+            continue
+        near = difflib.get_close_matches(path, KNOWN_KEYS, n=1, cutoff=0.6)
+        hint = f" (did you mean {near[0]!r}?)" if near else ""
+        warnings.append(f"unknown config key {path!r}{hint}")
+    return warnings
+
+
+def check_file(config_file: str) -> int:
+    """CLI ``--check`` entry: parse + lint; returns the exit code."""
+    config = Config.from_path(config_file)
+    warnings = lint_config(config)
+    for w in warnings:
+        print(f"warning: {w}")
+    if warnings:
+        print(f"{config_file}: {len(warnings)} warning(s)")
+        return 1
+    print(f"{config_file}: OK")
+    return 0

@@ -64,6 +64,20 @@ def _load():
                 ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
                 ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
                 ctypes.c_int]
+        if hasattr(lib, "fg_crc32c"):
+            lib.fg_crc32c.restype = ctypes.c_uint32
+            lib.fg_crc32c.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                      ctypes.c_uint32]
+        if hasattr(lib, "fg_snappy_compress"):
+            lib.fg_snappy_max_compressed.restype = ctypes.c_int64
+            lib.fg_snappy_max_compressed.argtypes = [ctypes.c_int64]
+            lib.fg_snappy_compress.restype = ctypes.c_int64
+            lib.fg_snappy_compress.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p]
+            lib.fg_snappy_decompress.restype = ctypes.c_int64
+            lib.fg_snappy_decompress.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+                ctypes.c_int64]
         if hasattr(lib, "fg_gelf_lens"):
             common = [
                 ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
@@ -132,6 +146,34 @@ def pack_chunk_native(chunk: bytes, starts: np.ndarray, lens: np.ndarray,
             max_len, batch.ctypes.data, lens_out.ctypes.data,
             _DEFAULT_THREADS)
     return batch, lens_out
+
+
+_CRC32C_TABLE = None
+
+
+def crc32c(data: bytes, init: int = 0) -> int:
+    """CRC32C (Castagnoli), as the Kafka record-batch v2 format requires;
+    native table-driven implementation with a Python fallback."""
+    lib = _load()
+    if lib is not None and hasattr(lib, "fg_crc32c"):
+        buf = np.frombuffer(data, dtype=np.uint8)
+        return int(lib.fg_crc32c(buf.ctypes.data if len(data) else None,
+                                 len(data), init))
+    global _CRC32C_TABLE
+    if _CRC32C_TABLE is None:
+        poly = 0x82F63B78
+        table = []
+        for i in range(256):
+            c = i
+            for _ in range(8):
+                c = (c >> 1) ^ poly if c & 1 else c >> 1
+            table.append(c)
+        _CRC32C_TABLE = table
+    c = ~init & 0xFFFFFFFF
+    t = _CRC32C_TABLE
+    for b in data:
+        c = (c >> 8) ^ t[(c ^ b) & 0xFF]
+    return ~c & 0xFFFFFFFF
 
 
 def split_syslen_native(chunk: bytes

@@ -4,7 +4,8 @@ Parity model: /root/reference/src/flowgger/output/kafka_output.rs:13-212.
 ``output.kafka_brokers`` (required list), ``kafka_topic`` (required),
 ``kafka_acks`` -1/0/1, ``kafka_timeout`` ms, ``kafka_threads``,
 ``kafka_coalesce`` (buffer N messages then send_all), ``kafka_compression``
-none/gzip (snappy is rejected here — no snappy codec without deps).
+none/gzip/snappy (snappy via the from-scratch codec in utils/snappy.py;
+requires a broker speaking record batches v2, negotiated automatically).
 An unresponsive broker terminates the process (exit 1), matching the
 reference's supervisor-restart contract; output framing is ignored with
 a warning.  Transport: utils/kafka_wire.py, a from-scratch minimal
@@ -62,10 +63,6 @@ class KafkaOutput(Output):
             KAFKA_DEFAULT_COMPRESSION).lower()
         if compression not in ("none", "gzip", "snappy"):
             raise ConfigError("Unsupported compression method")
-        if compression == "snappy":
-            raise ConfigError(
-                "Unsupported compression method: snappy needs an external codec; "
-                "use gzip or none")
         self.compression = compression
         self.exit_on_failure = True  # tests disable to keep pytest alive
 

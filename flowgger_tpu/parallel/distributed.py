@@ -1,0 +1,75 @@
+"""Multi-host deployment: jax.distributed initialization driven by the
+TOML config, and the global decode mesh that spans all hosts.
+
+Log decode is embarrassingly parallel over records (SURVEY.md §2.8: the
+reference has no cross-record communication to preserve), so the
+multi-host story is data parallelism over DCN: every host runs its own
+transport/ingest stack, hosts join one JAX process group, and the decode
+mesh's ``dp`` axis spans all chips — each host feeds its addressable
+shard, no collectives cross hosts on the decode path.  ICI still
+carries the (dp, sp) sharding inside each host.
+
+Config keys (all under ``[input]``, alongside the other tpu_* keys):
+
+    tpu_coordinator = "10.0.0.1:8476"   # coordinator address
+    tpu_num_processes = 4               # total hosts
+    tpu_process_id = 0                  # this host's rank
+
+See ``examples/multihost-dp.toml`` for a complete dp-over-DCN config.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..config import Config, ConfigError
+
+
+def distributed_spec(config: Config):
+    """(coordinator, num_processes, process_id) or None when the config
+    doesn't request multi-host operation.  Validation panics with the
+    key name, matching the reference's config error style."""
+    coord = config.lookup_str(
+        "input.tpu_coordinator", "input.tpu_coordinator must be a string")
+    if coord is None:
+        return None
+    nproc = config.lookup_int(
+        "input.tpu_num_processes",
+        "input.tpu_num_processes must be an integer")
+    pid = config.lookup_int(
+        "input.tpu_process_id", "input.tpu_process_id must be an integer")
+    if nproc is None or pid is None:
+        raise ConfigError(
+            "input.tpu_coordinator requires tpu_num_processes and "
+            "tpu_process_id")
+    if not (0 <= pid < nproc):
+        raise ConfigError(
+            "input.tpu_process_id must be in [0, tpu_num_processes)")
+    return coord, int(nproc), int(pid)
+
+
+def init_distributed(config: Config) -> bool:
+    """Join the JAX process group when the config asks for it.  Returns
+    True when distributed mode was initialized.  Safe to call once at
+    pipeline construction; all hosts must call it before any device op.
+    """
+    spec = distributed_spec(config)
+    if spec is None:
+        return False
+    coord, nproc, pid = spec
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coord, num_processes=nproc, process_id=pid)
+    return True
+
+
+def make_global_decode_mesh(sp: int = 1):
+    """Mesh over every device in the process group (all hosts): rows
+    over ``dp`` (spanning DCN — embarrassingly parallel, no cross-host
+    collectives on the decode path), bytes over ``sp`` (inside a host).
+    Call after ``init_distributed``."""
+    from .mesh import make_decode_mesh
+    import jax
+
+    return make_decode_mesh(jax.devices(), sp=sp)

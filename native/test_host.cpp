@@ -13,8 +13,16 @@
 extern "C" {
 int64_t fg_split_lines(const uint8_t*, int64_t, int32_t*, int32_t*, int64_t,
                        int, int64_t*);
+int64_t fg_split_syslen(const uint8_t*, int64_t, int32_t*, int32_t*, int64_t,
+                        int64_t*, int*);
 void fg_pack_lines(const uint8_t*, int64_t, const int32_t*, const int32_t*,
                    int64_t, int32_t, uint8_t*, int32_t*, int);
+void fg_concat_segments(const uint8_t*, const int64_t*, const int64_t*,
+                        const int64_t*, int64_t, uint8_t*, int);
+uint32_t fg_crc32c(const uint8_t*, int64_t, uint32_t);
+int64_t fg_snappy_max_compressed(int64_t);
+int64_t fg_snappy_compress(const uint8_t*, int64_t, uint8_t*);
+int64_t fg_snappy_decompress(const uint8_t*, int64_t, uint8_t*, int64_t);
 }
 
 int main() {
@@ -51,6 +59,62 @@ int main() {
         for (int j = lens_out[i]; j < max_len; j++)
             assert(out[(size_t)i * max_len + j] == 0);
     }
+    // threaded segment concat: interleave two sources of the chunk
+    {
+        int64_t nseg = 2 * n;
+        std::vector<int64_t> seg_src(nseg), seg_len(nseg), dst_off(nseg + 1);
+        int64_t pos = 0;
+        for (int64_t i = 0; i < n; i++) {
+            seg_src[2 * i] = starts[i];
+            seg_len[2 * i] = lens[i];
+            seg_src[2 * i + 1] = starts[0];
+            seg_len[2 * i + 1] = 4;  // "line"
+        }
+        for (int64_t i = 0; i < nseg; i++) {
+            dst_off[i] = pos;
+            pos += seg_len[i];
+        }
+        dst_off[nseg] = pos;
+        std::vector<uint8_t> cat(pos);
+        fg_concat_segments((const uint8_t*)chunk.data(), seg_src.data(),
+                           seg_len.data(), dst_off.data(), nseg, cat.data(), 8);
+        assert(memcmp(cat.data() + dst_off[1], "line", 4) == 0);
+        assert(memcmp(cat.data(), chunk.data(), (size_t)lens[0]) == 0);
+    }
+
+    // syslen scanner
+    {
+        std::string s = "5 hello0 12 hello world!9 partial";
+        std::vector<int32_t> st(8), ln(8);
+        int64_t consumed = 0;
+        int err = 0;
+        int64_t m = fg_split_syslen((const uint8_t*)s.data(), (int64_t)s.size(),
+                                    st.data(), ln.data(), 8, &consumed, &err);
+        assert(m == 3 && !err);
+        assert(std::string(s, st[0], ln[0]) == "hello");
+        assert(std::string(s, st[1], ln[1]) == "");
+        assert(std::string(s, st[2], ln[2]) == "hello world!");
+        assert(std::string(s, (size_t)consumed) == "9 partial");
+    }
+
+    // crc32c vector + snappy round-trip (threads not involved, but the
+    // sanitizers watch the buffer math)
+    {
+        assert(fg_crc32c((const uint8_t*)"123456789", 9, 0) == 0xE3069283u);
+        std::string data;
+        for (int i = 0; i < 5000; i++)
+            data += "repetitive payload chunk " + std::to_string(i % 17);
+        std::vector<uint8_t> comp(fg_snappy_max_compressed((int64_t)data.size()));
+        int64_t clen = fg_snappy_compress((const uint8_t*)data.data(),
+                                          (int64_t)data.size(), comp.data());
+        assert(clen > 0 && clen < (int64_t)data.size());
+        std::vector<uint8_t> round(data.size());
+        int64_t dlen = fg_snappy_decompress(comp.data(), clen, round.data(),
+                                            (int64_t)round.size());
+        assert(dlen == (int64_t)data.size());
+        assert(memcmp(round.data(), data.data(), data.size()) == 0);
+    }
+
     printf("native self-test ok: %lld lines\n", (long long)n);
     return 0;
 }

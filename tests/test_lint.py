@@ -1,0 +1,58 @@
+"""Config lint (--check) tests: unknown keys flagged with suggestions,
+free-form tables accepted, in-repo configs clean."""
+
+import os
+import subprocess
+import sys
+
+from flowgger_tpu.config import Config
+from flowgger_tpu.lint import lint_config
+
+REPO = os.path.join(os.path.dirname(__file__), "..")
+
+
+def test_known_keys_clean():
+    cfg = Config.from_string(
+        '[input]\ntype = "stdin"\nformat = "rfc5424_tpu"\n'
+        "tpu_batch_size = 1024\n"
+        '[output]\ntype = "file"\nfile_path = "/tmp/x"\n')
+    assert lint_config(cfg) == []
+
+
+def test_typo_suggestion():
+    cfg = Config.from_string('[input]\nfromat = "rfc5424"\n')
+    warns = lint_config(cfg)
+    assert len(warns) == 1
+    assert "input.fromat" in warns[0]
+    assert "input.format" in warns[0]
+
+
+def test_free_tables_accepted():
+    cfg = Config.from_string(
+        "[input.ltsv_schema]\ncounter = \"u64\"\n"
+        "[output.gelf_extra]\nanything_here = \"v\"\n")
+    assert lint_config(cfg) == []
+
+
+def test_repo_configs_are_clean():
+    for rel in ("flowgger.toml", os.path.join("examples", "multihost-dp.toml")):
+        cfg = Config.from_path(os.path.join(REPO, rel))
+        assert lint_config(cfg) == [], rel
+
+
+def test_cli_check_flag():
+    r = subprocess.run(
+        [sys.executable, "-m", "flowgger_tpu", "--check", "flowgger.toml"],
+        cwd=REPO, capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "OK" in r.stdout
+
+
+def test_cli_check_flag_bad(tmp_path):
+    bad = tmp_path / "bad.toml"
+    bad.write_text('[output]\nkafka_compresion = "gzip"\n')
+    r = subprocess.run(
+        [sys.executable, "-m", "flowgger_tpu", "--check", str(bad)],
+        cwd=REPO, capture_output=True, text=True, timeout=60)
+    assert r.returncode == 1
+    assert "kafka_compression" in r.stdout  # the suggestion

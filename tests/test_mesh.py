@@ -1,0 +1,94 @@
+"""Mesh-path tests on the virtual 8-device CPU mesh (conftest forces
+XLA_FLAGS=--xla_force_host_platform_device_count=8): sharded decode must
+be bitwise-identical to single-device decode for every output channel,
+for both dp-only and dp x sp layouts.  Plus the multi-host config
+helpers (jax.distributed arg assembly, validated without a real
+process group)."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from flowgger_tpu.config import Config, ConfigError
+from flowgger_tpu.parallel import mesh as mesh_mod
+from flowgger_tpu.parallel.distributed import distributed_spec, init_distributed
+from flowgger_tpu.tpu import pack, rfc5424
+
+from test_tpu_rfc5424 import CORPUS
+
+
+def _packed_corpus():
+    lines = [ln.encode("utf-8") for ln in CORPUS] * 8
+    return pack.pack_lines_2d(lines, 512)
+
+
+@pytest.mark.parametrize("sp", [1, 2], ids=["dp8", "dp4xsp2"])
+def test_sharded_decode_bitwise_equals_single_device(sp):
+    import jax.numpy as jnp
+
+    batch, lens, chunk, starts, orig_lens, n = _packed_corpus()
+    devices = jax.devices()
+    assert len(devices) == 8, "conftest must provide the 8-device CPU mesh"
+    m = mesh_mod.make_decode_mesh(devices, sp=sp)
+    assert m.axis_names == ("dp", "sp")
+
+    sharded = mesh_mod.decode_sharded(m, jnp.asarray(batch), jnp.asarray(lens))
+    single = rfc5424.decode_rfc5424_jit(jnp.asarray(batch), jnp.asarray(lens))
+    assert set(sharded.keys()) == set(single.keys())
+    for k in single:
+        a = np.asarray(single[k])
+        b = np.asarray(sharded[k])
+        assert a.shape == b.shape, k
+        assert (a == b).all(), f"channel {k} diverged under sharding"
+
+
+def test_mesh_rejects_bad_sp():
+    with pytest.raises(ValueError):
+        mesh_mod.make_decode_mesh(jax.devices(), sp=3)
+
+
+def test_distributed_spec_absent():
+    assert distributed_spec(Config.from_string("")) is None
+    assert init_distributed(Config.from_string("")) is False
+
+
+def test_distributed_spec_parses():
+    cfg = Config.from_string(
+        '[input]\ntpu_coordinator = "10.0.0.1:8476"\n'
+        "tpu_num_processes = 4\ntpu_process_id = 2\n")
+    assert distributed_spec(cfg) == ("10.0.0.1:8476", 4, 2)
+
+
+def test_distributed_spec_validation():
+    with pytest.raises(ConfigError):
+        distributed_spec(Config.from_string(
+            '[input]\ntpu_coordinator = "x:1"\n'))
+    with pytest.raises(ConfigError):
+        distributed_spec(Config.from_string(
+            '[input]\ntpu_coordinator = "x:1"\n'
+            "tpu_num_processes = 2\ntpu_process_id = 5\n"))
+
+
+def test_init_distributed_assembles_args(monkeypatch):
+    calls = {}
+
+    def fake_init(coordinator_address, num_processes, process_id):
+        calls.update(addr=coordinator_address, n=num_processes,
+                     pid=process_id)
+
+    monkeypatch.setattr(jax.distributed, "initialize", fake_init)
+    cfg = Config.from_string(
+        '[input]\ntpu_coordinator = "c:1"\n'
+        "tpu_num_processes = 2\ntpu_process_id = 1\n")
+    assert init_distributed(cfg) is True
+    assert calls == {"addr": "c:1", "n": 2, "pid": 1}
+
+
+def test_example_multihost_config_parses():
+    import os
+
+    path = os.path.join(os.path.dirname(__file__), "..", "examples",
+                        "multihost-dp.toml")
+    cfg = Config.from_path(path)
+    assert distributed_spec(cfg) == ("10.0.0.1:8476", 4, 0)

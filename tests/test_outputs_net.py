@@ -111,8 +111,11 @@ def test_tls_output_failover(pem):
 # Kafka
 # ---------------------------------------------------------------------------
 
-def _fake_kafka(received, port_holder, topic=b"logs"):
-    """Speaks Metadata v0 + Produce v0, single partition led by itself."""
+def _fake_kafka(received, port_holder, topic=b"logs", modern=False):
+    """Single-partition mock broker led by itself.  ``modern=False``
+    answers ApiVersions with legacy-only ranges and speaks Metadata v0 +
+    Produce v0; ``modern=True`` advertises (and requires) Metadata v4 +
+    Produce v3 with record batches v2."""
     server = socket.create_server(("127.0.0.1", 0))
     host, port = server.getsockname()
     port_holder.append(port)
@@ -137,8 +140,58 @@ def _fake_kafka(received, port_holder, topic=b"logs"):
                 while True:
                     size = struct.unpack(">i", read_exact(conn, 4))[0]
                     payload = read_exact(conn, size)
-                    api_key, _ver, corr = struct.unpack(">hhi", payload[:8])
-                    if api_key == 3:  # metadata
+                    api_key, ver, corr = struct.unpack(">hhi", payload[:8])
+                    if api_key == 18:  # ApiVersions
+                        lo, hi = (0, 0)
+                        mlo, mhi = (0, 0)
+                        if modern:
+                            lo, hi = (3, 9)   # KIP-896 era: no v0 produce
+                            mlo, mhi = (4, 12)
+                        body = (struct.pack(">h", 0)  # error
+                                + struct.pack(">i", 2)
+                                + struct.pack(">hhh", 0, lo, hi)
+                                + struct.pack(">hhh", 3, mlo, mhi))
+                        resp = struct.pack(">i", corr) + body
+                        conn.sendall(struct.pack(">i", len(resp)) + resp)
+                    elif api_key == 3 and modern:  # metadata v4
+                        assert ver == 4, ver
+                        broker = (struct.pack(">i", 1)       # brokers
+                                  + struct.pack(">i", 0)     # node id
+                                  + struct.pack(">h", 9) + b"127.0.0.1"
+                                  + struct.pack(">i", port)
+                                  + struct.pack(">h", -1))   # rack null
+                        partition = (struct.pack(">h", 0) + struct.pack(">i", 0)
+                                     + struct.pack(">i", 0)
+                                     + struct.pack(">i", 0) + struct.pack(">i", 0))
+                        topics = (struct.pack(">i", 1) + struct.pack(">h", 0)
+                                  + struct.pack(">h", len(topic)) + topic
+                                  + struct.pack(">b", 0)     # is_internal
+                                  + struct.pack(">i", 1) + partition)
+                        body = (struct.pack(">i", 0)         # throttle
+                                + broker
+                                + struct.pack(">h", -1)      # cluster id
+                                + struct.pack(">i", 0)       # controller
+                                + topics)
+                        resp = struct.pack(">i", corr) + body
+                        conn.sendall(struct.pack(">i", len(resp)) + resp)
+                    elif api_key == 0 and modern:  # produce v3
+                        assert ver == 3, ver
+                        received.append(payload)
+                        cid_len = struct.unpack(">h", payload[8:10])[0]
+                        off = 10 + cid_len
+                        tid = struct.unpack(">h", payload[off:off + 2])[0]
+                        assert tid == -1  # null transactional id
+                        acks = struct.unpack(">h", payload[off + 2:off + 4])[0]
+                        if acks != 0:
+                            body = (struct.pack(">i", 1)
+                                    + struct.pack(">h", len(topic)) + topic
+                                    + struct.pack(">i", 1)
+                                    + struct.pack(">i", 0) + struct.pack(">h", 0)
+                                    + struct.pack(">q", 0) + struct.pack(">q", -1)
+                                    + struct.pack(">i", 0))  # throttle
+                            resp = struct.pack(">i", corr) + body
+                            conn.sendall(struct.pack(">i", len(resp)) + resp)
+                    elif api_key == 3:  # metadata v0
                         broker = (struct.pack(">i", 1)
                                   + struct.pack(">i", 0)
                                   + struct.pack(">h", 9) + b"127.0.0.1"
@@ -232,3 +285,160 @@ def test_kafka_config_errors():
     with pytest.raises(ConfigError, match="Unsupported value for kafka_acks"):
         KafkaOutput(Config.from_string(
             '[output]\nkafka_brokers = ["b:9092"]\nkafka_topic = "t"\nkafka_acks = 2\n'))
+
+
+# -- modern broker: record batches v2 ---------------------------------------
+
+def _parse_record_batch(payload, topic=b"logs"):
+    """Extract record values from a Produce v3 request payload,
+    validating the v2 batch structure (magic, CRC32C, varint records)."""
+    import gzip
+
+    from flowgger_tpu import native
+    from flowgger_tpu.utils import snappy
+
+    cid_len = struct.unpack(">h", payload[8:10])[0]
+    off = 10 + cid_len
+    off += 2          # transactional_id (null)
+    off += 2 + 4      # acks + timeout
+    ntopics = struct.unpack(">i", payload[off:off + 4])[0]
+    assert ntopics == 1
+    off += 4
+    tlen = struct.unpack(">h", payload[off:off + 2])[0]
+    assert payload[off + 2:off + 2 + tlen] == topic
+    off += 2 + tlen
+    nparts = struct.unpack(">i", payload[off:off + 4])[0]
+    assert nparts == 1
+    off += 4
+    off += 4          # partition index
+    set_len = struct.unpack(">i", payload[off:off + 4])[0]
+    off += 4
+    batch = payload[off:off + set_len]
+
+    base_off, batch_len = struct.unpack(">qi", batch[:12])
+    assert base_off == 0 and batch_len == len(batch) - 12
+    epoch, magic = struct.unpack(">ib", batch[12:17])
+    assert magic == 2
+    crc = struct.unpack(">I", batch[17:21])[0]
+    post = batch[21:]
+    assert native.crc32c(post) == crc
+    (attrs, last_delta, _t0, _t1, pid_, pep, bseq,
+     count) = struct.unpack(">hiqqqhii", post[:40])
+    assert pid_ == -1 and pep == -1 and bseq == -1
+    records = post[40:]
+    codec = attrs & 7
+    if codec == 1:
+        records = gzip.decompress(records)
+    elif codec == 2:
+        records = snappy.decompress(records)
+    assert last_delta == count - 1
+
+    def varint(data, p):
+        v = 0
+        s = 0
+        while True:
+            b = data[p]
+            p += 1
+            v |= (b & 0x7F) << s
+            if not (b & 0x80):
+                break
+            s += 7
+        return (v >> 1) ^ -(v & 1), p  # un-zigzag
+
+    vals = []
+    p = 0
+    for _ in range(count):
+        rlen, p = varint(records, p)
+        end = p + rlen
+        p += 1  # record attributes
+        _, p = varint(records, p)   # ts delta
+        _, p = varint(records, p)   # offset delta
+        klen, p = varint(records, p)
+        assert klen == -1
+        vlen, p = varint(records, p)
+        vals.append(records[p:p + vlen])
+        p += vlen
+        hdrs, p = varint(records, p)
+        assert hdrs == 0 and p == end
+    return vals
+
+
+@pytest.mark.parametrize("compression", ["none", "gzip", "snappy"])
+def test_kafka_modern_record_batch_v2(compression):
+    from flowgger_tpu.utils.kafka_wire import KafkaProducer
+
+    received = []
+    ports = []
+    _fake_kafka(received, ports, modern=True)
+    producer = KafkaProducer([f"127.0.0.1:{ports[0]}"], required_acks=1,
+                             timeout_ms=1000, compression=compression,
+                             socket_timeout=5)
+    producer.refresh_metadata("logs")
+    msgs = [b"first message", b"second " * 30, b"third"]
+    producer.send_all("logs", msgs)
+    assert len(received) == 1
+    assert _parse_record_batch(received[0]) == msgs
+    producer.close()
+
+
+def test_kafka_snappy_rejected_on_legacy_broker():
+    from flowgger_tpu.utils.kafka_wire import KafkaError, KafkaProducer
+
+    received = []
+    ports = []
+    _fake_kafka(received, ports, modern=False)
+    producer = KafkaProducer([f"127.0.0.1:{ports[0]}"], required_acks=1,
+                             timeout_ms=1000, compression="snappy",
+                             socket_timeout=5)
+    producer.refresh_metadata("logs")
+    with pytest.raises(KafkaError, match="snappy"):
+        producer.send_all("logs", [b"x"])
+    producer.close()
+
+
+def test_kafka_output_modern_with_snappy():
+    """KafkaOutput end-to-end against the modern mock with snappy."""
+    from flowgger_tpu.outputs.kafka_output import KafkaOutput
+
+    received = []
+    ports = []
+    _fake_kafka(received, ports, modern=True)
+    config = Config.from_string(
+        f'[output]\nkafka_brokers = ["127.0.0.1:{ports[0]}"]\n'
+        'kafka_topic = "logs"\nkafka_coalesce = 2\nkafka_acks = 1\n'
+        'kafka_compression = "snappy"\n')
+    out = KafkaOutput(config)
+    out.exit_on_failure = False
+    tx = queue.Queue()
+    threads = out.start(tx, None)
+    tx.put(b"message one")
+    tx.put(b"message two")
+    deadline = time.time() + 10
+    while len(received) < 1 and time.time() < deadline:
+        time.sleep(0.05)
+    for _ in threads:
+        tx.put(SHUTDOWN)
+    assert received and _parse_record_batch(received[0]) == [
+        b"message one", b"message two"]
+
+
+def test_kafka_negotiation_retries_after_transport_failure():
+    """A transport failure during ApiVersions must not pin the broker to
+    legacy: the next connection renegotiates and gets v2 batches."""
+    from flowgger_tpu.utils.kafka_wire import KafkaProducer
+
+    received = []
+    ports = []
+    _fake_kafka(received, ports, modern=True)
+    addr = ("127.0.0.1", ports[0])
+    producer = KafkaProducer([f"127.0.0.1:{ports[0]}"], required_acks=1,
+                             timeout_ms=1000, socket_timeout=5)
+    # simulate the blip: negotiation failed, nothing cached
+    fake_sock = socket.create_connection(addr, timeout=5)
+    fake_sock.close()
+    assert addr not in producer._versions
+    producer.refresh_metadata("logs")     # reconnects + renegotiates
+    assert producer._versions[addr] == (3, 4)
+    producer.send_all("logs", [b"retry ok"])
+    assert _parse_record_batch(received[-1]) == [b"retry ok"]
+    producer.close()
