@@ -36,11 +36,13 @@ class FileWorker:
         self.from_tail = from_tail
         self.use_inotify = use_inotify
         self.stop = threading.Event()
+        self.open_failed = False
 
     def run(self):
         try:
             fd = open(self.path, "rb")
         except OSError as e:
+            self.open_failed = True
             print(f"Failed to open file {self.path}: {e}", file=sys.stderr)
             return
         if self.from_tail:
@@ -107,16 +109,20 @@ class FileInput(Input):
             workers[path] = (worker, t)
 
         def reap() -> bool:
-            # drop every finished worker: its tail is over whether the
-            # file vanished or was atomically replaced (logrotate's
-            # rename+create), so a recreated path can start a fresh
-            # worker reading from the start
+            # drop finished workers so a vanished or atomically replaced
+            # file (logrotate's rename+create) can start a fresh worker
+            # reading from the start — EXCEPT unopenable files that
+            # still exist, which stay parked instead of restarting in a
+            # spawn/stderr loop (the pre-inotify behavior)
             reaped = False
             for path in list(workers):
-                _worker, t = workers[path]
-                if not t.is_alive():
-                    del workers[path]
-                    reaped = True
+                worker, t = workers[path]
+                if t.is_alive():
+                    continue
+                if worker.open_failed and os.path.exists(path):
+                    continue
+                del workers[path]
+                reaped = True
             return reaped
 
         for path in _glob.glob(self.src):

@@ -343,9 +343,7 @@ def _encode_block_rfc5424(packed, encoder, merger):
     from . import encode_gelf_block, encode_passthrough_block, rfc5424
 
     batch, lens, chunk, starts, orig_lens, n_real = packed
-    out = rfc5424.decode_rfc5424_jit(jnp.asarray(batch), jnp.asarray(lens),
-                                     extract_impl=rfc5424.best_extract_impl())
-    host_out = {k: np.asarray(v) for k, v in out.items()}
+    host_out = rfc5424.decode_rfc5424_host(batch, lens)
     if type(encoder) is PassthroughEncoder:
         return encode_passthrough_block.encode_rfc5424_passthrough_block(
             chunk, starts, orig_lens, host_out, n_real, batch.shape[1],
@@ -362,9 +360,7 @@ def _encode_packed_rfc5424_gelf(packed, encoder):
     from . import encode_gelf, encode_passthrough, rfc5424
 
     batch, lens, chunk, starts, orig_lens, n_real = packed
-    out = rfc5424.decode_rfc5424_jit(jnp.asarray(batch), jnp.asarray(lens),
-                                     extract_impl=rfc5424.best_extract_impl())
-    host_out = {k: np.asarray(v) for k, v in out.items()}
+    host_out = rfc5424.decode_rfc5424_host(batch, lens)
     if type(encoder) is PassthroughEncoder:
         return encode_passthrough.encode_rfc5424_passthrough(
             chunk, starts, orig_lens, host_out, n_real, batch.shape[1], encoder)
@@ -378,15 +374,13 @@ def _decode_packed(fmt, packed, decoder=None):
     import jax.numpy as jnp
 
     batch, lens, chunk, starts, orig_lens, n_real = packed
-    jb, jl = jnp.asarray(batch), jnp.asarray(lens)
     if fmt == "rfc5424":
         from . import materialize, rfc5424
 
-        out = rfc5424.decode_rfc5424_jit(
-            jb, jl, extract_impl=rfc5424.best_extract_impl())
-        host_out = {k: np.asarray(v) for k, v in out.items()}
+        host_out = rfc5424.decode_rfc5424_host(batch, lens)
         return materialize.materialize(chunk, starts, lens, orig_lens, host_out,
                                        n_real, max_len=batch.shape[1])
+    jb, jl = jnp.asarray(batch), jnp.asarray(lens)
     if fmt == "ltsv":
         from . import ltsv, materialize_ltsv
 

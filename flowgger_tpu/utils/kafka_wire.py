@@ -198,6 +198,14 @@ class KafkaProducer:
                 # connection re-negotiates instead of pinning a modern
                 # broker to legacy v0 after one network blip
                 self._versions[addr] = versions
+            if addr not in self._conns:
+                # negotiation closed the socket (a pre-ApiVersions
+                # broker dropping the unknown request, or a blip):
+                # reconnect so the caller gets a usable connection for
+                # its legacy-versioned attempt
+                sock = socket.create_connection(
+                    addr, timeout=self.socket_timeout)
+                self._conns[addr] = sock
         return sock
 
     def _negotiate(self, addr, sock) -> Tuple[Tuple[int, int], bool]:

@@ -111,7 +111,8 @@ def test_tls_output_failover(pem):
 # Kafka
 # ---------------------------------------------------------------------------
 
-def _fake_kafka(received, port_holder, topic=b"logs", modern=False):
+def _fake_kafka(received, port_holder, topic=b"logs", modern=False,
+                drop_api_versions=False):
     """Single-partition mock broker led by itself.  ``modern=False``
     answers ApiVersions with legacy-only ranges and speaks Metadata v0 +
     Produce v0; ``modern=True`` advertises (and requires) Metadata v4 +
@@ -141,6 +142,11 @@ def _fake_kafka(received, port_holder, topic=b"logs", modern=False):
                     size = struct.unpack(">i", read_exact(conn, 4))[0]
                     payload = read_exact(conn, size)
                     api_key, ver, corr = struct.unpack(">hhi", payload[:8])
+                    if api_key == 18 and drop_api_versions:
+                        # pre-0.10 broker: unknown request kills the
+                        # connection
+                        conn.close()
+                        break
                     if api_key == 18:  # ApiVersions
                         lo, hi = (0, 0)
                         mlo, mhi = (0, 0)
@@ -441,4 +447,21 @@ def test_kafka_negotiation_retries_after_transport_failure():
     assert producer._versions[addr] == (3, 4)
     producer.send_all("logs", [b"retry ok"])
     assert _parse_record_batch(received[-1]) == [b"retry ok"]
+    producer.close()
+
+
+def test_kafka_legacy_broker_drops_api_versions():
+    """A pre-ApiVersions broker that closes the connection on the
+    negotiation request must still be usable via a reconnect and the
+    legacy v0 protocol."""
+    from flowgger_tpu.utils.kafka_wire import KafkaProducer
+
+    received = []
+    ports = []
+    _fake_kafka(received, ports, drop_api_versions=True)
+    producer = KafkaProducer([f"127.0.0.1:{ports[0]}"], required_acks=1,
+                             timeout_ms=1000, socket_timeout=5)
+    producer.refresh_metadata("logs")
+    producer.send_all("logs", [b"legacy delivery"])
+    assert received and b"legacy delivery" in received[-1]
     producer.close()

@@ -280,3 +280,32 @@ def test_scatter_extract_impl_matches_sum():
                                extract_impl="scatter")
     for k in a:
         assert (np.asarray(a[k]) == np.asarray(b[k])).all(), k
+
+
+def test_two_tier_pair_dispatch():
+    """Rows with DEFAULT_MAX_PAIRS < pairs <= RESCUE_MAX_PAIRS decode
+    on-device via the tier-2 kernel (not the scalar fallback), with pair
+    channels widened; beyond RESCUE they stay flagged for the oracle."""
+    from flowgger_tpu.tpu import rfc5424
+
+    def sd(npairs):
+        pairs = " ".join(f'k{i:02d}="{i}"' for i in range(npairs))
+        return f"<13>1 2015-08-05T15:53:45Z h a p m [id {pairs}] m"
+
+    lines = [sd(2).encode(), sd(10).encode(), sd(16).encode(),
+             sd(20).encode()]
+    batch, lens, chunk, starts, orig, n = pack.pack_lines_2d(lines, 512)
+    host = rfc5424.decode_rfc5424_host(batch, lens)
+    ok = host["ok"][:n]
+    assert ok[0] and ok[1] and ok[2]          # tier-2 rescued rows 1-2
+    assert not ok[3]                          # > rescue cap: oracle row
+    assert host["name_start"].shape[1] == rfc5424.RESCUE_MAX_PAIRS
+    assert host["pair_count"][1] == 10 and host["pair_count"][2] == 16
+    # spans of the rescued row must match the oracle record
+    rec = ORACLE.decode(lines[1].decode())
+    line = lines[1].decode()
+    got = [(line[host["name_start"][1][j]:host["name_end"][1][j]],
+            line[host["val_start"][1][j]:host["val_end"][1][j]])
+           for j in range(10)]
+    want = [(name[1:], val.value) for name, val in rec.sd[0].pairs]
+    assert got == want
