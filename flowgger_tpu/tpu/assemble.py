@@ -171,17 +171,18 @@ def concat_segments(src: np.ndarray, seg_src: np.ndarray,
 _DEC_WIDTH = 10  # covers int32 magnitudes
 
 
-def decimal_segments(values: np.ndarray, digits_off: int
+def decimal_segments(values: np.ndarray, digits_off: int,
+                     width: int = _DEC_WIDTH
                      ) -> Tuple[np.ndarray, np.ndarray]:
     """(seg_src, seg_len) rendering each non-negative value as ASCII
-    decimal using ``_DEC_WIDTH`` fixed slots per value; leading-zero
-    slots get length 0 so the gather emits exactly ``str(v)``.
+    decimal using ``width`` fixed slots per value; leading-zero slots
+    get length 0 so the gather emits exactly ``str(v)``.
 
     ``digits_off`` is the offset of a 10-byte "0123456789" table in the
     source buffer the caller gathers from.
     """
     v = values.astype(np.int64, copy=False)
-    pow10 = 10 ** np.arange(_DEC_WIDTH - 1, -1, -1, dtype=np.int64)
+    pow10 = 10 ** np.arange(width - 1, -1, -1, dtype=np.int64)
     digs = (v[:, None] // pow10[None, :]) % 10          # [n, W]
     # significant from the first nonzero (last slot always significant)
     sig = np.cumsum(digs != 0, axis=1) > 0

@@ -75,10 +75,12 @@ class BatchHandler(Handler):
         self._start_timer = start_timer
         # direct span->bytes encodes for rfc5424 routes
         from ..encoders.gelf import GelfEncoder
+        from ..encoders.ltsv import LTSVEncoder
         from ..encoders.passthrough import PassthroughEncoder
+        from ..encoders.rfc5424 import RFC5424Encoder
 
         self._fast_encode = fmt == "rfc5424" and (
-            type(encoder) is GelfEncoder
+            type(encoder) in (GelfEncoder, RFC5424Encoder, LTSVEncoder)
             or (type(encoder) is PassthroughEncoder
                 and encoder.header_time_format is None))
         # single source of truth for kernel dispatch: fmt -> batch decoder
@@ -242,7 +244,9 @@ class BatchHandler(Handler):
         if not self._block_mode:
             return False
         from ..encoders.gelf import GelfEncoder
+        from ..encoders.ltsv import LTSVEncoder
         from ..encoders.passthrough import PassthroughEncoder
+        from ..encoders.rfc5424 import RFC5424Encoder
         from .block_common import merger_suffix
 
         if merger_suffix(self._merger) is None:
@@ -251,16 +255,24 @@ class BatchHandler(Handler):
             return not self.encoder.extra
         if type(self.encoder) is PassthroughEncoder:
             return self.encoder.header_time_format is None
-        return False
+        return type(self.encoder) in (RFC5424Encoder, LTSVEncoder)
 
     def _emit_fast(self, packed) -> None:
         """Span→bytes encode for one packed tuple: the columnar block
-        route when engaged, else the per-row fast path."""
+        route when engaged, else the per-row fast path (gelf/passthrough
+        only), else the Record path."""
         if self._block_route_ok():
             res = _encode_block_rfc5424(packed, self.encoder, self._merger)
             self._emit_block(res, packed[5])
             return
-        self._emit_encoded(_encode_packed_rfc5424_gelf(packed, self.encoder))
+        from ..encoders.gelf import GelfEncoder
+        from ..encoders.passthrough import PassthroughEncoder
+
+        if type(self.encoder) in (GelfEncoder, PassthroughEncoder):
+            self._emit_encoded(
+                _encode_packed_rfc5424_gelf(packed, self.encoder))
+            return
+        self._emit(_decode_packed(self.fmt, packed, self.scalar.decoder))
 
     def _emit_block(self, res, n_real: int) -> None:
         _metrics.inc("input_lines", n_real)
@@ -337,20 +349,27 @@ class BatchHandler(Handler):
 def _encode_block_rfc5424(packed, encoder, merger):
     """Columnar block encode for the rfc5424 kernel: decode once, then
     dispatch on the encoder type (caller pre-checked applicability)."""
-    import jax.numpy as jnp
-
+    from ..encoders.ltsv import LTSVEncoder
     from ..encoders.passthrough import PassthroughEncoder
-    from . import encode_gelf_block, encode_passthrough_block, rfc5424
+    from ..encoders.rfc5424 import RFC5424Encoder
+    from . import (
+        encode_gelf_block,
+        encode_ltsv_block,
+        encode_passthrough_block,
+        encode_rfc5424_block,
+        rfc5424,
+    )
 
     batch, lens, chunk, starts, orig_lens, n_real = packed
     host_out = rfc5424.decode_rfc5424_host(batch, lens)
-    if type(encoder) is PassthroughEncoder:
-        return encode_passthrough_block.encode_rfc5424_passthrough_block(
-            chunk, starts, orig_lens, host_out, n_real, batch.shape[1],
-            encoder, merger)
-    return encode_gelf_block.encode_rfc5424_gelf_block(
-        chunk, starts, orig_lens, host_out, n_real, batch.shape[1],
-        encoder, merger)
+    fn = {
+        PassthroughEncoder:
+            encode_passthrough_block.encode_rfc5424_passthrough_block,
+        RFC5424Encoder: encode_rfc5424_block.encode_rfc5424_rfc5424_block,
+        LTSVEncoder: encode_ltsv_block.encode_rfc5424_ltsv_block,
+    }.get(type(encoder), encode_gelf_block.encode_rfc5424_gelf_block)
+    return fn(chunk, starts, orig_lens, host_out, n_real, batch.shape[1],
+              encoder, merger)
 
 
 def _encode_packed_rfc5424_gelf(packed, encoder):

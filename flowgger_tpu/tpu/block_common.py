@@ -18,8 +18,47 @@ import numpy as np
 from ..block import EncodedBlock
 from ..encoders import EncodeError
 from ..mergers import LineMerger, Merger, NulMerger, SyslenMerger
-from .assemble import exclusive_cumsum
-from .materialize import _scalar_line
+from .assemble import (
+    build_source,
+    concat_segments,
+    exclusive_cumsum,
+    syslen_prefix_segments,
+)
+from .materialize import _scalar_line, compute_ts
+
+
+def ts_scratch(out, n: int, ridx: np.ndarray, fmt_fn):
+    """Deduplicated formatted timestamps for the tier rows: repetitive
+    streams share few distinct stamps, and ``fmt_fn`` (json_f64,
+    display_f64, unix_to_rfc3339_ms...) is the only per-value Python.
+    Returns (scratch bytes, per-row offsets, per-row lengths)."""
+    ts = compute_ts({k: np.asarray(v)[:n][ridx]
+                     for k, v in out.items()
+                     if k in ("days", "sod", "off", "nanos")})
+    uniq, inv = np.unique(ts, return_inverse=True)
+    strs = [fmt_fn(float(u)).encode("ascii") for u in uniq]
+    scratch = b"".join(strs)
+    ulen = np.fromiter((len(s) for s in strs), dtype=np.int64,
+                       count=len(strs))
+    uoff = exclusive_cumsum(ulen)[:-1]
+    return scratch, uoff[inv], ulen[inv]
+
+
+def apply_syslen_prefix(body: np.ndarray, row_off: np.ndarray,
+                        tier_lens: np.ndarray):
+    """Prepend the syslen length prefix per row via one more segment
+    gather.  The rows in ``body`` must already carry their trailing
+    newline (the framed length value counts payload + '\\n',
+    syslen_merger.rs:14-31).  Returns (final_buf bytes, new row_off,
+    prefix_lens)."""
+    deco, _ = build_source(b"0123456789 ")
+    src2 = np.concatenate([body, deco])
+    psrc, plen, prefix_lens = syslen_prefix_segments(tier_lens,
+                                                     int(body.size))
+    seg_src = np.concatenate([psrc, row_off[:-1, None]], axis=1).ravel()
+    seg_len = np.concatenate([plen, tier_lens[:, None]], axis=1).ravel()
+    out = concat_segments(src2, seg_src, seg_len)
+    return out.tobytes(), exclusive_cumsum(tier_lens + prefix_lens), prefix_lens
 
 
 class BlockResult:

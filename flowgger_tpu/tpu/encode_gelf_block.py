@@ -29,7 +29,7 @@ result is an EncodedBlock the sinks write wholesale.
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional
 
 import numpy as np
 
@@ -40,11 +40,15 @@ from .assemble import (
     concat_segments,
     escape_json,
     exclusive_cumsum,
-    syslen_prefix_segments,
     _DEC_WIDTH,
 )
-from .block_common import BlockResult, finish_block, merger_suffix
-from .materialize import compute_ts
+from .block_common import (
+    BlockResult,
+    apply_syslen_prefix,
+    finish_block,
+    merger_suffix,
+    ts_scratch,
+)
 
 __all__ = ["encode_rfc5424_gelf_block", "BlockResult", "merger_suffix"]
 
@@ -68,23 +72,6 @@ _C_TAIL = b',"version":"1.1"}'
 _C_UNKNOWN = b"unknown"
 _C_DASH = b"-"
 _C_SEVD = b"01234567"
-
-
-def _ts_scratch(out: Dict[str, np.ndarray], n: int, ridx: np.ndarray
-                ) -> Tuple[bytes, np.ndarray, np.ndarray]:
-    """Deduplicated serde_json-format timestamps for the tier rows:
-    repetitive streams share few distinct stamps, and formatting is the
-    only remaining per-value Python."""
-    ts = compute_ts({k: np.asarray(v)[:n][ridx]
-                     for k, v in out.items()
-                     if k in ("days", "sod", "off", "nanos")})
-    uniq, inv = np.unique(ts, return_inverse=True)
-    strs = [json_f64(float(u)).encode("ascii") for u in uniq]
-    scratch = b"".join(strs)
-    ulen = np.fromiter((len(s) for s in strs), dtype=np.int64,
-                       count=len(strs))
-    uoff = exclusive_cumsum(ulen)[:-1]
-    return scratch, uoff[inv], ulen[inv]
 
 
 def _syslen_prefix_lens(framed_lens: np.ndarray) -> np.ndarray:
@@ -187,7 +174,7 @@ def encode_rfc5424_gelf_block(
     prefix_lens_tier: Optional[np.ndarray] = None
 
     if R and use_native:
-        scratch, ts_off, ts_len = _ts_scratch(out, n, ridx)
+        scratch, ts_off, ts_len = ts_scratch(out, n, ridx, json_f64)
         meta = np.empty((R, 17), dtype=np.int32)
         meta[:, 0] = starts64[ridx]
         for k, key in enumerate(("host_start", "host_end", "app_start",
@@ -242,7 +229,7 @@ def encode_rfc5424_gelf_block(
 
         sev = np.asarray(out["severity"])[:n][ridx].astype(np.int64)
 
-        scratch, ts_off, ts_len = _ts_scratch(out, n, ridx)
+        scratch, ts_off, ts_len = ts_scratch(out, n, ridx, json_f64)
         const_bank, coffs = build_source(
             _C_OPEN, _C_P0, _C_P1, _C_P2, _C_APP, _C_FULL, _C_HOST,
             _C_LEVEL, _C_PROC, _C_SDID, _C_SHORT, _C_TS, _C_TAIL + suffix,
@@ -338,22 +325,8 @@ def encode_rfc5424_gelf_block(
         tier_lens = np.diff(row_off)
 
         if syslen:
-            # prefix "{payload_len+newline} " — the payload already
-            # carries its trailing newline in the tail constant, so the
-            # framed length value is exactly the row length
-            # (syslen_merger.rs:14-31 counts payload + '\n')
-            deco, _ = build_source(b"0123456789 ")
-            src2 = np.concatenate([body, deco])
-            psrc, plen, prefix_lens_tier = syslen_prefix_segments(
-                tier_lens, int(body.size))
-            seg2_src = np.concatenate(
-                [psrc, row_off[:-1, None]], axis=1).ravel()
-            seg2_len = np.concatenate(
-                [plen, tier_lens[:, None]], axis=1).ravel()
-            framed = concat_segments(src2, seg2_src, seg2_len)
-            tier_lens = tier_lens + prefix_lens_tier
-            row_off = exclusive_cumsum(tier_lens)
-            final_buf = framed.tobytes()
+            final_buf, row_off, prefix_lens_tier = apply_syslen_prefix(
+                body, row_off, tier_lens)
         else:
             final_buf = body.tobytes()
 

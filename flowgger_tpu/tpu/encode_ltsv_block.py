@@ -1,0 +1,223 @@
+"""Columnar RFC5424→LTSV encoding: span tables → one framed output
+buffer per batch (ltsv_encoder.rs:65-125 semantics).
+
+Field order per record: SD pairs (leading ``_`` stripped — i.e. the raw
+decoded name span), ltsv_extra config pairs (static, pre-rendered),
+host, time, message?, full_message, level, facility, appname, procid,
+msgid.  The fast tier requires rows with no tab anywhere (LTSV's only
+value escape that could fire here) and no ``:``/newline in SD names
+(the only key escapes), checked vectorially with one cumulative-count
+pass over the chunk; everything else is raw spans, constants, digits
+and a deduplicated Rust-Display timestamp scratch.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..mergers import Merger
+from ..utils.rustfmt import display_f64
+from .assemble import (
+    build_source,
+    concat_segments,
+    decimal_segments,
+    exclusive_cumsum,
+)
+from .block_common import (
+    BlockResult,
+    apply_syslen_prefix,
+    finish_block,
+    merger_suffix,
+    ts_scratch,
+)
+
+
+def _count_in_spans(cum: np.ndarray, a: np.ndarray, b: np.ndarray):
+    """Occurrences within [a, b) given an inclusive prefix-count.
+    Indices are clipped: callers mask out invalid spans afterwards, but
+    padded/kernel-flagged rows may carry out-of-range placeholders."""
+    top = cum.size - 1
+    hi = np.where(b > 0, cum[np.clip(b - 1, 0, top)], 0)
+    lo = np.where(a > 0, cum[np.clip(a - 1, 0, top)], 0)
+    return hi - lo
+
+
+def encode_rfc5424_ltsv_block(
+    chunk_bytes: bytes,
+    starts: np.ndarray,
+    orig_lens: np.ndarray,
+    out: Dict[str, np.ndarray],
+    n_real: int,
+    max_len: int,
+    encoder,
+    merger: Optional[Merger],
+) -> Optional[BlockResult]:
+    spec = merger_suffix(merger)
+    if spec is None:
+        return None
+    suffix, syslen = spec
+
+    n = int(n_real)
+    starts64 = np.asarray(starts[:n], dtype=np.int64)
+    lens64 = np.asarray(orig_lens[:n], dtype=np.int64)
+    ok = np.asarray(out["ok"][:n], dtype=bool)
+    has_high = np.asarray(out["has_high"][:n], dtype=bool)
+    val_has_esc = np.asarray(out["val_has_esc"][:n], dtype=bool)
+    cand = ok & (lens64 <= max_len) & ~has_high
+    if val_has_esc.shape[1]:
+        cand &= ~val_has_esc.any(axis=1)
+
+    chunk_arr = np.frombuffer(chunk_bytes, dtype=np.uint8)
+    # rows containing a tab or newline would need LTSV value escaping
+    # (both map to space): cumulative count per row span, one pass over
+    # the chunk (newlines reach this route via nul/syslen framing)
+    esc_cum = np.cumsum((chunk_arr == 9) | (chunk_arr == 10))
+    row_esc = _count_in_spans(esc_cum, starts64, starts64 + lens64)
+    cand &= row_esc == 0
+    # SD names containing ':' would need key escaping (rare): count per
+    # name span, reduce per row
+    pair_count_all = np.asarray(out["pair_count"])[:n]
+    if pair_count_all.shape[0] and np.asarray(out["name_start"]).shape[1]:
+        P = np.asarray(out["name_start"]).shape[1]
+        jmask = np.arange(P)[None, :] < pair_count_all[:, None]
+        ns_all = starts64[:, None] + np.asarray(out["name_start"])[:n]
+        ne_all = starts64[:, None] + np.asarray(out["name_end"])[:n]
+        col_cum = np.cumsum(chunk_arr == ord(":"))
+        ncols = np.where(jmask,
+                         _count_in_spans(col_cum, ns_all, ne_all), 0)
+        cand &= ncols.sum(axis=1) == 0
+
+    ridx = np.flatnonzero(cand)
+    R = ridx.size
+    final_buf = b""
+    row_off = np.zeros(1, dtype=np.int64)
+    prefix_lens_tier: Optional[np.ndarray] = None
+
+    if R:
+        st = starts64[ridx]
+
+        def span(skey, ekey):
+            a = st + np.asarray(out[skey])[:n][ridx]
+            return a, st + np.asarray(out[ekey])[:n][ridx] - a
+
+        host_s, host_l = span("host_start", "host_end")
+        app_s, app_l = span("app_start", "app_end")
+        proc_s, proc_l = span("proc_start", "proc_end")
+        msgid_s, msgid_l = span("msgid_start", "msgid_end")
+        full_s = st + np.asarray(out["full_start"])[:n][ridx]
+        full_l = st + np.asarray(out["trim_end"])[:n][ridx] - full_s
+        msg_s = st + np.asarray(out["msg_trim_start"])[:n][ridx]
+        msg_l = st + np.asarray(out["trim_end"])[:n][ridx] - msg_s
+
+        fac = np.asarray(out["facility"])[:n][ridx].astype(np.int64)
+        sev = np.asarray(out["severity"])[:n][ridx].astype(np.int64)
+        pc = np.asarray(out["pair_count"])[:n][ridx].astype(np.int64)
+
+        scratch, ts_off, ts_len = ts_scratch(out, n, ridx, display_f64)
+
+        # static extra pairs, key/value-escaped once
+        extra_parts = []
+        for k, v in encoder.extra:
+            k = k[1:] if k.startswith("_") else k
+            k = (k.replace("\n", " ").replace("\t", " ")
+                 .replace(":", "_"))
+            v = v.replace("\t", " ").replace("\n", " ")
+            extra_parts.append(f"{k}:{v}\t".encode("utf-8"))
+        extra_blob = b"".join(extra_parts)
+
+        consts, offs = build_source(
+            b":", b"\t", b"host:", b"\ttime:", b"\tmessage:",
+            b"\tfull_message:", b"\tlevel:", b"\tfacility:",
+            b"\tappname:", b"\tprocid:", b"\tmsgid:",
+            b"0123456789 ", suffix, extra_blob, scratch)
+        (o_col, o_tab, o_host, o_time, o_msg, o_full, o_lvl, o_fac,
+         o_app, o_proc, o_msgid, o_dec, o_sfx, o_extra, o_ts) = offs
+        cbase = int(chunk_arr.size)
+        src = np.concatenate([chunk_arr, consts])
+
+        # per row: pairs (4 segs each: name ':' value '\t') + extra blob
+        # (1) + host(2: "host:" span) + time(2) + message(2, zero-len
+        # when empty) + full(2) + level(2: const + digit) + facility(3)
+        # + appname(2) + procid(2) + msgid(2) + framing suffix(1)
+        # leading tabs ride each "\t<key>:" const; the first part is the
+        # pair stream (tab-terminated) or the bare "host:" const.
+        FIXED = 21
+        segc = 4 * pc + FIXED
+        rstart = exclusive_cumsum(segc)[:-1]
+        S = int(segc.sum())
+        seg_src = np.zeros(S, dtype=np.int64)
+        seg_len = np.zeros(S, dtype=np.int64)
+
+        T2 = int(pc.sum())
+        if T2:
+            rows2 = np.repeat(np.arange(R), pc)
+            jop = np.arange(T2) - np.repeat(exclusive_cumsum(pc)[:-1], pc)
+            ns = st[rows2] + np.asarray(out["name_start"])[:n][ridx][rows2, jop]
+            ne = st[rows2] + np.asarray(out["name_end"])[:n][ridx][rows2, jop]
+            vs = st[rows2] + np.asarray(out["val_start"])[:n][ridx][rows2, jop]
+            ve = st[rows2] + np.asarray(out["val_end"])[:n][ridx][rows2, jop]
+            p0 = rstart[rows2] + 4 * jop
+            seg_src[p0] = ns
+            seg_len[p0] = ne - ns
+            seg_src[p0 + 1] = cbase + o_col
+            seg_len[p0 + 1] = 1
+            seg_src[p0 + 2] = vs
+            seg_len[p0 + 2] = ve - vs
+            seg_src[p0 + 3] = cbase + o_tab
+            seg_len[p0 + 3] = 1
+
+        fd = (rstart + 4 * pc)[:, None] + np.arange(FIXED,
+                                                    dtype=np.int64)[None, :]
+        fsrc = np.empty((R, FIXED), dtype=np.int64)
+        flen = np.empty((R, FIXED), dtype=np.int64)
+        fac_d = decimal_segments(fac, cbase + o_dec, width=2)
+        has_msg = msg_l > 0
+        cols = (
+            (cbase + o_extra, len(extra_blob)),
+            # "host:" carries no leading tab — the pair stream and the
+            # extra blob are tab-terminated, so it is always either the
+            # first part or already separated
+            (cbase + o_host, len(b"host:")),
+            (host_s, host_l),
+            (cbase + o_time, len(b"\ttime:")),
+            (cbase + o_ts + ts_off, ts_len),
+            (np.where(has_msg, cbase + o_msg, 0),
+             np.where(has_msg, len(b"\tmessage:"), 0)),
+            (msg_s, msg_l),
+            (cbase + o_full, len(b"\tfull_message:")),
+            (full_s, full_l),
+            (cbase + o_lvl, len(b"\tlevel:")),
+            (cbase + o_dec + sev, 1),
+            (cbase + o_fac, len(b"\tfacility:")),
+            (fac_d[0][0::2], fac_d[1][0::2]),
+            (fac_d[0][1::2], fac_d[1][1::2]),
+            (cbase + o_app, len(b"\tappname:")),
+            (app_s, app_l),
+            (cbase + o_proc, len(b"\tprocid:")),
+            (proc_s, proc_l),
+            (cbase + o_msgid, len(b"\tmsgid:")),
+            (msgid_s, msgid_l),
+            (cbase + o_sfx, len(suffix)),
+        )
+        for k, (s, ln) in enumerate(cols):
+            fsrc[:, k] = s
+            flen[:, k] = ln
+        fd_flat = fd
+        seg_src[fd_flat] = fsrc
+        seg_len[fd_flat] = flen
+
+        dst0 = exclusive_cumsum(seg_len)
+        body = concat_segments(src, seg_src, seg_len, dst0)
+        row_off = np.concatenate([dst0[rstart], dst0[-1:]])
+        tier_lens = np.diff(row_off)
+        if syslen:
+            final_buf, row_off, prefix_lens_tier = apply_syslen_prefix(
+                body, row_off, tier_lens)
+        else:
+            final_buf = body.tobytes()
+
+    return finish_block(chunk_bytes, starts64, lens64, n, cand, ridx,
+                        final_buf, row_off, prefix_lens_tier, suffix,
+                        syslen, merger, encoder)

@@ -1,0 +1,217 @@
+"""Columnar RFC5424→RFC5424 re-encoding: span tables → one framed
+output buffer per batch (rfc5424_encoder.rs:28-93 semantics).
+
+For kernel-ok ASCII rows without escaped SD values, every output piece
+is either a raw chunk span (host/app/proc/msgid, SD ids/names/values —
+the reference re-emits decoded values verbatim, record.rs:55-62), a
+constant, PRI digits, or a deduplicated millisecond-truncated RFC3339
+timestamp; the whole batch gathers in one ``concat_segments`` call.
+Multi-block structured data nests pairs inside their block's brackets
+via ``pair_sd`` attribution.  Rows outside the tier take the scalar
+oracle through block_common.finish_block.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..mergers import Merger
+from ..utils.timeparse import unix_to_rfc3339_ms
+from .assemble import (
+    build_source,
+    concat_segments,
+    decimal_segments,
+    exclusive_cumsum,
+)
+from .block_common import (
+    BlockResult,
+    apply_syslen_prefix,
+    finish_block,
+    merger_suffix,
+    ts_scratch,
+)
+
+
+def encode_rfc5424_rfc5424_block(
+    chunk_bytes: bytes,
+    starts: np.ndarray,
+    orig_lens: np.ndarray,
+    out: Dict[str, np.ndarray],
+    n_real: int,
+    max_len: int,
+    encoder,
+    merger: Optional[Merger],
+) -> Optional[BlockResult]:
+    spec = merger_suffix(merger)
+    if spec is None:
+        return None
+    suffix, syslen = spec
+
+    n = int(n_real)
+    starts64 = np.asarray(starts[:n], dtype=np.int64)
+    lens64 = np.asarray(orig_lens[:n], dtype=np.int64)
+    ok = np.asarray(out["ok"][:n], dtype=bool)
+    has_high = np.asarray(out["has_high"][:n], dtype=bool)
+    val_has_esc = np.asarray(out["val_has_esc"][:n], dtype=bool)
+    cand = ok & (lens64 <= max_len) & ~has_high
+    if val_has_esc.shape[1]:
+        cand &= ~val_has_esc.any(axis=1)
+
+    ridx = np.flatnonzero(cand)
+    R = ridx.size
+    final_buf = b""
+    row_off = np.zeros(1, dtype=np.int64)
+    prefix_lens_tier: Optional[np.ndarray] = None
+
+    if R:
+        chunk_arr = np.frombuffer(chunk_bytes, dtype=np.uint8)
+        st = starts64[ridx]
+
+        def span(skey, ekey):
+            a = st + np.asarray(out[skey])[:n][ridx]
+            return a, st + np.asarray(out[ekey])[:n][ridx] - a
+
+        host_s, host_l = span("host_start", "host_end")
+        app_s, app_l = span("app_start", "app_end")
+        proc_s, proc_l = span("proc_start", "proc_end")
+        msgid_s, msgid_l = span("msgid_start", "msgid_end")
+        msg_s = st + np.asarray(out["msg_trim_start"])[:n][ridx]
+        msg_l = st + np.asarray(out["trim_end"])[:n][ridx] - msg_s
+
+        fac = np.asarray(out["facility"])[:n][ridx].astype(np.int64)
+        sev = np.asarray(out["severity"])[:n][ridx].astype(np.int64)
+        pri = (fac << 3) + sev
+        sdc = np.asarray(out["sd_count"])[:n][ridx].astype(np.int64)
+        pc = np.asarray(out["pair_count"])[:n][ridx].astype(np.int64)
+        nsd = sdc > 0
+
+        scratch, ts_off, ts_len = ts_scratch(out, n, ridx,
+                                             unix_to_rfc3339_ms)
+        consts, offs = build_source(
+            b"<", b">1 ", b" ", b'="', b'"', b"[", b"]", b"-",
+            b"0123456789 ", suffix, scratch)
+        (o_lt, o_gt1, o_sp, o_eqq, o_q, o_lb, o_rb, o_dash,
+         o_dec, o_sfx, o_ts) = offs
+        cbase = int(chunk_arr.size)
+        src = np.concatenate([chunk_arr, consts])
+
+        # segment plan per row:
+        #   head (15): '<' d d d '>1 ' ts ' ' host ' ' app ' ' proc ' '
+        #              msgid ' '
+        #   sd: per block '[' sid ... ']' (3 + 5*pairs segs); dash rows 1
+        #   tail (3): ' ' msg framing-suffix
+        HEAD = 15
+        sd_segs = np.where(nsd, 3 * sdc + 5 * pc, 1)
+        segc = HEAD + sd_segs + 3
+        rstart = exclusive_cumsum(segc)[:-1]
+        S = int(segc.sum())
+        seg_src = np.zeros(S, dtype=np.int64)
+        seg_len = np.zeros(S, dtype=np.int64)
+
+        hd = rstart[:, None] + np.arange(HEAD, dtype=np.int64)[None, :]
+        hsrc = np.empty((R, HEAD), dtype=np.int64)
+        hlen = np.empty((R, HEAD), dtype=np.int64)
+        dsrc, dlen = decimal_segments(pri, cbase + o_dec, width=3)
+        cols = (
+            (cbase + o_lt, 1),
+            (dsrc[0::3], dlen[0::3]),
+            (dsrc[1::3], dlen[1::3]),
+            (dsrc[2::3], dlen[2::3]),
+            (cbase + o_gt1, 3),
+            (cbase + o_ts + ts_off, ts_len),
+            (cbase + o_sp, 1),
+            (host_s, host_l),
+            (cbase + o_sp, 1),
+            (app_s, app_l),
+            (cbase + o_sp, 1),
+            (proc_s, proc_l),
+            (cbase + o_sp, 1),
+            (msgid_s, msgid_l),
+            (cbase + o_sp, 1),
+        )
+        for k, (s, ln) in enumerate(cols):
+            hsrc[:, k] = s
+            hlen[:, k] = ln
+        seg_src[hd] = hsrc
+        seg_len[hd] = hlen
+
+        # dash rows
+        dmask = ~nsd
+        if dmask.any():
+            dpos = rstart[dmask] + HEAD
+            seg_src[dpos] = cbase + o_dash
+            seg_len[dpos] = 1
+
+        # blocks + pairs
+        max_sd = np.asarray(out["sid_start"]).shape[1]
+        P = np.asarray(out["name_start"]).shape[1]
+        if nsd.any():
+            pair_sd = np.asarray(out["pair_sd"])[:n][ridx]       # [R, P]
+            jmask = np.arange(P)[None, :] < pc[:, None]
+            # pairs with pair_sd < k, per row/block -> block seg offsets
+            pb_rb = ((pair_sd[:, None, :] < np.arange(max_sd)[None, :, None])
+                     & jmask[:, None, :]).sum(axis=2)            # [R, max_sd]
+            p_in = ((pair_sd[:, None, :] == np.arange(max_sd)[None, :, None])
+                    & jmask[:, None, :]).sum(axis=2)
+            kmask = np.arange(max_sd)[None, :] < sdc[:, None]
+            bstart = (rstart[:, None] + HEAD + 3 * np.arange(max_sd)[None, :]
+                      + 5 * pb_rb)                               # [R, max_sd]
+            sid_s = st[:, None] + np.asarray(out["sid_start"])[:n][ridx]
+            sid_e = st[:, None] + np.asarray(out["sid_end"])[:n][ridx]
+            km = kmask & nsd[:, None]
+            seg_src[bstart[km]] = cbase + o_lb
+            seg_len[bstart[km]] = 1
+            seg_src[bstart[km] + 1] = sid_s[km]
+            seg_len[bstart[km] + 1] = (sid_e - sid_s)[km]
+            rb_pos = bstart + 2 + 5 * p_in
+            seg_src[rb_pos[km]] = cbase + o_rb
+            seg_len[rb_pos[km]] = 1
+
+            # pair segments: ' ' name '="' value '"'; within-block
+            # ordinal = j - pairs_before_block(row, block_of_j)
+            rows2 = np.repeat(np.arange(R), pc)
+            jop = np.arange(int(pc.sum())) - np.repeat(
+                exclusive_cumsum(pc)[:-1], pc)
+            b_of = pair_sd[rows2, jop]
+            w_of = jop - pb_rb[rows2, b_of]
+            p0 = bstart[rows2, b_of] + 2 + 5 * w_of
+            ns = st[rows2] + np.asarray(out["name_start"])[:n][ridx][rows2, jop]
+            ne = st[rows2] + np.asarray(out["name_end"])[:n][ridx][rows2, jop]
+            vs = st[rows2] + np.asarray(out["val_start"])[:n][ridx][rows2, jop]
+            ve = st[rows2] + np.asarray(out["val_end"])[:n][ridx][rows2, jop]
+            seg_src[p0] = cbase + o_sp
+            seg_len[p0] = 1
+            seg_src[p0 + 1] = ns
+            seg_len[p0 + 1] = ne - ns
+            seg_src[p0 + 2] = cbase + o_eqq
+            seg_len[p0 + 2] = 2
+            seg_src[p0 + 3] = vs
+            seg_len[p0 + 3] = ve - vs
+            seg_src[p0 + 4] = cbase + o_q
+            seg_len[p0 + 4] = 1
+
+        # tail: ' ' + msg + framing suffix
+        t0 = rstart + HEAD + sd_segs
+        seg_src[t0] = cbase + o_sp
+        seg_len[t0] = 1
+        seg_src[t0 + 1] = msg_s
+        seg_len[t0 + 1] = msg_l
+        seg_src[t0 + 2] = cbase + o_sfx
+        seg_len[t0 + 2] = len(suffix)
+
+        dst0 = exclusive_cumsum(seg_len)
+        body = concat_segments(src, seg_src, seg_len, dst0)
+        row_off = np.concatenate([dst0[rstart], dst0[-1:]])
+        tier_lens = np.diff(row_off)
+        if syslen:
+            final_buf, row_off, prefix_lens_tier = apply_syslen_prefix(
+                body, row_off, tier_lens)
+        else:
+            final_buf = body.tobytes()
+
+    return finish_block(chunk_bytes, starts64, lens64, n, cand, ridx,
+                        final_buf, row_off, prefix_lens_tier, suffix,
+                        syslen, merger, encoder)
+

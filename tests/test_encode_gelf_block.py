@@ -220,3 +220,92 @@ def test_fuzz_block_vs_scalar():
     items = block_output(lines, merger)
     got = b"".join(i.data if isinstance(i, EncodedBlock) else i for i in items)
     assert got == b"".join(scalar_frames(lines, merger))
+
+
+# -- rfc5424 and ltsv block routes ------------------------------------------
+
+def _route_check(encoder_cls, cfg_text, merger, extra_lines=()):
+    cfg = Config.from_string(cfg_text)
+    enc = encoder_cls(cfg)
+    lines = [ln.encode("utf-8") for ln in CORPUS]
+    lines += [ln for ln in extra_lines]
+    want = []
+    for ln in lines:
+        try:
+            line = ln.decode("utf-8")
+            rec = ORACLE.decode(line)
+            payload = enc.encode(rec)
+        except Exception:
+            continue
+        want.append(merger.frame(payload) if merger is not None else payload)
+    tx = queue.Queue()
+    h = BatchHandler(tx, ORACLE, enc, cfg,
+                     fmt="rfc5424", start_timer=False, merger=merger)
+    for ln in lines:
+        h.handle_bytes(ln)
+    h.flush()
+    got = []
+    saw_block = False
+    while not tx.empty():
+        item = tx.get_nowait()
+        if isinstance(item, EncodedBlock):
+            saw_block = True
+            got.extend(item.iter_framed())
+        else:
+            got.append(merger.frame(item) if merger is not None else item)
+    assert saw_block
+    assert got == want
+
+
+@pytest.mark.parametrize("merger", [None, LineMerger(), NulMerger(),
+                                    SyslenMerger()],
+                         ids=["noop", "line", "nul", "syslen"])
+def test_rfc5424_block_route_matches_scalar(merger):
+    from flowgger_tpu.encoders.rfc5424 import RFC5424Encoder
+
+    _route_check(RFC5424Encoder, "", merger)
+
+
+@pytest.mark.parametrize("merger", [None, LineMerger(), SyslenMerger()],
+                         ids=["noop", "line", "syslen"])
+def test_ltsv_block_route_matches_scalar(merger):
+    from flowgger_tpu.encoders.ltsv import LTSVEncoder
+
+    _route_check(LTSVEncoder, "", merger, extra_lines=[
+        b"<13>1 2015-08-05T15:53:45Z h a p m - msg\twith tab",
+        b'<13>1 2015-08-05T15:53:45Z h a p m [id "co:lon"="v"] m',
+    ])
+
+
+def test_ltsv_block_route_with_extra():
+    from flowgger_tpu.encoders.ltsv import LTSVEncoder
+
+    _route_check(
+        LTSVEncoder,
+        '[output.ltsv_extra]\ncluster = "prod"\n"we:ird" = "v"\n',
+        LineMerger())
+
+
+def test_ltsv_block_newline_escaping():
+    """Messages containing raw newlines (reachable via nul/syslen
+    framing) must take the oracle path so LTSV's newline-to-space value
+    escape applies."""
+    from flowgger_tpu.encoders.ltsv import LTSVEncoder
+
+    enc = LTSVEncoder(Config.from_string(""))
+    lines = [b"<13>1 2015-08-05T15:53:45Z host app p m - msg with\nnewline",
+             b"<13>1 2015-08-05T15:53:45Z host app p m - clean"]
+    want = [enc.encode(ORACLE.decode(ln.decode())) for ln in lines]
+    assert b"message:msg with newline" in want[0]
+    tx = queue.Queue()
+    h = BatchHandler(tx, ORACLE, enc, Config.from_string(""),
+                     fmt="rfc5424", start_timer=False, merger=None)
+    for ln in lines:
+        h.handle_bytes(ln)
+    h.flush()
+    got = []
+    while not tx.empty():
+        item = tx.get_nowait()
+        got.extend(item.iter_unframed() if isinstance(item, EncodedBlock)
+                   else [item])
+    assert got == want
