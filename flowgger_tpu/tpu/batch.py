@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import sys
 import threading
+from collections import deque
 from typing import List, Optional
 
 import numpy as np
@@ -71,6 +72,11 @@ class BatchHandler(Handler):
         # serializes batch decodes so a timer flush racing a size flush
         # cannot reorder output
         self._decode_lock = threading.Lock()
+        # block-route double buffering: batch N decodes on-device while
+        # batch N-1's bytes are assembled on host (JAX dispatch is
+        # async; the fetch is the completion barrier).  Drained on
+        # timer/EOF flushes so latency stays bounded by one batch.
+        self._inflight = deque()
         self._timer: Optional[threading.Timer] = None
         self._start_timer = start_timer
         # direct span->bytes encodes for rfc5424 routes
@@ -112,7 +118,7 @@ class BatchHandler(Handler):
                 self._timer.daemon = True
                 self._timer.start()
         if full:
-            self.flush()
+            self.flush(drain=False)
 
     def ingest_spans(self, chunk: bytes, starts, lens) -> None:
         """Fast path fed by SyslenSplitter: a region plus pre-scanned
@@ -128,7 +134,7 @@ class BatchHandler(Handler):
                 self._timer.daemon = True
                 self._timer.start()
         if full:
-            self.flush()
+            self.flush(drain=False)
 
     def _pending_locked(self) -> int:
         return self._chunk_lines + self._span_count + len(self._lines)
@@ -142,12 +148,16 @@ class BatchHandler(Handler):
                 self._timer.daemon = True
                 self._timer.start()
         if full:
-            self.flush()
+            self.flush(drain=False)
 
     def handle_record(self, record: Record) -> None:
         self.scalar.handle_record(record)
 
-    def flush(self) -> None:
+    def flush(self, drain: bool = True) -> None:
+        """Decode pending input.  ``drain=False`` (size-triggered
+        flushes) leaves the newest block-route batch in flight so its
+        device decode overlaps the next batch's host work; timer and
+        end-of-stream flushes drain everything."""
         with self._lock:
             lines, self._lines = self._lines, []
             chunks, self._chunks = self._chunks, []
@@ -169,9 +179,22 @@ class BatchHandler(Handler):
                 self._decode_spans(*spans)
             if lines:
                 self._decode_batch(lines)
+            keep = 0 if drain else 1
+            while len(self._inflight) > keep:
+                self._pop_emit()
             _metrics.inc("batches")
             _metrics.inc("batch_lines", _metrics.get("input_lines") - n0)
             _metrics.batch_seconds.observe(_time.perf_counter() - t0)
+        if self._inflight and self._start_timer:
+            # a batch stays in flight with no new input guaranteed: arm
+            # the flush timer so the latency bound (one batch window)
+            # holds even if the stream pauses at a batch boundary
+            with self._lock:
+                if self._timer is None:
+                    self._timer = threading.Timer(self.flush_ms / 1000.0,
+                                                  self.flush)
+                    self._timer.daemon = True
+                    self._timer.start()
 
     # -- batched decode ----------------------------------------------------
     @staticmethod
@@ -262,8 +285,10 @@ class BatchHandler(Handler):
         route when engaged, else the per-row fast path (gelf/passthrough
         only), else the Record path."""
         if self._block_route_ok():
-            res = _encode_block_rfc5424(packed, self.encoder, self._merger)
-            self._emit_block(res, packed[5])
+            from . import rfc5424
+
+            handle = rfc5424.decode_rfc5424_submit(packed[0], packed[1])
+            self._inflight.append((handle, packed))
             return
         from ..encoders.gelf import GelfEncoder
         from ..encoders.passthrough import PassthroughEncoder
@@ -273,6 +298,22 @@ class BatchHandler(Handler):
                 _encode_packed_rfc5424_gelf(packed, self.encoder))
             return
         self._emit(_decode_packed(self.fmt, packed, self.scalar.decoder))
+
+    def _pop_emit(self) -> None:
+        import time as _time
+
+        from . import rfc5424
+
+        handle, packed = self._inflight.popleft()
+        t0 = _time.perf_counter()
+        host_out = rfc5424.decode_rfc5424_fetch(handle)
+        t1 = _time.perf_counter()
+        res = _encode_block_from_host(host_out, packed, self.encoder,
+                                      self._merger)
+        t2 = _time.perf_counter()
+        _metrics.add_seconds("device_fetch_seconds", t1 - t0)
+        _metrics.add_seconds("encode_seconds", t2 - t1)
+        self._emit_block(res, packed[5])
 
     def _emit_block(self, res, n_real: int) -> None:
         _metrics.inc("input_lines", n_real)
@@ -346,9 +387,9 @@ class BatchHandler(Handler):
             self.tx.put(encoded)
 
 
-def _encode_block_rfc5424(packed, encoder, merger):
-    """Columnar block encode for the rfc5424 kernel: decode once, then
-    dispatch on the encoder type (caller pre-checked applicability)."""
+def _encode_block_from_host(host_out, packed, encoder, merger):
+    """Columnar block encode from fetched kernel channels, dispatched
+    on the encoder type (caller pre-checked applicability)."""
     from ..encoders.ltsv import LTSVEncoder
     from ..encoders.passthrough import PassthroughEncoder
     from ..encoders.rfc5424 import RFC5424Encoder
@@ -357,11 +398,9 @@ def _encode_block_rfc5424(packed, encoder, merger):
         encode_ltsv_block,
         encode_passthrough_block,
         encode_rfc5424_block,
-        rfc5424,
     )
 
     batch, lens, chunk, starts, orig_lens, n_real = packed
-    host_out = rfc5424.decode_rfc5424_host(batch, lens)
     fn = {
         PassthroughEncoder:
             encode_passthrough_block.encode_rfc5424_passthrough_block,

@@ -560,18 +560,27 @@ _PAIR_KEYS = ("name_start", "name_end", "val_start", "val_end",
               "pair_sd", "val_has_esc")
 
 
-def decode_rfc5424_host(batch, lens, max_sd: int = DEFAULT_MAX_SD,
-                        extract_impl: str = None):
-    """Run the kernel and return host numpy channels, re-dispatching
-    pair-overflow rows (DEFAULT_MAX_PAIRS < pairs <= RESCUE_MAX_PAIRS)
-    through the wider tier-2 kernel so they stay on-device instead of
-    hitting the scalar fallback.  Pair channels come back widened to
-    RESCUE_MAX_PAIRS when any row needed tier 2."""
-    import numpy as np
-
+def decode_rfc5424_submit(batch, lens, max_sd: int = DEFAULT_MAX_SD,
+                          extract_impl: str = None):
+    """Dispatch the kernel asynchronously (JAX returns futures); pair
+    with ``decode_rfc5424_fetch``.  Splitting submit from fetch lets the
+    batch pipeline overlap device decode of batch N with host encoding
+    of batch N-1 (double buffering)."""
     impl = extract_impl or best_extract_impl()
     out = decode_rfc5424_jit(jnp.asarray(batch), jnp.asarray(lens),
                              max_sd=max_sd, extract_impl=impl)
+    return (out, batch, lens, max_sd, impl)
+
+
+def decode_rfc5424_fetch(handle):
+    """Block on a submitted decode and return host numpy channels,
+    re-dispatching pair-overflow rows (DEFAULT_MAX_PAIRS < pairs <=
+    RESCUE_MAX_PAIRS) through the wider tier-2 kernel so they stay
+    on-device instead of hitting the scalar fallback.  Pair channels
+    come back widened to RESCUE_MAX_PAIRS when any row needed tier 2."""
+    import numpy as np
+
+    out, batch, lens, max_sd, impl = handle
     host = {k: np.asarray(v) for k, v in out.items()}
     pc = host["pair_count"]
     over = np.flatnonzero((pc > DEFAULT_MAX_PAIRS) & (pc <= RESCUE_MAX_PAIRS))
@@ -602,6 +611,13 @@ def decode_rfc5424_host(batch, lens, max_sd: int = DEFAULT_MAX_SD,
             v[over] = host2[k][:over.size]
             merged[k] = v
     return merged
+
+
+def decode_rfc5424_host(batch, lens, max_sd: int = DEFAULT_MAX_SD,
+                        extract_impl: str = None):
+    """Synchronous submit + fetch."""
+    return decode_rfc5424_fetch(
+        decode_rfc5424_submit(batch, lens, max_sd, extract_impl))
 
 
 def best_extract_impl() -> str:

@@ -72,6 +72,7 @@ class Registry:
     def __init__(self):
         self._lock = threading.Lock()
         self._counters: Dict[str, int] = {name: 0 for name in _COUNTERS}
+        self._seconds: Dict[str, float] = {}
         self.batch_seconds = Histogram()
         self._reporter: Optional[threading.Thread] = None
         self._stop = threading.Event()
@@ -80,6 +81,12 @@ class Registry:
         with self._lock:
             self._counters[name] = self._counters.get(name, 0) + value
 
+    def add_seconds(self, name: str, value: float):
+        """Accumulate a per-stage wall-clock share (pipeline stage
+        timings: device_fetch_seconds, encode_seconds, ...)."""
+        with self._lock:
+            self._seconds[name] = self._seconds.get(name, 0.0) + value
+
     def get(self, name: str) -> int:
         with self._lock:
             return self._counters.get(name, 0)
@@ -87,8 +94,10 @@ class Registry:
     def snapshot(self) -> Dict[str, object]:
         with self._lock:
             counters = dict(self._counters)
+            seconds = {k: round(v, 6) for k, v in self._seconds.items()}
         snap: Dict[str, object] = {"ts": round(time.time(), 3)}
         snap.update(counters)
+        snap.update(seconds)
         snap["batch_seconds"] = self.batch_seconds.snapshot()
         return snap
 
@@ -96,6 +105,7 @@ class Registry:
         with self._lock:
             for k in self._counters:
                 self._counters[k] = 0
+            self._seconds.clear()
         self.batch_seconds = Histogram()
 
     # -- periodic reporter -------------------------------------------------

@@ -309,3 +309,56 @@ def test_ltsv_block_newline_escaping():
         got.extend(item.iter_unframed() if isinstance(item, EncodedBlock)
                    else [item])
     assert got == want
+
+
+def test_pipelined_flushes_preserve_order_and_drain():
+    """Size-triggered flushes leave one batch in flight (device decode
+    overlapping host encode); order across batches is preserved and a
+    final flush drains everything."""
+    lines = [
+        f'<13>1 2015-08-05T15:53:45.{i:03d}Z host{i} app {i} m '
+        f'[sd@1 k="{i}"] message {i}'.encode()
+        for i in range(40)
+    ]
+    merger = LineMerger()
+    cfg = Config.from_string("[input]\ntpu_batch_size = 8\n")
+    tx = queue.Queue()
+    h = BatchHandler(tx, ORACLE, ENC, cfg, fmt="rfc5424",
+                     start_timer=False, merger=merger)
+    for ln in lines:
+        h.handle_bytes(ln)  # triggers drain=False flushes every 8 lines
+    assert len(h._inflight) == 1  # one batch still in flight
+    h.flush()                      # EOF drain
+    assert len(h._inflight) == 0
+    got = []
+    while not tx.empty():
+        got.extend(tx.get_nowait().iter_framed())
+    assert got == scalar_frames(lines, merger)
+
+
+def test_inflight_batch_drains_on_timer():
+    """A stream pausing exactly at a batch boundary must still emit the
+    held batch within the flush window (the size flush re-arms the
+    timer when it leaves a batch in flight)."""
+    import time
+
+    lines = [
+        f'<13>1 2015-08-05T15:53:45Z host app {i} m - boundary {i}'.encode()
+        for i in range(8)
+    ]
+    cfg = Config.from_string(
+        "[input]\ntpu_batch_size = 8\ntpu_flush_ms = 50\n")
+    tx = queue.Queue()
+    h = BatchHandler(tx, ORACLE, ENC, cfg, fmt="rfc5424",
+                     start_timer=True, merger=LineMerger())
+    for ln in lines:
+        h.handle_bytes(ln)  # exactly one full batch: flush(drain=False)
+    deadline = time.time() + 5
+    got = []
+    while len(got) < 8 and time.time() < deadline:
+        try:
+            item = tx.get(timeout=0.2)
+            got.extend(item.iter_framed())
+        except queue.Empty:
+            pass
+    assert len(got) == 8  # arrived via the re-armed timer, no EOF flush
