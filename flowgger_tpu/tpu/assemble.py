@@ -192,6 +192,21 @@ def decimal_segments(values: np.ndarray, digits_off: int,
     return seg_src, seg_len
 
 
+def count_in_spans(cum: np.ndarray, a: np.ndarray, b: np.ndarray):
+    """Occurrences within [a, b) given an inclusive prefix-count.
+    Indices are clipped: callers mask out invalid spans afterwards, but
+    padded/kernel-flagged rows may carry out-of-range placeholders.
+    An empty source buffer (all-empty messages) counts as zero
+    everywhere — np.where evaluates both branches, so the clip alone
+    cannot protect indexing into a zero-length array."""
+    if cum.size == 0:
+        return np.zeros(np.broadcast(a, b).shape, dtype=np.int64)
+    top = cum.size - 1
+    hi = np.where(b > 0, cum[np.clip(b - 1, 0, top)], 0)
+    lo = np.where(a > 0, cum[np.clip(a - 1, 0, top)], 0)
+    return hi - lo
+
+
 def syslen_prefix_segments(body_lens: np.ndarray, digits_base: int
                            ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
     """Per-row syslen framing prefix ``"{body_len} "`` as 2D segment

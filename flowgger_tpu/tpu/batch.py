@@ -85,10 +85,11 @@ class BatchHandler(Handler):
         from ..encoders.passthrough import PassthroughEncoder
         from ..encoders.rfc5424 import RFC5424Encoder
 
-        self._fast_encode = fmt == "rfc5424" and (
+        self._fast_encode = (fmt == "rfc5424" and (
             type(encoder) in (GelfEncoder, RFC5424Encoder, LTSVEncoder)
             or (type(encoder) is PassthroughEncoder
                 and encoder.header_time_format is None))
+        ) or (fmt == "rfc3164" and type(encoder) is GelfEncoder)
         # single source of truth for kernel dispatch: fmt -> batch decoder
         auto_ltsv = self._auto_ltsv_decoder(cfg) if fmt == "auto" else None
         self._auto_ltsv = auto_ltsv
@@ -264,7 +265,7 @@ class BatchHandler(Handler):
     def _block_route_ok(self) -> bool:
         """Cheap applicability check, evaluated before any kernel work so
         an inapplicable route never pays a wasted device decode."""
-        if not self._block_mode:
+        if not self._block_mode or self.fmt not in ("rfc5424", "rfc3164"):
             return False
         from ..encoders.gelf import GelfEncoder
         from ..encoders.ltsv import LTSVEncoder
@@ -274,6 +275,10 @@ class BatchHandler(Handler):
 
         if merger_suffix(self._merger) is None:
             return False
+        if self.fmt == "rfc3164":
+            # legacy-syslog fast path currently block-encodes GELF only
+            return (type(self.encoder) is GelfEncoder
+                    and not self.encoder.extra)
         if type(self.encoder) is GelfEncoder:
             return not self.encoder.extra
         if type(self.encoder) is PassthroughEncoder:
@@ -285,15 +290,21 @@ class BatchHandler(Handler):
         route when engaged, else the per-row fast path (gelf/passthrough
         only), else the Record path."""
         if self._block_route_ok():
-            from . import rfc5424
+            if self.fmt == "rfc3164":
+                from . import rfc3164
 
-            handle = rfc5424.decode_rfc5424_submit(packed[0], packed[1])
+                handle = rfc3164.decode_rfc3164_submit(packed[0], packed[1])
+            else:
+                from . import rfc5424
+
+                handle = rfc5424.decode_rfc5424_submit(packed[0], packed[1])
             self._inflight.append((handle, packed))
             return
         from ..encoders.gelf import GelfEncoder
         from ..encoders.passthrough import PassthroughEncoder
 
-        if type(self.encoder) in (GelfEncoder, PassthroughEncoder):
+        if self.fmt == "rfc5424" and type(self.encoder) in (
+                GelfEncoder, PassthroughEncoder):
             self._emit_encoded(
                 _encode_packed_rfc5424_gelf(packed, self.encoder))
             return
@@ -302,14 +313,23 @@ class BatchHandler(Handler):
     def _pop_emit(self) -> None:
         import time as _time
 
-        from . import rfc5424
-
         handle, packed = self._inflight.popleft()
         t0 = _time.perf_counter()
-        host_out = rfc5424.decode_rfc5424_fetch(handle)
-        t1 = _time.perf_counter()
-        res = _encode_block_from_host(host_out, packed, self.encoder,
-                                      self._merger)
+        if self.fmt == "rfc3164":
+            from . import encode_rfc3164_gelf_block, rfc3164
+
+            host_out = rfc3164.decode_rfc3164_fetch(handle)
+            t1 = _time.perf_counter()
+            res = encode_rfc3164_gelf_block.encode_rfc3164_gelf_block(
+                packed[2], packed[3], packed[4], host_out, packed[5],
+                packed[0].shape[1], self.encoder, self._merger)
+        else:
+            from . import rfc5424
+
+            host_out = rfc5424.decode_rfc5424_fetch(handle)
+            t1 = _time.perf_counter()
+            res = _encode_block_from_host(host_out, packed, self.encoder,
+                                          self._merger)
         t2 = _time.perf_counter()
         _metrics.add_seconds("device_fetch_seconds", t1 - t0)
         _metrics.add_seconds("encode_seconds", t2 - t1)

@@ -102,9 +102,11 @@ def finish_block(
     syslen: bool,
     merger: Optional[Merger],
     encoder,
+    scalar_fn=_scalar_line,
 ) -> BlockResult:
-    """Fallback rows through the scalar oracle, splice in input order,
-    compute message bounds; returns the BlockResult."""
+    """Fallback rows through the scalar oracle (``scalar_fn``, the
+    rfc5424 one by default), splice in input order, compute message
+    bounds; returns the BlockResult."""
     errors: List[Tuple[str, str]] = []
     row_bytes_len = np.zeros(n, dtype=np.int64)
     emit = np.zeros(n, dtype=bool)
@@ -126,7 +128,7 @@ def finish_block(
             errors.append(("__utf8__", ""))
             continue
         fallback_rows += 1
-        res = _scalar_line(line)
+        res = scalar_fn(line)
         if res.record is None:
             errors.append((res.error, line))
             continue

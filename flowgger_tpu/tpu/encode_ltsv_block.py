@@ -22,6 +22,7 @@ from ..utils.rustfmt import display_f64
 from .assemble import (
     build_source,
     concat_segments,
+    count_in_spans,
     decimal_segments,
     exclusive_cumsum,
 )
@@ -33,15 +34,6 @@ from .block_common import (
     ts_scratch,
 )
 
-
-def _count_in_spans(cum: np.ndarray, a: np.ndarray, b: np.ndarray):
-    """Occurrences within [a, b) given an inclusive prefix-count.
-    Indices are clipped: callers mask out invalid spans afterwards, but
-    padded/kernel-flagged rows may carry out-of-range placeholders."""
-    top = cum.size - 1
-    hi = np.where(b > 0, cum[np.clip(b - 1, 0, top)], 0)
-    lo = np.where(a > 0, cum[np.clip(a - 1, 0, top)], 0)
-    return hi - lo
 
 
 def encode_rfc5424_ltsv_block(
@@ -74,7 +66,7 @@ def encode_rfc5424_ltsv_block(
     # (both map to space): cumulative count per row span, one pass over
     # the chunk (newlines reach this route via nul/syslen framing)
     esc_cum = np.cumsum((chunk_arr == 9) | (chunk_arr == 10))
-    row_esc = _count_in_spans(esc_cum, starts64, starts64 + lens64)
+    row_esc = count_in_spans(esc_cum, starts64, starts64 + lens64)
     cand &= row_esc == 0
     # SD names containing ':' would need key escaping (rare): count per
     # name span, reduce per row
@@ -86,7 +78,7 @@ def encode_rfc5424_ltsv_block(
         ne_all = starts64[:, None] + np.asarray(out["name_end"])[:n]
         col_cum = np.cumsum(chunk_arr == ord(":"))
         ncols = np.where(jmask,
-                         _count_in_spans(col_cum, ns_all, ne_all), 0)
+                         count_in_spans(col_cum, ns_all, ne_all), 0)
         cand &= ncols.sum(axis=1) == 0
 
     ridx = np.flatnonzero(cand)

@@ -1,0 +1,145 @@
+"""Columnar RFC3164→GELF encoding: the legacy-syslog fast path's span
+tables become framed GELF bytes with eleven fixed segments per row.
+
+An rfc3164 fast-path record (materialize_rfc3164.py) carries no SD, no
+appname/procid/msgid, an unstripped message, and the whole line as
+full_message, so its sorted-key GELF object is exactly::
+
+    {"full_message":F,"host":H,["level":N,]"short_message":M,
+     "timestamp":T,"version":"1.1"}
+
+with JSON escaping on the three spans (the shared sparse EscapeMap) and
+the level segments zero-length for no-PRI rows.  Rows outside the tier
+(kernel-flagged, oversized, non-ASCII via the kernel's has_high
+channel) re-run the scalar rfc3164 oracle, keeping bytes identical to
+decoder→GelfEncoder in every case.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..mergers import Merger
+from ..utils.rustfmt import json_f64
+from .assemble import (
+    build_source,
+    concat_segments,
+    escape_json,
+    exclusive_cumsum,
+)
+from .block_common import (
+    BlockResult,
+    apply_syslen_prefix,
+    finish_block,
+    merger_suffix,
+    ts_scratch,
+)
+from .materialize_rfc3164 import _scalar_3164
+
+_C_OPEN = b'{"full_message":"'
+_C_HOST = b'","host":"'
+_C_LEVEL = b'","level":'
+_C_SHORT_PRI = b',"short_message":"'     # after the bare level number
+_C_SHORT_NOPRI = b'","short_message":"'  # closing the host string
+_C_TS = b'","timestamp":'
+_C_TAIL = b',"version":"1.1"}'
+_C_SEVD = b"01234567"
+
+_SEGS = 11
+
+
+def encode_rfc3164_gelf_block(
+    chunk_bytes: bytes,
+    starts: np.ndarray,
+    orig_lens: np.ndarray,
+    out: Dict[str, np.ndarray],
+    n_real: int,
+    max_len: int,
+    encoder,
+    merger: Optional[Merger],
+) -> Optional[BlockResult]:
+    spec = merger_suffix(merger)
+    if spec is None or encoder.extra:
+        return None
+    suffix, syslen = spec
+
+    n = int(n_real)
+    starts64 = np.asarray(starts[:n], dtype=np.int64)
+    lens64 = np.asarray(orig_lens[:n], dtype=np.int64)
+    ok = np.asarray(out["ok"][:n], dtype=bool)
+    chunk_arr = np.frombuffer(chunk_bytes, dtype=np.uint8)
+    has_high = np.asarray(out["has_high"][:n], dtype=bool)
+    cand = ok & (lens64 <= max_len) & ~has_high
+
+    ridx = np.flatnonzero(cand)
+    R = ridx.size
+    final_buf = b""
+    row_off = np.zeros(1, dtype=np.int64)
+    prefix_lens_tier: Optional[np.ndarray] = None
+
+    if R:
+        emap = escape_json(chunk_arr)
+        st = starts64[ridx]
+
+        def espan(a_abs, b_abs):
+            ea = emap.map(a_abs)
+            return ea, emap.map(b_abs) - ea
+
+        row_end = st + lens64[ridx]
+        full_src, full_len = espan(st, row_end)
+        host_a = st + np.asarray(out["host_start"])[:n][ridx]
+        host_b = st + np.asarray(out["host_end"])[:n][ridx]
+        host_src, host_len = espan(host_a, host_b)
+        msg_a = st + np.asarray(out["msg_start"])[:n][ridx]
+        msg_src, msg_len = espan(msg_a, row_end)
+        has_pri = np.asarray(out["has_pri"][:n], dtype=bool)[ridx]
+        sev = np.asarray(out["severity"])[:n][ridx].astype(np.int64)
+
+        scratch, ts_off, ts_len = ts_scratch(out, n, ridx, json_f64)
+        consts, offs = build_source(
+            _C_OPEN, _C_HOST, _C_LEVEL, _C_SHORT_PRI, _C_SHORT_NOPRI,
+            _C_TS, _C_TAIL + suffix, _C_SEVD, scratch)
+        (o_open, o_host, o_level, o_short_p, o_short_n, o_ts, o_tail,
+         o_sevd, o_scratch) = offs
+        cbase = int(emap.esc.size)
+        src = np.concatenate([emap.esc, consts])
+
+        # (no empty-host substitution: the kernel only marks rows ok
+        # when the host span is non-empty, rfc3164.py host_e > host_s)
+        seg_src = np.empty((R, _SEGS), dtype=np.int64)
+        seg_len = np.empty((R, _SEGS), dtype=np.int64)
+        cols = (
+            (cbase + o_open, len(_C_OPEN)),
+            (full_src, full_len),
+            (cbase + o_host, len(_C_HOST)),
+            (host_src, host_len),
+            (cbase + o_level, np.where(has_pri, len(_C_LEVEL), 0)),
+            (cbase + o_sevd + sev, np.where(has_pri, 1, 0)),
+            (np.where(has_pri, cbase + o_short_p, cbase + o_short_n),
+             np.where(has_pri, len(_C_SHORT_PRI), len(_C_SHORT_NOPRI))),
+            (msg_src, msg_len),
+            (cbase + o_ts, len(_C_TS)),
+            (cbase + o_scratch + ts_off, ts_len),
+            (cbase + o_tail, len(_C_TAIL) + len(suffix)),
+        )
+        for k, (s, ln) in enumerate(cols):
+            seg_src[:, k] = s
+            seg_len[:, k] = ln
+
+        flat_src = seg_src.ravel()
+        flat_len = seg_len.ravel()
+        dst0 = exclusive_cumsum(flat_len)
+        body = concat_segments(src, flat_src, flat_len, dst0)
+        row_off = dst0[::_SEGS]
+        tier_lens = np.diff(row_off)
+        if syslen:
+            final_buf, row_off, prefix_lens_tier = apply_syslen_prefix(
+                body, row_off, tier_lens)
+        else:
+            final_buf = body.tobytes()
+
+    return finish_block(chunk_bytes, starts64, lens64, n, cand, ridx,
+                        final_buf, row_off, prefix_lens_tier, suffix,
+                        syslen, merger, encoder, scalar_fn=_scalar_3164)

@@ -191,6 +191,7 @@ def decode_rfc3164(batch: jnp.ndarray, lens: jnp.ndarray, year,
     return {
         "ok": ok,
         "has_pri": has_pri,
+        "has_high": jnp.any((bb >= 128) & valid, axis=1),
         "facility": pri >> 3,
         "severity": pri & 7,
         "days": days,
@@ -205,3 +206,20 @@ def decode_rfc3164(batch: jnp.ndarray, lens: jnp.ndarray, year,
 @functools.partial(jax.jit, static_argnames=())
 def decode_rfc3164_jit(batch, lens, year):
     return decode_rfc3164(batch, lens, year)
+
+
+def decode_rfc3164_submit(batch, lens):
+    """Asynchronous dispatch (pair with decode_rfc3164_fetch) — the
+    rfc3164 leg of the block pipeline's double buffering."""
+    import jax.numpy as jnp
+
+    from ..utils.timeparse import current_year_utc
+
+    return decode_rfc3164_jit(jnp.asarray(batch), jnp.asarray(lens),
+                              jnp.int32(current_year_utc()))
+
+
+def decode_rfc3164_fetch(handle):
+    import numpy as np
+
+    return {k: np.asarray(v) for k, v in handle.items()}

@@ -22,7 +22,8 @@ from flowgger_tpu.tpu.batch import BatchHandler
 from test_tpu_rfc5424 import CORPUS
 
 ORACLE = RFC5424Decoder()
-ENC = GelfEncoder(Config.from_string(""))
+CFG_EMPTY = Config.from_string("")
+ENC = GelfEncoder(CFG_EMPTY)
 
 
 def scalar_frames(lines, merger):
@@ -362,3 +363,118 @@ def test_inflight_batch_drains_on_timer():
         except queue.Empty:
             pass
     assert len(got) == 8  # arrived via the re-armed timer, no EOF flush
+
+
+def test_rfc3164_gelf_block_route_matches_scalar():
+    """rfc3164_tpu -> GELF block route: byte-identical to the scalar
+    decoder+encoder across standard-layout, custom-layout (fallback),
+    no-PRI, unicode and invalid lines."""
+    from flowgger_tpu.decoders.rfc3164 import RFC3164Decoder
+
+    dec = RFC3164Decoder(CFG_EMPTY)
+    lines = [
+        b"<34>Aug  5 15:53:45 testhost app[123]: standard layout line",
+        b"<13>Oct 11 22:14:15 mymachine su: 'su root' failed",
+        b"Aug  5 15:53:45 host prog: no pri line",
+        b"<34>testhost: Aug 5 15:53:45: custom layout line",
+        b"<34>Aug  5 15:53:45 host app: unicode m\xc3\xa9ssage",
+        b"<34>Aug  5 15:53:45 host app: quote\"and\\backslash",
+        b"completely invalid",
+        b"",
+        b"<34>Aug  5 15:53:45 emptyhost ",
+    ]
+    for merger in (None, LineMerger(), SyslenMerger()):
+        want = []
+        for ln in lines:
+            try:
+                rec = dec.decode(ln.decode("utf-8"))
+                payload = ENC.encode(rec)
+            except Exception:
+                continue
+            want.append(merger.frame(payload) if merger is not None
+                        else payload)
+        tx = queue.Queue()
+        h = BatchHandler(tx, dec, ENC, CFG_EMPTY, fmt="rfc3164",
+                         start_timer=False, merger=merger)
+        for ln in lines:
+            h.handle_bytes(ln)
+        h.flush()
+        got = []
+        saw_block = False
+        while not tx.empty():
+            item = tx.get_nowait()
+            if isinstance(item, EncodedBlock):
+                saw_block = True
+                got.extend(item.iter_framed())
+            else:
+                got.append(merger.frame(item) if merger is not None
+                           else item)
+        assert saw_block
+        assert got == want, merger
+
+
+def test_rfc3164_gelf_block_fuzz():
+    from flowgger_tpu.decoders.rfc3164 import RFC3164Decoder
+    import random
+
+    dec = RFC3164Decoder(CFG_EMPTY)
+    rng = random.Random(11)
+    base = [
+        b"<34>Aug  5 15:53:45 testhost app[123]: a valid legacy message",
+        b"<13>Oct 11 22:14:15 mymachine su: 'su root' failed for lonvick",
+        b"Aug  5 15:53:45 host prog: no pri either",
+    ]
+    lines = []
+    for _ in range(300):
+        b = bytearray(rng.choice(base))
+        for _ in range(rng.randrange(4)):
+            if b:
+                b[rng.randrange(len(b))] = rng.randrange(256)
+        lines.append(bytes(b))
+    merger = LineMerger()
+    want = []
+    for ln in lines:
+        try:
+            rec = dec.decode(ln.decode("utf-8"))
+            want.append(merger.frame(ENC.encode(rec)))
+        except Exception:
+            continue
+    tx = queue.Queue()
+    h = BatchHandler(tx, dec, ENC, CFG_EMPTY, fmt="rfc3164",
+                     start_timer=False, merger=merger)
+    for ln in lines:
+        h.handle_bytes(ln)
+    h.flush()
+    got = []
+    while not tx.empty():
+        item = tx.get_nowait()
+        got.extend(item.iter_framed() if isinstance(item, EncodedBlock)
+                   else [merger.frame(item)])
+    assert got == want
+
+
+def test_block_routes_survive_all_empty_batch():
+    """A batch of only empty messages (keep-alive newlines) must not
+    crash any block route — empty chunks have zero-length prefix-count
+    arrays."""
+    from flowgger_tpu.decoders.rfc3164 import RFC3164Decoder
+    from flowgger_tpu.encoders.ltsv import LTSVEncoder
+
+    for fmt, dec, enc in (
+        ("rfc5424", ORACLE, ENC),
+        ("rfc5424", ORACLE, LTSVEncoder(CFG_EMPTY)),
+        ("rfc3164", RFC3164Decoder(CFG_EMPTY), ENC),
+    ):
+        tx = queue.Queue()
+        h = BatchHandler(tx, dec, enc, CFG_EMPTY, fmt=fmt,
+                         start_timer=False, merger=LineMerger())
+        for _ in range(4):
+            h.handle_bytes(b"")
+        h.flush()
+        emitted = []
+        while not tx.empty():
+            item = tx.get_nowait()
+            emitted.extend(item.iter_framed()
+                           if isinstance(item, EncodedBlock) else [item])
+        # every empty line is a decode error in all three configs
+        assert emitted == [], (fmt, type(enc).__name__)
