@@ -89,7 +89,7 @@ class BatchHandler(Handler):
             type(encoder) in (GelfEncoder, RFC5424Encoder, LTSVEncoder)
             or (type(encoder) is PassthroughEncoder
                 and encoder.header_time_format is None))
-        ) or (fmt == "rfc3164" and type(encoder) is GelfEncoder)
+        ) or (fmt in ("rfc3164", "ltsv") and type(encoder) is GelfEncoder)
         # single source of truth for kernel dispatch: fmt -> batch decoder
         auto_ltsv = self._auto_ltsv_decoder(cfg) if fmt == "auto" else None
         self._auto_ltsv = auto_ltsv
@@ -265,7 +265,8 @@ class BatchHandler(Handler):
     def _block_route_ok(self) -> bool:
         """Cheap applicability check, evaluated before any kernel work so
         an inapplicable route never pays a wasted device decode."""
-        if not self._block_mode or self.fmt not in ("rfc5424", "rfc3164"):
+        if not self._block_mode or self.fmt not in ("rfc5424", "rfc3164",
+                                                     "ltsv"):
             return False
         from ..encoders.gelf import GelfEncoder
         from ..encoders.ltsv import LTSVEncoder
@@ -279,6 +280,11 @@ class BatchHandler(Handler):
             # legacy-syslog fast path currently block-encodes GELF only
             return (type(self.encoder) is GelfEncoder
                     and not self.encoder.extra)
+        if self.fmt == "ltsv":
+            # untyped LTSV decode block-encodes GELF only
+            return (type(self.encoder) is GelfEncoder
+                    and not self.encoder.extra
+                    and not self.scalar.decoder.schema)
         if type(self.encoder) is GelfEncoder:
             return not self.encoder.extra
         if type(self.encoder) is PassthroughEncoder:
@@ -294,6 +300,10 @@ class BatchHandler(Handler):
                 from . import rfc3164
 
                 handle = rfc3164.decode_rfc3164_submit(packed[0], packed[1])
+            elif self.fmt == "ltsv":
+                from . import ltsv
+
+                handle = ltsv.decode_ltsv_submit(packed[0], packed[1])
             else:
                 from . import rfc5424
 
@@ -323,6 +333,15 @@ class BatchHandler(Handler):
             res = encode_rfc3164_gelf_block.encode_rfc3164_gelf_block(
                 packed[2], packed[3], packed[4], host_out, packed[5],
                 packed[0].shape[1], self.encoder, self._merger)
+        elif self.fmt == "ltsv":
+            from . import encode_ltsv_gelf_block, ltsv
+
+            host_out = ltsv.decode_ltsv_fetch(handle)
+            t1 = _time.perf_counter()
+            res = encode_ltsv_gelf_block.encode_ltsv_gelf_block(
+                packed[2], packed[3], packed[4], host_out, packed[5],
+                packed[0].shape[1], self.encoder, self._merger,
+                self.scalar.decoder)
         else:
             from . import rfc5424
 

@@ -44,6 +44,34 @@ def ts_scratch(out, n: int, ridx: np.ndarray, fmt_fn):
     return scratch, uoff[inv], ulen[inv]
 
 
+def sorted_pair_order(chunk_arr: np.ndarray, rop: np.ndarray,
+                      ns_abs: np.ndarray, ne_abs: np.ndarray, cap: int):
+    """Sort a flat pair table by (row, name bytes) and detect duplicate
+    names within a row.
+
+    Sort keys are the name bytes packed big-endian into uint64 words via
+    a contiguous view, width adapting to the batch's longest name (the
+    caller guarantees names <= ``cap`` bytes).  Returns (order indices,
+    duplicate-row ids) — callers drop duplicate rows to the scalar
+    oracle for dict last-wins semantics, or handle them natively."""
+    max_name = int((ne_abs - ns_abs).max(initial=0))
+    K = max(8, min(cap, -(-max_name // 8) * 8))
+    gidx = (ns_abs[:, None]
+            + np.arange(K, dtype=np.int64)[None, :]).astype(np.int32)
+    nm = np.where(gidx < ne_abs[:, None].astype(np.int32),
+                  chunk_arr[np.minimum(gidx, chunk_arr.size - 1)],
+                  np.uint8(0))
+    words = np.ascontiguousarray(nm).view(">u8")
+    order = np.lexsort(tuple(words[:, w] for w in range(K // 8 - 1, -1, -1))
+                       + (rop,))
+    srop = rop[order]
+    swords = words[order]
+    dup = (srop[1:] == srop[:-1]) & (swords[1:] == swords[:-1]).all(axis=1)
+    dup_rows = np.unique(srop[1:][dup]) if dup.any() else np.zeros(
+        0, dtype=rop.dtype)
+    return order, dup_rows
+
+
 def apply_syslen_prefix(body: np.ndarray, row_off: np.ndarray,
                         tier_lens: np.ndarray):
     """Prepend the syslen length prefix per row via one more segment

@@ -47,6 +47,7 @@ from .block_common import (
     apply_syslen_prefix,
     finish_block,
     merger_suffix,
+    sorted_pair_order,
     ts_scratch,
 )
 
@@ -129,7 +130,6 @@ def encode_rfc5424_gelf_block(
         # numpy tier limits: SD name length cap + no duplicate names
         jmask = np.arange(name_start.shape[1])[None, :] < pair_count[:, None]
         nlen = np.where(jmask, name_end - name_start, 0)
-        max_name = int(nlen.max(initial=0))
         cand &= nlen.max(axis=1, initial=0) <= _NAME_KEY_MAX
 
         # pair table sorted by (row, name bytes)
@@ -144,26 +144,11 @@ def encode_rfc5424_gelf_block(
             ne_abs = starts64[rop] + name_end[rop, jop]
             vs_abs = starts64[rop] + np.asarray(out["val_start"])[:n][rop, jop]
             ve_abs = starts64[rop] + np.asarray(out["val_end"])[:n][rop, jop]
-            # sort keys: name bytes packed big-endian into uint64 words
-            # via a contiguous view — width adapts to the longest name
-            K = max(8, min(_NAME_KEY_MAX, -(-max_name // 8) * 8))
-            gidx = (ns_abs[:, None]
-                    + np.arange(K, dtype=np.int64)[None, :]).astype(np.int32)
-            nm = np.where(gidx < ne_abs[:, None].astype(np.int32),
-                          chunk_arr[np.minimum(gidx, chunk_arr.size - 1)],
-                          np.uint8(0))
-            words = np.ascontiguousarray(nm).view(">u8")
-            order = np.lexsort(
-                tuple(words[:, w] for w in range(K // 8 - 1, -1, -1))
-                + (rop,))
-            srop = rop[order]
-            swords = words[order]
-            dup = ((srop[1:] == srop[:-1])
-                   & (swords[1:] == swords[:-1]).all(axis=1))
-            if dup.any():
-                cand[np.unique(srop[1:][dup])] = False
-                order = order[cand[srop]]
-                srop = rop[order]
+            order, dup_rows = sorted_pair_order(chunk_arr, rop, ns_abs,
+                                                ne_abs, _NAME_KEY_MAX)
+            if dup_rows.size:
+                cand[dup_rows] = False
+                order = order[cand[rop[order]]]
             ns_s, ne_s = ns_abs[order], ne_abs[order]
             vs_s, ve_s = vs_abs[order], ve_abs[order]
 

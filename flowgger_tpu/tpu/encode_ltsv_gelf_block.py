@@ -1,0 +1,292 @@
+"""Columnar LTSV→GELF encoding: the LTSV kernel's part/special-key span
+tables become framed GELF bytes per batch.
+
+An untyped LTSV record (materialize_ltsv.py, no ``ltsv_schema``/
+``ltsv_suffixes`` configured) maps to the sorted-key GELF object::
+
+    {"_<key>":V..., "full_message":L, "host":H, ["level":N,]
+     "short_message":M|-, "timestamp":T, "version":"1.1"}
+
+Pair keys are emitted sorted (the shared uint64-word lexsort), values
+JSON-escaped via the sparse EscapeMap.  Rows with typed schemas (whole
+route disabled), duplicate keys, colon-less parts (the scalar path
+prints a "Missing value" notice), unix-literal-timestamp parse quirks,
+or non-ASCII bytes re-run the scalar oracle, keeping bytes identical to
+decoder→GelfEncoder.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..mergers import Merger
+from ..utils.rustfmt import json_f64
+from .assemble import (
+    build_source,
+    concat_segments,
+    escape_json,
+    exclusive_cumsum,
+)
+from .block_common import (
+    BlockResult,
+    apply_syslen_prefix,
+    finish_block,
+    merger_suffix,
+    sorted_pair_order,
+    ts_scratch,
+)
+from .materialize_ltsv import _scalar_ltsv
+
+_C_P0 = b'"_'
+_C_P1 = b'":"'
+_C_P2 = b'",'
+_C_FULL = b'"full_message":"'
+_C_HOST = b'","host":"'
+_C_LEVEL = b'","level":'
+_C_SHORT_LVL = b',"short_message":'    # after the bare level number
+_C_SHORT = b'","short_message":'      # closing the host string
+_C_TS = b',"timestamp":'
+_C_TAIL = b',"version":"1.1"}'
+_C_UNKNOWN = b"unknown"
+_C_DASH = b'"-"'
+_C_SEVD = b"01234567"
+_NAME_CAP = 48
+
+
+def encode_ltsv_gelf_block(
+    chunk_bytes: bytes,
+    starts: np.ndarray,
+    orig_lens: np.ndarray,
+    out: Dict[str, np.ndarray],
+    n_real: int,
+    max_len: int,
+    encoder,
+    merger: Optional[Merger],
+    decoder,
+) -> Optional[BlockResult]:
+    spec = merger_suffix(merger)
+    if spec is None or encoder.extra:
+        return None
+    if decoder.schema:
+        # typed values need Python conversion: Record path (suffixes
+        # are only consulted for schema-typed keys, so untyped configs
+        # qualify regardless of the suffix table)
+        return None
+
+    n = int(n_real)
+    starts64 = np.asarray(starts[:n], dtype=np.int64)
+    lens64 = np.asarray(orig_lens[:n], dtype=np.int64)
+    suffix, syslen = spec
+    ok = np.asarray(out["ok"][:n], dtype=bool)
+    has_high = np.asarray(out["has_high"][:n], dtype=bool)
+    n_parts = np.asarray(out["n_parts"])[:n].astype(np.int64)
+    part_start = np.asarray(out["part_start"])[:n]
+    part_end = np.asarray(out["part_end"])[:n]
+    colon_pos = np.asarray(out["colon_pos"])[:n]
+    host_pos = np.asarray(out["host_pos"])[:n]
+    ts_kind = np.asarray(out["ts_kind"])[:n]
+
+    P = part_start.shape[1]
+    jmask = np.arange(P)[None, :] < n_parts[:, None]
+    cand = ok & (lens64 <= max_len) & ~has_high & (host_pos >= 0)
+    # colon-less parts trigger the scalar path's stdout notice
+    cand &= ~(jmask & (colon_pos < 0)).any(axis=1)
+    # pair-name length cap for the sort-key matrix; special keys are
+    # excluded from pairs but bound the same way for simplicity
+    nlen = np.where(jmask, colon_pos - part_start, 0)
+    cand &= nlen.max(axis=1, initial=0) <= _NAME_CAP
+
+    chunk_arr = np.frombuffer(chunk_bytes, dtype=np.uint8)
+
+    # pair table: parts whose key NAME is not one of the special keys.
+    # Matching by the kernel's special positions would only catch the
+    # last occurrence; the scalar decoder routes every occurrence of a
+    # repeated special key (later assignments overwrite), and errors if
+    # any occurrence fails to parse — so name-match here, and drop rows
+    # with repeated special names to the oracle for exact parity.
+    key8 = (starts64[:, None, None] + part_start[:, :, None]
+            + np.arange(8, dtype=np.int64)[None, None, :])
+    km = chunk_arr[np.clip(key8, 0, max(chunk_arr.size - 1, 0))] \
+        if chunk_arr.size else np.zeros((n, P, 8), dtype=np.uint8)
+    special_name = np.zeros((n, P), dtype=bool)
+    for word in (b"time", b"host", b"message", b"level"):
+        match = jmask & (nlen == len(word))
+        for i, ch in enumerate(word[:8]):
+            match &= km[:, :, i] == ch
+        special_name |= match
+        cand &= match.sum(axis=1) <= 1
+    is_pair = jmask & ~special_name & cand[:, None]
+
+    pc = is_pair.sum(axis=1).astype(np.int64)
+    T = int(pc.sum())
+    if T:
+        rows_all, cols_all = np.nonzero(is_pair)
+        rop = rows_all.astype(np.int64)
+        ns_abs = starts64[rop] + part_start[rows_all, cols_all]
+        ne_abs = starts64[rop] + colon_pos[rows_all, cols_all]
+        vs_abs = ne_abs + 1
+        ve_abs = starts64[rop] + part_end[rows_all, cols_all]
+        order, dup_rows = sorted_pair_order(chunk_arr, rop, ns_abs,
+                                            ne_abs, _NAME_CAP)
+        if dup_rows.size:
+            cand[dup_rows] = False
+            keep = cand[rop[order]]
+            order = order[keep]
+        ns_s, ne_s = ns_abs[order], ne_abs[order]
+        vs_s, ve_s = vs_abs[order], ve_abs[order]
+        rop_s = rop[order]
+    else:
+        ns_s = ne_s = vs_s = ve_s = rop_s = np.zeros(0, dtype=np.int64)
+
+    ridx = np.flatnonzero(cand)
+    R = ridx.size
+    final_buf = b""
+    row_off = np.zeros(1, dtype=np.int64)
+    prefix_lens_tier: Optional[np.ndarray] = None
+
+    if R:
+        emap = escape_json(chunk_arr)
+        st = starts64[ridx]
+
+        def espan(a_abs, b_abs):
+            ea = emap.map(a_abs)
+            return ea, emap.map(b_abs) - ea
+
+        full_src, full_len = espan(st, st + lens64[ridx])
+        host_a = st + np.asarray(out["host_start"])[:n][ridx]
+        host_b = st + np.asarray(out["host_end"])[:n][ridx]
+        host_src, host_len = espan(host_a, host_b)
+        has_msg = np.asarray(out["msg_pos"])[:n][ridx] >= 0
+        msg_a = st + np.asarray(out["msg_start"])[:n][ridx]
+        msg_b = st + np.asarray(out["msg_end"])[:n][ridx]
+        msg_src, msg_len = espan(msg_a, msg_b)
+        level = np.asarray(out["level_val"])[:n][ridx].astype(np.int64)
+        has_level = level >= 0
+
+        # timestamps: rfc3339-kind rows share the deduplicated computed
+        # scratch; unix-literal rows format float(span) individually —
+        # the only remaining per-row Python, and only for that kind
+        kind = ts_kind[ridx]
+        scratch0, ts_off0, ts_len0 = ts_scratch(out, n, ridx, json_f64)
+        lit_rows = np.flatnonzero(kind != 0)
+        lit_strs = []
+        if lit_rows.size:
+            tsa = st[lit_rows] + np.asarray(out["ts_start"])[:n][ridx][lit_rows]
+            tsb = st[lit_rows] + np.asarray(out["ts_end"])[:n][ridx][lit_rows]
+            lit_strs = [
+                json_f64(float(chunk_bytes[a:b])).encode("ascii")
+                for a, b in zip(tsa.tolist(), tsb.tolist())
+            ]
+        lit_blob = b"".join(lit_strs)
+        lit_len = np.fromiter((len(s) for s in lit_strs), dtype=np.int64,
+                              count=len(lit_strs))
+        lit_off = exclusive_cumsum(lit_len)[:-1] if lit_strs else \
+            np.zeros(0, dtype=np.int64)
+        ts_off = ts_off0.copy()
+        ts_len = ts_len0.copy()
+        ts_off[lit_rows] = len(scratch0) + lit_off
+        ts_len[lit_rows] = lit_len
+        scratch = scratch0 + lit_blob
+
+        consts, offs = build_source(
+            b"{", _C_P0, _C_P1, _C_P2, _C_FULL, _C_HOST, _C_LEVEL,
+            _C_SHORT_LVL, _C_SHORT, _C_TS, _C_TAIL + suffix,
+            _C_UNKNOWN, _C_DASH, _C_SEVD, scratch)
+        (o_open, o_p0, o_p1, o_p2, o_full, o_host, o_level, o_short_l,
+         o_short, o_ts, o_tail, o_unknown, o_dash, o_sevd,
+         o_scratch) = offs
+        cbase = int(emap.esc.size)
+        src = np.concatenate([emap.esc, consts])
+
+        host_src = np.where(host_len == 0, cbase + o_unknown, host_src)
+        host_len = np.where(host_len == 0, len(_C_UNKNOWN), host_len)
+
+        # short_message value is `"msg"` (quoted, escaped) or `"-"`;
+        # emitted as [quote][msg][quote] with const redirects when absent
+        p = pc[ridx]
+        FIXED = 13
+        segc = 1 + 5 * p + FIXED
+        rstart = exclusive_cumsum(segc)[:-1]
+        S = int(segc.sum())
+        seg_src = np.zeros(S, dtype=np.int64)
+        seg_len = np.zeros(S, dtype=np.int64)
+        seg_src[rstart] = cbase + o_open
+        seg_len[rstart] = 1
+
+        if T:
+            # map sorted pairs to their (possibly shrunk) rows
+            tpos = np.cumsum(cand) - 1
+            tord = tpos[rop_s]
+            within = np.zeros(rop_s.size, dtype=np.int64)
+            if rop_s.size:
+                # consecutive runs per row in sorted order
+                new_row = np.ones(rop_s.size, dtype=bool)
+                new_row[1:] = rop_s[1:] != rop_s[:-1]
+                run_starts = np.flatnonzero(new_row)
+                within = (np.arange(rop_s.size)
+                          - np.repeat(run_starts,
+                                      np.diff(np.append(run_starts,
+                                                        rop_s.size))))
+            name_src = emap.map(ns_s)
+            name_len = emap.map(ne_s) - name_src
+            val_src = emap.map(vs_s)
+            val_len = emap.map(ve_s) - val_src
+            p0 = rstart[tord] + 1 + 5 * within
+            seg_src[p0] = cbase + o_p0
+            seg_len[p0] = 2
+            seg_src[p0 + 1] = name_src
+            seg_len[p0 + 1] = name_len
+            seg_src[p0 + 2] = cbase + o_p1
+            seg_len[p0 + 2] = 3
+            seg_src[p0 + 3] = val_src
+            seg_len[p0 + 3] = val_len
+            seg_src[p0 + 4] = cbase + o_p2
+            seg_len[p0 + 4] = 2
+
+        fd = (rstart + 1 + 5 * p)[:, None] + np.arange(
+            FIXED, dtype=np.int64)[None, :]
+        fsrc = np.empty((R, FIXED), dtype=np.int64)
+        flen = np.empty((R, FIXED), dtype=np.int64)
+        qsrc = cbase + o_p1 + 2  # a '"' byte inside the const bank
+        cols = (
+            (cbase + o_full, len(_C_FULL)),
+            (full_src, full_len),
+            (cbase + o_host, len(_C_HOST)),
+            (host_src, host_len),
+            (cbase + o_level, np.where(has_level, len(_C_LEVEL), 0)),
+            (cbase + o_sevd + np.maximum(level, 0),
+             np.where(has_level, 1, 0)),
+            (np.where(has_level, cbase + o_short_l, cbase + o_short),
+             np.where(has_level, len(_C_SHORT_LVL), len(_C_SHORT))),
+            (np.where(has_msg, qsrc, cbase + o_dash),
+             np.where(has_msg, 1, len(_C_DASH))),
+            (msg_src, np.where(has_msg, msg_len, 0)),
+            (qsrc, np.where(has_msg, 1, 0)),
+            (cbase + o_ts, len(_C_TS)),
+            (cbase + o_scratch + ts_off, ts_len),
+            (cbase + o_tail, len(_C_TAIL) + len(suffix)),
+        )
+        for k, (s_, ln) in enumerate(cols):
+            fsrc[:, k] = s_
+            flen[:, k] = ln
+        seg_src[fd] = fsrc
+        seg_len[fd] = flen
+
+        dst0 = exclusive_cumsum(seg_len)
+        body = concat_segments(src, seg_src, seg_len, dst0)
+        row_off = np.concatenate([dst0[rstart], dst0[-1:]])
+        tier_lens = np.diff(row_off)
+        if syslen:
+            final_buf, row_off, prefix_lens_tier = apply_syslen_prefix(
+                body, row_off, tier_lens)
+        else:
+            final_buf = body.tobytes()
+
+    def scalar_fn(line):
+        return _scalar_ltsv(decoder, line)
+
+    return finish_block(chunk_bytes, starts64, lens64, n, cand, ridx,
+                        final_buf, row_off, prefix_lens_tier, suffix,
+                        syslen, merger, encoder, scalar_fn=scalar_fn)

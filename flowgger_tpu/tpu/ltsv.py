@@ -234,6 +234,7 @@ def decode_ltsv(batch: jnp.ndarray, lens: jnp.ndarray,
 
     return {
         "ok": ok,
+        "has_high": jnp.any((bb >= 128) & valid, axis=1),
         "n_parts": n_parts,
         "part_start": part_start,
         "part_end": part_end,
@@ -252,3 +253,17 @@ def decode_ltsv(batch: jnp.ndarray, lens: jnp.ndarray,
 @functools.partial(jax.jit, static_argnames=("max_parts",))
 def decode_ltsv_jit(batch, lens, max_parts=DEFAULT_MAX_PARTS):
     return decode_ltsv(batch, lens, max_parts=max_parts)
+
+
+def decode_ltsv_submit(batch, lens):
+    """Asynchronous dispatch (pair with decode_ltsv_fetch) — the ltsv
+    leg of the block pipeline's double buffering."""
+    import jax.numpy as jnp
+
+    return decode_ltsv_jit(jnp.asarray(batch), jnp.asarray(lens))
+
+
+def decode_ltsv_fetch(handle):
+    import numpy as np
+
+    return {k: np.asarray(v) for k, v in handle.items()}

@@ -478,3 +478,109 @@ def test_block_routes_survive_all_empty_batch():
                            if isinstance(item, EncodedBlock) else [item])
         # every empty line is a decode error in all three configs
         assert emitted == [], (fmt, type(enc).__name__)
+
+
+def test_ltsv_gelf_block_route_matches_scalar():
+    """ltsv_tpu -> GELF block route: byte-identical to the scalar
+    decoder+encoder for untyped LTSV, covering pairs, sorted keys,
+    unix-literal and rfc3339 timestamps, missing message/level,
+    escaping, and fallback rows."""
+    from flowgger_tpu.decoders.ltsv import LTSVDecoder
+
+    dec = LTSVDecoder(CFG_EMPTY)
+    lines = [
+        b"host:web1\ttime:2015-08-05T15:53:45Z\tmessage:hello ltsv",
+        b"host:web2\ttime:1438790025.42\tzeta:z\talpha:a\tmessage:sorted",
+        b"host:w\ttime:1438790025\tlevel:3\tuser:bob\tmessage:lvl",
+        b"host:w\ttime:2015-08-05T15:53:45.25Z",     # no message
+        b"host:w\ttime:1438790025\tk:v with \"quote\"\tmessage:esc",
+        b"time:2015-08-05T15:53:45Z\tmessage:no host",      # error row
+        b"host:w\ttime:1438790025\tnovalue\tmessage:notice",  # fallback
+        b"host:w\ttime:1438790025\tdup:a\tdup:b\tmessage:dups",
+        "host:w\ttime:1438790025\tmessage:unicodé".encode(),
+        b"plain not ltsv at all",
+    ]
+    for merger in (None, LineMerger(), SyslenMerger()):
+        want = []
+        for ln in lines:
+            try:
+                rec = dec.decode(ln.decode("utf-8"))
+                payload = ENC.encode(rec)
+            except Exception:
+                continue
+            want.append(merger.frame(payload) if merger is not None
+                        else payload)
+        tx = queue.Queue()
+        h = BatchHandler(tx, dec, ENC, CFG_EMPTY, fmt="ltsv",
+                         start_timer=False, merger=merger)
+        for ln in lines:
+            h.handle_bytes(ln)
+        h.flush()
+        got = []
+        saw_block = False
+        while not tx.empty():
+            item = tx.get_nowait()
+            if isinstance(item, EncodedBlock):
+                saw_block = True
+                got.extend(item.iter_framed())
+            else:
+                got.append(merger.frame(item) if merger is not None
+                           else item)
+        assert saw_block
+        assert got == want, merger
+
+
+def test_ltsv_gelf_block_typed_schema_uses_record_path():
+    """A typed ltsv_schema disables the block route (values need Python
+    conversion) but output must still match the scalar path."""
+    from flowgger_tpu.decoders.ltsv import LTSVDecoder
+
+    cfg = Config.from_string('[input.ltsv_schema]\ncounter = "u64"\n')
+    dec = LTSVDecoder(cfg)
+    lines = [b"host:w\ttime:1438790025\tcounter:42\tmessage:typed"]
+    want = [ENC.encode(dec.decode(lines[0].decode()))]
+    tx = queue.Queue()
+    h = BatchHandler(tx, dec, ENC, cfg, fmt="ltsv",
+                     start_timer=False, merger=None)
+    h.handle_bytes(lines[0])
+    h.flush()
+    got = []
+    while not tx.empty():
+        item = tx.get_nowait()
+        got.extend(item.iter_unframed() if isinstance(item, EncodedBlock)
+                   else [item])
+    assert got == want
+    assert b'"_counter":42' in got[0]
+
+
+def test_ltsv_gelf_block_repeated_special_keys():
+    """Repeated special keys: earlier occurrences must not leak into the
+    pair table, and a bad earlier occurrence must error like the scalar
+    path (both via oracle fallback)."""
+    from flowgger_tpu.decoders.ltsv import LTSVDecoder
+
+    dec = LTSVDecoder(CFG_EMPTY)
+    lines = [
+        b"host:a\thost:b\ttime:1438790025\tmessage:x",
+        b"time:junk\ttime:1438790025\thost:w\tmessage:y",
+        b"host:w\ttime:1438790025\tmessage:clean",
+    ]
+    want = []
+    for ln in lines:
+        try:
+            want.append(ENC.encode(dec.decode(ln.decode())))
+        except Exception:
+            continue
+    tx = queue.Queue()
+    h = BatchHandler(tx, dec, ENC, CFG_EMPTY, fmt="ltsv",
+                     start_timer=False, merger=None)
+    for ln in lines:
+        h.handle_bytes(ln)
+    h.flush()
+    got = []
+    while not tx.empty():
+        item = tx.get_nowait()
+        got.extend(item.iter_unframed() if isinstance(item, EncodedBlock)
+                   else [item])
+    assert got == want
+    assert not any(b'"_host"' in g for g in got)
