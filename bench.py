@@ -139,6 +139,98 @@ def bench_e2e(lines, jax, jnp, extra):
     extra["e2e_stage_seconds"] = {k: round(v, 3) for k, v in stages.items()}
 
 
+def bench_other_configs(jax, jnp, dev, cpu_fallback, smoke, extra):
+    """BASELINE.json configs beyond #1: LTSV (#2), GELF (#3), multi-SD
+    extraction (#4), auto-detect dispatch (#5) — sustained device decode
+    lines/s for each, via the same chained-iteration methodology."""
+    from flowgger_tpu.tpu import gelf as gelf_k
+    from flowgger_tpu.tpu import ltsv as ltsv_k
+    from flowgger_tpu.tpu import pack, rfc5424
+    from flowgger_tpu.tpu.autodetect import classify_packed
+
+    if smoke:
+        n_lines, chain = 8_192, 2
+    elif cpu_fallback:
+        n_lines, chain = 65_536, 2
+    else:
+        n_lines, chain = 1_000_000, 8
+
+    def chained_rate(decode_fn, digest_fn, batch, lens):
+        def jf_fn(b, ln):
+            def body(i, carry):
+                out = decode_fn(
+                    jnp.bitwise_xor(b, (carry % 2).astype(jnp.uint8)), ln)
+                return carry + (digest_fn(out) & 1)
+
+            return jax.lax.fori_loop(0, chain, body, jnp.int32(0))
+
+        jf = jax.jit(jf_fn)
+        db = jax.device_put(batch, dev)
+        dl = jax.device_put(lens, dev)
+        int(jf(db, dl))
+        t0 = time.perf_counter()
+        int(jf(db, dl))
+        return n_lines / ((time.perf_counter() - t0) / chain)
+
+    # LTSV (#2)
+    ltsv_lines = [
+        (f"host:web{i % 20}\ttime:2015-08-05T15:53:45Z\tstatus:200"
+         f"\tpath:/api/{i}\tmessage:request {i}").encode()
+        for i in range(n_lines)
+    ]
+    b, l, *_ = pack.pack_lines_2d(ltsv_lines, MAX_LEN)
+    rate = chained_rate(
+        lambda bb, ll: ltsv_k.decode_ltsv(bb, ll),
+        lambda o: o["n_parts"].sum() + o["days"].sum(),
+        jnp.asarray(b), jnp.asarray(l))
+    extra["ltsv_device_lines_per_sec"] = round(rate)
+    print(f"ltsv device decode: {rate / 1e6:.1f}M lines/s", file=sys.stderr)
+
+    # GELF (#3)
+    gelf_lines = [
+        (b'{"version":"1.1","host":"h%d","short_message":"event %d",'
+         b'"timestamp":1438790025.%03d,"level":5}' % (i % 9, i, i % 1000))
+        for i in range(n_lines)
+    ]
+    b, l, *_ = pack.pack_lines_2d(gelf_lines, MAX_LEN)
+    rate = chained_rate(
+        lambda bb, ll: gelf_k.decode_gelf(bb, ll),
+        lambda o: o["ok"].sum() * 3 + o["n_fields"].sum(),
+        jnp.asarray(b), jnp.asarray(l))
+    extra["gelf_device_lines_per_sec"] = round(rate)
+    print(f"gelf device decode: {rate / 1e6:.1f}M lines/s", file=sys.stderr)
+
+    # multi-SD extraction (#4): 3 SD blocks, 6 pairs total
+    sd_lines = [
+        (f'<13>1 2015-08-05T15:53:45.{i % 1000:03d}Z h{i % 9} app {i} m '
+         f'[a@1 x="{i}" y="2"][b@2 z="3" w="4"][c@3 u="5" v="6"] '
+         f'multi-sd event {i}').encode()
+        for i in range(n_lines)
+    ]
+    b, l, *_ = pack.pack_lines_2d(sd_lines, MAX_LEN)
+    rate = chained_rate(
+        lambda bb, ll: rfc5424.decode_rfc5424(bb, ll),
+        lambda o: o["pair_count"].sum() + o["sd_count"].sum(),
+        jnp.asarray(b), jnp.asarray(l))
+    extra["multisd_device_lines_per_sec"] = round(rate)
+    print(f"multi-SD device decode: {rate / 1e6:.1f}M lines/s",
+          file=sys.stderr)
+
+    # auto-detect dispatch (#5): host-side vectorized classification rate
+    syslog_lines = gen_lines((n_lines + 2) // 3)
+    mixed = [
+        (syslog_lines[i // 3], ltsv_lines[i], gelf_lines[i])[i % 3]
+        for i in range(n_lines)
+    ]
+    packed = pack.pack_lines_2d(mixed, MAX_LEN)
+    t0 = time.perf_counter()
+    classify_packed(packed)
+    dt = time.perf_counter() - t0
+    extra["auto_classify_lines_per_sec"] = round(n_lines / dt)
+    print(f"auto-detect classification: {n_lines / dt / 1e6:.1f}M lines/s "
+          "(host, vectorized)", file=sys.stderr)
+
+
 def main():
     import os
 
@@ -225,6 +317,7 @@ def main():
 
     extra = {}
     bench_e2e(lines[:E2E_BATCH], jax, jnp, extra)
+    bench_other_configs(jax, jnp, dev, cpu_fallback, smoke, extra)
 
     # scalar CPU baseline (the reference's per-line architecture)
     from flowgger_tpu.decoders.rfc5424 import RFC5424Decoder
