@@ -78,19 +78,19 @@ def _load():
             lib.fg_snappy_decompress.argtypes = [
                 ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
                 ctypes.c_int64]
-        if hasattr(lib, "fg_gelf_lens"):
+        if hasattr(lib, "fg_gelf_lens_v2"):
             common = [
                 ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
                 ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
-                ctypes.c_void_p, ctypes.c_int32,
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int32,
                 ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int32,
                 ctypes.c_int32,
             ]
-            lib.fg_gelf_lens.restype = None
-            lib.fg_gelf_lens.argtypes = common + [ctypes.c_void_p,
+            lib.fg_gelf_lens_v2.restype = None
+            lib.fg_gelf_lens_v2.argtypes = common + [ctypes.c_void_p,
                                                   ctypes.c_int]
-            lib.fg_gelf_write.restype = None
-            lib.fg_gelf_write.argtypes = common + [ctypes.c_void_p,
+            lib.fg_gelf_write_v2.restype = None
+            lib.fg_gelf_write_v2.argtypes = common + [ctypes.c_void_p,
                                                    ctypes.c_void_p,
                                                    ctypes.c_int]
         _lib = lib
@@ -103,7 +103,7 @@ def available() -> bool:
 
 def gelf_rows_available() -> bool:
     lib = _load()
-    return lib is not None and hasattr(lib, "fg_gelf_lens")
+    return lib is not None and hasattr(lib, "fg_gelf_lens_v2")
 
 
 def split_chunk_native(chunk: bytes, strip_cr: bool = True
@@ -198,14 +198,14 @@ def split_syslen_native(chunk: bytes
 
 def gelf_rows_native(chunk: bytes, meta: np.ndarray,
                      pns: np.ndarray, pne: np.ndarray,
-                     pvs: np.ndarray, pve: np.ndarray,
+                     pvs: np.ndarray, pve: np.ndarray, pesc: np.ndarray,
                      ts_scratch: bytes, suffix: bytes, syslen: bool
                      ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
     """(framed buffer u8, row offsets int64[R+1]) for the tier rows
     described by ``meta`` ([R, 17] int32, see flowgger_host.cpp) — the
     native span→GELF assembly.  None when the library is unavailable."""
     lib = _load()
-    if lib is None or not hasattr(lib, "fg_gelf_lens"):
+    if lib is None or not hasattr(lib, "fg_gelf_lens_v2"):
         return None
     meta = np.ascontiguousarray(meta, dtype=np.int32)
     R = meta.shape[0]
@@ -214,21 +214,22 @@ def gelf_rows_native(chunk: bytes, meta: np.ndarray,
     pne = np.ascontiguousarray(pne, dtype=np.int32)
     pvs = np.ascontiguousarray(pvs, dtype=np.int32)
     pve = np.ascontiguousarray(pve, dtype=np.int32)
+    pesc = np.ascontiguousarray(pesc, dtype=np.int32)
     cbuf = np.frombuffer(chunk, dtype=np.uint8)
     tbuf = np.frombuffer(ts_scratch or b"\0", dtype=np.uint8)
     sbuf = np.frombuffer(suffix or b"\0", dtype=np.uint8)
     lens = np.empty(R, dtype=np.int64)
     args = (cbuf.ctypes.data, meta.ctypes.data, R,
             pns.ctypes.data, pne.ctypes.data, pvs.ctypes.data,
-            pve.ctypes.data, P, tbuf.ctypes.data,
+            pve.ctypes.data, pesc.ctypes.data, P, tbuf.ctypes.data,
             sbuf.ctypes.data, len(suffix), 1 if syslen else 0)
-    lib.fg_gelf_lens(*args, lens.ctypes.data, _DEFAULT_THREADS)
+    lib.fg_gelf_lens_v2(*args, lens.ctypes.data, _DEFAULT_THREADS)
     off = np.empty(R + 1, dtype=np.int64)
     off[0] = 0
     np.cumsum(lens, out=off[1:])
     out = np.empty(int(off[-1]), dtype=np.uint8)
-    lib.fg_gelf_write(*args, off.ctypes.data, out.ctypes.data,
-                      _DEFAULT_THREADS)
+    lib.fg_gelf_write_v2(*args, off.ctypes.data, out.ctypes.data,
+                         _DEFAULT_THREADS)
     return out, off
 
 

@@ -201,21 +201,38 @@ class Pipeline:
         self.input_format = input_format
         self.config = config
         self._handlers: list = []
+        import threading
+
+        self._handler_lock = threading.Lock()
         from .utils import metrics as _metrics_mod
 
         _metrics_mod.configure_from(config)
 
     def handler_factory(self):
         if self.input_format in _TPU_FORMATS:
-            from .tpu.batch import BatchHandler
+            # ONE batch handler shared by every connection thread: the
+            # reference's per-connection decode state is per-line and
+            # stateless, but batches fragment per connection — sharing
+            # aggregates all connections into full batches (the handler
+            # is internally locked; message interleaving across
+            # connections is unspecified in the reference too, mod.rs
+            # queue semantics).  Per-connection framing attributes are
+            # identical for every connection of one input by
+            # construction (single input.framing config).
+            with self._handler_lock:
+                if self._handlers:
+                    return self._handlers[0]
+                from .tpu.batch import BatchHandler
 
-            handler = BatchHandler(
-                self.tx, self.decoder, self.encoder, self.config,
-                fmt=_TPU_FORMATS[self.input_format], merger=self.merger,
-            )
-        else:
-            handler = ScalarHandler(self.tx, self.decoder, self.encoder)
-        self._handlers.append(handler)
+                handler = BatchHandler(
+                    self.tx, self.decoder, self.encoder, self.config,
+                    fmt=_TPU_FORMATS[self.input_format], merger=self.merger,
+                )
+                self._handlers.append(handler)
+                return handler
+        handler = ScalarHandler(self.tx, self.decoder, self.encoder)
+        with self._handler_lock:
+            self._handlers.append(handler)
         return handler
 
     def start_output(self):

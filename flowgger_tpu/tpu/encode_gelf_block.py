@@ -118,12 +118,15 @@ def encode_rfc5424_gelf_block(
     name_end = np.asarray(out["name_end"])[:n]
 
     cand = ok & (lens64 <= max_len) & ~has_high
-    if val_has_esc.shape[1]:
-        cand &= ~val_has_esc.any(axis=1)
 
     chunk_arr = np.frombuffer(chunk_bytes, dtype=np.uint8)
     use_native = (native.gelf_rows_available()
                   and name_start.shape[1] <= _NATIVE_MAX_PAIRS)
+    if not use_native and val_has_esc.shape[1]:
+        # the numpy engine emits value spans through the shared escaped
+        # chunk view and cannot compose the SD unescape; the native row
+        # assembler handles those values directly
+        cand &= ~val_has_esc.any(axis=1)
 
     ns_s = ne_s = vs_s = ve_s = np.zeros(0, dtype=np.int64)
     if not use_native:
@@ -179,8 +182,9 @@ def encode_rfc5424_gelf_block(
         pne = np.asarray(out["name_end"])[:n][ridx]
         pvs = np.asarray(out["val_start"])[:n][ridx]
         pve = np.asarray(out["val_end"])[:n][ridx]
+        pesc = val_has_esc[ridx].astype(np.int32)
         res = native.gelf_rows_native(chunk_bytes, meta, pns, pne, pvs, pve,
-                                      scratch, suffix, syslen)
+                                      pesc, scratch, suffix, syslen)
         # gelf_rows_available() was checked above, so res cannot be None
         buf, row_off = res
         tier_lens = np.diff(row_off)

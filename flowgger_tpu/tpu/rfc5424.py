@@ -143,6 +143,14 @@ def decode_rfc5424(batch: jnp.ndarray, lens: jnp.ndarray,
       (~70x faster than "sum" on the CPU backend).
     Identical outputs; differential-tested against each other."""
     N, L = batch.shape
+    # slot geometry for the bit-packed sum extraction: each word carries
+    # as many (value+1) slots as fit in 30 bits, with slot width sized to
+    # the packed byte axis — 10 bits / 3 slots for the common L <= 1022,
+    # widening automatically for long-record configs (tpu_max_line_len)
+    slot_bits = max(10, int(L + 1).bit_length())
+    slots = max(1, 30 // slot_bits)
+    slot_mask = (1 << slot_bits) - 1
+    slot_max = slot_mask - 1
 
     def _extract(mask, ord_, value, K, fill):
         """out[n, k] = value at the position with ordinal k+1 (masked),
@@ -156,16 +164,16 @@ def decode_rfc5424(batch: jnp.ndarray, lens: jnp.ndarray,
                 jnp.where(mask, value.astype(_I32), big))[:, :K]
             return jnp.where(out == big, fill, out)
         cols = []
-        v1 = jnp.clip(value, 0, 1021) + 1
-        for base in range(0, K, 3):
+        v1 = jnp.clip(value, 0, slot_max) + 1
+        for base in range(0, K, slots):
             acc = jnp.where(mask & (ord_ == base + 1), v1, 0)
-            if base + 1 < K:
-                acc = acc + (jnp.where(mask & (ord_ == base + 2), v1, 0) << 10)
-            if base + 2 < K:
-                acc = acc + (jnp.where(mask & (ord_ == base + 3), v1, 0) << 20)
+            for s in range(1, slots):
+                if base + s < K:
+                    acc = acc + (jnp.where(mask & (ord_ == base + 1 + s),
+                                           v1, 0) << (slot_bits * s))
             word = jnp.sum(acc, axis=1)
-            for slot in range(min(3, K - base)):
-                v = (word >> (10 * slot)) & 0x3FF
+            for slot in range(min(slots, K - base)):
+                v = (word >> (slot_bits * slot)) & slot_mask
                 cols.append(jnp.where(v == 0, fill, v - 1))
         return jnp.stack(cols, axis=1)
 
@@ -173,22 +181,22 @@ def decode_rfc5424(batch: jnp.ndarray, lens: jnp.ndarray,
         """out[n, k] = number of masked positions with ordinal k+1 —
         an *accumulating* variant of _extract (the mask may hit many
         positions per ordinal; each per-word slot's total is bounded by
-        L <= 1022, so 10-bit slots cannot carry)."""
+        L < 2**slot_bits, so slots cannot carry)."""
         if extract_impl == "scatter":
             rows = jax.lax.broadcasted_iota(_I32, mask.shape, 0)
             cols = jnp.where(mask, jnp.minimum(ord_ - 1, K), K)
             init = jnp.zeros((N, K + 1), _I32)
             return init.at[rows, cols].add(mask.astype(_I32))[:, :K]
         cols = []
-        for base in range(0, K, 3):
+        for base in range(0, K, slots):
             acc = jnp.where(mask & (ord_ == base + 1), 1, 0)
-            if base + 1 < K:
-                acc = acc + (jnp.where(mask & (ord_ == base + 2), 1, 0) << 10)
-            if base + 2 < K:
-                acc = acc + (jnp.where(mask & (ord_ == base + 3), 1, 0) << 20)
+            for s in range(1, slots):
+                if base + s < K:
+                    acc = acc + (jnp.where(mask & (ord_ == base + 1 + s),
+                                           1, 0) << (slot_bits * s))
             word = jnp.sum(acc, axis=1)
-            for slot in range(min(3, K - base)):
-                cols.append((word >> (10 * slot)) & 0x3FF)
+            for slot in range(min(slots, K - base)):
+                cols.append((word >> (slot_bits * slot)) & slot_mask)
         return jnp.stack(cols, axis=1)
     lens = lens.astype(_I32)
     iota = jax.lax.broadcasted_iota(_I32, (N, L), 1)
@@ -211,9 +219,9 @@ def decode_rfc5424(batch: jnp.ndarray, lens: jnp.ndarray,
     # ---- first six spaces → header field spans ---------------------------
     # positions are extracted by *sum* packing: each target position is
     # selected by a unique mask (space ordinal == k), so a masked sum of
-    # (pos+1) << (10*slot) recovers three positions per i32 reduction —
-    # 2 passes instead of 6 (not-found decodes as 0).
-    assert L <= 1022, "position packing uses 10-bit slots"
+    # (pos+1) << (slot_bits*slot) recovers ``slots`` positions per i32
+    # reduction (3 for the common L <= 1022 geometry, fewer for
+    # long-record configs; not-found decodes as 0).
     is_sp = (bb == 32) & valid
     sp_ord = _cumsum(is_sp, scan_impl)  # int32 [N,L] — inclusive ordinal
     sp = _extract(is_sp, sp_ord, iota, 6, L)  # [N, 6]

@@ -356,8 +356,11 @@ int64_t fg_snappy_decompress(const uint8_t* src, int64_t n,
 // Columnar RFC5424 -> GELF row assembly (the encode hot loop of
 // gelf_encoder.rs:51-116, batched): given the decode kernel's span
 // tables, emit each row's GELF JSON bytes directly from the chunk.
-// Two phases — fg_gelf_lens measures exact output lengths, the caller
-// prefix-sums them, fg_gelf_write fills the buffer in parallel.
+// Two phases — fg_gelf_lens_v2 measures exact output lengths, the
+// caller prefix-sums them, fg_gelf_write_v2 fills the buffer in
+// parallel.  (v2: the escaped-SD-value flags changed the signature; the
+// suffix keeps a stale prebuilt .so from being called with a shifted
+// argument layout — loaders feature-test the symbol name.)
 // JSON escaping matches json.encoder.encode_basestring (backslash,
 // quote, \b \t \n \f \r shortcuts, \u00XX for other control bytes);
 // differential tests in tests/test_encode_gelf_block.py pin the bytes
@@ -408,6 +411,56 @@ inline uint8_t* esc_write(uint8_t* dst, const uint8_t* s, int64_t len) {
         } else {
             memcpy(dst, kEsc.seq[s[i]], w);
             dst += w;
+        }
+    }
+    return dst;
+}
+
+// SD-escaped values: RFC5424 unescape (backslash before '"' '\\' ']'
+// collapses; any other backslash is literal — rfc5424_decoder.rs:105-125
+// semantics) composed with the JSON escape, in one walk.
+inline int64_t esc_len_sd(const uint8_t* s, int64_t len) {
+    int64_t out = 0;
+    int64_t i = 0;
+    while (i < len) {
+        uint8_t b = s[i];
+        if (b == '\\' && i + 1 < len) {
+            uint8_t c = s[i + 1];
+            if (c == '"' || c == '\\' || c == ']')
+                out += kEsc.width[c];
+            else
+                out += kEsc.width[(uint8_t)'\\'] + kEsc.width[c];
+            i += 2;
+        } else {
+            out += kEsc.width[b];
+            i += 1;
+        }
+    }
+    return out;
+}
+
+inline uint8_t* esc_write_sd(uint8_t* dst, const uint8_t* s, int64_t len) {
+    auto put1 = [&](uint8_t b) {
+        uint8_t w = kEsc.width[b];
+        if (w == 1) {
+            *dst++ = b;
+        } else {
+            memcpy(dst, kEsc.seq[b], w);
+            dst += w;
+        }
+    };
+    int64_t i = 0;
+    while (i < len) {
+        uint8_t b = s[i];
+        if (b == '\\' && i + 1 < len) {
+            uint8_t c = s[i + 1];
+            if (!(c == '"' || c == '\\' || c == ']'))
+                put1('\\');
+            put1(c);
+            i += 2;
+        } else {
+            put1(b);
+            i += 1;
         }
     }
     return dst;
@@ -478,6 +531,7 @@ struct GelfArgs {
     const int32_t* pne;
     const int32_t* pvs;
     const int32_t* pve;
+    const int32_t* pesc;      // [R, P] value-needs-SD-unescape flags
     int32_t P;
     const uint8_t* ts_scratch;
     const uint8_t* suffix;
@@ -496,13 +550,16 @@ int64_t gelf_row_len(const GelfArgs& a, int64_t r) {
         const int32_t* ne = a.pne + r * a.P;
         const int32_t* vs = a.pvs + r * a.P;
         const int32_t* ve = a.pve + r * a.P;
+        const int32_t* pe = a.pesc + r * a.P;
         int order[kMaxPairs];
         int cnt = sort_pairs(chunk, base, ns, ne, p, order);
         for (int k = 0; k < cnt; k++) {
             int i = order[k];
             len += 2 + 3 + 2;  // "_  ":"  ",
             len += esc_len(chunk + base + ns[i], ne[i] - ns[i]);
-            len += esc_len(chunk + base + vs[i], ve[i] - vs[i]);
+            len += pe[i]
+                ? esc_len_sd(chunk + base + vs[i], ve[i] - vs[i])
+                : esc_len(chunk + base + vs[i], ve[i] - vs[i]);
         }
     }
     len += 1;                                   // {
@@ -558,6 +615,7 @@ uint8_t* gelf_row_write(const GelfArgs& a, int64_t r, uint8_t* dst,
         const int32_t* ne = a.pne + r * a.P;
         const int32_t* vs = a.pvs + r * a.P;
         const int32_t* ve = a.pve + r * a.P;
+        const int32_t* pe = a.pesc + r * a.P;
         int order[kMaxPairs];
         int cnt = sort_pairs(chunk, base, ns, ne, p, order);
         for (int k = 0; k < cnt; k++) {
@@ -565,7 +623,9 @@ uint8_t* gelf_row_write(const GelfArgs& a, int64_t r, uint8_t* dst,
             dst = LIT(dst, "\"_");
             dst = esc_write(dst, chunk + base + ns[i], ne[i] - ns[i]);
             dst = LIT(dst, "\":\"");
-            dst = esc_write(dst, chunk + base + vs[i], ve[i] - vs[i]);
+            dst = pe[i]
+                ? esc_write_sd(dst, chunk + base + vs[i], ve[i] - vs[i])
+                : esc_write(dst, chunk + base + vs[i], ve[i] - vs[i]);
             dst = LIT(dst, "\",");
         }
     }
@@ -621,26 +681,28 @@ void run_threaded(int64_t n, int n_threads,
 
 extern "C" {
 
-void fg_gelf_lens(const uint8_t* chunk, const int32_t* meta, int64_t R,
+void fg_gelf_lens_v2(const uint8_t* chunk, const int32_t* meta, int64_t R,
                   const int32_t* pns, const int32_t* pne,
-                  const int32_t* pvs, const int32_t* pve, int32_t P,
+                  const int32_t* pvs, const int32_t* pve,
+                  const int32_t* pesc, int32_t P,
                   const uint8_t* ts_scratch,
                   const uint8_t* suffix, int32_t suffix_len, int32_t syslen,
                   int64_t* out_lens, int n_threads) {
-    GelfArgs a{chunk, meta, R, pns, pne, pvs, pve, P,
+    GelfArgs a{chunk, meta, R, pns, pne, pvs, pve, pesc, P,
                ts_scratch, suffix, suffix_len, syslen};
     run_threaded(R, n_threads, [&](int64_t lo, int64_t hi) {
         for (int64_t r = lo; r < hi; r++) out_lens[r] = gelf_row_len(a, r);
     });
 }
 
-void fg_gelf_write(const uint8_t* chunk, const int32_t* meta, int64_t R,
+void fg_gelf_write_v2(const uint8_t* chunk, const int32_t* meta, int64_t R,
                    const int32_t* pns, const int32_t* pne,
-                   const int32_t* pvs, const int32_t* pve, int32_t P,
+                   const int32_t* pvs, const int32_t* pve,
+                   const int32_t* pesc, int32_t P,
                    const uint8_t* ts_scratch,
                    const uint8_t* suffix, int32_t suffix_len, int32_t syslen,
                    const int64_t* out_off, uint8_t* dst, int n_threads) {
-    GelfArgs a{chunk, meta, R, pns, pne, pvs, pve, P,
+    GelfArgs a{chunk, meta, R, pns, pne, pvs, pve, pesc, P,
                ts_scratch, suffix, suffix_len, syslen};
     run_threaded(R, n_threads, [&](int64_t lo, int64_t hi) {
         for (int64_t r = lo; r < hi; r++)

@@ -87,3 +87,53 @@ def test_unknown_output_type():
         Pipeline(Config.from_string(
             '[input]\ntype = "stdin"\n[output]\ntype = "bogus"\n'
         ))
+
+
+def test_tpu_handler_shared_across_connections(tmp_path):
+    """Every connection of a *_tpu pipeline shares ONE batch handler so
+    batches aggregate across connections; scalar pipelines keep
+    per-connection handlers."""
+    import socket
+    import threading
+    import time
+
+    from flowgger_tpu.pipeline import Pipeline
+
+    out_path = tmp_path / "shared.out"
+    config = Config.from_string(
+        '[input]\ntype = "tcp"\nlisten = "127.0.0.1:0"\n'
+        'format = "rfc5424_tpu"\ntimeout = 5\ntpu_flush_ms = 30\n'
+        '[output]\ntype = "file"\nformat = "gelf"\n'
+        f'file_path = "{out_path}"\n')
+    p = Pipeline(config)
+    p.start_output()
+    t = threading.Thread(target=p.input.accept, args=(p.handler_factory,),
+                         daemon=True)
+    t.start()
+    while p.input.bound_port is None:
+        time.sleep(0.01)
+    line = "<13>1 2015-08-05T15:53:45Z shared app 1 2 - via conn %d"
+    conns = [socket.create_connection(("127.0.0.1", p.input.bound_port))
+             for _ in range(3)]
+    for i, c in enumerate(conns):
+        c.sendall((line % i + "\n").encode())
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if out_path.exists() and out_path.read_bytes().count(b"\0") >= 3:
+            break
+        time.sleep(0.05)
+    for c in conns:
+        c.close()
+    assert len(p._handlers) == 1  # one shared BatchHandler
+    data = out_path.read_bytes()
+    for i in range(3):
+        assert (f"via conn {i}".encode()) in data
+
+    # scalar pipelines keep one handler per connection
+    config2 = Config.from_string(
+        '[input]\ntype = "tcp"\nlisten = "127.0.0.1:0"\n'
+        'format = "rfc5424"\ntimeout = 5\n'
+        '[output]\ntype = "debug"\nformat = "gelf"\n')
+    p2 = Pipeline(config2)
+    h1, h2 = p2.handler_factory(), p2.handler_factory()
+    assert h1 is not h2
