@@ -89,7 +89,8 @@ class BatchHandler(Handler):
             type(encoder) in (GelfEncoder, RFC5424Encoder, LTSVEncoder)
             or (type(encoder) is PassthroughEncoder
                 and encoder.header_time_format is None))
-        ) or (fmt in ("rfc3164", "ltsv") and type(encoder) is GelfEncoder)
+        ) or (fmt in ("rfc3164", "ltsv", "gelf")
+              and type(encoder) is GelfEncoder)
         # single source of truth for kernel dispatch: fmt -> batch decoder
         auto_ltsv = self._auto_ltsv_decoder(cfg) if fmt == "auto" else None
         self._auto_ltsv = auto_ltsv
@@ -266,7 +267,7 @@ class BatchHandler(Handler):
         """Cheap applicability check, evaluated before any kernel work so
         an inapplicable route never pays a wasted device decode."""
         if not self._block_mode or self.fmt not in ("rfc5424", "rfc3164",
-                                                     "ltsv"):
+                                                     "ltsv", "gelf"):
             return False
         from ..encoders.gelf import GelfEncoder
         from ..encoders.ltsv import LTSVEncoder
@@ -285,6 +286,9 @@ class BatchHandler(Handler):
             return (type(self.encoder) is GelfEncoder
                     and not self.encoder.extra
                     and not self.scalar.decoder.schema)
+        if self.fmt == "gelf":
+            return (type(self.encoder) is GelfEncoder
+                    and not self.encoder.extra)
         if type(self.encoder) is GelfEncoder:
             return not self.encoder.extra
         if type(self.encoder) is PassthroughEncoder:
@@ -304,6 +308,10 @@ class BatchHandler(Handler):
                 from . import ltsv
 
                 handle = ltsv.decode_ltsv_submit(packed[0], packed[1])
+            elif self.fmt == "gelf":
+                from . import gelf
+
+                handle = gelf.decode_gelf_submit(packed[0], packed[1])
             else:
                 from . import rfc5424
 
@@ -342,6 +350,14 @@ class BatchHandler(Handler):
                 packed[2], packed[3], packed[4], host_out, packed[5],
                 packed[0].shape[1], self.encoder, self._merger,
                 self.scalar.decoder)
+        elif self.fmt == "gelf":
+            from . import encode_gelf_gelf_block, gelf
+
+            host_out = gelf.decode_gelf_fetch(handle)
+            t1 = _time.perf_counter()
+            res = encode_gelf_gelf_block.encode_gelf_gelf_block(
+                packed[2], packed[3], packed[4], host_out, packed[5],
+                packed[0].shape[1], self.encoder, self._merger)
         else:
             from . import rfc5424
 

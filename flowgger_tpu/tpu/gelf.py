@@ -227,6 +227,20 @@ def decode_gelf(batch: jnp.ndarray, lens: jnp.ndarray,
     }
 
 
+def decode_gelf_submit(batch, lens):
+    """Asynchronous dispatch (pair with decode_gelf_fetch) — the gelf
+    leg of the block pipeline's double buffering."""
+    import jax.numpy as jnp
+
+    return decode_gelf_jit(jnp.asarray(batch), jnp.asarray(lens))
+
+
+def decode_gelf_fetch(handle):
+    import numpy as np
+
+    return {k: np.asarray(v) for k, v in handle.items()}
+
+
 @functools.partial(jax.jit, static_argnames=("max_fields",))
 def decode_gelf_jit(batch, lens, max_fields=DEFAULT_MAX_FIELDS):
     return decode_gelf(batch, lens, max_fields=max_fields)
