@@ -139,3 +139,95 @@ def decode_auto_batch(lines: List[bytes], max_len: int,
 
     return decode_auto_packed(packmod.pack_lines_2d(lines, max_len),
                               max_len, ltsv_decoder)
+
+
+def encode_auto_gelf_blocks(packed, encoder, merger, ltsv_decoder=None):
+    """Block-encode a mixed batch: classify, submit every class's kernel
+    (device work for independent classes overlaps via JAX async
+    dispatch), run each class's columnar GELF route on its row subset,
+    and merge the per-class buffers back into input order with one
+    segment gather.  Returns a BlockResult or None when any leg is
+    inapplicable (typed ltsv_schema, gelf_extra, unsupported merger) —
+    the caller then uses the Record path."""
+    import numpy as np
+
+    from ..block import EncodedBlock
+    from .assemble import concat_segments, exclusive_cumsum
+    from .block_common import BlockResult, merger_suffix
+    from . import pack as packmod
+    from .batch import block_fetch_encode, block_submit
+
+    if ltsv_decoder is None:
+        ltsv_decoder = LTSVDecoder(Config.from_string(""))
+    spec = merger_suffix(merger)
+    if spec is None or encoder.extra:
+        return None
+    if ltsv_decoder.schema:
+        return None
+    suffix, syslen = spec
+
+    n = packed[5]
+    classes = classify_packed(packed)
+    submitted = []
+    for cls, fmt in ((F_RFC5424, "rfc5424"), (F_RFC3164, "rfc3164"),
+                     (F_LTSV, "ltsv"), (F_GELF, "gelf")):
+        idx = np.flatnonzero(classes == cls)
+        if not idx.size:
+            continue
+        sub = packmod.subset_packed(packed, idx)
+        submitted.append((idx, fmt, sub, block_submit(fmt, sub)))
+    legs = []
+    for idx, fmt, sub, handle in submitted:
+        res, _fetch_s = block_fetch_encode(fmt, handle, sub, encoder,
+                                           merger, ltsv_decoder)
+        if res is None:
+            return None
+        legs.append((idx, res))
+
+    emit = np.zeros(n, dtype=bool)
+    row_len = np.zeros(n, dtype=np.int64)
+    row_src = np.zeros(n, dtype=np.int64)   # leg ordinal
+    row_boff = np.zeros(n, dtype=np.int64)  # offset inside leg buffer
+    row_pfx = np.zeros(n, dtype=np.int64)
+    buffers = []
+    errors = []
+    error_rows = []
+    fallback_rows = 0
+    for li, (idx, res) in enumerate(legs):
+        b = res.block
+        erows = idx[np.flatnonzero(res.emit)]
+        lens_c = np.diff(b.bounds)
+        emit[erows] = True
+        row_len[erows] = lens_c
+        row_src[erows] = li
+        row_boff[erows] = b.bounds[:-1]
+        if b.prefix_lens is not None:
+            row_pfx[erows] = b.prefix_lens
+        buffers.append(np.frombuffer(b.data, dtype=np.uint8))
+        for (err, line), r in zip(res.errors, res.error_rows):
+            errors.append((err, line))
+            error_rows.append(int(idx[r]))
+        fallback_rows += res.fallback_rows
+
+    bases = exclusive_cumsum(np.array([b.size for b in buffers],
+                                      dtype=np.int64))[:-1] \
+        if buffers else np.zeros(0, dtype=np.int64)
+    src = np.concatenate(buffers) if buffers else np.zeros(0, dtype=np.uint8)
+    rows = np.flatnonzero(emit)
+    seg_src = bases[row_src[rows]] + row_boff[rows] if rows.size else \
+        np.zeros(0, dtype=np.int64)
+    seg_len = row_len[rows]
+    data = concat_segments(src, seg_src, seg_len).tobytes() if rows.size \
+        else b""
+    bounds = exclusive_cumsum(seg_len)
+    prefix_lens = row_pfx[rows] if syslen else None
+
+    # errors in input order (the per-leg lists are subset-ordered)
+    if errors:
+        order = np.argsort(np.array(error_rows, dtype=np.int64),
+                           kind="stable")
+        errors = [errors[i] for i in order.tolist()]
+
+    block = EncodedBlock(data, bounds, prefix_lens, len(suffix))
+    return BlockResult(block, errors, fallback_rows, emit=emit,
+                       error_rows=sorted(error_rows))

@@ -89,7 +89,7 @@ class BatchHandler(Handler):
             type(encoder) in (GelfEncoder, RFC5424Encoder, LTSVEncoder)
             or (type(encoder) is PassthroughEncoder
                 and encoder.header_time_format is None))
-        ) or (fmt in ("rfc3164", "ltsv", "gelf")
+        ) or (fmt in ("rfc3164", "ltsv", "gelf", "auto")
               and type(encoder) is GelfEncoder)
         # single source of truth for kernel dispatch: fmt -> batch decoder
         auto_ltsv = self._auto_ltsv_decoder(cfg) if fmt == "auto" else None
@@ -237,14 +237,14 @@ class BatchHandler(Handler):
 
     def _dispatch_packed(self, packed) -> None:
         """Route one packed tuple through the right decode/encode tier."""
+        if self._fast_encode:
+            self._emit_fast(packed)
+            return
         if self.fmt == "auto":
             from .autodetect import decode_auto_packed
 
             self._emit(decode_auto_packed(packed, self.max_len,
                                           self._auto_ltsv))
-            return
-        if self._fast_encode:
-            self._emit_fast(packed)
             return
         self._emit(_decode_packed(self.fmt, packed, self.scalar.decoder))
 
@@ -267,7 +267,7 @@ class BatchHandler(Handler):
         """Cheap applicability check, evaluated before any kernel work so
         an inapplicable route never pays a wasted device decode."""
         if not self._block_mode or self.fmt not in ("rfc5424", "rfc3164",
-                                                     "ltsv", "gelf"):
+                                                     "ltsv", "gelf", "auto"):
             return False
         from ..encoders.gelf import GelfEncoder
         from ..encoders.ltsv import LTSVEncoder
@@ -289,6 +289,10 @@ class BatchHandler(Handler):
         if self.fmt == "gelf":
             return (type(self.encoder) is GelfEncoder
                     and not self.encoder.extra)
+        if self.fmt == "auto":
+            return (type(self.encoder) is GelfEncoder
+                    and not self.encoder.extra
+                    and not (self._auto_ltsv and self._auto_ltsv.schema))
         if type(self.encoder) is GelfEncoder:
             return not self.encoder.extra
         if type(self.encoder) is PassthroughEncoder:
@@ -300,23 +304,12 @@ class BatchHandler(Handler):
         route when engaged, else the per-row fast path (gelf/passthrough
         only), else the Record path."""
         if self._block_route_ok():
-            if self.fmt == "rfc3164":
-                from . import rfc3164
-
-                handle = rfc3164.decode_rfc3164_submit(packed[0], packed[1])
-            elif self.fmt == "ltsv":
-                from . import ltsv
-
-                handle = ltsv.decode_ltsv_submit(packed[0], packed[1])
-            elif self.fmt == "gelf":
-                from . import gelf
-
-                handle = gelf.decode_gelf_submit(packed[0], packed[1])
-            else:
-                from . import rfc5424
-
-                handle = rfc5424.decode_rfc5424_submit(packed[0], packed[1])
-            self._inflight.append((handle, packed))
+            if self.fmt == "auto":
+                # the auto merger submits its per-class kernels at fetch
+                # time; defer everything (no cross-batch overlap here)
+                self._inflight.append((None, packed))
+                return
+            self._inflight.append((block_submit(self.fmt, packed), packed))
             return
         from ..encoders.gelf import GelfEncoder
         from ..encoders.passthrough import PassthroughEncoder
@@ -326,6 +319,12 @@ class BatchHandler(Handler):
             self._emit_encoded(
                 _encode_packed_rfc5424_gelf(packed, self.encoder))
             return
+        if self.fmt == "auto":
+            from .autodetect import decode_auto_packed
+
+            self._emit(decode_auto_packed(packed, self.max_len,
+                                          self._auto_ltsv))
+            return
         self._emit(_decode_packed(self.fmt, packed, self.scalar.decoder))
 
     def _pop_emit(self) -> None:
@@ -333,41 +332,28 @@ class BatchHandler(Handler):
 
         handle, packed = self._inflight.popleft()
         t0 = _time.perf_counter()
-        if self.fmt == "rfc3164":
-            from . import encode_rfc3164_gelf_block, rfc3164
+        if self.fmt == "auto":
+            from .autodetect import decode_auto_packed, encode_auto_gelf_blocks
 
-            host_out = rfc3164.decode_rfc3164_fetch(handle)
-            t1 = _time.perf_counter()
-            res = encode_rfc3164_gelf_block.encode_rfc3164_gelf_block(
-                packed[2], packed[3], packed[4], host_out, packed[5],
-                packed[0].shape[1], self.encoder, self._merger)
-        elif self.fmt == "ltsv":
-            from . import encode_ltsv_gelf_block, ltsv
-
-            host_out = ltsv.decode_ltsv_fetch(handle)
-            t1 = _time.perf_counter()
-            res = encode_ltsv_gelf_block.encode_ltsv_gelf_block(
-                packed[2], packed[3], packed[4], host_out, packed[5],
-                packed[0].shape[1], self.encoder, self._merger,
-                self.scalar.decoder)
-        elif self.fmt == "gelf":
-            from . import encode_gelf_gelf_block, gelf
-
-            host_out = gelf.decode_gelf_fetch(handle)
-            t1 = _time.perf_counter()
-            res = encode_gelf_gelf_block.encode_gelf_gelf_block(
-                packed[2], packed[3], packed[4], host_out, packed[5],
-                packed[0].shape[1], self.encoder, self._merger)
-        else:
-            from . import rfc5424
-
-            host_out = rfc5424.decode_rfc5424_fetch(handle)
-            t1 = _time.perf_counter()
-            res = _encode_block_from_host(host_out, packed, self.encoder,
-                                          self._merger)
+            res = encode_auto_gelf_blocks(packed, self.encoder,
+                                          self._merger, self._auto_ltsv)
+            if res is None:
+                self._emit(decode_auto_packed(packed, self.max_len,
+                                              self._auto_ltsv))
+                return
+            # per-leg fetch time is folded into encode_seconds here: the
+            # merger interleaves four kernels' fetches with their encodes
+            _metrics.add_seconds("encode_seconds",
+                                 _time.perf_counter() - t0)
+            self._emit_block(res, packed[5])
+            return
+        ltsv_dec = self.scalar.decoder if self.fmt == "ltsv" else None
+        res, fetch_s = block_fetch_encode(self.fmt, handle, packed,
+                                          self.encoder, self._merger,
+                                          ltsv_dec)
         t2 = _time.perf_counter()
-        _metrics.add_seconds("device_fetch_seconds", t1 - t0)
-        _metrics.add_seconds("encode_seconds", t2 - t1)
+        _metrics.add_seconds("device_fetch_seconds", fetch_s)
+        _metrics.add_seconds("encode_seconds", t2 - t0 - fetch_s)
         self._emit_block(res, packed[5])
 
     def _emit_block(self, res, n_real: int) -> None:
@@ -440,6 +426,66 @@ class BatchHandler(Handler):
             _metrics.inc("decoded_records")
             _metrics.inc("enqueued")
             self.tx.put(encoded)
+
+
+def block_submit(fmt, packed):
+    """Dispatch one packed tuple's kernel asynchronously (JAX futures);
+    pair with block_fetch_encode."""
+    if fmt == "rfc3164":
+        from . import rfc3164
+
+        return rfc3164.decode_rfc3164_submit(packed[0], packed[1])
+    if fmt == "ltsv":
+        from . import ltsv
+
+        return ltsv.decode_ltsv_submit(packed[0], packed[1])
+    if fmt == "gelf":
+        from . import gelf
+
+        return gelf.decode_gelf_submit(packed[0], packed[1])
+    from . import rfc5424
+
+    return rfc5424.decode_rfc5424_submit(packed[0], packed[1])
+
+
+def block_fetch_encode(fmt, handle, packed, encoder, merger,
+                       ltsv_decoder=None):
+    """Block on a submitted kernel and run the format's columnar block
+    encoder; returns (BlockResult-or-None, fetch_seconds)."""
+    import time as _time
+
+    t0 = _time.perf_counter()
+    if fmt == "rfc3164":
+        from . import encode_rfc3164_gelf_block, rfc3164
+
+        host_out = rfc3164.decode_rfc3164_fetch(handle)
+        t1 = _time.perf_counter()
+        res = encode_rfc3164_gelf_block.encode_rfc3164_gelf_block(
+            packed[2], packed[3], packed[4], host_out, packed[5],
+            packed[0].shape[1], encoder, merger)
+    elif fmt == "ltsv":
+        from . import encode_ltsv_gelf_block, ltsv
+
+        host_out = ltsv.decode_ltsv_fetch(handle)
+        t1 = _time.perf_counter()
+        res = encode_ltsv_gelf_block.encode_ltsv_gelf_block(
+            packed[2], packed[3], packed[4], host_out, packed[5],
+            packed[0].shape[1], encoder, merger, ltsv_decoder)
+    elif fmt == "gelf":
+        from . import encode_gelf_gelf_block, gelf
+
+        host_out = gelf.decode_gelf_fetch(handle)
+        t1 = _time.perf_counter()
+        res = encode_gelf_gelf_block.encode_gelf_gelf_block(
+            packed[2], packed[3], packed[4], host_out, packed[5],
+            packed[0].shape[1], encoder, merger)
+    else:
+        from . import rfc5424
+
+        host_out = rfc5424.decode_rfc5424_fetch(handle)
+        t1 = _time.perf_counter()
+        res = _encode_block_from_host(host_out, packed, encoder, merger)
+    return res, t1 - t0
 
 
 def _encode_block_from_host(host_out, packed, encoder, merger):

@@ -90,15 +90,23 @@ def apply_syslen_prefix(body: np.ndarray, row_off: np.ndarray,
 
 
 class BlockResult:
-    """The block plus per-row errors, in input order."""
+    """The block plus per-row errors, in input order.
 
-    __slots__ = ("block", "errors", "fallback_rows")
+    ``emit`` marks which input rows produced a message (the block's
+    bounds align with ``emit``'s True positions) and ``error_rows``
+    carries the input-row index of each error — both are what the
+    auto-detect merger needs to interleave per-class blocks back into
+    input order."""
+
+    __slots__ = ("block", "errors", "fallback_rows", "emit", "error_rows")
 
     def __init__(self, block: EncodedBlock, errors: List[Tuple[str, str]],
-                 fallback_rows: int):
+                 fallback_rows: int, emit=None, error_rows=None):
         self.block = block
         self.errors = errors
         self.fallback_rows = fallback_rows
+        self.emit = emit
+        self.error_rows = error_rows
 
 
 def merger_suffix(merger: Optional[Merger]) -> Optional[Tuple[bytes, bool]]:
@@ -146,6 +154,7 @@ def finish_block(
     fallback_payload: Dict[int, bytes] = {}
     fb_prefix: Dict[int, int] = {}
     fallback_rows = 0  # parity with the per-row path: utf8 errors excluded
+    error_rows: List[int] = []
     for i in fb_idx.tolist():
         s = int(starts64[i])
         ln = int(lens64[i])
@@ -154,16 +163,19 @@ def finish_block(
             line = raw.decode("utf-8")
         except UnicodeDecodeError:
             errors.append(("__utf8__", ""))
+            error_rows.append(i)
             continue
         fallback_rows += 1
         res = scalar_fn(line)
         if res.record is None:
             errors.append((res.error, line))
+            error_rows.append(i)
             continue
         try:
             payload = encoder.encode(res.record)
         except EncodeError as e:
             errors.append((str(e), line))
+            error_rows.append(i)
             continue
         framed_b = merger.frame(payload) if merger is not None else payload
         fallback_payload[i] = framed_b
@@ -205,4 +217,5 @@ def finish_block(
         prefix_lens = prefix_lens[emit]
 
     block = EncodedBlock(data, bounds, prefix_lens, len(suffix))
-    return BlockResult(block, errors, fallback_rows)
+    return BlockResult(block, errors, fallback_rows, emit=emit,
+                       error_rows=error_rows)

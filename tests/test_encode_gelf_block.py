@@ -684,3 +684,56 @@ def test_gelf_gelf_block_malformed_numbers_and_versions():
         got.extend(item.iter_unframed() if isinstance(item, EncodedBlock)
                    else [item])
     assert got == want
+
+
+def test_auto_gelf_block_merges_classes_in_order():
+    """auto_tpu with a GELF sink block-encodes every class and merges
+    the buffers back into input order, byte-identical to routing each
+    line through its scalar decoder."""
+    from flowgger_tpu.decoders.gelf import GelfDecoder
+    from flowgger_tpu.decoders.ltsv import LTSVDecoder
+    from flowgger_tpu.decoders.rfc3164 import RFC3164Decoder
+    from flowgger_tpu.tpu.autodetect import (
+        F_GELF, F_LTSV, F_RFC3164, F_RFC5424, classify,
+    )
+
+    decoders = {F_RFC5424: ORACLE, F_RFC3164: RFC3164Decoder(CFG_EMPTY),
+                F_LTSV: LTSVDecoder(CFG_EMPTY), F_GELF: GelfDecoder(CFG_EMPTY)}
+    lines = [
+        b"<13>1 2015-08-05T15:53:45Z h5424 app 1 2 - rfc5424 one",
+        b'{"host":"hg","timestamp":1438790025,"k":"v"}',
+        b"host:hl\ttime:2015-08-05T15:53:45Z\tmessage:ltsv here",
+        b"<34>Aug  5 15:53:45 h3164 app: legacy line",
+        b"<13>1 2015-08-05T15:53:45Z h5424b app 1 2 - rfc5424 two",
+        b"plain text goes legacy",
+        b"completely { broken ] line <",
+        b'{"host":"hg2","timestamp":1438790026,"level":2}',
+    ]
+    for merger in (None, LineMerger(), SyslenMerger()):
+        want = []
+        for ln in lines:
+            try:
+                rec = decoders[classify(ln)].decode(ln.decode())
+                payload = ENC.encode(rec)
+            except Exception:
+                continue
+            want.append(merger.frame(payload) if merger is not None
+                        else payload)
+        tx = queue.Queue()
+        h = BatchHandler(tx, ORACLE, ENC, CFG_EMPTY, fmt="auto",
+                         start_timer=False, merger=merger)
+        for ln in lines:
+            h.handle_bytes(ln)
+        h.flush()
+        got = []
+        saw_block = False
+        while not tx.empty():
+            item = tx.get_nowait()
+            if isinstance(item, EncodedBlock):
+                saw_block = True
+                got.extend(item.iter_framed())
+            else:
+                got.append(merger.frame(item) if merger is not None
+                           else item)
+        assert saw_block
+        assert got == want, merger
