@@ -85,33 +85,50 @@ def encode_gelf_gelf_block(
     val_esc = np.asarray(out["val_esc"][:n], dtype=bool)
 
     chunk_arr = np.frombuffer(chunk_bytes, dtype=np.uint8)
+    # zero-padded view: gathers of fixed-width windows past span ends
+    # read pad bytes instead of paying a clip pass per gather
+    # pad covers kernel fill values (row-relative spans up to max_len)
+    # plus the widest fixed gather window
+    chunk_pad = np.concatenate(
+        [chunk_arr, np.zeros(max_len + _KEYW + 2, dtype=np.uint8)])
     F = key_s.shape[1]
     jmask = np.arange(F)[None, :] < n_fields[:, None]
 
-    # row-level byte screens: non-ASCII (decode semantics) and any
-    # control byte (raw ctrl inside a JSON string is a parse error for
-    # the oracle; outside strings it is whitespace formatting we do not
-    # reproduce) — one prefix-count pass each
-    hi_cum = np.cumsum(chunk_arr >= 128)
-    ctl_cum = np.cumsum(chunk_arr < 0x20)
+    # row-level byte screen: non-ASCII (decode semantics) or any control
+    # byte (raw ctrl inside a JSON string is a parse error for the
+    # oracle; outside strings it is whitespace formatting we do not
+    # reproduce) — both must be absent, one prefix-count pass
+    bad_cum = np.cumsum((chunk_arr >= 128) | (chunk_arr < 0x20))
     row_end = starts64 + lens64
     cand = ok & (lens64 <= max_len)
-    cand &= count_in_spans(hi_cum, starts64, row_end) == 0
-    cand &= count_in_spans(ctl_cum, starts64, row_end) == 0
+    cand &= count_in_spans(bad_cum, starts64, row_end) == 0
     cand &= ~(jmask & key_esc).any(axis=1)
 
-    # key-name matrix for special routing ([n, F, 16])
+    # special-key routing: pack each key's first 8 bytes (zero-masked
+    # past its end) into one big-endian u64 and compare against the six
+    # special names' constants; names longer than 8 bytes verify their
+    # tail sparsely (few candidates survive the prefix+length match)
     kabs = starts64[:, None] + key_s
-    kidx = (kabs[:, :, None]
-            + np.arange(_KEYW, dtype=np.int64)[None, None, :])
     klen = key_e - key_s
-    km = chunk_arr[np.clip(kidx, 0, max(chunk_arr.size - 1, 0))] \
-        if chunk_arr.size else np.zeros((n, F, _KEYW), dtype=np.uint8)
+    k8i = (kabs[:, :, None].astype(np.int32)
+           + np.arange(8, dtype=np.int32)[None, None, :])
+    k8 = np.where(np.arange(8)[None, None, :] < klen[:, :, None],
+                  chunk_pad[k8i], np.uint8(0))
+    kwords = np.ascontiguousarray(k8).view(">u8")[:, :, 0]
 
     def name_is(word: bytes):
-        m = jmask & (klen == len(word))
-        for i, ch in enumerate(word):
-            m = m & (km[:, :, i] == ch)
+        prefix = word[:8] + b"\0" * (8 - min(len(word), 8))
+        target = int.from_bytes(prefix, "big")
+        m = jmask & (klen == len(word)) & (kwords == np.uint64(target))
+        if len(word) > 8 and m.any():
+            rr, ff = np.nonzero(m)
+            tail_ok = np.ones(rr.size, dtype=bool)
+            base = kabs[rr, ff]
+            for i, ch in enumerate(word[8:], start=8):
+                tail_ok &= chunk_pad[base + i] == ch
+            m2 = np.zeros_like(m)
+            m2[rr[tail_ok], ff[tail_ok]] = True
+            return m2
         return m
 
     sp_masks = {w: name_is(w) for w in _SPECIALS}
@@ -144,8 +161,7 @@ def encode_gelf_gelf_block(
         return val_esc[rows, f]
 
     def byte_at(pos):
-        return chunk_arr[np.clip(pos, 0, max(chunk_arr.size - 1, 0))] \
-            if chunk_arr.size else np.zeros(pos.shape, dtype=np.uint8)
+        return chunk_pad[np.asarray(pos, dtype=np.int64)]
 
     nondig_cum = np.cumsum(~((chunk_arr >= ord("0"))
                              & (chunk_arr <= ord("9"))))
@@ -190,12 +206,9 @@ def encode_gelf_gelf_block(
     # version: absent or the exact literals
     ver_a, ver_b = vspan_at(ver_f)
     ver_len = ver_b - ver_a
-    ver_first = chunk_arr[np.clip(ver_a, 0, max(chunk_arr.size - 1, 0))] \
-        if chunk_arr.size else np.zeros(n, dtype=np.uint8)
-    ver_last = chunk_arr[np.clip(ver_b - 1, 0, max(chunk_arr.size - 1, 0))] \
-        if chunk_arr.size else np.zeros(n, dtype=np.uint8)
-    ver_mid = chunk_arr[np.clip(ver_a + 1, 0, max(chunk_arr.size - 1, 0))] \
-        if chunk_arr.size else np.zeros(n, dtype=np.uint8)
+    ver_first = byte_at(ver_a)
+    ver_last = byte_at(np.maximum(ver_b - 1, 0))
+    ver_mid = byte_at(ver_a + 1)
     ver_ok = ((vt_at(ver_f) == VT_STRING) & ~vesc_at(ver_f)
               & (ver_len == 3) & (ver_first == ord("1"))
               & (ver_mid == ord("."))
@@ -203,8 +216,7 @@ def encode_gelf_gelf_block(
     cand &= ~has_ver | ver_ok
     # level: absent or a bare digit 0-7
     lvl_a, lvl_b = vspan_at(lvl_f)
-    lvl_byte = chunk_arr[np.clip(lvl_a, 0, max(chunk_arr.size - 1, 0))] \
-        if chunk_arr.size else np.zeros(n, dtype=np.uint8)
+    lvl_byte = byte_at(lvl_a)
     lvl_ok = ((vt_at(lvl_f) == VT_NUMBER) & (lvl_b - lvl_a == 1)
               & (lvl_byte >= ord("0")) & (lvl_byte <= ord("7")))
     cand &= ~has_lvl | lvl_ok
@@ -214,10 +226,8 @@ def encode_gelf_gelf_block(
     vabs_a = starts64[:, None] + val_s
     vabs_b = starts64[:, None] + val_e
     vlen = val_e - val_s
-    vfirst = chunk_arr[np.clip(vabs_a, 0, max(chunk_arr.size - 1, 0))] \
-        if chunk_arr.size else np.zeros((n, F), dtype=np.uint8)
-    vsecond = chunk_arr[np.clip(vabs_a + 1, 0, max(chunk_arr.size - 1, 0))] \
-        if chunk_arr.size else np.zeros((n, F), dtype=np.uint8)
+    vfirst = byte_at(vabs_a)
+    vsecond = byte_at(vabs_a + 1)
     dot_e_cum = np.cumsum((chunk_arr == ord(".")) | (chunk_arr == ord("e"))
                           | (chunk_arr == ord("E")))
     has_frac = count_in_spans(dot_e_cum, vabs_a, vabs_b) > 0
@@ -241,7 +251,7 @@ def encode_gelf_gelf_block(
         rop = prow.astype(np.int64)
         ns_abs = kabs[prow, pcol]
         ne_abs = starts64[rop] + key_e[prow, pcol]
-        has_us = chunk_arr[np.clip(ns_abs, 0, chunk_arr.size - 1)] == ord("_")
+        has_us = byte_at(ns_abs) == ord("_")
         order, dup_rows = sorted_pair_order(
             chunk_arr, rop, ns_abs + has_us, ne_abs, _NAME_CAP)
         if dup_rows.size:
@@ -266,27 +276,28 @@ def encode_gelf_gelf_block(
     prefix_lens_tier: Optional[np.ndarray] = None
 
     if R:
-        # timestamps: gather the (canonical, ctrl-free, <= _TSW byte)
-        # spans into a padded matrix and dedupe rows before the only
-        # per-value Python work, like ts_scratch does for computed stamps
+        # timestamps: dedupe the span texts in one dict pass before the
+        # per-value float/format work (repetitive streams share few
+        # distinct stamps; a dict of bytes keys beats a row-unique sort)
         tsa = tsa_all[ridx]
         tsb = tsb_all[ridx]
-        tmi = (tsa[:, None] + np.arange(_TSW, dtype=np.int64)[None, :])
-        tmat = np.where(tmi < tsb[:, None],
-                        chunk_arr[np.clip(tmi, 0, chunk_arr.size - 1)],
-                        np.uint8(0))
-        uniq, inv = np.unique(tmat, axis=0, return_inverse=True)
-        ts_strs = [
-            json_f64(float(bytes(row[row != 0]).decode("ascii")))
-            .encode("ascii")
-            for row in uniq
-        ]
-        ulen = np.fromiter((len(t) for t in ts_strs), dtype=np.int64,
-                           count=len(ts_strs))
-        uoff = exclusive_cumsum(ulen)[:-1]
-        scratch = b"".join(ts_strs)
-        ts_len = ulen[inv]
-        ts_off = uoff[inv]
+        cache = {}
+        pieces = []
+        pos = 0
+        ts_off = np.empty(R, dtype=np.int64)
+        ts_len = np.empty(R, dtype=np.int64)
+        for i, (a, b) in enumerate(zip(tsa.tolist(), tsb.tolist())):
+            key = chunk_bytes[a:b]
+            hit = cache.get(key)
+            if hit is None:
+                txt = json_f64(float(key)).encode("ascii")
+                hit = (pos, len(txt))
+                cache[key] = hit
+                pieces.append(txt)
+                pos += len(txt)
+            ts_off[i] = hit[0]
+            ts_len[i] = hit[1]
+        scratch = b"".join(pieces)
 
         consts, offs = build_source(
             b"{", b'"_', b'"', b'":', b'",', b"true", b"false", b"null",
