@@ -89,6 +89,32 @@ def test_unknown_output_type():
         ))
 
 
+def _start_tcp_tpu_pipeline(out_path, extra_input=""):
+    """Construct, start and return a TCP rfc5424_tpu -> gelf file
+    pipeline with its accept loop on a daemon thread; waits (bounded)
+    for the listener to bind."""
+    import threading
+    import time
+
+    from flowgger_tpu.pipeline import Pipeline
+
+    config = Config.from_string(
+        '[input]\ntype = "tcp"\nlisten = "127.0.0.1:0"\n'
+        'format = "rfc5424_tpu"\ntimeout = 5\n' + extra_input +
+        '[output]\ntype = "file"\nformat = "gelf"\n'
+        f'file_path = "{out_path}"\n')
+    p = Pipeline(config)
+    p.start_output()
+    t = threading.Thread(target=p.input.accept, args=(p.handler_factory,),
+                         daemon=True)
+    t.start()
+    deadline = time.time() + 10
+    while p.input.bound_port is None:
+        assert time.time() < deadline, "listener never bound"
+        time.sleep(0.01)
+    return p
+
+
 def test_tpu_handler_shared_across_connections(tmp_path):
     """Every connection of a *_tpu pipeline shares ONE batch handler so
     batches aggregate across connections; scalar pipelines keep
@@ -100,18 +126,7 @@ def test_tpu_handler_shared_across_connections(tmp_path):
     from flowgger_tpu.pipeline import Pipeline
 
     out_path = tmp_path / "shared.out"
-    config = Config.from_string(
-        '[input]\ntype = "tcp"\nlisten = "127.0.0.1:0"\n'
-        'format = "rfc5424_tpu"\ntimeout = 5\ntpu_flush_ms = 30\n'
-        '[output]\ntype = "file"\nformat = "gelf"\n'
-        f'file_path = "{out_path}"\n')
-    p = Pipeline(config)
-    p.start_output()
-    t = threading.Thread(target=p.input.accept, args=(p.handler_factory,),
-                         daemon=True)
-    t.start()
-    while p.input.bound_port is None:
-        time.sleep(0.01)
+    p = _start_tcp_tpu_pipeline(out_path, "tpu_flush_ms = 30\n")
     line = "<13>1 2015-08-05T15:53:45Z shared app 1 2 - via conn %d"
     conns = [socket.create_connection(("127.0.0.1", p.input.bound_port))
              for _ in range(3)]
@@ -137,3 +152,45 @@ def test_tpu_handler_shared_across_connections(tmp_path):
     p2 = Pipeline(config2)
     h1, h2 = p2.handler_factory(), p2.handler_factory()
     assert h1 is not h2
+
+
+def test_shared_handler_concurrent_connections_no_loss(tmp_path):
+    """Many threads hammering the shared batch handler concurrently:
+    every message must come out exactly once (locks on ingest, decode
+    serialization, pipelined flushes)."""
+    import socket
+    import threading
+    import time
+
+    from flowgger_tpu.pipeline import Pipeline
+
+    out_path = tmp_path / "stress.out"
+    p = _start_tcp_tpu_pipeline(
+        out_path, "tpu_batch_size = 64\ntpu_flush_ms = 20\n")
+
+    n_conns, per_conn = 8, 200
+
+    def sender(cid):
+        with socket.create_connection(("127.0.0.1", p.input.bound_port)) as s:
+            for i in range(per_conn):
+                s.sendall(
+                    (f"<13>1 2015-08-05T15:53:45.{i % 1000:03d}Z h app "
+                     f"{cid} m - c{cid}-m{i}\n").encode())
+
+    threads = [threading.Thread(target=sender, args=(c,))
+               for c in range(n_conns)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    want = n_conns * per_conn
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        if out_path.exists() and out_path.read_bytes().count(b"\0") >= want:
+            break
+        time.sleep(0.05)
+    data = out_path.read_bytes()
+    assert data.count(b"\0") == want
+    for c in range(n_conns):
+        for i in range(0, per_conn, 37):
+            assert f"c{c}-m{i}".encode() in data
