@@ -24,12 +24,20 @@ DEFAULT_LISTEN = "0.0.0.0:514"
 MAX_UDP_PACKET_SIZE = 65_527
 MAX_COMPRESSION_RATIO = 5
 _MAX_DECOMPRESSED = MAX_UDP_PACKET_SIZE * MAX_COMPRESSION_RATIO
+# compression magic, shared between the scalar sniffing path and the
+# vectorized recvmmsg classifier so the two can never drift
+ZLIB_MIN_LEN = 8
+ZLIB_MAGIC0 = 0x78
+ZLIB_MAGIC1 = (0x01, 0x9C, 0xDA)
+GZIP_MIN_LEN = 24
+GZIP_MAGIC = (0x1F, 0x8B, 0x08)
 
 
 def handle_record_maybe_compressed(data: bytes, handler: Handler) -> None:
     """Sniff compression magic, inflate, hand off; errors go to stderr
     (udp_input.rs:100-123 semantics, messages included)."""
-    if len(data) >= 8 and data[0] == 0x78 and data[1] in (0x01, 0x9C, 0xDA):
+    if (len(data) >= ZLIB_MIN_LEN and data[0] == ZLIB_MAGIC0
+            and data[1] in ZLIB_MAGIC1):
         try:
             d = zlib.decompressobj()
             out = d.decompress(data, _MAX_DECOMPRESSED)
@@ -40,7 +48,7 @@ def handle_record_maybe_compressed(data: bytes, handler: Handler) -> None:
             print("Corrupted compressed (gzip/zlib) record", file=sys.stderr)
             return
         handler.handle_bytes(out)
-    elif len(data) >= 24 and data[:3] == b"\x1f\x8b\x08":
+    elif len(data) >= GZIP_MIN_LEN and data[:3] == bytes(GZIP_MAGIC):
         try:
             # wbits=47: zlib-or-gzip auto-detect; max_length bounds the
             # expansion *during* decompression (no bomb-sized allocation)
@@ -73,9 +81,58 @@ class UdpInput(Input):
         self.bound_port = sock.getsockname()[1]
         handler = handler_factory()
         handler.bare_errors = True
+        if hasattr(handler, "ingest_spans"):
+            from ..utils import recvmmsg as _rm
+
+            if _rm.available():
+                self._accept_batched(sock, handler)
+                return
         while True:
             try:
                 data, _src = sock.recvfrom(MAX_UDP_PACKET_SIZE)
             except OSError:
                 continue
             handle_record_maybe_compressed(data, handler)
+
+    @staticmethod
+    def _accept_batched(sock, handler) -> None:
+        """recvmmsg fast path for span-capable handlers: up to 64
+        datagrams per syscall; plain datagrams compact into one chunk
+        and flow as frame spans with zero per-datagram Python, while
+        compressed ones (zlib/gzip magic) take the sniffing path.
+        Relative ordering between plain and compressed datagrams of one
+        batch is unspecified — UDP guarantees no ordering anyway."""
+        import numpy as np
+
+        from ..tpu.assemble import concat_segments, exclusive_cumsum
+        from ..utils.recvmmsg import BatchReceiver
+
+        rx = BatchReceiver(sock)
+        while True:
+            try:
+                got = rx.recv_batch()
+            except OSError:
+                return
+            if got is None:
+                continue
+            buf, starts, lens = got
+            b0 = buf[starts]
+            b1 = buf[starts + 1]
+            b2 = buf[starts + 2]
+            zlibm = (lens >= ZLIB_MIN_LEN) & (b0 == ZLIB_MAGIC0) & (
+                (b1 == ZLIB_MAGIC1[0]) | (b1 == ZLIB_MAGIC1[1])
+                | (b1 == ZLIB_MAGIC1[2]))
+            gzm = ((lens >= GZIP_MIN_LEN) & (b0 == GZIP_MAGIC[0])
+                   & (b1 == GZIP_MAGIC[1]) & (b2 == GZIP_MAGIC[2]))
+            special = zlibm | gzm
+            clean = ~special
+            if clean.any():
+                cs, cl = starts[clean], lens[clean]
+                chunk = concat_segments(buf, cs, cl).tobytes()
+                new_starts = exclusive_cumsum(cl)[:-1].astype(np.int32)
+                handler.ingest_spans(chunk, new_starts,
+                                     cl.astype(np.int32))
+            for i in np.flatnonzero(special).tolist():
+                s = int(starts[i])
+                handle_record_maybe_compressed(
+                    bytes(buf[s:s + int(lens[i])]), handler)

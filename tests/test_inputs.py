@@ -376,3 +376,61 @@ def test_file_input_logrotate_rename_create(tmp_path):
     time.sleep(0.2)
     log.write_text(f"{LINE}\n{LINE}\n")
     assert _drain(tx, 2) == [LINE.encode()] * 2
+
+
+def test_udp_batched_recvmmsg_tpu(tmp_path):
+    """UDP with a span-capable handler takes the recvmmsg fast path:
+    plain datagrams (incl. empty) batch into spans, compressed ones
+    inflate, all arrive exactly once."""
+    import zlib as _zlib
+    import gzip as _gzip
+
+    from flowgger_tpu.encoders.gelf import GelfEncoder
+    from flowgger_tpu.inputs.udp_input import UdpInput
+    from flowgger_tpu.mergers import LineMerger
+    from flowgger_tpu.tpu.batch import BatchHandler
+    from flowgger_tpu.utils import recvmmsg as rm
+    from flowgger_tpu.block import EncodedBlock
+    from flowgger_tpu.decoders.rfc5424 import RFC5424Decoder
+
+    if not rm.available():
+        import pytest
+
+        pytest.skip("recvmmsg unavailable")
+    cfg = Config.from_string(
+        '[input]\nlisten = "127.0.0.1:0"\ntpu_flush_ms = 20\n')
+    inp = UdpInput(cfg)
+    tx = queue.Queue()
+    dec = RFC5424Decoder(cfg)
+    enc = GelfEncoder(cfg)
+
+    def factory():
+        return BatchHandler(tx, dec, enc, cfg, fmt="rfc5424",
+                            start_timer=True, merger=LineMerger())
+
+    t = threading.Thread(target=inp.accept, args=(factory,), daemon=True)
+    t.start()
+    while inp.bound_port is None:
+        time.sleep(0.01)
+    line = "<13>1 2015-08-05T15:53:45Z h app 1 2 - udp msg %d"
+    with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+        for i in range(40):
+            s.sendto((line % i).encode(), ("127.0.0.1", inp.bound_port))
+        s.sendto(_zlib.compress((line % 100).encode()),
+                 ("127.0.0.1", inp.bound_port))
+        s.sendto(_gzip.compress((line % 101).encode() + b" padpadpadpad"),
+                 ("127.0.0.1", inp.bound_port))
+        s.sendto(b"", ("127.0.0.1", inp.bound_port))  # zero-length span
+    got = []
+    deadline = time.time() + 10
+    while len(got) < 42 and time.time() < deadline:
+        try:
+            item = tx.get(timeout=0.2)
+        except queue.Empty:
+            continue
+        got.extend(item.iter_unframed() if isinstance(item, EncodedBlock)
+                   else [item])
+    assert len(got) == 42
+    blob = b"".join(got)
+    for i in list(range(40)) + [100, 101]:
+        assert (f"udp msg {i}".encode()) in blob
