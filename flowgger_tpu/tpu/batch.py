@@ -85,12 +85,16 @@ class BatchHandler(Handler):
         from ..encoders.passthrough import PassthroughEncoder
         from ..encoders.rfc5424 import RFC5424Encoder
 
-        self._fast_encode = (fmt == "rfc5424" and (
-            type(encoder) in (GelfEncoder, RFC5424Encoder, LTSVEncoder)
-            or (type(encoder) is PassthroughEncoder
-                and encoder.header_time_format is None))
-        ) or (fmt in ("rfc3164", "ltsv", "gelf", "auto")
-              and type(encoder) is GelfEncoder)
+        passthrough_ok = (type(encoder) is PassthroughEncoder
+                          and encoder.header_time_format is None)
+        self._passthrough_ok = passthrough_ok
+        self._fast_encode = (
+            (fmt == "rfc5424"
+             and (type(encoder) in (GelfEncoder, RFC5424Encoder,
+                                    LTSVEncoder) or passthrough_ok))
+            or (fmt in ("rfc3164", "ltsv", "gelf", "auto")
+                and type(encoder) is GelfEncoder)
+            or (fmt == "rfc3164" and passthrough_ok))
         # single source of truth for kernel dispatch: fmt -> batch decoder
         auto_ltsv = self._auto_ltsv_decoder(cfg) if fmt == "auto" else None
         self._auto_ltsv = auto_ltsv
@@ -278,9 +282,9 @@ class BatchHandler(Handler):
         if merger_suffix(self._merger) is None:
             return False
         if self.fmt == "rfc3164":
-            # legacy-syslog fast path currently block-encodes GELF only
-            return (type(self.encoder) is GelfEncoder
-                    and not self.encoder.extra)
+            return self._passthrough_ok or (
+                type(self.encoder) is GelfEncoder
+                and not self.encoder.extra)
         if self.fmt == "ltsv":
             # untyped LTSV decode block-encodes GELF only
             return (type(self.encoder) is GelfEncoder
@@ -296,7 +300,7 @@ class BatchHandler(Handler):
         if type(self.encoder) is GelfEncoder:
             return not self.encoder.extra
         if type(self.encoder) is PassthroughEncoder:
-            return self.encoder.header_time_format is None
+            return self._passthrough_ok
         return type(self.encoder) in (RFC5424Encoder, LTSVEncoder)
 
     def _emit_fast(self, packed) -> None:
@@ -456,11 +460,19 @@ def block_fetch_encode(fmt, handle, packed, encoder, merger,
 
     t0 = _time.perf_counter()
     if fmt == "rfc3164":
-        from . import encode_rfc3164_gelf_block, rfc3164
+        from ..encoders.passthrough import PassthroughEncoder
+        from . import (
+            encode_passthrough_block,
+            encode_rfc3164_gelf_block,
+            rfc3164,
+        )
 
         host_out = rfc3164.decode_rfc3164_fetch(handle)
         t1 = _time.perf_counter()
-        res = encode_rfc3164_gelf_block.encode_rfc3164_gelf_block(
+        fn3164 = (encode_passthrough_block.encode_rfc3164_passthrough_block
+                  if type(encoder) is PassthroughEncoder
+                  else encode_rfc3164_gelf_block.encode_rfc3164_gelf_block)
+        res = fn3164(
             packed[2], packed[3], packed[4], host_out, packed[5],
             packed[0].shape[1], encoder, merger)
     elif fmt == "ltsv":

@@ -36,14 +36,56 @@ def encode_rfc5424_passthrough_block(
 ) -> Optional[BlockResult]:
     """Returns None when the route can't apply (prepend-timestamp
     configured or an unknown merger type)."""
-    spec = merger_suffix(merger)
-    if spec is None or encoder.header_time_format is not None:
+    if merger_suffix(merger) is None or encoder.header_time_format is not None:
         return None
-    suffix, syslen = spec
-
     n = int(n_real)
     starts64 = np.asarray(starts[:n], dtype=np.int64)
     lens64 = np.asarray(orig_lens[:n], dtype=np.int64)
+
+    def spans(ridx):
+        a = starts64[ridx] + np.asarray(out["full_start"])[:n][ridx]
+        return a, (starts64[ridx]
+                   + np.asarray(out["trim_end"])[:n][ridx] - a)
+
+    from .materialize import _scalar_line
+
+    return _passthrough_block(chunk_bytes, starts64, lens64, out,
+                              n, max_len, encoder, merger, spans,
+                              _scalar_line)
+
+
+def encode_rfc3164_passthrough_block(
+    chunk_bytes: bytes,
+    starts: np.ndarray,
+    orig_lens: np.ndarray,
+    out: Dict[str, np.ndarray],
+    n_real: int,
+    max_len: int,
+    encoder,
+    merger: Optional[Merger],
+) -> Optional[BlockResult]:
+    """rfc3164 variant: full_msg is the whole line, untrimmed
+    (materialize_rfc3164.py Record construction)."""
+    if merger_suffix(merger) is None or encoder.header_time_format is not None:
+        return None
+    n = int(n_real)
+    starts64 = np.asarray(starts[:n], dtype=np.int64)
+    lens64 = np.asarray(orig_lens[:n], dtype=np.int64)
+
+    def spans(ridx):
+        return starts64[ridx], lens64[ridx]
+
+    from .materialize_rfc3164 import _scalar_3164
+
+    return _passthrough_block(chunk_bytes, starts64, lens64, out,
+                              n, max_len, encoder, merger, spans,
+                              _scalar_3164)
+
+
+def _passthrough_block(chunk_bytes, starts64, lens64, out, n, max_len,
+                       encoder, merger, spans_fn, scalar_fn
+                       ) -> Optional[BlockResult]:
+    suffix, syslen = merger_suffix(merger)  # caller pre-checked
     ok = np.asarray(out["ok"][:n], dtype=bool)
     has_high = np.asarray(out["has_high"][:n], dtype=bool)
     cand = ok & (lens64 <= max_len) & ~has_high
@@ -56,9 +98,7 @@ def encode_rfc5424_passthrough_block(
 
     if R:
         chunk_arr = np.frombuffer(chunk_bytes, dtype=np.uint8)
-        span_src = starts64[ridx] + np.asarray(out["full_start"])[:n][ridx]
-        span_len = (starts64[ridx] + np.asarray(out["trim_end"])[:n][ridx]
-                    - span_src)
+        span_src, span_len = spans_fn(ridx)
         deco, offs = build_source(b"0123456789 ", suffix)
         src = np.concatenate([chunk_arr, deco])
         dbase = chunk_arr.size
@@ -93,4 +133,4 @@ def encode_rfc5424_passthrough_block(
 
     return finish_block(chunk_bytes, starts64, lens64, n, cand, ridx,
                         final_buf, row_off, prefix_lens_tier, suffix,
-                        syslen, merger, encoder)
+                        syslen, merger, encoder, scalar_fn=scalar_fn)

@@ -737,3 +737,45 @@ def test_auto_gelf_block_merges_classes_in_order():
                            else item)
         assert saw_block
         assert got == want, merger
+
+
+def test_rfc3164_passthrough_block_route_matches_scalar():
+    from flowgger_tpu.decoders.rfc3164 import RFC3164Decoder
+    from flowgger_tpu.encoders.passthrough import PassthroughEncoder
+
+    dec = RFC3164Decoder(CFG_EMPTY)
+    enc = PassthroughEncoder(CFG_EMPTY)
+    lines = [
+        b"<34>Aug  5 15:53:45 testhost app[123]: standard layout line",
+        b"Aug  5 15:53:45 host prog: no pri line  ",
+        b"<34>testhost: Aug 5 15:53:45: custom layout line",
+        b"<34>Aug  5 15:53:45 host app: unicode m\xc3\xa9ssage",
+        b"completely invalid",
+    ]
+    for merger in (None, LineMerger(), SyslenMerger()):
+        want = []
+        for ln in lines:
+            try:
+                payload = enc.encode(dec.decode(ln.decode("utf-8")))
+            except Exception:
+                continue
+            want.append(merger.frame(payload) if merger is not None
+                        else payload)
+        tx = queue.Queue()
+        h = BatchHandler(tx, dec, enc, CFG_EMPTY, fmt="rfc3164",
+                         start_timer=False, merger=merger)
+        for ln in lines:
+            h.handle_bytes(ln)
+        h.flush()
+        got = []
+        saw_block = False
+        while not tx.empty():
+            item = tx.get_nowait()
+            if isinstance(item, EncodedBlock):
+                saw_block = True
+                got.extend(item.iter_framed())
+            else:
+                got.append(merger.frame(item) if merger is not None
+                           else item)
+        assert saw_block
+        assert got == want, merger
