@@ -137,11 +137,12 @@ def decode_rfc3164(batch: jnp.ndarray, lens: jnp.ndarray, year,
     # whitespace-run tokenization means any non-space whitespace, or a
     # double space from the time token onward (the rebuilt-message
     # region), or leading/trailing spaces would change the scalar output
-    # single-byte whitespace per str.split(): tab, VT, FF, CR, and the
-    # 0x1C-0x1F separator control bytes (0x0A can't survive framing;
-    # multi-byte unicode whitespace is caught by the materializer's
-    # byte-length-vs-char-length check)
-    ws_other = ((bb == 9) | (bb == 11) | (bb == 12) | (bb == 13)
+    # single-byte whitespace per str.split(): tab, LF, VT, FF, CR, and
+    # the 0x1C-0x1F separator control bytes (LF is reachable inside a
+    # message via nul framing and UDP datagrams; multi-byte unicode
+    # whitespace is caught by the materializer's byte-length-vs-
+    # char-length check)
+    ws_other = ((bb >= 9) & (bb <= 13)
                 | ((bb >= 28) & (bb <= 31))) & valid
     dbl = is_sp & _shift_left(is_sp, 1, False) & (iota >= t0[:, None])
     last_ch_sp = _at(iota, lens - 1, bb) == 32

@@ -1,0 +1,19 @@
+"""Bounded cross-route differential fuzz (the full version lives in
+tools/deep_fuzz.py): every block route's bytes must match the scalar
+pipeline over randomized, mutated, partially-binary corpora."""
+
+import subprocess
+import sys
+import os
+
+REPO = os.path.join(os.path.dirname(__file__), "..")
+
+
+def test_cross_route_fuzz_bounded():
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "deep_fuzz.py"),
+         "5", "1"],
+        capture_output=True, timeout=900, cwd=REPO)
+    assert r.returncode == 0, (
+        r.stdout.decode("utf-8", "replace")[-1500:]
+        + r.stderr.decode("utf-8", "replace")[-800:])

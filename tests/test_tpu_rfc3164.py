@@ -116,3 +116,36 @@ def test_autodetect_uses_rfc3164_kernel():
     results = _decode_auto_batch(mixed, 512)
     assert results[0].record.hostname == "legacyhost1"
     assert results[1].record.hostname == "host5424"
+
+
+def test_embedded_newline_falls_back():
+    """A message byte-stream containing a raw LF (reachable via NUL
+    framing or UDP datagrams, never via line framing) must take the
+    scalar oracle: str.split() treats LF as whitespace and rebuilds the
+    message with single spaces."""
+    import queue
+
+    from flowgger_tpu.config import Config
+    from flowgger_tpu.block import EncodedBlock
+    from flowgger_tpu.encoders.gelf import GelfEncoder
+    from flowgger_tpu.mergers import LineMerger
+    from flowgger_tpu.tpu.batch import BatchHandler
+
+    cfg = Config.from_string("")
+    enc = GelfEncoder(cfg)
+    lines = [b"<34>Aug  5 15:53:45 host app[98\n: embedded lf",
+             b"<34>Aug  5 15:53:45 host app: clean"]
+    want = [enc.encode(ORACLE.decode(ln.decode())) for ln in lines]
+    assert b"app[98 : embedded lf" in want[0]  # LF became a space
+    tx = queue.Queue()
+    h = BatchHandler(tx, ORACLE, enc, cfg, fmt="rfc3164",
+                     start_timer=False, merger=LineMerger())
+    for ln in lines:
+        h.handle_bytes(ln)
+    h.flush()
+    got = []
+    while not tx.empty():
+        item = tx.get_nowait()
+        got.extend(item.iter_unframed() if isinstance(item, EncodedBlock)
+                   else [item])
+    assert got == want

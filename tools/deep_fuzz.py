@@ -1,0 +1,160 @@
+"""Deep cross-route differential fuzz: random corpora through every
+(input fmt, encoder, merger) block route vs the scalar pipeline.
+
+Usage: python tools/deep_fuzz.py [seed] [trials]
+Prints per-route mismatches (none expected) and a FAILURES count.
+A bounded version runs in CI as tests/test_cross_route_fuzz.py.
+"""
+import os, queue, random, re, sys, time
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax; jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+from flowgger_tpu.config import Config
+from flowgger_tpu.block import EncodedBlock
+from flowgger_tpu.decoders.gelf import GelfDecoder
+from flowgger_tpu.decoders.ltsv import LTSVDecoder
+from flowgger_tpu.decoders.rfc3164 import RFC3164Decoder
+from flowgger_tpu.decoders.rfc5424 import RFC5424Decoder
+from flowgger_tpu.encoders.gelf import GelfEncoder
+from flowgger_tpu.encoders.ltsv import LTSVEncoder
+from flowgger_tpu.encoders.passthrough import PassthroughEncoder
+from flowgger_tpu.encoders.rfc5424 import RFC5424Encoder
+from flowgger_tpu.mergers import LineMerger, NulMerger, SyslenMerger
+from flowgger_tpu.tpu.batch import BatchHandler
+
+CFG = Config.from_string("")
+rng = random.Random(int(sys.argv[1]) if len(sys.argv) > 1 else 1)
+
+def rnd_bytes(n):
+    return bytes(rng.randrange(256) for _ in range(n))
+
+def gen_rfc5424():
+    sd = ""
+    if rng.random() < 0.7:
+        nb = rng.randrange(1, 4)
+        blocks = []
+        for b in range(nb):
+            pairs = " ".join(
+                f'k{rng.randrange(20)}="{rnd_val()}"'
+                for _ in range(rng.randrange(0, 9)))
+            blocks.append(f"[b{b}@{rng.randrange(9)}{(' ' + pairs) if pairs else ''}]")
+        sd = "".join(blocks)
+    else:
+        sd = "-"
+    frac = f".{rng.randrange(1, 999999)}" if rng.random() < 0.5 else ""
+    off = rng.choice(["Z", "+02:00", "-11:30", "z"])
+    return (f"<{rng.randrange(200)}>1 2015-08-05T15:53:45{frac}{off} "
+            f"host{rng.randrange(5)} app {rng.randrange(100)} m {sd} "
+            f"msg {rnd_val()}").encode()
+
+def rnd_val():
+    alphabet = 'abc"\\]\t~é '
+    return "".join(rng.choice(alphabet) for _ in range(rng.randrange(0, 10)))
+
+def gen_rfc3164():
+    return (f"<{rng.randrange(200)}>Aug  5 15:53:45 host{rng.randrange(5)} "
+            f"app[{rng.randrange(100)}]: legacy {rnd_val()}").encode()
+
+def gen_ltsv():
+    parts = [f"host:h{rng.randrange(5)}",
+             rng.choice(["time:1438790025.5", "time:2015-08-05T15:53:45Z"])]
+    for _ in range(rng.randrange(0, 6)):
+        parts.append(f"k{rng.randrange(9)}:{rnd_val()}")
+    if rng.random() < 0.7:
+        parts.append(f"message:{rnd_val()}")
+    rng.shuffle(parts)
+    return "\t".join(parts).encode()
+
+def gen_gelf():
+    import json as _json
+    obj = {"host": f"h{rng.randrange(5)}", "timestamp": rng.choice([1438790025, 1438790025.42, -5, 0])}
+    for _ in range(rng.randrange(0, 5)):
+        obj[f"k{rng.randrange(9)}"] = rng.choice([rnd_val(), rng.randrange(-99, 99), True, False, None, 3.25])
+    if rng.random() < 0.5:
+        obj["short_message"] = rnd_val()
+    if rng.random() < 0.3:
+        obj["level"] = rng.randrange(0, 10)
+    return _json.dumps(obj).encode()
+
+GENS = [gen_rfc5424, gen_rfc3164, gen_ltsv, gen_gelf]
+
+
+def norm(bs):
+    """Mask now()-stamps (rows whose input lacked a numeric timestamp
+    differ between the two runs) and, when present, the syslen length
+    prefix their varying width perturbs."""
+    def repl(m):
+        v = float(m.group(1))
+        if abs(v - time.time()) < 86400:
+            return b'"timestamp":NOW'
+        return m.group(0)
+
+    out = re.sub(rb'"timestamp":([0-9.e+-]+)', repl, bs)
+    if b'"timestamp":NOW' in out:
+        out = re.sub(rb'^[0-9]+ ', b'LEN ', out)
+    return out
+
+def corpus(n, gen):
+    out = []
+    for _ in range(n):
+        r = rng.random()
+        if r < 0.08:
+            out.append(rnd_bytes(rng.randrange(0, 60)))
+        elif r < 0.25:
+            b = bytearray(gen())
+            for _ in range(rng.randrange(1, 5)):
+                if b:
+                    b[rng.randrange(len(b))] = rng.randrange(256)
+            out.append(bytes(b))
+        else:
+            out.append(gen())
+    return out
+
+ROUTES = [
+    ("rfc5424", RFC5424Decoder, [GelfEncoder, PassthroughEncoder, RFC5424Encoder, LTSVEncoder], gen_rfc5424),
+    ("rfc3164", RFC3164Decoder, [GelfEncoder, PassthroughEncoder], gen_rfc3164),
+    ("ltsv", LTSVDecoder, [GelfEncoder], gen_ltsv),
+    ("gelf", GelfDecoder, [GelfEncoder], gen_gelf),
+]
+MERGERS = [None, LineMerger(), NulMerger(), SyslenMerger()]
+fails = 0
+for trial in range(int(sys.argv[2]) if len(sys.argv) > 2 else 6):
+    for fmt, dec_cls, encs, gen in ROUTES:
+        lines = corpus(400, gen)
+        for enc_cls in encs:
+            dec = dec_cls(CFG)
+            enc = enc_cls(CFG)
+            merger = rng.choice(MERGERS)
+            want = []
+            for ln in lines:
+                try:
+                    payload = enc.encode(dec.decode(ln.decode("utf-8")))
+                except Exception:
+                    continue
+                want.append(merger.frame(payload) if merger else payload)
+            tx = queue.Queue()
+            h = BatchHandler(tx, dec, enc, CFG, fmt=fmt, start_timer=False, merger=merger)
+            for ln in lines:
+                h.handle_bytes(ln)
+            h.flush()
+            got = []
+            while not tx.empty():
+                item = tx.get_nowait()
+                if isinstance(item, EncodedBlock):
+                    got.extend(item.iter_framed())
+                else:
+                    got.append(merger.frame(item) if merger else item)
+            got = [norm(g) for g in got]
+            want = [norm(w) for w in want]
+            if got != want:
+                fails += 1
+                print(f"MISMATCH fmt={fmt} enc={enc_cls.__name__} merger={type(merger).__name__ if merger else None} trial={trial}")
+                for i, (w, g) in enumerate(zip(want, got)):
+                    if w != g:
+                        print("  WANT:", w[:140])
+                        print("  GOT :", g[:140])
+                        break
+                if len(want) != len(got):
+                    print("  count:", len(want), "vs", len(got))
+print("FAILURES:", fails)
+sys.exit(1 if fails else 0)
