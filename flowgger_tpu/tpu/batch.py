@@ -286,10 +286,10 @@ class BatchHandler(Handler):
                 type(self.encoder) is GelfEncoder
                 and not self.encoder.extra)
         if self.fmt == "ltsv":
-            # untyped LTSV decode block-encodes GELF only
+            # LTSV decode block-encodes GELF only; typed-schema support
+            # (and its per-row fallbacks) live in the encoder itself
             return (type(self.encoder) is GelfEncoder
-                    and not self.encoder.extra
-                    and not self.scalar.decoder.schema)
+                    and not self.encoder.extra)
         if self.fmt == "gelf":
             return (type(self.encoder) is GelfEncoder
                     and not self.encoder.extra)
@@ -355,6 +355,12 @@ class BatchHandler(Handler):
         res, fetch_s = block_fetch_encode(self.fmt, handle, packed,
                                           self.encoder, self._merger,
                                           ltsv_dec)
+        if res is None:
+            # the route declined after the fact (e.g. an oversized
+            # ltsv_schema or a configured suffix): Record path
+            self._emit(_decode_packed(self.fmt, packed,
+                                      self.scalar.decoder))
+            return
         t2 = _time.perf_counter()
         _metrics.add_seconds("device_fetch_seconds", fetch_s)
         _metrics.add_seconds("encode_seconds", t2 - t0 - fetch_s)

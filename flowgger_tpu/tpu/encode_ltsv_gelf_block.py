@@ -8,11 +8,13 @@ An untyped LTSV record (materialize_ltsv.py, no ``ltsv_schema``/
      "short_message":M|-, "timestamp":T, "version":"1.1"}
 
 Pair keys are emitted sorted (the shared uint64-word lexsort), values
-JSON-escaped via the sparse EscapeMap.  Rows with typed schemas (whole
-route disabled), duplicate keys, colon-less parts (the scalar path
-prints a "Missing value" notice), unix-literal-timestamp parse quirks,
-or non-ASCII bytes re-run the scalar oracle, keeping bytes identical to
-decoder→GelfEncoder.
+JSON-escaped via the sparse EscapeMap.  Typed ``ltsv_schema`` keys stay
+on the fast tier when their rendered bytes equal the raw span (bool
+``true``/``false`` literals, canonical u64/i64 integers — emitted bare);
+f64-typed values, non-canonical numbers, configured name suffixes,
+duplicate keys, colon-less parts (the scalar path prints a "Missing
+value" notice), and non-ASCII bytes re-run the scalar oracle, keeping
+bytes identical to decoder→GelfEncoder.
 """
 
 from __future__ import annotations
@@ -26,6 +28,7 @@ from ..utils.rustfmt import json_f64
 from .assemble import (
     build_source,
     concat_segments,
+    count_in_spans,
     escape_json,
     exclusive_cumsum,
 )
@@ -69,11 +72,17 @@ def encode_ltsv_gelf_block(
     spec = merger_suffix(merger)
     if spec is None or encoder.extra:
         return None
-    if decoder.schema:
-        # typed values need Python conversion: Record path (suffixes
-        # are only consulted for schema-typed keys, so untyped configs
-        # qualify regardless of the suffix table)
-        return None
+    schema = decoder.schema or {}
+    if schema:
+        # typed keys are supported on the fast tier for string/bool/
+        # u64/i64 when rendered bytes equal the raw span (canonical
+        # integers, the exact true/false literals); f64 values, any
+        # configured name suffix, and big schemas take the Record path
+        if len(schema) > 8:
+            return None
+        if any(decoder.suffixes.get(t) is not None
+               for t in set(schema.values())):
+            return None
 
     n = int(n_real)
     starts64 = np.asarray(starts[:n], dtype=np.int64)
@@ -128,17 +137,92 @@ def encode_ltsv_gelf_block(
         ne_abs = starts64[rop] + colon_pos[rows_all, cols_all]
         vs_abs = ne_abs + 1
         ve_abs = starts64[rop] + part_end[rows_all, cols_all]
+        # typed-schema pair classification: 0 string, 1 bare literal
+        # (bool true/false or canonical int — rendered bytes equal the
+        # span), 2 needs-oracle (f64, non-canonical, out-of-tier)
+        ptype = np.zeros(T, dtype=np.int8)
+        if schema:
+            # zero-padded view for fixed-width gathers past span ends
+            # (kernel fill values are bounded by the row-relative
+            # max_len); only the typed classification needs it
+            chunk_pad = np.concatenate(
+                [chunk_arr, np.zeros(max_len + 16, dtype=np.uint8)])
+            nlen_p = ne_abs - ns_abs
+            vlen_p = ve_abs - vs_abs
+            vfirst = chunk_pad[vs_abs]
+            vsecond = chunk_pad[np.minimum(vs_abs + 1, vs_abs + vlen_p - 1
+                                           + (vlen_p == 0))]
+
+            def name_match(word: bytes):
+                m = nlen_p == len(word)
+                if not m.any():
+                    return m
+                rr = np.flatnonzero(m)
+                okb = np.ones(rr.size, dtype=bool)
+                base = ns_abs[rr]
+                for i, ch in enumerate(word):
+                    okb &= chunk_pad[base + i] == ch
+                out_m = np.zeros(T, dtype=bool)
+                out_m[rr[okb]] = True
+                return out_m
+
+            def literal_match(word: bytes):
+                m = vlen_p == len(word)
+                if not m.any():
+                    return m
+                rr = np.flatnonzero(m)
+                okb = np.ones(rr.size, dtype=bool)
+                base = vs_abs[rr]
+                for i, ch in enumerate(word):
+                    okb &= chunk_pad[base + i] == ch
+                out_m = np.zeros(T, dtype=bool)
+                out_m[rr[okb]] = True
+                return out_m
+
+            # canonical integer spans: optional single '-', digits only,
+            # no leading zero (except exactly "0"), no '+', not "-0..."
+            dig_cum = np.cumsum(~((chunk_arr >= ord("0"))
+                                  & (chunk_arr <= ord("9"))))
+            neg = vfirst == ord("-")
+            nondig = count_in_spans(dig_cum, vs_abs, ve_abs)
+            dlen = vlen_p - neg
+            int_canon = ((dlen >= 1) & (dlen <= 18)
+                         & (nondig == neg.astype(np.int64))
+                         & ~((vfirst == ord("0")) & (vlen_p > 1))
+                         & ~(neg & (vsecond == ord("0"))))
+            for key, sdtype in schema.items():
+                m = name_match(key.encode("utf-8"))
+                if not m.any():
+                    continue
+                if sdtype == "string":
+                    continue
+                if sdtype == "bool":
+                    okv = literal_match(b"true") | literal_match(b"false")
+                    ptype = np.where(m, np.where(okv, 1, 2), ptype)
+                elif sdtype == "u64":
+                    okv = int_canon & ~neg
+                    ptype = np.where(m, np.where(okv, 1, 2), ptype)
+                elif sdtype == "i64":
+                    ptype = np.where(m, np.where(int_canon, 1, 2), ptype)
+                else:  # f64 or unknown: oracle
+                    ptype = np.where(m, 2, ptype)
+            bad = ptype == 2
+            if bad.any():
+                cand[np.unique(rop[bad])] = False
+
         order, dup_rows = sorted_pair_order(chunk_arr, rop, ns_abs,
                                             ne_abs, _NAME_CAP)
         if dup_rows.size:
             cand[dup_rows] = False
-            keep = cand[rop[order]]
-            order = order[keep]
+        keep = cand[rop[order]]
+        order = order[keep]
         ns_s, ne_s = ns_abs[order], ne_abs[order]
         vs_s, ve_s = vs_abs[order], ve_abs[order]
         rop_s = rop[order]
+        bare_s = (ptype == 1)[order] if schema else             np.zeros(rop_s.size, dtype=bool)
     else:
         ns_s = ne_s = vs_s = ve_s = rop_s = np.zeros(0, dtype=np.int64)
+        bare_s = np.zeros(0, dtype=bool)
 
     ridx = np.flatnonzero(cand)
     R = ridx.size
@@ -238,12 +322,15 @@ def encode_ltsv_gelf_block(
             seg_len[p0] = 2
             seg_src[p0 + 1] = name_src
             seg_len[p0 + 1] = name_len
+            # typed bare literals (bool/int) drop the value quotes:
+            # '":' is a prefix of the '":"' const and ',' a suffix of
+            # the '",' const, so both variants index the same bank
             seg_src[p0 + 2] = cbase + o_p1
-            seg_len[p0 + 2] = 3
+            seg_len[p0 + 2] = np.where(bare_s, 2, 3)
             seg_src[p0 + 3] = val_src
             seg_len[p0 + 3] = val_len
-            seg_src[p0 + 4] = cbase + o_p2
-            seg_len[p0 + 4] = 2
+            seg_src[p0 + 4] = cbase + o_p2 + bare_s
+            seg_len[p0 + 4] = np.where(bare_s, 1, 2)
 
         fd = (rstart + 1 + 5 * p)[:, None] + np.arange(
             FIXED, dtype=np.int64)[None, :]

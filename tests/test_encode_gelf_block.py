@@ -779,3 +779,73 @@ def test_rfc3164_passthrough_block_route_matches_scalar():
                            else item)
         assert saw_block
         assert got == want, merger
+
+
+def test_ltsv_gelf_block_typed_schema_fast_tier():
+    """bool/u64/i64-typed ltsv_schema keys stay on the fast tier when
+    canonical (bare literals in the GELF output); f64 and non-canonical
+    values drop to the oracle — all byte-identical to the scalar path."""
+    from flowgger_tpu.decoders.ltsv import LTSVDecoder
+
+    cfg = Config.from_string(
+        '[input.ltsv_schema]\ncounter = "u64"\ndelta = "i64"\n'
+        'flag = "bool"\nratio = "f64"\nname = "string"\n')
+    dec = LTSVDecoder(cfg)
+    lines = [
+        b"host:h\ttime:1438790025\tcounter:42\tflag:true\tmessage:m1",
+        b"host:h\ttime:1438790025\tdelta:-7\tname:xyz\tmessage:m2",
+        b"host:h\ttime:1438790025\tcounter:007\tmessage:bad int",
+        b"host:h\ttime:1438790025\tflag:TRUE\tmessage:bad bool",
+        b"host:h\ttime:1438790025\tratio:2.5\tmessage:f64 via oracle",
+        b"host:h\ttime:1438790025\tdelta:-0\tmessage:minus zero",
+        b"host:h\ttime:1438790025\tcounter:+5\tmessage:plus sign",
+    ]
+    want = []
+    for ln in lines:
+        try:
+            want.append(ENC.encode(dec.decode(ln.decode())))
+        except Exception:
+            continue
+    tx = queue.Queue()
+    h = BatchHandler(tx, dec, ENC, cfg, fmt="ltsv",
+                     start_timer=False, merger=None)
+    for ln in lines:
+        h.handle_bytes(ln)
+    h.flush()
+    got = []
+    saw_block = False
+    while not tx.empty():
+        item = tx.get_nowait()
+        if isinstance(item, EncodedBlock):
+            saw_block = True
+            got.extend(item.iter_unframed())
+        else:
+            got.append(item)
+    assert saw_block
+    assert got == want
+    assert b'"_counter":42' in got[0]      # bare number
+    assert b'"_flag":true' in got[0]       # bare bool
+    assert b'"_delta":-7' in got[1]
+
+
+def test_ltsv_big_schema_declines_to_record_path():
+    """A >8-key schema makes the block route decline after submit; the
+    handler must fall back to the Record path, not crash."""
+    from flowgger_tpu.decoders.ltsv import LTSVDecoder
+
+    keys = "\n".join(f'k{i} = "u64"' for i in range(9))
+    cfg = Config.from_string(f"[input.ltsv_schema]\n{keys}\n")
+    dec = LTSVDecoder(cfg)
+    lines = [b"host:h\ttime:1438790025\tk0:1\tmessage:big schema"]
+    want = [ENC.encode(dec.decode(lines[0].decode()))]
+    tx = queue.Queue()
+    h = BatchHandler(tx, dec, ENC, cfg, fmt="ltsv",
+                     start_timer=False, merger=None)
+    h.handle_bytes(lines[0])
+    h.flush()
+    got = []
+    while not tx.empty():
+        item = tx.get_nowait()
+        got.extend(item.iter_unframed() if isinstance(item, EncodedBlock)
+                   else [item])
+    assert got == want

@@ -23,6 +23,16 @@ from flowgger_tpu.mergers import LineMerger, NulMerger, SyslenMerger
 from flowgger_tpu.tpu.batch import BatchHandler
 
 CFG = Config.from_string("")
+CFG_TYPED = Config.from_string(
+    '[input.ltsv_schema]\ncounter = "u64"\ndelta = "i64"\n'
+    'flag = "bool"\nratio = "f64"\n')
+
+
+class TypedLTSVDecoder(LTSVDecoder):
+    """Marker so ROUTES can carry the typed config."""
+
+    def __init__(self, _cfg):
+        super().__init__(CFG_TYPED)
 rng = random.Random(int(sys.argv[1]) if len(sys.argv) > 1 else 1)
 
 def rnd_bytes(n):
@@ -62,6 +72,20 @@ def gen_ltsv():
         parts.append(f"k{rng.randrange(9)}:{rnd_val()}")
     if rng.random() < 0.7:
         parts.append(f"message:{rnd_val()}")
+    rng.shuffle(parts)
+    return "\t".join(parts).encode()
+
+
+def gen_ltsv_typed():
+    parts = [f"host:h{rng.randrange(5)}", "time:1438790025"]
+    for key, pool in (("counter", ["42", "007", "0", "18446744073709551615",
+                                   "+5", "x"]),
+                      ("delta", ["-7", "-0", "12", "9" * 25]),
+                      ("flag", ["true", "false", "TRUE", "1"]),
+                      ("ratio", ["2.5", "1438790025.25"])):
+        if rng.random() < 0.6:
+            parts.append(f"{key}:{rng.choice(pool)}")
+    parts.append(f"k{rng.randrange(3)}:{rnd_val()}")
     rng.shuffle(parts)
     return "\t".join(parts).encode()
 
@@ -114,6 +138,7 @@ ROUTES = [
     ("rfc5424", RFC5424Decoder, [GelfEncoder, PassthroughEncoder, RFC5424Encoder, LTSVEncoder], gen_rfc5424),
     ("rfc3164", RFC3164Decoder, [GelfEncoder, PassthroughEncoder], gen_rfc3164),
     ("ltsv", LTSVDecoder, [GelfEncoder], gen_ltsv),
+    ("ltsv", TypedLTSVDecoder, [GelfEncoder], gen_ltsv_typed),
     ("gelf", GelfDecoder, [GelfEncoder], gen_gelf),
 ]
 MERGERS = [None, LineMerger(), NulMerger(), SyslenMerger()]
