@@ -434,3 +434,60 @@ def test_udp_batched_recvmmsg_tpu(tmp_path):
     blob = b"".join(got)
     for i in list(range(40)) + [100, 101]:
         assert (f"udp msg {i}".encode()) in blob
+
+
+def test_tls_input_to_tpu_block_pipeline(tmp_path):
+    """TLS transport feeding the block-mode batch handler: framed TLS
+    bytes flow through ingest_chunk to an EncodedBlock, byte-identical
+    to the scalar expectation."""
+    import ssl
+    import subprocess
+
+    from flowgger_tpu.block import EncodedBlock
+    from flowgger_tpu.decoders.rfc5424 import RFC5424Decoder
+    from flowgger_tpu.encoders.gelf import GelfEncoder
+    from flowgger_tpu.inputs.tls_input import TlsInput
+    from flowgger_tpu.mergers import NulMerger
+    from flowgger_tpu.tpu.batch import BatchHandler
+
+    pem = tmp_path / "test.pem"
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-keyout",
+         str(pem), "-out", str(pem), "-days", "1", "-nodes",
+         "-subj", "/CN=localhost"],
+        check=True, capture_output=True)
+    config = Config.from_string(
+        f'[input]\nlisten = "127.0.0.1:0"\ntimeout = 5\n'
+        f'tls_cert = "{pem}"\ntls_key = "{pem}"\ntpu_flush_ms = 20\n')
+    inp = TlsInput(config)
+    tx = queue.Queue()
+    dec = RFC5424Decoder(config)
+    enc = GelfEncoder(config)
+
+    def factory():
+        return BatchHandler(tx, dec, enc, config, fmt="rfc5424",
+                            start_timer=True, merger=NulMerger())
+
+    t = threading.Thread(target=inp.accept, args=(factory,), daemon=True)
+    t.start()
+    while inp.bound_port is None:
+        time.sleep(0.01)
+    lines = [f"<13>1 2015-08-05T15:53:45Z tlshost app {i} m - over tls {i}"
+             for i in range(5)]
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+    ctx.check_hostname = False
+    ctx.verify_mode = ssl.CERT_NONE
+    with socket.create_connection(("127.0.0.1", inp.bound_port)) as raw:
+        with ctx.wrap_socket(raw) as s:
+            s.sendall(("".join(ln + "\n" for ln in lines)).encode())
+    want = [enc.encode(dec.decode(ln)) + b"\0" for ln in lines]
+    got = []
+    deadline = time.time() + 10
+    while len(got) < 5 and time.time() < deadline:
+        try:
+            item = tx.get(timeout=0.2)
+        except queue.Empty:
+            continue
+        got.extend(item.iter_framed() if isinstance(item, EncodedBlock)
+                   else [item])
+    assert got == want
