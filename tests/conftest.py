@@ -23,3 +23,21 @@ import jax  # noqa: E402
 _want = os.environ.get("JAX_PLATFORMS", "")
 if _want and "axon" not in _want:
     jax.config.update("jax_platforms", _want)
+
+
+import subprocess  # noqa: E402
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def session_pem(tmp_path_factory):
+    """One self-signed cert for every TLS test (RSA keygen is the slow
+    part; three tests previously each generated their own)."""
+    path = tmp_path_factory.mktemp("certs") / "test.pem"
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-keyout",
+         str(path), "-out", str(path), "-days", "1", "-nodes",
+         "-subj", "/CN=localhost"],
+        check=True, capture_output=True)
+    return str(path)

@@ -131,15 +131,10 @@ def test_udp_bare_error_format(capsys):
     assert err == "Unsupported BOM\n"  # no [line] suffix on the udp path
 
 
-def test_tls_input_end_to_end(tmp_path):
+def test_tls_input_end_to_end(session_pem):
     import ssl
-    import subprocess
 
-    pem = tmp_path / "test.pem"
-    subprocess.run(
-        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-keyout", str(pem),
-         "-out", str(pem), "-days", "1", "-nodes", "-subj", "/CN=localhost"],
-        check=True, capture_output=True)
+    pem = session_pem
     from flowgger_tpu.inputs.tls_input import TlsInput
 
     config = Config.from_string(
@@ -436,12 +431,11 @@ def test_udp_batched_recvmmsg_tpu(tmp_path):
         assert (f"udp msg {i}".encode()) in blob
 
 
-def test_tls_input_to_tpu_block_pipeline(tmp_path):
+def test_tls_input_to_tpu_block_pipeline(session_pem):
     """TLS transport feeding the block-mode batch handler: framed TLS
     bytes flow through ingest_chunk to an EncodedBlock, byte-identical
     to the scalar expectation."""
     import ssl
-    import subprocess
 
     from flowgger_tpu.block import EncodedBlock
     from flowgger_tpu.decoders.rfc5424 import RFC5424Decoder
@@ -450,12 +444,7 @@ def test_tls_input_to_tpu_block_pipeline(tmp_path):
     from flowgger_tpu.mergers import NulMerger
     from flowgger_tpu.tpu.batch import BatchHandler
 
-    pem = tmp_path / "test.pem"
-    subprocess.run(
-        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-keyout",
-         str(pem), "-out", str(pem), "-days", "1", "-nodes",
-         "-subj", "/CN=localhost"],
-        check=True, capture_output=True)
+    pem = session_pem
     config = Config.from_string(
         f'[input]\nlisten = "127.0.0.1:0"\ntimeout = 5\n'
         f'tls_cert = "{pem}"\ntls_key = "{pem}"\ntpu_flush_ms = 20\n')

@@ -16,14 +16,9 @@ from flowgger_tpu.mergers import LineMerger
 from flowgger_tpu.outputs import SHUTDOWN
 
 
-@pytest.fixture(scope="module")
-def pem(tmp_path_factory):
-    path = tmp_path_factory.mktemp("certs") / "test.pem"
-    subprocess.run(
-        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-keyout", str(path),
-         "-out", str(path), "-days", "1", "-nodes", "-subj", "/CN=localhost"],
-        check=True, capture_output=True)
-    return str(path)
+@pytest.fixture()
+def pem(session_pem):
+    return session_pem
 
 
 def _tls_sink(pem, received, stop):
