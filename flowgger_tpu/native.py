@@ -78,6 +78,22 @@ def _load():
             lib.fg_snappy_decompress.argtypes = [
                 ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
                 ctypes.c_int64]
+        if hasattr(lib, "fg_r5_lens"):
+            r5common = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int32,
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int32,
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int32,
+                ctypes.c_int32,
+            ]
+            lib.fg_r5_lens.restype = None
+            lib.fg_r5_lens.argtypes = r5common + [ctypes.c_void_p,
+                                                  ctypes.c_int]
+            lib.fg_r5_write.restype = None
+            lib.fg_r5_write.argtypes = r5common + [ctypes.c_void_p,
+                                                   ctypes.c_void_p,
+                                                   ctypes.c_int]
         if hasattr(lib, "fg_gelf_lens_v2"):
             common = [
                 ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
@@ -230,6 +246,49 @@ def gelf_rows_native(chunk: bytes, meta: np.ndarray,
     out = np.empty(int(off[-1]), dtype=np.uint8)
     lib.fg_gelf_write_v2(*args, off.ctypes.data, out.ctypes.data,
                          _DEFAULT_THREADS)
+    return out, off
+
+
+def r5_rows_available() -> bool:
+    lib = _load()
+    return lib is not None and hasattr(lib, "fg_r5_lens")
+
+
+def r5_rows_native(chunk: bytes, meta: np.ndarray,
+                   sid_s: np.ndarray, sid_e: np.ndarray,
+                   pns: np.ndarray, pne: np.ndarray,
+                   pvs: np.ndarray, pve: np.ndarray, psd: np.ndarray,
+                   ts_scratch: bytes, suffix: bytes, syslen: bool
+                   ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """(framed buffer u8, row offsets int64[R+1]) for the RFC5424
+    re-encode tier rows (fg_r5_lens/fg_r5_write)."""
+    lib = _load()
+    if lib is None or not hasattr(lib, "fg_r5_lens"):
+        return None
+    meta = np.ascontiguousarray(meta, dtype=np.int32)
+    R = meta.shape[0]
+    SD = sid_s.shape[1] if sid_s.size else 0
+    P = pns.shape[1] if pns.size else 0
+    arrs = [np.ascontiguousarray(a, dtype=np.int32)
+            for a in (sid_s, sid_e, pns, pne, pvs, pve, psd)]
+    sid_s, sid_e, pns, pne, pvs, pve, psd = arrs
+    cbuf = np.frombuffer(chunk, dtype=np.uint8)
+    tbuf = np.frombuffer(ts_scratch or b"\0", dtype=np.uint8)
+    sbuf = np.frombuffer(suffix or b"\0", dtype=np.uint8)
+    lens = np.empty(R, dtype=np.int64)
+    args = (cbuf.ctypes.data, meta.ctypes.data, R,
+            sid_s.ctypes.data, sid_e.ctypes.data, SD,
+            pns.ctypes.data, pne.ctypes.data, pvs.ctypes.data,
+            pve.ctypes.data, psd.ctypes.data, P,
+            tbuf.ctypes.data, sbuf.ctypes.data, len(suffix),
+            1 if syslen else 0)
+    lib.fg_r5_lens(*args, lens.ctypes.data, _DEFAULT_THREADS)
+    off = np.empty(R + 1, dtype=np.int64)
+    off[0] = 0
+    np.cumsum(lens, out=off[1:])
+    out = np.empty(int(off[-1]), dtype=np.uint8)
+    lib.fg_r5_write(*args, off.ctypes.data, out.ctypes.data,
+                    _DEFAULT_THREADS)
     return out, off
 
 

@@ -48,6 +48,7 @@ from .block_common import (
     finish_block,
     merger_suffix,
     sorted_pair_order,
+    syslen_prefix_lens_from_framed,
     ts_scratch,
 )
 
@@ -73,18 +74,6 @@ _C_TAIL = b',"version":"1.1"}'
 _C_UNKNOWN = b"unknown"
 _C_DASH = b"-"
 _C_SEVD = b"01234567"
-
-
-def _syslen_prefix_lens(framed_lens: np.ndarray) -> np.ndarray:
-    """Per-row syslen prefix width from framed lengths: the unique d
-    with decimal_digits(framed - d - 1) == d, plus one for the space."""
-    plens = np.zeros(framed_lens.size, dtype=np.int64)
-    pow10 = 10 ** np.arange(1, _DEC_WIDTH, dtype=np.int64)
-    for d in range(1, _DEC_WIDTH + 1):
-        body = framed_lens - d - 1
-        ndig = 1 + (body[:, None] >= pow10[None, :]).sum(axis=1)
-        plens = np.where((plens == 0) & (ndig == d), d + 1, plens)
-    return plens
 
 
 def encode_rfc5424_gelf_block(
@@ -189,7 +178,7 @@ def encode_rfc5424_gelf_block(
         buf, row_off = res
         tier_lens = np.diff(row_off)
         if syslen:
-            prefix_lens_tier = _syslen_prefix_lens(tier_lens)
+            prefix_lens_tier = syslen_prefix_lens_from_framed(tier_lens)
         final_buf = buf.tobytes()
 
     if R and not use_native:

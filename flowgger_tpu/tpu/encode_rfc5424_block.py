@@ -30,8 +30,46 @@ from .block_common import (
     apply_syslen_prefix,
     finish_block,
     merger_suffix,
+    syslen_prefix_lens_from_framed,
     ts_scratch,
 )
+
+
+def _native_rows(chunk_bytes, starts64, out, n, ridx, suffix, syslen):
+    """Assemble tier rows through the native fg_r5 row writer; None when
+    the library lacks the symbols."""
+    from .. import native
+
+    if not native.r5_rows_available():
+        return None
+    R = ridx.size
+    scratch, ts_off, ts_len = ts_scratch(out, n, ridx,
+                                         unix_to_rfc3339_ms)
+    meta = np.empty((R, 16), dtype=np.int32)
+    meta[:, 0] = starts64[ridx]
+    fac = np.asarray(out["facility"])[:n][ridx].astype(np.int64)
+    sev = np.asarray(out["severity"])[:n][ridx].astype(np.int64)
+    meta[:, 1] = (fac << 3) + sev
+    for k, key in enumerate(("host_start", "host_end", "app_start",
+                             "app_end", "proc_start", "proc_end",
+                             "msgid_start", "msgid_end",
+                             "msg_trim_start", "trim_end")):
+        meta[:, 2 + k] = np.asarray(out[key])[:n][ridx]
+    sdc = np.asarray(out["sd_count"])[:n][ridx]
+    meta[:, 12] = sdc
+    meta[:, 13] = np.asarray(out["pair_count"])[:n][ridx]
+    meta[:, 14] = ts_off
+    meta[:, 15] = ts_len
+    return native.r5_rows_native(
+        chunk_bytes, meta,
+        np.asarray(out["sid_start"])[:n][ridx],
+        np.asarray(out["sid_end"])[:n][ridx],
+        np.asarray(out["name_start"])[:n][ridx],
+        np.asarray(out["name_end"])[:n][ridx],
+        np.asarray(out["val_start"])[:n][ridx],
+        np.asarray(out["val_end"])[:n][ridx],
+        np.asarray(out["pair_sd"])[:n][ridx],
+        scratch, suffix, syslen)
 
 
 def encode_rfc5424_rfc5424_block(
@@ -64,6 +102,20 @@ def encode_rfc5424_rfc5424_block(
     final_buf = b""
     row_off = np.zeros(1, dtype=np.int64)
     prefix_lens_tier: Optional[np.ndarray] = None
+
+    if R:
+        res = _native_rows(chunk_bytes, starts64, out, n, ridx, suffix,
+                           syslen)
+        if res is not None:
+            buf, row_off = res
+            tier_lens = np.diff(row_off)
+            if syslen:
+                prefix_lens_tier = syslen_prefix_lens_from_framed(tier_lens)
+            final_buf = buf.tobytes()
+            return finish_block(chunk_bytes, starts64, lens64, n, cand,
+                                ridx, final_buf, row_off,
+                                prefix_lens_tier, suffix, syslen, merger,
+                                encoder)
 
     if R:
         chunk_arr = np.frombuffer(chunk_bytes, dtype=np.uint8)

@@ -849,3 +849,16 @@ def test_ltsv_big_schema_declines_to_record_path():
         got.extend(item.iter_unframed() if isinstance(item, EncodedBlock)
                    else [item])
     assert got == want
+
+
+@pytest.mark.parametrize("merger", [None, SyslenMerger()],
+                         ids=["noop", "syslen"])
+def test_rfc5424_block_numpy_fallback_engine(merger, monkeypatch):
+    """With the native r5 assembler disabled, the numpy segment engine
+    must produce the same bytes (it is the production path on
+    toolchain-less deployments)."""
+    from flowgger_tpu import native
+    from flowgger_tpu.encoders.rfc5424 import RFC5424Encoder
+
+    monkeypatch.setattr(native, "r5_rows_available", lambda: False)
+    _route_check(RFC5424Encoder, "", merger)
