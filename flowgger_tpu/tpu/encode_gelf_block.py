@@ -29,7 +29,7 @@ result is an EncodedBlock the sinks write wholesale.
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional
+from typing import Dict, Optional
 
 import numpy as np
 
@@ -40,7 +40,6 @@ from .assemble import (
     concat_segments,
     escape_json,
     exclusive_cumsum,
-    _DEC_WIDTH,
 )
 from .block_common import (
     BlockResult,
