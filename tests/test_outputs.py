@@ -120,3 +120,32 @@ def test_debug_output(capsys):
 
     _drain(DebugOutput(Config.from_string("")), [b"hello"], LineMerger())
     assert capsys.readouterr().out == "hello\n"
+
+
+def test_file_output_rotation_with_encoded_blocks(tmp_path):
+    """Blocks write per message when rotation is enabled so the
+    reference's rotation trigger granularity holds."""
+    import numpy as np
+
+    from flowgger_tpu.block import EncodedBlock
+    from flowgger_tpu.outputs import SHUTDOWN, FileOutput
+
+    path = tmp_path / "rot.log"
+    config = Config.from_string(
+        f'[output]\nfile_path = "{path}"\nfile_rotation_size = 64\n'
+        "file_rotation_maxfiles = 10\n")
+    out = FileOutput(config)
+    tx = queue.Queue()
+    thread = out.start(tx, None)
+    msgs = [b"x" * 40 + b"-%02d\n" % i for i in range(6)]
+    bounds = np.cumsum([0] + [len(m) for m in msgs]).astype(np.int64)
+    tx.put(EncodedBlock(b"".join(msgs), bounds, None, 1))
+    tx.put(SHUTDOWN)
+    thread.join(timeout=15)
+    rotated = sorted(p.name for p in tmp_path.iterdir())
+    # 6 x 44-byte messages with a 64-byte threshold: every write after
+    # the first in a file trips rotation, so multiple numbered files
+    assert len(rotated) >= 3, rotated
+    data = b"".join((tmp_path / n).read_bytes() for n in rotated)
+    for i in range(6):
+        assert (b"-%02d" % i) in data
