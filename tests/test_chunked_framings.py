@@ -227,3 +227,45 @@ def test_auto_chunked_region_matches_per_line():
         except Exception:
             pass
     assert got == want
+
+
+def test_syslen_dribble_fuzz():
+    """Randomized syslen streams (binary payloads, empty frames, odd
+    read boundaries) through both handler kinds: identical outputs."""
+    import random
+
+    rng = random.Random(17)
+    frames = []
+    for i in range(200):
+        r = rng.random()
+        if r < 0.5:
+            frames.append(
+                (f"<13>1 2015-08-05T15:53:45Z h a p m - fz {i}").encode())
+        elif r < 0.7:
+            frames.append(bytes(rng.randrange(256)
+                                for _ in range(rng.randrange(50))))
+        elif r < 0.8:
+            frames.append(b"")
+        else:
+            frames.append(("x" * rng.randrange(300, 900)).encode())
+    stream = b"".join(b"%d %s" % (len(f), f) for f in frames)
+
+    class Dribble:
+        def __init__(self, data, rng):
+            self.data = data
+            self.pos = 0
+            self.rng = rng
+
+        def read(self, n):
+            step = self.rng.randrange(1, 97)
+            chunk = self.data[self.pos:self.pos + step]
+            self.pos += step
+            return chunk
+
+    want = scalar_output(stream, SyslenSplitter)
+    tx = queue.Queue()
+    h = BatchHandler(tx, RFC5424Decoder(), GelfEncoder(CFG), CFG,
+                     fmt="rfc5424", start_timer=False, merger=None)
+    SyslenSplitter().run(Dribble(stream, random.Random(18)), h)
+    got = collect(tx)
+    assert got == want
