@@ -10,8 +10,9 @@ An untyped LTSV record (materialize_ltsv.py, no ``ltsv_schema``/
 Pair keys are emitted sorted (the shared uint64-word lexsort), values
 JSON-escaped via the sparse EscapeMap.  Typed ``ltsv_schema`` keys stay
 on the fast tier when their rendered bytes equal the raw span (bool
-``true``/``false`` literals, canonical u64/i64 integers — emitted bare);
-f64-typed values, non-canonical numbers, configured name suffixes,
+``true``/``false`` literals, canonical u64/i64 integers, f64 values that
+roundtrip through json_f64 — emitted bare); non-canonical numbers,
+configured name suffixes,
 duplicate keys, colon-less parts (the scalar path prints a "Missing
 value" notice), and non-ASCII bytes re-run the scalar oracle, keeping
 bytes identical to decoder→GelfEncoder.
@@ -204,7 +205,30 @@ def encode_ltsv_gelf_block(
                     ptype = np.where(m, np.where(okv, 1, 2), ptype)
                 elif sdtype == "i64":
                     ptype = np.where(m, np.where(int_canon, 1, 2), ptype)
-                else:  # f64 or unknown: oracle
+                elif sdtype == "f64":
+                    # canonical f64 spans: the raw bytes equal the
+                    # encoder's shortest-roundtrip rendering (json_f64)
+                    # of the parsed value, so bare emission is
+                    # byte-identical to the oracle.  Padded zeros,
+                    # rewritten exponents, inf/nan ("null"), and
+                    # Python-only forms ("1_0") all fail the roundtrip
+                    # and drop that row to the oracle.  Checked per
+                    # distinct value (typed fields repeat heavily).
+                    okv = np.zeros(T, dtype=bool)
+                    seen: dict = {}
+                    for t in np.flatnonzero(m).tolist():
+                        v = chunk_bytes[vs_abs[t]:ve_abs[t]]
+                        ok = seen.get(v)
+                        if ok is None:
+                            try:
+                                ok = (json_f64(float(v)).encode("ascii")
+                                      == v)
+                            except (ValueError, UnicodeDecodeError):
+                                ok = False
+                            seen[v] = ok
+                        okv[t] = ok
+                    ptype = np.where(m, np.where(okv, 1, 2), ptype)
+                else:  # unknown type: oracle
                     ptype = np.where(m, 2, ptype)
             bad = ptype == 2
             if bad.any():

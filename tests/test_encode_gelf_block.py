@@ -786,6 +786,9 @@ def test_ltsv_gelf_block_typed_schema_fast_tier():
     canonical (bare literals in the GELF output); f64 and non-canonical
     values drop to the oracle — all byte-identical to the scalar path."""
     from flowgger_tpu.decoders.ltsv import LTSVDecoder
+    from flowgger_tpu.utils.metrics import registry
+
+    base_fallbacks = registry.get("fallback_rows")
 
     cfg = Config.from_string(
         '[input.ltsv_schema]\ncounter = "u64"\ndelta = "i64"\n'
@@ -796,7 +799,12 @@ def test_ltsv_gelf_block_typed_schema_fast_tier():
         b"host:h\ttime:1438790025\tdelta:-7\tname:xyz\tmessage:m2",
         b"host:h\ttime:1438790025\tcounter:007\tmessage:bad int",
         b"host:h\ttime:1438790025\tflag:TRUE\tmessage:bad bool",
-        b"host:h\ttime:1438790025\tratio:2.5\tmessage:f64 via oracle",
+        b"host:h\ttime:1438790025\tratio:2.5\tmessage:canonical f64",
+        b"host:h\ttime:1438790025\tratio:-0.125\tmessage:negative f64",
+        b"host:h\ttime:1438790025\tratio:2.50\tmessage:padded f64 oracle",
+        b"host:h\ttime:1438790025\tratio:1e1\tmessage:exp f64 oracle",
+        b"host:h\ttime:1438790025\tratio:inf\tmessage:inf via oracle",
+        b"host:h\ttime:1438790025\tratio:x\tmessage:bad f64 dropped",
         b"host:h\ttime:1438790025\tdelta:-0\tmessage:minus zero",
         b"host:h\ttime:1438790025\tcounter:+5\tmessage:plus sign",
     ]
@@ -823,9 +831,15 @@ def test_ltsv_gelf_block_typed_schema_fast_tier():
             got.append(item)
     assert saw_block
     assert got == want
-    assert b'"_counter":42' in got[0]      # bare number
-    assert b'"_flag":true' in got[0]       # bare bool
-    assert b'"_delta":-7' in got[1]
+    joined = b"|".join(got)
+    assert b'"_counter":42' in joined      # bare number
+    assert b'"_flag":true' in joined       # bare bool
+    assert b'"_delta":-7' in joined
+    assert b'"_ratio":2.5,' in joined      # bare canonical f64
+    assert b'"_ratio":-0.125,' in joined
+    # the two canonical-f64 lines (plus m1/m2) stayed on the fast tier;
+    # every other line re-ran the oracle
+    assert registry.get("fallback_rows") - base_fallbacks == len(lines) - 4
 
 
 def test_ltsv_big_schema_declines_to_record_path():
