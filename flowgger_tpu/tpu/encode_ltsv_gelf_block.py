@@ -75,10 +75,10 @@ def encode_ltsv_gelf_block(
         return None
     schema = decoder.schema or {}
     if schema:
-        # typed keys are supported on the fast tier for string/bool/
-        # u64/i64 when rendered bytes equal the raw span (canonical
-        # integers, the exact true/false literals); f64 values, any
-        # configured name suffix, and big schemas take the Record path
+        # typed keys are supported on the fast tier when rendered bytes
+        # equal the raw span (canonical integers, the exact true/false
+        # literals, json_f64-roundtripping floats); any configured name
+        # suffix and big schemas take the Record path
         if len(schema) > 8:
             return None
         if any(decoder.suffixes.get(t) is not None
@@ -139,8 +139,8 @@ def encode_ltsv_gelf_block(
         vs_abs = ne_abs + 1
         ve_abs = starts64[rop] + part_end[rows_all, cols_all]
         # typed-schema pair classification: 0 string, 1 bare literal
-        # (bool true/false or canonical int — rendered bytes equal the
-        # span), 2 needs-oracle (f64, non-canonical, out-of-tier)
+        # (bool true/false, canonical int, or canonical f64 — rendered
+        # bytes equal the span), 2 needs-oracle (non-canonical)
         ptype = np.zeros(T, dtype=np.int8)
         if schema:
             # zero-padded view for fixed-width gathers past span ends
@@ -274,8 +274,8 @@ def encode_ltsv_gelf_block(
         has_level = level >= 0
 
         # timestamps: rfc3339-kind rows share the deduplicated computed
-        # scratch; unix-literal rows format float(span) individually —
-        # the only remaining per-row Python, and only for that kind
+        # scratch; unix-literal rows format float(span) individually
+        # (per-row Python, like the f64 canonicality screen above)
         kind = ts_kind[ridx]
         scratch0, ts_off0, ts_len0 = ts_scratch(out, n, ridx, json_f64)
         lit_rows = np.flatnonzero(kind != 0)

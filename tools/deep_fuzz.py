@@ -82,7 +82,9 @@ def gen_ltsv_typed():
                                    "+5", "x"]),
                       ("delta", ["-7", "-0", "12", "9" * 25]),
                       ("flag", ["true", "false", "TRUE", "1"]),
-                      ("ratio", ["2.5", "1438790025.25"])):
+                      ("ratio", ["2.5", "1438790025.25", "2.50", "1e1",
+                                 "inf", "nan", "-0.0", ".5", "5.", "1_0",
+                                 "-0", "1e999", "0.1"])):
         if rng.random() < 0.6:
             parts.append(f"{key}:{rng.choice(pool)}")
     parts.append(f"k{rng.randrange(3)}:{rnd_val()}")
