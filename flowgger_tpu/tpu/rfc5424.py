@@ -20,11 +20,19 @@ expressed with primitives the VPU executes wide:
   weighting each byte with a function of its *field-relative offset*
   ``r = iota - field_start`` and summing — never by slicing a window.
 
-Everything else is elementwise/scan arithmetic: space cumsum for the
-``splitn``-equivalent field spans, backslash-run parity (via cummax of
-last-non-backslash) for escaped quotes, prefix parity of real quotes for
-in/out-of-value classification, Hinnant civil-date math in int32 (the
-identical formula to utils/timeparse.py so the final f64 is bit-equal).
+Second rule, from live-chip profiling: **scans are the cost model** —
+one [1M,256] i32 cumsum/cummax costs ~22ms on v5e while any number of
+independent masked reductions fuse to ~10ms total, so the decode runs on
+three scan channels for the common L <= 1022 geometry (wider lines pack
+fewer ordinals per word and cost 1-2 extra scans — see _packed_ordinals):
+bit-packed multi-ordinal cumsums for spaces+quotes and brackets+pairs,
+one cummax for the name lookback, and a bounded shifted-AND ladder (no
+scan) for backslash-run parity.
+
+Everything else is elementwise/reduction arithmetic: prefix parity of
+real quotes for in/out-of-value classification, Hinnant civil-date math
+in int32 (the identical formula to utils/timeparse.py so the final f64
+is bit-equal).
 
 Any deviation from the fast-path grammar (bogus quotes, empty PRI, nil
 timestamps, >max_sd blocks, >max_pairs pairs...) sets ``ok=False`` for
@@ -52,6 +60,10 @@ DEFAULT_MAX_SD = 4
 # and only rows beyond the rescue budget fall back to the scalar oracle
 DEFAULT_MAX_PAIRS = 6
 RESCUE_MAX_PAIRS = 16
+# backslash runs are resolved by a bounded shifted-AND ladder instead of
+# a scan; a run of >= ESC_RUN_CAP backslashes feeding a quote sends the
+# row to the scalar oracle (exact semantics preserved via fallback)
+ESC_RUN_CAP = 16
 
 _I32 = jnp.int32
 
@@ -216,14 +228,59 @@ def decode_rfc5424(batch: jnp.ndarray, lens: jnp.ndarray,
     first_ch = jnp.where(bom, bb[:, 3] if L > 3 else 0, bb[:, 0])
     ok = first_ch == ord("<")
 
-    # ---- first six spaces → header field spans ---------------------------
-    # positions are extracted by *sum* packing: each target position is
-    # selected by a unique mask (space ordinal == k), so a masked sum of
-    # (pos+1) << (slot_bits*slot) recovers ``slots`` positions per i32
-    # reduction (3 for the common L <= 1022 geometry, fewer for
-    # long-record configs; not-found decodes as 0).
+    # ---- scan budget ------------------------------------------------------
+    # Scans are the kernel's dominant cost on TPU (measured ~22ms per
+    # [1M,256] i32 cumsum/cummax vs ~10ms for ANY number of fused masked
+    # reductions — tools/profile_kernel.py), so the whole decode runs on
+    # three scan channels (for the common L <= 1022; wider lines pack
+    # fewer ordinals per word and pay 1-2 extra scans):
+    #   1: cumsum(is_sp | real_q << sb)            (space + quote ordinals)
+    #   2: cumsum(rbrack | oq << sb | cq << 2sb)   (bracket + pair ordinals)
+    #   3: cummax(name lookback)
+    # The backslash-parity cummax is replaced by a bounded shifted-AND
+    # ladder (exact for runs < ESC_RUN_CAP; longer runs before a quote
+    # fall back to the scalar oracle), and the open/close-quote ordinal
+    # masks use a min-reduction SD terminator instead of the chain-walk
+    # sd_end so they can ride the same scan as the bracket ordinals.
+    scan_bits = slot_bits  # same invariant: 2**bits > L, so ordinals
+    scan_mask = (1 << scan_bits) - 1  # (counts <= L) cannot carry
+
+    def _packed_ordinals(channels):
+        """Inclusive prefix sums of the given bool channels, packing as
+        many as fit per int32 word (3 for L <= 1022, 2 up to 32766, 1
+        beyond) so the common geometry pays one scan for all of them."""
+        per = max(1, 31 // scan_bits)
+        outs = []
+        for base in range(0, len(channels), per):
+            grp = channels[base:base + per]
+            word = grp[0].astype(_I32)
+            for s, ch in enumerate(grp[1:], 1):
+                word = word + (ch.astype(_I32) << (scan_bits * s))
+            scanned = _cumsum(word, scan_impl)
+            for s in range(len(grp)):
+                outs.append((scanned >> (scan_bits * s)) & scan_mask)
+        return outs
+
+    # ---- escape parity (bounded ladder, no scan) -------------------------
+    # escaped[i] <=> the backslash run ending at i-1 has odd length.
+    # a_k = "bs at i-1..i-k"; the a_k are nested indicators, so their XOR
+    # is the run-length parity (exact while run < ESC_RUN_CAP; a_cap set
+    # means >= cap, and if a quote consumes that unknown parity the row
+    # is sent to the scalar oracle).
+    is_bs = (bb == 92) & valid
+    a_k = _shift_right(is_bs, 1, False)
+    escaped = a_k
+    for k in range(2, ESC_RUN_CAP):
+        a_k = a_k & _shift_right(is_bs, k, False)
+        escaped = escaped ^ a_k
+    run_cap_hit = a_k & _shift_right(is_bs, ESC_RUN_CAP, False)
+
+    # ---- stage B scan: space ordinals + quote parity ----------------------
     is_sp = (bb == 32) & valid
-    sp_ord = _cumsum(is_sp, scan_impl)  # int32 [N,L] — inclusive ordinal
+    quote = (bb == ord('"')) & valid
+    real_q_all = quote & ~escaped
+    viol2d = run_cap_hit & quote
+    sp_ord, q_incl_all = _packed_ordinals([is_sp, real_q_all])
     sp = _extract(is_sp, sp_ord, iota, 6, L)  # [N, 6]
     ok &= sp[:, 5] < L
     f_start = jnp.concatenate([start0[:, None], sp + 1], axis=1)  # [N,7]
@@ -237,7 +294,7 @@ def decode_rfc5424(batch: jnp.ndarray, lens: jnp.ndarray,
     e = gt[:, None] - 1 - iota
     pri_zone = (iota > start0[:, None]) & (iota < gt[:, None])
     w_pri = jnp.where(e == 0, 1, jnp.where(e == 1, 10, jnp.where(e == 2, 100, 0)))
-    viol2d = pri_zone & ~is_digit   # accumulated; reduced once at the end
+    viol2d |= pri_zone & ~is_digit   # accumulated; reduced once at the end
 
     # ---- packed field sums ------------------------------------------------
     # every fixed-layout numeric field and single-position structural flag
@@ -354,17 +411,16 @@ def decode_rfc5424(batch: jnp.ndarray, lens: jnp.ndarray,
 
     in_rest = (iota >= rest_s[:, None]) & valid
 
-    # escaped[i]: odd run of backslashes immediately before i
-    is_bs = (bb == 92) & valid
-    non_bs_pos = jnp.where(~is_bs, iota, -1)
-    last_non_bs = _cummax(non_bs_pos, scan_impl)
-    prev_last = _shift_right(last_non_bs, 1, -1)
-    escaped = ((iota - 1 - prev_last) % 2) == 1
-
-    quote = (bb == ord('"')) & in_rest
-    real_q = quote & ~escaped
-    q_excl = _cumsum(real_q, scan_impl) - real_q
-    outside = (q_excl % 2) == 0
+    # quote parity relative to the rest zone: stage B counted *all* real
+    # quotes (header fields may legally contain '"'); subtracting the
+    # running count at rest_s restores the in-rest-only ordinals the
+    # grammar needs — one fused reduction instead of a second scan.
+    q_before_rest = jnp.max(
+        jnp.where(valid & (iota < rest_s[:, None]), q_incl_all, 0), axis=1)
+    q_excl = (q_incl_all - real_q_all.astype(_I32)
+              - q_before_rest[:, None])
+    real_q = real_q_all & in_rest
+    outside = (q_excl & 1) == 0
     open_q = real_q & outside
     close_q = real_q & ~outside
 
@@ -381,12 +437,28 @@ def decode_rfc5424(batch: jnp.ndarray, lens: jnp.ndarray,
     #   bit1: next is '['   bit2: next is ' '
     prev_closeq = _shift_right(close_q, 1, False)
     rbrack = (bb == ord("]")) & outside & in_rest
+    next_valid = _shift_left(valid, 1, False)
     rb_payload = (
         ((prev_bb == 32) | prev_closeq).astype(_I32)
-        + ((next_bb == ord("[")) & _shift_left(valid, 1, False)).astype(_I32) * 2
-        + ((next_bb == 32) & _shift_left(valid, 1, False)).astype(_I32) * 4
+        + ((next_bb == ord("[")) & next_valid).astype(_I32) * 2
+        + ((next_bb == 32) & next_valid).astype(_I32) * 4
     )
-    rb_ord = _cumsum(rbrack, scan_impl)
+
+    # SD terminator for the pair-ordinal zone, found WITHOUT the bracket
+    # chain (so the open/close-quote ordinals can ride the same scan as
+    # the bracket ordinals): the first structural ']' followed by a space
+    # or EOL.  On rows that pass the chain checks below this equals the
+    # chain-walk sd_end (every earlier chain ']' is followed by '[');
+    # rows where they differ always fail those checks and fall back.
+    term_mask = rbrack & (((next_bb == 32) & next_valid)
+                          | (iota == lens[:, None] - 1))
+    sd_end_zone = _min_where(term_mask, iota, L)
+    zone_c = in_rest & (iota <= sd_end_zone[:, None]) & is_sd[:, None]
+    oq_mask = open_q & zone_c
+    cq_mask = close_q & zone_c
+
+    # ---- stage C scan: bracket + pair ordinals ---------------------------
+    rb_ord, oq_ord, cq_ord = _packed_ordinals([rbrack, oq_mask, cq_mask])
     rb_pos = _extract(rbrack, rb_ord, iota, max_sd + 1, L)
     rb_flags = _extract(rbrack, rb_ord, rb_payload, max_sd + 1, 0)
     rb_found = rb_pos < L
@@ -453,8 +525,13 @@ def decode_rfc5424(batch: jnp.ndarray, lens: jnp.ndarray,
     viol2d |= real_q & sd_zone & ~in_pair
 
     # ---- pair extraction -------------------------------------------------
-    # lookback channels ride a cummax of pos<<8|byte over non-name bytes
-    nn = ~name_struct
+    # lookback channels ride a cummax of pos<<8|byte over non-name bytes.
+    # The scan channel drops name_struct's in_pair term (pair regions are
+    # bounded by the sd_id space below and the block ']' above — both
+    # non-name — so a lookback from an in-pair quote can never cross a
+    # region boundary, making the term redundant for this channel; it
+    # stays in name_struct for the structural violation checks).
+    nn = ~(is_name & outside)
     nn_packed = _cummax(
         jnp.where(nn, (iota << 8) | bb.astype(_I32), -1), scan_impl)
     # at an open quote q: name ran from lnn[q-2]+1 to q-2 (inclusive);
@@ -463,10 +540,6 @@ def decode_rfc5424(batch: jnp.ndarray, lens: jnp.ndarray,
     lnn2_pos = jnp.where(lnn2 >= 0, lnn2 >> 8, -1)
     lnn2_ch = jnp.where(lnn2 >= 0, lnn2 & 0xFF, -1)
 
-    oq_mask = open_q & sd_zone
-    cq_mask = close_q & sd_zone
-    oq_ord = _cumsum(oq_mask, scan_impl)
-    cq_ord = _cumsum(cq_mask, scan_impl)
     pair_total = oq_ord[:, -1]
     pair_count = jnp.where(is_sd, pair_total, 0)
     ok &= jnp.where(is_sd, pair_count <= max_pairs, True)

@@ -67,6 +67,15 @@ CORPUS = [
     # >16 pairs (over MAX_PAIRS cap)
     '<13>1 2015-08-05T15:53:45Z h a p m [id ' +
     " ".join(f'k{i}="{i}"' for i in range(20)) + '] m',
+    # backslash runs around the ESC_RUN_CAP ladder bound (15/16/17 and a
+    # high-even run): parity must be exact below the cap and the >= cap
+    # rows must fall back to the oracle, not mis-parse
+    '<13>1 2015-08-05T15:53:45Z h a p m [id k="a' + "\\" * 14 + '" x="y"] m',
+    '<13>1 2015-08-05T15:53:45Z h a p m [id k="a' + "\\" * 15 + '\\"tail"] m',
+    '<13>1 2015-08-05T15:53:45Z h a p m [id k="a' + "\\" * 16 + '" x="y"] m',
+    '<13>1 2015-08-05T15:53:45Z h a p m [id k="a' + "\\" * 17 + '\\"t"] m',
+    '<13>1 2015-08-05T15:53:45Z h a p m [id k="a' + "\\" * 24 + '" x="y"] m',
+    '<13>1 2015-08-05T15:53:45Z h a p m - msg with ' + "\\" * 40 + ' run',
     # header errors
     "13>1 2015-08-05T15:53:45Z h a p m - x",
     "<13>2 2015-08-05T15:53:45Z h a p m - x",
@@ -124,6 +133,50 @@ def assert_identical(lines):
 
 def test_corpus_differential():
     assert_identical(CORPUS)
+
+
+def test_wide_line_scan_packing():
+    """L > 1022 drops the scan packing from 3 ordinals per word to 2
+    (scan_bits > 10): the wide-geometry branch must stay differential-
+    identical and keep clean rows on the fast path."""
+    from flowgger_tpu.tpu import rfc5424
+
+    filler = "x" * 900
+    lines = [
+        f'<13>1 2015-08-05T15:53:45Z h a p m [id k="v{i}" w="{filler}"] '
+        f"tail {filler}{i}"
+        for i in range(8)
+    ] + CORPUS[:30]
+    raw = [ln.encode() for ln in lines]
+    batch, lens, *_ = pack.pack_lines_2d(raw, 2048)
+    out = rfc5424.decode_rfc5424_host(batch, lens)
+    assert np.asarray(out["ok"])[:8].all(), "wide rows left the fast path"
+    # full record-level differential through the batch path
+    results = _decode_rfc5424_batch(raw, max_len=2048)
+    for ln, res in zip(lines, results):
+        kernel = ("rec", res.record) if res.record is not None else ("err", res.error)
+        try:
+            oracle = ("rec", ORACLE.decode(ln))
+        except DecodeError as e:
+            oracle = ("err", str(e))
+        assert kernel == oracle, f"wide-L divergence on {ln!r}"
+
+
+def test_escape_cap_rows_fall_back():
+    """Rows with >= ESC_RUN_CAP backslashes feeding a quote must be
+    flagged ok=False (oracle fallback), and sub-cap runs must stay on
+    the fast path with exact parity."""
+    from flowgger_tpu.tpu import rfc5424
+
+    under = ('<13>1 2015-08-05T15:53:45Z h a p m [id k="a'
+             + "\\" * (rfc5424.ESC_RUN_CAP - 2) + '" x="y"] m')
+    over = ('<13>1 2015-08-05T15:53:45Z h a p m [id k="a'
+            + "\\" * rfc5424.ESC_RUN_CAP + '" x="y"] m')
+    batch, lens, *_ = pack.pack_lines_2d([under.encode(), over.encode()], 256)
+    out = rfc5424.decode_rfc5424_host(batch, lens)
+    ok = np.asarray(out["ok"])
+    assert ok[0], "sub-cap escape run should stay on the fast path"
+    assert not ok[1], "cap-length escape run must fall back to the oracle"
 
 
 def test_fast_path_coverage():
