@@ -92,6 +92,13 @@ CORPUS = [
     "<13>1",
     "",
     "-",
+    # quotes and backslashes in header fields (legal PRINTUSASCII): the
+    # rest-relative parity subtraction and the parity-derived pair
+    # ordinals must not be perturbed (negative pre-rest q_excl)
+    '<34>1 2003-01-01T00:00:00Z host \\x"a"b" pid mid '
+    '[id a="v1" b="v2" c="v3" d="v4" e="v5" f="v6"] hello',
+    '<34>1 2003-01-01T00:00:00Z ho"st app" "1 "2" [id k="\\\\v"] m',
+    '<34>1 2003-01-01T00:00:00Z h"""" a p m [id k="v"] m',
     # empty header fields (double spaces)
     "<13>1 2015-08-05T15:53:45Z  a p m - empty hostname",
     "<13>1 2015-08-05T15:53:45Z h  p m - empty appname",
@@ -317,6 +324,25 @@ def test_manual_scan_impl_matches_lax():
                                scan_impl="manual")
     for k in a:
         assert (np.asarray(a[k]) == np.asarray(b[k])).all(), k
+
+
+def test_mm_scan_impl_matches_lax():
+    """scan_impl='mm' (MXU tri-matmul scans, the TPU default) must be
+    numerically identical to the lax scans — including the wide-L
+    geometry where the f32 packing uses more slot bits."""
+    import jax.numpy as jnp
+
+    from flowgger_tpu.tpu import rfc5424
+
+    lines = [ln.encode("utf-8") for ln in CORPUS]
+    for max_len in (512, 2048):
+        batch, lens, *_ = pack.pack_lines_2d(lines, max_len)
+        a = rfc5424.decode_rfc5424(jnp.asarray(batch), jnp.asarray(lens),
+                                   scan_impl="lax")
+        b = rfc5424.decode_rfc5424(jnp.asarray(batch), jnp.asarray(lens),
+                                   scan_impl="mm")
+        for k in a:
+            assert (np.asarray(a[k]) == np.asarray(b[k])).all(), (k, max_len)
 
 
 def test_scatter_extract_impl_matches_sum():
