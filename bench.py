@@ -76,6 +76,18 @@ def _tpu_responsive() -> bool:
     return False
 
 
+def digest_all(jnp, out):
+    """Fold EVERY kernel output channel into a scalar digest: a partial
+    digest lets XLA dead-code-eliminate the channels it doesn't reach,
+    and the benched kernel silently becomes a pruned subset of the one
+    the pipeline runs (caught in round 2: a 3-channel digest made the
+    kernel look 2.4x faster than it is)."""
+    acc = jnp.int32(0)
+    for v in out.values():
+        acc = acc + v.astype(jnp.int32).sum()
+    return acc
+
+
 def bench_e2e(lines, jax, jnp, extra):
     """End-to-end: complete-line region bytes → dense pack → device
     kernel → columnar GELF block encode (framed) → file sink.  This is
@@ -181,7 +193,7 @@ def bench_other_configs(jax, jnp, dev, cpu_fallback, smoke, extra):
     b, l, *_ = pack.pack_lines_2d(ltsv_lines, MAX_LEN)
     rate = chained_rate(
         lambda bb, ll: ltsv_k.decode_ltsv(bb, ll),
-        lambda o: o["n_parts"].sum() + o["days"].sum(),
+        lambda o: digest_all(jnp, o),
         jnp.asarray(b), jnp.asarray(l))
     extra["ltsv_device_lines_per_sec"] = round(rate)
     print(f"ltsv device decode: {rate / 1e6:.1f}M lines/s", file=sys.stderr)
@@ -195,7 +207,7 @@ def bench_other_configs(jax, jnp, dev, cpu_fallback, smoke, extra):
     b, l, *_ = pack.pack_lines_2d(gelf_lines, MAX_LEN)
     rate = chained_rate(
         lambda bb, ll: gelf_k.decode_gelf(bb, ll),
-        lambda o: o["ok"].sum() * 3 + o["n_fields"].sum(),
+        lambda o: digest_all(jnp, o),
         jnp.asarray(b), jnp.asarray(l))
     extra["gelf_device_lines_per_sec"] = round(rate)
     print(f"gelf device decode: {rate / 1e6:.1f}M lines/s", file=sys.stderr)
@@ -210,7 +222,7 @@ def bench_other_configs(jax, jnp, dev, cpu_fallback, smoke, extra):
     b, l, *_ = pack.pack_lines_2d(sd_lines, MAX_LEN)
     rate = chained_rate(
         lambda bb, ll: rfc5424.decode_rfc5424(bb, ll),
-        lambda o: o["pair_count"].sum() + o["sd_count"].sum(),
+        lambda o: digest_all(jnp, o),
         jnp.asarray(b), jnp.asarray(l))
     extra["multisd_device_lines_per_sec"] = round(rate)
     print(f"multi-SD device decode: {rate / 1e6:.1f}M lines/s",
@@ -275,8 +287,7 @@ def main():
         def body(i, carry):
             out = rfc5424.decode_rfc5424(
                 jnp.bitwise_xor(b, (carry % 2).astype(jnp.uint8)), ln)
-            c = (out["facility"].sum() + out["pair_count"].sum()
-                 + out["days"].sum()) & 1
+            c = digest_all(jnp, out) & 1
             return carry + c
 
         return jax.lax.fori_loop(0, CHAIN, body, jnp.int32(0))

@@ -21,13 +21,13 @@ expressed with primitives the VPU executes wide:
   ``r = iota - field_start`` and summing — never by slicing a window.
 
 Second rule, from live-chip profiling: **scans are the cost model** —
-one [1M,256] i32 cumsum/cummax costs ~22ms on v5e while any number of
-independent masked reductions fuse to ~10ms total, so the decode runs on
-three scan channels for the common L <= 1022 geometry (wider lines pack
-fewer ordinals per word and cost 1-2 extra scans — see _packed_ordinals):
-bit-packed multi-ordinal cumsums for spaces+quotes and brackets+pairs,
-one cummax for the name lookback, and a bounded shifted-AND ladder (no
-scan) for backslash-run parity.
+one [1M,256] i32 cumsum/cummax costs ~22ms on v5e while a group of
+sibling masked reductions fuses to ~10ms, so the decode runs on two
+scan channels, both lowered as MXU matmuls against a triangular ones
+matrix (see _scan_ordinals): spaces+quotes packed into one, brackets in
+the other.  Backslash-run parity is a bounded bit-packed shifted-AND
+ladder (no scan), and the name lookback is per-pair fused masked
+max-reductions instead of a cummax.
 
 Everything else is elementwise/reduction arithmetic: prefix parity of
 real quotes for in/out-of-value classification, Hinnant civil-date math
@@ -194,6 +194,57 @@ def _cummax(x, impl: str):
     return x
 
 
+def _esc_parity(is_bs, impl: str):
+    """Backslash-run parity without a scan: ``escaped[i]`` <=> the run of
+    backslashes ending at ``i-1`` has odd length (exact for runs <
+    ESC_RUN_CAP; ``cap_hit`` marks positions whose run reached the cap).
+
+    The ladder XORs nested run-indicators ``a_k = bs at i-1..i-k``.  On
+    the XLA path the [N, L] bool planes are bit-packed into [N, L/32]
+    uint32 lanes first — the 15 shifted ANDs then touch 1/32nd of the
+    bytes (measured 17ms -> ~2ms per 1M x 256 batch on v5e).  The Pallas
+    path (`impl='manual'`) keeps the plane form: Mosaic has no cheap
+    lane-crossing reshape."""
+    if impl == "manual":
+        a_k = _shift_right(is_bs, 1, False)
+        escaped = a_k
+        for k in range(2, ESC_RUN_CAP):
+            a_k = a_k & _shift_right(is_bs, k, False)
+            escaped = escaped ^ a_k
+        cap_hit = a_k & _shift_right(is_bs, ESC_RUN_CAP, False)
+        return escaped, cap_hit
+    N, L = is_bs.shape
+    W = (L + 31) // 32
+    pad = W * 32 - L
+    bits = is_bs
+    if pad:
+        bits = jnp.pad(bits, ((0, 0), (0, pad)))
+    lane = jnp.arange(32, dtype=jnp.uint32)
+    packed = jnp.sum(
+        bits.reshape(N, W, 32).astype(jnp.uint32) << lane[None, None, :],
+        axis=2)
+
+    def sr(w, k):
+        # shift right in *position* space by k (1 <= k <= 31): bit j of
+        # word w comes from bit j-k, borrowing the top of word w-1
+        prev = jnp.pad(w[:, :-1], ((0, 0), (1, 0)))
+        return (w << jnp.uint32(k)) | (prev >> jnp.uint32(32 - k))
+
+    a_k = sr(packed, 1)
+    esc = a_k
+    for k in range(2, ESC_RUN_CAP):
+        a_k = a_k & sr(packed, k)
+        esc = esc ^ a_k
+    assert ESC_RUN_CAP < 32  # sr() handles shifts of 1..31 only
+    cap = a_k & sr(packed, ESC_RUN_CAP)
+
+    def unpack(w):
+        b = ((w[:, :, None] >> lane[None, None, :]) & 1) != 0
+        return b.reshape(N, W * 32)[:, :L]
+
+    return unpack(esc), unpack(cap)
+
+
 def decode_rfc5424(batch: jnp.ndarray, lens: jnp.ndarray,
                    max_sd: int = DEFAULT_MAX_SD,
                    max_pairs: int = DEFAULT_MAX_PAIRS,
@@ -296,32 +347,27 @@ def decode_rfc5424(batch: jnp.ndarray, lens: jnp.ndarray,
 
     # ---- scan budget ------------------------------------------------------
     # Scans are the kernel's dominant cost on TPU (measured ~22ms per
-    # [1M,256] i32 cumsum/cummax vs ~10ms for ANY number of fused masked
-    # reductions — tools/profile_kernel.py), so the whole decode runs on
-    # three scan channels:
+    # [1M,256] i32 cumsum/cummax vs ~10ms for a group of fused masked
+    # reductions — tools/profile_kernel.py / profile_r3.py), so the
+    # whole decode runs on TWO scan channels, both MXU matmuls:
     #   1: ordinals of (is_sp, real_q) — one packed scan (space + quote)
     #   2: ordinals of rbrack — its mask needs stage 1's quote parity
-    #   3: cummax(name lookback)
     # The backslash-parity cummax is replaced by a bounded shifted-AND
     # ladder (exact for runs < ESC_RUN_CAP; longer runs before a quote
     # fall back to the scalar oracle); open/close-quote ordinals are
-    # parity-DERIVED from scan 1 (zone quotes strictly alternate), and
-    # their zone comes from a min-reduction SD terminator instead of the
-    # chain-walk sd_end so no scan has to wait on the bracket chain.
+    # parity-DERIVED from scan 1 (zone quotes strictly alternate), with
+    # their zone from a min-reduction SD terminator instead of the
+    # chain-walk sd_end so no scan has to wait on the bracket chain; the
+    # name lookback that used to be scan 3 (a cummax) is now max_pairs
+    # fused masked max-reductions keyed on the extracted open-quote
+    # positions (see the pair-extraction section).
 
-    # ---- escape parity (bounded ladder, no scan) -------------------------
-    # escaped[i] <=> the backslash run ending at i-1 has odd length.
-    # a_k = "bs at i-1..i-k"; the a_k are nested indicators, so their XOR
-    # is the run-length parity (exact while run < ESC_RUN_CAP; a_cap set
-    # means >= cap, and if a quote consumes that unknown parity the row
-    # is sent to the scalar oracle).
+    # ---- escape parity (bounded bit-packed ladder, no scan) --------------
+    # escaped[i] <=> the backslash run ending at i-1 has odd length
+    # (exact while run < ESC_RUN_CAP; cap hits feeding a quote send the
+    # row to the scalar oracle — semantics preserved via fallback).
     is_bs = (bb == 92) & valid
-    a_k = _shift_right(is_bs, 1, False)
-    escaped = a_k
-    for k in range(2, ESC_RUN_CAP):
-        a_k = a_k & _shift_right(is_bs, k, False)
-        escaped = escaped ^ a_k
-    run_cap_hit = a_k & _shift_right(is_bs, ESC_RUN_CAP, False)
+    escaped, run_cap_hit = _esc_parity(is_bs, scan_impl)
 
     # ---- stage B scan: space ordinals + quote parity ----------------------
     is_sp = (bb == 32) & valid
@@ -582,21 +628,6 @@ def decode_rfc5424(batch: jnp.ndarray, lens: jnp.ndarray,
     viol2d |= real_q & sd_zone & ~in_pair
 
     # ---- pair extraction -------------------------------------------------
-    # lookback channels ride a cummax of pos<<8|byte over non-name bytes.
-    # The scan channel drops name_struct's in_pair term (pair regions are
-    # bounded by the sd_id space below and the block ']' above — both
-    # non-name — so a lookback from an in-pair quote can never cross a
-    # region boundary, making the term redundant for this channel; it
-    # stays in name_struct for the structural violation checks).
-    nn = ~(is_name & outside)
-    nn_packed = _cummax(
-        jnp.where(nn, (iota << 8) | bb.astype(_I32), -1), scan_impl)
-    # at an open quote q: name ran from lnn[q-2]+1 to q-2 (inclusive);
-    # shift the channel right by 2 so the value is available *at* q
-    lnn2 = _shift_right(nn_packed, 2, -1)
-    lnn2_pos = jnp.where(lnn2 >= 0, lnn2 >> 8, -1)
-    lnn2_ch = jnp.where(lnn2 >= 0, lnn2 & 0xFF, -1)
-
     # oq_ord is parity-derived (not a cumsum), so the pair total is the
     # max ordinal over the zone's open quotes rather than a last-column
     # read of a running count
@@ -605,9 +636,7 @@ def decode_rfc5424(batch: jnp.ndarray, lens: jnp.ndarray,
     ok &= jnp.where(is_sd, pair_count <= max_pairs, True)
 
     # per-pair quantities via the dual-impl extractor
-    name_start_ch = lnn2_pos + 1
     oq_pos = _extract(oq_mask, oq_ord, iota, max_pairs, L)
-    oq_name_start = _extract(oq_mask, oq_ord, name_start_ch, max_pairs, 0)
     cq_pos = _extract(cq_mask, cq_ord, iota, max_pairs, L)
     # backslashes per value interior: quote-parity marks the inside of a
     # value, open-quote ordinal attributes each backslash to its pair —
@@ -615,15 +644,39 @@ def decode_rfc5424(batch: jnp.ndarray, lens: jnp.ndarray,
     inside_val = (q_excl % 2) == 1
     val_esc_count = _extract_counts(is_bs & inside_val, oq_ord, max_pairs)
 
-    # name sanity, checked elementwise at each structural open quote
-    # instead of per extracted pair: the name run must be nonempty and
-    # preceded by a space (or the block's own sd_id space)
-    name_len_at = (iota - 1) - name_start_ch   # [start, '='): '=' at p-1
-    name_prev_ok = (lnn2_ch == 32) | (lnn2_ch == -1)
-    viol2d |= oq_mask & (~name_prev_ok | (name_len_at < 1))
-
     pair_valid = (jnp.arange(max_pairs, dtype=_I32)[None, :]
                   < pair_count[:, None])
+
+    # name lookback: the last non-name byte before each pair's '=' used
+    # to ride a full-width cummax of pos<<8|byte — the costliest scan
+    # left in the kernel (~25ms per [1M,256] channel on v5e).  The value
+    # is only ever consumed at the <= max_pairs open quotes, so it is now
+    # max_pairs fused masked max-reductions keyed on the extracted
+    # oq_pos: lnn_k = max(pos<<8|byte over non-name positions <=
+    # oq_pos[k]-2).  Sibling reductions share one traversal of the byte
+    # plane after XLA fusion, so this costs ~one pass instead of a scan.
+    # (The pair region's lower bound — the sd_id space — and the block
+    # ']' are both non-name, so the lookback can never escape its pair's
+    # region; in_pair gating is redundant here, exactly as it was for the
+    # cummax channel.)
+    nn = ~(is_name & outside)
+    nn_src = jnp.where(nn, (iota << 8) | bb.astype(_I32), -1)
+    lnn = jnp.stack(
+        [jnp.max(jnp.where(iota <= oq_pos[:, k:k + 1] - 2, nn_src, -1),
+                 axis=1)
+         for k in range(max_pairs)], axis=1)
+    lnn_pos = jnp.where(lnn >= 0, lnn >> 8, -1)
+    lnn_ch = jnp.where(lnn >= 0, lnn & 0xFF, -1)
+    oq_name_start = jnp.where(pair_valid, lnn_pos + 1, 0)
+
+    # name sanity per extracted pair: the name run must be nonempty and
+    # preceded by a space (or be at the very start of its region).  Open
+    # quotes past max_pairs have no extracted slot, but such rows already
+    # failed the pair_count budget above and fall back to the oracle.
+    name_prev_ok = (lnn_ch == 32) | (lnn_ch == -1)
+    name_len = oq_pos - lnn_pos - 2        # [start, '='): '=' at oq-1
+    ok &= ~(pair_valid & (~name_prev_ok | (name_len < 1))).any(axis=1)
+
     ok &= jnp.where(pair_valid, cq_pos > oq_pos, True).all(axis=1)
     name_end = oq_pos - 1  # position of '='
 

@@ -1,0 +1,126 @@
+#!/usr/bin/env python
+"""Round-3 ablation: where do the 35ms of the 28M lines/s kernel go?
+
+Times the *current* kernel's actual building blocks (MXU matmul scans,
+the one remaining cummax, the escape ladder, packed extraction words)
+so the next rework targets the real dominator.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+N = 1_000_000
+L = 256
+CHAIN = 8
+_I32 = jnp.int32
+
+
+def timed(name, fn, *args):
+    def chained(a0, *rest):
+        def body(i, carry):
+            out = fn(jnp.bitwise_xor(a0, (carry % 2).astype(a0.dtype)), *rest)
+            return carry + (out.sum().astype(jnp.int32) & 1)
+
+        return jax.lax.fori_loop(0, CHAIN, body, jnp.int32(0))
+
+    jf = jax.jit(chained)
+    int(jf(*args))
+    best = None
+    for _ in range(3):
+        t0 = time.perf_counter()
+        int(jf(*args))
+        dt = (time.perf_counter() - t0) / CHAIN
+        best = dt if best is None else min(best, dt)
+    print(f"{name:46s} {best * 1e3:8.2f} ms/pass", file=sys.stderr)
+    return best
+
+
+def main():
+    from flowgger_tpu.tpu import rfc5424
+
+    dev = jax.devices()[0]
+    print(f"device: {dev}  geometry: [{N}, {L}]", file=sys.stderr)
+    rng = np.random.default_rng(0)
+    bytes_np = rng.integers(32, 127, size=(N, L), dtype=np.uint8)
+    b_u8 = jax.device_put(jnp.asarray(bytes_np), dev)
+    lens = jax.device_put(jnp.full((N,), L, jnp.int32), dev)
+
+    iota_l = jnp.arange(L, dtype=_I32)
+    tri_f = (iota_l[:, None] <= iota_l[None, :]).astype(jnp.float32)
+    tri_i8 = tri_f.astype(jnp.int8)
+
+    def mm_f32_packed(b):
+        packed = ((b == 32).astype(jnp.float32)
+                  + (b == 34).astype(jnp.float32) * 1024.0)
+        return jax.lax.dot_general(packed, tri_f, (((1,), (0,)), ((), ())),
+                                   preferred_element_type=jnp.float32
+                                   ).astype(_I32)[:, -1]
+
+    def mm_i8(b):
+        return jax.lax.dot_general((b == 93).astype(jnp.int8), tri_i8,
+                                   (((1,), (0,)), ((), ())),
+                                   preferred_element_type=_I32)[:, -1]
+
+    def cummax_pack(b):
+        io = jax.lax.broadcasted_iota(_I32, b.shape, 1)
+        ch = jnp.where(b != 61, (io << 8) | b.astype(_I32), -1)
+        return jax.lax.cummax(ch, axis=1)[:, -1]
+
+    def esc_ladder(b):
+        is_bs = b == 92
+        a_k = rfc5424._shift_right(is_bs, 1, False)
+        escaped = a_k
+        for k in range(2, rfc5424.ESC_RUN_CAP):
+            a_k = a_k & rfc5424._shift_right(is_bs, k, False)
+            escaped = escaped ^ a_k
+        return escaped.sum(axis=1)
+
+    def one_extract_word(b):
+        # one packed 3-slot word: what each extraction word costs
+        io = jax.lax.broadcasted_iota(_I32, b.shape, 1)
+        m = b == 32
+        ordv = jnp.cumsum(m.astype(_I32), axis=1)  # stand-in ordinal
+        v1 = jnp.clip(io, 0, 1021) + 1
+        acc = jnp.where(m & (ordv == 1), v1, 0)
+        acc = acc + (jnp.where(m & (ordv == 2), v1, 0) << 10)
+        acc = acc + (jnp.where(m & (ordv == 3), v1, 0) << 20)
+        return jnp.sum(acc, axis=1)
+
+    def word_sums(b):
+        # the three packed field-sum words (word1..word3 shape)
+        io = jax.lax.broadcasted_iota(_I32, b.shape, 1)
+        dig = jnp.where((b >= 48) & (b <= 57), b.astype(_I32) - 48, 0)
+        r = io - 7
+        w1 = (dig * ((r == 0) * 1000 + (r == 1) * 100 + (r == 2) * 10 + (r == 3))
+              + (dig * ((r == 5) * 10 + (r == 6)) << 14)
+              + (dig * ((r == 8) * 10 + (r == 9)) << 21))
+        return jnp.sum(w1, axis=1)
+
+    def min_reduce(b):
+        io = jax.lax.broadcasted_iota(_I32, b.shape, 1)
+        return jnp.min(jnp.where(b == 62, io, L), axis=1)
+
+    timed("mm scan f32 packed (2ch)", mm_f32_packed, b_u8)
+    timed("mm scan int8 (1ch)", mm_i8, b_u8)
+    timed("cummax i32 packed lookback", cummax_pack, b_u8)
+    timed("escape ladder (15 shifted ANDs)", esc_ladder, b_u8)
+    timed("one packed extract word (3 slots)", one_extract_word, b_u8)
+    timed("one packed field-sum word", word_sums, b_u8)
+    timed("one masked min-reduction", min_reduce, b_u8)
+
+    def full_decode(b, ln):
+        r = rfc5424.decode_rfc5424(b, ln)
+        return r["pair_count"] + r["days"] * 0
+
+    timed("full decode_rfc5424", full_decode, b_u8, lens)
+
+
+if __name__ == "__main__":
+    main()
