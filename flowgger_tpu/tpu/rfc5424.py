@@ -144,6 +144,13 @@ def _scan_ordinals(channels, impl: str):
     Other impls fall back to bit-packed i32 cumsums."""
     L = channels[0].shape[1]
     bits = max(10, int(L + 1).bit_length())
+    # ordinal channels are re-read by every downstream extraction word,
+    # so they come back as int16 where L allows (ordinals are bounded by
+    # L, and the guard keeps L < 32000 < 2**15-1) — halving the HBM
+    # bytes of the hottest reads in the kernel.  The 'manual'
+    # (Pallas/Mosaic) path stays int32: 16-bit vector support inside
+    # the block kernel is not worth the risk.
+    out_t = jnp.int16 if (impl != "manual" and L < 32000) else _I32
     if impl != "mm":
         mask = (1 << bits) - 1
         per = max(1, 31 // bits)
@@ -155,7 +162,7 @@ def _scan_ordinals(channels, impl: str):
                 word = word + (ch.astype(_I32) << (bits * s))
             scanned = _cumsum(word, impl)
             for s in range(len(grp)):
-                outs.append((scanned >> (bits * s)) & mask)
+                outs.append(((scanned >> (bits * s)) & mask).astype(out_t))
         return outs
     iota_l = jnp.arange(L, dtype=_I32)
     tri_f = (iota_l[:, None] <= iota_l[None, :]).astype(jnp.float32)
@@ -170,14 +177,14 @@ def _scan_ordinals(channels, impl: str):
             s = jax.lax.dot_general(
                 packed, tri_f, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32).astype(_I32)
-            outs.append(s & ((1 << bits) - 1))
-            outs.append(s >> bits)
+            outs.append((s & ((1 << bits) - 1)).astype(out_t))
+            outs.append((s >> bits).astype(out_t))
             base += 2
         else:
             s = jax.lax.dot_general(
                 channels[base].astype(jnp.int8), tri_i,
                 (((1,), (0,)), ((), ())), preferred_element_type=_I32)
-            outs.append(s)
+            outs.append(s.astype(out_t))
             base += 1
     return outs
 
@@ -194,17 +201,48 @@ def _cummax(x, impl: str):
     return x
 
 
+def _bitpack32(plane):
+    """[N, L] bool -> [N, ceil(L/32)] uint32, bit j of word w = plane[:,
+    32w+j].  The reshape/broadcast form beats 32 strided slices on TPU:
+    a stride-32 minor-axis slice still reads every 128-lane tile, so the
+    slice formulation pays ~32 reads of the plane (measured +13ms on the
+    full kernel)."""
+    N, L = plane.shape
+    W = (L + 31) // 32
+    if W * 32 != L:
+        plane = jnp.pad(plane, ((0, 0), (0, W * 32 - L)))
+    lane = jnp.arange(32, dtype=jnp.uint32)
+    return jnp.sum(
+        plane.reshape(N, W, 32).astype(jnp.uint32) << lane[None, None, :],
+        axis=2)
+
+
+def _bitunpack32(words, L):
+    """Inverse of _bitpack32: [N, W] uint32 -> [N, L] bool."""
+    N, W = words.shape
+    lane = jnp.arange(32, dtype=jnp.uint32)
+    b = ((words[:, :, None] >> lane[None, None, :]) & 1) != 0
+    return b.reshape(N, W * 32)[:, :L]
+
+
 def _esc_parity(is_bs, impl: str):
     """Backslash-run parity without a scan: ``escaped[i]`` <=> the run of
     backslashes ending at ``i-1`` has odd length (exact for runs <
-    ESC_RUN_CAP; ``cap_hit`` marks positions whose run reached the cap).
+    ESC_RUN_CAP).
+
+    Returns a 3-tuple ``(escaped, cap_plane, cap_words)`` — exactly one
+    of the cap channels is non-None, by path:
+    - manual (Pallas/Mosaic): ``cap_plane`` is an [N, L] bool plane of
+      positions whose run reached the cap; ``cap_words`` is None;
+    - XLA: ``cap_words`` is the [N, ceil(L/32)] packed uint32 stream
+      (same bit layout as ``_bitpack32``) for the caller to AND against
+      a packed quote plane; ``cap_plane`` is None.
 
     The ladder XORs nested run-indicators ``a_k = bs at i-1..i-k``.  On
     the XLA path the [N, L] bool planes are bit-packed into [N, L/32]
     uint32 lanes first — the 15 shifted ANDs then touch 1/32nd of the
-    bytes (measured 17ms -> ~2ms per 1M x 256 batch on v5e).  The Pallas
-    path (`impl='manual'`) keeps the plane form: Mosaic has no cheap
-    lane-crossing reshape."""
+    bytes.  The Pallas path (`impl='manual'`) keeps the plane form:
+    Mosaic has no cheap lane-crossing reshape."""
     if impl == "manual":
         a_k = _shift_right(is_bs, 1, False)
         escaped = a_k
@@ -212,17 +250,9 @@ def _esc_parity(is_bs, impl: str):
             a_k = a_k & _shift_right(is_bs, k, False)
             escaped = escaped ^ a_k
         cap_hit = a_k & _shift_right(is_bs, ESC_RUN_CAP, False)
-        return escaped, cap_hit
+        return escaped, cap_hit, None
     N, L = is_bs.shape
-    W = (L + 31) // 32
-    pad = W * 32 - L
-    bits = is_bs
-    if pad:
-        bits = jnp.pad(bits, ((0, 0), (0, pad)))
-    lane = jnp.arange(32, dtype=jnp.uint32)
-    packed = jnp.sum(
-        bits.reshape(N, W, 32).astype(jnp.uint32) << lane[None, None, :],
-        axis=2)
+    packed = _bitpack32(is_bs)
 
     def sr(w, k):
         # shift right in *position* space by k (1 <= k <= 31): bit j of
@@ -238,11 +268,7 @@ def _esc_parity(is_bs, impl: str):
     assert ESC_RUN_CAP < 32  # sr() handles shifts of 1..31 only
     cap = a_k & sr(packed, ESC_RUN_CAP)
 
-    def unpack(w):
-        b = ((w[:, :, None] >> lane[None, None, :]) & 1) != 0
-        return b.reshape(N, W * 32)[:, :L]
-
-    return unpack(esc), unpack(cap)
+    return _bitunpack32(esc, L), None, cap
 
 
 def decode_rfc5424(batch: jnp.ndarray, lens: jnp.ndarray,
@@ -331,10 +357,12 @@ def decode_rfc5424(batch: jnp.ndarray, lens: jnp.ndarray,
     iota = jax.lax.broadcasted_iota(_I32, (N, L), 1)
     bu = batch  # uint8 view for comparisons (half the HBM traffic of i32)
     valid = iota < lens[:, None]
-    bb = jnp.where(valid, bu, jnp.uint8(0)).astype(jnp.int16)
-    # int16 byte plane: wide enough for digit math, half of int32 traffic
+    bb = jnp.where(valid, bu, jnp.uint8(0))
+    # uint8 byte plane: every mask read touches 1 byte/position; sites
+    # that need arithmetic widen inside their own fusion (free VPU work
+    # vs doubled HBM traffic for a materialized int16 plane)
     is_digit = (bb >= 48) & (bb <= 57)
-    dig = (bb - 48).astype(_I32)
+    dig = bb.astype(_I32) - 48
 
     # ---- BOM (rs:57-72) --------------------------------------------------
     bom = (
@@ -367,13 +395,21 @@ def decode_rfc5424(batch: jnp.ndarray, lens: jnp.ndarray,
     # (exact while run < ESC_RUN_CAP; cap hits feeding a quote send the
     # row to the scalar oracle — semantics preserved via fallback).
     is_bs = (bb == 92) & valid
-    escaped, run_cap_hit = _esc_parity(is_bs, scan_impl)
+    escaped, cap_plane, cap_words = _esc_parity(is_bs, scan_impl)
 
     # ---- stage B scan: space ordinals + quote parity ----------------------
     is_sp = (bb == 32) & valid
     quote = (bb == ord('"')) & valid
     real_q_all = quote & ~escaped
-    viol2d = run_cap_hit & quote
+    if cap_plane is not None:
+        viol2d = cap_plane & quote
+    else:
+        # packed-ladder path: the cap-hit stream never leaves bit-packed
+        # form — AND against the packed quote plane and fold the row-wise
+        # "a quote consumed an unknown run parity" violation straight
+        # into ok (no [N, L] unpack for a channel consumed row-wise)
+        viol2d = jnp.zeros_like(quote)
+        ok &= ~jnp.any((cap_words & _bitpack32(quote)) != 0, axis=1)
     sp_ord, q_incl_all = _scan_ordinals([is_sp, real_q_all], scan_impl)
     sp = _extract(is_sp, sp_ord, iota, 6, L)  # [N, 6]
     ok &= sp[:, 5] < L
@@ -511,7 +547,7 @@ def decode_rfc5424(batch: jnp.ndarray, lens: jnp.ndarray,
     # grammar needs — one fused reduction instead of a second scan.
     q_before_rest = jnp.max(
         jnp.where(valid & (iota < rest_s[:, None]), q_incl_all, 0), axis=1)
-    q_excl = (q_incl_all - real_q_all.astype(_I32)
+    q_excl = (q_incl_all - real_q_all.astype(q_incl_all.dtype)
               - q_before_rest[:, None])
     real_q = real_q_all & in_rest
     outside = (q_excl & 1) == 0

@@ -16,6 +16,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from bench import digest_all
 from flowgger_tpu.tpu import rfc5424 as R
 
 N = int(os.environ.get("HLO_N", 65_536))
@@ -27,11 +28,7 @@ def main():
     ln = jnp.full((N,), L, jnp.int32)
 
     def full(b, ln):
-        out = R.decode_rfc5424(b, ln)
-        acc = jnp.int32(0)
-        for v in out.values():
-            acc = acc + v.astype(jnp.int32).sum()
-        return acc
+        return digest_all(jnp, R.decode_rfc5424(b, ln))
 
     comp = jax.jit(full).lower(b, ln).compile()
     txt = comp.as_text()

@@ -32,15 +32,18 @@ L = 256
 CHAIN = 8
 
 
-def timed(name, fn, *args):
-    """fn must return a scalar-reducible array; chained via xor bit."""
+def timed(name, fn, *args, chain=None, width=46, unit="ms"):
+    """fn must return a scalar-reducible array; chained via xor bit.
+    Shared by every tools/profile_*.py harness so the methodology can
+    only change in one place."""
+    chain = chain or CHAIN
 
     def chained(a0, *rest):
         def body(i, carry):
             out = fn(jnp.bitwise_xor(a0, (carry % 2).astype(a0.dtype)), *rest)
             return carry + (out.sum().astype(jnp.int32) & 1)
 
-        return jax.lax.fori_loop(0, CHAIN, body, jnp.int32(0))
+        return jax.lax.fori_loop(0, chain, body, jnp.int32(0))
 
     jf = jax.jit(chained)
     int(jf(*args))
@@ -48,9 +51,9 @@ def timed(name, fn, *args):
     for _ in range(3):
         t0 = time.perf_counter()
         int(jf(*args))
-        dt = (time.perf_counter() - t0) / CHAIN
+        dt = (time.perf_counter() - t0) / chain
         best = dt if best is None else min(best, dt)
-    print(f"{name:42s} {best * 1e3:8.2f} ms/pass", file=sys.stderr)
+    print(f"{name:{width}s} {best * 1e3:8.2f} {unit}", file=sys.stderr)
     return best
 
 

@@ -15,30 +15,18 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+import profile_kernel
+
 N = 1_000_000
 L = 256
 CHAIN = 8
 _I32 = jnp.int32
 
 
-def timed(name, fn, *args):
-    def chained(a0, *rest):
-        def body(i, carry):
-            out = fn(jnp.bitwise_xor(a0, (carry % 2).astype(a0.dtype)), *rest)
-            return carry + (out.sum().astype(jnp.int32) & 1)
 
-        return jax.lax.fori_loop(0, CHAIN, body, jnp.int32(0))
 
-    jf = jax.jit(chained)
-    int(jf(*args))
-    best = None
-    for _ in range(3):
-        t0 = time.perf_counter()
-        int(jf(*args))
-        dt = (time.perf_counter() - t0) / CHAIN
-        best = dt if best is None else min(best, dt)
-    print(f"{name:52s} {best * 1e3:8.2f} ms", file=sys.stderr)
-    return best
+def _timed(name, fn, *args):
+    return profile_kernel.timed(name, fn, *args, chain=CHAIN, width=52)
 
 
 def main():
@@ -51,9 +39,9 @@ def main():
 
 
     # 1) masked min-reduction
-    timed("[N,L] masked min-reduce axis1",
+    _timed("[N,L] masked min-reduce axis1",
           lambda b: jnp.min(jnp.where(b == 62, jax.lax.broadcasted_iota(_I32, (N, L), 1), L), axis=1), b_nl)
-    timed("[L,N] masked min-reduce axis0",
+    _timed("[L,N] masked min-reduce axis0",
           lambda b: jnp.min(jnp.where(b == 62, jax.lax.broadcasted_iota(_I32, (L, N), 0), L), axis=0), b_ln)
 
     # 2) six sibling masked sum-reductions (extraction-word shape)
@@ -69,18 +57,18 @@ def main():
             acc = acc + jnp.sum(jnp.where(b == t, jax.lax.broadcasted_iota(_I32, (L, N), 0), 0), axis=0)
         return acc
 
-    timed("[N,L] 6 masked sum-reduces axis1", six_sums_nl, b_nl)
-    timed("[L,N] 6 masked sum-reduces axis0", six_sums_ln, b_ln)
+    _timed("[N,L] 6 masked sum-reduces axis1", six_sums_nl, b_nl)
+    _timed("[L,N] 6 masked sum-reduces axis0", six_sums_ln, b_ln)
 
     # 3) prefix scan
-    timed("[N,L] cumsum i32 axis1",
+    _timed("[N,L] cumsum i32 axis1",
           lambda b: jnp.cumsum((b == 32).astype(_I32), axis=1)[:, -1], b_nl)
-    timed("[L,N] cumsum i32 axis0",
+    _timed("[L,N] cumsum i32 axis0",
           lambda b: jnp.cumsum((b == 32).astype(_I32), axis=0)[-1], b_ln)
-    timed("[N,L] cummax i32 axis1",
+    _timed("[N,L] cummax i32 axis1",
           lambda b: jax.lax.cummax(
               jnp.where(b == 32, jax.lax.broadcasted_iota(_I32, (N, L), 1), -1), axis=1)[:, -1], b_nl)
-    timed("[L,N] cummax i32 axis0",
+    _timed("[L,N] cummax i32 axis0",
           lambda b: jax.lax.cummax(
               jnp.where(b == 32, jax.lax.broadcasted_iota(_I32, (L, N), 0), -1), axis=0)[-1], b_ln)
 
@@ -89,25 +77,26 @@ def main():
     tri = (iol[:, None] <= iol[None, :]).astype(jnp.float32)
     triT = (iol[:, None] >= iol[None, :]).astype(jnp.float32)
 
-    timed("[N,L] mm scan (b@tri)",
+    _timed("[N,L] mm scan (b@tri)",
           lambda b: jax.lax.dot_general(
               (b == 32).astype(jnp.float32), tri, (((1,), (0,)), ((), ())),
               preferred_element_type=jnp.float32)[:, -1].astype(_I32), b_nl)
-    timed("[L,N] mm scan (triT@b)",
+    _timed("[L,N] mm scan (triT@b)",
           lambda b: jax.lax.dot_general(
               triT, (b == 32).astype(jnp.float32), (((1,), (0,)), ((), ())),
               preferred_element_type=jnp.float32)[-1].astype(_I32), b_ln)
 
     # 5) transpose cost itself
-    timed("[N,L] -> [L,N] u8 transpose",
+    _timed("[N,L] -> [L,N] u8 transpose",
           lambda b: jnp.sum(b.T.astype(_I32), axis=0), b_nl)
 
     # 6) elementwise shift along the scan axis (pad/slice)
-    timed("[N,L] shift-right axis1",
+    _timed("[N,L] shift-right axis1",
           lambda b: jnp.pad(b[:, :-1], ((0, 0), (1, 0))).sum(axis=1), b_nl)
-    timed("[L,N] shift-right axis0",
+    _timed("[L,N] shift-right axis0",
           lambda b: jnp.pad(b[:-1], ((1, 0), (0, 0))).sum(axis=0), b_ln)
 
 
 if __name__ == "__main__":
     main()
+

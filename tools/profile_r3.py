@@ -16,30 +16,18 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+import profile_kernel
+
 N = 1_000_000
 L = 256
 CHAIN = 8
 _I32 = jnp.int32
 
 
-def timed(name, fn, *args):
-    def chained(a0, *rest):
-        def body(i, carry):
-            out = fn(jnp.bitwise_xor(a0, (carry % 2).astype(a0.dtype)), *rest)
-            return carry + (out.sum().astype(jnp.int32) & 1)
 
-        return jax.lax.fori_loop(0, CHAIN, body, jnp.int32(0))
 
-    jf = jax.jit(chained)
-    int(jf(*args))
-    best = None
-    for _ in range(3):
-        t0 = time.perf_counter()
-        int(jf(*args))
-        dt = (time.perf_counter() - t0) / CHAIN
-        best = dt if best is None else min(best, dt)
-    print(f"{name:46s} {best * 1e3:8.2f} ms/pass", file=sys.stderr)
-    return best
+def _timed(name, fn, *args):
+    return profile_kernel.timed(name, fn, *args, chain=CHAIN, width=46)
 
 
 def main():
@@ -107,20 +95,21 @@ def main():
         io = jax.lax.broadcasted_iota(_I32, b.shape, 1)
         return jnp.min(jnp.where(b == 62, io, L), axis=1)
 
-    timed("mm scan f32 packed (2ch)", mm_f32_packed, b_u8)
-    timed("mm scan int8 (1ch)", mm_i8, b_u8)
-    timed("cummax i32 packed lookback", cummax_pack, b_u8)
-    timed("escape ladder (15 shifted ANDs)", esc_ladder, b_u8)
-    timed("one packed extract word (3 slots)", one_extract_word, b_u8)
-    timed("one packed field-sum word", word_sums, b_u8)
-    timed("one masked min-reduction", min_reduce, b_u8)
+    _timed("mm scan f32 packed (2ch)", mm_f32_packed, b_u8)
+    _timed("mm scan int8 (1ch)", mm_i8, b_u8)
+    _timed("cummax i32 packed lookback", cummax_pack, b_u8)
+    _timed("escape ladder (15 shifted ANDs)", esc_ladder, b_u8)
+    _timed("one packed extract word (3 slots)", one_extract_word, b_u8)
+    _timed("one packed field-sum word", word_sums, b_u8)
+    _timed("one masked min-reduction", min_reduce, b_u8)
 
     def full_decode(b, ln):
         r = rfc5424.decode_rfc5424(b, ln)
         return r["pair_count"] + r["days"] * 0
 
-    timed("full decode_rfc5424", full_decode, b_u8, lens)
+    _timed("full decode_rfc5424", full_decode, b_u8, lens)
 
 
 if __name__ == "__main__":
     main()
+

@@ -14,6 +14,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+import profile_kernel
+
 from flowgger_tpu.tpu import rfc5424 as R
 
 N = 1_000_000
@@ -22,24 +24,6 @@ CHAIN = 8
 _I32 = jnp.int32
 
 
-def timed(name, fn, *args):
-    def chained(a0, *rest):
-        def body(i, carry):
-            out = fn(jnp.bitwise_xor(a0, (carry % 2).astype(a0.dtype)), *rest)
-            return carry + (out.sum().astype(jnp.int32) & 1)
-
-        return jax.lax.fori_loop(0, CHAIN, body, jnp.int32(0))
-
-    jf = jax.jit(chained)
-    int(jf(*args))
-    best = None
-    for _ in range(3):
-        t0 = time.perf_counter()
-        int(jf(*args))
-        dt = (time.perf_counter() - t0) / CHAIN
-        best = dt if best is None else min(best, dt)
-    print(f"{name:46s} {best * 1e3:8.2f} ms", file=sys.stderr)
-    return best
 
 
 def stage(upto):
@@ -66,6 +50,10 @@ def stage(upto):
     return fn
 
 
+def _timed(name, fn, *args):
+    return profile_kernel.timed(name, fn, *args, chain=CHAIN, width=46)
+
+
 def main():
     dev = jax.devices()[0]
     print(f"device: {dev}  geometry: [{N}, {L}]", file=sys.stderr)
@@ -75,8 +63,9 @@ def main():
     lens = jax.device_put(jnp.full((N,), L, jnp.int32), dev)
 
     for s in ("header", "sd", "pairs", "full"):
-        timed(f"decode upto {s}", stage(s), b_u8, lens)
+        _timed(f"decode upto {s}", stage(s), b_u8, lens)
 
 
 if __name__ == "__main__":
     main()
+
