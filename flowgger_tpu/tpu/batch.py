@@ -568,8 +568,8 @@ def _decode_packed(fmt, packed, decoder=None):
     if fmt == "gelf":
         from . import gelf, materialize_gelf
 
-        out = gelf.decode_gelf_jit(jb, jl)
-        host_out = {k: np.asarray(v) for k, v in out.items()}
+        host_out = gelf.decode_gelf_fetch(
+            gelf.decode_gelf_submit(batch, lens))
         return materialize_gelf.materialize_gelf(chunk, starts, orig_lens, host_out,
                                                  n_real, batch.shape[1])
     if fmt == "rfc3164":

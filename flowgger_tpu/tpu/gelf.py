@@ -2,20 +2,42 @@ r"""Columnar GELF (flat JSON) tokenizer (BASELINE.json config #3).
 
 Scalar spec: flowgger_tpu/decoders/gelf.py (reference
 gelf_decoder.rs:34-125).  GELF messages are flat JSON objects of scalar
-values — exactly the shape a simdjson-style structural pass handles:
+values.
 
-stage 1 (device, this module): backslash-run parity marks escaped
-quotes; prefix parity classifies in/out-of-string; three scan channels
-answer every "what comes next/before" question without gathers —
-  ``P`` forward: last significant byte before each position,
-  ``C`` reverse: next significant byte at/after each position,
-  ``Q`` reverse: next real quote after each position —
-(significant = non-whitespace outside strings, plus quotes).  Key
-strings are strings whose preceding significant byte is ``{`` or ``,``;
-per-pair masked min-reductions then walk key-close → colon → value →
-value-end through the channels, emitting span tables and a value-type
-code per pair.  Arrays, nested objects, >max_fields keys, or any
-structural surprise flags the row for the scalar oracle.
+Round-3 design: **scan-free** except two MXU matmul ordinal cumsums.
+The previous kernel ran eight full-width scan channels (forward/reverse
+packed cummaxes answering "prev/next significant byte") plus ~170
+per-key masked reductions — v5e profiling showed each [1M, 256] scan
+costs ~22-27ms and each reduction pass ~1-2ms, so it decoded at 2.4M
+lines/s.  This version replaces every channel walk:
+
+- **quote parity** classifies in/out-of-string (escaped quotes via the
+  shared bit-packed backslash ladder); open/close quotes alternate, so
+  no per-string bookkeeping is needed;
+- **bounded-window lookarounds** replace the prev/next-significant
+  scans: the previous/next non-whitespace byte is found by an
+  elementwise select chain over W=8 shifted planes.  Flat JSON with a
+  whitespace run longer than W between tokens falls back to the scalar
+  oracle (a single fused AND-ladder detects that row-wise);
+- token roles become **elementwise masks**: an open quote is a key iff
+  its previous non-ws byte is ``{`` or ``,`` and a value iff it is
+  ``:``; a close quote is a key-close iff its next non-ws byte is
+  ``:``; a number/literal value starts at a non-ws byte whose previous
+  non-ws byte is ``:``; literal runs end where the run mask switches
+  off — no position is ever "walked to";
+- **key-ordinal extraction**: every per-key quantity is pulled out with
+  the shared packed-sum extractor keyed on the key-ordinal plane
+  (cumsum of key-opens — one packed matmul with the key-close ordinal)
+  — ceil(F/3) reduction words per channel instead of F reductions;
+- **two-tier field budget**: the default kernel extracts
+  DEFAULT_MAX_FIELDS keys; rows with more (up to RESCUE_MAX_FIELDS)
+  re-dispatch through a lazily-compiled wider kernel in
+  ``decode_gelf_fetch``, and only rows beyond that hit the oracle.
+
+Anything structurally surprising (arrays, nested objects, stray
+tokens, >1 value per key, windows overflowing) flags the row for the
+scalar oracle, keeping observable output byte-identical
+(tests/test_tpu_gelf_auto.py, tools/deep_fuzz.py).
 
 stage 2 (host, materialize_gelf.py): slices spans, json-parses only the
 tokens that need it (escaped strings, numbers), routes the special GELF
@@ -30,20 +52,26 @@ from typing import Dict
 import jax
 import jax.numpy as jnp
 
-from .rfc5424 import _cummax, _cumsum, _min_where, _shift_left, _shift_right
+from .rfc5424 import (
+    _bitpack32,
+    _esc_parity,
+    _min_where,
+    _scan_ordinals,
+    _shift_left,
+    _shift_right,
+    best_extract_impl,
+    best_scan_impl,
+    extract_by_ord,
+    extract_counts_by_ord,
+    rescue_refetch,
+)
 
-DEFAULT_MAX_FIELDS = 24
+DEFAULT_MAX_FIELDS = 8
+RESCUE_MAX_FIELDS = 24
+WS_WINDOW = 8
 _I32 = jnp.int32
 
 VT_STRING, VT_NUMBER, VT_TRUE, VT_FALSE, VT_NULL = 0, 1, 2, 3, 4
-
-
-def _rev_next_min(packed, big, impl):
-    """Reverse scan: per position, the minimum of ``packed`` at or after
-    it (packed = pos<<8|byte so min == nearest)."""
-    flipped = jnp.flip(packed, axis=1)
-    acc = _cummax(-flipped, impl)
-    return jnp.flip(-acc, axis=1)
 
 
 def _match_token(bb, text: bytes):
@@ -56,174 +84,188 @@ def _match_token(bb, text: bytes):
 
 def decode_gelf(batch: jnp.ndarray, lens: jnp.ndarray,
                 max_fields: int = DEFAULT_MAX_FIELDS,
-                scan_impl: str = "lax") -> Dict[str, jnp.ndarray]:
+                scan_impl: str = None,
+                extract_impl: str = None) -> Dict[str, jnp.ndarray]:
+    if scan_impl is None:
+        scan_impl = best_scan_impl()
+    if extract_impl is None:
+        extract_impl = best_extract_impl()
     N, L = batch.shape
     lens = lens.astype(_I32)
     iota = jax.lax.broadcasted_iota(_I32, (N, L), 1)
     valid = iota < lens[:, None]
-    bb = jnp.where(valid, batch, jnp.uint8(0)).astype(jnp.int16)
+    # uint8 byte plane (see rfc5424.py): widen inside consumer fusions
+    bb = jnp.where(valid, batch, jnp.uint8(0))
 
     is_ws = ((bb == 32) | (bb == 9) | (bb == 10) | (bb == 13)) & valid
+    nonws = valid & ~is_ws
 
-    # escaped quotes via backslash-run parity
+    # ---- escaped quotes & parity ----------------------------------------
     is_bs = (bb == 92) & valid
-    non_bs_pos = jnp.where(~is_bs, iota, -1)
-    last_non_bs = _cummax(non_bs_pos, scan_impl)
-    prev_last = _shift_right(last_non_bs, 1, -1)
-    escaped = ((iota - 1 - prev_last) % 2) == 1
-
     quote = (bb == ord('"')) & valid
+    escaped, cap_plane, cap_words = _esc_parity(is_bs, scan_impl)
     real_q = quote & ~escaped
-    q_excl = _cumsum(real_q, scan_impl) - real_q
-    outside = (q_excl % 2) == 0
+    if cap_plane is not None:
+        cap_viol = jnp.any(cap_plane & quote, axis=1)
+    else:
+        cap_viol = jnp.any((cap_words & _bitpack32(quote)) != 0, axis=1)
+
+    (q_incl,) = _scan_ordinals([real_q], scan_impl)
+    q_excl = q_incl - real_q.astype(q_incl.dtype)
+    outside = (q_excl & 1) == 0
     open_q = real_q & outside
     close_q = real_q & ~outside
-    ok = (q_excl[:, -1] + real_q[:, -1]) % 2 == 0  # even quote count
+    inside_str = (~outside) & valid
+    n_quotes = jnp.max(jnp.where(real_q, q_incl, 0), axis=1).astype(_I32)
+    ok = (n_quotes & 1) == 0  # every string closed
+    ok &= ~cap_viol
 
-    significant = ((~is_ws & outside & valid) | real_q)
+    # ---- bounded-window lookarounds -------------------------------------
+    # ptb/ntb: byte of the nearest non-ws position within WS_WINDOW
+    # before/after each position (0 when none in window).  Rows with a
+    # longer outside-string whitespace run fall back, so "not found in
+    # window" can never silently mean "found nothing relevant".
+    ptb = jnp.zeros_like(bb)
+    ntb = jnp.zeros_like(bb)
+    for k in range(WS_WINDOW, 0, -1):
+        nw_p = _shift_right(nonws, k, False)
+        ptb = jnp.where(nw_p, _shift_right(bb, k, 0), ptb)
+        nw_n = _shift_left(nonws, k, False)
+        ntb = jnp.where(nw_n, _shift_left(bb, k, 0), ntb)
 
-    PACK = lambda: (iota << 8) | bb.astype(_I32)  # noqa: E731
-    BIG = jnp.int32((L + 1) << 8)
+    run = is_ws & outside
+    acc = run
+    for k in range(1, WS_WINDOW + 1):
+        acc = acc & _shift_right(run, k, False)
+    ok &= ~jnp.any(acc, axis=1)  # ws run > WS_WINDOW outside strings
 
-    # channels
-    P = _shift_right(_cummax(jnp.where(significant, PACK(), -1), scan_impl), 1, -1)
-    C = _rev_next_min(jnp.where(significant, PACK(), BIG), BIG, scan_impl)
-    Q = _rev_next_min(jnp.where(real_q, PACK(), BIG), BIG, scan_impl)
+    # ---- structure: braces, arrays --------------------------------------
+    lb = (bb == ord("{")) & outside
+    rb = (bb == ord("}")) & outside
+    ok &= jnp.sum(lb.astype(_I32), axis=1) == 1
+    ok &= jnp.sum(rb.astype(_I32), axis=1) == 1
+    ok &= ~jnp.any(((bb == ord("[")) | (bb == ord("]"))) & outside, axis=1)
+    first_nonws = _min_where(nonws, iota, L)
+    lb_pos = _min_where(lb, iota, L)
+    rb_pos = jnp.max(jnp.where(rb, iota, -1), axis=1)
+    last_nonws = jnp.max(jnp.where(nonws, iota, -1), axis=1)
+    ok &= (first_nonws == lb_pos) & (last_nonws == rb_pos) & (lb_pos < rb_pos)
 
-    def chan_at(chan, pos):
-        """chan[n, pos[n]] via masked reduction; (L+1)<<8 when pos >= L."""
-        hit = iota == jnp.clip(pos, 0, L)[:, None]
-        return jnp.min(jnp.where(hit, chan, BIG), axis=1)
+    # ---- token roles (elementwise) --------------------------------------
+    is_key_open = open_q & ((ptb == ord("{")) | (ptb == ord(",")))
+    is_val_open = open_q & (ptb == ord(":"))
+    ok &= ~jnp.any(open_q & ~is_key_open & ~is_val_open, axis=1)
+    is_key_close = close_q & (ntb == ord(":"))
+    is_val_close = close_q & ~is_key_close
+    # a value close must be followed by ',' or '}'
+    ok &= ~jnp.any(is_val_close & (ntb != ord(",")) & (ntb != ord("}")),
+                   axis=1)
 
-    # overall shape: first significant is '{', last is '}'
-    first_sig = C[:, 0]
-    ok &= (first_sig & 0xFF) == ord("{")
-    # no arrays / extra braces outside strings
-    brace_open = (bb == ord("{")) & outside & valid
-    ok &= jnp.sum(brace_open.astype(_I32), axis=1) == 1
-    ok &= ~jnp.any(((bb == ord("[")) | (bb == ord("]"))) & outside & valid, axis=1)
-    brace_close = (bb == ord("}")) & outside & valid
-    ok &= jnp.sum(brace_close.astype(_I32), axis=1) == 1
-    rb_pos = jnp.max(jnp.where(brace_close, iota, -1), axis=1)
-    # nothing significant after the closing brace
-    after_rb = chan_at(C, rb_pos + 1)
-    ok &= after_rb >= BIG
+    colon_out = (bb == ord(":")) & outside & valid
+    comma_out = (bb == ord(",")) & outside & valid
+    # every comma introduces another key (next non-ws is a quote)
+    ok &= ~jnp.any(comma_out & (ntb != ord('"')), axis=1)
 
-    # every string must be a key (prev sig in {, ,) or a value (prev :)
-    prev_at_oq_ch = jnp.where(P >= 0, P & 0xFF, -1)
-    is_key_q = open_q & ((prev_at_oq_ch == ord("{")) | (prev_at_oq_ch == ord(",")))
-    is_val_q = open_q & (prev_at_oq_ch == ord(":"))
-    ok &= ~jnp.any(open_q & ~is_key_q & ~is_val_q, axis=1)
-
-    key_ord = _cumsum(is_key_q, scan_impl)
-    n_keys = key_ord[:, -1]
+    key_ord, kc_ord = _scan_ordinals(
+        [is_key_open, is_key_close], scan_impl)
+    n_keys = jnp.max(jnp.where(is_key_open, key_ord, 0), axis=1).astype(_I32)
+    n_kc = jnp.max(jnp.where(is_key_close, kc_ord, 0), axis=1).astype(_I32)
+    ok &= n_kc == n_keys
     ok &= n_keys <= max_fields
+    n_colons = jnp.sum(colon_out.astype(_I32), axis=1)
+    n_commas = jnp.sum(comma_out.astype(_I32), axis=1)
+    ok &= n_colons == n_keys
+    ok &= n_commas == jnp.maximum(n_keys - 1, 0)
 
-    POS = 8
-    key_open = jnp.stack(
-        [_min_where(is_key_q & (key_ord == k + 1), iota, L) for k in range(max_fields)],
-        axis=1)  # [N, F]
+    # ---- literal/number runs --------------------------------------------
+    structural = (colon_out | comma_out | lb | rb | real_q)
+    is_lit = nonws & outside & ~structural
+    lit_start = is_lit & ~_shift_right(is_lit, 1, False)
+    lit_end_m = is_lit & ~_shift_left(is_lit, 1, False)
+    # nothing significant may precede the first key (between '{' and it)
+    ok &= ~jnp.any(is_lit & (key_ord == 0), axis=1)
+    # backslashes are only legal inside strings in flat JSON; a bs
+    # "outside" (per possibly-garbled parity) sends the row to the
+    # oracle, which also shields the parity math itself from junk input
+    ok &= ~jnp.any(is_bs & outside, axis=1)
 
-    # walk the channels per key
-    key_close_pk = jnp.stack(
-        [chan_at(Q, key_open[:, k] + 1) for k in range(max_fields)], axis=1)
-    key_close = key_close_pk >> POS
-    colon_pk = jnp.stack(
-        [chan_at(C, key_close[:, k] + 1) for k in range(max_fields)], axis=1)
-    colon_ok = (colon_pk & 0xFF) == ord(":")
-    colon_pos = colon_pk >> POS
-    val_pk = jnp.stack(
-        [chan_at(C, colon_pos[:, k] + 1) for k in range(max_fields)], axis=1)
-    val_ch = val_pk & 0xFF
-    val_pos = val_pk >> POS
-
-    field_valid = (jnp.arange(max_fields, dtype=_I32)[None, :] < n_keys[:, None])
-    ok &= jnp.where(field_valid, colon_ok & (key_close[:, :] < L + 1), True).all(axis=1)
-
-    # value classification
-    is_vstr = val_ch == ord('"')
-    is_vnum = ((val_ch >= ord("0")) & (val_ch <= ord("9"))) | (val_ch == ord("-"))
+    # number/literal value start: a literal-run start whose previous
+    # non-ws byte is ':'
+    is_lit_val = lit_start & (ptb == ord(":"))
+    is_val_start = is_val_open | is_lit_val
     true_at = _match_token(bb, b"true")
     false_at = _match_token(bb, b"false")
     null_at = _match_token(bb, b"null")
+    is_num0 = ((bb >= 48) & (bb <= 57)) | (bb == ord("-"))
+    vclass = jnp.where(
+        is_val_open, 1 + VT_STRING,
+        jnp.where(true_at, 1 + VT_TRUE,
+                  jnp.where(false_at, 1 + VT_FALSE,
+                            jnp.where(null_at, 1 + VT_NULL,
+                                      jnp.where(is_num0, 1 + VT_NUMBER, 0)))))
 
-    def mask_at(mask, pos):
-        hit = iota == jnp.clip(pos, 0, L - 1)[:, None]
-        return jnp.any(mask & hit, axis=1)
+    # ---- per-key extraction (packed-sum words) --------------------------
+    F = max_fields
+    key_open_pos = extract_by_ord(is_key_open, key_ord, iota, F, L,
+                                  extract_impl)
+    key_close_pos = extract_by_ord(is_key_close, kc_ord, iota, F, L,
+                                   extract_impl)
+    val_start_pos = extract_by_ord(is_val_start, key_ord, iota, F, L,
+                                   extract_impl)
+    val_class1 = extract_by_ord(is_val_start, key_ord, vclass, F, 0,
+                                extract_impl)
+    val_close_pos = extract_by_ord(is_val_close, key_ord, iota, F, L,
+                                   extract_impl)
+    lit_end_pos = extract_by_ord(lit_end_m, key_ord, iota, F, L,
+                                 extract_impl)
+    # exactly one value token per key: a string close or a literal run
+    val_tokens = extract_counts_by_ord(is_val_close | lit_start, key_ord,
+                                       F, extract_impl)
+    esc_count = extract_counts_by_ord(is_bs & inside_str, key_ord, F,
+                                      extract_impl)
 
-    is_vtrue = jnp.stack([mask_at(true_at, val_pos[:, k]) for k in range(max_fields)], axis=1)
-    is_vfalse = jnp.stack([mask_at(false_at, val_pos[:, k]) for k in range(max_fields)], axis=1)
-    is_vnull = jnp.stack([mask_at(null_at, val_pos[:, k]) for k in range(max_fields)], axis=1)
+    field_valid = (jnp.arange(F, dtype=_I32)[None, :] < n_keys[:, None])
+    ok &= jnp.where(field_valid, val_tokens == 1, val_tokens == 0).all(axis=1)
+    ok &= jnp.where(field_valid, val_class1 >= 1, True).all(axis=1)
+    val_type = jnp.where(field_valid, val_class1 - 1, -1)
 
-    val_type = jnp.where(
-        is_vstr, VT_STRING,
-        jnp.where(is_vnum, VT_NUMBER,
-                  jnp.where(is_vtrue, VT_TRUE,
-                            jnp.where(is_vfalse, VT_FALSE,
-                                      jnp.where(is_vnull, VT_NULL, -1)))))
-    ok &= jnp.where(field_valid, val_type >= 0, True).all(axis=1)
+    # per-key ordering sanity: open < close < value start
+    ok &= jnp.where(field_valid,
+                    (key_open_pos < key_close_pos)
+                    & (key_close_pos < val_start_pos), True).all(axis=1)
+    # extraction-collision guard: multiple val-starts per key would
+    # corrupt the packed sums — val_tokens==1 bounds val_close/lit runs,
+    # and >1 val_start implies >1 lit_start or val_open (the former is
+    # bounded above; a second val_open implies a second ':' which the
+    # colon count bounds)
 
-    # value end + after-value check
-    # string: close quote; others: next ws/structural boundary
-    vclose = jnp.stack(
-        [chan_at(Q, val_pos[:, k] + 1) >> POS for k in range(max_fields)], axis=1)
-    boundary = (is_ws | (((bb == ord(",")) | (bb == ord("}")) | (bb == ord(":")))
-                         & outside)) & valid
-    Bc = _rev_next_min(jnp.where(boundary, PACK(), BIG), BIG, scan_impl)
-    vbound = jnp.stack(
-        [chan_at(Bc, val_pos[:, k] + 1) >> POS for k in range(max_fields)], axis=1)
-    vbound = jnp.minimum(vbound, lens[:, None])
-    val_end = jnp.where(val_type == VT_STRING, vclose, vbound)
-    # after-value char: strings end at their close quote (look past it);
-    # number/literal val_end is already the first boundary byte (C skips
-    # any whitespace from there to the structural ',' or '}')
-    after_pos = jnp.where(val_type == VT_STRING, val_end + 1, val_end)
-    after_pk = jnp.stack(
-        [chan_at(C, after_pos[:, k]) for k in range(max_fields)], axis=1)
-    after_ch = after_pk & 0xFF
-    ok &= jnp.where(field_valid, (after_ch == ord(",")) | (after_ch == ord("}")),
-                    True).all(axis=1)
-    # literal tokens must end exactly at the boundary
+    # string values: close quote; literals: last run byte + 1
+    is_string = val_type == VT_STRING
+    val_end = jnp.where(is_string, val_close_pos, lit_end_pos + 1)
+    val_end = jnp.minimum(val_end, lens[:, None])
+    # literal token length must match exactly (rejects "truex")
     lit_len = jnp.where(val_type == VT_TRUE, 4,
                         jnp.where(val_type == VT_FALSE, 5,
                                   jnp.where(val_type == VT_NULL, 4, -1)))
     ok &= jnp.where(field_valid & (lit_len > 0),
-                    vbound == val_pos + lit_len, True).all(axis=1)
+                    val_end - val_start_pos == lit_len, True).all(axis=1)
+    # string values must close after they open
+    ok &= jnp.where(field_valid & is_string,
+                    val_close_pos > val_start_pos, True).all(axis=1)
 
-    # escapes inside string values / keys -> host json-decodes the span
-    bs_csum = _cumsum(is_bs, scan_impl)
-
-    def bs_between(a, b):
-        va = jnp.stack([chan_at(bs_csum[:, :] << 8, a[:, k]) >> 8
-                        for k in range(max_fields)], axis=1)
-        vb = jnp.stack([chan_at(bs_csum[:, :] << 8, jnp.maximum(b[:, k] - 1, 0)) >> 8
-                        for k in range(max_fields)], axis=1)
-        return (vb - va) > 0
-
-    key_esc = bs_between(key_open, key_close)
-    val_esc = bs_between(val_pos, val_end) & (val_type == VT_STRING)
-
-    # every structural comma must introduce another key, and comma count
-    # must match (rejects `{"a":1,}` and stray commas)
-    comma = (bb == ord(",")) & outside & valid
-    next_sig_ch = jnp.where(_shift_left(C, 1, BIG) < BIG,
-                            _shift_left(C, 1, BIG) & 0xFF, -1)
-    ok &= ~jnp.any(comma & (next_sig_ch != ord('"')), axis=1)
-    n_commas = jnp.sum(comma.astype(_I32), axis=1)
-    ok &= jnp.where(n_keys > 0, n_commas == n_keys - 1, n_commas == 0)
-
-    # empty object: '{' directly followed by '}'
-    ok &= jnp.where(n_keys == 0, (chan_at(C, (first_sig >> POS) + 1) & 0xFF)
-                    == ord("}"), True)
+    esc_flag = (esc_count > 0) & field_valid
 
     return {
         "ok": ok,
-        "n_fields": jnp.where(ok, n_keys, 0),
-        "key_start": key_open + 1, "key_end": key_close,
-        "val_start": jnp.where(val_type == VT_STRING, val_pos + 1, val_pos),
+        # n_fields stays un-zeroed on not-ok rows so the fetch-side
+        # rescue can screen precisely; every consumer gates on ok
+        # before reading it (materialize_gelf.py, encode_gelf_gelf_block)
+        "n_fields": n_keys,
+        "key_start": key_open_pos + 1, "key_end": key_close_pos,
+        "val_start": jnp.where(is_string, val_start_pos + 1, val_start_pos),
         "val_end": val_end,
         "val_type": val_type,
-        "key_esc": key_esc, "val_esc": val_esc,
+        "key_esc": esc_flag, "val_esc": esc_flag & is_string,
     }
 
 
@@ -232,13 +274,36 @@ def decode_gelf_submit(batch, lens):
     leg of the block pipeline's double buffering."""
     import jax.numpy as jnp
 
-    return decode_gelf_jit(jnp.asarray(batch), jnp.asarray(lens))
+    out = decode_gelf_jit(jnp.asarray(batch), jnp.asarray(lens))
+    return (out, batch, lens)
+
+
+_FIELD_KEYS = ("key_start", "key_end", "val_start", "val_end", "val_type",
+               "key_esc", "val_esc")
 
 
 def decode_gelf_fetch(handle):
+    """Block on a submitted decode; rows whose field count lies in
+    (DEFAULT_MAX_FIELDS, RESCUE_MAX_FIELDS] re-dispatch through the
+    wider tier-2 kernel so they stay on-device.  Field channels come
+    back widened to RESCUE_MAX_FIELDS when tier 2 ran."""
     import numpy as np
 
-    return {k: np.asarray(v) for k, v in handle.items()}
+    out, batch, lens = handle
+    host = {k: np.asarray(v) for k, v in out.items()}
+    if host["key_start"].shape[1] >= RESCUE_MAX_FIELDS:
+        return host
+    nf = host["n_fields"]
+    over = np.flatnonzero(~host["ok"] & (nf > DEFAULT_MAX_FIELDS)
+                          & (nf <= RESCUE_MAX_FIELDS))
+
+    def dispatch(sub_b, sub_l):
+        out2 = decode_gelf_jit(jnp.asarray(sub_b), jnp.asarray(sub_l),
+                               max_fields=RESCUE_MAX_FIELDS)
+        return {k: np.asarray(v) for k, v in out2.items()}
+
+    return rescue_refetch(host, batch, lens, over, _FIELD_KEYS, dispatch,
+                          RESCUE_MAX_FIELDS)
 
 
 @functools.partial(jax.jit, static_argnames=("max_fields",))

@@ -40,13 +40,15 @@ import jax
 import jax.numpy as jnp
 
 from .rfc5424 import (
-    _cummax,
-    _cumsum,
     _days_from_civil,
     _days_in_month,
     _min_where,
+    _scan_ordinals,
     _shift_left,
     _shift_right,
+    best_extract_impl,
+    best_scan_impl,
+    extract_by_ord,
 )
 
 DEFAULT_MAX_PARTS = 24
@@ -64,35 +66,37 @@ def _match_at(bb, text: bytes, valid):
 
 def decode_ltsv(batch: jnp.ndarray, lens: jnp.ndarray,
                 max_parts: int = DEFAULT_MAX_PARTS,
-                scan_impl: str = "lax") -> Dict[str, jnp.ndarray]:
+                scan_impl: str = None,
+                extract_impl: str = None) -> Dict[str, jnp.ndarray]:
+    if scan_impl is None:
+        scan_impl = best_scan_impl()
+    if extract_impl is None:
+        extract_impl = best_extract_impl()
     N, L = batch.shape
     lens = lens.astype(_I32)
     iota = jax.lax.broadcasted_iota(_I32, (N, L), 1)
     valid = iota < lens[:, None]
-    bb = jnp.where(valid, batch, jnp.uint8(0)).astype(jnp.int16)
+    # uint8 byte plane (see rfc5424.py): widen inside consumer fusions
+    bb = jnp.where(valid, batch, jnp.uint8(0))
     is_digit = (bb >= 48) & (bb <= 57)
-    dig = (bb - 48).astype(_I32)
+    dig = bb.astype(_I32) - 48
 
     is_tab = (bb == 9) & valid
-    tab_ord = _cumsum(is_tab, scan_impl)
-    n_parts = tab_ord[:, -1] + 1
+    (tab_ord,) = _scan_ordinals([is_tab], scan_impl)
+    n_tabs = jnp.max(jnp.where(is_tab, tab_ord, 0), axis=1).astype(_I32)
+    n_parts = n_tabs + 1
     ok = n_parts <= max_parts
 
-    # part starts: 0 and tab+1; part ends: tab positions and len
-    POS = 12
-    NOTF = jnp.int32((L + 1) << POS)
-    tab_pos = [
-        _min_where(is_tab & (tab_ord == k + 1), iota << POS, NOTF) >> POS
-        for k in range(max_parts - 1)
-    ]
-    part_start = [jnp.zeros_like(lens)]
-    part_end = []
-    for k in range(max_parts - 1):
-        part_end.append(jnp.minimum(tab_pos[k], lens))
-        part_start.append(jnp.minimum(tab_pos[k] + 1, lens))
-    part_end.append(lens)
-    part_start = jnp.stack(part_start, axis=1)   # [N, max_parts]
-    part_end = jnp.stack(part_end, axis=1)
+    # part starts: 0 and tab+1; part ends: tab positions and len —
+    # tab positions via packed-sum extraction words (one word per 3
+    # ordinals) instead of one masked min-reduction per ordinal
+    tab_pos = extract_by_ord(is_tab, tab_ord, iota, max_parts - 1, L,
+                             extract_impl)
+    part_end = jnp.concatenate(
+        [jnp.minimum(tab_pos, lens[:, None]), lens[:, None]], axis=1)
+    part_start = jnp.concatenate(
+        [jnp.zeros_like(lens)[:, None],
+         jnp.minimum(tab_pos + 1, lens[:, None])], axis=1)
 
     # first ':' in each part (or L)
     is_colon = (bb == ord(":")) & valid
@@ -146,9 +150,9 @@ def decode_ltsv(batch: jnp.ndarray, lens: jnp.ndarray,
     # ---- time parse -----------------------------------------------------
     # optional [ ... ] wrapper
     t_first = jnp.where(has_time, jnp.sum(
-        jnp.where(iota == time_start[:, None], bb, 0), axis=1), 0)
+        jnp.where(iota == time_start[:, None], bb.astype(_I32), 0), axis=1), 0)
     t_last = jnp.where(has_time, jnp.sum(
-        jnp.where(iota == (time_end - 1)[:, None], bb, 0), axis=1), 0)
+        jnp.where(iota == (time_end - 1)[:, None], bb.astype(_I32), 0), axis=1), 0)
     bracketed = (t_first == ord("[")) & (t_last == ord("]")) & \
         (time_end - time_start >= 2)
     ts_s = jnp.where(bracketed, time_start + 1, time_start)
@@ -159,7 +163,7 @@ def decode_ltsv(batch: jnp.ndarray, lens: jnp.ndarray,
     in_t = (r >= 0) & (r < tlen[:, None])
 
     # float form: [+-]? digits [. digits]  (exponents/inf/nan -> fallback)
-    c0 = jnp.sum(jnp.where(in_t & (r == 0), bb, 0), axis=1)
+    c0 = jnp.sum(jnp.where(in_t & (r == 0), bb.astype(_I32), 0), axis=1)
     has_sign = (c0 == ord("+")) | (c0 == ord("-"))
     body_from = jnp.where(has_sign, 1, 0)
     dot_pos = _min_where(in_t & (bb == ord(".")), r, 1 << 20)
@@ -189,7 +193,8 @@ def decode_ltsv(batch: jnp.ndarray, lens: jnp.ndarray,
     rviol |= jnp.any(in_t & ((r == 4) | (r == 7)) & (bb != ord("-")), axis=1)
     rviol |= jnp.any(in_t & (r == 10) & (bb != ord("T")) & (bb != ord("t")), axis=1)
     rviol |= jnp.any(in_t & ((r == 13) | (r == 16)) & (bb != ord(":")), axis=1)
-    has_frac = jnp.sum(jnp.where(in_t & (r == 19), bb, 0), axis=1) == ord(".")
+    has_frac = jnp.sum(jnp.where(in_t & (r == 19), bb.astype(_I32), 0),
+                       axis=1) == ord(".")
     rd = r - 20
     frac_run = _min_where(in_t & (rd >= 0) & (rd < 10) & ~is_digit, rd, 10)
     frac_run = jnp.minimum(frac_run, jnp.maximum(tlen - 20, 0))
@@ -201,7 +206,7 @@ def decode_ltsv(batch: jnp.ndarray, lens: jnp.ndarray,
                               dig * w_frac, 0), axis=1)
     opos = jnp.where(has_frac, 20 + frac_len, 19)
     r2 = r - opos[:, None]
-    oc = jnp.sum(jnp.where(in_t & (r2 == 0), bb, 0), axis=1)
+    oc = jnp.sum(jnp.where(in_t & (r2 == 0), bb.astype(_I32), 0), axis=1)
     is_zulu = (oc == ord("Z")) | (oc == ord("z"))
     is_num_off = (oc == ord("+")) | (oc == ord("-"))
     off_ok = jnp.where(is_zulu, tlen == opos + 1, True)

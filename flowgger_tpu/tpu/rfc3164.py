@@ -59,9 +59,10 @@ def decode_rfc3164(batch: jnp.ndarray, lens: jnp.ndarray, year,
     year = jnp.asarray(year, _I32)
     iota = jax.lax.broadcasted_iota(_I32, (N, L), 1)
     valid = iota < lens[:, None]
-    bb = jnp.where(valid, batch, jnp.uint8(0)).astype(jnp.int16)
+    # uint8 byte plane (see rfc5424.py): widen inside consumer fusions
+    bb = jnp.where(valid, batch, jnp.uint8(0))
     is_digit = (bb >= 48) & (bb <= 57)
-    dig = (bb - 48).astype(_I32)
+    dig = bb.astype(_I32) - 48
 
     # ---- optional <pri> --------------------------------------------------
     has_pri = bb[:, 0] == ord("<")
@@ -96,9 +97,9 @@ def decode_rfc3164(batch: jnp.ndarray, lens: jnp.ndarray, year,
     r = iota - m0[:, None]
     c4 = _at(iota, m0 + 3, bb)
     ok &= c4 == 32  # space after month
-    d0 = _at(iota, m0 + 4, bb)
-    d1 = _at(iota, m0 + 5, bb)
-    d2 = _at(iota, m0 + 6, bb)
+    d0 = _at(iota, m0 + 4, bb).astype(_I32)
+    d1 = _at(iota, m0 + 5, bb).astype(_I32)
+    d2 = _at(iota, m0 + 6, bb).astype(_I32)
     d0_dig = (d0 >= 48) & (d0 <= 57)
     d1_dig = (d1 >= 48) & (d1 <= 57)
     case_a = d0_dig & d1_dig

@@ -271,6 +271,79 @@ def _esc_parity(is_bs, impl: str):
     return _bitunpack32(esc, L), None, cap
 
 
+def _slot_geometry(L: int):
+    """Slot geometry for the bit-packed sum extraction: each i32 word
+    carries as many (value+1) slots as fit in 30 bits, with slot width
+    sized to the packed byte axis — 10 bits / 3 slots for the common
+    L <= 1022, widening automatically for long-record configs
+    (tpu_max_line_len)."""
+    slot_bits = max(10, int(L + 1).bit_length())
+    slots = max(1, 30 // slot_bits)
+    return slot_bits, slots, (1 << slot_bits) - 1
+
+
+def extract_by_ord(mask, ord_, value, K, fill, extract_impl="sum"):
+    """out[n, k] = ``value`` at the position with ordinal k+1 (masked),
+    else ``fill``.  The ordinal channel must hit each ordinal at most
+    once per row.  Shared by every format kernel.
+
+    - ``"sum"``: bit-packed masked sums — few wide passes, no scatter;
+      the TPU path (XLA:TPU lowers scatter/gather near-serially);
+    - ``"scatter"``: one scatter-min per channel — the CPU path."""
+    N, L = mask.shape
+    slot_bits, slots, slot_mask = _slot_geometry(L)
+    if extract_impl == "scatter":
+        # ord_ may be parity-derived and go negative before its zone;
+        # gate on >= 1 so .at[] never wraps a negative column index
+        big = jnp.iinfo(jnp.int32).max
+        hit = mask & (ord_ >= 1)
+        rows = jax.lax.broadcasted_iota(_I32, mask.shape, 0)
+        cols = jnp.where(hit, jnp.minimum(ord_ - 1, K), K)
+        init = jnp.full((N, K + 1), big, _I32)
+        out = init.at[rows, cols].min(
+            jnp.where(hit, value.astype(_I32), big))[:, :K]
+        return jnp.where(out == big, fill, out)
+    cols = []
+    v1 = jnp.clip(value, 0, slot_mask - 1) + 1
+    for base in range(0, K, slots):
+        acc = jnp.where(mask & (ord_ == base + 1), v1, 0)
+        for s in range(1, slots):
+            if base + s < K:
+                acc = acc + (jnp.where(mask & (ord_ == base + 1 + s),
+                                       v1, 0) << (slot_bits * s))
+        word = jnp.sum(acc, axis=1)
+        for slot in range(min(slots, K - base)):
+            v = (word >> (slot_bits * slot)) & slot_mask
+            cols.append(jnp.where(v == 0, fill, v - 1))
+    return jnp.stack(cols, axis=1)
+
+
+def extract_counts_by_ord(mask, ord_, K, extract_impl="sum"):
+    """out[n, k] = number of masked positions with ordinal k+1 — an
+    *accumulating* variant of extract_by_ord (the mask may hit many
+    positions per ordinal; each per-word slot's total is bounded by
+    L < 2**slot_bits, so slots cannot carry)."""
+    N, L = mask.shape
+    slot_bits, slots, slot_mask = _slot_geometry(L)
+    if extract_impl == "scatter":
+        hit = mask & (ord_ >= 1)
+        rows = jax.lax.broadcasted_iota(_I32, mask.shape, 0)
+        cols = jnp.where(hit, jnp.minimum(ord_ - 1, K), K)
+        init = jnp.zeros((N, K + 1), _I32)
+        return init.at[rows, cols].add(hit.astype(_I32))[:, :K]
+    cols = []
+    for base in range(0, K, slots):
+        acc = jnp.where(mask & (ord_ == base + 1), 1, 0)
+        for s in range(1, slots):
+            if base + s < K:
+                acc = acc + (jnp.where(mask & (ord_ == base + 1 + s),
+                                       1, 0) << (slot_bits * s))
+        word = jnp.sum(acc, axis=1)
+        for slot in range(min(slots, K - base)):
+            cols.append((word >> (slot_bits * slot)) & slot_mask)
+    return jnp.stack(cols, axis=1)
+
+
 def decode_rfc5424(batch: jnp.ndarray, lens: jnp.ndarray,
                    max_sd: int = DEFAULT_MAX_SD,
                    max_pairs: int = DEFAULT_MAX_PAIRS,
@@ -294,65 +367,12 @@ def decode_rfc5424(batch: jnp.ndarray, lens: jnp.ndarray,
     if scan_impl is None:
         scan_impl = best_scan_impl()
     N, L = batch.shape
-    # slot geometry for the bit-packed sum extraction: each word carries
-    # as many (value+1) slots as fit in 30 bits, with slot width sized to
-    # the packed byte axis — 10 bits / 3 slots for the common L <= 1022,
-    # widening automatically for long-record configs (tpu_max_line_len)
-    slot_bits = max(10, int(L + 1).bit_length())
-    slots = max(1, 30 // slot_bits)
-    slot_mask = (1 << slot_bits) - 1
-    slot_max = slot_mask - 1
 
     def _extract(mask, ord_, value, K, fill):
-        """out[n, k] = value at the position with ordinal k+1 (masked),
-        else fill."""
-        if extract_impl == "scatter":
-            # ord_ may be parity-derived and go negative before rest_s;
-            # gate on >= 1 so .at[] never wraps a negative column index
-            big = jnp.iinfo(jnp.int32).max
-            hit = mask & (ord_ >= 1)
-            rows = jax.lax.broadcasted_iota(_I32, mask.shape, 0)
-            cols = jnp.where(hit, jnp.minimum(ord_ - 1, K), K)
-            init = jnp.full((N, K + 1), big, _I32)
-            out = init.at[rows, cols].min(
-                jnp.where(hit, value.astype(_I32), big))[:, :K]
-            return jnp.where(out == big, fill, out)
-        cols = []
-        v1 = jnp.clip(value, 0, slot_max) + 1
-        for base in range(0, K, slots):
-            acc = jnp.where(mask & (ord_ == base + 1), v1, 0)
-            for s in range(1, slots):
-                if base + s < K:
-                    acc = acc + (jnp.where(mask & (ord_ == base + 1 + s),
-                                           v1, 0) << (slot_bits * s))
-            word = jnp.sum(acc, axis=1)
-            for slot in range(min(slots, K - base)):
-                v = (word >> (slot_bits * slot)) & slot_mask
-                cols.append(jnp.where(v == 0, fill, v - 1))
-        return jnp.stack(cols, axis=1)
+        return extract_by_ord(mask, ord_, value, K, fill, extract_impl)
 
     def _extract_counts(mask, ord_, K):
-        """out[n, k] = number of masked positions with ordinal k+1 —
-        an *accumulating* variant of _extract (the mask may hit many
-        positions per ordinal; each per-word slot's total is bounded by
-        L < 2**slot_bits, so slots cannot carry)."""
-        if extract_impl == "scatter":
-            hit = mask & (ord_ >= 1)
-            rows = jax.lax.broadcasted_iota(_I32, mask.shape, 0)
-            cols = jnp.where(hit, jnp.minimum(ord_ - 1, K), K)
-            init = jnp.zeros((N, K + 1), _I32)
-            return init.at[rows, cols].add(hit.astype(_I32))[:, :K]
-        cols = []
-        for base in range(0, K, slots):
-            acc = jnp.where(mask & (ord_ == base + 1), 1, 0)
-            for s in range(1, slots):
-                if base + s < K:
-                    acc = acc + (jnp.where(mask & (ord_ == base + 1 + s),
-                                           1, 0) << (slot_bits * s))
-            word = jnp.sum(acc, axis=1)
-            for slot in range(min(slots, K - base)):
-                cols.append((word >> (slot_bits * slot)) & slot_mask)
-        return jnp.stack(cols, axis=1)
+        return extract_counts_by_ord(mask, ord_, K, extract_impl)
     lens = lens.astype(_I32)
     iota = jax.lax.broadcasted_iota(_I32, (N, L), 1)
     bu = batch  # uint8 view for comparisons (half the HBM traffic of i32)
@@ -802,6 +822,40 @@ def decode_rfc5424_submit(batch, lens, max_sd: int = DEFAULT_MAX_SD,
     return (out, batch, lens, max_sd, impl)
 
 
+def rescue_refetch(host, batch, lens, rows_idx, field_keys, dispatch,
+                   width):
+    """Tier-2 rescue: re-dispatch ``rows_idx`` through a wider kernel
+    (``dispatch(sub_batch, sub_lens) -> host dict``) and merge results
+    back; per-field channels in ``field_keys`` widen to ``width``.
+    Shared by every two-tier format kernel."""
+    import numpy as np
+
+    if not rows_idx.size:
+        return host
+    rows = 256
+    while rows < rows_idx.size:
+        rows <<= 1
+    batch_np = np.asarray(batch)
+    lens_np = np.asarray(lens)
+    sub_b = np.zeros((rows, batch_np.shape[1]), dtype=np.uint8)
+    sub_l = np.zeros(rows, dtype=lens_np.dtype)
+    sub_b[:rows_idx.size] = batch_np[rows_idx]
+    sub_l[:rows_idx.size] = lens_np[rows_idx]
+    host2 = dispatch(sub_b, sub_l)
+    merged = {}
+    for k, v in host.items():
+        if k in field_keys:
+            wide = np.zeros((v.shape[0], width), dtype=v.dtype)
+            wide[:, :v.shape[1]] = v
+            wide[rows_idx] = host2[k][:rows_idx.size]
+            merged[k] = wide
+        else:
+            v = v.copy()
+            v[rows_idx] = host2[k][:rows_idx.size]
+            merged[k] = v
+    return merged
+
+
 def decode_rfc5424_fetch(handle):
     """Block on a submitted decode and return host numpy channels,
     re-dispatching pair-overflow rows (DEFAULT_MAX_PAIRS < pairs <=
@@ -814,33 +868,16 @@ def decode_rfc5424_fetch(handle):
     host = {k: np.asarray(v) for k, v in out.items()}
     pc = host["pair_count"]
     over = np.flatnonzero((pc > DEFAULT_MAX_PAIRS) & (pc <= RESCUE_MAX_PAIRS))
-    if not over.size:
-        return host
-    rows = 256
-    while rows < over.size:
-        rows <<= 1
-    batch_np = np.asarray(batch)
-    lens_np = np.asarray(lens)
-    sub_b = np.zeros((rows, batch_np.shape[1]), dtype=np.uint8)
-    sub_l = np.zeros(rows, dtype=lens_np.dtype)
-    sub_b[:over.size] = batch_np[over]
-    sub_l[:over.size] = lens_np[over]
-    out2 = decode_rfc5424_jit(jnp.asarray(sub_b), jnp.asarray(sub_l),
-                              max_sd=max_sd, max_pairs=RESCUE_MAX_PAIRS,
-                              extract_impl=impl)
-    host2 = {k: np.asarray(v) for k, v in out2.items()}
-    merged = {}
-    for k, v in host.items():
-        if k in _PAIR_KEYS:
-            wide = np.zeros((v.shape[0], RESCUE_MAX_PAIRS), dtype=v.dtype)
-            wide[:, :v.shape[1]] = v
-            wide[over] = host2[k][:over.size]
-            merged[k] = wide
-        else:
-            v = v.copy()
-            v[over] = host2[k][:over.size]
-            merged[k] = v
-    return merged
+
+    def dispatch(sub_b, sub_l):
+        out2 = decode_rfc5424_jit(jnp.asarray(sub_b), jnp.asarray(sub_l),
+                                  max_sd=max_sd,
+                                  max_pairs=RESCUE_MAX_PAIRS,
+                                  extract_impl=impl)
+        return {k: np.asarray(v) for k, v in out2.items()}
+
+    return rescue_refetch(host, batch, lens, over, _PAIR_KEYS, dispatch,
+                          RESCUE_MAX_PAIRS)
 
 
 def decode_rfc5424_host(batch, lens, max_sd: int = DEFAULT_MAX_SD,

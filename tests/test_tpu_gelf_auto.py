@@ -152,3 +152,38 @@ def test_autodetect_order_preserved():
         assert res.record is not None
         expect = {0: f"h5424-{i}", 1: f"hl-{i}", 2: f"hg-{i}"}[i % 3]
         assert res.record.hostname == expect
+
+
+def test_gelf_rescue_tier_wide_rows():
+    """Rows with DEFAULT_MAX_FIELDS < fields <= RESCUE_MAX_FIELDS must
+    decode on-device via the tier-2 rescue in decode_gelf_fetch (not the
+    scalar fallback), and match the oracle exactly."""
+    import numpy as np
+
+    from flowgger_tpu.tpu import gelf, pack
+
+    wide = ('{"version":"1.1","host":"h","short_message":"m","timestamp":7'
+            + "".join(f',"_k{i}":{i}' for i in range(12)) + "}")
+    narrow = '{"host":"n","short_message":"x","timestamp":1}'
+    lines = [wide.encode(), narrow.encode(), b"junk not json"] * 3
+    batch, lens, *_ = pack.pack_lines_2d(lines, 256)
+    host = gelf.decode_gelf_fetch(gelf.decode_gelf_submit(batch, lens))
+    ok = np.asarray(host["ok"])
+    nf = np.asarray(host["n_fields"])
+    assert host["key_start"].shape[1] == gelf.RESCUE_MAX_FIELDS
+    for i, ln in enumerate(lines):
+        if ln.startswith(b"junk"):
+            assert not ok[i]
+        else:
+            assert ok[i], f"row {i} should stay on-device"
+    assert nf[0] == 16 and nf[1] == 3
+
+    # span-level parity with the oracle for the rescued row
+    rec = ORACLE.decode(wide)
+    row = np.asarray(batch[0])
+    keys = set()
+    for k in range(int(nf[0])):
+        ks, ke = int(host["key_start"][0][k]), int(host["key_end"][0][k])
+        keys.add(bytes(row[ks:ke]).decode())
+    assert "_k11" in keys and "host" in keys and len(keys) == 16
+    assert rec.hostname == "h"
