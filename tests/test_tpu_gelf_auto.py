@@ -187,3 +187,37 @@ def test_gelf_rescue_tier_wide_rows():
         keys.add(bytes(row[ks:ke]).decode())
     assert "_k11" in keys and "host" in keys and len(keys) == 16
     assert rec.hostname == "h"
+
+
+def test_classify_device_matches_scalar():
+    """The device classifier must reproduce classify() bit-for-bit on a
+    corpus large enough to engage the device path (n >= 512)."""
+    import numpy as np
+
+    from flowgger_tpu.tpu import pack
+    from flowgger_tpu.tpu.autodetect import classify, classify_packed
+
+    base = [
+        b"<13>1 2015-08-05T15:53:45Z h a p m - x",       # rfc5424
+        b"\xef\xbb\xbf<13>1 2015-08-05T15:53:45Z h a p m - x",  # BOM 5424
+        b"<34>Aug  6 11:15:24 host su: msg",              # rfc3164 w/ pri
+        b"Aug  6 11:15:24 host app msg",                  # bare rfc3164
+        b"time:1.5\thost:h\tk:v",                         # ltsv
+        b'{"host":"h","short_message":"m"}',              # gelf
+        b"\xef\xbb\xbf{\"host\":\"h\"}",                  # BOM gelf
+        b"<999999>1 not valid pri",                       # '>' past window
+        b"<13>not5424",                                   # pri, no version
+        b"<1a3>1 junk digits",                            # non-digit pri
+        b"has\ttab but no colon-free",                    # tab+colon -> ltsv
+        b"has\ttab only",                                 # tab, no colon
+        b"plain text line",                               # catch-all
+        b"<>",                                            # empty pri
+        b"{",                                             # bare brace
+        b"",                                              # empty
+    ]
+    lines = [base[i % len(base)] + b" pad%d" % i if i % 3 == 0
+             else base[i % len(base)] for i in range(1024)]
+    packed = pack.pack_lines_2d(lines, 64)
+    got = classify_packed(packed)
+    want = np.array([classify(ln) for ln in lines], dtype=np.int8)
+    assert (got == want).all(), np.flatnonzero(got != want)[:10]
