@@ -1,8 +1,12 @@
-"""Config tests (reference: config.rs:111-191 inline tests)."""
+"""Config tests (reference: config.rs:111-191 inline tests + tests/resources fixtures)."""
+
+import os
 
 import pytest
 
 from flowgger_tpu.config import Config, ConfigError
+
+RESOURCES = os.path.join(os.path.dirname(__file__), "resources")
 
 
 def test_config_from_string():
@@ -25,6 +29,60 @@ def test_config_nested_lookup():
 def test_config_bad_toml():
     with pytest.raises(ConfigError, match="Syntax error"):
         Config.from_string("this is { not toml")
+
+
+def test_config_from_string_no_key():
+    # config.rs:139-142 — `= "no key"` is a TOML syntax error
+    with pytest.raises(ConfigError, match="Syntax error"):
+        Config.from_string('[section]\n= "no key"')
+
+
+def test_config_from_path_good():
+    # config.rs:143-167 against tests/resources/good_config.toml
+    config = Config.from_path(os.path.join(RESOURCES, "good_config.toml"))
+    assert config.lookup("valid_section.valid_field") == "a valid value"
+    assert (
+        config.lookup("valid_section.subsection.nested_field.dotted")
+        == "a nested value"
+    )
+    assert config.lookup("valid_section.subsection.integer_value") == 42
+    assert config.lookup("valid_section.subsection.float_value") == 2.5
+    assert config.lookup("valid_section.flag") is True
+    assert config.lookup("non_existing_section") is None
+    assert config.lookup("non_existing_section.with.field") is None
+
+
+def test_config_from_path_duplicate_key():
+    # config.rs:169-173 — duplicate keys are a TOML syntax error
+    with pytest.raises(ConfigError, match="Syntax error"):
+        Config.from_path(os.path.join(RESOURCES, "bad_config.toml"))
+
+
+def test_config_from_path_missing_file():
+    # config.rs:175-180 — a missing file is an IO error, not a syntax error
+    with pytest.raises(FileNotFoundError):
+        Config.from_path("doesnotexist.toml")
+
+
+def test_config_non_table_intermediate_skipped():
+    # config.rs:100-106 quirk: non-table intermediates are skipped, so the
+    # remaining path parts are ignored and the scalar itself is returned.
+    config = Config.from_string('output = "file"\n')
+    assert config.lookup("output.file_path") == "file"
+
+
+def test_config_table_lookup_returns_dict():
+    config = Config.from_path(os.path.join(RESOURCES, "good_config.toml"))
+    assert config.lookup("valid_section.table_of_pairs") == {
+        "k1": "v1",
+        "k2": "v2",
+    }
+    assert (
+        config.lookup_table("valid_section.table_of_pairs", "must be table")
+        == {"k1": "v1", "k2": "v2"}
+    )
+    with pytest.raises(ConfigError, match="must be table"):
+        config.lookup_table("valid_section.valid_field", "must be table")
 
 
 def test_typed_helpers():
