@@ -216,7 +216,8 @@ def decode_auto_batch(lines: List[bytes], max_len: int,
                               max_len, ltsv_decoder)
 
 
-def encode_auto_gelf_blocks(packed, encoder, merger, ltsv_decoder=None):
+def encode_auto_gelf_blocks(packed, encoder, merger, ltsv_decoder=None,
+                            route_state=None):
     """Block-encode a mixed batch: classify, submit every class's kernel
     (device work for independent classes overlaps via JAX async
     dispatch), run each class's columnar GELF route on its row subset,
@@ -253,8 +254,8 @@ def encode_auto_gelf_blocks(packed, encoder, merger, ltsv_decoder=None):
         submitted.append((idx, fmt, sub, block_submit(fmt, sub)))
     legs = []
     for idx, fmt, sub, handle in submitted:
-        res, _fetch_s = block_fetch_encode(fmt, handle, sub, encoder,
-                                           merger, ltsv_decoder)
+        res, _fetch_s, _declined_s = block_fetch_encode(
+            fmt, handle, sub, encoder, merger, ltsv_decoder, route_state)
         if res is None:
             return None
         legs.append((idx, res))

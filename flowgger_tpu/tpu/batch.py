@@ -79,6 +79,9 @@ class BatchHandler(Handler):
         self._inflight = deque()
         self._timer: Optional[threading.Timer] = None
         self._start_timer = start_timer
+        # per-handler hysteresis for the device-encode route (declines /
+        # cooldown counters owned here, updated by device_gelf)
+        self._device_route_state: dict = {}
         # direct span->bytes encodes for rfc5424 routes
         from ..encoders.gelf import GelfEncoder
         from ..encoders.ltsv import LTSVEncoder
@@ -340,7 +343,8 @@ class BatchHandler(Handler):
             from .autodetect import decode_auto_packed, encode_auto_gelf_blocks
 
             res = encode_auto_gelf_blocks(packed, self.encoder,
-                                          self._merger, self._auto_ltsv)
+                                          self._merger, self._auto_ltsv,
+                                          self._device_route_state)
             if res is None:
                 self._emit(decode_auto_packed(packed, self.max_len,
                                               self._auto_ltsv))
@@ -352,9 +356,9 @@ class BatchHandler(Handler):
             self._emit_block(res, packed[5])
             return
         ltsv_dec = self.scalar.decoder if self.fmt == "ltsv" else None
-        res, fetch_s = block_fetch_encode(self.fmt, handle, packed,
-                                          self.encoder, self._merger,
-                                          ltsv_dec)
+        res, fetch_s, declined_s = block_fetch_encode(
+            self.fmt, handle, packed, self.encoder, self._merger,
+            ltsv_dec, self._device_route_state)
         if res is None:
             # the route declined after the fact (e.g. an oversized
             # ltsv_schema or a configured suffix): Record path
@@ -363,7 +367,8 @@ class BatchHandler(Handler):
             return
         t2 = _time.perf_counter()
         _metrics.add_seconds("device_fetch_seconds", fetch_s)
-        _metrics.add_seconds("encode_seconds", t2 - t0 - fetch_s)
+        _metrics.add_seconds("encode_seconds",
+                             t2 - t0 - fetch_s - declined_s)
         self._emit_block(res, packed[5])
 
     def _emit_block(self, res, n_real: int) -> None:
@@ -459,12 +464,15 @@ def block_submit(fmt, packed):
 
 
 def block_fetch_encode(fmt, handle, packed, encoder, merger,
-                       ltsv_decoder=None):
+                       ltsv_decoder=None, route_state=None):
     """Block on a submitted kernel and run the format's columnar block
-    encoder; returns (BlockResult-or-None, fetch_seconds)."""
+    encoder; returns (BlockResult-or-None, fetch_seconds,
+    declined_seconds) — the last is wall time burned by a declined
+    device-encode attempt, so callers can keep stage metrics additive."""
     import time as _time
 
     t0 = _time.perf_counter()
+    declined_s = 0.0
     if fmt == "rfc3164":
         from ..encoders.passthrough import PassthroughEncoder
         from . import (
@@ -498,12 +506,24 @@ def block_fetch_encode(fmt, handle, packed, encoder, merger,
             packed[2], packed[3], packed[4], host_out, packed[5],
             packed[0].shape[1], encoder, merger)
     else:
-        from . import rfc5424
+        from . import device_gelf, rfc5424
 
+        if device_gelf.route_ok(encoder, merger):
+            res, fetch_s = device_gelf.fetch_encode(handle, packed,
+                                                    encoder, merger,
+                                                    route_state)
+            if res is not None:
+                return res, fetch_s, 0.0
+            # charge the declined attempt to its own metric, not to the
+            # host path's fetch or encode share
+            declined_s = _time.perf_counter() - t0
+            _metrics.add_seconds("device_encode_declined_seconds",
+                                 declined_s)
+            t0 = _time.perf_counter()
         host_out = rfc5424.decode_rfc5424_fetch(handle)
         t1 = _time.perf_counter()
         res = _encode_block_from_host(host_out, packed, encoder, merger)
-    return res, t1 - t0
+    return res, t1 - t0, declined_s
 
 
 def _encode_block_from_host(host_out, packed, encoder, merger):

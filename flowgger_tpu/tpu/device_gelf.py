@@ -1,0 +1,502 @@
+"""Device-side RFC5424→GELF encode: the kernel emits the *final framed
+output bytes* as one dense ``[N, OW]`` byte matrix plus a length vector,
+so the host fetches output-sized data instead of ~24 span channels and
+does nothing but row compaction (the reference fuses decode→encode per
+line in its hot loop, line_splitter.rs:44-54 → gelf_encoder.rs:59-115 —
+this is the batched-TPU shape of that fusion).
+
+Everything is gather-free (the environment's recorded XLA-on-TPU fact:
+dynamic gathers lower near-serially — never gather):
+
+- **JSON escaping** is a monotone expansion: each byte's destination is
+  ``j + #escapes-before(j) (+1 for the escaped byte itself)``, shifts are
+  nondecreasing along the row, and an MSB-first barrel shifter places
+  bytes collision-free in ``log2(E_CAP)`` masked-select passes (proof:
+  after processing bit k, positions ``j + (s>>k<<k)`` stay strictly
+  increasing whenever ``s`` is nondecreasing — right-shifts only).
+- **Segment assembly** is an OR-accumulation over a *static* list of
+  ~48 segments (1 brace + 5 per SD pair + 17 tail parts, mirroring
+  encode_gelf_block.py's layout byte-for-byte): each segment masks its
+  source span out of a concatenated source row (escaped line ∥ constant
+  bank ∥ timestamp text) and cyclically rotates it to its destination
+  with a per-row power-of-2 barrel (``log2(OW)`` selects), where the
+  destination offsets are an exclusive running sum of segment lengths.
+- **SD pair sorting** (serde_json's BTreeMap key order) extracts each
+  name's first 8 bytes into two packed int32 words via masked one-hot
+  sums, runs a 12-comparator sorting network over the ≤6-pair tier with
+  the d-mapped spans riding as payload, and falls the row back to the
+  host tiers when keys are ambiguous (equal 8-byte prefixes that zero-
+  padding cannot order) or duplicate (dict last-wins semantics).
+
+Rows outside the tier (kernel-flagged, non-ASCII, >6 pairs, RFC5424
+value escapes, 6-byte ``\\u00XX`` control escapes, oversized output)
+keep their existing host paths, so observable bytes stay identical to
+the scalar route in every case.
+
+The timestamp digits (shortest round-trip f64, serde_json/Ryu form) are
+formatted host-side from a small scalar fetch and uploaded as a
+``[N, TS_W]`` text block — the only host↔device round-trip; everything
+else rides the decode call's device-resident channels.
+"""
+
+from __future__ import annotations
+
+import os
+from functools import partial
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils.rustfmt import json_f64
+from .assemble import exclusive_cumsum
+from .block_common import finish_block, merger_suffix
+from .materialize import compute_ts
+from .rfc5424 import _cumsum, best_scan_impl
+
+_I32 = jnp.int32
+_U8 = jnp.uint8
+
+TS_W = 32          # timestamp text slot width (longest json_f64 ≈ 25)
+E_CAP = 56         # max JSON escapes per row on the device tier
+_AMBIG_LEN = 8     # name-key bytes captured for sorting
+_BIG = 0x7FFFFFFF  # sort key for absent pairs (names are ASCII < 0x7f)
+
+# constant bank: the same byte constants the host tier uses (single
+# source of truth — the two tiers must never diverge, since fallback
+# rows splice host-tier output into device-tier blocks)
+from .encode_gelf_block import (  # noqa: E402
+    _C_APP, _C_DASH, _C_FULL, _C_HOST, _C_LEVEL, _C_OPEN, _C_P0, _C_P1,
+    _C_P2, _C_PROC, _C_SDID, _C_SEVD, _C_SHORT, _C_TAIL, _C_TS,
+    _C_UNKNOWN,
+)
+
+_PARTS = {
+    "open": _C_OPEN,
+    "p0": _C_P0,
+    "p1": _C_P1,
+    "p2": _C_P2,
+    "app": _C_APP,
+    "full": _C_FULL,
+    "host": _C_HOST,
+    "level": _C_LEVEL,
+    "proc": _C_PROC,
+    "sdid": _C_SDID,
+    "short": _C_SHORT,
+    "ts": _C_TS,
+    "tail": _C_TAIL,
+    "unknown": _C_UNKNOWN,
+    "dash": _C_DASH,
+    "sevd": _C_SEVD,
+}
+
+# optimal 12-comparator sorting network for 6 elements
+_NET6 = ((0, 5), (1, 3), (2, 4), (1, 2), (3, 4), (0, 3), (2, 5),
+         (0, 1), (2, 3), (4, 5), (1, 2), (3, 4))
+
+
+def _bank(suffix: bytes) -> Tuple[bytes, Dict[str, int]]:
+    offs, bank = {}, b""
+    for k, v in _PARTS.items():
+        if k == "tail":
+            v = v + suffix
+        offs[k] = len(bank)
+        bank += v
+    return bank, offs
+
+
+def _shr2d(arr, k):
+    """Shift rows right by static k (drop tail, zero-fill head)."""
+    if k == 0:
+        return arr
+    return jnp.pad(arr[:, :-k], ((0, 0), (k, 0)))
+
+
+def _monotone_expand(vals, shifts, w_out, nbits):
+    """Place vals[i,j] at column j + shifts[i,j]; shifts nondecreasing
+    along each row, < 2**nbits. Vacated slots become 0 (vals must be 0
+    where nothing is emitted). MSB-first barrel: collision-free because
+    intermediate positions j + (s>>k<<k) stay strictly increasing."""
+    x = jnp.pad(vals, ((0, 0), (0, w_out - vals.shape[1])))
+    s = jnp.pad(shifts, ((0, 0), (0, w_out - shifts.shape[1])))
+    for k in range(nbits - 1, -1, -1):
+        d = 1 << k
+        mv = s >= d
+        xm = jnp.where(mv, x, 0)
+        sm = jnp.where(mv, s - d, 0)
+        x = jnp.where(mv, 0, x) | _shr2d(xm, d)
+        s = jnp.where(mv, 0, s) + _shr2d(sm, d)
+    return x
+
+
+def _rot_rows(x, r, w: int):
+    """Cyclic right-rotate each row of [N, w] by per-row r (w pow2)."""
+    for k in range(w.bit_length() - 1):
+        d = 1 << k
+        bit = ((r >> k) & 1) == 1
+        rolled = jnp.concatenate([x[:, -d:], x[:, :-d]], axis=1)
+        x = jnp.where(bit[:, None], rolled, x)
+    return x
+
+
+def _out_width(L: int) -> int:
+    """Static output width: a power of two covering the concatenated
+    source row and typical GELF output for lines of width L."""
+    w = 512
+    while w < 2 * L:
+        w *= 2
+    return w
+
+
+@partial(jax.jit, static_argnames=("suffix", "max_sd", "impl",
+                                   "assemble"))
+def _encode_kernel(batch, lens, dec, ts_text, ts_len, *, suffix: bytes,
+                   max_sd: int, impl: str, assemble: bool = True):
+    N, L = batch.shape
+    OW = _out_width(L)
+    bank, off = _bank(suffix)
+    CB = len(bank)
+    iota = jax.lax.broadcasted_iota(_I32, (N, L), 1)
+    bb = batch.astype(_I32)
+    valid = iota < lens.astype(_I32)[:, None]
+
+    # ---- escape classes --------------------------------------------------
+    two_ctl = ((bb == 8) | (bb == 9) | (bb == 10) | (bb == 12) | (bb == 13))
+    esc = ((bb == 34) | (bb == 92) | two_ctl) & valid
+    bad_ctl = (bb < 32) & ~two_ctl & valid
+    mapped = jnp.where(bb == 8, ord("b"),
+             jnp.where(bb == 9, ord("t"),
+             jnp.where(bb == 10, ord("n"),
+             jnp.where(bb == 12, ord("f"),
+             jnp.where(bb == 13, ord("r"), bb)))))
+    mapped = jnp.where(valid, mapped, 0).astype(_I32)
+
+    esc_i = esc.astype(_I32)
+    ne_incl = _cumsum(esc_i, impl)
+    ne_excl = ne_incl - esc_i
+    ne_total = ne_incl[:, -1]
+
+    nbits = E_CAP.bit_length()
+    EW = L + E_CAP
+    esc_row = None
+    if assemble:
+        s_main = jnp.minimum(ne_excl + esc_i, E_CAP)
+        s_pref = jnp.minimum(ne_excl, E_CAP)
+        main = _monotone_expand(mapped, s_main, EW, nbits)
+        pref = _monotone_expand(jnp.where(esc, ord("\\"), 0).astype(_I32),
+                                s_pref, EW, nbits)
+        esc_row = (main | pref).astype(_U8)
+
+    # d-map: raw index a -> escaped index a + #escapes-before(a)
+    def dmap(a):
+        a = a.astype(_I32)
+        ne_at = jnp.sum(esc_i * (iota < a[:, None]), axis=1)
+        return a + ne_at
+
+    # ---- fixed-field spans in escaped coordinates ------------------------
+    app_s, app_e = dmap(dec["app_start"]), dmap(dec["app_end"])
+    proc_s, proc_e = dmap(dec["proc_start"]), dmap(dec["proc_end"])
+    host_s, host_e = dmap(dec["host_start"]), dmap(dec["host_end"])
+    full_s = dmap(dec["full_start"])
+    trim_e = dmap(dec["trim_end"])
+    msg_s = dmap(dec["msg_trim_start"])
+
+    sd_count = dec["sd_count"].astype(_I32)
+    nsd = sd_count > 0
+    # last SD block id span (select over the small static block axis)
+    sid_s_raw = jnp.zeros_like(sd_count)
+    sid_e_raw = jnp.zeros_like(sd_count)
+    for k in range(dec["sid_start"].shape[1]):
+        pick = sd_count - 1 == k
+        sid_s_raw = jnp.where(pick, dec["sid_start"][:, k].astype(_I32),
+                              sid_s_raw)
+        sid_e_raw = jnp.where(pick, dec["sid_end"][:, k].astype(_I32),
+                              sid_e_raw)
+    sid_s, sid_e = dmap(sid_s_raw), dmap(sid_e_raw)
+
+    # ---- SD pairs: 8-byte name keys, d-mapped spans, sorting network -----
+    pair_count = dec["pair_count"].astype(_I32)
+    P = dec["name_start"].shape[1]
+    val_esc_any = jnp.zeros((N,), dtype=bool)
+    cols = {k: [] for k in ("hi", "lo", "nlen", "ns", "ne", "vs", "ve")}
+    for p in range(P):
+        ns_r = dec["name_start"][:, p].astype(_I32)
+        ne_r = dec["name_end"][:, p].astype(_I32)
+        pv = p < pair_count
+        val_esc_any |= dec["val_has_esc"][:, p].astype(bool) & pv
+        r = iota - ns_r[:, None]
+        in_name = (r >= 0) & (iota < ne_r[:, None])
+        z = jnp.where(in_name, bb, 0)
+        hi = jnp.sum(z * ((r == 0) * (1 << 24) + (r == 1) * (1 << 16)
+                          + (r == 2) * (1 << 8) + (r == 3)), axis=1)
+        lo = jnp.sum(z * ((r == 4) * (1 << 24) + (r == 5) * (1 << 16)
+                          + (r == 6) * (1 << 8) + (r == 7)), axis=1)
+        cols["hi"].append(jnp.where(pv, hi, _BIG))
+        cols["lo"].append(jnp.where(pv, lo, _BIG))
+        cols["nlen"].append(jnp.where(pv, ne_r - ns_r, _BIG))
+        cols["ns"].append(dmap(ns_r))
+        cols["ne"].append(dmap(ne_r))
+        cols["vs"].append(dmap(dec["val_start"][:, p]))
+        cols["ve"].append(dmap(dec["val_end"][:, p]))
+
+    for i, j in _NET6:
+        if i >= P or j >= P:
+            continue
+        ah, bh = cols["hi"][i], cols["hi"][j]
+        al, bl = cols["lo"][i], cols["lo"][j]
+        an, bn = cols["nlen"][i], cols["nlen"][j]
+        swap = (bh < ah) | ((bh == ah) & ((bl < al)
+                            | ((bl == al) & (bn < an))))
+        for key in cols:
+            a, b = cols[key][i], cols[key][j]
+            cols[key][i] = jnp.where(swap, b, a)
+            cols[key][j] = jnp.where(swap, a, b)
+
+    # ambiguity / duplicate detection on sorted neighbours: equal 8-byte
+    # keys are adjacent after sorting; zero-padding orders them only when
+    # exactly one name is ≤8 bytes (a strict prefix of the other)
+    ambig = jnp.zeros((N,), dtype=bool)
+    for p in range(P - 1):
+        keq = ((cols["hi"][p] == cols["hi"][p + 1])
+               & (cols["lo"][p] == cols["lo"][p + 1])
+               & (cols["hi"][p] != _BIG))
+        la, lb = cols["nlen"][p], cols["nlen"][p + 1]
+        ambig |= keq & ((la == lb) | ((la > _AMBIG_LEN)
+                                      & (lb > _AMBIG_LEN)))
+
+    # ---- segment table ---------------------------------------------------
+    cbase = EW
+    tbase = EW + CB
+    zero = jnp.zeros((N,), dtype=_I32)
+    segs = []  # (src0 [N], seglen [N]) in destination order
+
+    def add_const(name, gate=None):
+        ln = zero + len(_PARTS[name]) + (len(suffix) if name == "tail"
+                                         else 0)
+        if gate is not None:
+            ln = jnp.where(gate, ln, 0)
+        segs.append((zero + (cbase + off[name]), ln))
+
+    def add_span(s, e, gate=None):
+        ln = jnp.maximum(e - s, 0)
+        if gate is not None:
+            ln = jnp.where(gate, ln, 0)
+        segs.append((s, ln))
+
+    add_const("open")
+    for p in range(P):
+        pv = p < pair_count
+        add_const("p0", pv)
+        add_span(cols["ns"][p], cols["ne"][p], pv)
+        add_const("p1", pv)
+        add_span(cols["vs"][p], cols["ve"][p], pv)
+        add_const("p2", pv)
+
+    add_const("app")
+    add_span(app_s, app_e)
+    add_const("full")
+    add_span(full_s, trim_e)
+    add_const("host")
+    host_empty = host_e <= host_s
+    segs.append((jnp.where(host_empty, cbase + off["unknown"], host_s),
+                 jnp.where(host_empty, len(_PARTS["unknown"]),
+                           host_e - host_s)))
+    add_const("level")
+    segs.append((cbase + off["sevd"] + dec["severity"].astype(_I32),
+                 zero + 1))
+    add_const("proc")
+    add_span(proc_s, proc_e)
+    add_const("sdid", nsd)
+    add_span(sid_s, sid_e, nsd)
+    add_const("short")
+    msg_empty = trim_e <= msg_s
+    segs.append((jnp.where(msg_empty, cbase + off["dash"], msg_s),
+                 jnp.where(msg_empty, 1, trim_e - msg_s)))
+    add_const("ts")
+    segs.append((zero + tbase, ts_len.astype(_I32)))
+    add_const("tail")
+
+    # ---- assemble --------------------------------------------------------
+    # stack the segment table [S, N] and scan: the roll body compiles
+    # once instead of once per segment (48x smaller HLO graph), while
+    # each step remains a handful of fused [N, OW] elementwise passes
+    seg_src = jnp.stack([s for s, _ in segs])
+    seg_len = jnp.stack([ln for _, ln in segs])
+    seg_dst = jnp.cumsum(seg_len, axis=0) - seg_len
+    out_len = seg_dst[-1] + seg_len[-1]
+
+    acc = None
+    if assemble:
+        const_row = jnp.asarray(np.frombuffer(bank, dtype=np.uint8))
+        src2 = jnp.concatenate([
+            esc_row,
+            jnp.broadcast_to(const_row[None, :], (N, CB)),
+            ts_text.astype(_U8),
+        ], axis=1)
+        if src2.shape[1] > OW:
+            raise ValueError(f"source row {src2.shape[1]} exceeds OW {OW}")
+        src2 = jnp.pad(src2, ((0, 0), (0, OW - src2.shape[1])))
+        iow = jax.lax.broadcasted_iota(_I32, (N, OW), 1)
+
+        def step(a, xs):
+            src0, seglen, dst0 = xs
+            m = (iow >= src0[:, None]) & (iow < (src0 + seglen)[:, None])
+            contrib = jnp.where(m, src2, jnp.uint8(0))
+            return a | _rot_rows(contrib, (dst0 - src0) % OW, OW), None
+
+        acc, _ = jax.lax.scan(step, jnp.zeros((N, OW), dtype=_U8),
+                              (seg_src, seg_len, seg_dst))
+
+    # ---- tier ------------------------------------------------------------
+    tier = (dec["ok"].astype(bool)
+            & ~dec["has_high"].astype(bool)
+            & ~jnp.any(bad_ctl, axis=1)
+            & (ne_total <= E_CAP)
+            & (pair_count <= P)
+            & (sd_count <= max_sd)
+            & ~val_esc_any
+            & ~ambig
+            & (out_len <= OW))
+    if not assemble:
+        return tier
+    return acc, out_len, tier
+
+
+def route_ok(encoder, merger) -> bool:
+    """Device encode applies to GELF output without extras over line/nul
+    framing (syslen's variable-width prefix stays on the host tiers)."""
+    from ..encoders.gelf import GelfEncoder
+    from ..mergers import LineMerger, NulMerger
+
+    if os.environ.get("FLOWGGER_DEVICE_ENCODE", "1") == "0":
+        return False
+    if type(encoder) is not GelfEncoder or encoder.extra:
+        return False
+    return merger is None or type(merger) in (LineMerger, NulMerger)
+
+
+# fraction of non-tier rows above which the span-fetch host path wins
+# (scalar oracle ≈70K rows/s vs native assembler ≈1.16M rows/s per core).
+# Rows the decode kernel itself flagged — including 7-16-pair rows the
+# span path would rescue through the wider tier-2 kernel — count against
+# this budget, so a stream that is persistently rescue-heavy declines to
+# the span path rather than scalar-oracling those rows forever.
+FALLBACK_FRAC = 0.05
+
+# hysteresis: after this many consecutive declined batches, skip the
+# device attempt entirely for COOLDOWN batches before probing again
+DECLINE_LIMIT = 3
+COOLDOWN = 16
+
+
+def _ts_text_block(small: Dict[str, np.ndarray]):
+    """Format per-row timestamp digits host-side, deduplicated (repetitive
+    streams share few distinct stamps; json_f64 is the only per-value
+    Python work left on this route)."""
+    okh = small["ok"].astype(bool)
+    masked = {k: np.where(okh, small[k], 0)
+              for k in ("days", "sod", "off", "nanos")}
+    ts_vals = compute_ts(masked)
+    uniq, inv = np.unique(ts_vals, return_inverse=True)
+    txt = np.zeros((uniq.size, TS_W), dtype=np.uint8)
+    ulen = np.zeros(uniq.size, dtype=np.int32)
+    for u, val in enumerate(uniq):
+        s = json_f64(float(val)).encode("ascii")[:TS_W]
+        txt[u, :len(s)] = np.frombuffer(s, dtype=np.uint8)
+        ulen[u] = len(s)
+    return txt[inv], ulen[inv]
+
+
+def fetch_encode(handle, packed, encoder, merger, route_state=None):
+    """Run the device encode for a submitted rfc5424 decode; returns
+    (BlockResult | None, fetch_seconds). None = caller should use the
+    span-fetch host path (high fallback fraction).
+
+    Phase 1 runs a tier-only variant of the kernel (XLA dead-code-
+    eliminates the whole assembly) with a pessimistic TS_W timestamp
+    width, so persistently declining streams never pay the assembly or
+    the host timestamp formatting; ``route_state`` (a caller-owned dict)
+    adds cross-batch hysteresis on top."""
+    import time as _time
+
+    from ..utils.metrics import registry as _metrics
+
+    out, _, _, max_sd, _, batch_dev, lens_dev = handle
+    batch, lens, chunk, starts, orig_lens, n_real = packed
+    n = int(n_real)
+    suffix, syslen = merger_suffix(merger)
+    assert not syslen
+
+    if route_state is not None and route_state.get("cooldown", 0) > 0:
+        route_state["cooldown"] -= 1
+        return None, 0.0
+
+    N = batch.shape[0]
+    impl = best_scan_impl()
+    empty_ts = jnp.zeros((N, 0), dtype=jnp.uint8)
+    full_ts_len = jnp.full((N,), TS_W, dtype=jnp.int32)
+    tier1 = _encode_kernel(batch_dev, lens_dev, dict(out), empty_ts,
+                           full_ts_len, suffix=suffix, max_sd=max_sd,
+                           impl=impl, assemble=False)
+
+    t_fetch = 0.0
+    t0 = _time.perf_counter()
+    tier1_np = np.asarray(tier1)[:n]
+    t_fetch += _time.perf_counter() - t0
+
+    starts64 = np.asarray(starts[:n], dtype=np.int64)
+    lens64 = np.asarray(orig_lens[:n], dtype=np.int64)
+    max_len = batch.shape[1]
+    cand1 = tier1_np & (lens64 <= max_len)
+
+    if n and (1.0 - cand1.mean()) > FALLBACK_FRAC:
+        _metrics.inc("device_encode_declined")
+        if route_state is not None:
+            route_state["declines"] = route_state.get("declines", 0) + 1
+            if route_state["declines"] >= DECLINE_LIMIT:
+                route_state["cooldown"] = COOLDOWN
+                route_state["declines"] = 0
+        return None, t_fetch
+    if route_state is not None:
+        route_state["declines"] = 0
+
+    t0 = _time.perf_counter()
+    small = {k: np.asarray(out[k]) for k in ("ok", "days", "sod", "off",
+                                             "nanos")}
+    t_fetch += _time.perf_counter() - t0
+
+    ts_text, ts_len = _ts_text_block(small)
+    acc, out_len, tier = _encode_kernel(
+        batch_dev, lens_dev, dict(out), jnp.asarray(ts_text),
+        jnp.asarray(ts_len), suffix=suffix, max_sd=max_sd,
+        impl=impl)
+
+    t0 = _time.perf_counter()
+    tier_np = np.asarray(tier)[:n]
+    t_fetch += _time.perf_counter() - t0
+
+    # the real (shorter) timestamp text can only widen the tier vs the
+    # pessimistic phase-1 gate; cand stays the decision set either way
+    cand = tier_np & (lens64 <= max_len)
+
+    t0 = _time.perf_counter()
+    out_np = np.asarray(acc)[:n]
+    len_np = np.asarray(out_len)[:n].astype(np.int64)
+    t_fetch += _time.perf_counter() - t0
+
+    ridx = np.flatnonzero(cand)
+    if ridx.size:
+        rows = out_np[ridx]
+        m = np.arange(rows.shape[1])[None, :] < len_np[ridx, None]
+        final_buf = rows[m].tobytes()
+        row_off = exclusive_cumsum(len_np[ridx])
+    else:
+        final_buf = b""
+        row_off = np.zeros(1, dtype=np.int64)
+
+    _metrics.inc("device_encode_rows", int(ridx.size))
+    _metrics.inc("device_encode_scalar_rows", int(n - ridx.size))
+    res = finish_block(chunk, starts64, lens64, n, cand, ridx, final_buf,
+                       row_off, None, suffix, False, merger, encoder)
+    return res, t_fetch

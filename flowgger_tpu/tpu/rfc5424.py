@@ -817,9 +817,14 @@ def decode_rfc5424_submit(batch, lens, max_sd: int = DEFAULT_MAX_SD,
     batch pipeline overlap device decode of batch N with host encoding
     of batch N-1 (double buffering)."""
     impl = extract_impl or best_extract_impl()
-    out = decode_rfc5424_jit(jnp.asarray(batch), jnp.asarray(lens),
+    batch_dev, lens_dev = jnp.asarray(batch), jnp.asarray(lens)
+    out = decode_rfc5424_jit(batch_dev, lens_dev,
                              max_sd=max_sd, extract_impl=impl)
-    return (out, batch, lens, max_sd, impl)
+    # the handle keeps the original *host* arrays (rescue_refetch slices
+    # them without a device round-trip) plus the uploaded *device*
+    # arrays so downstream device-side stages (tpu/device_gelf.py) can
+    # reuse them without a re-upload
+    return (out, batch, lens, max_sd, impl, batch_dev, lens_dev)
 
 
 def rescue_refetch(host, batch, lens, rows_idx, field_keys, dispatch,
@@ -864,7 +869,7 @@ def decode_rfc5424_fetch(handle):
     come back widened to RESCUE_MAX_PAIRS when any row needed tier 2."""
     import numpy as np
 
-    out, batch, lens, max_sd, impl = handle
+    out, batch, lens, max_sd, impl = handle[:5]
     host = {k: np.asarray(v) for k, v in out.items()}
     pc = host["pair_count"]
     over = np.flatnonzero((pc > DEFAULT_MAX_PAIRS) & (pc <= RESCUE_MAX_PAIRS))

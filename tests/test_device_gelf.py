@@ -1,0 +1,236 @@
+"""Device-side GELF encode (tpu/device_gelf.py): primitive unit tests
+plus differential tests proving the device tier engages and produces
+byte-identical output to the scalar oracle (RFC5424Decoder →
+GelfEncoder → merger.frame), including fallback splicing."""
+
+import queue
+import random
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from flowgger_tpu.config import Config
+from flowgger_tpu.block import EncodedBlock
+from flowgger_tpu.decoders import DecodeError
+from flowgger_tpu.decoders.rfc5424 import RFC5424Decoder
+from flowgger_tpu.encoders.gelf import GelfEncoder
+from flowgger_tpu.mergers import LineMerger, NulMerger
+from flowgger_tpu.tpu import device_gelf, pack, rfc5424
+from flowgger_tpu.tpu.batch import BatchHandler
+from flowgger_tpu.utils.metrics import registry as metrics
+
+ORACLE = RFC5424Decoder()
+ENC = GelfEncoder(Config.from_string(""))
+
+
+# ---- primitives ------------------------------------------------------------
+
+def test_monotone_expand_matches_numpy():
+    rng = np.random.default_rng(7)
+    for _ in range(20):
+        n, w = 5, 64
+        esc = rng.random((n, w)) < 0.2
+        shifts = np.cumsum(esc, axis=1) - esc  # exclusive, nondecreasing
+        vals = rng.integers(1, 200, (n, w))
+        w_out = w + 32
+        got = np.asarray(device_gelf._monotone_expand(
+            jnp.asarray(vals.astype(np.int32)),
+            jnp.asarray(shifts.astype(np.int32)), w_out, 6))
+        want = np.zeros((n, w_out), dtype=np.int64)
+        for i in range(n):
+            for j in range(w):
+                want[i, j + shifts[i, j]] = vals[i, j]
+        assert (got == want).all()
+
+
+def test_rot_rows_matches_numpy():
+    rng = np.random.default_rng(8)
+    x = rng.integers(0, 255, (6, 128)).astype(np.uint8)
+    r = rng.integers(0, 128, 6).astype(np.int32)
+    got = np.asarray(device_gelf._rot_rows(jnp.asarray(x),
+                                           jnp.asarray(r), 128))
+    for i in range(6):
+        assert (got[i] == np.roll(x[i], int(r[i]))).all()
+
+
+# ---- differential harness --------------------------------------------------
+
+def scalar_frames(lines, merger):
+    out = []
+    for ln in lines:
+        try:
+            rec = ORACLE.decode(ln.decode("utf-8"))
+        except (DecodeError, UnicodeDecodeError):
+            continue
+        payload = ENC.encode(rec)
+        out.append(merger.frame(payload) if merger is not None else payload)
+    return out
+
+
+def run_device(lines, merger, max_len=256):
+    """Drive the device engine directly; returns (BlockResult|None, used)."""
+    packed = pack.pack_lines_2d(lines, max_len)
+    handle = rfc5424.decode_rfc5424_submit(packed[0], packed[1])
+    return device_gelf.fetch_encode(handle, packed, ENC, merger)
+
+
+CLEAN = [
+    b'<13>1 2023-09-20T12:35:45.123Z host app 123 MSGID '
+    b'[ex@32473 k="v" a="b"] hello world',
+    b'<165>1 2003-10-11T22:14:15.003Z mymachine.example.com evntslog - '
+    b'ID47 [exampleSDID@32473 iut="3" eventSource="Application" '
+    b'eventID="1011"] An application event log entry',
+    b'<34>1 2003-10-11T22:14:15.003Z mymachine.example.com su - ID47 - '
+    b'su root failed for lonvick on /dev/pts/8',
+    b'<0>1 2023-01-01T00:00:00Z - - - - - -',
+    b'<191>1 2023-06-30T23:59:59.999999Z h a p m [x@1 zz="1" aa="2" '
+    b'mm="3"] msg with "quotes" and\ttabs',
+]
+
+
+@pytest.mark.parametrize("merger", [None, LineMerger(), NulMerger()],
+                         ids=["noop", "line", "nul"])
+def test_device_matches_scalar_and_engages(merger):
+    n0 = metrics.get("device_encode_rows")
+    res, _ = run_device(CLEAN * 3, merger)
+    assert res is not None
+    assert metrics.get("device_encode_rows") - n0 == len(CLEAN) * 3
+    want = b"".join(scalar_frames(CLEAN * 3, merger))
+    assert res.block.data == want
+
+
+def test_device_fallback_splicing(monkeypatch):
+    monkeypatch.setattr(device_gelf, "FALLBACK_FRAC", 1.1)
+    mixed = [
+        CLEAN[0],
+        b'<13>1 2023-09-20T12:35:45.123Z h a - - [x@1 k="a\\"b"] esc val',
+        b"garbage line",
+        CLEAN[2],
+        b'<13>1 2023-09-20T12:35:45.123Z h a - - [x@1 samekey="1" '
+        b'samekey="2"] dup names',
+        "<13>1 2023-09-20T12:35:45.123Z hést a - - - utf8".encode(),
+        CLEAN[4],
+    ]
+    res, _ = run_device(mixed, LineMerger())
+    assert res is not None
+    want = b"".join(scalar_frames(mixed, LineMerger()))
+    assert res.block.data == want
+    # the error row surfaced as an error, not silently dropped
+    assert len(res.errors) == 1
+
+
+def test_device_declines_on_heavy_fallback():
+    bad = [b"not a syslog line"] * 20 + [CLEAN[0]]
+    res, _ = run_device(bad, LineMerger())
+    assert res is None
+
+
+def test_ambiguous_long_names_fall_back(monkeypatch):
+    monkeypatch.setattr(device_gelf, "FALLBACK_FRAC", 1.1)
+    lines = [
+        # two names sharing an 8-byte prefix, differing at byte 9
+        b'<13>1 2023-09-20T12:35:45.123Z h a - - '
+        b'[x@1 commonpreA="1" commonpreB="2"] m',
+        # prefix-of-the-other pair (orderable by zero-padding)
+        b'<13>1 2023-09-20T12:35:45.123Z h a - - '
+        b'[x@1 abcdefgh="1" abcdefghi="2"] m',
+        CLEAN[1],
+    ]
+    res, _ = run_device(lines, LineMerger())
+    want = b"".join(scalar_frames(lines, LineMerger()))
+    assert res.block.data == want
+
+
+def test_sorted_pair_order_device():
+    lines = [
+        b'<13>1 2023-09-20T12:35:45.123Z h a - - '
+        b'[x@1 zeta="1" alpha="2" mike="3" bravo="4" yank="5" echo="6"] m',
+    ] * 4
+    res, _ = run_device(lines, LineMerger())
+    assert res is not None
+    want = b"".join(scalar_frames(lines, LineMerger()))
+    assert res.block.data == want
+
+
+def test_timestamp_forms_device():
+    lines = [
+        b'<13>1 2023-09-20T12:35:45Z h a - - - integral seconds',
+        b'<13>1 2023-09-20T12:35:45.5Z h a - - - half',
+        b'<13>1 2023-09-20T12:35:45.123456789Z h a - - - nanos',
+        b'<13>1 2023-09-20T12:35:45.123+05:30 h a - - - offset',
+        b'<13>1 1970-01-01T00:00:00Z h a - - - epoch',
+    ] * 2
+    res, _ = run_device(lines, LineMerger())
+    assert res is not None
+    want = b"".join(scalar_frames(lines, LineMerger()))
+    assert res.block.data == want
+
+
+def test_device_fuzz_vs_scalar(monkeypatch):
+    monkeypatch.setattr(device_gelf, "FALLBACK_FRAC", 1.1)
+    rng = random.Random(42)
+    names = ["k", "key2", "a_longer_name", "x" * 9, "x" * 9 + "y",
+             "dup", "dup"]
+    msgs = ["hello", 'say "hi"', "tab\there", "", "-", "trail   ",
+            "back\\slash"]
+    lines = []
+    for _ in range(200):
+        pairs = " ".join(
+            f'{rng.choice(names)}="{rng.choice(msgs)}"'
+            for _ in range(rng.randint(0, 7)))
+        sd = f"[sd@1 {pairs}]" if pairs else rng.choice(["-", "[sd@1]"])
+        host = rng.choice(["host", "-", "h" * 40])
+        line = (f'<{rng.randint(0, 191)}>1 2023-09-20T12:35:45.'
+                f'{rng.randint(0, 999)}Z {host} app {rng.randint(1, 9)} '
+                f'MID {sd} {rng.choice(msgs)}')
+        lines.append(line.encode())
+    for merger in (LineMerger(), NulMerger()):
+        res, _ = run_device(lines, merger)
+        assert res is not None
+        want = b"".join(scalar_frames(lines, merger))
+        assert res.block.data == want
+
+
+def test_batch_handler_uses_device_engine():
+    tx = queue.Queue()
+    h = BatchHandler(tx, ORACLE, ENC, Config.from_string(""),
+                     fmt="rfc5424", start_timer=False, merger=LineMerger())
+    n0 = metrics.get("device_encode_rows")
+    for ln in CLEAN * 4:
+        h.handle_bytes(ln)
+    h.flush()
+    assert metrics.get("device_encode_rows") - n0 == len(CLEAN) * 4
+    items = []
+    while not tx.empty():
+        items.append(tx.get_nowait())
+    got = b"".join(i.data if isinstance(i, EncodedBlock) else i
+                   for i in items)
+    assert got == b"".join(scalar_frames(CLEAN * 4, LineMerger()))
+
+
+def test_device_disabled_by_env(monkeypatch):
+    monkeypatch.setenv("FLOWGGER_DEVICE_ENCODE", "0")
+    assert not device_gelf.route_ok(ENC, LineMerger())
+
+
+def test_decline_hysteresis():
+    bad = [b"not a syslog line"] * 20 + [CLEAN[0]]
+    packed = pack.pack_lines_2d(bad, 256)
+    state = {}
+    for _ in range(device_gelf.DECLINE_LIMIT):
+        handle = rfc5424.decode_rfc5424_submit(packed[0], packed[1])
+        res, _ = device_gelf.fetch_encode(handle, packed, ENC,
+                                          LineMerger(), state)
+        assert res is None
+    assert state["cooldown"] == device_gelf.COOLDOWN
+    # during cooldown the attempt is skipped outright (no kernel work)
+    n0 = metrics.get("device_encode_declined")
+    handle = rfc5424.decode_rfc5424_submit(packed[0], packed[1])
+    res, secs = device_gelf.fetch_encode(handle, packed, ENC,
+                                         LineMerger(), state)
+    assert res is None and secs == 0.0
+    assert metrics.get("device_encode_declined") == n0
+    assert state["cooldown"] == device_gelf.COOLDOWN - 1
