@@ -28,7 +28,7 @@ KNOWN_KEYS = {
     # TPU extensions
     "input.tpu_batch_size", "input.tpu_flush_ms", "input.tpu_max_line_len",
     "input.tpu_coordinator", "input.tpu_num_processes",
-    "input.tpu_process_id",
+    "input.tpu_process_id", "input.tpu_mesh", "input.tpu_sp",
     # [output] — per-output config sites
     "output.type", "output.format", "output.framing", "output.connect",
     "output.timeout", "output.file_path", "output.file_buffer_size",

@@ -1,4 +1,15 @@
-"""Device-mesh sharding for the columnar decoders."""
+"""Device-mesh sharding for the columnar decoders.
+
+Log decode is embarrassingly parallel over records (SURVEY.md §2.8), so
+the mesh carries two axes: ``dp`` shards batch rows across chips (ICI or
+DCN — no cross-record collectives exist on this path) and ``sp`` shards
+the packed byte axis of very long records inside a host.  Every format
+kernel (rfc5424 / ltsv / gelf / rfc3164 / the auto-detect classifier)
+shards the same way; ``ShardedDecode`` wraps the jitted sharded kernel
+together with the input placement (pad rows to a dp multiple, then
+``jax.device_put`` with the batch sharding) so the production
+BatchHandler can swap it in for the single-chip submit path.
+"""
 
 from __future__ import annotations
 
@@ -25,25 +36,86 @@ def make_decode_mesh(devices: Optional[Sequence] = None,
     return Mesh(arr, axis_names=("dp", "sp"))
 
 
-def make_sharded_decode_fn(mesh: Mesh, max_sd: int = rfc5424.DEFAULT_MAX_SD,
-                           max_pairs: int = rfc5424.DEFAULT_MAX_PAIRS):
-    """jit the columnar decoder over the mesh: rows over dp, bytes over
-    sp.  Outputs are row-sharded over dp (replicated over sp), ready for
-    a sharded columnar encode stage or host gather."""
+def _decode_body(fmt: str, **kw):
+    """The un-jitted decode body for one format, normalized to
+    ``fn(batch, lens, *extra)``."""
+    if fmt == "rfc5424":
+        return lambda b, ln: rfc5424.decode_rfc5424(
+            b, ln, max_sd=kw.get("max_sd", rfc5424.DEFAULT_MAX_SD),
+            max_pairs=kw.get("max_pairs", rfc5424.DEFAULT_MAX_PAIRS),
+            extract_impl=kw.get("extract_impl", "sum"))
+    if fmt == "ltsv":
+        from ..tpu import ltsv
+
+        return lambda b, ln: ltsv.decode_ltsv(
+            b, ln, max_parts=kw.get("max_parts", ltsv.DEFAULT_MAX_PARTS))
+    if fmt == "gelf":
+        from ..tpu import gelf
+
+        return lambda b, ln: gelf.decode_gelf(
+            b, ln, max_fields=kw.get("max_fields",
+                                     gelf.DEFAULT_MAX_FIELDS))
+    if fmt == "rfc3164":
+        from ..tpu import rfc3164
+
+        return lambda b, ln, year: rfc3164.decode_rfc3164(b, ln, year)
+    if fmt == "classify":
+        from ..tpu import autodetect
+
+        return autodetect.classify_device
+    raise ValueError(f"no sharded decode for format {fmt}")
+
+
+def make_sharded_decode_fn(mesh: Mesh, fmt: str = "rfc5424", **kw):
+    """jit one format's columnar decoder over the mesh: rows over dp,
+    bytes over sp.  Outputs are row-sharded over dp (replicated over
+    sp), ready for a sharded device-encode stage or host gather.
+    rfc3164's trailing ``year`` argument rides replicated."""
     batch_sharding = NamedSharding(mesh, P("dp", "sp"))
     lens_sharding = NamedSharding(mesh, P("dp"))
     out_sharding = NamedSharding(mesh, P("dp"))
+    body = _decode_body(fmt, **kw)
+    extra = (NamedSharding(mesh, P()),) if fmt == "rfc3164" else ()
 
-    @functools.partial(
-        jax.jit,
-        in_shardings=(batch_sharding, lens_sharding),
+    return jax.jit(
+        body,
+        in_shardings=(batch_sharding, lens_sharding) + extra,
         out_shardings=out_sharding,
     )
-    def fn(batch, lens):
-        return rfc5424.decode_rfc5424(batch, lens, max_sd=max_sd,
-                                      max_pairs=max_pairs)
 
-    return fn
+
+class ShardedDecode:
+    """A jitted sharded decode plus its input placement, pluggable into
+    the per-format ``decode_*_submit`` functions."""
+
+    def __init__(self, mesh: Mesh, fmt: str, **kw):
+        self.mesh = mesh
+        self.fmt = fmt
+        # the kernel parameters actually baked into the jitted fn —
+        # submit paths must record these in their handles, not their
+        # own arguments (rescue/encode stages trust the handle)
+        self.kw = dict(kw)
+        self.fn = make_sharded_decode_fn(mesh, fmt, **kw)
+        self.batch_sharding = NamedSharding(mesh, P("dp", "sp"))
+        self.lens_sharding = NamedSharding(mesh, P("dp"))
+        self.dp = mesh.shape["dp"]
+        self.sp = mesh.shape["sp"]
+
+    def put(self, batch, lens):
+        """Pad rows to a dp multiple (padding rows have len 0 and fall
+        outside ``n_real``) and place both arrays on the mesh."""
+        batch = np.asarray(batch)
+        lens = np.asarray(lens)
+        n, L = batch.shape
+        if L % self.sp:
+            raise ValueError(
+                f"packed width {L} not divisible by sp={self.sp}")
+        pad = (-n) % self.dp
+        if pad:
+            batch = np.pad(batch, ((0, pad), (0, 0)))
+            lens = np.pad(lens, (0, pad))
+        return (jax.device_put(batch, self.batch_sharding),
+                jax.device_put(lens, self.lens_sharding))
 
 
 def decode_sharded(mesh: Mesh, batch, lens):

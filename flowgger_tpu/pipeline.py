@@ -207,6 +207,13 @@ class Pipeline:
         from .utils import metrics as _metrics_mod
 
         _metrics_mod.configure_from(config)
+        if input_format in _TPU_FORMATS:
+            # multi-host: join the JAX process group before any device
+            # op so the decode mesh's dp axis can span every host's
+            # chips (no-op without the tpu_coordinator keys)
+            from .parallel.distributed import init_distributed
+
+            init_distributed(config)
 
     def handler_factory(self):
         if self.input_format in _TPU_FORMATS:

@@ -106,13 +106,14 @@ def _classify_device_jit(batch, lens):
     return _CLASSIFY_JIT(batch, lens)
 
 
-def classify_packed(packed) -> "np.ndarray":
+def classify_packed(packed, sharded=None) -> "np.ndarray":
     """First-bytes classification of the packed batch — the same
     decision table as ``classify`` with no per-line Python: the device
     kernel above for real batches, numpy host fallback for tiny or
     pathological geometries.  Rows longer than max_len are
     re-classified from their raw bytes (their tab/colon signature may
-    lie beyond the clip)."""
+    lie beyond the clip).  ``sharded`` (a ShardedDecode built for
+    "classify") spreads the kernel over the device mesh."""
     import numpy as np
 
     batch, lens, chunk, starts, orig_lens, n = packed
@@ -122,8 +123,12 @@ def classify_packed(packed) -> "np.ndarray":
     if L >= 19 and n >= 512:
         import jax.numpy as jnp
 
-        cls = np.asarray(_classify_device_jit(
-            jnp.asarray(batch[:n]), jnp.asarray(lens[:n]))).copy()
+        if sharded is not None:
+            cls = np.asarray(
+                sharded.fn(*sharded.put(batch[:n], lens[:n])))[:n].copy()
+        else:
+            cls = np.asarray(_classify_device_jit(
+                jnp.asarray(batch[:n]), jnp.asarray(lens[:n]))).copy()
         over = np.flatnonzero(np.asarray(orig_lens)[:n] > L)
         for i in over.tolist():
             s = int(np.asarray(starts)[i])
@@ -217,7 +222,7 @@ def decode_auto_batch(lines: List[bytes], max_len: int,
 
 
 def encode_auto_gelf_blocks(packed, encoder, merger, ltsv_decoder=None,
-                            route_state=None):
+                            route_state=None, sharded_for=None):
     """Block-encode a mixed batch: classify, submit every class's kernel
     (device work for independent classes overlaps via JAX async
     dispatch), run each class's columnar GELF route on its row subset,
@@ -243,7 +248,8 @@ def encode_auto_gelf_blocks(packed, encoder, merger, ltsv_decoder=None,
     suffix, syslen = spec
 
     n = packed[5]
-    classes = classify_packed(packed)
+    classes = classify_packed(
+        packed, sharded_for("classify") if sharded_for else None)
     submitted = []
     for cls, fmt in ((F_RFC5424, "rfc5424"), (F_RFC3164, "rfc3164"),
                      (F_LTSV, "ltsv"), (F_GELF, "gelf")):
@@ -251,7 +257,8 @@ def encode_auto_gelf_blocks(packed, encoder, merger, ltsv_decoder=None,
         if not idx.size:
             continue
         sub = packmod.subset_packed(packed, idx)
-        submitted.append((idx, fmt, sub, block_submit(fmt, sub)))
+        submitted.append((idx, fmt, sub, block_submit(
+            fmt, sub, sharded_for(fmt) if sharded_for else None)))
     legs = []
     for idx, fmt, sub, handle in submitted:
         res, _fetch_s, _declined_s = block_fetch_encode(

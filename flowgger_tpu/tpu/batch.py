@@ -82,6 +82,21 @@ class BatchHandler(Handler):
         # per-handler hysteresis for the device-encode route (declines /
         # cooldown counters owned here, updated by device_gelf)
         self._device_route_state: dict = {}
+        # multi-chip mesh: rows shard over dp, bytes over sp (SURVEY
+        # §2.8 mapping).  "auto" engages whenever more than one real
+        # device is visible; "on" also engages on the virtual CPU mesh
+        # (tests); "off" disables.
+        self._mesh = None
+        self._mesh_checked = False
+        self._sharded: dict = {}
+        self._mesh_mode = cfg.lookup_str(
+            "input.tpu_mesh", "input.tpu_mesh must be a string", "auto")
+        if self._mesh_mode not in ("auto", "on", "off"):
+            from ..config import ConfigError
+
+            raise ConfigError("input.tpu_mesh must be auto, on or off")
+        self._mesh_sp = cfg.lookup_int(
+            "input.tpu_sp", "input.tpu_sp must be an integer", 1)
         # direct span->bytes encodes for rfc5424 routes
         from ..encoders.gelf import GelfEncoder
         from ..encoders.ltsv import LTSVEncoder
@@ -205,6 +220,50 @@ class BatchHandler(Handler):
                     self._timer.daemon = True
                     self._timer.start()
 
+    # -- multi-chip mesh ---------------------------------------------------
+    def _sharded_for(self, fmt: str):
+        """Lazily build (and cache) the ShardedDecode for one format;
+        None when the mesh doesn't engage (single device, cpu backend in
+        "auto" mode, or tpu_mesh="off")."""
+        if self._mesh_mode == "off":
+            return None
+        if fmt in self._sharded:
+            return self._sharded[fmt]
+        sharded = None
+        try:
+            import jax
+
+            if not self._mesh_checked:
+                self._mesh_checked = True
+                if self.max_len % self._mesh_sp:
+                    raise ValueError(
+                        f"tpu_max_line_len {self.max_len} not divisible "
+                        f"by tpu_sp={self._mesh_sp}")
+                devs = jax.devices()
+                engage = len(devs) > 1 and (
+                    self._mesh_mode == "on"
+                    or jax.default_backend() != "cpu")
+                if engage:
+                    from ..parallel.mesh import make_decode_mesh
+
+                    self._mesh = make_decode_mesh(devs, sp=self._mesh_sp)
+            if self._mesh is not None:
+                from ..parallel.mesh import ShardedDecode
+                from .rfc5424 import best_extract_impl
+
+                kw = ({"extract_impl": best_extract_impl()}
+                      if fmt == "rfc5424" else {})
+                sharded = ShardedDecode(self._mesh, fmt, **kw)
+                _metrics.inc("sharded_kernels")
+        except ValueError as e:
+            # e.g. device count not divisible by tpu_sp: surface once,
+            # run single-device rather than dying mid-stream
+            print(f"tpu_mesh disabled: {e}", file=sys.stderr)
+            self._mesh_mode = "off"
+            return None
+        self._sharded[fmt] = sharded
+        return sharded
+
     # -- batched decode ----------------------------------------------------
     @staticmethod
     def _auto_ltsv_decoder(config):
@@ -316,7 +375,8 @@ class BatchHandler(Handler):
                 # time; defer everything (no cross-batch overlap here)
                 self._inflight.append((None, packed))
                 return
-            self._inflight.append((block_submit(self.fmt, packed), packed))
+            self._inflight.append((block_submit(
+                self.fmt, packed, self._sharded_for(self.fmt)), packed))
             return
         from ..encoders.gelf import GelfEncoder
         from ..encoders.passthrough import PassthroughEncoder
@@ -344,7 +404,8 @@ class BatchHandler(Handler):
 
             res = encode_auto_gelf_blocks(packed, self.encoder,
                                           self._merger, self._auto_ltsv,
-                                          self._device_route_state)
+                                          self._device_route_state,
+                                          self._sharded_for)
             if res is None:
                 self._emit(decode_auto_packed(packed, self.max_len,
                                               self._auto_ltsv))
@@ -443,24 +504,26 @@ class BatchHandler(Handler):
             self.tx.put(encoded)
 
 
-def block_submit(fmt, packed):
+def block_submit(fmt, packed, sharded=None):
     """Dispatch one packed tuple's kernel asynchronously (JAX futures);
-    pair with block_fetch_encode."""
+    pair with block_fetch_encode.  ``sharded`` (parallel.mesh.
+    ShardedDecode) swaps in the multi-chip mesh kernel."""
     if fmt == "rfc3164":
         from . import rfc3164
 
-        return rfc3164.decode_rfc3164_submit(packed[0], packed[1])
+        return rfc3164.decode_rfc3164_submit(packed[0], packed[1], sharded)
     if fmt == "ltsv":
         from . import ltsv
 
-        return ltsv.decode_ltsv_submit(packed[0], packed[1])
+        return ltsv.decode_ltsv_submit(packed[0], packed[1], sharded)
     if fmt == "gelf":
         from . import gelf
 
-        return gelf.decode_gelf_submit(packed[0], packed[1])
+        return gelf.decode_gelf_submit(packed[0], packed[1], sharded)
     from . import rfc5424
 
-    return rfc5424.decode_rfc5424_submit(packed[0], packed[1])
+    return rfc5424.decode_rfc5424_submit(packed[0], packed[1],
+                                         sharded=sharded)
 
 
 def block_fetch_encode(fmt, handle, packed, encoder, merger,

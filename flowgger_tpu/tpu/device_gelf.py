@@ -432,7 +432,9 @@ def fetch_encode(handle, packed, encoder, merger, route_state=None):
         route_state["cooldown"] -= 1
         return None, 0.0
 
-    N = batch.shape[0]
+    # size the per-row inputs from the *device* batch: a sharded submit
+    # may have row-padded it to a dp multiple beyond the host batch
+    N = batch_dev.shape[0]
     impl = best_scan_impl()
     empty_ts = jnp.zeros((N, 0), dtype=jnp.uint8)
     full_ts_len = jnp.full((N,), TS_W, dtype=jnp.int32)

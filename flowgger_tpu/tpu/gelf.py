@@ -269,12 +269,16 @@ def decode_gelf(batch: jnp.ndarray, lens: jnp.ndarray,
     }
 
 
-def decode_gelf_submit(batch, lens):
+def decode_gelf_submit(batch, lens, sharded=None):
     """Asynchronous dispatch (pair with decode_gelf_fetch) — the gelf
-    leg of the block pipeline's double buffering."""
+    leg of the block pipeline's double buffering.  ``sharded`` swaps in
+    the multi-chip mesh kernel (parallel.mesh.ShardedDecode)."""
     import jax.numpy as jnp
 
-    out = decode_gelf_jit(jnp.asarray(batch), jnp.asarray(lens))
+    if sharded is not None:
+        out = sharded.fn(*sharded.put(batch, lens))
+    else:
+        out = decode_gelf_jit(jnp.asarray(batch), jnp.asarray(lens))
     return (out, batch, lens)
 
 

@@ -260,11 +260,14 @@ def decode_ltsv_jit(batch, lens, max_parts=DEFAULT_MAX_PARTS):
     return decode_ltsv(batch, lens, max_parts=max_parts)
 
 
-def decode_ltsv_submit(batch, lens):
+def decode_ltsv_submit(batch, lens, sharded=None):
     """Asynchronous dispatch (pair with decode_ltsv_fetch) — the ltsv
-    leg of the block pipeline's double buffering."""
+    leg of the block pipeline's double buffering.  ``sharded`` swaps in
+    the multi-chip mesh kernel (parallel.mesh.ShardedDecode)."""
     import jax.numpy as jnp
 
+    if sharded is not None:
+        return sharded.fn(*sharded.put(batch, lens))
     return decode_ltsv_jit(jnp.asarray(batch), jnp.asarray(lens))
 
 

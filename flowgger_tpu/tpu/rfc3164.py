@@ -210,13 +210,18 @@ def decode_rfc3164_jit(batch, lens, year):
     return decode_rfc3164(batch, lens, year)
 
 
-def decode_rfc3164_submit(batch, lens):
+def decode_rfc3164_submit(batch, lens, sharded=None):
     """Asynchronous dispatch (pair with decode_rfc3164_fetch) — the
-    rfc3164 leg of the block pipeline's double buffering."""
+    rfc3164 leg of the block pipeline's double buffering.  ``sharded``
+    swaps in the multi-chip mesh kernel (parallel.mesh.ShardedDecode);
+    the year scalar rides replicated."""
     import jax.numpy as jnp
 
     from ..utils.timeparse import current_year_utc
 
+    if sharded is not None:
+        b, ln = sharded.put(batch, lens)
+        return sharded.fn(b, ln, jnp.int32(current_year_utc()))
     return decode_rfc3164_jit(jnp.asarray(batch), jnp.asarray(lens),
                               jnp.int32(current_year_utc()))
 

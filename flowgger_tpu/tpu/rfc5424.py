@@ -811,15 +811,25 @@ _PAIR_KEYS = ("name_start", "name_end", "val_start", "val_end",
 
 
 def decode_rfc5424_submit(batch, lens, max_sd: int = DEFAULT_MAX_SD,
-                          extract_impl: str = None):
+                          extract_impl: str = None, sharded=None):
     """Dispatch the kernel asynchronously (JAX returns futures); pair
     with ``decode_rfc5424_fetch``.  Splitting submit from fetch lets the
     batch pipeline overlap device decode of batch N with host encoding
-    of batch N-1 (double buffering)."""
+    of batch N-1 (double buffering).  ``sharded`` (a
+    parallel.mesh.ShardedDecode) swaps in the multi-chip mesh kernel."""
     impl = extract_impl or best_extract_impl()
-    batch_dev, lens_dev = jnp.asarray(batch), jnp.asarray(lens)
-    out = decode_rfc5424_jit(batch_dev, lens_dev,
-                             max_sd=max_sd, extract_impl=impl)
+    if sharded is not None:
+        # the sharded fn was jitted with its own kernel params; the
+        # handle must reflect those (rescue and device-encode stages
+        # size their work from the handle's max_sd/impl)
+        max_sd = sharded.kw.get("max_sd", DEFAULT_MAX_SD)
+        impl = sharded.kw.get("extract_impl", "sum")
+        batch_dev, lens_dev = sharded.put(batch, lens)
+        out = sharded.fn(batch_dev, lens_dev)
+    else:
+        batch_dev, lens_dev = jnp.asarray(batch), jnp.asarray(lens)
+        out = decode_rfc5424_jit(batch_dev, lens_dev,
+                                 max_sd=max_sd, extract_impl=impl)
     # the handle keeps the original *host* arrays (rescue_refetch slices
     # them without a device round-trip) plus the uploaded *device*
     # arrays so downstream device-side stages (tpu/device_gelf.py) can
