@@ -97,6 +97,10 @@ class BatchHandler(Handler):
             raise ConfigError("input.tpu_mesh must be auto, on or off")
         self._mesh_sp = cfg.lookup_int(
             "input.tpu_sp", "input.tpu_sp must be an integer", 1)
+        if self._mesh_sp < 1:
+            from ..config import ConfigError
+
+            raise ConfigError("input.tpu_sp must be >= 1")
         # direct span->bytes encodes for rfc5424 routes
         from ..encoders.gelf import GelfEncoder
         from ..encoders.ltsv import LTSVEncoder
@@ -239,7 +243,15 @@ class BatchHandler(Handler):
                     raise ValueError(
                         f"tpu_max_line_len {self.max_len} not divisible "
                         f"by tpu_sp={self._mesh_sp}")
-                devs = jax.devices()
+                # Multi-host: decode is embarrassingly parallel over
+                # records, so each host shards only its OWN ingest
+                # stream across its OWN chips (dp within host).  A
+                # global-device mesh would device_put host-local batches
+                # with a global sharding — rows outside this host's
+                # addressable shard would be dropped and fetches would
+                # crash on non-addressable arrays.
+                devs = (jax.local_devices() if jax.process_count() > 1
+                        else jax.devices())
                 engage = len(devs) > 1 and (
                     self._mesh_mode == "on"
                     or jax.default_backend() != "cpu")

@@ -283,3 +283,56 @@ def test_mesh_indivisible_max_len_disables_not_dies():
                      fmt="rfc5424", start_timer=False, merger=LineMerger())
     assert h._sharded_for("rfc5424") is None
     assert h._mesh_mode == "off"
+
+
+def test_tpu_sp_zero_is_config_error():
+    """tpu_sp = 0 must fail at construction (ConfigError naming the
+    key), not as a ZeroDivisionError at the first flush."""
+    import queue as queue_mod
+
+    from flowgger_tpu.decoders.rfc5424 import RFC5424Decoder
+    from flowgger_tpu.encoders.gelf import GelfEncoder
+    from flowgger_tpu.tpu.batch import BatchHandler
+
+    with pytest.raises(ConfigError, match="tpu_sp"):
+        BatchHandler(queue_mod.Queue(), RFC5424Decoder(),
+                     GelfEncoder(Config.from_string("")),
+                     Config.from_string('[input]\ntpu_sp = 0\n'),
+                     fmt="rfc5424", start_timer=False)
+
+
+def test_sharded_put_reuses_placement():
+    """put() called twice with the same host arrays must reuse the
+    first device placement (no second upload)."""
+    batch, lens, *_ = _packed_corpus()
+    m = mesh_mod.make_decode_mesh(jax.devices(), sp=1)
+    dec = mesh_mod.ShardedDecode(m, "rfc5424")
+    a1, l1 = dec.put(batch, lens)
+    a2, l2 = dec.put(batch, lens)
+    assert a1 is a2 and l1 is l2
+    other = batch.copy()
+    a3, _ = dec.put(other, lens)
+    assert a3 is not a1
+
+
+def test_multiprocess_mesh_uses_local_devices(monkeypatch):
+    """When jax.process_count() > 1 the production handler must build
+    its mesh from local devices only: a global mesh would device_put
+    host-local rows with a non-addressable sharding (ADVICE r3)."""
+    import queue as queue_mod
+
+    from flowgger_tpu.decoders.rfc5424 import RFC5424Decoder
+    from flowgger_tpu.encoders.gelf import GelfEncoder
+    from flowgger_tpu.mergers import LineMerger
+    from flowgger_tpu.tpu.batch import BatchHandler
+
+    local = jax.devices()[:4]
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(jax, "local_devices", lambda: local)
+    h = BatchHandler(queue_mod.Queue(), RFC5424Decoder(),
+                     GelfEncoder(Config.from_string("")),
+                     Config.from_string('[input]\ntpu_mesh = "on"\n'),
+                     fmt="rfc5424", start_timer=False, merger=LineMerger())
+    assert h._sharded_for("rfc5424") is not None
+    assert h._mesh.shape == {"dp": 4, "sp": 1}
+    assert set(h._mesh.devices.flat) == set(local)
