@@ -109,6 +109,11 @@ def _load():
             lib.fg_gelf_write_v2.argtypes = common + [ctypes.c_void_p,
                                                    ctypes.c_void_p,
                                                    ctypes.c_int]
+        if hasattr(lib, "fg_format_f64_json"):
+            lib.fg_format_f64_json.restype = None
+            lib.fg_format_f64_json.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+                ctypes.c_int32, ctypes.c_void_p, ctypes.c_int]
         _lib = lib
         return _lib
 
@@ -162,6 +167,25 @@ def pack_chunk_native(chunk: bytes, starts: np.ndarray, lens: np.ndarray,
             max_len, batch.ctypes.data, lens_out.ctypes.data,
             _DEFAULT_THREADS)
     return batch, lens_out
+
+
+def format_f64_json_native(vals: np.ndarray, width: int
+                           ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """serde_json-style text for a f64 vector: dense [n, width] u8 rows
+    (zero-padded) + per-row lengths, via the threaded native shortest-
+    round-trip formatter (exact json_f64 semantics; differential-fuzzed
+    in tests/test_native_and_chunks.py).  None when the library is unavailable."""
+    lib = _load()
+    if lib is None or not hasattr(lib, "fg_format_f64_json"):
+        return None
+    vals = np.ascontiguousarray(vals, dtype=np.float64)
+    n = vals.size
+    txt = np.empty((n, width), dtype=np.uint8)
+    lens = np.empty(n, dtype=np.int32)
+    if n:
+        lib.fg_format_f64_json(vals.ctypes.data, n, txt.ctypes.data,
+                               width, lens.ctypes.data, _DEFAULT_THREADS)
+    return txt, lens
 
 
 _CRC32C_TABLE = None

@@ -106,7 +106,14 @@ class ShardedDecode:
         """Pad rows to a dp multiple (padding rows have len 0 and fall
         outside ``n_real``) and place both arrays on the mesh.  Repeat
         calls with the *same* host arrays (dryrun, rescue paths) reuse
-        the first placement instead of re-padding + re-uploading."""
+        the first placement instead of re-padding + re-uploading.
+
+        Contract: the cache keys on object identity, so callers must
+        treat a batch passed to put() as frozen — mutating it in place
+        and re-putting would decode the stale device copy.  Every
+        packer allocates fresh arrays per batch; a future pooled-buffer
+        packer must copy (or bypass the sharded path) instead of
+        rewriting a previously-put array."""
         if self._put_cache is not None:
             cb, cl, placed = self._put_cache
             if cb is batch and cl is lens:

@@ -1,9 +1,10 @@
 """Device-side RFC5424→GELF encode: the kernel emits the *final framed
 output bytes* as one dense ``[N, OW]`` byte matrix plus a length vector,
-so the host fetches output-sized data instead of ~24 span channels and
-does nothing but row compaction (the reference fuses decode→encode per
-line in its hot loop, line_splitter.rs:44-54 → gelf_encoder.rs:59-115 —
-this is the batched-TPU shape of that fusion).
+then compacts the tier rows on-device (``_compact_kernel``) so the host
+fetch is ~``sum(out_len)`` bytes — truly output-sized — instead of
+~24 span channels or the padded matrix (the reference fuses
+decode→encode per line in its hot loop, line_splitter.rs:44-54 →
+gelf_encoder.rs:59-115 — this is the batched-TPU shape of that fusion).
 
 Everything is gather-free (the environment's recorded XLA-on-TPU fact:
 dynamic gathers lower near-serially — never gather):
@@ -363,6 +364,62 @@ def _encode_kernel(batch, lens, dec, ts_text, ts_len, *, suffix: bytes,
     return acc, out_len, tier
 
 
+COMPACT_G = 32   # group granularity (bytes) of on-device row compaction
+# skip compaction when padded size is within this factor of the real
+# output (the extra device passes would not pay for the smaller fetch)
+COMPACT_MIN_SAVING = 1.15
+
+
+@partial(jax.jit, static_argnames=("G",))
+def _compact_kernel(acc, out_len, tier, *, G: int = COMPACT_G):
+    """Row compaction on device: pack the tier rows' output bytes into a
+    contiguous group-aligned buffer so the host fetches ~sum(out_len)
+    bytes instead of the padded ``[N, OW]`` matrix.
+
+    Rows are already left-aligned, so compaction is a pure left-shift of
+    whole G-byte groups: row i's ``ceil(len/G)`` leading groups move to
+    group offset ``base[i] = sum_j<i ceil(len_j/G)``.  The per-group
+    shift ``i*(OW/G) - base[i]`` is row-constant and nondecreasing, and
+    destinations are strictly increasing, so an LSB-first barrel shifter
+    is collision-free: after applying bits 0..k, two valid groups a < b
+    satisfy ``p_b - p_a = (b-a) - ((s_b&m)-(s_a&m)) >= (b-a)-(s_b-s_a)
+    >= 1`` (low-bit differences never exceed the full difference when
+    the high bits are monotone).  Non-tier and padding groups are zeroed
+    and stay put (shift 0); moving groups OR over them harmlessly.
+
+    Returns the flat byte buffer; the host slices the first
+    ``sum(ceil(gated_len/G))*G`` bytes (it recomputes base from the
+    fetched lengths with the same integer math)."""
+    N, OW = acc.shape
+    assert OW % G == 0
+    ngr = OW // G
+    gated = jnp.where(tier, out_len, 0)
+    used = (gated + (G - 1)) // G                          # [N]
+    base = jnp.cumsum(used) - used                         # exclusive
+    gi = jax.lax.broadcasted_iota(_I32, (N, ngr), 1)
+    row = jax.lax.broadcasted_iota(_I32, (N, ngr), 0)
+    valid = gi < used[:, None]
+    shift = jnp.where(valid, row * ngr - base[:, None], 0).reshape(-1)
+    x = jnp.where(valid.reshape(-1)[:, None], acc.reshape(N * ngr, G),
+                  jnp.uint8(0))
+    s = shift
+    T = N * ngr
+    for k in range(max(T - 1, 1).bit_length()):
+        d = 1 << k
+        if d >= T:
+            break
+        mv = ((s >> k) & 1) == 1
+        xm = jnp.where(mv[:, None], x, jnp.uint8(0))
+        sm = jnp.where(mv, s - d, 0)
+        x = jnp.where(mv[:, None], jnp.uint8(0), x)
+        s = jnp.where(mv, 0, s)
+        x = x | jnp.concatenate(
+            [xm[d:], jnp.zeros((d, G), jnp.uint8)], axis=0)
+        s = s + jnp.concatenate(
+            [sm[d:], jnp.zeros((d,), s.dtype)], axis=0)
+    return x.reshape(-1)
+
+
 def route_ok(encoder, merger) -> bool:
     """Device encode applies to GELF output without extras over line/nul
     framing (syslen's variable-width prefix stays on the host tiers)."""
@@ -391,13 +448,21 @@ COOLDOWN = 16
 
 
 def _ts_text_block(small: Dict[str, np.ndarray]):
-    """Format per-row timestamp digits host-side, deduplicated (repetitive
-    streams share few distinct stamps; json_f64 is the only per-value
-    Python work left on this route)."""
+    """Format per-row timestamp digits host-side.  The native threaded
+    formatter (fg_format_f64_json: to_chars shortest round-trip,
+    json_f64 notation — differentially fuzzed in
+    tests/test_native_and_chunks.py) handles near-unique real-stream
+    stamps at full rate; without the library, fall back to dedup +
+    per-unique json_f64 (only fast for repetitive streams)."""
+    from .. import native
+
     okh = small["ok"].astype(bool)
     masked = {k: np.where(okh, small[k], 0)
               for k in ("days", "sod", "off", "nanos")}
     ts_vals = compute_ts(masked)
+    res = native.format_f64_json_native(ts_vals, TS_W)
+    if res is not None:
+        return res
     uniq, inv = np.unique(ts_vals, return_inverse=True)
     txt = np.zeros((uniq.size, TS_W), dtype=np.uint8)
     ulen = np.zeros(uniq.size, dtype=np.int32)
@@ -443,9 +508,17 @@ def fetch_encode(handle, packed, encoder, merger, route_state=None):
                            impl=impl, assemble=False)
 
     t_fetch = 0.0
-    t0 = _time.perf_counter()
-    tier1_np = np.asarray(tier1)[:n]
-    t_fetch += _time.perf_counter() - t0
+    fetched = [0]
+
+    def _fetch(arr):
+        nonlocal t_fetch
+        t0 = _time.perf_counter()
+        h = np.asarray(arr)
+        t_fetch += _time.perf_counter() - t0
+        fetched[0] += h.nbytes
+        return h
+
+    tier1_np = _fetch(tier1)[:n]
 
     starts64 = np.asarray(starts[:n], dtype=np.int64)
     lens64 = np.asarray(orig_lens[:n], dtype=np.int64)
@@ -454,6 +527,7 @@ def fetch_encode(handle, packed, encoder, merger, route_state=None):
 
     if n and (1.0 - cand1.mean()) > FALLBACK_FRAC:
         _metrics.inc("device_encode_declined")
+        _metrics.inc("device_encode_fetch_bytes", fetched[0])
         if route_state is not None:
             route_state["declines"] = route_state.get("declines", 0) + 1
             if route_state["declines"] >= DECLINE_LIMIT:
@@ -463,10 +537,8 @@ def fetch_encode(handle, packed, encoder, merger, route_state=None):
     if route_state is not None:
         route_state["declines"] = 0
 
-    t0 = _time.perf_counter()
-    small = {k: np.asarray(out[k]) for k in ("ok", "days", "sod", "off",
-                                             "nanos")}
-    t_fetch += _time.perf_counter() - t0
+    small = {k: _fetch(out[k]) for k in ("ok", "days", "sod", "off",
+                                         "nanos")}
 
     ts_text, ts_len = _ts_text_block(small)
     acc, out_len, tier = _encode_kernel(
@@ -474,21 +546,46 @@ def fetch_encode(handle, packed, encoder, merger, route_state=None):
         jnp.asarray(ts_len), suffix=suffix, max_sd=max_sd,
         impl=impl)
 
-    t0 = _time.perf_counter()
-    tier_np = np.asarray(tier)[:n]
-    t_fetch += _time.perf_counter() - t0
+    # full-N fetches (tiny): the host must recompute the compaction
+    # layout with the exact integer math the device used, including any
+    # dp-padding rows beyond n
+    tier_full = _fetch(tier)
+    len_full = _fetch(out_len).astype(np.int64)
+    tier_np = tier_full[:n]
+    len_np = len_full[:n]
 
     # the real (shorter) timestamp text can only widen the tier vs the
     # pessimistic phase-1 gate; cand stays the decision set either way
     cand = tier_np & (lens64 <= max_len)
-
-    t0 = _time.perf_counter()
-    out_np = np.asarray(acc)[:n]
-    len_np = np.asarray(out_len)[:n].astype(np.int64)
-    t_fetch += _time.perf_counter() - t0
-
     ridx = np.flatnonzero(cand)
-    if ridx.size:
+
+    N, OW = acc.shape
+    G = COMPACT_G
+    gated = np.where(tier_full, len_full, 0)
+    total_bytes = int(gated.sum())
+    if (total_bytes and ridx.size
+            and N * OW > total_bytes * COMPACT_MIN_SAVING):
+        # device-side row compaction: D2H ≈ sum(out_len), G-aligned
+        flat = _compact_kernel(acc, out_len, tier)
+        used = (gated + (G - 1)) // G
+        base = np.cumsum(used) - used
+        total_groups = int(used.sum())
+        comp = _fetch(flat[: total_groups * G]).reshape(-1, G)
+        if ridx.size:
+            u = used[ridx]
+            ucum = np.cumsum(u) - u
+            pos = np.arange(int(u.sum()), dtype=np.int64) \
+                - np.repeat(ucum, u)
+            gidx = np.repeat(base[ridx], u) + pos
+            gv = np.minimum(G, np.repeat(len_np[ridx], u) - pos * G)
+            grp = comp[gidx]
+            final_buf = grp[np.arange(G)[None, :] < gv[:, None]].tobytes()
+            row_off = exclusive_cumsum(len_np[ridx])
+        else:
+            final_buf = b""
+            row_off = np.zeros(1, dtype=np.int64)
+    elif ridx.size:
+        out_np = _fetch(acc)[:n]
         rows = out_np[ridx]
         m = np.arange(rows.shape[1])[None, :] < len_np[ridx, None]
         final_buf = rows[m].tobytes()
@@ -499,6 +596,8 @@ def fetch_encode(handle, packed, encoder, merger, route_state=None):
 
     _metrics.inc("device_encode_rows", int(ridx.size))
     _metrics.inc("device_encode_scalar_rows", int(n - ridx.size))
+    _metrics.inc("device_encode_fetch_bytes", fetched[0])
+    _metrics.inc("device_encode_out_bytes", len(final_buf))
     res = finish_block(chunk, starts64, lens64, n, cand, ridx, final_buf,
                        row_off, None, suffix, False, merger, encoder)
     return res, t_fetch

@@ -282,16 +282,25 @@ def _slot_geometry(L: int):
     return slot_bits, slots, (1 << slot_bits) - 1
 
 
-def extract_by_ord(mask, ord_, value, K, fill, extract_impl="sum"):
+def extract_by_ord(mask, ord_, value, K, fill, extract_impl="sum",
+                   slot_bits=None):
     """out[n, k] = ``value`` at the position with ordinal k+1 (masked),
     else ``fill``.  The ordinal channel must hit each ordinal at most
     once per row.  Shared by every format kernel.
 
     - ``"sum"``: bit-packed masked sums — few wide passes, no scatter;
       the TPU path (XLA:TPU lowers scatter/gather near-serially);
-    - ``"scatter"``: one scatter-min per channel — the CPU path."""
+    - ``"scatter"``: one scatter-min per channel — the CPU path.
+
+    ``slot_bits`` overrides the position-sized slot geometry when the
+    caller packs several small fields into one value (fewer slots per
+    word, but fewer reduction words for the channel group overall)."""
     N, L = mask.shape
-    slot_bits, slots, slot_mask = _slot_geometry(L)
+    if slot_bits is None:
+        slot_bits, slots, slot_mask = _slot_geometry(L)
+    else:
+        slots = max(1, 30 // slot_bits)
+        slot_mask = (1 << slot_bits) - 1
     if extract_impl == "scatter":
         # ord_ may be parity-derived and go negative before its zone;
         # gate on >= 1 so .at[] never wraps a negative column index
@@ -517,13 +526,16 @@ def decode_rfc5424(batch: jnp.ndarray, lens: jnp.ndarray,
     in_frac = in_ts & (rd >= 0) & (rd < frac_len[:, None])
     nanos = jnp.sum(jnp.where(in_frac, dig * w_frac, 0), axis=1)
 
-    # offset zone at r2 = r - opos; word3 packs its digits and the
-    # remaining single-position flags:
+    # offset zone at r2 = r - opos; word3 packs its digits, the
+    # remaining single-position flags, and (for the common L <= 1023
+    # geometry) the high-byte count that used to be its own reduction:
     # oh[0:7] om[7:14] zulu[14] plus[15] minus[16] dash[17] sd_open[18]
+    # high_count[19:29]
     opos = jnp.where(has_frac, 20 + frac_len, 19)
     r2 = r - opos[:, None]
     at_off = in_ts & (r2 == 0)
     at_rest = iota == rest_s[:, None]
+    pack_high = L <= 1023  # count <= L must fit bits [19:29)
     w3 = (
         dz * ((r2 == 1) * 10 + (r2 == 2))
         + (dz * ((r2 == 4) * 10 + (r2 == 5)) << 7)
@@ -533,6 +545,8 @@ def decode_rfc5424(batch: jnp.ndarray, lens: jnp.ndarray,
         + (jnp.where(at_rest & (bb == ord("-")), 1, 0) << 17)
         + (jnp.where(at_rest & (bb == ord("[")), 1, 0) << 18)
     )
+    if pack_high:
+        w3 = w3 + (jnp.where((bb >= 128) & valid, 1, 0) << 19)
     word3 = jnp.sum(w3, axis=1)
     oh = word3 & 0x7F
     om = (word3 >> 7) & 0x7F
@@ -594,19 +608,6 @@ def decode_rfc5424(batch: jnp.ndarray, lens: jnp.ndarray,
         + ((next_bb == 32) & next_valid).astype(_I32) * 4
     )
 
-    # SD terminator for the pair-ordinal zone, found WITHOUT the bracket
-    # chain (so the open/close-quote ordinals can ride the same scan as
-    # the bracket ordinals): the first structural ']' followed by a space
-    # or EOL.  On rows that pass the chain checks below this equals the
-    # chain-walk sd_end (every earlier chain ']' is followed by '[');
-    # rows where they differ always fail those checks and fall back.
-    term_mask = rbrack & (((next_bb == 32) & next_valid)
-                          | (iota == lens[:, None] - 1))
-    sd_end_zone = _min_where(term_mask, iota, L)
-    zone_c = in_rest & (iota <= sd_end_zone[:, None]) & is_sd[:, None]
-    oq_mask = open_q & zone_c
-    cq_mask = close_q & zone_c
-
     # ---- stage C scan: bracket + pair ordinals ---------------------------
     # brackets need a real scan (their mask depends on quote parity), but
     # open/close-quote ordinals come free from the stage-B parity: zone
@@ -618,9 +619,30 @@ def decode_rfc5424(batch: jnp.ndarray, lens: jnp.ndarray,
     (rb_ord,) = _scan_ordinals([rbrack], scan_impl)
     oq_ord = (q_excl >> 1) + 1
     cq_ord = (q_excl + 1) >> 1
-    rb_pos = _extract(rbrack, rb_ord, iota, max_sd + 1, L)
-    rb_flags = _extract(rbrack, rb_ord, rb_payload, max_sd + 1, 0)
+    # pos and payload flags ride one packed value (pos<<3 | flags, 12-bit
+    # slots): 3 reduction words for the ']' chain instead of 2+2
+    rb_sb = (((L << 3) | 7) + 1).bit_length()
+    rb_word = extract_by_ord(rbrack, rb_ord, (iota << 3) | rb_payload,
+                             max_sd + 1, L << 3, extract_impl,
+                             slot_bits=rb_sb)
+    rb_pos = rb_word >> 3
+    rb_flags = rb_word & 7
     rb_found = rb_pos < L
+
+    # SD terminator for the pair-ordinal zone, derived from the
+    # extracted ']' columns instead of a dedicated [N, L] min-reduction:
+    # the first structural ']' followed by a space or EOL.  On rows that
+    # pass the chain checks below this equals the chain-walk sd_end
+    # (every earlier chain ']' is followed by '[').  Rows whose first
+    # terminator lies beyond the max_sd+1 extracted brackets always fail
+    # the sd_count / end-flags checks below and fall back, so the
+    # truncated view never changes an accepted row's zone.
+    term_col = rb_found & (((rb_flags & 4) != 0)
+                           | (rb_pos == (lens - 1)[:, None]))
+    sd_end_zone = jnp.min(jnp.where(term_col, rb_pos, L), axis=1)
+    zone_c = in_rest & (iota <= sd_end_zone[:, None]) & is_sd[:, None]
+    oq_mask = open_q & zone_c
+    cq_mask = close_q & zone_c
 
     # running AND over the (small, static) block axis
     chain_alive = ((rb_flags[:, :max_sd] & 2) != 0) & rb_found[:, :max_sd]
@@ -653,11 +675,18 @@ def decode_rfc5424(batch: jnp.ndarray, lens: jnp.ndarray,
     ok &= jnp.where(is_sd,
                     jnp.where(blk_idx_valid, rb_legal, True).all(axis=1), True)
 
-    # sd_id span per block: blk_start+1 .. first space (must precede ']')
+    # sd_id span per block: blk_start+1 .. first space (must precede ']').
+    # The first space of block k is the only structural space there not
+    # preceded by a close quote or another space, and its inclusive
+    # bracket ordinal is k-1 — so all max_sd sid_end channels come out
+    # of one packed-sum extraction instead of per-block [N, L]
+    # min-reductions.  Multi-hit ordinals only occur on rows already
+    # flagged by the name-run violations above (they fall back), where
+    # the old per-block first-space answer was equally meaningless.
     sid_start = blk_start + 1
-    sid_end = jnp.stack(
-        [_min_where(is_sp & (iota >= sid_start[:, k:k + 1]), iota, L)
-         for k in range(max_sd)], axis=1)
+    prev_sp = _shift_right(is_sp, 1, False)
+    sid_sp_mask = is_sp & outside & zone_c & ~prev_closeq & ~prev_sp
+    sid_end = _extract(sid_sp_mask, rb_ord + 1, iota, max_sd, L)
     ok &= jnp.where(is_sd,
                     jnp.where(blk_idx_valid, sid_end < blk_rb, True).all(axis=1),
                     True)
@@ -675,9 +704,15 @@ def decode_rfc5424(batch: jnp.ndarray, lens: jnp.ndarray,
     # structural rules the parity model needs checked explicitly:
     viol2d |= open_q & sd_zone & (prev_bb != ord("="))
     name_struct = is_name & (bb != 32) & outside & in_pair
+    prev_name = _shift_right(name_struct, 1, False)
     next_name = _shift_left(name_struct, 1, False)
+    ns_mask = name_struct & ~prev_name        # name-run starts
     name_run_end = name_struct & ~next_name
     viol2d |= name_run_end & (next_bb != ord("="))
+    # a pair name must be preceded by a space (the sd_id terminator or
+    # the separator after the previous pair's close quote) — the byte
+    # the old per-pair lookback checked
+    viol2d |= ns_mask & (prev_bb != 32)
     eq_struct = (bb == ord("=")) & outside & in_pair
     next_open = _shift_left(open_q & in_pair, 1, False)
     viol2d |= eq_struct & ~next_open
@@ -703,35 +738,22 @@ def decode_rfc5424(batch: jnp.ndarray, lens: jnp.ndarray,
     pair_valid = (jnp.arange(max_pairs, dtype=_I32)[None, :]
                   < pair_count[:, None])
 
-    # name lookback: the last non-name byte before each pair's '=' used
-    # to ride a full-width cummax of pos<<8|byte — the costliest scan
-    # left in the kernel (~25ms per [1M,256] channel on v5e).  The value
-    # is only ever consumed at the <= max_pairs open quotes, so it is now
-    # max_pairs fused masked max-reductions keyed on the extracted
-    # oq_pos: lnn_k = max(pos<<8|byte over non-name positions <=
-    # oq_pos[k]-2).  Sibling reductions share one traversal of the byte
-    # plane after XLA fusion, so this costs ~one pass instead of a scan.
-    # (The pair region's lower bound — the sd_id space — and the block
-    # ']' are both non-name, so the lookback can never escape its pair's
-    # region; in_pair gating is redundant here, exactly as it was for the
-    # cummax channel.)
-    nn = ~(is_name & outside)
-    nn_src = jnp.where(nn, (iota << 8) | bb.astype(_I32), -1)
-    lnn = jnp.stack(
-        [jnp.max(jnp.where(iota <= oq_pos[:, k:k + 1] - 2, nn_src, -1),
-                 axis=1)
-         for k in range(max_pairs)], axis=1)
-    lnn_pos = jnp.where(lnn >= 0, lnn >> 8, -1)
-    lnn_ch = jnp.where(lnn >= 0, lnn & 0xFF, -1)
-    oq_name_start = jnp.where(pair_valid, lnn_pos + 1, 0)
+    # name starts: a name-run start's pair index IS the parity-derived
+    # open-quote ordinal (2(k-1) zone quotes precede pair k's name, and
+    # no quote sits between the name and its open quote), so the k-th
+    # name start comes out of the same packed-sum extractor as the quote
+    # positions — replacing the round-3 stack of max_pairs masked
+    # max-reductions (one [N, L] traversal per pair) with one 2-word
+    # extraction.  Rows with several runs per ordinal (malformed pairs)
+    # produce garbage sums, but every such row is already flagged by the
+    # name_run_end / eq_struct / prev-space violations above and falls
+    # back to the scalar oracle.
+    ns_pos = _extract(ns_mask, oq_ord, iota, max_pairs, L)
+    oq_name_start = jnp.where(pair_valid, ns_pos, 0)
 
-    # name sanity per extracted pair: the name run must be nonempty and
-    # preceded by a space (or be at the very start of its region).  Open
-    # quotes past max_pairs have no extracted slot, but such rows already
-    # failed the pair_count budget above and fall back to the oracle.
-    name_prev_ok = (lnn_ch == 32) | (lnn_ch == -1)
-    name_len = oq_pos - lnn_pos - 2        # [start, '='): '=' at oq-1
-    ok &= ~(pair_valid & (~name_prev_ok | (name_len < 1))).any(axis=1)
+    # name sanity per extracted pair: a run was found and it is nonempty
+    # ('=' sits at oq_pos-1, so the run spans [ns_pos, oq_pos-1)).
+    ok &= jnp.where(pair_valid, ns_pos <= oq_pos - 2, True).all(axis=1)
 
     ok &= jnp.where(pair_valid, cq_pos > oq_pos, True).all(axis=1)
     name_end = oq_pos - 1  # position of '='
@@ -765,7 +787,10 @@ def decode_rfc5424(batch: jnp.ndarray, lens: jnp.ndarray,
         jnp.max(jnp.where(non_ws, iota + 1, 0), axis=1), start0)
     msg_a = _min_where(non_ws & (iota >= msg_start[:, None]), iota, L)
     msg_trim_start = jnp.minimum(msg_a, trim_end)
-    has_high = jnp.any((bb >= 128) & valid, axis=1)
+    if pack_high:
+        has_high = ((word3 >> 19) & 0x3FF) > 0
+    else:
+        has_high = jnp.any((bb >= 128) & valid, axis=1)
 
     # single reduction over every accumulated 2-D violation
     ok &= ~jnp.any(viol2d, axis=1)

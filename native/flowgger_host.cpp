@@ -11,6 +11,8 @@
 // 17 — \n framing, one trailing \r stripped); the packer implements the
 // same clip-and-zero-pad contract as tpu/pack.py pack_lines_2d.
 
+#include <charconv>
+#include <cmath>
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
@@ -899,6 +901,99 @@ void fg_concat_segments(const uint8_t* src,
                 memcpy(dst + dst_off[i], src + seg_src[i], (size_t)len);
         }
     }, 8192);
+}
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// serde_json-style f64 formatting (utils/rustfmt.py json_f64 semantics):
+// shortest round-trip digits via std::to_chars, re-rendered with the
+// CPython-repr notation rule (fixed for 10^-4 <= |v| < 10^16, keeping
+// ".0" on integral values; otherwise "dE" exponent form without '+' or
+// leading exponent zeros; non-finite -> "null").  Differentially fuzz-
+// tested against the Python oracle in tests/test_native_and_chunks.py.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+int json_f64_render(double v, char* out) {
+    if (std::isnan(v) || std::isinf(v)) {
+        memcpy(out, "null", 4);
+        return 4;
+    }
+    char buf[40];
+    auto r = std::to_chars(buf, buf + sizeof(buf), v,
+                           std::chars_format::scientific);
+    const char* p = buf;
+    char* o = out;
+    if (*p == '-') { *o++ = '-'; p++; }
+    char digits[24];
+    int nd = 0;
+    while (p < r.ptr && *p != 'e') {
+        if (*p != '.') digits[nd++] = *p;
+        p++;
+    }
+    p++;  // 'e'
+    int esign = 1;
+    if (p < r.ptr && *p == '+') p++;
+    else if (p < r.ptr && *p == '-') { esign = -1; p++; }
+    int E = 0;
+    while (p < r.ptr) E = E * 10 + (*p++ - '0');
+    E *= esign;
+    if (E >= -4 && E < 16) {
+        if (E >= 0) {
+            int i = 0;
+            for (; i <= E; i++) *o++ = i < nd ? digits[i] : '0';
+            *o++ = '.';
+            if (i < nd) { for (; i < nd; i++) *o++ = digits[i]; }
+            else *o++ = '0';
+        } else {
+            *o++ = '0';
+            *o++ = '.';
+            for (int z = 0; z < -E - 1; z++) *o++ = '0';
+            for (int i = 0; i < nd; i++) *o++ = digits[i];
+        }
+    } else {
+        *o++ = digits[0];
+        if (nd > 1) {
+            *o++ = '.';
+            for (int i = 1; i < nd; i++) *o++ = digits[i];
+        }
+        *o++ = 'e';
+        if (E < 0) { *o++ = '-'; E = -E; }
+        char eb[8];
+        int ne = 0;
+        do { eb[ne++] = (char)('0' + E % 10); E /= 10; } while (E);
+        while (ne) *o++ = eb[--ne];
+    }
+    return (int)(o - out);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Format n doubles into a dense [n, width] byte matrix (rows zero-
+// padded) + per-row byte lengths.  Rows whose rendering would exceed
+// `width` get length 0 (callers treat that as "fall back this row");
+// json_f64 output is at most 24 bytes so any width >= 24 never clips.
+void fg_format_f64_json(const double* vals, int64_t n, uint8_t* out,
+                        int32_t width, int32_t* out_len, int n_threads) {
+    run_threaded(n, n_threads, [&](int64_t lo, int64_t hi) {
+        char buf[48];
+        for (int64_t i = lo; i < hi; i++) {
+            int len = json_f64_render(vals[i], buf);
+            uint8_t* row = out + (size_t)i * (size_t)width;
+            if (len > width) {
+                memset(row, 0, (size_t)width);
+                out_len[i] = 0;
+                continue;
+            }
+            memcpy(row, buf, (size_t)len);
+            if (len < width) memset(row + len, 0, (size_t)(width - len));
+            out_len[i] = (int32_t)len;
+        }
+    }, 16384);
 }
 
 }  // extern "C"

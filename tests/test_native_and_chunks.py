@@ -120,3 +120,59 @@ def test_chunked_ingest_small_reads():
     while not tx.empty():
         out.append(tx.get_nowait())
     assert len(out) == 3  # three valid lines
+
+
+def test_native_format_f64_json_matches_oracle():
+    """fg_format_f64_json must byte-match utils.rustfmt.json_f64 across
+    the full f64 space: random bit patterns (subnormals, huge/tiny
+    magnitudes, NaN/inf payloads), timestamp-like values, integral
+    floats, and signed zeros."""
+    if not native.available():
+        pytest.skip("native library not built")
+    from flowgger_tpu.utils.rustfmt import json_f64
+
+    rng = np.random.default_rng(20260729)
+    bits = rng.integers(0, 2**64, size=20000, dtype=np.uint64)
+    ts = (rng.integers(0, 2_000_000_000, 20000).astype(np.float64)
+          + rng.integers(0, 10**9, 20000) / 1e9)
+    specials = np.array([0.0, -0.0, 1.0, -1.0, 1e15, 1e16, -1e16,
+                         0.0001, 1e-5, 9999999999999998.0, 5e-324,
+                         -5e-324, 1.7976931348623157e308, np.nan,
+                         np.inf, -np.inf, 2.0**53, 2.0**53 + 2])
+    vals = np.concatenate([bits.view(np.float64), ts, specials])
+    txt, lens = native.format_f64_json_native(vals, 32)
+    assert txt.shape == (vals.size, 32)
+    for i, v in enumerate(vals):
+        want = json_f64(float(v)).encode("ascii")
+        got = txt[i, :lens[i]].tobytes()
+        assert got == want, (repr(float(v)), got, want)
+        assert not txt[i, lens[i]:].any()
+
+
+def test_ts_text_block_uses_native_and_matches_fallback():
+    """_ts_text_block native path must agree with the dedup+json_f64
+    fallback on realistic near-unique timestamps."""
+    if not native.available():
+        pytest.skip("native library not built")
+    from flowgger_tpu.tpu import device_gelf
+
+    rng = np.random.default_rng(3)
+    n = 500
+    small = {
+        "ok": np.ones(n, dtype=np.uint8),
+        "days": rng.integers(10000, 20000, n).astype(np.int32),
+        "sod": rng.integers(0, 86400, n).astype(np.int32),
+        "off": np.zeros(n, dtype=np.int32),
+        "nanos": rng.integers(0, 10**9, n).astype(np.int32),
+    }
+    small["ok"][::7] = 0
+    txt_n, len_n = device_gelf._ts_text_block(small)
+
+    import unittest.mock as mock
+
+    with mock.patch.object(native, "format_f64_json_native",
+                           lambda *a, **k: None):
+        txt_p, len_p = device_gelf._ts_text_block(small)
+    assert (len_n == len_p).all()
+    w = min(txt_n.shape[1], txt_p.shape[1])
+    assert (txt_n[:, :w] == txt_p[:, :w]).all()

@@ -347,7 +347,11 @@ def test_mm_scan_impl_matches_lax():
 
 def test_scatter_extract_impl_matches_sum():
     """extract_impl='scatter' (CPU fast path) must agree with the
-    bit-packed sums (TPU path) on every output."""
+    bit-packed sums (TPU path): 'ok' everywhere, every channel on
+    accepted rows.  On rejected rows the ordinal-keyed channels
+    (sid_end, name_start since round 4) may hold impl-defined garbage —
+    those rows always take the scalar oracle, so no consumer ever reads
+    them (production never mixes impls within one batch)."""
     import jax.numpy as jnp
 
     from flowgger_tpu.tpu import rfc5424
@@ -357,8 +361,12 @@ def test_scatter_extract_impl_matches_sum():
     a = rfc5424.decode_rfc5424(jnp.asarray(batch), jnp.asarray(lens))
     b = rfc5424.decode_rfc5424(jnp.asarray(batch), jnp.asarray(lens),
                                extract_impl="scatter")
+    ok_a = np.asarray(a["ok"])
+    ok_b = np.asarray(b["ok"])
+    assert (ok_a == ok_b).all()
     for k in a:
-        assert (np.asarray(a[k]) == np.asarray(b[k])).all(), k
+        va, vb = np.asarray(a[k]), np.asarray(b[k])
+        assert (va[ok_a] == vb[ok_a]).all(), k
 
 
 def test_two_tier_pair_dispatch():

@@ -13,6 +13,11 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
+
+from flowgger_tpu.tpu import apply_platform_env
+
+apply_platform_env()  # sitecustomize clobbers JAX_PLATFORMS=cpu
+
 import jax.numpy as jnp
 import numpy as np
 
