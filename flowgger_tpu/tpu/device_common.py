@@ -1,0 +1,369 @@
+"""Shared machinery for device-side encode kernels (device_gelf,
+device_rfc3164, ...): gather-free JSON escaping, per-row segment
+assembly, on-device row compaction, and the host fetch driver with
+tier gating, decline hysteresis, and output-sized D2H.
+
+Every format-specific module contributes only (a) a jitted kernel
+``kernel(ts_text, ts_len, assemble) -> tier | (acc, out_len, tier)``
+built from these primitives plus its own segment table, and (b) a
+``route_ok`` predicate; the fetch flow (phase-1 tier probe, timestamp
+text upload, compaction, syslen prefixing, fallback splicing) is one
+implementation here.
+
+The reference fuses decode→encode per line in its hot loop
+(line_splitter.rs:44-54 → encoder/mod.rs:54-56); this is the batched
+TPU shape of that fusion, for every format pair that rides it.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .assemble import exclusive_cumsum
+from .materialize import compute_ts
+
+_I32 = jnp.int32
+_U8 = jnp.uint8
+
+TS_W = 32          # timestamp text slot width (longest json_f64 ≈ 25)
+E_CAP = 56         # max JSON escapes per row on the device tier
+
+COMPACT_G = 32     # group granularity (bytes) of on-device compaction
+# skip compaction when padded size is within this factor of the real
+# output (the extra device passes would not pay for the smaller fetch)
+COMPACT_MIN_SAVING = 1.15
+
+
+def _shr2d(arr, k):
+    """Shift rows right by static k (drop tail, zero-fill head)."""
+    if k == 0:
+        return arr
+    return jnp.pad(arr[:, :-k], ((0, 0), (k, 0)))
+
+
+def _monotone_expand(vals, shifts, w_out, nbits):
+    """Place vals[i,j] at column j + shifts[i,j]; shifts nondecreasing
+    along each row, < 2**nbits. Vacated slots become 0 (vals must be 0
+    where nothing is emitted). MSB-first barrel: collision-free because
+    intermediate positions j + (s>>k<<k) stay strictly increasing."""
+    x = jnp.pad(vals, ((0, 0), (0, w_out - vals.shape[1])))
+    s = jnp.pad(shifts, ((0, 0), (0, w_out - shifts.shape[1])))
+    for k in range(nbits - 1, -1, -1):
+        d = 1 << k
+        mv = s >= d
+        xm = jnp.where(mv, x, 0)
+        sm = jnp.where(mv, s - d, 0)
+        x = jnp.where(mv, 0, x) | _shr2d(xm, d)
+        s = jnp.where(mv, 0, s) + _shr2d(sm, d)
+    return x
+
+
+def _rot_rows(x, r, w: int):
+    """Cyclic right-rotate each row of [N, w] by per-row r (w pow2)."""
+    for k in range(w.bit_length() - 1):
+        d = 1 << k
+        bit = ((r >> k) & 1) == 1
+        rolled = jnp.concatenate([x[:, -d:], x[:, :-d]], axis=1)
+        x = jnp.where(bit[:, None], rolled, x)
+    return x
+
+
+def _out_width(L: int) -> int:
+    """Static output width: a power of two covering the concatenated
+    source row and typical GELF output for lines of width L."""
+    w = 512
+    while w < 2 * L:
+        w *= 2
+    return w
+
+
+def escape_stage(batch, lens, iota, cumsum_fn, assemble: bool):
+    """JSON-escape classification + (when assembling) the escaped row.
+
+    Returns a dict with: ``esc_row`` ([N, L+E_CAP] u8 escaped bytes, or
+    None when not assembling), ``esc_i`` (int [N, L] escape indicator),
+    ``ne_total`` ([N] escapes per row), ``bad_ctl`` ([N, L] control
+    bytes needing 6-byte \\u00XX escapes — off-tier), and ``dmap(a)``
+    mapping raw offsets to escaped offsets."""
+    bb = batch.astype(_I32)
+    valid = iota < lens.astype(_I32)[:, None]
+    two_ctl = ((bb == 8) | (bb == 9) | (bb == 10) | (bb == 12) | (bb == 13))
+    esc = ((bb == 34) | (bb == 92) | two_ctl) & valid
+    bad_ctl = (bb < 32) & ~two_ctl & valid
+    esc_i = esc.astype(_I32)
+    ne_incl = cumsum_fn(esc_i)
+    ne_excl = ne_incl - esc_i
+    ne_total = ne_incl[:, -1]
+
+    esc_row = None
+    if assemble:
+        mapped = jnp.where(bb == 8, ord("b"),
+                 jnp.where(bb == 9, ord("t"),
+                 jnp.where(bb == 10, ord("n"),
+                 jnp.where(bb == 12, ord("f"),
+                 jnp.where(bb == 13, ord("r"), bb)))))
+        mapped = jnp.where(valid, mapped, 0).astype(_I32)
+        nbits = E_CAP.bit_length()
+        EW = batch.shape[1] + E_CAP
+        s_main = jnp.minimum(ne_excl + esc_i, E_CAP)
+        s_pref = jnp.minimum(ne_excl, E_CAP)
+        main = _monotone_expand(mapped, s_main, EW, nbits)
+        pref = _monotone_expand(jnp.where(esc, ord("\\"), 0).astype(_I32),
+                                s_pref, EW, nbits)
+        esc_row = (main | pref).astype(_U8)
+
+    def dmap(a):
+        a = a.astype(_I32)
+        ne_at = jnp.sum(esc_i * (iota < a[:, None]), axis=1)
+        return a + ne_at
+
+    return {"esc_row": esc_row, "esc_i": esc_i, "ne_total": ne_total,
+            "bad_ctl": bad_ctl, "dmap": dmap, "valid": valid}
+
+
+def assemble_rows(segs, esc_row, bank: bytes, ts_text, N: int, OW: int):
+    """OR-accumulate the per-row segment table into the [N, OW] output.
+
+    ``segs`` is a list of ``(src0 [N], seglen [N])`` in destination
+    order; sources index the concatenated row ``escaped line ∥ constant
+    bank ∥ timestamp text``.  Returns (acc, out_len).  The scan body
+    compiles once (vs once per segment) while each step stays a handful
+    of fused [N, OW] elementwise passes."""
+    seg_src = jnp.stack([s for s, _ in segs])
+    seg_len = jnp.stack([ln for _, ln in segs])
+    seg_dst = jnp.cumsum(seg_len, axis=0) - seg_len
+    out_len = seg_dst[-1] + seg_len[-1]
+
+    const_row = jnp.asarray(np.frombuffer(bank, dtype=np.uint8))
+    CB = len(bank)
+    src2 = jnp.concatenate([
+        esc_row,
+        jnp.broadcast_to(const_row[None, :], (N, CB)),
+        ts_text.astype(_U8),
+    ], axis=1)
+    if src2.shape[1] > OW:
+        raise ValueError(f"source row {src2.shape[1]} exceeds OW {OW}")
+    src2 = jnp.pad(src2, ((0, 0), (0, OW - src2.shape[1])))
+    iow = jax.lax.broadcasted_iota(_I32, (N, OW), 1)
+
+    def step(a, xs):
+        src0, seglen, dst0 = xs
+        m = (iow >= src0[:, None]) & (iow < (src0 + seglen)[:, None])
+        contrib = jnp.where(m, src2, jnp.uint8(0))
+        return a | _rot_rows(contrib, (dst0 - src0) % OW, OW), None
+
+    acc, _ = jax.lax.scan(step, jnp.zeros((N, OW), dtype=_U8),
+                          (seg_src, seg_len, seg_dst))
+    return acc, out_len
+
+
+@partial(jax.jit, static_argnames=("G",))
+def _compact_kernel(acc, out_len, tier, *, G: int = COMPACT_G):
+    """Row compaction on device: pack the tier rows' output bytes into a
+    contiguous group-aligned buffer so the host fetches ~sum(out_len)
+    bytes instead of the padded ``[N, OW]`` matrix.
+
+    Rows are already left-aligned, so compaction is a pure left-shift of
+    whole G-byte groups: row i's ``ceil(len/G)`` leading groups move to
+    group offset ``base[i] = sum_j<i ceil(len_j/G)``.  The per-group
+    shift ``i*(OW/G) - base[i]`` is row-constant and nondecreasing, and
+    destinations are strictly increasing, so an LSB-first barrel shifter
+    is collision-free: after applying bits 0..k, two valid groups a < b
+    satisfy ``p_b - p_a = (b-a) - ((s_b&m)-(s_a&m)) >= (b-a)-(s_b-s_a)
+    >= 1`` (low-bit differences never exceed the full difference when
+    the high bits are monotone).  Non-tier and padding groups are zeroed
+    and stay put (shift 0); moving groups OR over them harmlessly.
+
+    Returns the flat byte buffer; the host slices the first
+    ``sum(ceil(gated_len/G))*G`` bytes (it recomputes base from the
+    fetched lengths with the same integer math)."""
+    N, OW = acc.shape
+    assert OW % G == 0
+    ngr = OW // G
+    gated = jnp.where(tier, out_len, 0)
+    used = (gated + (G - 1)) // G                          # [N]
+    base = jnp.cumsum(used) - used                         # exclusive
+    gi = jax.lax.broadcasted_iota(_I32, (N, ngr), 1)
+    row = jax.lax.broadcasted_iota(_I32, (N, ngr), 0)
+    valid = gi < used[:, None]
+    shift = jnp.where(valid, row * ngr - base[:, None], 0).reshape(-1)
+    x = jnp.where(valid.reshape(-1)[:, None], acc.reshape(N * ngr, G),
+                  jnp.uint8(0))
+    s = shift
+    T = N * ngr
+    for k in range(max(T - 1, 1).bit_length()):
+        d = 1 << k
+        if d >= T:
+            break
+        mv = ((s >> k) & 1) == 1
+        xm = jnp.where(mv[:, None], x, jnp.uint8(0))
+        sm = jnp.where(mv, s - d, 0)
+        x = jnp.where(mv[:, None], jnp.uint8(0), x)
+        s = jnp.where(mv, 0, s)
+        x = x | jnp.concatenate(
+            [xm[d:], jnp.zeros((d, G), jnp.uint8)], axis=0)
+        s = s + jnp.concatenate(
+            [sm[d:], jnp.zeros((d,), s.dtype)], axis=0)
+    return x.reshape(-1)
+
+
+def ts_text_block(small: Dict[str, np.ndarray]):
+    """Format per-row timestamp digits host-side.  The native threaded
+    formatter (fg_format_f64_json: to_chars shortest round-trip,
+    json_f64 notation — differentially fuzzed in
+    tests/test_native_and_chunks.py) handles near-unique real-stream
+    stamps at full rate; without the library, fall back to dedup +
+    per-unique json_f64 (only fast for repetitive streams)."""
+    from .. import native
+    from ..utils.rustfmt import json_f64
+
+    okh = small["ok"].astype(bool)
+    masked = {k: np.where(okh, small[k], 0)
+              for k in ("days", "sod", "off", "nanos")}
+    ts_vals = compute_ts(masked)
+    res = native.format_f64_json_native(ts_vals, TS_W)
+    if res is not None:
+        return res
+    uniq, inv = np.unique(ts_vals, return_inverse=True)
+    txt = np.zeros((uniq.size, TS_W), dtype=np.uint8)
+    ulen = np.zeros(uniq.size, dtype=np.int32)
+    for u, val in enumerate(uniq):
+        s = json_f64(float(val)).encode("ascii")[:TS_W]
+        txt[u, :len(s)] = np.frombuffer(s, dtype=np.uint8)
+        ulen[u] = len(s)
+    return txt[inv], ulen[inv]
+
+
+def fetch_encode_driver(kernel, out, batch_dev, lens_dev, packed, encoder,
+                        merger, route_state, suffix: bytes, syslen: bool,
+                        scalar_fn, fallback_frac: float,
+                        decline_limit: int, cooldown: int):
+    """Shared fetch flow for every device-encode format:
+
+    1. phase-1 tier probe (``kernel(..., assemble=False)`` — XLA
+       dead-code-eliminates the assembly) with a pessimistic TS_W
+       timestamp width, so persistently declining streams never pay the
+       assembly or the host timestamp formatting;
+    2. decline hysteresis via ``route_state`` (caller-owned dict);
+    3. timestamp text upload (native formatter), full kernel;
+    4. on-device row compaction when it saves >15% of the fetch;
+    5. syslen prefixing (host splice over the output-sized body);
+    6. fallback splicing through ``finish_block``.
+
+    Returns (BlockResult | None, fetch_seconds); None = caller should
+    use the span-fetch host path."""
+    import time as _time
+
+    from ..utils.metrics import registry as _metrics
+    from .block_common import apply_syslen_prefix, finish_block
+
+    batch, lens, chunk, starts, orig_lens, n_real = packed
+    n = int(n_real)
+    N = batch_dev.shape[0]
+
+    if route_state is not None and route_state.get("cooldown", 0) > 0:
+        route_state["cooldown"] -= 1
+        return None, 0.0
+
+    t_fetch = 0.0
+    fetched = [0]
+
+    def _fetch(arr):
+        nonlocal t_fetch
+        t0 = _time.perf_counter()
+        h = np.asarray(arr)
+        t_fetch += _time.perf_counter() - t0
+        fetched[0] += h.nbytes
+        return h
+
+    empty_ts = jnp.zeros((N, 0), dtype=jnp.uint8)
+    full_ts_len = jnp.full((N,), TS_W, dtype=jnp.int32)
+    tier1 = kernel(empty_ts, full_ts_len, False)
+    tier1_np = _fetch(tier1)[:n]
+
+    starts64 = np.asarray(starts[:n], dtype=np.int64)
+    lens64 = np.asarray(orig_lens[:n], dtype=np.int64)
+    max_len = batch.shape[1]
+    cand1 = tier1_np & (lens64 <= max_len)
+
+    if n and (1.0 - cand1.mean()) > fallback_frac:
+        _metrics.inc("device_encode_declined")
+        _metrics.inc("device_encode_fetch_bytes", fetched[0])
+        if route_state is not None:
+            route_state["declines"] = route_state.get("declines", 0) + 1
+            if route_state["declines"] >= decline_limit:
+                route_state["cooldown"] = cooldown
+                route_state["declines"] = 0
+        return None, t_fetch
+    if route_state is not None:
+        route_state["declines"] = 0
+
+    small = {k: _fetch(out[k]) for k in ("ok", "days", "sod", "off",
+                                         "nanos")}
+    ts_text, ts_len = ts_text_block(small)
+    acc, out_len, tier = kernel(jnp.asarray(ts_text),
+                                jnp.asarray(ts_len), True)
+
+    # full-N fetches (tiny): the host must recompute the compaction
+    # layout with the exact integer math the device used, including any
+    # dp-padding rows beyond n
+    tier_full = _fetch(tier)
+    len_full = _fetch(out_len).astype(np.int64)
+    tier_np = tier_full[:n]
+    len_np = len_full[:n]
+
+    # the real (shorter) timestamp text can only widen the tier vs the
+    # pessimistic phase-1 gate; cand stays the decision set either way
+    cand = tier_np & (lens64 <= max_len)
+    ridx = np.flatnonzero(cand)
+
+    N_acc, OW = acc.shape
+    G = COMPACT_G
+    gated = np.where(tier_full, len_full, 0)
+    total_bytes = int(gated.sum())
+    if (total_bytes and ridx.size
+            and N_acc * OW > total_bytes * COMPACT_MIN_SAVING):
+        # device-side row compaction: D2H ≈ sum(out_len), G-aligned
+        flat = _compact_kernel(acc, out_len, tier)
+        used = (gated + (G - 1)) // G
+        base = np.cumsum(used) - used
+        total_groups = int(used.sum())
+        comp = _fetch(flat[: total_groups * G]).reshape(-1, G)
+        u = used[ridx]
+        ucum = np.cumsum(u) - u
+        pos = np.arange(int(u.sum()), dtype=np.int64) - np.repeat(ucum, u)
+        gidx = np.repeat(base[ridx], u) + pos
+        gv = np.minimum(G, np.repeat(len_np[ridx], u) - pos * G)
+        grp = comp[gidx]
+        body = grp[np.arange(G)[None, :] < gv[:, None]]
+        row_off = exclusive_cumsum(len_np[ridx])
+    elif ridx.size:
+        out_np = _fetch(acc)[:n]
+        rows = out_np[ridx]
+        m = np.arange(rows.shape[1])[None, :] < len_np[ridx, None]
+        body = rows[m]
+        row_off = exclusive_cumsum(len_np[ridx])
+    else:
+        body = np.zeros(0, dtype=np.uint8)
+        row_off = np.zeros(1, dtype=np.int64)
+
+    prefix_lens_tier = None
+    if syslen and ridx.size:
+        final_buf, row_off, prefix_lens_tier = apply_syslen_prefix(
+            body, row_off, np.diff(row_off))
+    else:
+        final_buf = body.tobytes()
+
+    _metrics.inc("device_encode_rows", int(ridx.size))
+    _metrics.inc("device_encode_scalar_rows", int(n - ridx.size))
+    _metrics.inc("device_encode_fetch_bytes", fetched[0])
+    _metrics.inc("device_encode_out_bytes", len(final_buf))
+    res = finish_block(chunk, starts64, lens64, n, cand, ridx, final_buf,
+                       row_off, prefix_lens_tier, suffix, syslen, merger,
+                       encoder, scalar_fn=scalar_fn)
+    return res, t_fetch

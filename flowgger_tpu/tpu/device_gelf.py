@@ -1,8 +1,8 @@
 """Device-side RFC5424→GELF encode: the kernel emits the *final framed
 output bytes* as one dense ``[N, OW]`` byte matrix plus a length vector,
-then compacts the tier rows on-device (``_compact_kernel``) so the host
-fetch is ~``sum(out_len)`` bytes — truly output-sized — instead of
-~24 span channels or the padded matrix (the reference fuses
+then compacts the tier rows on-device (device_common._compact_kernel)
+so the host fetch is ~``sum(out_len)`` bytes — truly output-sized —
+instead of ~24 span channels or the padded matrix (the reference fuses
 decode→encode per line in its hot loop, line_splitter.rs:44-54 →
 gelf_encoder.rs:59-115 — this is the batched-TPU shape of that fusion).
 
@@ -10,18 +10,13 @@ Everything is gather-free (the environment's recorded XLA-on-TPU fact:
 dynamic gathers lower near-serially — never gather):
 
 - **JSON escaping** is a monotone expansion: each byte's destination is
-  ``j + #escapes-before(j) (+1 for the escaped byte itself)``, shifts are
-  nondecreasing along the row, and an MSB-first barrel shifter places
-  bytes collision-free in ``log2(E_CAP)`` masked-select passes (proof:
-  after processing bit k, positions ``j + (s>>k<<k)`` stay strictly
-  increasing whenever ``s`` is nondecreasing — right-shifts only).
+  ``j + #escapes-before(j) (+1 for the escaped byte itself)``, placed
+  collision-free by the MSB-first barrel shifter
+  (device_common._monotone_expand).
 - **Segment assembly** is an OR-accumulation over a *static* list of
   ~48 segments (1 brace + 5 per SD pair + 17 tail parts, mirroring
-  encode_gelf_block.py's layout byte-for-byte): each segment masks its
-  source span out of a concatenated source row (escaped line ∥ constant
-  bank ∥ timestamp text) and cyclically rotates it to its destination
-  with a per-row power-of-2 barrel (``log2(OW)`` selects), where the
-  destination offsets are an exclusive running sum of segment lengths.
+  encode_gelf_block.py's layout byte-for-byte) via
+  device_common.assemble_rows.
 - **SD pair sorting** (serde_json's BTreeMap key order) extracts each
   name's first 8 bytes into two packed int32 words via masked one-hot
   sums, runs a 12-comparator sorting network over the ≤6-pair tier with
@@ -35,7 +30,7 @@ keep their existing host paths, so observable bytes stay identical to
 the scalar route in every case.
 
 The timestamp digits (shortest round-trip f64, serde_json/Ryu form) are
-formatted host-side from a small scalar fetch and uploaded as a
+formatted host-side (native threaded formatter) and uploaded as a
 ``[N, TS_W]`` text block — the only host↔device round-trip; everything
 else rides the decode call's device-resident channels.
 """
@@ -50,17 +45,25 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..utils.rustfmt import json_f64
-from .assemble import exclusive_cumsum
-from .block_common import finish_block, merger_suffix
-from .materialize import compute_ts
+from .device_common import (  # noqa: F401  (re-exported for tests/siblings)
+    COMPACT_G,
+    COMPACT_MIN_SAVING,
+    E_CAP,
+    TS_W,
+    _compact_kernel,
+    _monotone_expand,
+    _rot_rows,
+    _out_width,
+    assemble_rows,
+    escape_stage,
+    fetch_encode_driver,
+    ts_text_block as _ts_text_block,
+)
 from .rfc5424 import _cumsum, best_scan_impl
 
 _I32 = jnp.int32
 _U8 = jnp.uint8
 
-TS_W = 32          # timestamp text slot width (longest json_f64 ≈ 25)
-E_CAP = 56         # max JSON escapes per row on the device tier
 _AMBIG_LEN = 8     # name-key bytes captured for sorting
 _BIG = 0x7FFFFFFF  # sort key for absent pairs (names are ASCII < 0x7f)
 
@@ -107,49 +110,6 @@ def _bank(suffix: bytes) -> Tuple[bytes, Dict[str, int]]:
     return bank, offs
 
 
-def _shr2d(arr, k):
-    """Shift rows right by static k (drop tail, zero-fill head)."""
-    if k == 0:
-        return arr
-    return jnp.pad(arr[:, :-k], ((0, 0), (k, 0)))
-
-
-def _monotone_expand(vals, shifts, w_out, nbits):
-    """Place vals[i,j] at column j + shifts[i,j]; shifts nondecreasing
-    along each row, < 2**nbits. Vacated slots become 0 (vals must be 0
-    where nothing is emitted). MSB-first barrel: collision-free because
-    intermediate positions j + (s>>k<<k) stay strictly increasing."""
-    x = jnp.pad(vals, ((0, 0), (0, w_out - vals.shape[1])))
-    s = jnp.pad(shifts, ((0, 0), (0, w_out - shifts.shape[1])))
-    for k in range(nbits - 1, -1, -1):
-        d = 1 << k
-        mv = s >= d
-        xm = jnp.where(mv, x, 0)
-        sm = jnp.where(mv, s - d, 0)
-        x = jnp.where(mv, 0, x) | _shr2d(xm, d)
-        s = jnp.where(mv, 0, s) + _shr2d(sm, d)
-    return x
-
-
-def _rot_rows(x, r, w: int):
-    """Cyclic right-rotate each row of [N, w] by per-row r (w pow2)."""
-    for k in range(w.bit_length() - 1):
-        d = 1 << k
-        bit = ((r >> k) & 1) == 1
-        rolled = jnp.concatenate([x[:, -d:], x[:, :-d]], axis=1)
-        x = jnp.where(bit[:, None], rolled, x)
-    return x
-
-
-def _out_width(L: int) -> int:
-    """Static output width: a power of two covering the concatenated
-    source row and typical GELF output for lines of width L."""
-    w = 512
-    while w < 2 * L:
-        w *= 2
-    return w
-
-
 @partial(jax.jit, static_argnames=("suffix", "max_sd", "impl",
                                    "assemble"))
 def _encode_kernel(batch, lens, dec, ts_text, ts_len, *, suffix: bytes,
@@ -157,43 +117,12 @@ def _encode_kernel(batch, lens, dec, ts_text, ts_len, *, suffix: bytes,
     N, L = batch.shape
     OW = _out_width(L)
     bank, off = _bank(suffix)
-    CB = len(bank)
     iota = jax.lax.broadcasted_iota(_I32, (N, L), 1)
     bb = batch.astype(_I32)
-    valid = iota < lens.astype(_I32)[:, None]
 
-    # ---- escape classes --------------------------------------------------
-    two_ctl = ((bb == 8) | (bb == 9) | (bb == 10) | (bb == 12) | (bb == 13))
-    esc = ((bb == 34) | (bb == 92) | two_ctl) & valid
-    bad_ctl = (bb < 32) & ~two_ctl & valid
-    mapped = jnp.where(bb == 8, ord("b"),
-             jnp.where(bb == 9, ord("t"),
-             jnp.where(bb == 10, ord("n"),
-             jnp.where(bb == 12, ord("f"),
-             jnp.where(bb == 13, ord("r"), bb)))))
-    mapped = jnp.where(valid, mapped, 0).astype(_I32)
-
-    esc_i = esc.astype(_I32)
-    ne_incl = _cumsum(esc_i, impl)
-    ne_excl = ne_incl - esc_i
-    ne_total = ne_incl[:, -1]
-
-    nbits = E_CAP.bit_length()
-    EW = L + E_CAP
-    esc_row = None
-    if assemble:
-        s_main = jnp.minimum(ne_excl + esc_i, E_CAP)
-        s_pref = jnp.minimum(ne_excl, E_CAP)
-        main = _monotone_expand(mapped, s_main, EW, nbits)
-        pref = _monotone_expand(jnp.where(esc, ord("\\"), 0).astype(_I32),
-                                s_pref, EW, nbits)
-        esc_row = (main | pref).astype(_U8)
-
-    # d-map: raw index a -> escaped index a + #escapes-before(a)
-    def dmap(a):
-        a = a.astype(_I32)
-        ne_at = jnp.sum(esc_i * (iota < a[:, None]), axis=1)
-        return a + ne_at
+    es = escape_stage(batch, lens, iota,
+                      lambda x: _cumsum(x, impl), assemble)
+    dmap = es["dmap"]
 
     # ---- fixed-field spans in escaped coordinates ------------------------
     app_s, app_e = dmap(dec["app_start"]), dmap(dec["app_end"])
@@ -267,8 +196,9 @@ def _encode_kernel(batch, lens, dec, ts_text, ts_len, *, suffix: bytes,
                                       & (lb > _AMBIG_LEN)))
 
     # ---- segment table ---------------------------------------------------
+    EW = L + E_CAP
     cbase = EW
-    tbase = EW + CB
+    tbase = EW + len(bank)
     zero = jnp.zeros((N,), dtype=_I32)
     segs = []  # (src0 [N], seglen [N]) in destination order
 
@@ -318,42 +248,15 @@ def _encode_kernel(batch, lens, dec, ts_text, ts_len, *, suffix: bytes,
     segs.append((zero + tbase, ts_len.astype(_I32)))
     add_const("tail")
 
-    # ---- assemble --------------------------------------------------------
-    # stack the segment table [S, N] and scan: the roll body compiles
-    # once instead of once per segment (48x smaller HLO graph), while
-    # each step remains a handful of fused [N, OW] elementwise passes
-    seg_src = jnp.stack([s for s, _ in segs])
-    seg_len = jnp.stack([ln for _, ln in segs])
-    seg_dst = jnp.cumsum(seg_len, axis=0) - seg_len
-    out_len = seg_dst[-1] + seg_len[-1]
-
-    acc = None
-    if assemble:
-        const_row = jnp.asarray(np.frombuffer(bank, dtype=np.uint8))
-        src2 = jnp.concatenate([
-            esc_row,
-            jnp.broadcast_to(const_row[None, :], (N, CB)),
-            ts_text.astype(_U8),
-        ], axis=1)
-        if src2.shape[1] > OW:
-            raise ValueError(f"source row {src2.shape[1]} exceeds OW {OW}")
-        src2 = jnp.pad(src2, ((0, 0), (0, OW - src2.shape[1])))
-        iow = jax.lax.broadcasted_iota(_I32, (N, OW), 1)
-
-        def step(a, xs):
-            src0, seglen, dst0 = xs
-            m = (iow >= src0[:, None]) & (iow < (src0 + seglen)[:, None])
-            contrib = jnp.where(m, src2, jnp.uint8(0))
-            return a | _rot_rows(contrib, (dst0 - src0) % OW, OW), None
-
-        acc, _ = jax.lax.scan(step, jnp.zeros((N, OW), dtype=_U8),
-                              (seg_src, seg_len, seg_dst))
+    out_len = segs[0][1]
+    for _, ln in segs[1:]:
+        out_len = out_len + ln
 
     # ---- tier ------------------------------------------------------------
     tier = (dec["ok"].astype(bool)
             & ~dec["has_high"].astype(bool)
-            & ~jnp.any(bad_ctl, axis=1)
-            & (ne_total <= E_CAP)
+            & ~jnp.any(es["bad_ctl"], axis=1)
+            & (es["ne_total"] <= E_CAP)
             & (pair_count <= P)
             & (sd_count <= max_sd)
             & ~val_esc_any
@@ -361,76 +264,24 @@ def _encode_kernel(batch, lens, dec, ts_text, ts_len, *, suffix: bytes,
             & (out_len <= OW))
     if not assemble:
         return tier
-    return acc, out_len, tier
-
-
-COMPACT_G = 32   # group granularity (bytes) of on-device row compaction
-# skip compaction when padded size is within this factor of the real
-# output (the extra device passes would not pay for the smaller fetch)
-COMPACT_MIN_SAVING = 1.15
-
-
-@partial(jax.jit, static_argnames=("G",))
-def _compact_kernel(acc, out_len, tier, *, G: int = COMPACT_G):
-    """Row compaction on device: pack the tier rows' output bytes into a
-    contiguous group-aligned buffer so the host fetches ~sum(out_len)
-    bytes instead of the padded ``[N, OW]`` matrix.
-
-    Rows are already left-aligned, so compaction is a pure left-shift of
-    whole G-byte groups: row i's ``ceil(len/G)`` leading groups move to
-    group offset ``base[i] = sum_j<i ceil(len_j/G)``.  The per-group
-    shift ``i*(OW/G) - base[i]`` is row-constant and nondecreasing, and
-    destinations are strictly increasing, so an LSB-first barrel shifter
-    is collision-free: after applying bits 0..k, two valid groups a < b
-    satisfy ``p_b - p_a = (b-a) - ((s_b&m)-(s_a&m)) >= (b-a)-(s_b-s_a)
-    >= 1`` (low-bit differences never exceed the full difference when
-    the high bits are monotone).  Non-tier and padding groups are zeroed
-    and stay put (shift 0); moving groups OR over them harmlessly.
-
-    Returns the flat byte buffer; the host slices the first
-    ``sum(ceil(gated_len/G))*G`` bytes (it recomputes base from the
-    fetched lengths with the same integer math)."""
-    N, OW = acc.shape
-    assert OW % G == 0
-    ngr = OW // G
-    gated = jnp.where(tier, out_len, 0)
-    used = (gated + (G - 1)) // G                          # [N]
-    base = jnp.cumsum(used) - used                         # exclusive
-    gi = jax.lax.broadcasted_iota(_I32, (N, ngr), 1)
-    row = jax.lax.broadcasted_iota(_I32, (N, ngr), 0)
-    valid = gi < used[:, None]
-    shift = jnp.where(valid, row * ngr - base[:, None], 0).reshape(-1)
-    x = jnp.where(valid.reshape(-1)[:, None], acc.reshape(N * ngr, G),
-                  jnp.uint8(0))
-    s = shift
-    T = N * ngr
-    for k in range(max(T - 1, 1).bit_length()):
-        d = 1 << k
-        if d >= T:
-            break
-        mv = ((s >> k) & 1) == 1
-        xm = jnp.where(mv[:, None], x, jnp.uint8(0))
-        sm = jnp.where(mv, s - d, 0)
-        x = jnp.where(mv[:, None], jnp.uint8(0), x)
-        s = jnp.where(mv, 0, s)
-        x = x | jnp.concatenate(
-            [xm[d:], jnp.zeros((d, G), jnp.uint8)], axis=0)
-        s = s + jnp.concatenate(
-            [sm[d:], jnp.zeros((d,), s.dtype)], axis=0)
-    return x.reshape(-1)
+    acc, out_len2 = assemble_rows(segs, es["esc_row"], bank, ts_text,
+                                  N, OW)
+    return acc, out_len2, tier
 
 
 def route_ok(encoder, merger) -> bool:
-    """Device encode applies to GELF output without extras over line/nul
-    framing (syslen's variable-width prefix stays on the host tiers)."""
+    """Device encode applies to GELF output without extras over
+    line/nul/syslen framing (the syslen prefix is spliced host-side
+    over the output-sized device body)."""
     from ..encoders.gelf import GelfEncoder
-    from ..mergers import LineMerger, NulMerger
+    from ..mergers import LineMerger, NulMerger, SyslenMerger
 
     if os.environ.get("FLOWGGER_DEVICE_ENCODE", "1") == "0":
         return False
     if type(encoder) is not GelfEncoder or encoder.extra:
         return False
-    return merger is None or type(merger) in (LineMerger, NulMerger)
+    return merger is None or type(merger) in (LineMerger, NulMerger,
+                                              SyslenMerger)
 
 
 # fraction of non-tier rows above which the span-fetch host path wins
@@ -447,157 +298,26 @@ DECLINE_LIMIT = 3
 COOLDOWN = 16
 
 
-def _ts_text_block(small: Dict[str, np.ndarray]):
-    """Format per-row timestamp digits host-side.  The native threaded
-    formatter (fg_format_f64_json: to_chars shortest round-trip,
-    json_f64 notation — differentially fuzzed in
-    tests/test_native_and_chunks.py) handles near-unique real-stream
-    stamps at full rate; without the library, fall back to dedup +
-    per-unique json_f64 (only fast for repetitive streams)."""
-    from .. import native
-
-    okh = small["ok"].astype(bool)
-    masked = {k: np.where(okh, small[k], 0)
-              for k in ("days", "sod", "off", "nanos")}
-    ts_vals = compute_ts(masked)
-    res = native.format_f64_json_native(ts_vals, TS_W)
-    if res is not None:
-        return res
-    uniq, inv = np.unique(ts_vals, return_inverse=True)
-    txt = np.zeros((uniq.size, TS_W), dtype=np.uint8)
-    ulen = np.zeros(uniq.size, dtype=np.int32)
-    for u, val in enumerate(uniq):
-        s = json_f64(float(val)).encode("ascii")[:TS_W]
-        txt[u, :len(s)] = np.frombuffer(s, dtype=np.uint8)
-        ulen[u] = len(s)
-    return txt[inv], ulen[inv]
-
-
 def fetch_encode(handle, packed, encoder, merger, route_state=None):
     """Run the device encode for a submitted rfc5424 decode; returns
     (BlockResult | None, fetch_seconds). None = caller should use the
-    span-fetch host path (high fallback fraction).
+    span-fetch host path (high fallback fraction).  See
+    device_common.fetch_encode_driver for the shared flow."""
+    from .block_common import merger_suffix
 
-    Phase 1 runs a tier-only variant of the kernel (XLA dead-code-
-    eliminates the whole assembly) with a pessimistic TS_W timestamp
-    width, so persistently declining streams never pay the assembly or
-    the host timestamp formatting; ``route_state`` (a caller-owned dict)
-    adds cross-batch hysteresis on top."""
-    import time as _time
-
-    from ..utils.metrics import registry as _metrics
-
-    out, _, _, max_sd, _, batch_dev, lens_dev = handle
-    batch, lens, chunk, starts, orig_lens, n_real = packed
-    n = int(n_real)
+    out, _, _, max_sd, impl_unused, batch_dev, lens_dev = handle
     suffix, syslen = merger_suffix(merger)
-    assert not syslen
-
-    if route_state is not None and route_state.get("cooldown", 0) > 0:
-        route_state["cooldown"] -= 1
-        return None, 0.0
-
-    # size the per-row inputs from the *device* batch: a sharded submit
-    # may have row-padded it to a dp multiple beyond the host batch
-    N = batch_dev.shape[0]
     impl = best_scan_impl()
-    empty_ts = jnp.zeros((N, 0), dtype=jnp.uint8)
-    full_ts_len = jnp.full((N,), TS_W, dtype=jnp.int32)
-    tier1 = _encode_kernel(batch_dev, lens_dev, dict(out), empty_ts,
-                           full_ts_len, suffix=suffix, max_sd=max_sd,
-                           impl=impl, assemble=False)
 
-    t_fetch = 0.0
-    fetched = [0]
+    def kernel(ts_text, ts_len, assemble):
+        return _encode_kernel(batch_dev, lens_dev, dict(out), ts_text,
+                              ts_len, suffix=suffix, max_sd=max_sd,
+                              impl=impl, assemble=assemble)
 
-    def _fetch(arr):
-        nonlocal t_fetch
-        t0 = _time.perf_counter()
-        h = np.asarray(arr)
-        t_fetch += _time.perf_counter() - t0
-        fetched[0] += h.nbytes
-        return h
+    from .materialize import _scalar_line
 
-    tier1_np = _fetch(tier1)[:n]
-
-    starts64 = np.asarray(starts[:n], dtype=np.int64)
-    lens64 = np.asarray(orig_lens[:n], dtype=np.int64)
-    max_len = batch.shape[1]
-    cand1 = tier1_np & (lens64 <= max_len)
-
-    if n and (1.0 - cand1.mean()) > FALLBACK_FRAC:
-        _metrics.inc("device_encode_declined")
-        _metrics.inc("device_encode_fetch_bytes", fetched[0])
-        if route_state is not None:
-            route_state["declines"] = route_state.get("declines", 0) + 1
-            if route_state["declines"] >= DECLINE_LIMIT:
-                route_state["cooldown"] = COOLDOWN
-                route_state["declines"] = 0
-        return None, t_fetch
-    if route_state is not None:
-        route_state["declines"] = 0
-
-    small = {k: _fetch(out[k]) for k in ("ok", "days", "sod", "off",
-                                         "nanos")}
-
-    ts_text, ts_len = _ts_text_block(small)
-    acc, out_len, tier = _encode_kernel(
-        batch_dev, lens_dev, dict(out), jnp.asarray(ts_text),
-        jnp.asarray(ts_len), suffix=suffix, max_sd=max_sd,
-        impl=impl)
-
-    # full-N fetches (tiny): the host must recompute the compaction
-    # layout with the exact integer math the device used, including any
-    # dp-padding rows beyond n
-    tier_full = _fetch(tier)
-    len_full = _fetch(out_len).astype(np.int64)
-    tier_np = tier_full[:n]
-    len_np = len_full[:n]
-
-    # the real (shorter) timestamp text can only widen the tier vs the
-    # pessimistic phase-1 gate; cand stays the decision set either way
-    cand = tier_np & (lens64 <= max_len)
-    ridx = np.flatnonzero(cand)
-
-    N, OW = acc.shape
-    G = COMPACT_G
-    gated = np.where(tier_full, len_full, 0)
-    total_bytes = int(gated.sum())
-    if (total_bytes and ridx.size
-            and N * OW > total_bytes * COMPACT_MIN_SAVING):
-        # device-side row compaction: D2H ≈ sum(out_len), G-aligned
-        flat = _compact_kernel(acc, out_len, tier)
-        used = (gated + (G - 1)) // G
-        base = np.cumsum(used) - used
-        total_groups = int(used.sum())
-        comp = _fetch(flat[: total_groups * G]).reshape(-1, G)
-        if ridx.size:
-            u = used[ridx]
-            ucum = np.cumsum(u) - u
-            pos = np.arange(int(u.sum()), dtype=np.int64) \
-                - np.repeat(ucum, u)
-            gidx = np.repeat(base[ridx], u) + pos
-            gv = np.minimum(G, np.repeat(len_np[ridx], u) - pos * G)
-            grp = comp[gidx]
-            final_buf = grp[np.arange(G)[None, :] < gv[:, None]].tobytes()
-            row_off = exclusive_cumsum(len_np[ridx])
-        else:
-            final_buf = b""
-            row_off = np.zeros(1, dtype=np.int64)
-    elif ridx.size:
-        out_np = _fetch(acc)[:n]
-        rows = out_np[ridx]
-        m = np.arange(rows.shape[1])[None, :] < len_np[ridx, None]
-        final_buf = rows[m].tobytes()
-        row_off = exclusive_cumsum(len_np[ridx])
-    else:
-        final_buf = b""
-        row_off = np.zeros(1, dtype=np.int64)
-
-    _metrics.inc("device_encode_rows", int(ridx.size))
-    _metrics.inc("device_encode_scalar_rows", int(n - ridx.size))
-    _metrics.inc("device_encode_fetch_bytes", fetched[0])
-    _metrics.inc("device_encode_out_bytes", len(final_buf))
-    res = finish_block(chunk, starts64, lens64, n, cand, ridx, final_buf,
-                       row_off, None, suffix, False, merger, encoder)
-    return res, t_fetch
+    return fetch_encode_driver(
+        kernel, out, batch_dev, lens_dev, packed, encoder, merger,
+        route_state, suffix, syslen, scalar_fn=_scalar_line,
+        fallback_frac=FALLBACK_FRAC, decline_limit=DECLINE_LIMIT,
+        cooldown=COOLDOWN)

@@ -1,0 +1,157 @@
+"""Device-side RFC3164→GELF encode: final framed bytes assembled on
+device for the legacy-syslog fast path, compacted and fetched
+output-sized (device_common machinery — same contract as device_gelf).
+
+The rfc3164 fast-path record carries no SD, no appname/procid/msgid, an
+unstripped message, and the whole line as full_message
+(rfc3164_decoder.rs:31-122 lenient grammar; materialize_rfc3164.py), so
+the sorted-key GELF object is eleven segments per row::
+
+    {"full_message":F,"host":H,["level":N,]"short_message":M,
+     "timestamp":T,"version":"1.1"}
+
+with the level pair gated per row on has_pri — exactly the layout of
+the host tier (encode_rfc3164_gelf_block.py), whose byte constants this
+kernel shares so fallback splices can never diverge.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from .device_common import (
+    E_CAP,
+    _out_width,
+    assemble_rows,
+    escape_stage,
+    fetch_encode_driver,
+)
+from .encode_rfc3164_gelf_block import (
+    _C_HOST,
+    _C_LEVEL,
+    _C_OPEN,
+    _C_SEVD,
+    _C_SHORT_NOPRI,
+    _C_SHORT_PRI,
+    _C_TAIL,
+    _C_TS,
+)
+from .rfc5424 import _cumsum, best_scan_impl
+
+_I32 = jnp.int32
+
+FALLBACK_FRAC = 0.05
+DECLINE_LIMIT = 3
+COOLDOWN = 16
+
+_PARTS = {
+    "open": _C_OPEN,
+    "host": _C_HOST,
+    "level": _C_LEVEL,
+    "short_p": _C_SHORT_PRI,
+    "short_n": _C_SHORT_NOPRI,
+    "ts": _C_TS,
+    "tail": _C_TAIL,
+    "sevd": _C_SEVD,
+}
+
+
+def _bank(suffix: bytes):
+    offs, bank = {}, b""
+    for k, v in _PARTS.items():
+        if k == "tail":
+            v = v + suffix
+        offs[k] = len(bank)
+        bank += v
+    return bank, offs
+
+
+@partial(jax.jit, static_argnames=("suffix", "impl", "assemble"))
+def _encode_kernel(batch, lens, dec, ts_text, ts_len, *, suffix: bytes,
+                   impl: str, assemble: bool = True):
+    N, L = batch.shape
+    OW = _out_width(L)
+    bank, off = _bank(suffix)
+    iota = jax.lax.broadcasted_iota(_I32, (N, L), 1)
+
+    es = escape_stage(batch, lens, iota,
+                      lambda x: _cumsum(x, impl), assemble)
+    dmap = es["dmap"]
+
+    lens32 = lens.astype(_I32)
+    host_s, host_e = dmap(dec["host_start"]), dmap(dec["host_end"])
+    msg_s = dmap(dec["msg_start"])
+    row_e = lens32 + es["ne_total"]     # dmap(lens) without the reduction
+    has_pri = dec["has_pri"].astype(bool)
+
+    EW = L + E_CAP
+    cbase = EW
+    tbase = EW + len(bank)
+    zero = jnp.zeros((N,), dtype=_I32)
+    segs = [
+        (zero + (cbase + off["open"]), zero + len(_C_OPEN)),
+        (zero, row_e),                                   # full_message
+        (zero + (cbase + off["host"]), zero + len(_C_HOST)),
+        (host_s, jnp.maximum(host_e - host_s, 0)),
+        (zero + (cbase + off["level"]),
+         jnp.where(has_pri, len(_C_LEVEL), 0)),
+        (cbase + off["sevd"] + dec["severity"].astype(_I32),
+         jnp.where(has_pri, 1, 0)),
+        (jnp.where(has_pri, cbase + off["short_p"],
+                   cbase + off["short_n"]),
+         jnp.where(has_pri, len(_C_SHORT_PRI), len(_C_SHORT_NOPRI))),
+        (msg_s, jnp.maximum(row_e - msg_s, 0)),          # short_message
+        (zero + (cbase + off["ts"]), zero + len(_C_TS)),
+        (zero + tbase, ts_len.astype(_I32)),
+        (zero + (cbase + off["tail"]),
+         zero + len(_C_TAIL) + len(suffix)),
+    ]
+
+    out_len = segs[0][1]
+    for _, ln in segs[1:]:
+        out_len = out_len + ln
+
+    tier = (dec["ok"].astype(bool)
+            & ~dec["has_high"].astype(bool)
+            & ~jnp.any(es["bad_ctl"], axis=1)
+            & (es["ne_total"] <= E_CAP)
+            & (out_len <= OW))
+    if not assemble:
+        return tier
+    acc, out_len2 = assemble_rows(segs, es["esc_row"], bank, ts_text,
+                                  N, OW)
+    return acc, out_len2, tier
+
+
+def route_ok(encoder, merger) -> bool:
+    """Same applicability as the rfc5424 device route: GELF output
+    without extras over line/nul/syslen framing."""
+    from . import device_gelf
+
+    return device_gelf.route_ok(encoder, merger)
+
+
+def fetch_encode(handle, packed, encoder, merger, route_state=None):
+    """Device rfc3164→GELF encode for a submitted rfc3164 decode handle
+    (out dict, batch_dev, lens_dev); returns (BlockResult | None,
+    fetch_seconds) with None = use the host span path."""
+    from .block_common import merger_suffix
+    from .materialize_rfc3164 import _scalar_3164
+
+    out, batch_dev, lens_dev = handle
+    suffix, syslen = merger_suffix(merger)
+    impl = best_scan_impl()
+
+    def kernel(ts_text, ts_len, assemble):
+        return _encode_kernel(batch_dev, lens_dev, dict(out), ts_text,
+                              ts_len, suffix=suffix, impl=impl,
+                              assemble=assemble)
+
+    return fetch_encode_driver(
+        kernel, out, batch_dev, lens_dev, packed, encoder, merger,
+        route_state, suffix, syslen, scalar_fn=_scalar_3164,
+        fallback_frac=FALLBACK_FRAC, decline_limit=DECLINE_LIMIT,
+        cooldown=COOLDOWN)

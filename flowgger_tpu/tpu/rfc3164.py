@@ -214,19 +214,21 @@ def decode_rfc3164_submit(batch, lens, sharded=None):
     """Asynchronous dispatch (pair with decode_rfc3164_fetch) — the
     rfc3164 leg of the block pipeline's double buffering.  ``sharded``
     swaps in the multi-chip mesh kernel (parallel.mesh.ShardedDecode);
-    the year scalar rides replicated."""
+    the year scalar rides replicated.  The handle carries the uploaded
+    device arrays so the device-side encode (tpu/device_rfc3164.py)
+    reuses them without a re-upload."""
     import jax.numpy as jnp
 
     from ..utils.timeparse import current_year_utc
 
     if sharded is not None:
         b, ln = sharded.put(batch, lens)
-        return sharded.fn(b, ln, jnp.int32(current_year_utc()))
-    return decode_rfc3164_jit(jnp.asarray(batch), jnp.asarray(lens),
-                              jnp.int32(current_year_utc()))
+        return sharded.fn(b, ln, jnp.int32(current_year_utc())), b, ln
+    b, ln = jnp.asarray(batch), jnp.asarray(lens)
+    return decode_rfc3164_jit(b, ln, jnp.int32(current_year_utc())), b, ln
 
 
 def decode_rfc3164_fetch(handle):
     import numpy as np
 
-    return {k: np.asarray(v) for k, v in handle.items()}
+    return {k: np.asarray(v) for k, v in handle[0].items()}

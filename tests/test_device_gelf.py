@@ -277,3 +277,51 @@ def test_compact_kernel_matches_numpy():
     for i in range(N):
         got = flat[base[i] * G: base[i] * G + gated[i]]
         assert (got == acc[i, :gated[i]]).all(), f"row {i}"
+
+
+def test_record_path_cliff_warns_at_startup(capsys):
+    """A config that can never engage the block route (any *_extra on a
+    JSON route, an encoder with no columnar path for the input format)
+    must say so once at construction, naming the key."""
+    from flowgger_tpu.decoders.ltsv import LTSVDecoder
+    from flowgger_tpu.encoders.rfc5424 import RFC5424Encoder
+
+    enc_extra = GelfEncoder(Config.from_string(
+        '[output.gelf_extra]\nstatic_key = "v"\n'))
+    BatchHandler(queue.Queue(), RFC5424Decoder(), enc_extra,
+                 Config.from_string(""), fmt="rfc5424",
+                 start_timer=False, merger=LineMerger())
+    err = capsys.readouterr().err
+    assert "output.gelf_extra" in err and "block route disabled" in err
+
+    BatchHandler(queue.Queue(), LTSVDecoder(Config.from_string("")),
+                 RFC5424Encoder(Config.from_string("")),
+                 Config.from_string(""), fmt="ltsv",
+                 start_timer=False, merger=LineMerger())
+    err = capsys.readouterr().err
+    assert "RFC5424Encoder" in err and "block route disabled" in err
+
+    # engaged routes: no warning (incl. the new capnp columnar route)
+    from flowgger_tpu.encoders.capnp import CapnpEncoder
+
+    for enc in (GelfEncoder(Config.from_string("")),
+                CapnpEncoder(Config.from_string(""))):
+        BatchHandler(queue.Queue(), RFC5424Decoder(), enc,
+                     Config.from_string(""), fmt="rfc5424",
+                     start_timer=False, merger=LineMerger())
+        assert "block route disabled" not in capsys.readouterr().err
+
+
+def test_device_syslen_framing_matches_scalar():
+    """Syslen framing on the device route: the length prefix is spliced
+    host-side over the output-sized device body; bytes must equal the
+    scalar oracle → GelfEncoder → SyslenMerger frames."""
+    from flowgger_tpu.mergers import SyslenMerger
+
+    merger = SyslenMerger()
+    n0 = metrics.get("device_encode_rows")
+    res, _ = run_device(CLEAN * 3, merger)
+    assert res is not None
+    assert metrics.get("device_encode_rows") - n0 == len(CLEAN) * 3
+    want = b"".join(scalar_frames(CLEAN * 3, merger))
+    assert res.block.data == want

@@ -1,0 +1,132 @@
+"""Device-side RFC3164→GELF encode (tpu/device_rfc3164.py):
+differential tests vs the scalar oracle (RFC3164Decoder → GelfEncoder →
+merger.frame), including fallback splicing, framing variants, and the
+production BatchHandler route."""
+
+import queue
+import random
+
+import numpy as np
+import pytest
+
+from flowgger_tpu.config import Config
+from flowgger_tpu.block import EncodedBlock
+from flowgger_tpu.decoders import DecodeError
+from flowgger_tpu.decoders.rfc3164 import RFC3164Decoder
+from flowgger_tpu.encoders.gelf import GelfEncoder
+from flowgger_tpu.mergers import LineMerger, NulMerger, SyslenMerger
+from flowgger_tpu.tpu import device_rfc3164, pack, rfc3164
+from flowgger_tpu.tpu.batch import BatchHandler
+from flowgger_tpu.utils.metrics import registry as metrics
+
+ORACLE = RFC3164Decoder()
+ENC = GelfEncoder(Config.from_string(""))
+
+
+def scalar_frames(lines, merger):
+    out = []
+    for ln in lines:
+        try:
+            rec = ORACLE.decode(ln.decode("utf-8"))
+        except (DecodeError, UnicodeDecodeError):
+            continue
+        payload = ENC.encode(rec)
+        out.append(merger.frame(payload) if merger is not None else payload)
+    return out
+
+
+def run_device(lines, merger, max_len=256):
+    packed = pack.pack_lines_2d(lines, max_len)
+    handle = rfc3164.decode_rfc3164_submit(packed[0], packed[1])
+    return device_rfc3164.fetch_encode(handle, packed, ENC, merger)
+
+
+CLEAN = [
+    b"<13>Sep 20 12:35:45 host app: a legacy message",
+    b"<34>Oct 11 22:14:15 mymachine su: 'su root' failed for lonvick",
+    b"Sep 20 12:35:45 nopri-host appname: message without pri",
+    b"<165>Aug  1 03:00:00 h1 proc: short",
+]
+
+
+@pytest.mark.parametrize("merger", [None, LineMerger(), NulMerger(),
+                                    SyslenMerger()],
+                         ids=["noop", "line", "nul", "syslen"])
+def test_device_3164_matches_scalar_and_engages(merger):
+    n0 = metrics.get("device_encode_rows")
+    res, _ = run_device(CLEAN * 4, merger)
+    assert res is not None
+    assert metrics.get("device_encode_rows") - n0 == len(CLEAN) * 4
+    want = b"".join(scalar_frames(CLEAN * 4, merger))
+    assert res.block.data == want
+
+
+def test_device_3164_fallback_splicing(monkeypatch):
+    monkeypatch.setattr(device_rfc3164, "FALLBACK_FRAC", 1.1)
+    mixed = [
+        CLEAN[0],
+        b'<13>Sep 20 12:35:45 host app: quotes "here" and\ttabs',
+        "<13>Sep 20 12:35:45 hést app: non-ascii host".encode(),
+        CLEAN[1],
+        b"\xff\xfe invalid utf8",
+        CLEAN[3],
+    ]
+    res, _ = run_device(mixed, LineMerger())
+    assert res is not None
+    want = b"".join(scalar_frames(mixed, LineMerger()))
+    assert res.block.data == want
+
+
+def test_device_3164_fuzz_vs_scalar(monkeypatch):
+    monkeypatch.setattr(device_rfc3164, "FALLBACK_FRAC", 1.1)
+    rng = random.Random(7)
+    months = ["Jan", "Feb", "Mar", "Sep", "Oct", "Dec"]
+    msgs = ["hello", 'say "hi"', "tab\there", "", "-", "trail   ",
+            "back\\slash", "x" * 150]
+    lines = []
+    for i in range(200):
+        pri = f"<{rng.randrange(0, 192)}>" if rng.random() < 0.8 else ""
+        day = rng.randint(1, 28)
+        line = (f"{pri}{rng.choice(months)} {day:2d} "
+                f"{rng.randint(0, 23):02d}:{rng.randint(0, 59):02d}:"
+                f"{rng.randint(0, 59):02d} host{i % 9} app{i % 5}: "
+                f"{rng.choice(msgs)}")
+        lines.append(line.encode())
+    for merger in (LineMerger(), NulMerger(), SyslenMerger()):
+        res, _ = run_device(lines, merger)
+        assert res is not None
+        want = b"".join(scalar_frames(lines, merger))
+        assert res.block.data == want
+
+
+def test_batch_handler_3164_uses_device_engine():
+    tx = queue.Queue()
+    h = BatchHandler(tx, ORACLE, ENC, Config.from_string(""),
+                     fmt="rfc3164", start_timer=False, merger=LineMerger())
+    n0 = metrics.get("device_encode_rows")
+    for ln in CLEAN * 4:
+        h.handle_bytes(ln)
+    h.flush()
+    assert metrics.get("device_encode_rows") - n0 == len(CLEAN) * 4
+    data = b""
+    while not tx.empty():
+        item = tx.get_nowait()
+        data += item.data if isinstance(item, EncodedBlock) else item
+    assert data == b"".join(scalar_frames(CLEAN * 4, LineMerger()))
+
+
+def test_device_3164_compaction_fetch_is_output_sized():
+    rng = random.Random(3)
+    lines = []
+    for i in range(192):
+        msg = "y" * rng.randrange(1, 100)
+        lines.append(
+            f"<{i % 192}>Sep {1 + i % 28:2d} 12:35:{i % 60:02d} "
+            f"h{i} app: {msg}".encode())
+    n0 = metrics.get("device_encode_fetch_bytes")
+    res, _ = run_device(lines, LineMerger())
+    assert res is not None
+    want = b"".join(scalar_frames(lines, LineMerger()))
+    assert res.block.data == want
+    fetched = metrics.get("device_encode_fetch_bytes") - n0
+    assert fetched < len(res.block.data) * 1.2 + 64 * len(lines)

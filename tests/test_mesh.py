@@ -160,8 +160,8 @@ def test_sharded_formats_bitwise_equal(fmt, lines):
                                           jnp.asarray(lens))
         out = gelf_mod.decode_gelf_submit(batch, lens, sharded)[0]
     else:
-        single = rfc3164_mod.decode_rfc3164_submit(batch, lens)
-        out = rfc3164_mod.decode_rfc3164_submit(batch, lens, sharded)
+        single = rfc3164_mod.decode_rfc3164_submit(batch, lens)[0]
+        out = rfc3164_mod.decode_rfc3164_submit(batch, lens, sharded)[0]
     for k in single:
         a, b = np.asarray(single[k]), np.asarray(out[k])
         assert a.shape == b.shape, k
