@@ -121,7 +121,9 @@ def bench_e2e(lines, jax, jnp, extra):
 
     best = None
     best_snap = None
-    for trial in range(2):
+    trials = 1 if os.environ.get("JAX_PLATFORMS", "").startswith("cpu") \
+        else 2
+    for trial in range(trials):
         tx = queue_mod.Queue()
         handler = BatchHandler(
             tx, RFC5424Decoder(), GelfEncoder(Config.from_string("")),
@@ -208,6 +210,93 @@ def bench_e2e(lines, jax, jnp, extra):
         "declined": round(best_snap["device_encode_declined_seconds"], 3),
         "sink": round(best_snap["sink_seconds"], 3),
     }
+
+
+def bench_fallback_corpora(jax, jnp, extra, small: bool):
+    """Tier-economics measurement (VERDICT r3 #5): adversarial corpora
+    through the device-encode route, reporting device-tier residency,
+    decline rate, and scalar-fallback share — the numbers that justify
+    FALLBACK_FRAC / E_CAP / the 6-pair tier, instead of guessing."""
+    from flowgger_tpu.config import Config
+    from flowgger_tpu.encoders.gelf import GelfEncoder
+    from flowgger_tpu.mergers import LineMerger
+    from flowgger_tpu.tpu import device_gelf, pack, rfc5424
+    from flowgger_tpu.utils.metrics import registry as metrics
+
+    n = 2_048 if small else 65_536
+    rng = random.Random(9)
+
+    def syslog(i, sd, msg):
+        return (f'<{i % 192}>1 2023-09-20T12:35:45.{i % 1000:03d}Z '
+                f'h{i % 50} app {i} m {sd} {msg}').encode()
+
+    corpora = {
+        # the flagship corpus: everything should stay on the device tier
+        "clean": [syslog(i, f'[sd@1 k="{i}" x="y"]', f"event {i}")
+                  for i in range(n)],
+        # escaped quotes in values: val_has_esc rows leave the device
+        # tier (host span tiers), E_CAP bounds the escape ladder
+        "escape_heavy": [
+            syslog(i, f'[sd@1 k="a\\"b{i}" x="c\\\\d"]', "esc " * 3)
+            for i in range(n)],
+        # 8 pairs: beyond the 6-pair device tier, inside the 16-pair
+        # rescue kernel — device decode, host span encode
+        "pairs8": [
+            syslog(i, "[sd@1 " + " ".join(
+                f'k{j}="{j}"' for j in range(8)) + "]", "multi")
+            for i in range(n)],
+        # 20 pairs: beyond rescue — scalar oracle rows
+        "pairs20": [
+            syslog(i, "[sd@1 " + " ".join(
+                f'k{j}="{j}"' for j in range(20)) + "]", "multi")
+            for i in range(n)],
+        # near-unique sub-second stamps: the native timestamp formatter
+        # path (dedup would save nothing here)
+        "unique_ts": [
+            (f'<13>1 2023-09-20T12:35:45.{rng.randrange(10**9):09d}Z '
+             f'h app {i} m [sd@1 k="v"] unique stamp {i}').encode()
+            for i in range(n)],
+    }
+
+    enc = GelfEncoder(Config.from_string(""))
+    merger = LineMerger()
+    # warmup: compile the decode + both encode-kernel phases once (same
+    # [n, MAX_LEN] shape as every corpus) so the first corpus'
+    # encode_ms is execution, not compilation
+    warm = pack.pack_lines_2d(corpora["clean"], MAX_LEN)
+    device_gelf.fetch_encode(
+        rfc5424.decode_rfc5424_submit(warm[0], warm[1]), warm, enc,
+        merger, route_state={})
+    results = {}
+    for name, lines in corpora.items():
+        packed = pack.pack_lines_2d(lines, MAX_LEN)
+        handle = rfc5424.decode_rfc5424_submit(packed[0], packed[1])
+        snap0 = metrics.snapshot()
+        t0 = time.perf_counter()
+        res, _ = device_gelf.fetch_encode(handle, packed, enc, merger,
+                                          route_state={})
+        dt = time.perf_counter() - t0
+        snap1 = metrics.snapshot()
+        d = {k: snap1.get(k, 0) - snap0.get(k, 0)
+             for k in ("device_encode_rows", "device_encode_scalar_rows",
+                       "device_encode_declined")}
+        if res is None:
+            # declined: the span-fetch host path takes over
+            results[name] = {"declined": True,
+                             "device_rows_pct": 0.0,
+                             "route": "host-span"}
+        else:
+            total = max(1, len(lines))
+            results[name] = {
+                "declined": False,
+                "device_rows_pct": round(
+                    100.0 * d["device_encode_rows"] / total, 1),
+                "scalar_rows_pct": round(
+                    100.0 * d["device_encode_scalar_rows"] / total, 1),
+                "encode_ms": round(dt * 1e3, 1),
+            }
+        print(f"corpus {name}: {results[name]}", file=sys.stderr)
+    extra["fallback_corpora"] = results
 
 
 def bench_other_configs(jax, jnp, dev, cpu_fallback, smoke, extra):
@@ -309,7 +398,9 @@ def main():
     import os
 
     smoke = bool(os.environ.get("FLOWGGER_BENCH_SMOKE"))
-    cpu_fallback = True if smoke else not _tpu_responsive()
+    force_cpu = bool(os.environ.get("FLOWGGER_BENCH_CPU"))
+    cpu_fallback = (True if (smoke or force_cpu)
+                    else not _tpu_responsive())
     if cpu_fallback:
         if not smoke:
             print(
@@ -336,7 +427,9 @@ def main():
         BATCH_LINES, CHAIN, TRIALS, E2E_BATCH = 8_192, 2, 1, 8_192
     elif cpu_fallback:
         # keep the degraded run bounded: smaller batch, shorter chain
-        BATCH_LINES, CHAIN, TRIALS, E2E_BATCH = 262_144, 2, 1, 131_072
+        # (the CPU backend executes the kernels ~100x slower than a
+        # chip; these sizes keep the whole degraded run under ~5 min)
+        BATCH_LINES, CHAIN, TRIALS, E2E_BATCH = 131_072, 2, 1, 32_768
 
     lines = gen_lines(BATCH_LINES)
     t0 = time.perf_counter()
@@ -373,22 +466,34 @@ def main():
         file=sys.stderr,
     )
 
-    # batch latency incl. the dispatch round trip (p99 proxy: max of trials)
+    # batch decode latency incl. the dispatch round trip — a real p99
+    # (BASELINE.json metric: "p99 decode latency @ 1M-line batch"):
+    # >= 100 trials on the device backend, bounded on degraded runs
+    lat_trials = 3 if smoke else (10 if cpu_fallback else 100)
     lat = []
-    single = jax.jit(lambda b, ln: rfc5424.decode_rfc5424(b, ln)["ok"].sum())
+    single = jax.jit(lambda b, ln: digest_all(
+        jnp, rfc5424.decode_rfc5424(b, ln)))
     int(single(db, dl))
-    for _ in range(5):
+    for _ in range(lat_trials):
         t0 = time.perf_counter()
         int(single(db, dl))
         lat.append(time.perf_counter() - t0)
     lat.sort()
+    p50 = lat[len(lat) // 2]
+    p99 = lat[min(len(lat) - 1, max(0, -(-99 * len(lat) // 100) - 1))]
     print(
-        f"single-batch decode latency (incl. dispatch rtt): "
-        f"p50={lat[len(lat) // 2] * 1e3:.0f}ms max={lat[-1] * 1e3:.0f}ms",
+        f"single-batch decode latency (incl. dispatch rtt, "
+        f"{lat_trials} trials): p50={p50 * 1e3:.0f}ms "
+        f"p99={p99 * 1e3:.0f}ms max={lat[-1] * 1e3:.0f}ms",
         file=sys.stderr,
     )
 
-    extra = {}
+    extra = {"batch_latency_ms": {"p50": round(p50 * 1e3, 1),
+                                  "p99": round(p99 * 1e3, 1),
+                                  "max": round(lat[-1] * 1e3, 1),
+                                  "trials": lat_trials,
+                                  "batch_lines": n}}
+    bench_fallback_corpora(jax, jnp, extra, smoke or cpu_fallback)
     bench_e2e(lines[:E2E_BATCH], jax, jnp, extra)
     bench_other_configs(jax, jnp, dev, cpu_fallback, smoke, extra)
 
