@@ -392,7 +392,11 @@ class BatchHandler(Handler):
                     and not self.encoder.extra
                     and not (self._auto_ltsv and self._auto_ltsv.schema))
         if type(self.encoder) is GelfEncoder:
-            return not self.encoder.extra
+            # extras with static placement ride the columnar route as
+            # constant segments (encode_gelf_block.gelf_extra_slots)
+            from .encode_gelf_block import gelf_extra_slots
+
+            return gelf_extra_slots(self.encoder.extra) is not None
         if type(self.encoder) is PassthroughEncoder:
             return self._passthrough_ok
         return type(self.encoder) in (RFC5424Encoder, LTSVEncoder)
@@ -420,6 +424,10 @@ class BatchHandler(Handler):
             # GELF output is columnar for every kernel format, so the
             # only possible blockers are the extras / the auto schema
             if enc.extra:
+                if self.fmt == "rfc5424":
+                    return ("output.gelf_extra keys need dynamic "
+                            "placement (leading '_' or a fixed-key "
+                            "overwrite)")
                 return "output.gelf_extra is set"
             if (self.fmt == "auto" and self._auto_ltsv
                     and self._auto_ltsv.schema):

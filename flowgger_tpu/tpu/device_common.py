@@ -73,11 +73,13 @@ def _rot_rows(x, r, w: int):
     return x
 
 
-def _out_width(L: int) -> int:
+def _out_width(L: int, src_width: int = 0) -> int:
     """Static output width: a power of two covering the concatenated
-    source row and typical GELF output for lines of width L."""
+    source row (``src_width`` = escaped line + constant bank + ts text,
+    which the rotate-assembly requires to fit) and typical GELF output
+    for lines of width L."""
     w = 512
-    while w < 2 * L:
+    while w < 2 * L or w < src_width:
         w *= 2
     return w
 

@@ -100,23 +100,38 @@ _NET6 = ((0, 5), (1, 3), (2, 4), (1, 2), (3, 4), (0, 3), (2, 5),
          (0, 1), (2, 3), (4, 5), (1, 2), (3, 4))
 
 
-def _bank(suffix: bytes) -> Tuple[bytes, Dict[str, int]]:
+def _bank(suffix: bytes, extras: Tuple[Tuple[str, str], ...] = ()
+          ) -> Tuple[bytes, Dict[str, int], Dict[str, bytes]]:
+    """Constant bank with any ``gelf_extra`` pairs folded into the
+    neighbouring segment constants (static insertion slots — the same
+    gelf_extra_consts the host tier uses, so the two tiers can never
+    disagree on extras placement)."""
+    from .encode_gelf_block import gelf_extra_consts
+
+    parts = dict(_PARTS)
+    if extras:
+        econsts = gelf_extra_consts(list(extras))
+        assert econsts is not None  # route_ok pre-checked
+        (parts["open"], parts["app"], parts["full"], parts["host"],
+         parts["level"], parts["proc"], parts["p6x"], parts["short"],
+         parts["ts"], parts["tail"]) = econsts
     offs, bank = {}, b""
-    for k, v in _PARTS.items():
+    for k, v in parts.items():
         if k == "tail":
             v = v + suffix
         offs[k] = len(bank)
         bank += v
-    return bank, offs
+    return bank, offs, parts
 
 
 @partial(jax.jit, static_argnames=("suffix", "max_sd", "impl",
-                                   "assemble"))
+                                   "assemble", "extras"))
 def _encode_kernel(batch, lens, dec, ts_text, ts_len, *, suffix: bytes,
-                   max_sd: int, impl: str, assemble: bool = True):
+                   max_sd: int, impl: str, assemble: bool = True,
+                   extras: Tuple[Tuple[str, str], ...] = ()):
     N, L = batch.shape
-    OW = _out_width(L)
-    bank, off = _bank(suffix)
+    bank, off, parts = _bank(suffix, extras)
+    OW = _out_width(L, L + E_CAP + len(bank) + TS_W)
     iota = jax.lax.broadcasted_iota(_I32, (N, L), 1)
     bb = batch.astype(_I32)
 
@@ -203,8 +218,8 @@ def _encode_kernel(batch, lens, dec, ts_text, ts_len, *, suffix: bytes,
     segs = []  # (src0 [N], seglen [N]) in destination order
 
     def add_const(name, gate=None):
-        ln = zero + len(_PARTS[name]) + (len(suffix) if name == "tail"
-                                         else 0)
+        ln = zero + len(parts[name]) + (len(suffix) if name == "tail"
+                                        else 0)
         if gate is not None:
             ln = jnp.where(gate, ln, 0)
         segs.append((zero + (cbase + off[name]), ln))
@@ -231,13 +246,17 @@ def _encode_kernel(batch, lens, dec, ts_text, ts_len, *, suffix: bytes,
     add_const("host")
     host_empty = host_e <= host_s
     segs.append((jnp.where(host_empty, cbase + off["unknown"], host_s),
-                 jnp.where(host_empty, len(_PARTS["unknown"]),
+                 jnp.where(host_empty, len(parts["unknown"]),
                            host_e - host_s)))
     add_const("level")
     segs.append((cbase + off["sevd"] + dec["severity"].astype(_I32),
                  zero + 1))
     add_const("proc")
     add_span(proc_s, proc_e)
+    if parts.get("p6x"):
+        # extras sorting between "process_id" and "sd_id": always-on
+        # constant ahead of the (gated) sd_id segment
+        add_const("p6x")
     add_const("sdid", nsd)
     add_span(sid_s, sid_e, nsd)
     add_const("short")
@@ -270,15 +289,19 @@ def _encode_kernel(batch, lens, dec, ts_text, ts_len, *, suffix: bytes,
 
 
 def route_ok(encoder, merger) -> bool:
-    """Device encode applies to GELF output without extras over
-    line/nul/syslen framing (the syslen prefix is spliced host-side
-    over the output-sized device body)."""
+    """Device encode applies to GELF output over line/nul/syslen framing
+    (the syslen prefix is spliced host-side over the output-sized device
+    body); gelf_extra rides as constant segments when its keys have
+    static placement (encode_gelf_block.gelf_extra_slots)."""
     from ..encoders.gelf import GelfEncoder
     from ..mergers import LineMerger, NulMerger, SyslenMerger
+    from .encode_gelf_block import gelf_extra_slots
 
     if os.environ.get("FLOWGGER_DEVICE_ENCODE", "1") == "0":
         return False
-    if type(encoder) is not GelfEncoder or encoder.extra:
+    if type(encoder) is not GelfEncoder:
+        return False
+    if encoder.extra and gelf_extra_slots(encoder.extra) is None:
         return False
     return merger is None or type(merger) in (LineMerger, NulMerger,
                                               SyslenMerger)
@@ -308,11 +331,13 @@ def fetch_encode(handle, packed, encoder, merger, route_state=None):
     out, _, _, max_sd, impl_unused, batch_dev, lens_dev = handle
     suffix, syslen = merger_suffix(merger)
     impl = best_scan_impl()
+    extras = tuple((k, v) for k, v in getattr(encoder, "extra", ()))
 
     def kernel(ts_text, ts_len, assemble):
         return _encode_kernel(batch_dev, lens_dev, dict(out), ts_text,
                               ts_len, suffix=suffix, max_sd=max_sd,
-                              impl=impl, assemble=assemble)
+                              impl=impl, assemble=assemble,
+                              extras=extras)
 
     from .materialize import _scalar_line
 

@@ -24,6 +24,7 @@ import jax.numpy as jnp
 
 from .device_common import (
     E_CAP,
+    TS_W,
     _out_width,
     assemble_rows,
     escape_stage,
@@ -73,8 +74,8 @@ def _bank(suffix: bytes):
 def _encode_kernel(batch, lens, dec, ts_text, ts_len, *, suffix: bytes,
                    impl: str, assemble: bool = True):
     N, L = batch.shape
-    OW = _out_width(L)
     bank, off = _bank(suffix)
+    OW = _out_width(L, L + E_CAP + len(bank) + TS_W)
     iota = jax.lax.broadcasted_iota(_I32, (N, L), 1)
 
     es = escape_stage(batch, lens, iota,
@@ -127,11 +128,13 @@ def _encode_kernel(batch, lens, dec, ts_text, ts_len, *, suffix: bytes,
 
 
 def route_ok(encoder, merger) -> bool:
-    """Same applicability as the rfc5424 device route: GELF output
-    without extras over line/nul/syslen framing."""
+    """GELF output over line/nul/syslen framing, WITHOUT extras: this
+    kernel's segment table has no extras slots (unlike device_gelf's),
+    so accepting an extras encoder would silently drop its pairs."""
     from . import device_gelf
 
-    return device_gelf.route_ok(encoder, merger)
+    return (not getattr(encoder, "extra", None)
+            and device_gelf.route_ok(encoder, merger))
 
 
 def fetch_encode(handle, packed, encoder, merger, route_state=None):

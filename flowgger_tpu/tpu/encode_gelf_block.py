@@ -33,6 +33,9 @@ from typing import Dict, Optional
 
 import numpy as np
 
+# serde_json-compatible string escaping (shared with encoders/gelf.py)
+from json.encoder import encode_basestring as _quote
+
 from ..mergers import Merger
 from ..utils.rustfmt import json_f64
 from .assemble import (
@@ -55,6 +58,10 @@ __all__ = ["encode_rfc5424_gelf_block", "BlockResult", "merger_suffix"]
 
 _NAME_KEY_MAX = 48   # numpy tier: SD names longer than this fall back
 _NATIVE_MAX_PAIRS = 64  # kMaxPairs in flowgger_host.cpp
+# numpy tier row stride: the open-brace slot + the canonical tail
+# columns (asserted against len(cols) below so the two can't desync)
+_TAIL_COLS = 18
+_ROW_STRIDE = 1 + _TAIL_COLS
 
 # constant bank --------------------------------------------------------------
 _C_OPEN = b"{"
@@ -74,6 +81,75 @@ _C_UNKNOWN = b"unknown"
 _C_DASH = b"-"
 _C_SEVD = b"01234567"
 
+_FIXED_KEYS = ("application_name", "full_message", "host", "level",
+               "process_id", "sd_id", "short_message", "timestamp",
+               "version")
+
+
+def gelf_extra_slots(extra):
+    """Render ``[output.gelf_extra]`` pairs into the static insertion
+    slots of the rfc5424 GELF layout (serde_json BTreeMap order means a
+    non-``_`` key's position among the fixed keys is config-static, so
+    each extra is a constant byte run folded into the neighbouring
+    segment constant).  Slot text forms: ``self`` (before a key, fully
+    quoted + trailing comma), ``string-close`` (after a string value:
+    leading ``",`` closes it, own closing quote supplied by the next
+    constant), ``number`` (after a bare number: self-contained with a
+    leading comma).  Returns the slot dict, or None when any key needs
+    dynamic placement — a leading ``_`` interleaves with SD pairs, and
+    a fixed-key name overwrites a computed field (gelf_encoder.rs
+    extras overwrite everything) — those configs take the Record path.
+    """
+    slots = {k: b"" for k in ("open", "app", "full", "host", "level",
+                              "proc", "p6", "short", "ts", "tail_num",
+                              "tail_ver")}
+    for k, v in sorted(extra or ()):
+        if k.startswith("_") or k in _FIXED_KEYS:
+            return None
+        kq = _quote(k).encode("utf-8")
+        vq = _quote(v).encode("utf-8")
+        sc = b'",' + kq + b":" + vq[:-1]       # string-close form
+        if k < "_":
+            slots["open"] += kq + b":" + vq + b","
+        elif k < "application_name":
+            slots["app"] += kq + b":" + vq + b","
+        elif k < "full_message":
+            slots["full"] += sc
+        elif k < "host":
+            slots["host"] += sc
+        elif k < "level":
+            slots["level"] += sc
+        elif k < "process_id":
+            slots["proc"] += b"," + kq + b":" + vq
+        elif k < "sd_id":
+            slots["p6"] += sc
+        elif k < "short_message":
+            slots["short"] += sc
+        elif k < "timestamp":
+            slots["ts"] += sc
+        elif k < "version":
+            slots["tail_num"] += b"," + kq + b":" + vq
+        else:
+            slots["tail_ver"] += sc
+    return slots
+
+
+def gelf_extra_consts(extra):
+    """(open, app, full, host, level, proc, p6, short, ts, tail) segment
+    constants with the extras folded in; None when unsupported."""
+    slots = gelf_extra_slots(extra)
+    if slots is None:
+        return None
+    tail = _C_TAIL
+    if slots["tail_num"] or slots["tail_ver"]:
+        tail = (slots["tail_num"] + b',"version":"1.1'
+                + slots["tail_ver"] + b'"}')
+    return (_C_OPEN + slots["open"], slots["app"] + _C_APP,
+            slots["full"] + _C_FULL, slots["host"] + _C_HOST,
+            slots["level"] + _C_LEVEL, slots["proc"] + _C_PROC,
+            slots["p6"], slots["short"] + _C_SHORT,
+            slots["ts"] + _C_TS, tail)
+
 
 def encode_rfc5424_gelf_block(
     chunk_bytes: bytes,
@@ -85,13 +161,19 @@ def encode_rfc5424_gelf_block(
     encoder,
     merger: Optional[Merger],
 ) -> Optional[BlockResult]:
-    """Returns None when this route can't apply (gelf_extra configured or
-    an unknown merger type) — the caller then uses the per-row path."""
+    """Returns None when this route can't apply (gelf_extra keys that
+    need dynamic placement, or an unknown merger type) — the caller
+    then uses the per-row path."""
     from .. import native
 
     spec = merger_suffix(merger)
-    if spec is None or encoder.extra:
+    if spec is None:
         return None
+    econsts = gelf_extra_consts(encoder.extra)
+    if econsts is None:
+        return None
+    (c_open, c_app, c_full, c_host, c_level, c_proc, c_p6, c_short,
+     c_ts, c_tail) = econsts
     suffix, syslen = spec
 
     n = int(n_real)
@@ -108,7 +190,11 @@ def encode_rfc5424_gelf_block(
     cand = ok & (lens64 <= max_len) & ~has_high
 
     chunk_arr = np.frombuffer(chunk_bytes, dtype=np.uint8)
+    # the native row assembler predates the extras slots: extras run on
+    # the numpy segment engine (still columnar, still ~20x the Record
+    # path)
     use_native = (native.gelf_rows_available()
+                  and not encoder.extra
                   and name_start.shape[1] <= _NATIVE_MAX_PAIRS)
     if not use_native and val_has_esc.shape[1]:
         # the numpy engine emits value spans through the shared escaped
@@ -208,11 +294,12 @@ def encode_rfc5424_gelf_block(
 
         scratch, ts_off, ts_len = ts_scratch(out, n, ridx, json_f64)
         const_bank, coffs = build_source(
-            _C_OPEN, _C_P0, _C_P1, _C_P2, _C_APP, _C_FULL, _C_HOST,
-            _C_LEVEL, _C_PROC, _C_SDID, _C_SHORT, _C_TS, _C_TAIL + suffix,
-            _C_UNKNOWN, _C_DASH, _C_SEVD)
+            c_open, _C_P0, _C_P1, _C_P2, c_app, c_full, c_host,
+            c_level, c_proc, _C_SDID, c_short, c_ts, c_tail + suffix,
+            _C_UNKNOWN, _C_DASH, _C_SEVD, c_p6)
         (o_open, o_p0, o_p1, o_p2, o_app, o_full, o_host, o_level, o_proc,
-         o_sdid, o_short, o_ts, o_tail, o_unknown, o_dash, o_sevd) = coffs
+         o_sdid, o_short, o_ts, o_tail, o_unknown, o_dash, o_sevd,
+         o_p6) = coffs
         cbase = int(esc.size)
         tbase = cbase + int(const_bank.size)
         src = np.concatenate([
@@ -226,8 +313,9 @@ def encode_rfc5424_gelf_block(
         msg_len = np.where(msg_len == 0, 1, msg_len)
 
         # ---- segment stream (column-wise construction) ---------------
-        # every row gets 18 fixed segment slots (brace + 17 canonical
-        # tail parts, with the sd_id pair zero-length when absent) plus
+        # every row gets 19 fixed segment slots (brace + 18 canonical
+        # tail parts — incl. the extras slot between process_id and
+        # sd_id — with the sd_id pair zero-length when absent) plus
         # 5 slots per SD pair, so destinations are pure index arithmetic
         # and each column is one R- or T-sized write — no S-sized masks.
         pc2 = np.where(cand & (np.asarray(sd_count) > 0),
@@ -235,13 +323,13 @@ def encode_rfc5424_gelf_block(
         p = pc2[ridx]
         T2 = ns_s.size
         pb = exclusive_cumsum(p)
-        rstart = 18 * np.arange(R, dtype=np.int64) + 5 * pb[:-1]
-        S = 18 * R + 5 * T2
+        rstart = _ROW_STRIDE * np.arange(R, dtype=np.int64) + 5 * pb[:-1]
+        S = _ROW_STRIDE * R + 5 * T2
         seg_src = np.empty(S, dtype=np.int64)
         seg_len = np.empty(S, dtype=np.int64)
 
         seg_src[rstart] = cbase + o_open
-        seg_len[rstart] = 1
+        seg_len[rstart] = len(c_open)
 
         if T2:
             name_src = emap.map(ns_s)
@@ -267,29 +355,31 @@ def encode_rfc5424_gelf_block(
             seg_src[pair_dest] = pair_src2
             seg_len[pair_dest] = pair_len2
 
-        tail_dest = (rstart + 1 + 5 * p)[:, None] + np.arange(
-            17, dtype=np.int64)[None, :]
-        tsrc = np.empty((R, 17), dtype=np.int64)
-        tlen = np.empty((R, 17), dtype=np.int64)
         cols = (
-            (cbase + o_app, len(_C_APP)),
+            (cbase + o_app, len(c_app)),
             (app_src, app_len),
-            (cbase + o_full, len(_C_FULL)),
+            (cbase + o_full, len(c_full)),
             (full_src, full_len),
-            (cbase + o_host, len(_C_HOST)),
+            (cbase + o_host, len(c_host)),
             (host_src, host_len),
-            (cbase + o_level, len(_C_LEVEL)),
+            (cbase + o_level, len(c_level)),
             (cbase + o_sevd + sev, 1),
-            (cbase + o_proc, len(_C_PROC)),
+            (cbase + o_proc, len(c_proc)),
             (proc_src, proc_len),
+            (cbase + o_p6, len(c_p6)),
             (cbase + o_sdid, np.where(nsd, len(_C_SDID), 0)),
             (sid_src, np.where(nsd, sid_len, 0)),
-            (cbase + o_short, len(_C_SHORT)),
+            (cbase + o_short, len(c_short)),
             (msg_src, msg_len),
-            (cbase + o_ts, len(_C_TS)),
+            (cbase + o_ts, len(c_ts)),
             (ts_src, ts_len),
-            (cbase + o_tail, len(_C_TAIL) + len(suffix)),
+            (cbase + o_tail, len(c_tail) + len(suffix)),
         )
+        assert len(cols) == _TAIL_COLS
+        tail_dest = (rstart + 1 + 5 * p)[:, None] + np.arange(
+            _TAIL_COLS, dtype=np.int64)[None, :]
+        tsrc = np.empty((R, _TAIL_COLS), dtype=np.int64)
+        tlen = np.empty((R, _TAIL_COLS), dtype=np.int64)
         for k, (s, ln) in enumerate(cols):
             tsrc[:, k] = s
             tlen[:, k] = ln

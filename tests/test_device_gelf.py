@@ -287,7 +287,7 @@ def test_record_path_cliff_warns_at_startup(capsys):
     from flowgger_tpu.encoders.rfc5424 import RFC5424Encoder
 
     enc_extra = GelfEncoder(Config.from_string(
-        '[output.gelf_extra]\nstatic_key = "v"\n'))
+        '[output.gelf_extra]\n_dynamic_key = "v"\n'))
     BatchHandler(queue.Queue(), RFC5424Decoder(), enc_extra,
                  Config.from_string(""), fmt="rfc5424",
                  start_timer=False, merger=LineMerger())
@@ -325,3 +325,78 @@ def test_device_syslen_framing_matches_scalar():
     assert metrics.get("device_encode_rows") - n0 == len(CLEAN) * 3
     want = b"".join(scalar_frames(CLEAN * 3, merger))
     assert res.block.data == want
+
+
+def _extra_enc(pairs_toml):
+    return GelfEncoder(Config.from_string(f"[output.gelf_extra]\n{pairs_toml}"))
+
+
+def test_gelf_extra_static_slots_device_and_host():
+    """gelf_extra as constant segments: keys covering every static
+    insertion slot (before pairs, between each fixed key, after
+    version) must produce bytes identical to the scalar encoder, on
+    both the device tier and the host span tier."""
+    enc = _extra_enc(
+        'Zone = "eu"\n'          # < "_": before the SD pairs
+        'about = "x"\n'          # pairs < k < application_name
+        'country = "de"\n'       # application_name < k < full_message
+        'gateway = "gw1"\n'      # full_message < k < host
+        'kind = "syslog"\n'      # host < k < level
+        'origin = "edge"\n'      # level < k < process_id (after number)
+        'rack = "r7"\n'          # process_id < k < sd_id (p6 slot)
+        'service = "ingest"\n'   # sd_id < k < short_message
+        'stage = "prod"\n'       # short_message < k < timestamp
+        'tier = "t0"\n'          # timestamp < k < version (after number)
+        'zzz = "last"\n')        # > version: inside the tail
+    # short lines so base GELF + ~170 extras bytes stays inside the
+    # device tier's OW=512 output budget (oversized rows legitimately
+    # fall back — covered by the host-tier half below)
+    short = [
+        b'<13>1 2023-09-20T12:35:45.123Z h app 1 M [x@1 k="v"] hi',
+        b'<165>1 2003-10-11T22:14:15.003Z m ev - I7 - short line',
+        b'<0>1 2023-01-01T00:00:00Z - - - - - -',
+    ] * 2
+
+    def oracle(lines):
+        return b"".join(LineMerger().frame(enc.encode(
+            ORACLE.decode(ln.decode()))) for ln in lines)
+
+    packed = pack.pack_lines_2d(short, 256)
+    handle = rfc5424.decode_rfc5424_submit(packed[0], packed[1])
+    n0 = metrics.get("device_encode_rows")
+    res, _ = device_gelf.fetch_encode(handle, packed, enc, LineMerger())
+    assert res is not None
+    assert metrics.get("device_encode_rows") - n0 == len(short)
+    assert res.block.data == oracle(short)
+
+    # host span tier (numpy engine — native excluded for extras),
+    # including the long lines the device tier would reject
+    from flowgger_tpu.tpu.encode_gelf_block import encode_rfc5424_gelf_block
+
+    packed2 = pack.pack_lines_2d(CLEAN * 2, 256)
+    handle2 = rfc5424.decode_rfc5424_submit(packed2[0], packed2[1])
+    host_out = rfc5424.decode_rfc5424_fetch(handle2)
+    res2 = encode_rfc5424_gelf_block(packed2[2], packed2[3], packed2[4],
+                                     host_out, packed2[5], 256, enc,
+                                     LineMerger())
+    assert res2 is not None and res2.block.data == oracle(CLEAN * 2)
+
+
+def test_gelf_extra_dynamic_keys_take_record_path():
+    """Leading-underscore or fixed-key extras need dynamic placement:
+    the block route must refuse (encoder still handles them via the
+    Record path) and the startup warning must say why."""
+    from flowgger_tpu.tpu.encode_gelf_block import gelf_extra_slots
+
+    assert gelf_extra_slots([("_custom", "v")]) is None
+    assert gelf_extra_slots([("host", "override")]) is None
+    assert gelf_extra_slots([("region", "eu")]) is not None
+
+    h = BatchHandler(queue.Queue(), RFC5424Decoder(),
+                     _extra_enc('_custom = "v"\n'), Config.from_string(""),
+                     fmt="rfc5424", start_timer=False, merger=LineMerger())
+    assert not h._block_route_ok()
+    h2 = BatchHandler(queue.Queue(), RFC5424Decoder(),
+                      _extra_enc('region = "eu"\n'), Config.from_string(""),
+                      fmt="rfc5424", start_timer=False, merger=LineMerger())
+    assert h2._block_route_ok()

@@ -130,3 +130,12 @@ def test_device_3164_compaction_fetch_is_output_sized():
     assert res.block.data == want
     fetched = metrics.get("device_encode_fetch_bytes") - n0
     assert fetched < len(res.block.data) * 1.2 + 64 * len(lines)
+
+
+def test_3164_device_route_rejects_extras():
+    """The rfc3164 device kernel has no extras slots: an extras encoder
+    must not engage it (output would silently drop the extra pairs);
+    the host/scalar paths still emit them."""
+    enc = GelfEncoder(Config.from_string(
+        '[output.gelf_extra]\nregion = "eu"\n'))
+    assert device_rfc3164.route_ok(enc, LineMerger()) is False
