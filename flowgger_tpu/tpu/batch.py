@@ -106,6 +106,7 @@ class BatchHandler(Handler):
         from ..encoders.gelf import GelfEncoder
         from ..encoders.ltsv import LTSVEncoder
         from ..encoders.passthrough import PassthroughEncoder
+        from ..encoders.rfc3164 import RFC3164Encoder
         from ..encoders.rfc5424 import RFC5424Encoder
 
         passthrough_ok = (type(encoder) is PassthroughEncoder
@@ -118,7 +119,9 @@ class BatchHandler(Handler):
                   or passthrough_ok))
             or (fmt in ("rfc3164", "ltsv", "gelf", "auto")
                 and type(encoder) is GelfEncoder)
-            or (fmt == "rfc3164" and passthrough_ok))
+            or (fmt == "rfc3164"
+                and (passthrough_ok
+                     or type(encoder) is RFC3164Encoder)))
         # single source of truth for kernel dispatch: fmt -> batch decoder
         auto_ltsv = self._auto_ltsv_decoder(cfg) if fmt == "auto" else None
         self._auto_ltsv = auto_ltsv
@@ -376,6 +379,12 @@ class BatchHandler(Handler):
             # this route, so extras stay on the fast tier here
             return True
         if self.fmt == "rfc3164":
+            from ..encoders.rfc3164 import RFC3164Encoder
+
+            if type(self.encoder) is RFC3164Encoder:
+                # syslog->syslog relay re-encode; the prepend-timestamp
+                # option is wall-clock-at-encode-time (per-call)
+                return self.encoder.header_time_format is None
             return self._passthrough_ok or (
                 type(self.encoder) is GelfEncoder
                 and not self.encoder.extra)
@@ -434,6 +443,10 @@ class BatchHandler(Handler):
                 return "input.ltsv_schema is set"
             return no_columnar
         if t is PassthroughEncoder and self.fmt in ("rfc5424", "rfc3164"):
+            return "output.syslog_prepend_timestamp is set"
+        from ..encoders.rfc3164 import RFC3164Encoder
+
+        if t is RFC3164Encoder and self.fmt == "rfc3164":
             return "output.syslog_prepend_timestamp is set"
         return no_columnar
 
@@ -615,9 +628,11 @@ def block_fetch_encode(fmt, handle, packed, encoder, merger,
         route_state = route_state.setdefault(fmt, {})
     if fmt == "rfc3164":
         from ..encoders.passthrough import PassthroughEncoder
+        from ..encoders.rfc3164 import RFC3164Encoder
         from . import (
             device_rfc3164,
             encode_passthrough_block,
+            encode_rfc3164_3164_block,
             encode_rfc3164_gelf_block,
             rfc3164,
         )
@@ -633,9 +648,13 @@ def block_fetch_encode(fmt, handle, packed, encoder, merger,
             t0 = _time.perf_counter()
         host_out = rfc3164.decode_rfc3164_fetch(handle)
         t1 = _time.perf_counter()
-        fn3164 = (encode_passthrough_block.encode_rfc3164_passthrough_block
-                  if type(encoder) is PassthroughEncoder
-                  else encode_rfc3164_gelf_block.encode_rfc3164_gelf_block)
+        fn3164 = {
+            PassthroughEncoder:
+                encode_passthrough_block.encode_rfc3164_passthrough_block,
+            RFC3164Encoder:
+                encode_rfc3164_3164_block.encode_rfc3164_3164_block,
+        }.get(type(encoder),
+              encode_rfc3164_gelf_block.encode_rfc3164_gelf_block)
         res = fn3164(
             packed[2], packed[3], packed[4], host_out, packed[5],
             packed[0].shape[1], encoder, merger)

@@ -139,3 +139,61 @@ def test_3164_device_route_rejects_extras():
     enc = GelfEncoder(Config.from_string(
         '[output.gelf_extra]\nregion = "eu"\n'))
     assert device_rfc3164.route_ok(enc, LineMerger()) is False
+
+
+# ---- rfc3164 -> rfc3164 self-encode (syslog relay mode) --------------------
+
+def test_3164_self_encode_block_matches_scalar():
+    from flowgger_tpu.encoders.rfc3164 import RFC3164Encoder
+    from flowgger_tpu.tpu.batch import block_fetch_encode, block_submit
+
+    enc = RFC3164Encoder(Config.from_string(""))
+
+    def oracle(lines, merger):
+        out = []
+        for ln in lines:
+            try:
+                rec = ORACLE.decode(ln.decode("utf-8"))
+            except (DecodeError, UnicodeDecodeError):
+                continue
+            payload = enc.encode(rec)
+            out.append(merger.frame(payload) if merger is not None
+                       else payload)
+        return b"".join(out)
+
+    mixed = CLEAN * 3 + [b"\xff bad utf8", b""]
+    for merger in (LineMerger(), NulMerger(), SyslenMerger()):
+        packed = pack.pack_lines_2d(mixed, 256)
+        handle = block_submit("rfc3164", packed)
+        res, _, _ = block_fetch_encode("rfc3164", handle, packed, enc,
+                                       merger)
+        assert res is not None
+        assert res.block.data == oracle(mixed, merger)
+
+
+def test_3164_self_encode_handler_route():
+    from flowgger_tpu.encoders.rfc3164 import RFC3164Encoder
+
+    enc = RFC3164Encoder(Config.from_string(""))
+    tx = queue.Queue()
+    h = BatchHandler(tx, ORACLE, enc, Config.from_string(""),
+                     fmt="rfc3164", start_timer=False, merger=LineMerger())
+    assert h._block_route_ok()
+    for ln in CLEAN * 3:
+        h.handle_bytes(ln)
+    h.flush()
+    data = b""
+    while not tx.empty():
+        item = tx.get_nowait()
+        data += item.data if isinstance(item, EncodedBlock) else item
+    want = b"".join(LineMerger().frame(enc.encode(ORACLE.decode(
+        ln.decode()))) for ln in CLEAN * 3)
+    assert data == want
+
+    # prepend-timestamp configs stay on the Record path, loudly
+    enc_ts = RFC3164Encoder(Config.from_string(
+        '[output]\nsyslog_prepend_timestamp = "[%Y-%m-%dT%H:%M:%SZ] "\n'))
+    h2 = BatchHandler(queue.Queue(), ORACLE, enc_ts, Config.from_string(""),
+                      fmt="rfc3164", start_timer=False,
+                      merger=LineMerger())
+    assert not h2._block_route_ok()

@@ -18,6 +18,8 @@ from flowgger_tpu.decoders.rfc5424 import RFC5424Decoder
 from flowgger_tpu.encoders.gelf import GelfEncoder
 from flowgger_tpu.encoders.ltsv import LTSVEncoder
 from flowgger_tpu.encoders.passthrough import PassthroughEncoder
+from flowgger_tpu.encoders.capnp import CapnpEncoder
+from flowgger_tpu.encoders.rfc3164 import RFC3164Encoder
 from flowgger_tpu.encoders.rfc5424 import RFC5424Encoder
 from flowgger_tpu.mergers import LineMerger, NulMerger, SyslenMerger
 from flowgger_tpu.tpu.batch import BatchHandler
@@ -137,8 +139,8 @@ def corpus(n, gen):
     return out
 
 ROUTES = [
-    ("rfc5424", RFC5424Decoder, [GelfEncoder, PassthroughEncoder, RFC5424Encoder, LTSVEncoder], gen_rfc5424),
-    ("rfc3164", RFC3164Decoder, [GelfEncoder, PassthroughEncoder], gen_rfc3164),
+    ("rfc5424", RFC5424Decoder, [GelfEncoder, PassthroughEncoder, RFC5424Encoder, LTSVEncoder, CapnpEncoder], gen_rfc5424),
+    ("rfc3164", RFC3164Decoder, [GelfEncoder, PassthroughEncoder, RFC3164Encoder], gen_rfc3164),
     ("ltsv", LTSVDecoder, [GelfEncoder], gen_ltsv),
     ("ltsv", TypedLTSVDecoder, [GelfEncoder], gen_ltsv_typed),
     ("gelf", GelfDecoder, [GelfEncoder], gen_gelf),
