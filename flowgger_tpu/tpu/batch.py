@@ -385,9 +385,14 @@ class BatchHandler(Handler):
                 # syslog->syslog relay re-encode; the prepend-timestamp
                 # option is wall-clock-at-encode-time (per-call)
                 return self.encoder.header_time_format is None
-            return self._passthrough_ok or (
-                type(self.encoder) is GelfEncoder
-                and not self.encoder.extra)
+            if type(self.encoder) is GelfEncoder:
+                from .encode_rfc3164_gelf_block import (
+                    gelf_extra_consts_3164,
+                )
+
+                return gelf_extra_consts_3164(
+                    self.encoder.extra) is not None
+            return self._passthrough_ok
         if self.fmt == "ltsv":
             # LTSV decode block-encodes GELF only; typed-schema support
             # (and its per-row fallbacks) live in the encoder itself
@@ -433,7 +438,7 @@ class BatchHandler(Handler):
             # GELF output is columnar for every kernel format, so the
             # only possible blockers are the extras / the auto schema
             if enc.extra:
-                if self.fmt == "rfc5424":
+                if self.fmt in ("rfc5424", "rfc3164"):
                     return ("output.gelf_extra keys need dynamic "
                             "placement (leading '_' or a fixed-key "
                             "overwrite)")

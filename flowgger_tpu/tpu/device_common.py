@@ -241,6 +241,26 @@ def ts_text_block(small: Dict[str, np.ndarray]):
     return txt[inv], ulen[inv]
 
 
+def gelf_route_ok(encoder, merger, extras_placeable) -> bool:
+    """Shared applicability predicate for the device GELF-encode routes:
+    GELF output over line/nul/syslen framing, with the kill switch and
+    merger allowlist in ONE place; ``extras_placeable(extra) -> bool``
+    is the per-layout static-placement check."""
+    import os
+
+    from ..encoders.gelf import GelfEncoder
+    from ..mergers import LineMerger, NulMerger, SyslenMerger
+
+    if os.environ.get("FLOWGGER_DEVICE_ENCODE", "1") == "0":
+        return False
+    if type(encoder) is not GelfEncoder:
+        return False
+    if encoder.extra and not extras_placeable(encoder.extra):
+        return False
+    return merger is None or type(merger) in (LineMerger, NulMerger,
+                                              SyslenMerger)
+
+
 def fetch_encode_driver(kernel, out, batch_dev, lens_dev, packed, encoder,
                         merger, route_state, suffix: bytes, syslen: bool,
                         scalar_fn, fallback_frac: float,

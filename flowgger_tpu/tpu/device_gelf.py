@@ -293,18 +293,11 @@ def route_ok(encoder, merger) -> bool:
     (the syslen prefix is spliced host-side over the output-sized device
     body); gelf_extra rides as constant segments when its keys have
     static placement (encode_gelf_block.gelf_extra_slots)."""
-    from ..encoders.gelf import GelfEncoder
-    from ..mergers import LineMerger, NulMerger, SyslenMerger
+    from .device_common import gelf_route_ok
     from .encode_gelf_block import gelf_extra_slots
 
-    if os.environ.get("FLOWGGER_DEVICE_ENCODE", "1") == "0":
-        return False
-    if type(encoder) is not GelfEncoder:
-        return False
-    if encoder.extra and gelf_extra_slots(encoder.extra) is None:
-        return False
-    return merger is None or type(merger) in (LineMerger, NulMerger,
-                                              SyslenMerger)
+    return gelf_route_ok(
+        encoder, merger, lambda e: gelf_extra_slots(e) is not None)
 
 
 # fraction of non-tier rows above which the span-fetch host path wins

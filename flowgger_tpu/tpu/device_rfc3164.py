@@ -60,21 +60,36 @@ _PARTS = {
 }
 
 
-def _bank(suffix: bytes):
+def _bank(suffix: bytes, extras=()):
+    """Constant bank; extras fold in via the host tier's
+    gelf_extra_consts_3164 so the two tiers can never diverge."""
+    parts = dict(_PARTS)
+    parts["hl"] = b""
+    parts["l2a"] = b""
+    parts["l2b"] = b""
+    if extras:
+        from .encode_rfc3164_gelf_block import gelf_extra_consts_3164
+
+        econsts = gelf_extra_consts_3164(list(extras))
+        assert econsts is not None  # route_ok pre-checked
+        (parts["open"], parts["host"], parts["hl"], parts["l2a"],
+         parts["l2b"], parts["short_p"], parts["short_n"], parts["ts"],
+         parts["tail"]) = econsts
     offs, bank = {}, b""
-    for k, v in _PARTS.items():
+    for k, v in parts.items():
         if k == "tail":
             v = v + suffix
         offs[k] = len(bank)
         bank += v
-    return bank, offs
+    return bank, offs, parts
 
 
-@partial(jax.jit, static_argnames=("suffix", "impl", "assemble"))
+@partial(jax.jit, static_argnames=("suffix", "impl", "assemble",
+                                   "extras"))
 def _encode_kernel(batch, lens, dec, ts_text, ts_len, *, suffix: bytes,
-                   impl: str, assemble: bool = True):
+                   impl: str, assemble: bool = True, extras=()):
     N, L = batch.shape
-    bank, off = _bank(suffix)
+    bank, off, parts = _bank(suffix, extras)
     OW = _out_width(L, L + E_CAP + len(bank) + TS_W)
     iota = jax.lax.broadcasted_iota(_I32, (N, L), 1)
 
@@ -93,22 +108,29 @@ def _encode_kernel(batch, lens, dec, ts_text, ts_len, *, suffix: bytes,
     tbase = EW + len(bank)
     zero = jnp.zeros((N,), dtype=_I32)
     segs = [
-        (zero + (cbase + off["open"]), zero + len(_C_OPEN)),
+        (zero + (cbase + off["open"]), zero + len(parts["open"])),
         (zero, row_e),                                   # full_message
-        (zero + (cbase + off["host"]), zero + len(_C_HOST)),
+        (zero + (cbase + off["host"]), zero + len(parts["host"])),
         (host_s, jnp.maximum(host_e - host_s, 0)),
+        (zero + (cbase + off["hl"]), zero + len(parts["hl"])),
         (zero + (cbase + off["level"]),
-         jnp.where(has_pri, len(_C_LEVEL), 0)),
+         jnp.where(has_pri, len(parts["level"]), 0)),
         (cbase + off["sevd"] + dec["severity"].astype(_I32),
          jnp.where(has_pri, 1, 0)),
+        # extras between level and short: after-number variant when PRI
+        # present, string-close variant otherwise (same selection as the
+        # short constant below)
+        (jnp.where(has_pri, cbase + off["l2a"], cbase + off["l2b"]),
+         jnp.where(has_pri, len(parts["l2a"]), len(parts["l2b"]))),
         (jnp.where(has_pri, cbase + off["short_p"],
                    cbase + off["short_n"]),
-         jnp.where(has_pri, len(_C_SHORT_PRI), len(_C_SHORT_NOPRI))),
+         jnp.where(has_pri, len(parts["short_p"]),
+                   len(parts["short_n"]))),
         (msg_s, jnp.maximum(row_e - msg_s, 0)),          # short_message
-        (zero + (cbase + off["ts"]), zero + len(_C_TS)),
+        (zero + (cbase + off["ts"]), zero + len(parts["ts"])),
         (zero + tbase, ts_len.astype(_I32)),
         (zero + (cbase + off["tail"]),
-         zero + len(_C_TAIL) + len(suffix)),
+         zero + len(parts["tail"]) + len(suffix)),
     ]
 
     out_len = segs[0][1]
@@ -128,13 +150,16 @@ def _encode_kernel(batch, lens, dec, ts_text, ts_len, *, suffix: bytes,
 
 
 def route_ok(encoder, merger) -> bool:
-    """GELF output over line/nul/syslen framing, WITHOUT extras: this
-    kernel's segment table has no extras slots (unlike device_gelf's),
-    so accepting an extras encoder would silently drop its pairs."""
-    from . import device_gelf
+    """GELF output over line/nul/syslen framing; gelf_extra rides as
+    constant segments when this layout can place the keys statically
+    (gelf_extra_consts_3164 — note the rfc3164 fixed-key set differs
+    from the rfc5424 one, so placeability differs too)."""
+    from .device_common import gelf_route_ok
+    from .encode_rfc3164_gelf_block import gelf_extra_consts_3164
 
-    return (not getattr(encoder, "extra", None)
-            and device_gelf.route_ok(encoder, merger))
+    return gelf_route_ok(
+        encoder, merger,
+        lambda e: gelf_extra_consts_3164(e) is not None)
 
 
 def fetch_encode(handle, packed, encoder, merger, route_state=None):
@@ -147,11 +172,12 @@ def fetch_encode(handle, packed, encoder, merger, route_state=None):
     out, batch_dev, lens_dev = handle
     suffix, syslen = merger_suffix(merger)
     impl = best_scan_impl()
+    extras = tuple((k, v) for k, v in getattr(encoder, "extra", ()))
 
     def kernel(ts_text, ts_len, assemble):
         return _encode_kernel(batch_dev, lens_dev, dict(out), ts_text,
                               ts_len, suffix=suffix, impl=impl,
-                              assemble=assemble)
+                              assemble=assemble, extras=extras)
 
     return fetch_encode_driver(
         kernel, out, batch_dev, lens_dev, packed, encoder, merger,

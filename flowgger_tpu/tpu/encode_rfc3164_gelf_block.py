@@ -47,7 +47,59 @@ _C_TS = b'","timestamp":'
 _C_TAIL = b',"version":"1.1"}'
 _C_SEVD = b"01234567"
 
-_SEGS = 11
+_SEGS = 13  # incl. the two extras slot columns (empty without extras)
+
+_FIXED_3164 = ("full_message", "host", "level", "short_message",
+               "timestamp", "version")
+
+
+def gelf_extra_consts_3164(extra):
+    """Fold ``[output.gelf_extra]`` pairs into this layout's constants
+    (same static-placement idea as encode_gelf_block.gelf_extra_slots,
+    adapted to the gated ``level`` key): returns
+    (open, host_const, hl_slot, l2_pri, l2_nopri, short_pri,
+    short_nopri, ts_const, tail_const) or None when a key needs dynamic
+    placement.  The level→short slot is per-row dual-form — after the
+    bare level digit (number form) when PRI is present, after a string
+    value otherwise — mirroring the existing short-const selection."""
+    from json.encoder import encode_basestring as _quote
+
+    pre = hl = b""
+    l2a = l2b = b""          # level<k<short: (pri, no-pri) variants
+    fh = b""                 # full<k<host
+    st = b""                 # short<k<timestamp
+    tv = b""                 # timestamp<k<version (number form)
+    vz = b""                 # > version (inside tail)
+    for k, v in sorted(extra or ()):
+        if k in _FIXED_3164:
+            return None
+        kq = _quote(k).encode("utf-8")
+        vq = _quote(v).encode("utf-8")
+        sc = b'",' + kq + b":" + vq[:-1]      # string-close form
+        nm = b"," + kq + b":" + vq            # after-number form
+        if k < "full_message":
+            pre += kq + b":" + vq + b","
+        elif k < "host":
+            fh += sc
+        elif k < "level":
+            hl += sc
+        elif k < "short_message":
+            l2a += nm
+            l2b += sc
+        elif k < "timestamp":
+            st += sc
+        elif k < "version":
+            tv += nm
+        else:
+            vz += sc
+    tail = _C_TAIL
+    if tv or vz:
+        tail = tv + b',"version":"1.1' + vz + b'"}'
+    # an l2a chain ends quoted -> short needs the after-number variant;
+    # an l2b chain ends unquoted -> the string-close variant: exactly
+    # the existing has_pri pairing, so no new selection logic is needed
+    return (b"{" + pre + _C_OPEN[1:], fh + _C_HOST, hl, l2a, l2b,
+            _C_SHORT_PRI, _C_SHORT_NOPRI, st + _C_TS, tail)
 
 
 def encode_rfc3164_gelf_block(
@@ -61,8 +113,13 @@ def encode_rfc3164_gelf_block(
     merger: Optional[Merger],
 ) -> Optional[BlockResult]:
     spec = merger_suffix(merger)
-    if spec is None or encoder.extra:
+    if spec is None:
         return None
+    econsts = gelf_extra_consts_3164(encoder.extra)
+    if econsts is None:
+        return None
+    (c_open, c_host, c_hl, c_l2a, c_l2b, c_short_p, c_short_n, c_ts,
+     c_tail) = econsts
     suffix, syslen = spec
 
     n = int(n_real)
@@ -99,10 +156,10 @@ def encode_rfc3164_gelf_block(
 
         scratch, ts_off, ts_len = ts_scratch(out, n, ridx, json_f64)
         consts, offs = build_source(
-            _C_OPEN, _C_HOST, _C_LEVEL, _C_SHORT_PRI, _C_SHORT_NOPRI,
-            _C_TS, _C_TAIL + suffix, _C_SEVD, scratch)
+            c_open, c_host, _C_LEVEL, c_short_p, c_short_n,
+            c_ts, c_tail + suffix, _C_SEVD, c_hl, c_l2a, c_l2b, scratch)
         (o_open, o_host, o_level, o_short_p, o_short_n, o_ts, o_tail,
-         o_sevd, o_scratch) = offs
+         o_sevd, o_hl, o_l2a, o_l2b, o_scratch) = offs
         cbase = int(emap.esc.size)
         src = np.concatenate([emap.esc, consts])
 
@@ -111,18 +168,21 @@ def encode_rfc3164_gelf_block(
         seg_src = np.empty((R, _SEGS), dtype=np.int64)
         seg_len = np.empty((R, _SEGS), dtype=np.int64)
         cols = (
-            (cbase + o_open, len(_C_OPEN)),
+            (cbase + o_open, len(c_open)),
             (full_src, full_len),
-            (cbase + o_host, len(_C_HOST)),
+            (cbase + o_host, len(c_host)),
             (host_src, host_len),
+            (cbase + o_hl, len(c_hl)),
             (cbase + o_level, np.where(has_pri, len(_C_LEVEL), 0)),
             (cbase + o_sevd + sev, np.where(has_pri, 1, 0)),
+            (np.where(has_pri, cbase + o_l2a, cbase + o_l2b),
+             np.where(has_pri, len(c_l2a), len(c_l2b))),
             (np.where(has_pri, cbase + o_short_p, cbase + o_short_n),
-             np.where(has_pri, len(_C_SHORT_PRI), len(_C_SHORT_NOPRI))),
+             np.where(has_pri, len(c_short_p), len(c_short_n))),
             (msg_src, msg_len),
-            (cbase + o_ts, len(_C_TS)),
+            (cbase + o_ts, len(c_ts)),
             (cbase + o_scratch + ts_off, ts_len),
-            (cbase + o_tail, len(_C_TAIL) + len(suffix)),
+            (cbase + o_tail, len(c_tail) + len(suffix)),
         )
         for k, (s, ln) in enumerate(cols):
             seg_src[:, k] = s

@@ -132,13 +132,47 @@ def test_device_3164_compaction_fetch_is_output_sized():
     assert fetched < len(res.block.data) * 1.2 + 64 * len(lines)
 
 
-def test_3164_device_route_rejects_extras():
-    """The rfc3164 device kernel has no extras slots: an extras encoder
-    must not engage it (output would silently drop the extra pairs);
-    the host/scalar paths still emit them."""
+def test_3164_gelf_extra_static_slots():
+    """gelf_extra on the rfc3164→GELF pair: keys covering every static
+    slot of THIS layout (incl. the dual-form level→short slot exercised
+    by both PRI and no-PRI rows) must match the scalar encoder on the
+    device tier; unplaceable keys (fixed-key overwrite) refuse."""
     enc = GelfEncoder(Config.from_string(
-        '[output.gelf_extra]\nregion = "eu"\n'))
-    assert device_rfc3164.route_ok(enc, LineMerger()) is False
+        "[output.gelf_extra]\n"
+        'about = "pre-slot"\n'       # < full_message
+        'gateway = "fh"\n'           # full_message < k < host
+        'kind = "hl"\n'              # host < k < level
+        'region = "l2"\n'            # level < k < short_message (dual)\n
+        'stage = "st"\n'             # short_message < k < timestamp
+        'tier = "tv"\n'              # timestamp < k < version
+        'zzz = "tail"\n'))           # > version
+    assert device_rfc3164.route_ok(enc, LineMerger()) is True
+
+    def oracle(lines):
+        return b"".join(LineMerger().frame(enc.encode(ORACLE.decode(
+            ln.decode()))) for ln in lines)
+
+    packed = pack.pack_lines_2d(CLEAN * 3, 256)
+    handle = rfc3164.decode_rfc3164_submit(packed[0], packed[1])
+    res, _ = device_rfc3164.fetch_encode(handle, packed, enc,
+                                         LineMerger())
+    assert res is not None
+    assert res.block.data == oracle(CLEAN * 3)
+
+    # host tier too
+    from flowgger_tpu.tpu.encode_rfc3164_gelf_block import (
+        encode_rfc3164_gelf_block,
+    )
+
+    host_out = rfc3164.decode_rfc3164_fetch(handle)
+    res2 = encode_rfc3164_gelf_block(packed[2], packed[3], packed[4],
+                                     host_out, packed[5], 256, enc,
+                                     LineMerger())
+    assert res2 is not None and res2.block.data == oracle(CLEAN * 3)
+
+    bad = GelfEncoder(Config.from_string(
+        '[output.gelf_extra]\nhost = "overwrite"\n'))
+    assert device_rfc3164.route_ok(bad, LineMerger()) is False
 
 
 # ---- rfc3164 -> rfc3164 self-encode (syslog relay mode) --------------------
