@@ -664,8 +664,18 @@ def block_fetch_encode(fmt, handle, packed, encoder, merger,
             packed[2], packed[3], packed[4], host_out, packed[5],
             packed[0].shape[1], encoder, merger)
     elif fmt == "ltsv":
-        from . import encode_ltsv_gelf_block, ltsv
+        from . import device_ltsv, encode_ltsv_gelf_block, ltsv
 
+        if device_ltsv.route_ok(encoder, merger, ltsv_decoder):
+            res, fetch_s = device_ltsv.fetch_encode(
+                handle, packed, encoder, merger, route_state,
+                ltsv_decoder)
+            if res is not None:
+                return res, fetch_s, 0.0
+            declined_s = _time.perf_counter() - t0
+            _metrics.add_seconds("device_encode_declined_seconds",
+                                 declined_s)
+            t0 = _time.perf_counter()
         host_out = ltsv.decode_ltsv_fetch(handle)
         t1 = _time.perf_counter()
         res = encode_ltsv_gelf_block.encode_ltsv_gelf_block(

@@ -241,6 +241,71 @@ def ts_text_block(small: Dict[str, np.ndarray]):
     return txt[inv], ulen[inv]
 
 
+_AMBIG_LEN = 8     # name-key bytes captured for sorting
+_BIG = 0x7FFFFFFF  # sort key for absent pairs (names are ASCII < 0x7f)
+
+# optimal 12-comparator sorting network for 6 elements
+_NET6 = ((0, 5), (1, 3), (2, 4), (1, 2), (3, 4), (0, 3), (2, 5),
+         (0, 1), (2, 3), (4, 5), (1, 2), (3, 4))
+
+
+def sort_pairs_by_key8(bb, iota, cols, max_pairs: int):
+    """Sort per-pair span columns by their names' first 8 bytes
+    (serde_json BTreeMap order) with a 12-comparator network, and flag
+    rows whose order the 8-byte prefix cannot decide.
+
+    ``cols`` must carry lists keyed ``ns``/``ne`` (raw name spans used
+    for the keys) plus any payload lists to ride the swaps; this adds
+    ``hi``/``lo``/``nlen`` key lists, sorts everything in place, and
+    returns the ambig mask: equal 8-byte prefixes are orderable only
+    when exactly one name is ≤8 bytes (a strict prefix of the other) —
+    equal-length or both-longer pairs (including duplicates, dict
+    last-wins semantics) fall back to the host tiers."""
+    import jax.numpy as jnp
+
+    N = bb.shape[0]
+    pair_count = cols.pop("_pair_count")
+    cols["hi"], cols["lo"], cols["nlen"] = [], [], []
+    for p in range(max_pairs):
+        ns_r = cols["ns_raw"][p]
+        ne_r = cols["ne_raw"][p]
+        pv = p < pair_count
+        r = iota - ns_r[:, None]
+        in_name = (r >= 0) & (iota < ne_r[:, None])
+        z = jnp.where(in_name, bb, 0)
+        hi = jnp.sum(z * ((r == 0) * (1 << 24) + (r == 1) * (1 << 16)
+                          + (r == 2) * (1 << 8) + (r == 3)), axis=1)
+        lo = jnp.sum(z * ((r == 4) * (1 << 24) + (r == 5) * (1 << 16)
+                          + (r == 6) * (1 << 8) + (r == 7)), axis=1)
+        cols["hi"].append(jnp.where(pv, hi, _BIG))
+        cols["lo"].append(jnp.where(pv, lo, _BIG))
+        cols["nlen"].append(jnp.where(pv, ne_r - ns_r, _BIG))
+
+    payload = [k for k in cols if k not in ("hi", "lo", "nlen")]
+    for i, j in _NET6:
+        if i >= max_pairs or j >= max_pairs:
+            continue
+        ah, bh = cols["hi"][i], cols["hi"][j]
+        al, bl = cols["lo"][i], cols["lo"][j]
+        an, bn = cols["nlen"][i], cols["nlen"][j]
+        swap = (bh < ah) | ((bh == ah) & ((bl < al)
+                            | ((bl == al) & (bn < an))))
+        for key in ("hi", "lo", "nlen", *payload):
+            a, b = cols[key][i], cols[key][j]
+            cols[key][i] = jnp.where(swap, b, a)
+            cols[key][j] = jnp.where(swap, a, b)
+
+    ambig = jnp.zeros((N,), dtype=bool)
+    for p in range(max_pairs - 1):
+        keq = ((cols["hi"][p] == cols["hi"][p + 1])
+               & (cols["lo"][p] == cols["lo"][p + 1])
+               & (cols["hi"][p] != _BIG))
+        la, lb = cols["nlen"][p], cols["nlen"][p + 1]
+        ambig |= keq & ((la == lb) | ((la > _AMBIG_LEN)
+                                      & (lb > _AMBIG_LEN)))
+    return ambig
+
+
 def gelf_route_ok(encoder, merger, extras_placeable) -> bool:
     """Shared applicability predicate for the device GELF-encode routes:
     GELF output over line/nul/syslen framing, with the kill switch and

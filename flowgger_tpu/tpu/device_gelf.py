@@ -50,6 +50,9 @@ from .device_common import (  # noqa: F401  (re-exported for tests/siblings)
     COMPACT_MIN_SAVING,
     E_CAP,
     TS_W,
+    _AMBIG_LEN,
+    _BIG,
+    _NET6,
     _compact_kernel,
     _monotone_expand,
     _rot_rows,
@@ -57,15 +60,13 @@ from .device_common import (  # noqa: F401  (re-exported for tests/siblings)
     assemble_rows,
     escape_stage,
     fetch_encode_driver,
+    sort_pairs_by_key8,
     ts_text_block as _ts_text_block,
 )
 from .rfc5424 import _cumsum, best_scan_impl
 
 _I32 = jnp.int32
 _U8 = jnp.uint8
-
-_AMBIG_LEN = 8     # name-key bytes captured for sorting
-_BIG = 0x7FFFFFFF  # sort key for absent pairs (names are ASCII < 0x7f)
 
 # constant bank: the same byte constants the host tier uses (single
 # source of truth — the two tiers must never diverge, since fallback
@@ -94,11 +95,6 @@ _PARTS = {
     "dash": _C_DASH,
     "sevd": _C_SEVD,
 }
-
-# optimal 12-comparator sorting network for 6 elements
-_NET6 = ((0, 5), (1, 3), (2, 4), (1, 2), (3, 4), (0, 3), (2, 5),
-         (0, 1), (2, 3), (4, 5), (1, 2), (3, 4))
-
 
 def _bank(suffix: bytes, extras: Tuple[Tuple[str, str], ...] = ()
           ) -> Tuple[bytes, Dict[str, int], Dict[str, bytes]]:
@@ -160,55 +156,24 @@ def _encode_kernel(batch, lens, dec, ts_text, ts_len, *, suffix: bytes,
                               sid_e_raw)
     sid_s, sid_e = dmap(sid_s_raw), dmap(sid_e_raw)
 
-    # ---- SD pairs: 8-byte name keys, d-mapped spans, sorting network -----
+    # ---- SD pairs: 8-byte name keys, d-mapped spans, shared sorter ------
     pair_count = dec["pair_count"].astype(_I32)
     P = dec["name_start"].shape[1]
     val_esc_any = jnp.zeros((N,), dtype=bool)
-    cols = {k: [] for k in ("hi", "lo", "nlen", "ns", "ne", "vs", "ve")}
+    cols = {"_pair_count": pair_count, "ns_raw": [], "ne_raw": [],
+            "ns": [], "ne": [], "vs": [], "ve": []}
     for p in range(P):
         ns_r = dec["name_start"][:, p].astype(_I32)
         ne_r = dec["name_end"][:, p].astype(_I32)
-        pv = p < pair_count
-        val_esc_any |= dec["val_has_esc"][:, p].astype(bool) & pv
-        r = iota - ns_r[:, None]
-        in_name = (r >= 0) & (iota < ne_r[:, None])
-        z = jnp.where(in_name, bb, 0)
-        hi = jnp.sum(z * ((r == 0) * (1 << 24) + (r == 1) * (1 << 16)
-                          + (r == 2) * (1 << 8) + (r == 3)), axis=1)
-        lo = jnp.sum(z * ((r == 4) * (1 << 24) + (r == 5) * (1 << 16)
-                          + (r == 6) * (1 << 8) + (r == 7)), axis=1)
-        cols["hi"].append(jnp.where(pv, hi, _BIG))
-        cols["lo"].append(jnp.where(pv, lo, _BIG))
-        cols["nlen"].append(jnp.where(pv, ne_r - ns_r, _BIG))
+        val_esc_any |= (dec["val_has_esc"][:, p].astype(bool)
+                        & (p < pair_count))
+        cols["ns_raw"].append(ns_r)
+        cols["ne_raw"].append(ne_r)
         cols["ns"].append(dmap(ns_r))
         cols["ne"].append(dmap(ne_r))
         cols["vs"].append(dmap(dec["val_start"][:, p]))
         cols["ve"].append(dmap(dec["val_end"][:, p]))
-
-    for i, j in _NET6:
-        if i >= P or j >= P:
-            continue
-        ah, bh = cols["hi"][i], cols["hi"][j]
-        al, bl = cols["lo"][i], cols["lo"][j]
-        an, bn = cols["nlen"][i], cols["nlen"][j]
-        swap = (bh < ah) | ((bh == ah) & ((bl < al)
-                            | ((bl == al) & (bn < an))))
-        for key in cols:
-            a, b = cols[key][i], cols[key][j]
-            cols[key][i] = jnp.where(swap, b, a)
-            cols[key][j] = jnp.where(swap, a, b)
-
-    # ambiguity / duplicate detection on sorted neighbours: equal 8-byte
-    # keys are adjacent after sorting; zero-padding orders them only when
-    # exactly one name is ≤8 bytes (a strict prefix of the other)
-    ambig = jnp.zeros((N,), dtype=bool)
-    for p in range(P - 1):
-        keq = ((cols["hi"][p] == cols["hi"][p + 1])
-               & (cols["lo"][p] == cols["lo"][p + 1])
-               & (cols["hi"][p] != _BIG))
-        la, lb = cols["nlen"][p], cols["nlen"][p + 1]
-        ambig |= keq & ((la == lb) | ((la > _AMBIG_LEN)
-                                      & (lb > _AMBIG_LEN)))
+    ambig = sort_pairs_by_key8(bb, iota, cols, P)
 
     # ---- segment table ---------------------------------------------------
     EW = L + E_CAP

@@ -263,15 +263,19 @@ def decode_ltsv_jit(batch, lens, max_parts=DEFAULT_MAX_PARTS):
 def decode_ltsv_submit(batch, lens, sharded=None):
     """Asynchronous dispatch (pair with decode_ltsv_fetch) — the ltsv
     leg of the block pipeline's double buffering.  ``sharded`` swaps in
-    the multi-chip mesh kernel (parallel.mesh.ShardedDecode)."""
+    the multi-chip mesh kernel (parallel.mesh.ShardedDecode).  The
+    handle carries the uploaded device arrays so the device-side encode
+    (tpu/device_ltsv.py) reuses them without a re-upload."""
     import jax.numpy as jnp
 
     if sharded is not None:
-        return sharded.fn(*sharded.put(batch, lens))
-    return decode_ltsv_jit(jnp.asarray(batch), jnp.asarray(lens))
+        b, ln = sharded.put(batch, lens)
+        return sharded.fn(b, ln), b, ln
+    b, ln = jnp.asarray(batch), jnp.asarray(lens)
+    return decode_ltsv_jit(b, ln), b, ln
 
 
 def decode_ltsv_fetch(handle):
     import numpy as np
 
-    return {k: np.asarray(v) for k, v in handle.items()}
+    return {k: np.asarray(v) for k, v in handle[0].items()}

@@ -1,0 +1,138 @@
+"""Device-side LTSV→GELF encode (tpu/device_ltsv.py): differential
+tests vs the scalar oracle (LTSVDecoder → GelfEncoder → merger.frame),
+including the tier restrictions (rfc3339 stamps only, ≤6 pairs,
+repeated-special fallback) and the production BatchHandler route."""
+
+import queue
+import random
+
+import pytest
+
+from flowgger_tpu.config import Config
+from flowgger_tpu.block import EncodedBlock
+from flowgger_tpu.decoders import DecodeError
+from flowgger_tpu.decoders.ltsv import LTSVDecoder
+from flowgger_tpu.encoders.gelf import GelfEncoder
+from flowgger_tpu.mergers import LineMerger, NulMerger, SyslenMerger
+from flowgger_tpu.tpu import device_ltsv, ltsv, pack
+from flowgger_tpu.tpu.batch import BatchHandler
+from flowgger_tpu.utils.metrics import registry as metrics
+
+ORACLE = LTSVDecoder(Config.from_string(""))
+ENC = GelfEncoder(Config.from_string(""))
+
+
+def scalar_frames(lines, merger):
+    out = []
+    for ln in lines:
+        try:
+            rec = ORACLE.decode(ln.decode("utf-8"))
+        except (DecodeError, UnicodeDecodeError):
+            continue
+        payload = ENC.encode(rec)
+        out.append(merger.frame(payload) if merger is not None else payload)
+    return out
+
+
+def run_device(lines, merger, max_len=256):
+    packed = pack.pack_lines_2d(lines, max_len)
+    handle = ltsv.decode_ltsv_submit(packed[0], packed[1])
+    return device_ltsv.fetch_encode(handle, packed, ENC, merger,
+                                    decoder=ORACLE)
+
+
+CLEAN = [
+    b"time:2023-09-20T12:35:45.123Z\thost:web1\tstatus:200\t"
+    b"path:/api/x\tmessage:request served",
+    b"host:db2\ttime:2023-09-20T12:35:45Z\tuser:alice\tlevel:3\t"
+    b"message:login ok",
+    b"time:2023-09-20T12:35:46Z\thost:w\tzeta:1\talpha:2\tmike:3\t"
+    b"bravo:4\tmessage:sorted keys",
+    b"time:2023-09-20T12:35:47Z\thost:h9\tmessage:no pairs at all",
+]
+
+
+@pytest.mark.parametrize("merger", [None, LineMerger(), NulMerger(),
+                                    SyslenMerger()],
+                         ids=["noop", "line", "nul", "syslen"])
+def test_device_ltsv_matches_scalar_and_engages(merger):
+    n0 = metrics.get("device_encode_rows")
+    res, _ = run_device(CLEAN * 4, merger)
+    assert res is not None
+    assert metrics.get("device_encode_rows") - n0 == len(CLEAN) * 4
+    want = b"".join(scalar_frames(CLEAN * 4, merger))
+    assert res.block.data == want
+
+
+def test_device_ltsv_fallback_splicing(monkeypatch):
+    monkeypatch.setattr(device_ltsv, "FALLBACK_FRAC", 1.1)
+    mixed = [
+        CLEAN[0],
+        # unix-float stamp: off the device tier, host tiers handle it
+        b"time:1438790025.42\thost:h\tmessage:float stamp",
+        # repeated special name: scalar parity requires the oracle
+        b"time:2023-09-20T12:35:45Z\thost:a\thost:b\tmessage:rep",
+        # 7 pairs: beyond the 6-pair device tier
+        b"time:2023-09-20T12:35:45Z\thost:h\t"
+        b"k1:1\tk2:2\tk3:3\tk4:4\tk5:5\tk6:6\tk7:7\tmessage:many",
+        # colon-less part: the scalar path prints its notice
+        b"time:2023-09-20T12:35:45Z\thost:h\tnovalue\tmessage:m",
+        "time:2023-09-20T12:35:45Z\thost:hé\tmessage:non-ascii".encode(),
+        CLEAN[1],
+        # duplicate pair keys (dict last-wins): ambiguity fallback
+        b"time:2023-09-20T12:35:45Z\thost:h\tdup:1\tdup:2\tmessage:d",
+    ]
+    res, _ = run_device(mixed, LineMerger())
+    assert res is not None
+    want = b"".join(scalar_frames(mixed, LineMerger()))
+    assert res.block.data == want
+
+
+def test_device_ltsv_fuzz_vs_scalar(monkeypatch):
+    monkeypatch.setattr(device_ltsv, "FALLBACK_FRAC", 1.1)
+    rng = random.Random(13)
+    keys = ["k", "key2", "a_long_key_name", "x" * 9, "x" * 9 + "y"]
+    vals = ["v", 'say "hi"', "trail  ", "", "a\\b", "longer value here"]
+    lines = []
+    for i in range(200):
+        parts = [f"time:2023-09-20T12:35:45.{i % 1000:03d}Z",
+                 f"host:h{i % 7}"]
+        if rng.random() < 0.5:
+            parts.append(f"message:{rng.choice(vals)}")
+        if rng.random() < 0.3:
+            parts.append(f"level:{rng.randrange(0, 8)}")
+        for _ in range(rng.randrange(0, 7)):
+            parts.append(f"{rng.choice(keys)}:{rng.choice(vals)}")
+        rng.shuffle(parts)
+        lines.append("\t".join(parts).encode())
+    for merger in (LineMerger(), NulMerger(), SyslenMerger()):
+        res, _ = run_device(lines, merger)
+        assert res is not None
+        want = b"".join(scalar_frames(lines, merger))
+        assert res.block.data == want
+
+
+def test_batch_handler_ltsv_uses_device_engine():
+    tx = queue.Queue()
+    h = BatchHandler(tx, ORACLE, ENC, Config.from_string(""),
+                     fmt="ltsv", start_timer=False, merger=LineMerger())
+    n0 = metrics.get("device_encode_rows")
+    for ln in CLEAN * 4:
+        h.handle_bytes(ln)
+    h.flush()
+    assert metrics.get("device_encode_rows") - n0 == len(CLEAN) * 4
+    data = b""
+    while not tx.empty():
+        item = tx.get_nowait()
+        data += item.data if isinstance(item, EncodedBlock) else item
+    assert data == b"".join(scalar_frames(CLEAN * 4, LineMerger()))
+
+
+def test_device_ltsv_schema_stays_off_device():
+    typed = LTSVDecoder(Config.from_string(
+        '[input.ltsv_schema]\ncounter = "u64"\n'))
+    assert device_ltsv.route_ok(ENC, LineMerger(), typed) is False
+    assert device_ltsv.route_ok(ENC, LineMerger(), ORACLE) is True
+    enc_extra = GelfEncoder(Config.from_string(
+        '[output.gelf_extra]\nregion = "eu"\n'))
+    assert device_ltsv.route_ok(enc_extra, LineMerger(), ORACLE) is False

@@ -154,7 +154,7 @@ def test_sharded_formats_bitwise_equal(fmt, lines):
     if fmt == "ltsv":
         single = ltsv_mod.decode_ltsv_jit(jnp.asarray(batch),
                                           jnp.asarray(lens))
-        out = ltsv_mod.decode_ltsv_submit(batch, lens, sharded)
+        out = ltsv_mod.decode_ltsv_submit(batch, lens, sharded)[0]
     elif fmt == "gelf":
         single = gelf_mod.decode_gelf_jit(jnp.asarray(batch),
                                           jnp.asarray(lens))
