@@ -121,9 +121,10 @@ def bench_e2e(lines, jax, jnp, extra):
 
     best = None
     best_snap = None
-    trials = 1 if os.environ.get("JAX_PLATFORMS", "").startswith("cpu") \
-        else 2
-    for trial in range(trials):
+    # two trials always: the first pays the jit compiles, best-of-2
+    # reports the warm path (the degraded-CPU corpus is sized so both
+    # fit the bench window)
+    for trial in range(2):
         tx = queue_mod.Queue()
         handler = BatchHandler(
             tx, RFC5424Decoder(), GelfEncoder(Config.from_string("")),
