@@ -396,8 +396,11 @@ class BatchHandler(Handler):
         if self.fmt == "ltsv":
             # LTSV decode block-encodes GELF only; typed-schema support
             # (and its per-row fallbacks) live in the encoder itself
-            return (type(self.encoder) is GelfEncoder
-                    and not self.encoder.extra)
+            if type(self.encoder) is not GelfEncoder:
+                return False
+            from .encode_ltsv_gelf_block import gelf_extra_consts_ltsv
+
+            return gelf_extra_consts_ltsv(self.encoder.extra) is not None
         if self.fmt == "gelf":
             return (type(self.encoder) is GelfEncoder
                     and not self.encoder.extra)
@@ -438,7 +441,7 @@ class BatchHandler(Handler):
             # GELF output is columnar for every kernel format, so the
             # only possible blockers are the extras / the auto schema
             if enc.extra:
-                if self.fmt in ("rfc5424", "rfc3164"):
+                if self.fmt in ("rfc5424", "rfc3164", "ltsv"):
                     return ("output.gelf_extra keys need dynamic "
                             "placement (leading '_' or a fixed-key "
                             "overwrite)")

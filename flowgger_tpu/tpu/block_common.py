@@ -125,6 +125,31 @@ class BlockResult:
         self.error_rows = error_rows
 
 
+def extra_forms(k: str, v: str) -> Tuple[bytes, bytes, bytes]:
+    """The three boundary renderings of one gelf_extra pair, shared by
+    every layout's slot folder (encode_gelf_block / _rfc3164 / _ltsv):
+    ``self`` (before a key: fully quoted + trailing comma),
+    ``string-close`` (after an unclosed string value: leading ``",``
+    closes it, own closing quote supplied by the next constant), and
+    ``after-number`` (after a bare number or self-closed value:
+    self-contained with a leading comma)."""
+    from json.encoder import encode_basestring as _quote
+
+    kq = _quote(k).encode("utf-8")
+    vq = _quote(v).encode("utf-8")
+    return (kq + b":" + vq + b",",
+            b'",' + kq + b":" + vq[:-1],
+            b"," + kq + b":" + vq)
+
+
+def extra_tail(default: bytes, tv: bytes, vz: bytes) -> bytes:
+    """Rebuild the ``,"version":"1.1"}`` tail with extras before/after
+    the version key (tv: after-number form, vz: string-close form)."""
+    if not (tv or vz):
+        return default
+    return tv + b',"version":"1.1' + vz + b'"}'
+
+
 def merger_suffix(merger: Optional[Merger]) -> Optional[Tuple[bytes, bool]]:
     """(suffix bytes, needs syslen prefix) or None if the merger type is
     not block-encodable."""

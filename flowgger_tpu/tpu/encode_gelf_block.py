@@ -100,19 +100,19 @@ def gelf_extra_slots(extra):
     a fixed-key name overwrites a computed field (gelf_encoder.rs
     extras overwrite everything) — those configs take the Record path.
     """
+    from .block_common import extra_forms
+
     slots = {k: b"" for k in ("open", "app", "full", "host", "level",
                               "proc", "p6", "short", "ts", "tail_num",
                               "tail_ver")}
     for k, v in sorted(extra or ()):
         if k.startswith("_") or k in _FIXED_KEYS:
             return None
-        kq = _quote(k).encode("utf-8")
-        vq = _quote(v).encode("utf-8")
-        sc = b'",' + kq + b":" + vq[:-1]       # string-close form
+        sf, sc, nm = extra_forms(k, v)
         if k < "_":
-            slots["open"] += kq + b":" + vq + b","
+            slots["open"] += sf
         elif k < "application_name":
-            slots["app"] += kq + b":" + vq + b","
+            slots["app"] += sf
         elif k < "full_message":
             slots["full"] += sc
         elif k < "host":
@@ -120,7 +120,7 @@ def gelf_extra_slots(extra):
         elif k < "level":
             slots["level"] += sc
         elif k < "process_id":
-            slots["proc"] += b"," + kq + b":" + vq
+            slots["proc"] += nm
         elif k < "sd_id":
             slots["p6"] += sc
         elif k < "short_message":
@@ -128,7 +128,7 @@ def gelf_extra_slots(extra):
         elif k < "timestamp":
             slots["ts"] += sc
         elif k < "version":
-            slots["tail_num"] += b"," + kq + b":" + vq
+            slots["tail_num"] += nm
         else:
             slots["tail_ver"] += sc
     return slots
@@ -140,10 +140,9 @@ def gelf_extra_consts(extra):
     slots = gelf_extra_slots(extra)
     if slots is None:
         return None
-    tail = _C_TAIL
-    if slots["tail_num"] or slots["tail_ver"]:
-        tail = (slots["tail_num"] + b',"version":"1.1'
-                + slots["tail_ver"] + b'"}')
+    from .block_common import extra_tail
+
+    tail = extra_tail(_C_TAIL, slots["tail_num"], slots["tail_ver"])
     return (_C_OPEN + slots["open"], slots["app"] + _C_APP,
             slots["full"] + _C_FULL, slots["host"] + _C_HOST,
             slots["level"] + _C_LEVEL, slots["proc"] + _C_PROC,

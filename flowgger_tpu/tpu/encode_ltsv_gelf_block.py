@@ -58,6 +58,49 @@ _C_DASH = b'"-"'
 _C_SEVD = b"01234567"
 _NAME_CAP = 48
 
+_FIXED_LTSV = ("full_message", "host", "level", "short_message",
+               "timestamp", "version")
+
+
+def gelf_extra_consts_ltsv(extra):
+    """Fold ``[output.gelf_extra]`` pairs into this layout's constants
+    (static BTreeMap placement, same idea as the rfc5424/rfc3164
+    renderers).  Slot chain: pre-pairs (k < "_"), post-pairs
+    ("_" < k < full_message), then the gated-level chain shared with
+    the rfc3164 layout — except the short value here closes its own
+    quote, so the short→timestamp slot is after-number form.  Returns
+    (open, full_c, host_c, hl, l2_pri, l2_nopri, ts_c, tail_c) or None
+    when a key needs dynamic placement (leading '_' interleaves with
+    the pair keys; fixed keys overwrite)."""
+    from .block_common import extra_forms, extra_tail
+
+    pre = post = fh = hl = b""
+    l2a = l2b = b""
+    st = tv = vz = b""
+    for k, v in sorted(extra or ()):
+        if k.startswith("_") or k in _FIXED_LTSV:
+            return None
+        sf, sc, nm = extra_forms(k, v)
+        if k < "_":
+            pre += sf
+        elif k < "full_message":
+            post += sf
+        elif k < "host":
+            fh += sc
+        elif k < "level":
+            hl += sc
+        elif k < "short_message":
+            l2a += nm
+            l2b += sc
+        elif k < "timestamp":
+            st += nm                           # short value self-closes
+        elif k < "version":
+            tv += nm
+        else:
+            vz += sc
+    return (b"{" + pre, post + _C_FULL, fh + _C_HOST, hl, l2a, l2b,
+            st + _C_TS, extra_tail(_C_TAIL, tv, vz))
+
 
 def encode_ltsv_gelf_block(
     chunk_bytes: bytes,
@@ -71,8 +114,12 @@ def encode_ltsv_gelf_block(
     decoder,
 ) -> Optional[BlockResult]:
     spec = merger_suffix(merger)
-    if spec is None or encoder.extra:
+    if spec is None:
         return None
+    econsts = gelf_extra_consts_ltsv(encoder.extra)
+    if econsts is None:
+        return None
+    (c_open, c_full, c_host, c_hl, c_l2a, c_l2b, c_ts, c_tail) = econsts
     schema = decoder.schema or {}
     if schema:
         # typed keys are supported on the fast tier when rendered bytes
@@ -299,12 +346,12 @@ def encode_ltsv_gelf_block(
         scratch = scratch0 + lit_blob
 
         consts, offs = build_source(
-            b"{", _C_P0, _C_P1, _C_P2, _C_FULL, _C_HOST, _C_LEVEL,
-            _C_SHORT_LVL, _C_SHORT, _C_TS, _C_TAIL + suffix,
-            _C_UNKNOWN, _C_DASH, _C_SEVD, scratch)
+            c_open, _C_P0, _C_P1, _C_P2, c_full, c_host, _C_LEVEL,
+            _C_SHORT_LVL, _C_SHORT, c_ts, c_tail + suffix,
+            _C_UNKNOWN, _C_DASH, _C_SEVD, c_hl, c_l2a, c_l2b, scratch)
         (o_open, o_p0, o_p1, o_p2, o_full, o_host, o_level, o_short_l,
          o_short, o_ts, o_tail, o_unknown, o_dash, o_sevd,
-         o_scratch) = offs
+         o_hl, o_l2a, o_l2b, o_scratch) = offs
         cbase = int(emap.esc.size)
         src = np.concatenate([emap.esc, consts])
 
@@ -314,14 +361,14 @@ def encode_ltsv_gelf_block(
         # short_message value is `"msg"` (quoted, escaped) or `"-"`;
         # emitted as [quote][msg][quote] with const redirects when absent
         p = pc[ridx]
-        FIXED = 13
+        FIXED = 15  # incl. the two extras slot columns (empty w/o extras)
         segc = 1 + 5 * p + FIXED
         rstart = exclusive_cumsum(segc)[:-1]
         S = int(segc.sum())
         seg_src = np.zeros(S, dtype=np.int64)
         seg_len = np.zeros(S, dtype=np.int64)
         seg_src[rstart] = cbase + o_open
-        seg_len[rstart] = 1
+        seg_len[rstart] = len(c_open)
 
         if T:
             # map sorted pairs to their (possibly shrunk) rows
@@ -362,22 +409,25 @@ def encode_ltsv_gelf_block(
         flen = np.empty((R, FIXED), dtype=np.int64)
         qsrc = cbase + o_p1 + 2  # a '"' byte inside the const bank
         cols = (
-            (cbase + o_full, len(_C_FULL)),
+            (cbase + o_full, len(c_full)),
             (full_src, full_len),
-            (cbase + o_host, len(_C_HOST)),
+            (cbase + o_host, len(c_host)),
             (host_src, host_len),
+            (cbase + o_hl, len(c_hl)),
             (cbase + o_level, np.where(has_level, len(_C_LEVEL), 0)),
             (cbase + o_sevd + np.maximum(level, 0),
              np.where(has_level, 1, 0)),
+            (np.where(has_level, cbase + o_l2a, cbase + o_l2b),
+             np.where(has_level, len(c_l2a), len(c_l2b))),
             (np.where(has_level, cbase + o_short_l, cbase + o_short),
              np.where(has_level, len(_C_SHORT_LVL), len(_C_SHORT))),
             (np.where(has_msg, qsrc, cbase + o_dash),
              np.where(has_msg, 1, len(_C_DASH))),
             (msg_src, np.where(has_msg, msg_len, 0)),
             (qsrc, np.where(has_msg, 1, 0)),
-            (cbase + o_ts, len(_C_TS)),
+            (cbase + o_ts, len(c_ts)),
             (cbase + o_scratch + ts_off, ts_len),
-            (cbase + o_tail, len(_C_TAIL) + len(suffix)),
+            (cbase + o_tail, len(c_tail) + len(suffix)),
         )
         for k, (s_, ln) in enumerate(cols):
             fsrc[:, k] = s_

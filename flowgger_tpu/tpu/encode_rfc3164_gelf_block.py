@@ -62,7 +62,7 @@ def gelf_extra_consts_3164(extra):
     placement.  The level→short slot is per-row dual-form — after the
     bare level digit (number form) when PRI is present, after a string
     value otherwise — mirroring the existing short-const selection."""
-    from json.encoder import encode_basestring as _quote
+    from .block_common import extra_forms, extra_tail
 
     pre = hl = b""
     l2a = l2b = b""          # level<k<short: (pri, no-pri) variants
@@ -73,12 +73,9 @@ def gelf_extra_consts_3164(extra):
     for k, v in sorted(extra or ()):
         if k in _FIXED_3164:
             return None
-        kq = _quote(k).encode("utf-8")
-        vq = _quote(v).encode("utf-8")
-        sc = b'",' + kq + b":" + vq[:-1]      # string-close form
-        nm = b"," + kq + b":" + vq            # after-number form
+        sf, sc, nm = extra_forms(k, v)
         if k < "full_message":
-            pre += kq + b":" + vq + b","
+            pre += sf
         elif k < "host":
             fh += sc
         elif k < "level":
@@ -92,9 +89,7 @@ def gelf_extra_consts_3164(extra):
             tv += nm
         else:
             vz += sc
-    tail = _C_TAIL
-    if tv or vz:
-        tail = tv + b',"version":"1.1' + vz + b'"}'
+    tail = extra_tail(_C_TAIL, tv, vz)
     # an l2a chain ends quoted -> short needs the after-number variant;
     # an l2b chain ends unquoted -> the string-close variant: exactly
     # the existing has_pri pairing, so no new selection logic is needed

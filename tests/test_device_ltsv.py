@@ -136,3 +136,44 @@ def test_device_ltsv_schema_stays_off_device():
     enc_extra = GelfEncoder(Config.from_string(
         '[output.gelf_extra]\nregion = "eu"\n'))
     assert device_ltsv.route_ok(enc_extra, LineMerger(), ORACLE) is False
+
+
+def test_ltsv_gelf_extra_static_slots_host_tier():
+    """gelf_extra on the ltsv→GELF pair (host tier; the device tier
+    declines extras and splices through here): keys covering every slot
+    of this layout, over rows with and without level/message, must
+    byte-match the scalar encoder."""
+    from flowgger_tpu.tpu.batch import block_fetch_encode, block_submit
+
+    enc = GelfEncoder(Config.from_string(
+        "[output.gelf_extra]\n"
+        'Zone = "pre-pairs"\n'      # < "_"
+        'about = "post-pairs"\n'    # "_" < k < full_message
+        'gateway = "fh"\n'
+        'kind = "hl"\n'
+        'region = "l2"\n'
+        'stage = "st"\n'
+        'tier = "tv"\n'
+        'zzz = "tail"\n'))
+    # mix of level/no-level rows plus a message-less one (dash value)
+    lines = CLEAN * 3 + [b"time:2023-09-20T12:35:48Z\thost:q\tk:v"]
+
+    def oracle(merger):
+        return b"".join(merger.frame(enc.encode(ORACLE.decode(
+            ln.decode()))) for ln in lines)
+
+    for merger in (LineMerger(), SyslenMerger()):
+        packed = pack.pack_lines_2d(lines, 256)
+        handle = block_submit("ltsv", packed)
+        res, _, _ = block_fetch_encode("ltsv", handle, packed, enc,
+                                       merger, ORACLE)
+        assert res is not None
+        assert res.block.data == oracle(merger)
+
+    bad = GelfEncoder(Config.from_string(
+        '[output.gelf_extra]\n_dyn = "v"\n'))
+    from flowgger_tpu.tpu.encode_ltsv_gelf_block import (
+        gelf_extra_consts_ltsv,
+    )
+
+    assert gelf_extra_consts_ltsv(bad.extra) is None
