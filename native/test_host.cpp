@@ -6,6 +6,7 @@
 #include <cassert>
 #include <cstdint>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <string>
 #include <vector>
@@ -23,6 +24,8 @@ uint32_t fg_crc32c(const uint8_t*, int64_t, uint32_t);
 int64_t fg_snappy_max_compressed(int64_t);
 int64_t fg_snappy_compress(const uint8_t*, int64_t, uint8_t*);
 int64_t fg_snappy_decompress(const uint8_t*, int64_t, uint8_t*, int64_t);
+void fg_format_f64_json(const double*, int64_t, uint8_t*, int32_t,
+                        int32_t*, int);
 }
 
 int main() {
@@ -113,6 +116,36 @@ int main() {
                                             (int64_t)round.size());
         assert(dlen == (int64_t)data.size());
         assert(memcmp(round.data(), data.data(), data.size()) == 0);
+    }
+
+    // threaded f64 JSON formatter (shortest round-trip, json_f64
+    // notation): spot values + a threaded batch under the sanitizers
+    {
+        std::vector<double> vals = {1438790025.637824, 0.0, -0.0, 1e16,
+                                    0.0001, 1e-5, 5e-324,
+                                    1.7976931348623157e308};
+        for (int i = 0; i < 40000; i++)
+            vals.push_back(1.0e9 + i * 0.001 + i);
+        int64_t nv = (int64_t)vals.size();
+        std::vector<uint8_t> txt((size_t)nv * 32);
+        std::vector<int32_t> tlen(nv);
+        fg_format_f64_json(vals.data(), nv, txt.data(), 32, tlen.data(), 4);
+        auto row = [&](int64_t i) {
+            return std::string((const char*)txt.data() + i * 32,
+                               (size_t)tlen[i]);
+        };
+        assert(row(0) == "1438790025.637824");
+        assert(row(1) == "0.0");
+        assert(row(2) == "-0.0");
+        assert(row(3) == "1e16");
+        assert(row(4) == "0.0001");
+        assert(row(5) == "1e-5");
+        assert(row(6) == "5e-324");
+        for (int64_t i = 0; i < nv; i++) {
+            assert(tlen[i] >= 1 && tlen[i] <= 32);
+            double back = strtod(row(i).c_str(), nullptr);
+            assert(back == vals[i] || (vals[i] != vals[i]));
+        }
     }
 
     printf("native self-test ok: %lld lines\n", (long long)n);
