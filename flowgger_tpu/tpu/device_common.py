@@ -241,6 +241,19 @@ def ts_text_block(small: Dict[str, np.ndarray]):
     return txt[inv], ulen[inv]
 
 
+def build_bank(parts: Dict[str, bytes], suffix: bytes):
+    """Concatenate a device encoder's segment constants into one bank
+    (the framing suffix rides the tail constant); returns
+    (bank_bytes, {name: offset})."""
+    offs, bank = {}, b""
+    for k, v in parts.items():
+        if k == "tail":
+            v = v + suffix
+        offs[k] = len(bank)
+        bank += v
+    return bank, offs
+
+
 _AMBIG_LEN = 8     # name-key bytes captured for sorting
 _BIG = 0x7FFFFFFF  # sort key for absent pairs (names are ASCII < 0x7f)
 

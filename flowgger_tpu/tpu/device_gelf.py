@@ -111,12 +111,9 @@ def _bank(suffix: bytes, extras: Tuple[Tuple[str, str], ...] = ()
         (parts["open"], parts["app"], parts["full"], parts["host"],
          parts["level"], parts["proc"], parts["p6x"], parts["short"],
          parts["ts"], parts["tail"]) = econsts
-    offs, bank = {}, b""
-    for k, v in parts.items():
-        if k == "tail":
-            v = v + suffix
-        offs[k] = len(bank)
-        bank += v
+    from .device_common import build_bank
+
+    bank, offs = build_bank(parts, suffix)
     return bank, offs, parts
 
 

@@ -82,21 +82,33 @@ _PARTS = {
 }
 
 
-def _bank(suffix: bytes):
-    offs, bank = {}, b""
-    for k, v in _PARTS.items():
-        if k == "tail":
-            v = v + suffix
-        offs[k] = len(bank)
-        bank += v
-    return bank, offs
+def _bank(suffix: bytes, extras=()):
+    """Constant bank; extras fold in via the host tier's
+    gelf_extra_consts_ltsv so the two tiers can never diverge."""
+    parts = dict(_PARTS)
+    parts["hl"] = b""
+    parts["l2a"] = b""
+    parts["l2b"] = b""
+    if extras:
+        from .encode_ltsv_gelf_block import gelf_extra_consts_ltsv
+
+        econsts = gelf_extra_consts_ltsv(list(extras))
+        assert econsts is not None  # route_ok pre-checked
+        (parts["open"], parts["full"], parts["host"], parts["hl"],
+         parts["l2a"], parts["l2b"], parts["ts"],
+         parts["tail"]) = econsts
+    from .device_common import build_bank
+
+    bank, offs = build_bank(parts, suffix)
+    return bank, offs, parts
 
 
-@partial(jax.jit, static_argnames=("suffix", "impl", "assemble"))
+@partial(jax.jit, static_argnames=("suffix", "impl", "assemble",
+                                   "extras"))
 def _encode_kernel(batch, lens, dec, ts_text, ts_len, *, suffix: bytes,
-                   impl: str, assemble: bool = True):
+                   impl: str, assemble: bool = True, extras=()):
     N, L = batch.shape
-    bank, off = _bank(suffix)
+    bank, off, parts = _bank(suffix, extras)
     OW = _out_width(L, L + E_CAP + len(bank) + TS_W)
     iota = jax.lax.broadcasted_iota(_I32, (N, L), 1)
     bb = batch.astype(_I32)
@@ -181,7 +193,8 @@ def _encode_kernel(batch, lens, dec, ts_text, ts_len, *, suffix: bytes,
     cbase = EW
     tbase = EW + len(bank)
     zero = jnp.zeros((N,), dtype=_I32)
-    segs = [(zero + (cbase + off["open"]), zero + 1)]
+    segs = [(zero + (cbase + off["open"]),
+             zero + len(parts["open"]))]
     for p in range(MAX_DEV_PAIRS):
         pv = p < pair_count
         segs.append((zero + (cbase + off["p0"]),
@@ -197,15 +210,20 @@ def _encode_kernel(batch, lens, dec, ts_text, ts_len, *, suffix: bytes,
     host_empty = host_e <= host_s
     qsrc = cbase + off["p1"] + 2   # a '"' byte inside the '":"' const
     segs += [
-        (zero + (cbase + off["full"]), zero + len(_C_FULL)),
+        (zero + (cbase + off["full"]), zero + len(parts["full"])),
         (zero, row_e),
-        (zero + (cbase + off["host"]), zero + len(_C_HOST)),
+        (zero + (cbase + off["host"]), zero + len(parts["host"])),
         (jnp.where(host_empty, cbase + off["unknown"], host_s),
          jnp.where(host_empty, len(_C_UNKNOWN), host_e - host_s)),
+        (zero + (cbase + off["hl"]), zero + len(parts["hl"])),
         (zero + (cbase + off["level"]),
          jnp.where(has_level, len(_C_LEVEL), 0)),
         (cbase + off["sevd"] + jnp.maximum(level, 0),
          jnp.where(has_level, 1, 0)),
+        # extras between level and short: after-number when a level is
+        # present, string-close otherwise (same pairing as short below)
+        (jnp.where(has_level, cbase + off["l2a"], cbase + off["l2b"]),
+         jnp.where(has_level, len(parts["l2a"]), len(parts["l2b"]))),
         (jnp.where(has_level, cbase + off["short_l"],
                    cbase + off["short"]),
          jnp.where(has_level, len(_C_SHORT_LVL), len(_C_SHORT))),
@@ -213,10 +231,10 @@ def _encode_kernel(batch, lens, dec, ts_text, ts_len, *, suffix: bytes,
          jnp.where(has_msg, 1, len(_C_DASH))),
         (msg_s, jnp.where(has_msg, msg_e - msg_s, 0)),
         (zero + qsrc, jnp.where(has_msg, 1, 0)),
-        (zero + (cbase + off["ts"]), zero + len(_C_TS)),
+        (zero + (cbase + off["ts"]), zero + len(parts["ts"])),
         (zero + tbase, ts_len.astype(_I32)),
         (zero + (cbase + off["tail"]),
-         zero + len(_C_TAIL) + len(suffix)),
+         zero + len(parts["tail"]) + len(suffix)),
     ]
 
     out_len = segs[0][1]
@@ -244,12 +262,16 @@ def _encode_kernel(batch, lens, dec, ts_text, ts_len, *, suffix: bytes,
 def route_ok(encoder, merger, decoder=None) -> bool:
     """GELF output over line/nul/syslen framing, untyped decode only
     (``ltsv_schema`` rows carry per-value canonicality screens that are
-    host work), no extras (this layout has no extras slots yet)."""
+    host work); gelf_extra rides as constant segments when this
+    layout's keys place statically (gelf_extra_consts_ltsv)."""
     from .device_common import gelf_route_ok
+    from .encode_ltsv_gelf_block import gelf_extra_consts_ltsv
 
     if decoder is not None and getattr(decoder, "schema", None):
         return False
-    return gelf_route_ok(encoder, merger, lambda e: False)
+    return gelf_route_ok(
+        encoder, merger,
+        lambda e: gelf_extra_consts_ltsv(e) is not None)
 
 
 def fetch_encode(handle, packed, encoder, merger, route_state=None,
@@ -262,11 +284,12 @@ def fetch_encode(handle, packed, encoder, merger, route_state=None,
     out, batch_dev, lens_dev = handle
     suffix, syslen = merger_suffix(merger)
     impl = best_scan_impl()
+    extras = tuple((k, v) for k, v in getattr(encoder, "extra", ()))
 
     def kernel(ts_text, ts_len, assemble):
         return _encode_kernel(batch_dev, lens_dev, dict(out), ts_text,
                               ts_len, suffix=suffix, impl=impl,
-                              assemble=assemble)
+                              assemble=assemble, extras=extras)
 
     def scalar_fn(line):
         return _scalar_ltsv(decoder, line)

@@ -75,12 +75,9 @@ def _bank(suffix: bytes, extras=()):
         (parts["open"], parts["host"], parts["hl"], parts["l2a"],
          parts["l2b"], parts["short_p"], parts["short_n"], parts["ts"],
          parts["tail"]) = econsts
-    offs, bank = {}, b""
-    for k, v in parts.items():
-        if k == "tail":
-            v = v + suffix
-        offs[k] = len(bank)
-        bank += v
+    from .device_common import build_bank
+
+    bank, offs = build_bank(parts, suffix)
     return bank, offs, parts
 
 

@@ -133,16 +133,20 @@ def test_device_ltsv_schema_stays_off_device():
         '[input.ltsv_schema]\ncounter = "u64"\n'))
     assert device_ltsv.route_ok(ENC, LineMerger(), typed) is False
     assert device_ltsv.route_ok(ENC, LineMerger(), ORACLE) is True
-    enc_extra = GelfEncoder(Config.from_string(
+    placeable = GelfEncoder(Config.from_string(
         '[output.gelf_extra]\nregion = "eu"\n'))
-    assert device_ltsv.route_ok(enc_extra, LineMerger(), ORACLE) is False
+    assert device_ltsv.route_ok(placeable, LineMerger(), ORACLE) is True
+    dynamic = GelfEncoder(Config.from_string(
+        '[output.gelf_extra]\n_dyn = "v"\n'))
+    assert device_ltsv.route_ok(dynamic, LineMerger(), ORACLE) is False
 
 
 def test_ltsv_gelf_extra_static_slots_host_tier():
-    """gelf_extra on the ltsv→GELF pair (host tier; the device tier
-    declines extras and splices through here): keys covering every slot
-    of this layout, over rows with and without level/message, must
-    byte-match the scalar encoder."""
+    """gelf_extra on the ltsv→GELF pair: keys covering every slot of
+    this layout, over rows with and without level/message, must
+    byte-match the scalar encoder — through the production route (the
+    device tier engages and shares the host tier's folded constants)
+    and on the host segment engine directly."""
     from flowgger_tpu.tpu.batch import block_fetch_encode, block_submit
 
     enc = GelfEncoder(Config.from_string(
@@ -169,6 +173,21 @@ def test_ltsv_gelf_extra_static_slots_host_tier():
                                        merger, ORACLE)
         assert res is not None
         assert res.block.data == oracle(merger)
+
+    # host segment engine directly (the fallback when the device tier
+    # declines a batch) must produce the same bytes
+    from flowgger_tpu.tpu.encode_ltsv_gelf_block import (
+        encode_ltsv_gelf_block,
+    )
+
+    packed = pack.pack_lines_2d(lines, 256)
+    handle = block_submit("ltsv", packed)
+    host_out = ltsv.decode_ltsv_fetch(handle)
+    res2 = encode_ltsv_gelf_block(packed[2], packed[3], packed[4],
+                                  host_out, packed[5], 256, enc,
+                                  LineMerger(), ORACLE)
+    assert res2 is not None
+    assert res2.block.data == oracle(LineMerger())
 
     bad = GelfEncoder(Config.from_string(
         '[output.gelf_extra]\n_dyn = "v"\n'))
