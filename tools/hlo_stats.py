@@ -26,16 +26,33 @@ from flowgger_tpu.tpu import rfc5424 as R
 
 N = int(os.environ.get("HLO_N", 65_536))
 L = 256
+FMT = os.environ.get("HLO_FMT", "rfc5424")
+
+
+def _decode_fn():
+    """The lowered function for HLO_FMT (rfc5424 default; ltsv, gelf,
+    rfc3164 for the other kernels' censuses)."""
+    if FMT == "ltsv":
+        from flowgger_tpu.tpu import ltsv
+
+        return lambda b, ln: digest_all(jnp, ltsv.decode_ltsv(b, ln))
+    if FMT == "gelf":
+        from flowgger_tpu.tpu import gelf
+
+        return lambda b, ln: digest_all(jnp, gelf.decode_gelf(b, ln))
+    if FMT == "rfc3164":
+        from flowgger_tpu.tpu import rfc3164
+
+        return lambda b, ln: digest_all(
+            jnp, rfc3164.decode_rfc3164(b, ln, jnp.int32(2026)))
+    return lambda b, ln: digest_all(jnp, R.decode_rfc5424(b, ln))
 
 
 def main():
     b = jnp.zeros((N, L), jnp.uint8)
     ln = jnp.full((N,), L, jnp.int32)
 
-    def full(b, ln):
-        return digest_all(jnp, R.decode_rfc5424(b, ln))
-
-    comp = jax.jit(full).lower(b, ln).compile()
+    comp = jax.jit(_decode_fn()).lower(b, ln).compile()
     txt = comp.as_text()
     big = f"{N},{L}"
     counts = collections.Counter()
@@ -58,7 +75,7 @@ def main():
                 k in s for k in (" dot(", " dot-general(",
                                  " cumsum", " sort(", " scatter(")):
             counts[op] += 1
-    print(f"geometry [{N},{L}] — ops materializing a [N,L] operand:")
+    print(f"{FMT} geometry [{N},{L}] — ops materializing a [N,L] operand:")
     for k, v in counts.most_common():
         print(f"  {k:24s} {v}")
     print(f"\ntotal fusions touching [N,L]: "
