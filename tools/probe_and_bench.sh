@@ -1,0 +1,14 @@
+#!/bin/sh
+# Probe the TPU relay; on success run the full bench and save the JSON
+# (the round's one missing artifact — every round-4 change is
+# CPU-verified and waiting on a chip number).
+cd "$(dirname "$0")/.."
+if timeout 240 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+    echo "relay UP — running live bench"
+    timeout 3000 python bench.py > BENCH_live_r04.json 2> /tmp/bench_live.log
+    echo "bench rc=$?"
+    tail -c 400 BENCH_live_r04.json
+else
+    echo "relay still down"
+    exit 1
+fi
