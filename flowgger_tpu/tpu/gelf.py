@@ -55,7 +55,6 @@ import jax.numpy as jnp
 from .rfc5424 import (
     _bitpack32,
     _esc_parity,
-    _min_where,
     _scan_ordinals,
     _shift_left,
     _shift_right,
@@ -72,14 +71,6 @@ WS_WINDOW = 8
 _I32 = jnp.int32
 
 VT_STRING, VT_NUMBER, VT_TRUE, VT_FALSE, VT_NULL = 0, 1, 2, 3, 4
-
-
-def _match_token(bb, text: bytes):
-    """positions where ``text`` starts, via shifted byte planes."""
-    m = bb == text[0]
-    for i, ch in enumerate(text[1:], start=1):
-        m &= _shift_left(bb, i, 0) == ch
-    return m
 
 
 def decode_gelf(batch: jnp.ndarray, lens: jnp.ndarray,
@@ -116,64 +107,107 @@ def decode_gelf(batch: jnp.ndarray, lens: jnp.ndarray,
     open_q = real_q & outside
     close_q = real_q & ~outside
     inside_str = (~outside) & valid
-    n_quotes = jnp.max(jnp.where(real_q, q_incl, 0), axis=1).astype(_I32)
-    ok = (n_quotes & 1) == 0  # every string closed
-    ok &= ~cap_viol
+    ok = ~cap_viol
 
     # ---- bounded-window lookarounds -------------------------------------
     # ptb/ntb: byte of the nearest non-ws position within WS_WINDOW
     # before/after each position (0 when none in window).  Rows with a
     # longer outside-string whitespace run fall back, so "not found in
     # window" can never silently mean "found nothing relevant".
-    ptb = jnp.zeros_like(bb)
-    ntb = jnp.zeros_like(bb)
-    for k in range(WS_WINDOW, 0, -1):
-        nw_p = _shift_right(nonws, k, False)
-        ptb = jnp.where(nw_p, _shift_right(bb, k, 0), ptb)
-        nw_n = _shift_left(nonws, k, False)
-        ntb = jnp.where(nw_n, _shift_left(bb, k, 0), ntb)
+    #
+    # Round-5 fold: the old per-shift select chain materialized ~2*W
+    # [N, L] pad fusions (the shifted planes each had many consumers, so
+    # XLA would not rematerialize them); a reduce-window over a packed
+    # (position << 8 | byte) word is ONE windowed pass each way — max
+    # over [p-W, p-1] picks the nearest previous non-ws (largest
+    # position) with its byte in the low bits, min over [p+1, p+W] the
+    # nearest next.
+    bi32 = bb.astype(_I32)
+    pv = jnp.where(nonws, (iota << 8) | bi32, -1)
+    rw_p = jax.lax.reduce_window(
+        pv, jnp.int32(-1), jax.lax.max, (1, WS_WINDOW), (1, 1),
+        ((0, 0), (WS_WINDOW - 1, 0)))
+    ptb_w = _shift_right(rw_p, 1, -1)
+    ptb = jnp.where(ptb_w >= 0, ptb_w & 255, 0)
+    _BIG = jnp.int32(1 << 30)
+    nv = jnp.where(nonws, (iota << 8) | bi32, _BIG)
+    rw_n = jax.lax.reduce_window(
+        nv, _BIG, jax.lax.min, (1, WS_WINDOW), (1, 1),
+        ((0, 0), (0, WS_WINDOW - 1)))
+    ntb_w = _shift_left(rw_n, 1, _BIG)
+    ntb = jnp.where(ntb_w < _BIG, ntb_w & 255, 0)
 
+    # ws run > WS_WINDOW outside strings: a windowed count hitting W+1
+    # (edge padding contributes 0, so short runs at the line start can
+    # never flag, matching the old shifted-AND ladder's False fill)
     run = is_ws & outside
-    acc = run
-    for k in range(1, WS_WINDOW + 1):
-        acc = acc & _shift_right(run, k, False)
-    ok &= ~jnp.any(acc, axis=1)  # ws run > WS_WINDOW outside strings
+    rw_run = jax.lax.reduce_window(
+        run.astype(_I32), jnp.int32(0), jax.lax.add,
+        (1, WS_WINDOW + 1), (1, 1), ((0, 0), (WS_WINDOW, 0)))
+    # every row-disqualifying plane ORs into one mask reduced by a single
+    # any at the end (round-5 fold: was 7 separate any-reductions)
+    viol = rw_run == WS_WINDOW + 1
 
     # ---- structure: braces, arrays --------------------------------------
     lb = (bb == ord("{")) & outside
     rb = (bb == ord("}")) & outside
-    ok &= jnp.sum(lb.astype(_I32), axis=1) == 1
-    ok &= jnp.sum(rb.astype(_I32), axis=1) == 1
-    ok &= ~jnp.any(((bb == ord("[")) | (bb == ord("]"))) & outside, axis=1)
-    first_nonws = _min_where(nonws, iota, L)
-    lb_pos = _min_where(lb, iota, L)
-    rb_pos = jnp.max(jnp.where(rb, iota, -1), axis=1)
-    last_nonws = jnp.max(jnp.where(nonws, iota, -1), axis=1)
-    ok &= (first_nonws == lb_pos) & (last_nonws == rb_pos) & (lb_pos < rb_pos)
+    viol |= ((bb == ord("[")) | (bb == ord("]"))) & outside
+    # first/last non-ws position with an is-it-the-brace tag packed into
+    # the reduction word (fold: was 4 reductions — first_nonws/lb_pos
+    # mins, last_nonws/rb_pos maxes).  Combined with the exactly-one
+    # lb/rb count checks below this is equivalent to first_nonws==lb_pos
+    # & last_nonws==rb_pos.
+    wf = jnp.min(jnp.where(nonws, 2 * iota + (~lb).astype(_I32), 2 * L + 2),
+                 axis=1)
+    first_is_lb = (wf & 1) == 0
+    first_nonws = wf >> 1
+    wl = jnp.max(jnp.where(nonws, 2 * iota + rb.astype(_I32), -1), axis=1)
+    last_is_rb = (wl & 1) == 1
+    last_nonws = wl >> 1
+    ok &= first_is_lb & last_is_rb & (first_nonws < last_nonws)
 
     # ---- token roles (elementwise) --------------------------------------
     is_key_open = open_q & ((ptb == ord("{")) | (ptb == ord(",")))
     is_val_open = open_q & (ptb == ord(":"))
-    ok &= ~jnp.any(open_q & ~is_key_open & ~is_val_open, axis=1)
+    viol |= open_q & ~is_key_open & ~is_val_open
     is_key_close = close_q & (ntb == ord(":"))
     is_val_close = close_q & ~is_key_close
     # a value close must be followed by ',' or '}'
-    ok &= ~jnp.any(is_val_close & (ntb != ord(",")) & (ntb != ord("}")),
-                   axis=1)
+    viol |= is_val_close & (ntb != ord(",")) & (ntb != ord("}"))
 
     colon_out = (bb == ord(":")) & outside & valid
     comma_out = (bb == ord(",")) & outside & valid
     # every comma introduces another key (next non-ws is a quote)
-    ok &= ~jnp.any(comma_out & (ntb != ord('"')), axis=1)
+    viol |= comma_out & (ntb != ord('"'))
 
     key_ord, kc_ord = _scan_ordinals(
         [is_key_open, is_key_close], scan_impl)
-    n_keys = jnp.max(jnp.where(is_key_open, key_ord, 0), axis=1).astype(_I32)
-    n_kc = jnp.max(jnp.where(is_key_close, kc_ord, 0), axis=1).astype(_I32)
+    # the seven row counts ride packed sums, as many per-count fields per
+    # i32 word as L allows (fold: was 3 maxes + 4 sums); the ordinal-plane
+    # maxes equal plain mask counts because the ordinals are inclusive
+    # cumsums
+    cbits = max(10, int(L + 1).bit_length())
+    per = max(1, 30 // cbits)
+    cmask = (1 << cbits) - 1
+
+    def packed_counts(masks):
+        outs = []
+        for base in range(0, len(masks), per):
+            grp = masks[base:base + per]
+            acc = grp[0].astype(_I32)
+            for s, m in enumerate(grp[1:], 1):
+                acc = acc + (m.astype(_I32) << (cbits * s))
+            word = jnp.sum(acc, axis=1)
+            for s in range(len(grp)):
+                outs.append((word >> (cbits * s)) & cmask)
+        return outs
+
+    n_quotes, lbc, rbc, n_keys, n_kc, n_colons, n_commas = packed_counts(
+        [real_q, lb, rb, is_key_open, is_key_close, colon_out, comma_out])
+    ok &= (n_quotes & 1) == 0  # every string closed
+    ok &= (lbc == 1) & (rbc == 1)
     ok &= n_kc == n_keys
     ok &= n_keys <= max_fields
-    n_colons = jnp.sum(colon_out.astype(_I32), axis=1)
-    n_commas = jnp.sum(comma_out.astype(_I32), axis=1)
     ok &= n_colons == n_keys
     ok &= n_commas == jnp.maximum(n_keys - 1, 0)
 
@@ -183,19 +217,27 @@ def decode_gelf(batch: jnp.ndarray, lens: jnp.ndarray,
     lit_start = is_lit & ~_shift_right(is_lit, 1, False)
     lit_end_m = is_lit & ~_shift_left(is_lit, 1, False)
     # nothing significant may precede the first key (between '{' and it)
-    ok &= ~jnp.any(is_lit & (key_ord == 0), axis=1)
+    viol |= is_lit & (key_ord == 0)
     # backslashes are only legal inside strings in flat JSON; a bs
     # "outside" (per possibly-garbled parity) sends the row to the
     # oracle, which also shields the parity math itself from junk input
-    ok &= ~jnp.any(is_bs & outside, axis=1)
+    viol |= is_bs & outside
+    ok &= ~jnp.any(viol, axis=1)
 
     # number/literal value start: a literal-run start whose previous
     # non-ws byte is ':'
     is_lit_val = lit_start & (ptb == ord(":"))
     is_val_start = is_val_open | is_lit_val
-    true_at = _match_token(bb, b"true")
-    false_at = _match_token(bb, b"false")
-    null_at = _match_token(bb, b"null")
+    # literal tokens match against a packed next-4-bytes word (2 shifted
+    # planes) instead of per-token shifted-plane chains (was ~11 planes);
+    # high input bytes overflow into the sign bit deterministically and
+    # can never collide with the ASCII token constants
+    w2 = (bi32 << 8) | _shift_left(bi32, 1, 0)
+    w4 = (w2 << 16) | _shift_left(w2, 2, 0)
+    true_at = w4 == int.from_bytes(b"true", "big")
+    null_at = w4 == int.from_bytes(b"null", "big")
+    false_at = (w4 == int.from_bytes(b"fals", "big")) & \
+        (_shift_left(bi32, 4, 0) == ord("e"))
     is_num0 = ((bb >= 48) & (bb <= 57)) | (bb == ord("-"))
     vclass = jnp.where(
         is_val_open, 1 + VT_STRING,
@@ -210,10 +252,15 @@ def decode_gelf(batch: jnp.ndarray, lens: jnp.ndarray,
                                   extract_impl)
     key_close_pos = extract_by_ord(is_key_close, kc_ord, iota, F, L,
                                    extract_impl)
-    val_start_pos = extract_by_ord(is_val_start, key_ord, iota, F, L,
-                                   extract_impl)
-    val_class1 = extract_by_ord(is_val_start, key_ord, vclass, F, 0,
-                                extract_impl)
+    # value position and class share one extraction word per slot: the
+    # class rides bits above the position field (fold: was 2 channels =
+    # 6 reduction words at F=8; fill L keeps the class field 0)
+    pbits = max(10, int(L + 1).bit_length())
+    vs_packed = extract_by_ord(is_val_start, key_ord,
+                               iota | (vclass << pbits), F, L,
+                               extract_impl, slot_bits=pbits + 3)
+    val_start_pos = vs_packed & ((1 << pbits) - 1)
+    val_class1 = vs_packed >> pbits
     val_close_pos = extract_by_ord(is_val_close, key_ord, iota, F, L,
                                    extract_impl)
     lit_end_pos = extract_by_ord(lit_end_m, key_ord, iota, F, L,

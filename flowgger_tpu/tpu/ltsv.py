@@ -40,6 +40,7 @@ import jax
 import jax.numpy as jnp
 
 from .rfc5424 import (
+    _cummax,
     _days_from_civil,
     _days_in_month,
     _min_where,
@@ -98,42 +99,66 @@ def decode_ltsv(batch: jnp.ndarray, lens: jnp.ndarray,
         [jnp.zeros_like(lens)[:, None],
          jnp.minimum(tab_pos + 1, lens[:, None])], axis=1)
 
-    # first ':' in each part (or L)
+    # first ':' in each part (or L): a colon is first-in-its-part iff the
+    # last tab-or-colon strictly before it is a tab (or line start).  One
+    # cummax of a tagged channel (2*iota+1 at tabs, 2*iota at colons) plus
+    # ONE packed-sum extraction keyed on part ordinals replaces the old
+    # P=24 per-part _min_where stack (round-5 fusion fold; the same shape
+    # that took rfc5424's sid_end stack out).
     is_colon = (bb == ord(":")) & valid
-    colon_pos = jnp.stack(
-        [_min_where(is_colon & (iota >= part_start[:, k:k + 1]), iota, L)
-         for k in range(max_parts)], axis=1)
+    tag = jnp.where(is_tab, 2 * iota + 1,
+                    jnp.where(is_colon, 2 * iota, -1))
+    last_tc = _shift_right(_cummax(tag, scan_impl), 1, -1)
+    # -1 & 1 == 1, so line start (no prior tab/colon) also counts as tab
+    first_colon = is_colon & ((last_tc & 1) == 1)
+    # part ordinal of a (non-tab) position = tabs at or before it
+    part_of = tab_ord.astype(_I32)
+    colon_pos = extract_by_ord(first_colon, part_of + 1, iota, max_parts, L,
+                               extract_impl)
     has_colon = colon_pos < part_end
 
     # ---- special keys, elementwise pattern matches ----------------------
     at_part_start = (iota == 0) | (_shift_right(is_tab, 1, False))
+    # pack (position, part ordinal) in one word so the max-reduction that
+    # finds the key also yields which part holds it (fold: the 4 per-key
+    # value_span min-reductions become [N, P]-sized part_end selects)
+    tbits = int(L + 1).bit_length()
+    pos_part = (iota << tbits) | part_of
 
     def special(key: bytes):
         pat = _match_at(bb, key + b":", valid) & at_part_start
-        # last occurrence wins (scalar decoder overwrites)
-        pos = jnp.max(jnp.where(pat, iota, -1), axis=1)
-        return pos  # -1 if absent; else position of key start
+        # last occurrence wins (scalar decoder overwrites); max over the
+        # packed word orders by position (the high field)
+        w = jnp.max(jnp.where(pat, pos_part, -1), axis=1)
+        pos = jnp.where(w >= 0, w >> tbits, -1)
+        pidx = jnp.where(w >= 0, w & ((1 << tbits) - 1), 0)
+        return pos, pidx
 
-    time_pos = special(b"time")
-    host_pos = special(b"host")
-    msg_pos = special(b"message")
-    level_pos = special(b"level")
+    time_pos, time_pi = special(b"time")
+    host_pos, host_pi = special(b"host")
+    msg_pos, msg_pi = special(b"message")
+    level_pos, level_pi = special(b"level")
 
-    def value_span(pos, key_len):
-        """[value_start, next tab or end) for a special key at pos."""
+    krange = jnp.arange(max_parts, dtype=_I32)
+
+    def value_span(pos, pidx, key_len):
+        """[value_start, part end) for a special key at pos — tabs are
+        separators, so the value always runs to its part's end; select
+        part_end[n, pidx] with a tiny [N, P] masked sum (no gather)."""
         vstart = pos + key_len + 1
-        vend = _min_where(is_tab & (iota >= vstart[:, None]), iota, L)
-        vend = jnp.minimum(vend, lens)
+        vend = jnp.sum(
+            jnp.where(krange[None, :] == pidx[:, None], part_end, 0), axis=1)
         return vstart, jnp.where(pos >= 0, vend, -1)
 
-    host_start, host_end = value_span(host_pos, 4)
-    msg_start, msg_end = value_span(msg_pos, 7)
-    level_start, level_end = value_span(level_pos, 5)
-    time_start, time_end = value_span(time_pos, 4)
+    host_start, host_end = value_span(host_pos, host_pi, 4)
+    msg_start, msg_end = value_span(msg_pos, msg_pi, 7)
+    level_start, level_end = value_span(level_pos, level_pi, 5)
+    time_start, time_end = value_span(time_pos, time_pi, 4)
 
     has_time = time_pos >= 0
     has_host = host_pos >= 0
     ok &= has_time & has_host  # missing -> oracle for exact error text
+    tv_len = time_end - time_start
 
     # ---- level parse ----------------------------------------------------
     has_level = level_pos >= 0
@@ -148,13 +173,20 @@ def decode_ltsv(batch: jnp.ndarray, lens: jnp.ndarray,
     ok &= lv_ok  # >7 or junk -> oracle reproduces the exact error
 
     # ---- time parse -----------------------------------------------------
-    # optional [ ... ] wrapper
-    t_first = jnp.where(has_time, jnp.sum(
-        jnp.where(iota == time_start[:, None], bb.astype(_I32), 0), axis=1), 0)
-    t_last = jnp.where(has_time, jnp.sum(
-        jnp.where(iota == (time_end - 1)[:, None], bb.astype(_I32), 0), axis=1), 0)
-    bracketed = (t_first == ord("[")) & (t_last == ord("]")) & \
-        (time_end - time_start >= 2)
+    # optional [ ... ] wrapper.  The bytes at time_start, time_start+1 and
+    # time_end-1 ride ONE packed 8-bit-field sum (fold: was 3 reductions —
+    # t_first, t_last, and the post-bracket c0); coinciding positions for
+    # 1/2-char values land in separate fields, so no carries.
+    bi = bb.astype(_I32)
+    w3 = jnp.sum(
+        jnp.where(iota == time_start[:, None], bi, 0)
+        + (jnp.where(iota == (time_start + 1)[:, None], bi, 0) << 8)
+        + (jnp.where(iota == (time_end - 1)[:, None], bi, 0) << 16), axis=1)
+    w3 = jnp.where(has_time, w3, 0)
+    t_first = w3 & 255
+    t_second = (w3 >> 8) & 255
+    t_last = (w3 >> 16) & 255
+    bracketed = (t_first == ord("[")) & (t_last == ord("]")) & (tv_len >= 2)
     ts_s = jnp.where(bracketed, time_start + 1, time_start)
     ts_e = jnp.where(bracketed, time_end - 1, time_end)
     tlen = ts_e - ts_s
@@ -163,36 +195,49 @@ def decode_ltsv(batch: jnp.ndarray, lens: jnp.ndarray,
     in_t = (r >= 0) & (r < tlen[:, None])
 
     # float form: [+-]? digits [. digits]  (exponents/inf/nan -> fallback)
-    c0 = jnp.sum(jnp.where(in_t & (r == 0), bb.astype(_I32), 0), axis=1)
+    c0 = jnp.where(bracketed, t_second, t_first)
     has_sign = (c0 == ord("+")) | (c0 == ord("-"))
     body_from = jnp.where(has_sign, 1, 0)
     dot_pos = _min_where(in_t & (bb == ord(".")), r, 1 << 20)
-    is_float_body = ~jnp.any(
-        in_t & (r >= body_from[:, None]) & (r != dot_pos[:, None]) & ~is_digit,
-        axis=1)
     n_dots = jnp.sum((in_t & (bb == ord("."))).astype(_I32), axis=1)
+    # both disqualifiers share ONE any-reduction (fold: was 2)
+    float_viol = (
+        (in_t & (r >= body_from[:, None]) & (r != dot_pos[:, None]) & ~is_digit)
+        | (in_t & (r == body_from[:, None]) & (bb == ord(".")))
+    )
     float_ok = (
-        is_float_body & (n_dots <= 1) & (tlen >= 1)
+        ~jnp.any(float_viol, axis=1) & (n_dots <= 1) & (tlen >= 1)
         & (tlen - body_from >= 1)
-        # need at least one digit and, if dotted, digits around count free
-        & ~jnp.any(in_t & (r == body_from[:, None]) & (bb == ord(".")), axis=1)
     )
 
-    # rfc3339 form: reuse the rfc5424 timestamp machinery inline
-    w_date = ((r == 0) * 1000 + (r == 1) * 100 + (r == 2) * 10 + (r == 3))
+    # rfc3339 form: reuse the rfc5424 timestamp machinery inline.
+    # Digit sums ride packed 8/14-bit fields: month|day|hour|minute in one
+    # word, year|sec in a second (fold: was 6 reductions); per-field sums
+    # are <= 99/9999, so fields never carry.
     dz = jnp.where(in_t, dig, 0)
-    year = jnp.sum(dz * w_date, axis=1)
-    month = jnp.sum(dz * ((r == 5) * 10 + (r == 6)), axis=1)
-    day = jnp.sum(dz * ((r == 8) * 10 + (r == 9)), axis=1)
-    hour = jnp.sum(dz * ((r == 11) * 10 + (r == 12)), axis=1)
-    minute = jnp.sum(dz * ((r == 14) * 10 + (r == 15)), axis=1)
-    sec = jnp.sum(dz * ((r == 17) * 10 + (r == 18)), axis=1)
+    w_mdhm = ((r == 5) * 10 + (r == 6)
+              + (((r == 8) * 10 + (r == 9)) << 8)
+              + (((r == 11) * 10 + (r == 12)) << 16)
+              + (((r == 14) * 10 + (r == 15)) << 24))
+    wm = jnp.sum(dz * w_mdhm, axis=1)
+    month = wm & 255
+    day = (wm >> 8) & 255
+    hour = (wm >> 16) & 255
+    minute = (wm >> 24) & 255
+    w_ys = ((r == 0) * 1000 + (r == 1) * 100 + (r == 2) * 10 + (r == 3)
+            + (((r == 17) * 10 + (r == 18)) << 14))
+    wy = jnp.sum(dz * w_ys, axis=1)
+    year = wy & 16383
+    sec = (wy >> 14) & 255
     digit_off = ((r >= 0) & (r <= 18) &
                  (r != 4) & (r != 7) & (r != 10) & (r != 13) & (r != 16))
-    rviol = jnp.any(in_t & digit_off & ~is_digit, axis=1)
-    rviol |= jnp.any(in_t & ((r == 4) | (r == 7)) & (bb != ord("-")), axis=1)
-    rviol |= jnp.any(in_t & (r == 10) & (bb != ord("T")) & (bb != ord("t")), axis=1)
-    rviol |= jnp.any(in_t & ((r == 13) | (r == 16)) & (bb != ord(":")), axis=1)
+    # every structural disqualifier (digit slots, separators, and — below —
+    # the numeric-offset shape) ORs into one mask for a single any (fold:
+    # was 6 reductions across rviol/oviol)
+    viol_mask = in_t & digit_off & ~is_digit
+    viol_mask |= in_t & ((r == 4) | (r == 7)) & (bb != ord("-"))
+    viol_mask |= in_t & (r == 10) & (bb != ord("T")) & (bb != ord("t"))
+    viol_mask |= in_t & ((r == 13) | (r == 16)) & (bb != ord(":"))
     has_frac = jnp.sum(jnp.where(in_t & (r == 19), bb.astype(_I32), 0),
                        axis=1) == ord(".")
     rd = r - 20
@@ -210,17 +255,20 @@ def decode_ltsv(batch: jnp.ndarray, lens: jnp.ndarray,
     is_zulu = (oc == ord("Z")) | (oc == ord("z"))
     is_num_off = (oc == ord("+")) | (oc == ord("-"))
     off_ok = jnp.where(is_zulu, tlen == opos + 1, True)
-    oviol = jnp.any(in_t & ((r2 == 1) | (r2 == 2) | (r2 == 4) | (r2 == 5))
-                    & ~is_digit & is_num_off[:, None], axis=1)
-    oviol |= jnp.any(in_t & (r2 == 3) & (bb != ord(":")) & is_num_off[:, None],
-                     axis=1)
-    oh = jnp.sum(dz * ((r2 == 1) * 10 + (r2 == 2)), axis=1)
-    om = jnp.sum(dz * ((r2 == 4) * 10 + (r2 == 5)), axis=1)
+    viol_mask |= (in_t & ((r2 == 1) | (r2 == 2) | (r2 == 4) | (r2 == 5))
+                  & ~is_digit & is_num_off[:, None])
+    viol_mask |= (in_t & (r2 == 3) & (bb != ord(":")) & is_num_off[:, None])
+    struct_viol = jnp.any(viol_mask, axis=1)
+    # oh|om packed in one 8-bit-field sum (fold: was 2 reductions)
+    w_ohm = jnp.sum(dz * ((r2 == 1) * 10 + (r2 == 2)
+                          + (((r2 == 4) * 10 + (r2 == 5)) << 8)), axis=1)
+    oh = w_ohm & 255
+    om = (w_ohm >> 8) & 255
     off_ok &= jnp.where(is_num_off,
-                        ~oviol & (tlen == opos + 6) & (oh <= 23) & (om <= 59),
+                        (tlen == opos + 6) & (oh <= 23) & (om <= 59),
                         True)
     rfc_ok = (
-        (tlen >= 20) & ~rviol & (is_zulu | is_num_off) & off_ok
+        (tlen >= 20) & ~struct_viol & (is_zulu | is_num_off) & off_ok
         & (month >= 1) & (month <= 12) & (day >= 1)
         & (day <= _days_in_month(year, month))
         & (hour <= 23) & (minute <= 59) & (sec <= 59)

@@ -73,7 +73,8 @@ def main():
             fusion_lines.append(s[:160])
         elif big in shape and any(
                 k in s for k in (" dot(", " dot-general(",
-                                 " cumsum", " sort(", " scatter(")):
+                                 " cumsum", " sort(", " scatter(",
+                                 " reduce-window(")):
             counts[op] += 1
     print(f"{FMT} geometry [{N},{L}] — ops materializing a [N,L] operand:")
     for k, v in counts.most_common():

@@ -1,13 +1,22 @@
 #!/bin/sh
 # Probe the TPU relay; on success run the full bench and save the JSON
-# (the round's one missing artifact — every round-4 change is
-# CPU-verified and waiting on a chip number).
+# atomically (ADVICE r4: never leave a truncated BENCH_live file behind).
 cd "$(dirname "$0")/.."
 if timeout 240 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
     echo "relay UP — running live bench"
-    timeout 3000 python bench.py > BENCH_live_r04.json 2> /tmp/bench_live.log
-    echo "bench rc=$?"
-    tail -c 400 BENCH_live_r04.json
+    # stage next to the destination so the mv is an atomic rename even
+    # when /tmp is a different filesystem (tmpfs)
+    timeout 3000 python bench.py > BENCH_live_r05.json.tmp 2> /tmp/bench_live.log
+    rc=$?
+    echo "bench rc=$rc"
+    if [ "$rc" -eq 0 ] && [ -s BENCH_live_r05.json.tmp ]; then
+        mv BENCH_live_r05.json.tmp BENCH_live_r05.json
+        tail -c 400 BENCH_live_r05.json
+    else
+        echo "bench failed; artifact NOT written (see /tmp/bench_live.log)"
+        rm -f BENCH_live_r05.json.tmp
+        exit 2
+    fi
 else
     echo "relay still down"
     exit 1

@@ -1,0 +1,12 @@
+#!/bin/sh
+# Keep probing the relay all round (VERDICT r4 task #1). Logs each
+# attempt; exits as soon as a live bench artifact lands.
+cd "$(dirname "$0")/.."
+i=0
+while [ ! -f BENCH_live_r05.json ]; do
+    i=$((i+1))
+    echo "[probe_loop] attempt $i $(date -u +%H:%M:%S)"
+    sh tools/probe_and_bench.sh && break
+    sleep 600
+done
+echo "[probe_loop] done"
