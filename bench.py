@@ -154,6 +154,11 @@ def bench_e2e(lines, jax, jnp, extra):
         pos = 0
         while pos < len(region):
             cut = region.rfind(b"\n", pos, pos + approx)
+            if cut < 0:
+                # no newline inside the window: take the next one forward
+                # instead of swallowing the rest of the region in one
+                # chunk (ADVICE r4 — keeps the double-buffer overlap real)
+                cut = region.find(b"\n", pos + approx)
             cut = len(region) if cut < 0 else cut + 1
             handler.ingest_chunk(region[pos:cut])
             pos = cut
@@ -489,11 +494,18 @@ def main():
         file=sys.stderr,
     )
 
-    extra = {"batch_latency_ms": {"p50": round(p50 * 1e3, 1),
-                                  "p99": round(p99 * 1e3, 1),
-                                  "max": round(lat[-1] * 1e3, 1),
-                                  "trials": lat_trials,
-                                  "batch_lines": n}}
+    lat_ms = {"p50": round(p50 * 1e3, 1),
+              "max": round(lat[-1] * 1e3, 1),
+              "trials": lat_trials,
+              "batch_lines": n}
+    # a 3/10-trial degraded run has no real tail: report its sample max
+    # under a distinct name so it is never comparable-by-name with the
+    # 100-trial device p99 (ADVICE r4)
+    if lat_trials >= 50:
+        lat_ms["p99"] = round(p99 * 1e3, 1)
+    else:
+        lat_ms["p99_unavailable_sample_max"] = round(p99 * 1e3, 1)
+    extra = {"batch_latency_ms": lat_ms}
     bench_fallback_corpora(jax, jnp, extra, smoke or cpu_fallback)
     bench_e2e(lines[:E2E_BATCH], jax, jnp, extra)
     bench_other_configs(jax, jnp, dev, cpu_fallback, smoke, extra)

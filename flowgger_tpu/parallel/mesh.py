@@ -101,6 +101,7 @@ class ShardedDecode:
         self.dp = mesh.shape["dp"]
         self.sp = mesh.shape["sp"]
         self._put_cache = None  # one-slot: (batch_obj, lens_obj, placed)
+        self._frozen = []       # arrays we set read-only for the cache entry
 
     def put(self, batch, lens):
         """Pad rows to a dp multiple (padding rows have len 0 and fall
@@ -131,6 +132,19 @@ class ShardedDecode:
             lens = np.pad(lens, (0, pad))
         placed = (jax.device_put(batch, self.batch_sharding),
                   jax.device_put(lens, self.lens_sharding))
+        # enforce the freeze contract: a cached numpy batch is made
+        # read-only so an in-place mutation + re-put raises instead of
+        # silently decoding the stale device copy (ADVICE r4).  The
+        # freeze is scoped to the cache entry's lifetime: arrays WE
+        # froze thaw on eviction, so refilling a buffer after a later
+        # put displaced it stays legal.
+        for a in self._frozen:
+            a.flags.writeable = True
+        self._frozen = []
+        for a in orig:
+            if isinstance(a, np.ndarray) and a.flags.writeable:
+                a.flags.writeable = False
+                self._frozen.append(a)
         # hold the original objects so their ids can't be recycled
         self._put_cache = (orig[0], orig[1], placed)
         return placed

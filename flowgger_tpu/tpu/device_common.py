@@ -405,6 +405,14 @@ def fetch_encode_driver(kernel, out, batch_dev, lens_dev, packed, encoder,
 
     small = {k: _fetch(out[k]) for k in ("ok", "days", "sod", "off",
                                          "nanos")}
+    # only phase-1 candidates get host timestamp formatting (ADVICE r4):
+    # tier-rejected rows (e.g. LTSV float-stamp rows) may hold garbage
+    # days/sod and their text is discarded anyway.  Phase-2 acceptance
+    # is intersected with cand1 below so a non-candidate can never ride
+    # the device tier with the placeholder text.
+    cand1_full = np.zeros(small["ok"].shape[0], dtype=bool)
+    cand1_full[:n] = cand1
+    small["ok"] = small["ok"].astype(bool) & cand1_full
     ts_text, ts_len = ts_text_block(small)
     acc, out_len, tier = kernel(jnp.asarray(ts_text),
                                 jnp.asarray(ts_len), True)
@@ -418,8 +426,9 @@ def fetch_encode_driver(kernel, out, batch_dev, lens_dev, packed, encoder,
     len_np = len_full[:n]
 
     # the real (shorter) timestamp text can only widen the tier vs the
-    # pessimistic phase-1 gate; cand stays the decision set either way
-    cand = tier_np & (lens64 <= max_len)
+    # pessimistic phase-1 gate, but rows outside cand1 carry placeholder
+    # ts text (masked above), so the decision set is the intersection
+    cand = tier_np & cand1
     ridx = np.flatnonzero(cand)
 
     N_acc, OW = acc.shape

@@ -56,6 +56,7 @@ from .rfc5424 import (
     _bitpack32,
     _esc_parity,
     _scan_ordinals,
+    _slot_geometry,
     _shift_left,
     _shift_right,
     best_extract_impl,
@@ -186,9 +187,7 @@ def decode_gelf(batch: jnp.ndarray, lens: jnp.ndarray,
     # i32 word as L allows (fold: was 3 maxes + 4 sums); the ordinal-plane
     # maxes equal plain mask counts because the ordinals are inclusive
     # cumsums
-    cbits = max(10, int(L + 1).bit_length())
-    per = max(1, 30 // cbits)
-    cmask = (1 << cbits) - 1
+    cbits, per, cmask = _slot_geometry(L)
 
     def packed_counts(masks):
         outs = []
