@@ -17,7 +17,7 @@ TPU shape of that fusion, for every format pair that rides it.
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 from typing import Dict
 
 import jax
@@ -214,20 +214,27 @@ def _compact_kernel(acc, out_len, tier, *, G: int = COMPACT_G):
     return x.reshape(-1)
 
 
-def ts_text_block(small: Dict[str, np.ndarray]):
+def ts_text_block(small: Dict[str, np.ndarray], ts_vals_fn=None):
     """Format per-row timestamp digits host-side.  The native threaded
     formatter (fg_format_f64_json: to_chars shortest round-trip,
     json_f64 notation — differentially fuzzed in
     tests/test_native_and_chunks.py) handles near-unique real-stream
     stamps at full rate; without the library, fall back to dedup +
-    per-unique json_f64 (only fast for repetitive streams)."""
+    per-unique json_f64 (only fast for repetitive streams).
+
+    ``ts_vals_fn(small, ok_mask) -> float64 array`` overrides the
+    default days/sod/off/nanos combine for formats whose device tier
+    carries other timestamp channels (ltsv float spans)."""
     from .. import native
     from ..utils.rustfmt import json_f64
 
     okh = small["ok"].astype(bool)
-    masked = {k: np.where(okh, small[k], 0)
-              for k in ("days", "sod", "off", "nanos")}
-    ts_vals = compute_ts(masked)
+    if ts_vals_fn is not None:
+        ts_vals = ts_vals_fn(small, okh)
+    else:
+        masked = {k: np.where(okh, small[k], 0)
+                  for k in ("days", "sod", "off", "nanos")}
+        ts_vals = compute_ts(masked)
     res = native.format_f64_json_native(ts_vals, TS_W)
     if res is not None:
         return res
@@ -260,6 +267,30 @@ _BIG = 0x7FFFFFFF  # sort key for absent pairs (names are ASCII < 0x7f)
 # optimal 12-comparator sorting network for 6 elements
 _NET6 = ((0, 5), (1, 3), (2, 4), (1, 2), (3, 4), (0, 3), (2, 5),
          (0, 1), (2, 3), (4, 5), (1, 2), (3, 4))
+
+
+@lru_cache(maxsize=None)
+def _sort_network(n: int):
+    """Comparator list sorting ``n`` elements: the hand-tuned
+    12-comparator network for the common 6-pair tier, Batcher
+    odd-even mergesort for any other width (63 comparators at n=16 —
+    the wide tier that keeps 7..16-pair streams on-device)."""
+    if n == 6:
+        return _NET6
+    pairs = []
+    p = 1
+    while p < n:
+        k = p
+        while k >= 1:
+            j = k % p
+            while j <= n - 1 - k:
+                for i in range(0, min(k, n - j - k)):
+                    if (i + j) // (p * 2) == (i + j + k) // (p * 2):
+                        pairs.append((i + j, i + j + k))
+                j += 2 * k
+            k //= 2
+        p *= 2
+    return tuple(pairs)
 
 
 def sort_pairs_by_key8(bb, iota, cols, max_pairs: int):
@@ -295,9 +326,7 @@ def sort_pairs_by_key8(bb, iota, cols, max_pairs: int):
         cols["nlen"].append(jnp.where(pv, ne_r - ns_r, _BIG))
 
     payload = [k for k in cols if k not in ("hi", "lo", "nlen")]
-    for i, j in _NET6:
-        if i >= max_pairs or j >= max_pairs:
-            continue
+    for i, j in _sort_network(max_pairs):
         ah, bh = cols["hi"][i], cols["hi"][j]
         al, bl = cols["lo"][i], cols["lo"][j]
         an, bn = cols["nlen"][i], cols["nlen"][j]
@@ -342,7 +371,9 @@ def gelf_route_ok(encoder, merger, extras_placeable) -> bool:
 def fetch_encode_driver(kernel, out, batch_dev, lens_dev, packed, encoder,
                         merger, route_state, suffix: bytes, syslen: bool,
                         scalar_fn, fallback_frac: float,
-                        decline_limit: int, cooldown: int):
+                        decline_limit: int, cooldown: int,
+                        ts_keys=("days", "sod", "off", "nanos"),
+                        ts_vals_fn=None, wide=None):
     """Shared fetch flow for every device-encode format:
 
     1. phase-1 tier probe (``kernel(..., assemble=False)`` — XLA
@@ -391,6 +422,30 @@ def fetch_encode_driver(kernel, out, batch_dev, lens_dev, packed, encoder,
     max_len = batch.shape[1]
     cand1 = tier1_np & (lens64 <= max_len)
 
+    # pair-budget escalation: when the base-width tier declines (e.g. a
+    # 7+-pair stream) and the format has a wide kernel (the encode-side
+    # analog of decode's 16-pair rescue), probe it before giving the
+    # batch to the host path; wide batches pay the bigger sort network
+    # and segment table only when the base width actually failed.  A
+    # failed wide probe sets its own cooldown so streams declining for
+    # non-pair reasons (escapes, bad stamps) don't pay a futile second
+    # decode + probe every batch.
+    if (n and wide is not None
+            and (1.0 - cand1.mean()) > fallback_frac):
+        wide_cd = 0 if route_state is None else \
+            route_state.get("wide_cooldown", 0)
+        if wide_cd > 0:
+            route_state["wide_cooldown"] = wide_cd - 1
+        else:
+            out_w, kernel_w = wide()
+            tier1w = kernel_w(empty_ts, full_ts_len, False)
+            cand1w = _fetch(tier1w)[:n] & (lens64 <= max_len)
+            if (1.0 - cand1w.mean()) <= fallback_frac:
+                _metrics.inc("device_encode_wide_batches")
+                kernel, out, cand1 = kernel_w, out_w, cand1w
+            elif route_state is not None:
+                route_state["wide_cooldown"] = cooldown
+
     if n and (1.0 - cand1.mean()) > fallback_frac:
         _metrics.inc("device_encode_declined")
         _metrics.inc("device_encode_fetch_bytes", fetched[0])
@@ -403,8 +458,7 @@ def fetch_encode_driver(kernel, out, batch_dev, lens_dev, packed, encoder,
     if route_state is not None:
         route_state["declines"] = 0
 
-    small = {k: _fetch(out[k]) for k in ("ok", "days", "sod", "off",
-                                         "nanos")}
+    small = {k: _fetch(out[k]) for k in ("ok",) + tuple(ts_keys)}
     # only phase-1 candidates get host timestamp formatting (ADVICE r4):
     # tier-rejected rows (e.g. LTSV float-stamp rows) may hold garbage
     # days/sod and their text is discarded anyway.  Phase-2 acceptance
@@ -413,7 +467,7 @@ def fetch_encode_driver(kernel, out, batch_dev, lens_dev, packed, encoder,
     cand1_full = np.zeros(small["ok"].shape[0], dtype=bool)
     cand1_full[:n] = cand1
     small["ok"] = small["ok"].astype(bool) & cand1_full
-    ts_text, ts_len = ts_text_block(small)
+    ts_text, ts_len = ts_text_block(small, ts_vals_fn)
     acc, out_len, tier = kernel(jnp.asarray(ts_text),
                                 jnp.asarray(ts_len), True)
 

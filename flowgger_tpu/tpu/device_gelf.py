@@ -294,10 +294,28 @@ def fetch_encode(handle, packed, encoder, merger, route_state=None):
                               impl=impl, assemble=assemble,
                               extras=extras)
 
+    def wide():
+        """Pair-budget escalation: re-decode the batch on-device at the
+        decode rescue width (16 SD pairs) and encode from those
+        channels — the [N, 16] pair axis sizes the sorter and segment
+        table automatically.  Lazy: a 7+-pair stream pays the second
+        decode + wide compile only when the base width declines."""
+        from .rfc5424 import RESCUE_MAX_PAIRS, decode_rfc5424_jit
+
+        out_w = decode_rfc5424_jit(batch_dev, lens_dev, max_sd=max_sd,
+                                   max_pairs=RESCUE_MAX_PAIRS)
+
+        def kernel_w(ts_text, ts_len, assemble):
+            return _encode_kernel(batch_dev, lens_dev, dict(out_w),
+                                  ts_text, ts_len, suffix=suffix,
+                                  max_sd=max_sd, impl=impl,
+                                  assemble=assemble, extras=extras)
+        return out_w, kernel_w
+
     from .materialize import _scalar_line
 
     return fetch_encode_driver(
         kernel, out, batch_dev, lens_dev, packed, encoder, merger,
         route_state, suffix, syslen, scalar_fn=_scalar_line,
         fallback_frac=FALLBACK_FRAC, decline_limit=DECLINE_LIMIT,
-        cooldown=COOLDOWN)
+        cooldown=COOLDOWN, wide=wide)

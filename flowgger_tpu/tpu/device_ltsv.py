@@ -16,11 +16,12 @@ row the tier accepts, exactly like the host tier's repeated-special
 fallback (encode_ltsv_gelf_block.py special_name handling).
 
 Device tier restrictions (everything else splices through the host
-span tier / scalar oracle): rfc3339 timestamps only (``ts_kind == 0``;
-unix-literal stamps need per-value host parses), ≤6 pairs, 8-byte sort
-prefixes with the ambiguity/duplicate fallback of the rfc5424 device
-sorter, no typed ``ltsv_schema`` (gated at the route), ASCII rows
-within the JSON-escape budget.
+span tier / scalar oracle): rfc3339 or unsigned unix-literal
+timestamps (the kernel's split-integer parse covers <= 16 digits
+within 2**53 exactly; signed or longer stamps need per-value host
+parses), ≤6 pairs, 8-byte sort prefixes with the ambiguity/duplicate
+fallback of the rfc5424 device sorter, no typed ``ltsv_schema`` (gated
+at the route), ASCII rows within the JSON-escape budget.
 """
 
 from __future__ import annotations
@@ -63,6 +64,10 @@ FALLBACK_FRAC = 0.05
 DECLINE_LIMIT = 3
 COOLDOWN = 16
 MAX_DEV_PAIRS = 6
+# escalation width when the 6-pair tier declines a batch (encode-side
+# analog of the decode rescue): Batcher-16 sort network, 16-pair
+# segment table; parts beyond the decode's P=24 axis still fall back
+WIDE_DEV_PAIRS = 16
 
 _PARTS = {
     "open": b"{",
@@ -104,9 +109,10 @@ def _bank(suffix: bytes, extras=()):
 
 
 @partial(jax.jit, static_argnames=("suffix", "impl", "assemble",
-                                   "extras"))
+                                   "extras", "max_pairs"))
 def _encode_kernel(batch, lens, dec, ts_text, ts_len, *, suffix: bytes,
-                   impl: str, assemble: bool = True, extras=()):
+                   impl: str, assemble: bool = True, extras=(),
+                   max_pairs: int = MAX_DEV_PAIRS):
     N, L = batch.shape
     bank, off, parts = _bank(suffix, extras)
     OW = _out_width(L, L + E_CAP + len(bank) + TS_W)
@@ -156,7 +162,7 @@ def _encode_kernel(batch, lens, dec, ts_text, ts_len, *, suffix: bytes,
     def sel(chan_key, plus=0):
         outs = []
         ch = dec[chan_key].astype(_I32)
-        for p in range(MAX_DEV_PAIRS):
+        for p in range(max_pairs):
             acc = jnp.zeros((N,), dtype=_I32)
             for j in range(P):
                 acc = jnp.where(is_pair_cols[j]
@@ -177,7 +183,7 @@ def _encode_kernel(batch, lens, dec, ts_text, ts_len, *, suffix: bytes,
             "ne": [dmap(x) for x in ne_r],
             "vs": [dmap(x) for x in vs_r],
             "ve": [dmap(x) for x in ve_r]}
-    ambig = sort_pairs_by_key8(bb, iota, cols, MAX_DEV_PAIRS)
+    ambig = sort_pairs_by_key8(bb, iota, cols, max_pairs)
 
     # ---- fixed-field spans ----------------------------------------------
     host_s = dmap(dec["host_start"])
@@ -195,7 +201,7 @@ def _encode_kernel(batch, lens, dec, ts_text, ts_len, *, suffix: bytes,
     zero = jnp.zeros((N,), dtype=_I32)
     segs = [(zero + (cbase + off["open"]),
              zero + len(parts["open"]))]
-    for p in range(MAX_DEV_PAIRS):
+    for p in range(max_pairs):
         pv = p < pair_count
         segs.append((zero + (cbase + off["p0"]),
                      jnp.where(pv, 2, 0)))
@@ -241,15 +247,30 @@ def _encode_kernel(batch, lens, dec, ts_text, ts_len, *, suffix: bytes,
     for _, ln in segs[1:]:
         out_len = out_len + ln
 
+    # timestamps: rfc3339 rides the computed-channel path; unix-literal
+    # floats ride the split-integer parse when unsigned and within f64's
+    # exact-integer range (<= 16 digits, value < 2**53 — the host
+    # combine is then the correctly rounded strtod value); anything
+    # else (signed, 17+ digits) falls back to the host tier
+    kind = dec["ts_kind"].astype(_I32)
+    meta = dec["ts_meta"].astype(_I32)
+    ts_hi = dec["ts_hi"].astype(_I32)
+    ts_lo = dec["ts_lo"].astype(_I32)
+    ndig = (meta >> 8) & 255
+    signed = ((meta >> 16) & 1) == 1
+    f16_ok = (ts_hi < 9007199) | ((ts_hi == 9007199)
+                                  & (ts_lo <= 254740992))
+    float_dev = ((kind == 1) & ~signed
+                 & ((ndig <= 15) | ((ndig == 16) & f16_ok)))
     tier = (dec["ok"].astype(bool)
             & ~dec["has_high"].astype(bool)
             & ~jnp.any(es["bad_ctl"], axis=1)
             & (es["ne_total"] <= E_CAP)
-            & (dec["ts_kind"].astype(_I32) == 0)
+            & ((kind == 0) | float_dev)
             & (dec["host_pos"].astype(_I32) >= 0)
             & ~colonless
             & ~rep_special
-            & (pair_count <= MAX_DEV_PAIRS)
+            & (pair_count <= max_pairs)
             & ~ambig
             & (out_len <= OW))
     if not assemble:
@@ -291,11 +312,42 @@ def fetch_encode(handle, packed, encoder, merger, route_state=None,
                               ts_len, suffix=suffix, impl=impl,
                               assemble=assemble, extras=extras)
 
+    def wide():
+        """16-pair escalation kernel (lazy: compiled only when a batch
+        declines at the 6-pair width)."""
+        def kernel_w(ts_text, ts_len, assemble):
+            return _encode_kernel(batch_dev, lens_dev, dict(out), ts_text,
+                                  ts_len, suffix=suffix, impl=impl,
+                                  assemble=assemble, extras=extras,
+                                  max_pairs=WIDE_DEV_PAIRS)
+        return out, kernel_w
+
     def scalar_fn(line):
         return _scalar_ltsv(decoder, line)
+
+    def ts_vals_fn(small, okh):
+        """rfc3339 rows combine days/sod/off/nanos; float-span rows
+        combine the kernel's exact split-integer parse (vectorized —
+        no per-row Python)."""
+        import numpy as np
+
+        from .materialize import compute_ts
+
+        kind = small["ts_kind"]
+        rfc = okh & (kind == 0)
+        masked = {k: np.where(rfc, small[k], 0)
+                  for k in ("days", "sod", "off", "nanos")}
+        vals = compute_ts(masked)
+        fv = ((small["ts_hi"].astype(np.float64) * 1e9
+               + small["ts_lo"].astype(np.float64))
+              / np.power(10.0, (small["ts_meta"] & 255).astype(np.int64)))
+        return np.where(okh & (kind == 1), fv, vals)
 
     return fetch_encode_driver(
         kernel, out, batch_dev, lens_dev, packed, encoder, merger,
         route_state, suffix, syslen, scalar_fn=scalar_fn,
         fallback_frac=FALLBACK_FRAC, decline_limit=DECLINE_LIMIT,
-        cooldown=COOLDOWN)
+        cooldown=COOLDOWN,
+        ts_keys=("days", "sod", "off", "nanos", "ts_kind",
+                 "ts_hi", "ts_lo", "ts_meta"),
+        ts_vals_fn=ts_vals_fn, wide=wide)

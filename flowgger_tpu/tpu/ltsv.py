@@ -210,6 +210,29 @@ def decode_ltsv(batch: jnp.ndarray, lens: jnp.ndarray,
         & (tlen - body_from >= 1)
     )
 
+    # exact split-integer parse of the float span for the device-encode
+    # tier: value == (ts_hi * 1e9 + ts_lo) / 10**frac.  The tier bounds
+    # total digits (<= 16 within 2**53) so the f64 combine on the host
+    # is the correctly rounded strtod value — byte-identical to the
+    # scalar path's float(span) + json_f64.  ts_meta packs
+    # frac_digits | n_digits<<8 | has_sign<<16, all elementwise.
+    has_dot = n_dots == 1
+    nd_digits = tlen - body_from - has_dot.astype(_I32)
+    frac_digits = jnp.where(has_dot, tlen - 1 - dot_pos, 0)
+    di = r - body_from[:, None] - (r > dot_pos[:, None]).astype(_I32)
+    place = nd_digits[:, None] - 1 - di
+    dig_m = (in_t & is_digit & (r >= body_from[:, None])
+             & (r != dot_pos[:, None]))
+    lo_w = jnp.where(dig_m & (place >= 0) & (place <= 8),
+                     10 ** jnp.clip(place, 0, 8), 0)
+    hi_w = jnp.where(dig_m & (place >= 9) & (place <= 17),
+                     10 ** jnp.clip(place - 9, 0, 8), 0)
+    ts_lo = jnp.sum(dig * lo_w, axis=1)
+    ts_hi = jnp.sum(dig * hi_w, axis=1)
+    ts_meta = (jnp.clip(frac_digits, 0, 255)
+               | (jnp.clip(nd_digits, 0, 255) << 8)
+               | (has_sign.astype(_I32) << 16))
+
     # rfc3339 form: reuse the rfc5424 timestamp machinery inline.
     # Digit sums ride packed 8/14-bit fields: month|day|hour|minute in one
     # word, year|sec in a second (fold: was 6 reductions); per-field sums
@@ -300,6 +323,7 @@ def decode_ltsv(batch: jnp.ndarray, lens: jnp.ndarray,
         "ts_kind": ts_kind,
         "ts_start": ts_s, "ts_end": ts_e,
         "days": days, "sod": sod, "off": off_secs, "nanos": nanos,
+        "ts_hi": ts_hi, "ts_lo": ts_lo, "ts_meta": ts_meta,
     }
 
 

@@ -400,3 +400,41 @@ def test_gelf_extra_dynamic_keys_take_record_path():
                       _extra_enc('region = "eu"\n'), Config.from_string(""),
                       fmt="rfc5424", start_timer=False, merger=LineMerger())
     assert h2._block_route_ok()
+
+
+def test_device_gelf_wide_pair_escalation():
+    """Round-5: a 7..16-pair SD stream declines the 6-pair tier but
+    rides the 16-pair wide kernel (re-decode at the rescue width +
+    Batcher-16 sorter) — byte-identical and fully on-device; 20-pair
+    rows still splice through the host (rfc5424_decoder.rs:127-161
+    multi-pair SD is normal traffic)."""
+    pairs8 = [
+        (f'<13>1 2023-09-20T12:35:45.{i:03d}Z h8 app {i} m [sd@1 '
+         + " ".join(f'k{j}="{j}v"' for j in range(8)) + f'] multi {i}'
+         ).encode()
+        for i in range(24)
+    ]
+    n0 = metrics.get("device_encode_rows")
+    w0 = metrics.get("device_encode_wide_batches")
+    res, _ = run_device(pairs8, LineMerger())
+    assert res is not None
+    assert metrics.get("device_encode_wide_batches") - w0 == 1
+    assert metrics.get("device_encode_rows") - n0 == len(pairs8)
+    assert res.block.data == b"".join(scalar_frames(pairs8, LineMerger()))
+
+    # mixed 8/20-pair batch on the wide kernel: 20-pair rows fall back
+    pairs20 = [
+        (f'<13>1 2023-09-20T12:35:45Z h20 app {i} m [sd@1 '
+         + " ".join(f'k{j}="{j}"' for j in range(20)) + '] deep'
+         ).encode()
+        for i in range(3)
+    ]
+    mixed = pairs8 + pairs20
+    old = device_gelf.FALLBACK_FRAC
+    device_gelf.FALLBACK_FRAC = 0.5
+    try:
+        res2, _ = run_device(mixed, LineMerger())
+    finally:
+        device_gelf.FALLBACK_FRAC = old
+    assert res2 is not None
+    assert res2.block.data == b"".join(scalar_frames(mixed, LineMerger()))

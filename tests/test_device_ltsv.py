@@ -196,3 +196,57 @@ def test_ltsv_gelf_extra_static_slots_host_tier():
     )
 
     assert gelf_extra_consts_ltsv(bad.extra) is None
+
+
+def test_device_ltsv_unix_literal_stamps_ride_device_tier():
+    """Round-5: unsigned unix-literal stamps within f64's exact-integer
+    range decode + encode fully on-device (the split-integer parse);
+    signed / 17-digit / non-float stamps still splice through the host
+    (ltsv_decoder.rs:224-267 lists unix literals as LTSV's primary
+    stamp form)."""
+    on_tier = [
+        b"time:1438790025.42\thost:h\tmessage:float stamp",
+        b"time:1511963055\thost:h2\tuser:bob\tmessage:int stamp",
+        b"time:1511963055.637824\thost:h3\tmessage:micros",   # 16 digits
+        b"time:0.5\thost:h4\tmessage:small",
+        b"time:9007199254740992\thost:h5\tmessage:2^53 exactly",
+    ]
+    off_tier = [
+        b"time:+1438790025.42\thost:h\tmessage:signed",
+        b"time:14389790025.637824\thost:h\tmessage:17 digits",
+        b"time:9007199254740993\thost:h\tmessage:2^53+1",
+    ]
+    n0 = metrics.get("device_encode_rows")
+    res, _ = run_device(on_tier * 3, LineMerger())
+    assert res is not None
+    assert metrics.get("device_encode_rows") - n0 == len(on_tier) * 3
+    assert res.block.data == b"".join(scalar_frames(on_tier * 3,
+                                                    LineMerger()))
+
+    # mixed batch: off-tier rows splice via host, output still identical
+    mixed = on_tier + off_tier
+    import flowgger_tpu.tpu.device_ltsv as dl
+    old = dl.FALLBACK_FRAC
+    dl.FALLBACK_FRAC = 1.1
+    try:
+        res2, _ = run_device(mixed, LineMerger())
+    finally:
+        dl.FALLBACK_FRAC = old
+    assert res2 is not None
+    assert res2.block.data == b"".join(scalar_frames(mixed, LineMerger()))
+
+
+def test_device_ltsv_wide_pair_escalation():
+    """Round-5: 7..16-pair LTSV rows ride the 16-pair wide kernel."""
+    pairs10 = [
+        ("time:2023-09-20T12:35:45Z\thost:hw\tmessage:wide\t"
+         + "\t".join(f"k{j:02d}:{j}v{i}" for j in range(10))).encode()
+        for i in range(24)
+    ]
+    n0 = metrics.get("device_encode_rows")
+    w0 = metrics.get("device_encode_wide_batches")
+    res, _ = run_device(pairs10, LineMerger())
+    assert res is not None
+    assert metrics.get("device_encode_wide_batches") - w0 == 1
+    assert metrics.get("device_encode_rows") - n0 == len(pairs10)
+    assert res.block.data == b"".join(scalar_frames(pairs10, LineMerger()))
