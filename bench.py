@@ -367,6 +367,57 @@ def bench_fallback_corpora(jax, jnp, extra, small: bool):
     extra["fallback_corpora"] = results
 
 
+def bench_host_scaling(lines, extra, smoke):
+    """Host-stage thread scaling (VERDICT r4 #8): native pack and the
+    segment-gather assembler at n_threads = 1,2,4,8 (bounded by the
+    host's cores x2 so oversubscription is visible), keyed by nproc —
+    the first multi-core session produces the >=5M lines/s host-stages
+    evidence automatically instead of re-deferring."""
+    import os as _os
+
+    from flowgger_tpu import native
+    from flowgger_tpu.tpu import pack
+
+    ncpu = _os.cpu_count() or 1
+    region = b"".join(ln + b"\n" for ln in lines)
+    n_lines = len(lines)
+    rng = np.random.default_rng(3)
+    seg_len = rng.integers(16, 120, 3 * n_lines).astype(np.int64)
+    seg_src = rng.integers(0, max(1, len(region) - 130),
+                           3 * n_lines).astype(np.int64)
+    dst = np.concatenate([[0], np.cumsum(seg_len)])
+    total = int(dst[-1])
+    src_arr = np.frombuffer(region, dtype=np.uint8)
+
+    table = {}
+    old = native._DEFAULT_THREADS
+    try:
+        for nt in (1, 2, 4, 8):
+            if nt > 2 * ncpu:
+                break
+            native._DEFAULT_THREADS = nt
+            trials = 1 if smoke else 3
+            best_p = best_c = None
+            for _ in range(trials):
+                t0 = time.perf_counter()
+                pack.pack_region_2d(region, MAX_LEN)
+                dt = time.perf_counter() - t0
+                best_p = dt if best_p is None else min(best_p, dt)
+                t0 = time.perf_counter()
+                out = native.concat_segments_native(
+                    src_arr, seg_src, seg_len, dst[:-1], total)
+                dt = time.perf_counter() - t0
+                best_c = dt if best_c is None else min(best_c, dt)
+            row = {"pack_mlps": round(n_lines / best_p / 1e6, 2)}
+            if out is not None:
+                row["concat_gbps"] = round(total / best_c / 1e9, 2)
+            table[str(nt)] = row
+    finally:
+        native._DEFAULT_THREADS = old
+    extra["host_scaling"] = {"nproc": ncpu, "by_threads": table}
+    print(f"host scaling (nproc={ncpu}): {table}", file=sys.stderr)
+
+
 def bench_other_configs(jax, jnp, dev, cpu_fallback, smoke, extra):
     """BASELINE.json configs beyond #1: LTSV (#2), GELF (#3), multi-SD
     extraction (#4), auto-detect dispatch (#5) — sustained device decode
@@ -569,6 +620,7 @@ def main():
         lat_ms["p99_unavailable_sample_max"] = round(p99 * 1e3, 1)
     extra = {"batch_latency_ms": lat_ms}
     bench_fallback_corpora(jax, jnp, extra, smoke or cpu_fallback)
+    bench_host_scaling(lines[:65_536], extra, smoke or cpu_fallback)
     bench_e2e(lines[:E2E_BATCH], jax, jnp, extra)
     bench_other_configs(jax, jnp, dev, cpu_fallback, smoke, extra)
 

@@ -373,10 +373,15 @@ class BatchHandler(Handler):
             return False
         from ..encoders.capnp import CapnpEncoder
 
-        if self.fmt == "rfc5424" and type(self.encoder) is CapnpEncoder:
+        if (type(self.encoder) is CapnpEncoder
+                and self.fmt in ("rfc5424", "rfc3164", "ltsv")):
             # columnar capnp (the reference's default kafka output wire
-            # format, mod.rs:104); capnp_extra is a constant blob on
-            # this route, so extras stay on the fast tier here
+            # format, mod.rs:104) from every kernel decoder; capnp_extra
+            # is a constant blob on this route, so extras stay on the
+            # fast tier here.  A typed ltsv_schema keeps the Record
+            # path (per-value typing is per-row host work).
+            if self.fmt == "ltsv":
+                return not getattr(self.scalar.decoder, "schema", None)
             return True
         if self.fmt == "rfc3164":
             from ..encoders.rfc3164 import RFC3164Encoder
@@ -394,8 +399,8 @@ class BatchHandler(Handler):
                     self.encoder.extra) is not None
             return self._passthrough_ok
         if self.fmt == "ltsv":
-            # LTSV decode block-encodes GELF only; typed-schema support
-            # (and its per-row fallbacks) live in the encoder itself
+            # LTSV decode block-encodes GELF and capnp; typed-schema
+            # support (and its per-row fallbacks) lives in the encoders
             if type(self.encoder) is not GelfEncoder:
                 return False
             from .encode_ltsv_gelf_block import gelf_extra_consts_ltsv
@@ -437,6 +442,13 @@ class BatchHandler(Handler):
         t = type(enc)
         no_columnar = (f"output.format {t.__name__} has no columnar "
                        f"encoder for input format '{self.fmt}'")
+        from ..encoders.capnp import CapnpEncoder
+
+        if t is CapnpEncoder:
+            if self.fmt == "ltsv":
+                # the only capnp blocker on the ltsv route
+                return "input.ltsv_schema is set"
+            return no_columnar
         if t is GelfEncoder:
             # GELF output is columnar for every kernel format, so the
             # only possible blockers are the extras / the auto schema
@@ -656,11 +668,16 @@ def block_fetch_encode(fmt, handle, packed, encoder, merger,
             t0 = _time.perf_counter()
         host_out = rfc3164.decode_rfc3164_fetch(handle)
         t1 = _time.perf_counter()
+        from ..encoders.capnp import CapnpEncoder
+        from . import encode_capnp_block
+
         fn3164 = {
             PassthroughEncoder:
                 encode_passthrough_block.encode_rfc3164_passthrough_block,
             RFC3164Encoder:
                 encode_rfc3164_3164_block.encode_rfc3164_3164_block,
+            CapnpEncoder:
+                encode_capnp_block.encode_rfc3164_capnp_block,
         }.get(type(encoder),
               encode_rfc3164_gelf_block.encode_rfc3164_gelf_block)
         res = fn3164(
@@ -681,12 +698,30 @@ def block_fetch_encode(fmt, handle, packed, encoder, merger,
             t0 = _time.perf_counter()
         host_out = ltsv.decode_ltsv_fetch(handle)
         t1 = _time.perf_counter()
-        res = encode_ltsv_gelf_block.encode_ltsv_gelf_block(
-            packed[2], packed[3], packed[4], host_out, packed[5],
-            packed[0].shape[1], encoder, merger, ltsv_decoder)
-    elif fmt == "gelf":
-        from . import encode_gelf_gelf_block, gelf
+        from ..encoders.capnp import CapnpEncoder
 
+        if type(encoder) is CapnpEncoder:
+            from . import encode_capnp_block
+
+            res = encode_capnp_block.encode_ltsv_capnp_block(
+                packed[2], packed[3], packed[4], host_out, packed[5],
+                packed[0].shape[1], encoder, merger, ltsv_decoder)
+        else:
+            res = encode_ltsv_gelf_block.encode_ltsv_gelf_block(
+                packed[2], packed[3], packed[4], host_out, packed[5],
+                packed[0].shape[1], encoder, merger, ltsv_decoder)
+    elif fmt == "gelf":
+        from . import device_gelf_gelf, encode_gelf_gelf_block, gelf
+
+        if device_gelf_gelf.route_ok(encoder, merger):
+            res, fetch_s = device_gelf_gelf.fetch_encode(
+                handle, packed, encoder, merger, route_state)
+            if res is not None:
+                return res, fetch_s, 0.0
+            declined_s = _time.perf_counter() - t0
+            _metrics.add_seconds("device_encode_declined_seconds",
+                                 declined_s)
+            t0 = _time.perf_counter()
         host_out = gelf.decode_gelf_fetch(handle)
         t1 = _time.perf_counter()
         res = encode_gelf_gelf_block.encode_gelf_gelf_block(

@@ -293,7 +293,7 @@ def _sort_network(n: int):
     return tuple(pairs)
 
 
-def sort_pairs_by_key8(bb, iota, cols, max_pairs: int):
+def sort_pairs_by_key8(bb, iota, cols, max_pairs: int, slot_valid=None):
     """Sort per-pair span columns by their names' first 8 bytes
     (serde_json BTreeMap order) with a 12-comparator network, and flag
     rows whose order the 8-byte prefix cannot decide.
@@ -304,7 +304,13 @@ def sort_pairs_by_key8(bb, iota, cols, max_pairs: int):
     returns the ambig mask: equal 8-byte prefixes are orderable only
     when exactly one name is ≤8 bytes (a strict prefix of the other) —
     equal-length or both-longer pairs (including duplicates, dict
-    last-wins semantics) fall back to the host tiers."""
+    last-wins semantics) fall back to the host tiers.
+
+    Slots are normally pre-compacted (valid pairs first, ``_pair_count``
+    gating); ``slot_valid`` (per-slot [N] bool list) instead marks valid
+    slots in place — invalid ones key to _BIG and the sort itself
+    compacts them to the tail, saving callers the O(F^2) where-chain
+    compaction (device_gelf_gelf feeds raw field order this way)."""
     import jax.numpy as jnp
 
     N = bb.shape[0]
@@ -313,7 +319,7 @@ def sort_pairs_by_key8(bb, iota, cols, max_pairs: int):
     for p in range(max_pairs):
         ns_r = cols["ns_raw"][p]
         ne_r = cols["ne_raw"][p]
-        pv = p < pair_count
+        pv = (p < pair_count) if slot_valid is None else slot_valid[p]
         r = iota - ns_r[:, None]
         in_name = (r >= 0) & (iota < ne_r[:, None])
         z = jnp.where(in_name, bb, 0)
@@ -414,7 +420,21 @@ def fetch_encode_driver(kernel, out, batch_dev, lens_dev, packed, encoder,
 
     empty_ts = jnp.zeros((N, 0), dtype=jnp.uint8)
     full_ts_len = jnp.full((N,), TS_W, dtype=jnp.int32)
-    tier1 = kernel(empty_ts, full_ts_len, False)
+
+    def probe(k):
+        """Phase-1 tier probe.  A kernel may return a dict — ``tier``
+        plus extra device channels (e.g. gelf→GELF's timestamp parse,
+        which only exists encode-side); the extras merge into ``out``
+        so the ts fetch below sees them like decode outputs."""
+        t1 = k(empty_ts, full_ts_len, False)
+        if isinstance(t1, dict):
+            extra = {k2: v for k2, v in t1.items() if k2 != "tier"}
+            return t1["tier"], extra
+        return t1, None
+
+    tier1, extra1 = probe(kernel)
+    if extra1:
+        out = {**out, **extra1}
     tier1_np = _fetch(tier1)[:n]
 
     starts64 = np.asarray(starts[:n], dtype=np.int64)
@@ -438,11 +458,13 @@ def fetch_encode_driver(kernel, out, batch_dev, lens_dev, packed, encoder,
             route_state["wide_cooldown"] = wide_cd - 1
         else:
             out_w, kernel_w = wide()
-            tier1w = kernel_w(empty_ts, full_ts_len, False)
+            tier1w, extraw = probe(kernel_w)
             cand1w = _fetch(tier1w)[:n] & (lens64 <= max_len)
             if (1.0 - cand1w.mean()) <= fallback_frac:
                 _metrics.inc("device_encode_wide_batches")
                 kernel, out, cand1 = kernel_w, out_w, cand1w
+                if extraw:
+                    out = {**out, **extraw}
             elif route_state is not None:
                 route_state["wide_cooldown"] = cooldown
 

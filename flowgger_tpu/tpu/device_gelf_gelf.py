@@ -1,0 +1,482 @@
+"""Device-side GELF→GELF re-canonicalization: framed canonical GELF
+bytes assembled on-device from the JSON tokenizer's span channels
+(device_common machinery — same contract as the other device tiers).
+
+Layout mirrors the host tier (encode_gelf_gelf_block.py) byte-for-byte::
+
+    {"_<key>":V..., ["full_message":"F",] "host":H|unknown,
+     ["level":D,] "short_message":"S"|"-", "timestamp":T,
+     "version":"1.1"}
+
+Unlike the other formats this tier is **escape-free**: string spans
+re-emit verbatim, so rows with escape flags, control bytes, or
+non-ASCII fall back (serde escaping of clean text is identity) and the
+assembly source is the raw row — no escape stage at all.
+
+Special keys route by *elementwise quoted-name pattern matches* over
+packed 4-byte words (``"timestamp"`` including both quotes — the
+closing quote pins the key length, so prefix collisions are
+impossible), extracted per field as 3-bit ids in packed point-sum
+words.  Pair keys sort by their final name (leading ``_`` stripped —
+the emitted name always carries exactly one) through the shared
+Batcher sorter with the span payload riding the swaps.
+
+The timestamp re-formats like the host tier (json_f64 of the parsed
+span): the kernel carries an exact split-integer parse (ts_hi/ts_lo ×
+1e9 + frac scale, correctly rounded within 2**53 — same scheme as the
+ltsv device tier) back through the phase-1 probe dict, and the driver
+uploads the formatted text.
+
+Off-tier (host span tier / scalar oracle, bytes identical either way):
+escaped keys/values, non-canonical numbers, floats as pair values,
+17+-digit timestamps, duplicate final names or ambiguous 8-byte sort
+prefixes, repeated specials, >F fields (the wide hook re-decodes at 16
+fields first; 17+ keeps the host rescue path), gelf_extra configured
+(dynamic keys cannot place statically — route-gated).
+
+Reference parity: gelf_decoder.rs:34-125 (decode semantics),
+gelf_encoder.rs:51-116 (sorted-key canonical emit).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from .device_common import (
+    TS_W,
+    _out_width,
+    assemble_rows,
+    build_bank,
+    fetch_encode_driver,
+    sort_pairs_by_key8,
+)
+from .gelf import VT_FALSE, VT_NULL, VT_NUMBER, VT_STRING, VT_TRUE
+from .rfc5424 import _shift_left
+
+_I32 = jnp.int32
+
+FALLBACK_FRAC = 0.05
+DECLINE_LIMIT = 3
+COOLDOWN = 16
+
+_TSW = 24   # host-tier bound: longer timestamp spans take the oracle
+_SPECIALS = (b"timestamp", b"host", b"short_message", b"full_message",
+             b"version", b"level")
+_SP_TS, _SP_HOST, _SP_SHORT, _SP_FULL, _SP_VER, _SP_LVL = range(1, 7)
+
+_PARTS = {
+    "open": b"{",
+    "kpre": b'"_',
+    "q": b'"',
+    "colon": b'":',
+    "qc": b'",',
+    "true": b"true",
+    "false": b"false",
+    "null": b"null",
+    "full": b'"full_message":"',
+    "host": b'"host":"',
+    "lvl": b'"level":',
+    "short": b'"short_message":"',
+    "ts": b'"timestamp":',
+    "unknown": b"unknown",
+    "dash": b"-",
+    "comma": b",",
+    "tail": b'"version":"1.1"}',
+}
+
+
+@partial(jax.jit, static_argnames=("suffix", "assemble"))
+def _encode_kernel(batch, lens, dec, ts_text, ts_len, *, suffix: bytes,
+                   assemble: bool = True):
+    N, L = batch.shape
+    bank, off = build_bank(dict(_PARTS), suffix)
+    F = dec["key_start"].shape[1]
+    OW = _out_width(L, L + len(bank) + TS_W)
+    iota = jax.lax.broadcasted_iota(_I32, (N, L), 1)
+    bb = jnp.where(iota < lens.astype(_I32)[:, None], batch,
+                   jnp.uint8(0)).astype(_I32)
+    lens32 = lens.astype(_I32)
+    valid = iota < lens32[:, None]
+
+    ok = dec["ok"].astype(bool)
+    nf = jnp.minimum(dec["n_fields"].astype(_I32), F)
+    key_s = dec["key_start"].astype(_I32)
+    key_e = dec["key_end"].astype(_I32)
+    val_s = dec["val_start"].astype(_I32)
+    val_e = dec["val_end"].astype(_I32)
+    val_t = dec["val_type"].astype(_I32)
+    key_esc = dec["key_esc"].astype(bool)
+    val_esc = dec["val_esc"].astype(bool)
+    frange = jnp.arange(F, dtype=_I32)
+    jm = (frange[None, :] < nf[:, None]) & ok[:, None]
+
+    # escape-free tier: any control byte or non-ASCII in the row → host
+    # (encode_gelf_gelf_block.py's bad_cum screen, exactly)
+    viol_row = jnp.any(((bb >= 128) | (bb < 32)) & valid, axis=1)
+
+    # ---- quoted-name pattern planes -------------------------------------
+    # w4 planes carry bytes p..p+3 big-endian; tier rows are pure ASCII
+    # 0x20..0x7f so the packed words are always positive
+    w2 = (bb << 8) | _shift_left(bb, 1, 0)
+    w4 = (w2 << 16) | _shift_left(w2, 2, 0)
+    planes = (w4, _shift_left(w4, 4, 0), _shift_left(w4, 8, 0),
+              _shift_left(w4, 12, 0))
+
+    def pat(name: bytes):
+        s = b'"' + name + b'"'
+        m = None
+        for blk in range(0, len(s), 4):
+            piece = s[blk:blk + 4]
+            pl = planes[blk // 4]
+            if len(piece) == 4:
+                c = pl == int.from_bytes(piece, "big")
+            else:
+                sh = (4 - len(piece)) * 8
+                c = (pl >> sh) == int.from_bytes(piece, "big")
+            m = c if m is None else (m & c)
+        return m
+
+    sp = jnp.zeros((N, L), dtype=_I32)
+    for sid, name in enumerate(_SPECIALS, start=1):
+        sp = jnp.where(pat(name), sid, sp)
+
+    # spid per field: the plane's value at the key's open quote, packed
+    # 3-bit × 10 fields per point-sum word
+    kopen = key_s - 1
+    spid = []
+    per = 10
+    for base in range(0, F, per):
+        acc = jnp.zeros((N, L), dtype=_I32)
+        for s_ in range(min(per, F - base)):
+            acc = acc + jnp.where(iota == kopen[:, base + s_][:, None],
+                                  sp << (3 * s_), 0)
+        word = jnp.sum(acc, axis=1)
+        for s_ in range(min(per, F - base)):
+            spid.append((word >> (3 * s_)) & 7)
+
+    # ---- per-field point bytes + span counts ----------------------------
+    # five bytes per field (key first byte; value bytes 0,1,2,last) and
+    # three counts per field (dots / non-digits / frac chars in the
+    # value span), all packed 3 per i32 word
+    is_dot = bb == ord(".")
+    is_nondig = ((bb < ord("0")) | (bb > ord("9"))) & valid
+    is_fracc = is_dot | (bb == ord("e")) | (bb == ord("E"))
+
+    def point_bytes(pos_cols):
+        """bytes at per-field positions: list of [N] byte values."""
+        outs = []
+        for base in range(0, len(pos_cols), 3):
+            grp = pos_cols[base:base + 3]
+            acc = jnp.zeros((N, L), dtype=_I32)
+            for s_, pos in enumerate(grp):
+                acc = acc + jnp.where(iota == pos[:, None], bb << (8 * s_),
+                                      0)
+            word = jnp.sum(acc, axis=1)
+            for s_ in range(len(grp)):
+                outs.append((word >> (8 * s_)) & 255)
+        return outs
+
+    def span_counts(mask, a_cols, b_cols):
+        """count of mask inside [a, b) per field, packed 3/word."""
+        outs = []
+        for base in range(0, len(a_cols), 3):
+            acc = jnp.zeros((N, L), dtype=_I32)
+            for s_ in range(min(3, len(a_cols) - base)):
+                a = a_cols[base + s_]
+                b = b_cols[base + s_]
+                inside = mask & (iota >= a[:, None]) & (iota < b[:, None])
+                acc = acc + (inside.astype(_I32) << (10 * s_))
+            word = jnp.sum(acc, axis=1)
+            for s_ in range(min(3, len(a_cols) - base)):
+                outs.append((word >> (10 * s_)) & 1023)
+        return outs
+
+    va = [val_s[:, f] for f in range(F)]
+    vb = [val_e[:, f] for f in range(F)]
+    kfirst = point_bytes([key_s[:, f] for f in range(F)])
+    v0 = point_bytes(va)
+    v1 = point_bytes([x + 1 for x in va])
+    v2 = point_bytes([x + 2 for x in va])
+    vlast = point_bytes([x - 1 for x in vb])
+    dots = span_counts(is_dot, va, vb)
+    nondig = span_counts(is_nondig, va, vb)
+    fracc = span_counts(is_fracc, va, vb)
+
+    def canonical_number(f):
+        r"""JSON grammar ``-?(0|[1-9][0-9]*)(\.[0-9]+)?`` (the host
+        tier's canonical_number, field-wise)."""
+        ln = vb[f] - va[f]
+        neg = (v0[f] == ord("-")).astype(_I32)
+        dfirst = jnp.where(neg == 1, v1[f], v0[f])
+        dsecond = jnp.where(neg == 1, v2[f], v1[f])
+        okn = (ln > neg) & (nondig[f] == neg + dots[f])
+        okn &= (dots[f] <= 1) & (dfirst != ord(".")) \
+            & (vlast[f] != ord("."))
+        okn &= ((dfirst != ord("0")) | (ln - neg == 1)
+                | (dsecond == ord(".")))
+        okn &= ~((neg == 1) & (dfirst == ord("0")) & (dots[f] == 0))
+        return okn
+
+    # ---- specials: presence, uniqueness, per-special field selects ------
+    def sel_field(sid, chans):
+        """per-row values of ``chans`` at the (unique) field whose spid
+        is ``sid``; also returns presence."""
+        pres = jnp.zeros((N,), dtype=bool)
+        outs = [jnp.zeros((N,), dtype=_I32) for _ in chans]
+        for f in range(F):
+            hit = jm[:, f] & (spid[f] == sid)
+            pres |= hit
+            for c, ch in enumerate(chans):
+                cv = ch[f] if isinstance(ch, list) else ch[:, f]
+                outs[c] = jnp.where(hit, cv.astype(_I32), outs[c])
+        return pres, outs
+
+    rep_special = jnp.zeros((N,), dtype=bool)
+    for sid in range(1, 7):
+        cnt = jnp.zeros((N,), dtype=_I32)
+        for f in range(F):
+            cnt = cnt + (jm[:, f] & (spid[f] == sid)).astype(_I32)
+        rep_special |= cnt > 1
+
+    has_ts, (tsa, tsb, ts_vt) = sel_field(_SP_TS, [va, vb, val_t])
+    _, (ts_dots, ts_nondig, ts_v0, ts_v1, ts_v2, ts_vlast) = sel_field(
+        _SP_TS, [dots, nondig, v0, v1, v2, vlast])
+    has_host, (host_a, host_b, host_vt) = sel_field(
+        _SP_HOST, [va, vb, val_t])
+    _, (host_esc,) = sel_field(_SP_HOST, [val_esc])
+    has_short, (short_a, short_b, short_vt) = sel_field(
+        _SP_SHORT, [va, vb, val_t])
+    _, (short_esc,) = sel_field(_SP_SHORT, [val_esc])
+    has_full, (full_a, full_b, full_vt) = sel_field(
+        _SP_FULL, [va, vb, val_t])
+    _, (full_esc,) = sel_field(_SP_FULL, [val_esc])
+    has_ver, (ver_vt, ver_ln0, ver_v0, ver_v1, ver_v2) = sel_field(
+        _SP_VER, [val_t, [vb[f] - va[f] for f in range(F)], v0, v1, v2])
+    _, (ver_esc,) = sel_field(_SP_VER, [val_esc])
+    has_lvl, (lvl_a, lvl_b, lvl_vt, lvl_v0) = sel_field(
+        _SP_LVL, [va, vb, val_t, v0])
+
+    # ---- timestamp validation + exact split-integer parse ---------------
+    ts_ln = tsb - tsa
+    ts_neg = (ts_v0 == ord("-")).astype(_I32)
+    ts_dfirst = jnp.where(ts_neg == 1, ts_v1, ts_v0)
+    ts_dsecond = jnp.where(ts_neg == 1, ts_v2, ts_v1)
+    ts_canon = (ts_ln > ts_neg) & (ts_nondig == ts_neg + ts_dots)
+    ts_canon &= (ts_dots <= 1) & (ts_dfirst != ord(".")) \
+        & (ts_vlast != ord("."))
+    ts_canon &= ((ts_dfirst != ord("0")) | (ts_ln - ts_neg == 1)
+                 | (ts_dsecond == ord(".")))
+    ts_canon &= ~((ts_neg == 1) & (ts_dfirst == ord("0"))
+                  & (ts_dots == 0))
+    ts_ok = has_ts & (ts_vt == VT_NUMBER) & ts_canon & (ts_ln <= _TSW)
+
+    r = iota - tsa[:, None]
+    in_ts = (r >= 0) & (r < ts_ln[:, None])
+    dot_r = jnp.min(jnp.where(in_ts & is_dot, r, 1 << 20), axis=1)
+    has_dot = ts_dots == 1
+    nd_digits = ts_ln - ts_neg - has_dot.astype(_I32)
+    frac_digits = jnp.where(has_dot, ts_ln - 1 - dot_r, 0)
+    di = r - ts_neg[:, None] - (r > dot_r[:, None]).astype(_I32)
+    place = nd_digits[:, None] - 1 - di
+    dig = bb - 48
+    dig_m = (in_ts & ~is_nondig & (r >= ts_neg[:, None])
+             & (r != dot_r[:, None]))
+    lo_w = jnp.where(dig_m & (place >= 0) & (place <= 8),
+                     10 ** jnp.clip(place, 0, 8), 0)
+    hi_w = jnp.where(dig_m & (place >= 9) & (place <= 17),
+                     10 ** jnp.clip(place - 9, 0, 8), 0)
+    ts_lo = jnp.sum(dig * lo_w, axis=1)
+    ts_hi = jnp.sum(dig * hi_w, axis=1)
+    ts_meta = (jnp.clip(frac_digits, 0, 255)
+               | (jnp.clip(nd_digits, 0, 255) << 8)
+               | (ts_neg << 16))
+    f16_ok = (ts_hi < 9007199) | ((ts_hi == 9007199)
+                                  & (ts_lo <= 254740992))
+    ts_ok &= (nd_digits <= 15) | ((nd_digits == 16) & f16_ok)
+
+    # ---- other specials --------------------------------------------------
+    host_ok = has_host & (host_vt == VT_STRING) & (host_esc == 0)
+    short_ok = ~has_short | ((short_vt == VT_STRING) & (short_esc == 0))
+    full_ok = ~has_full | ((full_vt == VT_STRING) & (full_esc == 0))
+    ver_ok = ~has_ver | ((ver_vt == VT_STRING) & (ver_esc == 0)
+                         & (ver_ln0 == 3) & (ver_v0 == ord("1"))
+                         & (ver_v1 == ord("."))
+                         & ((ver_v2 == ord("0")) | (ver_v2 == ord("1"))))
+    lvl_ok = ~has_lvl | ((lvl_vt == VT_NUMBER) & (lvl_b - lvl_a == 1)
+                         & (lvl_v0 >= ord("0")) & (lvl_v0 <= ord("7")))
+
+    # ---- pair validation + slot compaction ------------------------------
+    pair_bad = jnp.zeros((N,), dtype=bool)
+    is_pair_cols = []
+    run = jnp.zeros((N,), dtype=_I32)
+    for f in range(F):
+        isp = jm[:, f] & (spid[f] == 0)
+        neg = (v0[f] == ord("-")).astype(_I32)
+        int_ok = ((val_t[:, f] == VT_NUMBER) & (fracc[f] == 0)
+                  & (vb[f] - va[f] - neg <= 18) & canonical_number(f)
+                  & ~((v0[f] == ord("0")) & (vb[f] - va[f] > 1))
+                  & ~((neg == 1) & (v1[f] == ord("0"))))
+        p_ok = (((val_t[:, f] == VT_STRING) & ~val_esc[:, f])
+                | (val_t[:, f] == VT_TRUE) | (val_t[:, f] == VT_FALSE)
+                | (val_t[:, f] == VT_NULL) | int_ok)
+        pair_bad |= isp & ~p_ok
+        pair_bad |= jm[:, f] & key_esc[:, f]
+        run = run + isp.astype(_I32)
+        is_pair_cols.append(isp)
+    pair_count = run
+
+    # pair slots feed the sorter in RAW FIELD ORDER with a per-slot
+    # validity mask: non-pair/absent fields key to _BIG and the sort
+    # itself compacts them to the tail — no O(F^2) where-chain
+    # compaction (the F=24 wide kernel would not compile in reasonable
+    # time with one).  Sort key = final name (leading '_' stripped).
+    ns_true = [key_s[:, f] for f in range(F)]
+    ne_slot = [key_e[:, f] for f in range(F)]
+    us_slot = [(b == ord("_")).astype(_I32) for b in kfirst]
+    # NB: "ne_raw" and "ne" must be DISTINCT list objects (the sorter
+    # swaps each payload list in place; an aliased list would swap
+    # twice and end unsorted)
+    cols = {"_pair_count": pair_count,
+            "ns_raw": [ns + us for ns, us in zip(ns_true, us_slot)],
+            "ne_raw": list(ne_slot),
+            "ns": list(ns_true), "ne": ne_slot, "us": us_slot,
+            "vs": [val_s[:, f] for f in range(F)],
+            "ve": [val_e[:, f] for f in range(F)],
+            "vt": [val_t[:, f] for f in range(F)]}
+    ambig = sort_pairs_by_key8(bb, iota, cols, F,
+                               slot_valid=is_pair_cols)
+
+    # ---- segment table (host tier's 1 + 7p + 16 layout) -----------------
+    cbase = L
+    tbase = L + len(bank)
+    zero = jnp.zeros((N,), dtype=_I32)
+    segs = [(zero + (cbase + off["open"]), zero + 1)]
+    for p in range(F):
+        pv = p < pair_count
+        us = cols["us"][p] == 1
+        is_str = cols["vt"][p] == VT_STRING
+        vsrc = jnp.where(
+            is_str | (cols["vt"][p] == VT_NUMBER), cols["vs"][p],
+            jnp.where(cols["vt"][p] == VT_TRUE, cbase + off["true"],
+                      jnp.where(cols["vt"][p] == VT_FALSE,
+                                cbase + off["false"],
+                                cbase + off["null"])))
+        vln = jnp.where(
+            is_str | (cols["vt"][p] == VT_NUMBER),
+            cols["ve"][p] - cols["vs"][p],
+            jnp.where(cols["vt"][p] == VT_TRUE, 4,
+                      jnp.where(cols["vt"][p] == VT_FALSE, 5, 4)))
+        segs.append((jnp.where(us, cbase + off["q"], cbase + off["kpre"]),
+                     jnp.where(pv, jnp.where(us, 1, 2), 0)))
+        segs.append((cols["ns"][p],
+                     jnp.where(pv, cols["ne"][p] - cols["ns"][p], 0)))
+        segs.append((zero + (cbase + off["colon"]),
+                     jnp.where(pv, 2, 0)))
+        segs.append((zero + (cbase + off["q"]),
+                     jnp.where(pv & is_str, 1, 0)))
+        segs.append((vsrc, jnp.where(pv, vln, 0)))
+        segs.append((zero + (cbase + off["q"]),
+                     jnp.where(pv & is_str, 1, 0)))
+        segs.append((zero + (cbase + off["comma"]),
+                     jnp.where(pv, 1, 0)))
+
+    host_len0 = host_b - host_a
+    host_empty = host_len0 <= 0
+    segs += [
+        (zero + (cbase + off["full"]),
+         jnp.where(has_full, len(_PARTS["full"]), 0)),
+        (full_a, jnp.where(has_full, full_b - full_a, 0)),
+        (zero + (cbase + off["qc"]), jnp.where(has_full, 2, 0)),
+        (zero + (cbase + off["host"]), zero + len(_PARTS["host"])),
+        (jnp.where(host_empty, cbase + off["unknown"], host_a),
+         jnp.where(host_empty, len(_PARTS["unknown"]), host_len0)),
+        (zero + (cbase + off["qc"]), zero + 2),
+        (zero + (cbase + off["lvl"]),
+         jnp.where(has_lvl, len(_PARTS["lvl"]), 0)),
+        (lvl_a, jnp.where(has_lvl, 1, 0)),
+        (zero + (cbase + off["comma"]), jnp.where(has_lvl, 1, 0)),
+        (zero + (cbase + off["short"]), zero + len(_PARTS["short"])),
+        (jnp.where(has_short, short_a, cbase + off["dash"]),
+         jnp.where(has_short, short_b - short_a, 1)),
+        (zero + (cbase + off["qc"]), zero + 2),
+        (zero + (cbase + off["ts"]), zero + len(_PARTS["ts"])),
+        (zero + tbase, ts_len.astype(_I32)),
+        (zero + (cbase + off["comma"]), zero + 1),
+        (zero + (cbase + off["tail"]),
+         zero + len(_PARTS["tail"]) + len(suffix)),
+    ]
+
+    out_len = segs[0][1]
+    for _, ln in segs[1:]:
+        out_len = out_len + ln
+
+    tier = (ok & ~viol_row & ~rep_special
+            & ts_ok & host_ok & short_ok & full_ok & ver_ok & lvl_ok
+            & ~pair_bad & ~ambig & (out_len <= OW))
+    if not assemble:
+        return {"tier": tier, "ts_hi": ts_hi, "ts_lo": ts_lo,
+                "ts_meta": ts_meta, "ts_ok_row": tier}
+    acc, out_len2 = assemble_rows(segs, batch, bank, ts_text, N, OW)
+    return acc, out_len2, tier
+
+
+def route_ok(encoder, merger) -> bool:
+    """GELF output over line/nul/syslen framing; gelf_extra cannot place
+    statically in a re-canonicalized object (dynamic input keys), so any
+    extras keep the host paths — exactly the host block's gate."""
+    from .device_common import gelf_route_ok
+
+    return gelf_route_ok(encoder, merger, lambda e: False)
+
+
+def fetch_encode(handle, packed, encoder, merger, route_state=None):
+    """Device gelf→GELF encode for a submitted gelf decode handle;
+    returns (BlockResult | None, fetch_seconds)."""
+    from .block_common import merger_suffix
+    from .materialize_gelf import _scalar_gelf
+
+    out, batch_dev, lens_dev, _batch_host, _lens_host = handle
+    suffix, syslen = merger_suffix(merger)
+
+    def kernel(ts_text, ts_len, assemble):
+        return _encode_kernel(batch_dev, lens_dev, dict(out), ts_text,
+                              ts_len, suffix=suffix, assemble=assemble)
+
+    def wide():
+        """16-field escalation: re-decode wider (the [N, F] field axis
+        sizes every loop in the kernel).  16 rather than the 24-field
+        decode rescue bound: the per-field point/count extraction words
+        scale compile time with F, and 17+-field GELF objects are rare
+        enough to leave on the host rescue path."""
+        from .gelf import decode_gelf_jit
+
+        out_w = decode_gelf_jit(batch_dev, lens_dev, max_fields=16)
+
+        def kernel_w(ts_text, ts_len, assemble):
+            return _encode_kernel(batch_dev, lens_dev, dict(out_w),
+                                  ts_text, ts_len, suffix=suffix,
+                                  assemble=assemble)
+        return out_w, kernel_w
+
+    def ts_vals_fn(small, okh):
+        """Combine the kernel's split-integer parse; sign rides
+        ts_meta bit 16 (canonical JSON allows negative stamps)."""
+        import numpy as np
+
+        hi = small["ts_hi"].astype(np.float64)
+        lo = small["ts_lo"].astype(np.float64)
+        meta = small["ts_meta"]
+        frac = (meta & 255).astype(np.int64)
+        sign = np.where((meta >> 16) & 1, -1.0, 1.0)
+        return sign * (hi * 1e9 + lo) / np.power(10.0, frac)
+
+    return fetch_encode_driver(
+        kernel, out, batch_dev, lens_dev, packed, encoder, merger,
+        route_state, suffix, syslen, scalar_fn=_scalar_gelf,
+        fallback_frac=FALLBACK_FRAC, decline_limit=DECLINE_LIMIT,
+        cooldown=COOLDOWN,
+        ts_keys=("ts_hi", "ts_lo", "ts_meta"), ts_vals_fn=ts_vals_fn,
+        wide=wide)

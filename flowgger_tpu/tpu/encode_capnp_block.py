@@ -1,29 +1,40 @@
-"""Columnar RFC5424→Cap'n Proto encoding: span tables become framed
-capnp messages without per-row Python.
+"""Columnar →Cap'n Proto encoding: span tables become framed capnp
+messages without per-row Python, for the rfc5424, rfc3164, and ltsv
+decoders (the reference's capnp encoder is decoder-agnostic,
+capnp_encoder.rs:36-109, and kafka+capnp is its default pipeline,
+mod.rs:104 — every kernel format reaching it columnar means a stock
+config never silently drops to the ~30x Record path).
 
-The reference's default output is kafka+capnp (mod.rs:104;
-capnp_encoder.rs:36-109), so this route closing means a stock config no
-longer silently drops to the ~30x Record path.  The wire layout
-(capnp_wire.py, byte-identical with the reference's golden bytes) is a
-bump-allocated single segment whose piece order is fixed:
+The wire layout (capnp_wire.py, byte-identical with the reference's
+golden bytes) is a bump-allocated single segment whose piece order is
+fixed:
 
     framing | root ptr | root struct (2 data + 9 ptr words) |
-    hostname, appname, procid, msgid, [msg], full_msg, [sd_id] texts |
-    [pairs tag word + 4-word elements | per-pair "_"+name and value
-    texts] | [constant capnp_extra blob]
+    hostname, [appname], [procid], [msgid], [msg], full_msg, [sd_id]
+    texts | [pairs tag word + 4-word elements | per-pair "_"+name and
+    value texts] | [constant capnp_extra blob]
 
 Every pointer is a self-relative word — pure arithmetic over the
-per-row word layout, computed here as int64 numpy vectors and viewed as
+per-row word layout, computed as int64 numpy vectors and viewed as
 little-endian bytes.  Text bytes come out of the input chunk with one
 ``concat_segments`` gather (NUL padding from a zero bank), exactly like
 the JSON block encoders.  ``capnp_extra`` is allocated last by the
 reference encoder, so its bytes are row-invariant: one constant blob
 plus a computed pointer word.
 
-Tier: kernel-ok rows without value escapes (RFC5424 ``\\"``-unescaping
-is host work) and within ``max_len``; everything else splices through
-the scalar oracle → CapnpEncoder, byte-identical in every case
-(differential-tested in tests/test_encode_capnp_block.py).
+Format tiers (everything else splices through the scalar oracle →
+CapnpEncoder, byte-identical in every case — differential-tested in
+tests/test_encode_capnp_block.py):
+
+- rfc5424: kernel-ok rows without value escapes (``\\"``-unescaping is
+  host work) and within ``max_len``;
+- rfc3164: kernel-ok ASCII rows (no SD, no optional fields beyond the
+  PRI-gated facility/severity);
+- ltsv: untyped rows (a configured ``ltsv_schema`` types pair values —
+  route-gated to the Record path), no repeated/colonless specials;
+  rfc3339 stamps combine from the kernel's calendar channels and
+  unix-literal stamps from its exact split-integer parse, with a
+  per-row ``float(span)`` for the rare 17+-digit stamp.
 """
 
 from __future__ import annotations
@@ -33,10 +44,12 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from ..capnp_wire import (
+    FACILITY_MISSING,
     PAIR_DATA_WORDS,
     PAIR_PTR_WORDS,
     RECORD_DATA_WORDS,
     RECORD_PTR_WORDS,
+    SEVERITY_MISSING,
     WORD,
 )
 from ..mergers import Merger
@@ -101,6 +114,202 @@ def _extra_blob(extra: List[Tuple[str, str]]) -> bytes:
     return blob
 
 
+def _capnp_assemble(chunk_bytes, starts64, lens64, n, cand, ridx,
+                    texts, sid, pairs, ts, fac, sev, encoder, merger,
+                    suffix, syslen, scalar_fn=None):
+    """Shared layout + assembly for every format wrapper, over
+    ridx-selected [R] arrays.
+
+    ``texts``: the six plain text slots in allocation order —
+    hostname/appname/procid/msgid/msg/full_msg — each ``(a, blen,
+    gate)`` with gate None = present on every row (an all-False gate =
+    the format never sets the field, matching the scalar encoder's
+    skipped set_text → NULL pointer).  ``sid``: ``(a, blen, gate)`` or
+    None.  ``pairs``: ``(name_a, name_l, val_a, val_l, pvalid,
+    has_sd)`` [R, P] / [R] or None — pair names emit with the ``"_"``
+    prefix and string discriminants.  ``ts``/``fac``/``sev``: [R]
+    float64 / uint8 values (missing already mapped to the *_MISSING
+    sentinels)."""
+    R = ridx.size
+    final_buf = b""
+    row_off = np.zeros(1, dtype=np.int64)
+    prefix_lens_tier: Optional[np.ndarray] = None
+
+    if R:
+        # ---- word layout ------------------------------------------------
+        def gated(blen, gate):
+            return blen if gate is None else np.where(gate, blen, 0)
+
+        tw = []
+        for a, blen, gate in texts:
+            present = (np.ones(R, dtype=bool) if gate is None
+                       else np.asarray(gate, dtype=bool))
+            tw.append(np.where(present, _text_words(blen), 0))
+        if sid is not None:
+            sid_a, sid_l, has_sd_sid = sid
+            si_w = np.where(has_sd_sid, _text_words(sid_l), 0)
+        else:
+            sid_a = sid_l = np.zeros(R, dtype=np.int64)
+            has_sd_sid = np.zeros(R, dtype=bool)
+            si_w = np.zeros(R, dtype=np.int64)
+        if pairs is not None:
+            name_a, name_l, val_a, val_l, pvalid, has_sd = pairs
+            P = name_a.shape[1]
+            name_l = np.where(pvalid, name_l, 0)
+            val_l = np.where(pvalid, val_l, 0)
+            k0 = pvalid.sum(axis=1).astype(np.int64)
+            key_w = np.where(pvalid, _text_words(name_l + 1), 0)  # "_"+name
+            valw = np.where(pvalid, _text_words(val_l), 0)
+            pairs_w = np.where(has_sd, 1 + k0 * _PAIR_WORDS
+                               + key_w.sum(axis=1) + valw.sum(axis=1), 0)
+        else:
+            P = 0
+            has_sd = np.zeros(R, dtype=bool)
+            k0 = np.zeros(R, dtype=np.int64)
+            pairs_w = np.zeros(R, dtype=np.int64)
+        extra = getattr(encoder, "extra", [])
+        blob = _extra_blob(extra)
+        blob_w = len(blob) // WORD
+
+        w_at = [np.full(R, 1 + _ROOT_WORDS, dtype=np.int64)]
+        for w in tw:
+            w_at.append(w_at[-1] + w)
+        w_sid = w_at[-1]
+        w_pairs = w_sid + si_w            # tag word position
+        w_extra = w_pairs + pairs_w
+        nwords = w_extra + blob_w
+
+        # ---- binary scratch: framing + root ptr + root struct -----------
+        hdr = np.zeros((R, _HDR_BYTES), dtype=np.uint8)
+        hdr[:, 4:8] = nwords.astype("<u4").view(np.uint8).reshape(R, 4)
+        root_ptr = (RECORD_DATA_WORDS | (RECORD_PTR_WORDS << 16)) << 32
+        hdr[:, 8:16] = np.frombuffer(
+            int(root_ptr).to_bytes(8, "little"), dtype=np.uint8)
+        hdr[:, 16:24] = np.asarray(ts, dtype=np.float64).astype(
+            "<f8").view(np.uint8).reshape(R, 8)
+        hdr[:, 24] = np.asarray(fac).astype(np.uint8)
+        hdr[:, 25] = np.asarray(sev).astype(np.uint8)
+
+        ptrs = np.zeros((R, RECORD_PTR_WORDS), dtype=np.int64)
+        pw0 = 1 + RECORD_DATA_WORDS  # word index of pointer slot 0
+
+        def text_ptr(slot, target_w, blen, gate=None):
+            v = _list_ptr_words(np.full(R, pw0 + slot, dtype=np.int64),
+                                target_w, blen + 1)
+            ptrs[:, slot] = v if gate is None else np.where(gate, v, 0)
+
+        for slot, ((a, blen, gate), w0) in enumerate(zip(texts, w_at)):
+            text_ptr(slot, w0, blen, gate)
+        text_ptr(_P_SD_ID, w_sid, sid_l, has_sd_sid)
+        if pairs is not None:
+            ptrs[:, _P_PAIRS] = np.where(
+                has_sd,
+                _list_ptr_words(np.full(R, pw0 + _P_PAIRS, dtype=np.int64),
+                                w_pairs, k0 * _PAIR_WORDS, elem_size=7), 0)
+        if blob_w:
+            ptrs[:, _P_EXTRA] = _list_ptr_words(
+                np.full(R, pw0 + _P_EXTRA, dtype=np.int64), w_extra,
+                len(extra) * _PAIR_WORDS, elem_size=7)
+        hdr[:, 32:] = ptrs.astype("<i8").view(np.uint8).reshape(R, 72)
+
+        # ---- pairs scratch: tag word + 4-word elements -------------------
+        if pairs is not None:
+            pair_bytes = WORD * (1 + P * _PAIR_WORDS)
+            pscratch = np.zeros((R, pair_bytes), dtype=np.uint8)
+            tag = ((k0 << 2) & 0xFFFFFFFF) | np.int64(
+                (PAIR_DATA_WORDS | (PAIR_PTR_WORDS << 16)) << 32)
+            pscratch[:, 0:8] = np.where(has_sd, tag, 0).astype(
+                "<i8").view(np.uint8).reshape(R, 8)
+            # per-pair text word positions: keys/values alloc in pair order
+            kv_w = np.zeros((R, P, 2), dtype=np.int64)
+            cursor = w_pairs + 1 + k0 * _PAIR_WORDS
+            for p in range(P):
+                kv_w[:, p, 0] = cursor
+                cursor = cursor + key_w[:, p]
+                kv_w[:, p, 1] = cursor
+                cursor = cursor + valw[:, p]
+            ewords = np.zeros((R, P, _PAIR_WORDS), dtype=np.int64)
+            for p in range(P):
+                base = w_pairs + 1 + p * _PAIR_WORDS
+                ewords[:, p, 2] = np.where(
+                    pvalid[:, p],
+                    _list_ptr_words(base + PAIR_DATA_WORDS, kv_w[:, p, 0],
+                                    name_l[:, p] + 2), 0)
+                ewords[:, p, 3] = np.where(
+                    pvalid[:, p],
+                    _list_ptr_words(base + PAIR_DATA_WORDS + 1,
+                                    kv_w[:, p, 1], val_l[:, p] + 1), 0)
+            pscratch[:, 8:] = ewords.astype("<i8").view(np.uint8).reshape(
+                R, P * _PAIR_WORDS * WORD)
+
+        # ---- segment table ----------------------------------------------
+        chunk_arr = np.frombuffer(chunk_bytes, dtype=np.uint8)
+        consts, offs = build_source(b"\x00" * (WORD * 2), b"_", blob,
+                                    suffix, hdr.tobytes(),
+                                    pscratch.tobytes() if pairs is not None
+                                    else b"")
+        o_zero, o_us, o_blob, o_suffix, o_hdr, o_pscratch = offs
+        cbase = int(chunk_arr.size)
+        src = np.concatenate([chunk_arr, consts])
+
+        def pad_for(blen, words, gate=None):
+            ln = words * WORD - blen
+            if gate is not None:
+                ln = np.where(gate, ln, 0)
+            return ln
+
+        cols: List[Tuple[np.ndarray, np.ndarray]] = []
+
+        def add(srcv, lenv):
+            cols.append((np.broadcast_to(srcv, (R,)).astype(np.int64),
+                         np.broadcast_to(lenv, (R,)).astype(np.int64)))
+
+        add(cbase + o_hdr + np.arange(R) * _HDR_BYTES,
+            np.full(R, _HDR_BYTES))
+        for (a, blen, gate), w in zip(texts, tw):
+            gl = gated(blen, gate)
+            add(a, gl)
+            add(cbase + o_zero, pad_for(gl, w, gate))
+        add(sid_a, gated(sid_l, has_sd_sid))
+        add(cbase + o_zero, pad_for(gated(sid_l, has_sd_sid), si_w,
+                                    has_sd_sid))
+        if pairs is not None:
+            # pairs: tag+elements scratch, then "_name\0pad value\0pad"
+            add(cbase + o_pscratch + np.arange(R) * pair_bytes,
+                np.where(has_sd, 8 + k0 * _PAIR_WORDS * WORD, 0))
+            for p in range(P):
+                pv = pvalid[:, p]
+                add(cbase + o_us, np.where(pv, 1, 0))
+                add(name_a[:, p], name_l[:, p])
+                add(cbase + o_zero,
+                    pad_for(name_l[:, p] + 1, key_w[:, p], pv))
+                add(val_a[:, p], val_l[:, p])
+                add(cbase + o_zero, pad_for(val_l[:, p], valw[:, p], pv))
+        add(cbase + o_blob, np.full(R, len(blob)))
+        add(cbase + o_suffix, np.full(R, len(suffix)))
+
+        nseg = len(cols)
+        seg_src = np.empty((R, nseg), dtype=np.int64)
+        seg_len = np.empty((R, nseg), dtype=np.int64)
+        for k, (s, ln) in enumerate(cols):
+            seg_src[:, k] = s
+            seg_len[:, k] = ln
+        dst0 = exclusive_cumsum(seg_len.ravel())
+        body = concat_segments(src, seg_src.ravel(), seg_len.ravel(), dst0)
+        row_off = dst0[::nseg]
+        tier_lens = np.diff(row_off)
+        if syslen:
+            final_buf, row_off, prefix_lens_tier = apply_syslen_prefix(
+                body, row_off, tier_lens)
+        else:
+            final_buf = body.tobytes()
+
+    kw = {} if scalar_fn is None else {"scalar_fn": scalar_fn}
+    return finish_block(chunk_bytes, starts64, lens64, n, cand, ridx,
+                        final_buf, row_off, prefix_lens_tier, suffix,
+                        syslen, merger, encoder, **kw)
+
+
 def encode_rfc5424_capnp_block(
     chunk_bytes: bytes,
     starts: np.ndarray,
@@ -123,215 +332,279 @@ def encode_rfc5424_capnp_block(
     has_high = np.asarray(out["has_high"][:n], dtype=bool)
     val_esc = np.asarray(out["val_has_esc"][:n], dtype=bool)
     pair_count = np.asarray(out["pair_count"][:n], dtype=np.int64)
-    P = np.asarray(out["name_start"]).shape[1]
     esc_any = (val_esc[:, :]
                & (np.arange(val_esc.shape[1])[None, :] < pair_count[:, None])
                ).any(axis=1)
     cand = ok & (lens64 <= max_len) & ~has_high & ~esc_any
 
     ridx = np.flatnonzero(cand)
+    if not ridx.size:
+        return _capnp_assemble(chunk_bytes, starts64, lens64, n, cand,
+                               ridx, [], None, None, None, None, None,
+                               encoder, merger, suffix, syslen)
+    st = starts64[ridx]
+
+    def span(a_key, b_key):
+        a = np.asarray(out[a_key])[:n][ridx].astype(np.int64)
+        b = np.asarray(out[b_key])[:n][ridx].astype(np.int64)
+        return st + a, np.maximum(b - a, 0)
+
+    host_a, host_l = span("host_start", "host_end")
+    app_a, app_l = span("app_start", "app_end")
+    proc_a, proc_l = span("proc_start", "proc_end")
+    msgid_a, msgid_l = span("msgid_start", "msgid_end")
+    # msg: [msg_trim_start, trim_end) — None (no text) when empty
+    msg_a = st + np.asarray(out["msg_trim_start"])[:n][ridx].astype(np.int64)
+    trim_e = st + np.asarray(out["trim_end"])[:n][ridx].astype(np.int64)
+    msg_l = np.maximum(trim_e - msg_a, 0)
+    has_msg = msg_l > 0
+    full_a = st + np.asarray(out["full_start"])[:n][ridx].astype(np.int64)
+    full_l = np.maximum(trim_e - full_a, 0)
+    sd_count = np.asarray(out["sd_count"])[:n][ridx].astype(np.int64)
+    has_sd = sd_count > 0
+    sid_a = st + np.asarray(out["sid_start"])[:n][ridx, 0].astype(np.int64)
+    sid_l = np.maximum(
+        np.asarray(out["sid_end"])[:n][ridx, 0].astype(np.int64)
+        - np.asarray(out["sid_start"])[:n][ridx, 0].astype(np.int64), 0)
+    pc = pair_count[ridx]
+    P = np.asarray(out["name_start"]).shape[1]
+    pair_sd = np.asarray(out["pair_sd"])[:n][ridx].astype(np.int64)
+    name_a = st[:, None] + np.asarray(out["name_start"])[:n][ridx].astype(np.int64)
+    name_l = (np.asarray(out["name_end"])[:n][ridx].astype(np.int64)
+              - np.asarray(out["name_start"])[:n][ridx].astype(np.int64))
+    val_a = st[:, None] + np.asarray(out["val_start"])[:n][ridx].astype(np.int64)
+    val_l = (np.asarray(out["val_end"])[:n][ridx].astype(np.int64)
+             - np.asarray(out["val_start"])[:n][ridx].astype(np.int64))
+    # capnp carries only sd[0] (capnp_encoder.rs:78-80): gate pairs
+    # on block 0 membership
+    pvalid = (np.arange(P)[None, :] < pc[:, None]) & (pair_sd == 0)
+
+    ts = compute_ts({k: np.asarray(v)[:n][ridx]
+                     for k, v in out.items()
+                     if k in ("days", "sod", "off", "nanos")})
+    fac = np.asarray(out["facility"])[:n][ridx].astype(np.uint8)
+    sev = np.asarray(out["severity"])[:n][ridx].astype(np.uint8)
+
+    texts = [
+        (host_a, host_l, None),
+        (app_a, app_l, None),
+        (proc_a, proc_l, None),
+        (msgid_a, msgid_l, None),
+        (msg_a, msg_l, has_msg),
+        (full_a, full_l, None),
+    ]
+    return _capnp_assemble(
+        chunk_bytes, starts64, lens64, n, cand, ridx, texts,
+        (sid_a, sid_l, has_sd),
+        (name_a, name_l, val_a, val_l, pvalid, has_sd),
+        ts, fac, sev, encoder, merger, suffix, syslen)
+
+
+def encode_rfc3164_capnp_block(
+    chunk_bytes: bytes,
+    starts: np.ndarray,
+    orig_lens: np.ndarray,
+    out: Dict[str, np.ndarray],
+    n_real: int,
+    max_len: int,
+    encoder,
+    merger: Optional[Merger],
+):
+    """rfc3164 Record → capnp: hostname + msg (tail) + full line, PRI-
+    gated facility/severity, no appname/procid/msgid/sd
+    (materialize_rfc3164.py's Record shape)."""
+    from .materialize_rfc3164 import _scalar_3164
+
+    spec = merger_suffix(merger)
+    if spec is None:
+        return None
+    suffix, syslen = spec
+
+    n = int(n_real)
+    starts64 = np.asarray(starts[:n], dtype=np.int64)
+    lens64 = np.asarray(orig_lens[:n], dtype=np.int64)
+    ok = np.asarray(out["ok"][:n], dtype=bool)
+    has_high = np.asarray(out["has_high"][:n], dtype=bool)
+    cand = ok & (lens64 <= max_len) & ~has_high
+    ridx = np.flatnonzero(cand)
+    st = starts64[ridx]
+
+    def sp(a_key, b_key):
+        a = np.asarray(out[a_key])[:n][ridx].astype(np.int64)
+        b = np.asarray(out[b_key])[:n][ridx].astype(np.int64)
+        return st + a, np.maximum(b - a, 0)
+
+    host_a, host_l = sp("host_start", "host_end")
+    msg_a = st + np.asarray(out["msg_start"])[:n][ridx].astype(np.int64)
+    msg_l = np.maximum(st + lens64[ridx] - msg_a, 0)
     R = ridx.size
-    final_buf = b""
-    row_off = np.zeros(1, dtype=np.int64)
-    prefix_lens_tier: Optional[np.ndarray] = None
+    zero = np.zeros(R, dtype=np.int64)
+    absent = np.zeros(R, dtype=bool)
+    has_pri = np.asarray(out["has_pri"][:n], dtype=bool)[ridx]
+    fac = np.where(has_pri,
+                   np.asarray(out["facility"])[:n][ridx], FACILITY_MISSING)
+    sev = np.where(has_pri,
+                   np.asarray(out["severity"])[:n][ridx], SEVERITY_MISSING)
+    ts = compute_ts({k: np.asarray(v)[:n][ridx]
+                     for k, v in out.items()
+                     if k in ("days", "sod", "off", "nanos")})
 
-    if R:
-        st = starts64[ridx]
+    texts = [
+        (host_a, host_l, None),
+        (zero, zero, absent),          # appname
+        (zero, zero, absent),          # procid
+        (zero, zero, absent),          # msgid
+        (msg_a, msg_l, None),          # msg = line[msg_start:], may be ""
+        (st, lens64[ridx], None),      # full_msg = whole line
+    ]
+    return _capnp_assemble(
+        chunk_bytes, starts64, lens64, n, cand, ridx, texts, None, None,
+        ts, fac, sev, encoder, merger, suffix, syslen,
+        scalar_fn=_scalar_3164)
 
-        def span(a_key, b_key):
-            a = np.asarray(out[a_key])[:n][ridx].astype(np.int64)
-            b = np.asarray(out[b_key])[:n][ridx].astype(np.int64)
-            return st + a, np.maximum(b - a, 0)
 
-        host_a, host_l = span("host_start", "host_end")
-        app_a, app_l = span("app_start", "app_end")
-        proc_a, proc_l = span("proc_start", "proc_end")
-        msgid_a, msgid_l = span("msgid_start", "msgid_end")
-        # msg: [msg_trim_start, trim_end) — None (no text) when empty
-        msg_a = st + np.asarray(out["msg_trim_start"])[:n][ridx].astype(np.int64)
-        trim_e = st + np.asarray(out["trim_end"])[:n][ridx].astype(np.int64)
-        msg_l = np.maximum(trim_e - msg_a, 0)
-        has_msg = msg_l > 0
-        full_a = st + np.asarray(out["full_start"])[:n][ridx].astype(np.int64)
-        full_l = np.maximum(trim_e - full_a, 0)
-        sd_count = np.asarray(out["sd_count"])[:n][ridx].astype(np.int64)
-        has_sd = sd_count > 0
-        sid_a = st + np.asarray(out["sid_start"])[:n][ridx, 0].astype(np.int64)
-        sid_l = np.maximum(
-            np.asarray(out["sid_end"])[:n][ridx, 0].astype(np.int64)
-            - np.asarray(out["sid_start"])[:n][ridx, 0].astype(np.int64), 0)
-        pc = pair_count[ridx]
-        pair_sd = np.asarray(out["pair_sd"])[:n][ridx].astype(np.int64)
-        name_a = st[:, None] + np.asarray(out["name_start"])[:n][ridx].astype(np.int64)
-        name_l = (np.asarray(out["name_end"])[:n][ridx].astype(np.int64)
-                  - np.asarray(out["name_start"])[:n][ridx].astype(np.int64))
-        val_a = st[:, None] + np.asarray(out["val_start"])[:n][ridx].astype(np.int64)
-        val_l = (np.asarray(out["val_end"])[:n][ridx].astype(np.int64)
-                 - np.asarray(out["val_start"])[:n][ridx].astype(np.int64))
-        # capnp carries only sd[0] (capnp_encoder.rs:78-80): gate pairs
-        # on block 0 membership
-        pvalid = (np.arange(P)[None, :] < pc[:, None]) & (pair_sd == 0)
-        name_l = np.where(pvalid, name_l, 0)
-        val_l = np.where(pvalid, val_l, 0)
-        k0 = pvalid.sum(axis=1).astype(np.int64)
+def encode_ltsv_capnp_block(
+    chunk_bytes: bytes,
+    starts: np.ndarray,
+    orig_lens: np.ndarray,
+    out: Dict[str, np.ndarray],
+    n_real: int,
+    max_len: int,
+    encoder,
+    merger: Optional[Merger],
+    decoder=None,
+):
+    """ltsv Record → capnp: hostname, optional message, full line,
+    severity from ``level``, untyped pairs in part order (a configured
+    ``ltsv_schema`` types values — those rows keep the Record path,
+    gated here like the GELF block's typed screens)."""
+    from .materialize_ltsv import _scalar_ltsv
 
-        # ---- word layout ------------------------------------------------
-        hn_w = _text_words(host_l)
-        ap_w = _text_words(app_l)
-        pr_w = _text_words(proc_l)
-        mi_w = _text_words(msgid_l)
-        ms_w = np.where(has_msg, _text_words(msg_l), 0)
-        fm_w = _text_words(full_l)
-        si_w = np.where(has_sd, _text_words(sid_l), 0)
-        key_w = np.where(pvalid, _text_words(name_l + 1), 0)  # "_" + name
-        valw = np.where(pvalid, _text_words(val_l), 0)
-        pairs_w = np.where(has_sd, 1 + k0 * _PAIR_WORDS
-                           + key_w.sum(axis=1) + valw.sum(axis=1), 0)
-        extra = getattr(encoder, "extra", [])
-        blob = _extra_blob(extra)
-        blob_w = len(blob) // WORD
+    spec = merger_suffix(merger)
+    if spec is None:
+        return None
+    if decoder is not None and getattr(decoder, "schema", None):
+        return None
+    suffix, syslen = spec
 
-        w_host = np.full(R, 1 + _ROOT_WORDS, dtype=np.int64)
-        w_app = w_host + hn_w
-        w_proc = w_app + ap_w
-        w_msgid = w_proc + pr_w
-        w_msg = w_msgid + mi_w
-        w_full = w_msg + ms_w
-        w_sid = w_full + fm_w
-        w_pairs = w_sid + si_w            # tag word position
-        w_extra = w_pairs + pairs_w
-        nwords = w_extra + blob_w
+    def scalar_fn(line):
+        return _scalar_ltsv(decoder, line)
 
-        # ---- binary scratch: framing + root ptr + root struct -----------
-        hdr = np.zeros((R, _HDR_BYTES), dtype=np.uint8)
-        hdr[:, 4:8] = nwords.astype("<u4").view(np.uint8).reshape(R, 4)
-        root_ptr = (RECORD_DATA_WORDS | (RECORD_PTR_WORDS << 16)) << 32
-        hdr[:, 8:16] = np.frombuffer(
-            int(root_ptr).to_bytes(8, "little"), dtype=np.uint8)
-        ts = compute_ts({k: np.asarray(v)[:n][ridx]
-                         for k, v in out.items()
-                         if k in ("days", "sod", "off", "nanos")})
-        hdr[:, 16:24] = ts.astype("<f8").view(np.uint8).reshape(R, 8)
-        hdr[:, 24] = np.asarray(out["facility"])[:n][ridx].astype(np.uint8)
-        hdr[:, 25] = np.asarray(out["severity"])[:n][ridx].astype(np.uint8)
+    n = int(n_real)
+    starts64 = np.asarray(starts[:n], dtype=np.int64)
+    lens64 = np.asarray(orig_lens[:n], dtype=np.int64)
+    ok = np.asarray(out["ok"][:n], dtype=bool)
+    has_high = np.asarray(out["has_high"][:n], dtype=bool)
+    n_parts = np.asarray(out["n_parts"])[:n].astype(np.int64)
+    part_start = np.asarray(out["part_start"])[:n]
+    part_end = np.asarray(out["part_end"])[:n]
+    colon_pos = np.asarray(out["colon_pos"])[:n]
+    host_pos = np.asarray(out["host_pos"])[:n]
+    ts_kind = np.asarray(out["ts_kind"])[:n]
 
-        ptrs = np.zeros((R, RECORD_PTR_WORDS), dtype=np.int64)
-        pw0 = 1 + RECORD_DATA_WORDS  # word index of pointer slot 0
+    P = part_start.shape[1]
+    jmask = np.arange(P)[None, :] < n_parts[:, None]
+    cand = ok & (lens64 <= max_len) & ~has_high & (host_pos >= 0)
+    # colon-less parts trigger the scalar path's stdout notice
+    cand &= ~(jmask & (colon_pos < 0)).any(axis=1)
 
-        def text_ptr(slot, target_w, blen, gate=None):
-            v = _list_ptr_words(np.full(R, pw0 + slot, dtype=np.int64),
-                                target_w, blen + 1)
-            ptrs[:, slot] = v if gate is None else np.where(gate, v, 0)
+    chunk_arr = np.frombuffer(chunk_bytes, dtype=np.uint8)
+    # specials route by NAME (every occurrence), and repeated special
+    # names drop to the oracle — exactly the GELF block's screen
+    nlen = np.where(jmask, colon_pos - part_start, 0)
+    key8 = (starts64[:, None, None] + part_start[:, :, None]
+            + np.arange(8, dtype=np.int64)[None, None, :])
+    km = chunk_arr[np.clip(key8, 0, max(chunk_arr.size - 1, 0))] \
+        if chunk_arr.size else np.zeros((n, P, 8), dtype=np.uint8)
+    special_name = np.zeros((n, P), dtype=bool)
+    for word in (b"time", b"host", b"message", b"level"):
+        match = jmask & (nlen == len(word))
+        for i, ch in enumerate(word[:8]):
+            match &= km[:, :, i] == ch
+        special_name |= match
+        cand &= match.sum(axis=1) <= 1
 
-        text_ptr(_P_HOSTNAME, w_host, host_l)
-        text_ptr(_P_APPNAME, w_app, app_l)
-        text_ptr(_P_PROCID, w_proc, proc_l)
-        text_ptr(_P_MSGID, w_msgid, msgid_l)
-        text_ptr(_P_MSG, w_msg, msg_l, has_msg)
-        text_ptr(_P_FULL_MSG, w_full, full_l)
-        text_ptr(_P_SD_ID, w_sid, sid_l, has_sd)
-        ptrs[:, _P_PAIRS] = np.where(
-            has_sd,
-            _list_ptr_words(np.full(R, pw0 + _P_PAIRS, dtype=np.int64),
-                            w_pairs, k0 * _PAIR_WORDS, elem_size=7), 0)
-        if blob_w:
-            ptrs[:, _P_EXTRA] = _list_ptr_words(
-                np.full(R, pw0 + _P_EXTRA, dtype=np.int64), w_extra,
-                len(extra) * _PAIR_WORDS, elem_size=7)
-        hdr[:, 32:] = ptrs.astype("<i8").view(np.uint8).reshape(R, 72)
+    ridx = np.flatnonzero(cand)
+    st = starts64[ridx]
 
-        # ---- pairs scratch: tag word + 4-word elements -------------------
-        pair_bytes = WORD * (1 + P * _PAIR_WORDS)
-        pscratch = np.zeros((R, pair_bytes), dtype=np.uint8)
-        tag = ((k0 << 2) & 0xFFFFFFFF) | np.int64(
-            (PAIR_DATA_WORDS | (PAIR_PTR_WORDS << 16)) << 32)
-        pscratch[:, 0:8] = np.where(has_sd, tag, 0).astype(
-            "<i8").view(np.uint8).reshape(R, 8)
-        # per-pair text word positions: keys/values alloc in pair order
-        kv_w = np.zeros((R, P, 2), dtype=np.int64)
-        cursor = w_pairs + 1 + k0 * _PAIR_WORDS
-        for p in range(P):
-            kv_w[:, p, 0] = cursor
-            cursor = cursor + key_w[:, p]
-            kv_w[:, p, 1] = cursor
-            cursor = cursor + valw[:, p]
-        ewords = np.zeros((R, P, _PAIR_WORDS), dtype=np.int64)
-        for p in range(P):
-            base = w_pairs + 1 + p * _PAIR_WORDS
-            ewords[:, p, 2] = np.where(
-                pvalid[:, p],
-                _list_ptr_words(base + PAIR_DATA_WORDS, kv_w[:, p, 0],
-                                name_l[:, p] + 2), 0)
-            ewords[:, p, 3] = np.where(
-                pvalid[:, p],
-                _list_ptr_words(base + PAIR_DATA_WORDS + 1, kv_w[:, p, 1],
-                                val_l[:, p] + 1), 0)
-        pscratch[:, 8:] = ewords.astype("<i8").view(np.uint8).reshape(
-            R, P * _PAIR_WORDS * WORD)
+    def sp(a_key, b_key):
+        a = np.asarray(out[a_key])[:n][ridx].astype(np.int64)
+        b = np.asarray(out[b_key])[:n][ridx].astype(np.int64)
+        return st + a, np.maximum(b - a, 0)
 
-        # ---- segment table ----------------------------------------------
-        chunk_arr = np.frombuffer(chunk_bytes, dtype=np.uint8)
-        consts, offs = build_source(b"\x00" * (WORD * 2), b"_", blob,
-                                    suffix, hdr.tobytes(),
-                                    pscratch.tobytes())
-        o_zero, o_us, o_blob, o_suffix, o_hdr, o_pscratch = offs
-        cbase = int(chunk_arr.size)
-        src = np.concatenate([chunk_arr, consts])
+    host_a, host_l = sp("host_start", "host_end")
+    msg_a, msg_l = sp("msg_start", "msg_end")
+    has_msg = np.asarray(out["msg_pos"])[:n][ridx].astype(np.int64) >= 0
+    level = np.asarray(out["level_val"])[:n][ridx].astype(np.int64)
+    R = ridx.size
+    zero = np.zeros(R, dtype=np.int64)
+    absent = np.zeros(R, dtype=bool)
+    fac = np.full(R, FACILITY_MISSING, dtype=np.int64)
+    sev = np.where(level >= 0, level, SEVERITY_MISSING)
 
-        def pad_for(blen, words, gate=None):
-            ln = words * WORD - blen
-            if gate is not None:
-                ln = np.where(gate, ln, 0)
-            return ln
+    # timestamps: rfc3339 rows from the calendar channels; float rows
+    # from the exact split-integer parse (vectorized), with a per-row
+    # float(span) only for stamps past f64's exact-integer range
+    kind = ts_kind[ridx]
+    ts = compute_ts({k: np.where(kind == 0, np.asarray(v)[:n][ridx], 0)
+                     for k, v in out.items()
+                     if k in ("days", "sod", "off", "nanos")})
+    fl = np.flatnonzero(kind == 1)
+    if fl.size:
+        hi = np.asarray(out["ts_hi"])[:n][ridx][fl].astype(np.float64)
+        lo = np.asarray(out["ts_lo"])[:n][ridx][fl].astype(np.float64)
+        meta = np.asarray(out["ts_meta"])[:n][ridx][fl].astype(np.int64)
+        frac = meta & 255
+        ndig = (meta >> 8) & 255
+        # ts_meta bit 16 means "has a sign CHARACTER" ('+' or '-'), not
+        # "negative" (ltsv.py packs has_sign) — signed stamps take the
+        # exact per-row float(span) below rather than guessing the sign
+        signed = ((meta >> 16) & 1) == 1
+        fv = (hi * 1e9 + lo) / np.power(10.0, frac)
+        wide = np.flatnonzero(
+            signed | (ndig > 16)
+            | ((ndig == 16)
+               & ((hi > 9007199.0)
+                  | ((hi == 9007199.0) & (lo > 254740992.0)))))
+        if wide.size:
+            tsa = (st[fl] + np.asarray(out["ts_start"])[:n][ridx][fl]
+                   ).astype(np.int64)
+            tsb = (st[fl] + np.asarray(out["ts_end"])[:n][ridx][fl]
+                   ).astype(np.int64)
+            for w in wide.tolist():
+                fv[w] = float(chunk_bytes[tsa[w]:tsb[w]])
+        ts[fl] = fv
 
-        cols: List[Tuple[np.ndarray, np.ndarray]] = []
+    # pairs: non-special parts in part order, "_"-prefixed string values
+    is_pair = jmask[ridx] & ~special_name[ridx]
+    name_a = st[:, None] + part_start[ridx].astype(np.int64)
+    name_l2 = (colon_pos[ridx].astype(np.int64)
+               - part_start[ridx].astype(np.int64))
+    val_a = st[:, None] + colon_pos[ridx].astype(np.int64) + 1
+    val_l = (part_end[ridx].astype(np.int64)
+             - colon_pos[ridx].astype(np.int64) - 1)
+    # compact pairs left so pvalid is a prefix mask (the layout cursor
+    # walks pair slots in order; gaps would still work but waste slots)
+    order = np.argsort(~is_pair, axis=1, kind="stable")
+    rr = np.arange(R)[:, None]
+    pvalid = np.take_along_axis(is_pair, order, axis=1)
+    name_a = name_a[rr, order]
+    name_l2 = name_l2[rr, order]
+    val_a = val_a[rr, order]
+    val_l = val_l[rr, order]
+    has_sd = pvalid.any(axis=1)
 
-        def add(srcv, lenv):
-            cols.append((np.broadcast_to(srcv, (R,)).astype(np.int64),
-                         np.broadcast_to(lenv, (R,)).astype(np.int64)))
-
-        add(cbase + o_hdr + np.arange(R) * _HDR_BYTES,
-            np.full(R, _HDR_BYTES))
-        for a, ln, w, gate in (
-                (host_a, host_l, hn_w, None),
-                (app_a, app_l, ap_w, None),
-                (proc_a, proc_l, pr_w, None),
-                (msgid_a, msgid_l, mi_w, None),
-                (msg_a, msg_l, ms_w, has_msg),
-                (full_a, full_l, fm_w, None),
-                (sid_a, sid_l, si_w, has_sd)):
-            gl = ln if gate is None else np.where(gate, ln, 0)
-            add(a, gl)
-            add(cbase + o_zero, pad_for(gl, w, gate))
-        # pairs: tag+elements scratch, then per-pair "_name\0pad value\0pad"
-        add(cbase + o_pscratch + np.arange(R) * pair_bytes,
-            np.where(has_sd, 8 + k0 * _PAIR_WORDS * WORD, 0))
-        for p in range(P):
-            pv = pvalid[:, p]
-            add(cbase + o_us, np.where(pv, 1, 0))
-            add(name_a[:, p], name_l[:, p])
-            add(cbase + o_zero, pad_for(name_l[:, p] + 1, key_w[:, p], pv))
-            add(val_a[:, p], val_l[:, p])
-            add(cbase + o_zero, pad_for(val_l[:, p], valw[:, p], pv))
-        add(cbase + o_blob, np.full(R, len(blob)))
-        add(cbase + o_suffix, np.full(R, len(suffix)))
-
-        nseg = len(cols)
-        seg_src = np.empty((R, nseg), dtype=np.int64)
-        seg_len = np.empty((R, nseg), dtype=np.int64)
-        for k, (s, ln) in enumerate(cols):
-            seg_src[:, k] = s
-            seg_len[:, k] = ln
-        dst0 = exclusive_cumsum(seg_len.ravel())
-        body = concat_segments(src, seg_src.ravel(), seg_len.ravel(), dst0)
-        row_off = dst0[::nseg]
-        tier_lens = np.diff(row_off)
-        if syslen:
-            final_buf, row_off, prefix_lens_tier = apply_syslen_prefix(
-                body, row_off, tier_lens)
-        else:
-            final_buf = body.tobytes()
-
-    return finish_block(chunk_bytes, starts64, lens64, n, cand, ridx,
-                        final_buf, row_off, prefix_lens_tier, suffix,
-                        syslen, merger, encoder)
+    texts = [
+        (host_a, host_l, None),
+        (zero, zero, absent),          # appname
+        (zero, zero, absent),          # procid
+        (zero, zero, absent),          # msgid
+        (msg_a, msg_l, has_msg),
+        (st, lens64[ridx], None),      # full_msg = whole line
+    ]
+    return _capnp_assemble(
+        chunk_bytes, starts64, lens64, n, cand, ridx, texts,
+        (zero, zero, np.zeros(R, dtype=bool)),   # sd_id is None for ltsv
+        (name_a, name_l2, val_a, val_l, pvalid, has_sd),
+        ts, fac, sev, encoder, merger, suffix, syslen,
+        scalar_fn=scalar_fn)

@@ -321,11 +321,15 @@ def decode_gelf_submit(batch, lens, sharded=None):
     the multi-chip mesh kernel (parallel.mesh.ShardedDecode)."""
     import jax.numpy as jnp
 
+    # the handle carries BOTH the device arrays (for the device-encode
+    # tier, no re-upload) and the caller's host arrays (so the tier-2
+    # rescue in decode_gelf_fetch never pays a full-batch D2H just to
+    # slice a few rescue rows)
     if sharded is not None:
-        out = sharded.fn(*sharded.put(batch, lens))
-    else:
-        out = decode_gelf_jit(jnp.asarray(batch), jnp.asarray(lens))
-    return (out, batch, lens)
+        b, ln = sharded.put(batch, lens)
+        return (sharded.fn(b, ln), b, ln, batch, lens)
+    b, ln = jnp.asarray(batch), jnp.asarray(lens)
+    return (decode_gelf_jit(b, ln), b, ln, batch, lens)
 
 
 _FIELD_KEYS = ("key_start", "key_end", "val_start", "val_end", "val_type",
@@ -339,7 +343,7 @@ def decode_gelf_fetch(handle):
     back widened to RESCUE_MAX_FIELDS when tier 2 ran."""
     import numpy as np
 
-    out, batch, lens = handle
+    out, _b_dev, _ln_dev, batch, lens = handle
     host = {k: np.asarray(v) for k, v in out.items()}
     if host["key_start"].shape[1] >= RESCUE_MAX_FIELDS:
         return host

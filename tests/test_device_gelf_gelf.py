@@ -1,0 +1,144 @@
+"""Device GELF→GELF re-canonicalization tier: differential vs the
+scalar oracle (GelfDecoder → GelfEncoder), engagement metrics, and the
+fallback splice for off-tier rows."""
+
+import random
+
+import pytest
+
+from flowgger_tpu.config import Config
+from flowgger_tpu.decoders import DecodeError
+from flowgger_tpu.decoders.gelf import GelfDecoder
+from flowgger_tpu.encoders.gelf import GelfEncoder
+from flowgger_tpu.mergers import LineMerger, NulMerger, SyslenMerger
+from flowgger_tpu.tpu import device_gelf_gelf, gelf, pack
+from flowgger_tpu.utils.metrics import registry as metrics
+
+ORACLE = GelfDecoder()
+ENC = GelfEncoder(Config.from_string(""))
+
+
+def scalar_frames(lines, merger):
+    out = []
+    for ln in lines:
+        try:
+            rec = ORACLE.decode(ln.decode("utf-8"))
+        except (DecodeError, UnicodeDecodeError):
+            continue
+        payload = ENC.encode(rec)
+        out.append(merger.frame(payload) if merger is not None else payload)
+    return out
+
+
+def run_device(lines, merger, max_len=256):
+    packed = pack.pack_lines_2d(lines, max_len)
+    handle = gelf.decode_gelf_submit(packed[0], packed[1])
+    return device_gelf_gelf.fetch_encode(handle, packed, ENC, merger)
+
+
+CLEAN = [
+    b'{"version":"1.1","host":"web1","short_message":"request served",'
+    b'"timestamp":1695213345.123,"level":6,"_status":200,"_path":"/x"}',
+    b'{"host":"db2","timestamp":1695213345,"short_message":"login ok",'
+    b'"_user":"alice","_ok":true,"_x":null,"_n":-17}',
+    b'{"timestamp":1695213346.5,"host":"w","zeta":1,"alpha":"two",'
+    b'"_mike":false,"bravo":"4","short_message":"sorted keys"}',
+    b'{"host":"h9","timestamp":0.5,"full_message":"the full text",'
+    b'"short_message":"short"}',
+    b'{ "host" : "spacy" , "timestamp" : 42 , "_a" : "b" }',
+]
+
+
+@pytest.mark.parametrize("merger", [None, LineMerger(), NulMerger(),
+                                    SyslenMerger()],
+                         ids=["noop", "line", "nul", "syslen"])
+def test_device_gelf_gelf_matches_scalar_and_engages(merger):
+    n0 = metrics.get("device_encode_rows")
+    res, _ = run_device(CLEAN * 4, merger)
+    assert res is not None
+    assert metrics.get("device_encode_rows") - n0 == len(CLEAN) * 4
+    want = b"".join(scalar_frames(CLEAN * 4, merger))
+    assert res.block.data == want
+
+
+def test_device_gelf_gelf_fallback_splicing(monkeypatch):
+    monkeypatch.setattr(device_gelf_gelf, "FALLBACK_FRAC", 1.1)
+    mixed = [
+        CLEAN[0],
+        # escaped string value: host tiers handle it
+        b'{"host":"h","timestamp":1,"_m":"say \\"hi\\""}',
+        # float pair value: json_f64 re-format is per-value host work
+        b'{"host":"h","timestamp":2,"_f":1.25}',
+        # non-canonical int (leading zero): host
+        b'{"host":"h","timestamp":3,"_z":007}',
+        # repeated special: oracle parity
+        b'{"host":"a","host":"b","timestamp":4}',
+        # negative timestamp (canonical JSON): device or host, same out
+        b'{"host":"h","timestamp":-12.5,"short_message":"neg"}',
+        # 17-digit timestamp: beyond the exact split parse, host
+        b'{"host":"h","timestamp":14389790025.637824}',
+        # bad version literal
+        b'{"host":"h","timestamp":5,"version":"2.0"}',
+        # duplicate final names (dict last-wins): oracle
+        b'{"host":"h","timestamp":6,"_k":1,"k":2}',
+        CLEAN[1],
+        # non-ascii: off tier (decode semantics on the oracle)
+        '{"host":"hé","timestamp":7}'.encode(),
+    ]
+    res, _ = run_device(mixed, LineMerger())
+    assert res is not None
+    want = b"".join(scalar_frames(mixed, LineMerger()))
+    assert res.block.data == want
+
+
+def test_device_gelf_gelf_wide_field_escalation():
+    """9..16-field objects decline the 8-field decode but ride the
+    16-field re-decode through the wide hook."""
+    rows = [
+        (b'{"host":"hw","timestamp":9,'
+         + b",".join(b'"k%02d":%d' % (j, j) for j in range(10))
+         + b',"short_message":"wide"}')
+        for _ in range(12)
+    ]
+    w0 = metrics.get("device_encode_wide_batches")
+    n0 = metrics.get("device_encode_rows")
+    res, _ = run_device(rows, LineMerger())
+    assert res is not None
+    assert metrics.get("device_encode_wide_batches") - w0 == 1
+    assert metrics.get("device_encode_rows") - n0 == len(rows)
+    assert res.block.data == b"".join(scalar_frames(rows, LineMerger()))
+
+
+def test_device_gelf_gelf_fuzz_vs_scalar(monkeypatch):
+    monkeypatch.setattr(device_gelf_gelf, "FALLBACK_FRAC", 1.1)
+    rng = random.Random(29)
+    keys = ["k", "_k", "key2", "_key2", "a_b", "x" * 9, "x" * 9 + "y",
+            "zeta", "alpha"]
+    vals = ['"v"', '"trail  "', '""', "true", "false", "null", "0",
+            "-7", "123456", '"longer value here"', "1.5", "007"]
+    lines = []
+    for i in range(200):
+        parts = [f'"host":"h{i % 7}"', f'"timestamp":{i}.{i % 100:02d}']
+        if rng.random() < 0.5:
+            parts.append(f'"short_message":"m {i}"')
+        if rng.random() < 0.2:
+            parts.append(f'"full_message":"f {i}"')
+        if rng.random() < 0.3:
+            parts.append(f'"level":{rng.randrange(0, 8)}')
+        if rng.random() < 0.3:
+            parts.append('"version":"1.1"')
+        used = set()
+        for _ in range(rng.randrange(0, 4)):
+            k = rng.choice(keys)
+            if k in used:
+                continue
+            used.add(k)
+            parts.append(f'"{k}":{rng.choice(vals)}')
+        rng.shuffle(parts)
+        sep = " , " if rng.random() < 0.1 else ","
+        lines.append(("{" + sep.join(parts) + "}").encode())
+    for merger in (LineMerger(), NulMerger(), SyslenMerger()):
+        res, _ = run_device(lines, merger)
+        assert res is not None
+        want = b"".join(scalar_frames(lines, merger))
+        assert res.block.data == want

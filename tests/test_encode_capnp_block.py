@@ -127,3 +127,109 @@ def test_batch_handler_capnp_block_route():
         item = tx.get_nowait()
         data += item.data if isinstance(item, EncodedBlock) else item
     assert data == b"".join(scalar_frames(CLEAN * 2, NulMerger()))
+
+
+# ---- rfc3164 / ltsv → capnp (round 5: the generalized core) ---------------
+
+def _scalar_frames_for(decoder, lines, merger, enc=ENC):
+    out = []
+    for ln in lines:
+        try:
+            rec = decoder.decode(ln.decode("utf-8"))
+        except (DecodeError, UnicodeDecodeError):
+            continue
+        payload = enc.encode(rec)
+        out.append(merger.frame(payload) if merger is not None else payload)
+    return out
+
+
+@pytest.mark.parametrize("merger", [LineMerger(), NulMerger(),
+                                    SyslenMerger()],
+                         ids=["line", "nul", "syslen"])
+def test_capnp_block_rfc3164(merger):
+    from flowgger_tpu.decoders.rfc3164 import RFC3164Decoder
+
+    dec = RFC3164Decoder()
+    lines = [
+        b"<34>Oct 11 22:14:15 mymachine su: 'su root' failed for lonvick",
+        b"Oct 11 22:14:15 host app[42]: no pri here",
+        b"<13>Sep  7 01:02:03 h short",
+        b"<191>Dec 31 23:59:59 edge msg with  spaces",
+    ]
+    packed = pack.pack_lines_2d(lines * 3, 256)
+    handle = block_submit("rfc3164", packed)
+    res, _, _ = block_fetch_encode("rfc3164", handle, packed, ENC, merger)
+    assert res is not None
+    want = b"".join(_scalar_frames_for(dec, lines * 3, merger))
+    assert res.block.data == want
+
+
+@pytest.mark.parametrize("merger", [LineMerger(), NulMerger(),
+                                    SyslenMerger()],
+                         ids=["line", "nul", "syslen"])
+def test_capnp_block_ltsv(merger):
+    from flowgger_tpu.decoders.ltsv import LTSVDecoder
+    from flowgger_tpu.tpu.encode_capnp_block import encode_ltsv_capnp_block
+
+    dec = LTSVDecoder(Config.from_string(""))
+    lines = [
+        b"time:2023-09-20T12:35:45.123Z\thost:web1\tstatus:200\t"
+        b"path:/api/x\tmessage:request served",
+        b"host:db2\ttime:2023-09-20T12:35:45Z\tuser:alice\tlevel:3\t"
+        b"message:login ok",
+        # unix-literal stamp rides the split-integer parse
+        b"time:1511963055.637824\thost:h3\tmessage:micros\tk:v",
+        # 19-digit stamp: per-row float fallback inside the tier
+        b"time:1511963055.123456789\thost:h4\tmessage:nanos",
+        # signed stamps: ts_meta bit 16 is "has sign CHAR", so these
+        # must take the per-row parse (a '+' stamp once came out negated)
+        b"time:+1511963055.5\thost:h5\tmessage:plus signed",
+        b"time:-12.25\thost:h6\tmessage:minus signed",
+        # no message key, no pairs
+        b"time:2023-09-20T12:35:47Z\thost:h9",
+        # empty value pair
+        b"time:2023-09-20T12:35:47Z\thost:h9\tempty:\tmessage:m",
+    ]
+    packed = pack.pack_lines_2d(lines * 3, 256)
+    handle = block_submit("ltsv", packed)
+    res, _, _ = block_fetch_encode("ltsv", handle, packed, ENC, merger,
+                                   dec)
+    assert res is not None
+    want = b"".join(_scalar_frames_for(dec, lines * 3, merger))
+    assert res.block.data == want
+
+    # typed schema gates the route (Record path)
+    tdec = LTSVDecoder(Config.from_string(
+        '[input.ltsv_schema]\nstatus = "u64"\n'))
+    assert encode_ltsv_capnp_block(
+        packed[2], packed[3], packed[4], {}, 0, 256, ENC, merger,
+        decoder=tdec) is None
+
+
+def test_capnp_block_ltsv_fallback_and_roundtrip():
+    from flowgger_tpu.decoders.ltsv import LTSVDecoder
+
+    dec = LTSVDecoder(Config.from_string(""))
+    mixed = [
+        b"time:2023-09-20T12:35:45Z\thost:h\tk:v\tmessage:m",
+        # repeated special name: oracle parity
+        b"time:2023-09-20T12:35:45Z\thost:a\thost:b\tmessage:rep",
+        # colon-less part: scalar path notice
+        b"time:2023-09-20T12:35:45Z\thost:h\tnovalue\tmessage:m",
+        # non-ascii: off tier
+        "time:2023-09-20T12:35:45Z\thost:hé\tmessage:acc".encode(),
+        # apache-english stamp: decode fallback, oracle
+        b"time:[20/Sep/2023:12:35:45 +0000]\thost:h\tmessage:m",
+    ]
+    packed = pack.pack_lines_2d(mixed, 256)
+    handle = block_submit("ltsv", packed)
+    res, _, _ = block_fetch_encode("ltsv", handle, packed, ENC,
+                                   LineMerger(), dec)
+    assert res is not None
+    want = b"".join(_scalar_frames_for(dec, mixed, LineMerger()))
+    assert res.block.data == want
+    # every emitted record parses back through the capnp reader
+    for a, b in zip(res.block.bounds[:-1], res.block.bounds[1:]):
+        rec_bytes = bytes(res.block.data[a:b - 1])  # strip \n
+        r = capnp_wire.parse_message(rec_bytes)
+        assert r.get_hostname() is not None
