@@ -533,6 +533,15 @@ def main():
 
     if cpu_fallback:
         jax.config.update("jax_platforms", "cpu")
+    # persistent compilation cache: a successful remote compile (the
+    # relay's weak point) becomes a one-time cost across sessions
+    cache_dir = os.environ.get(
+        "FLOWGGER_JAX_CACHE", os.path.expanduser("~/.cache/flowgger_jax"))
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+    except Exception:
+        pass
     import jax.numpy as jnp
 
     from flowgger_tpu.tpu import pack, rfc5424

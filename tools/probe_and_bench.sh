@@ -3,6 +3,9 @@
 # atomically (ADVICE r4: never leave a truncated BENCH_live file behind).
 cd "$(dirname "$0")/.."
 if timeout 240 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+    echo "relay UP — trying the Pallas AOT artifact first (cheap, cacheable)"
+    timeout 600 python tools/pallas_aot.py run > /tmp/pallas_aot.log 2>&1
+    echo "pallas_aot rc=$? (see /tmp/pallas_aot.log)"
     echo "relay UP — running live bench"
     # stage next to the destination so the mv is an atomic rename even
     # when /tmp is a different filesystem (tmpfs)
