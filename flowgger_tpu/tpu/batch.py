@@ -390,6 +390,8 @@ class BatchHandler(Handler):
                 # syslog->syslog relay re-encode; the prepend-timestamp
                 # option is wall-clock-at-encode-time (per-call)
                 return self.encoder.header_time_format is None
+            if type(self.encoder) in (LTSVEncoder, RFC5424Encoder):
+                return True
             if type(self.encoder) is GelfEncoder:
                 from .encode_rfc3164_gelf_block import (
                     gelf_extra_consts_3164,
@@ -399,8 +401,11 @@ class BatchHandler(Handler):
                     self.encoder.extra) is not None
             return self._passthrough_ok
         if self.fmt == "ltsv":
-            # LTSV decode block-encodes GELF and capnp; typed-schema
-            # support (and its per-row fallbacks) lives in the encoders
+            # LTSV decode block-encodes GELF, LTSV (self re-encode),
+            # and capnp; typed-schema support (and its per-row
+            # fallbacks) lives in the encoders
+            if type(self.encoder) is LTSVEncoder:
+                return not getattr(self.scalar.decoder, "schema", None)
             if type(self.encoder) is not GelfEncoder:
                 return False
             from .encode_ltsv_gelf_block import gelf_extra_consts_ltsv
@@ -449,6 +454,10 @@ class BatchHandler(Handler):
                 # the only capnp blocker on the ltsv route
                 return "input.ltsv_schema is set"
             return no_columnar
+        from ..encoders.ltsv import LTSVEncoder
+
+        if t is LTSVEncoder and self.fmt == "ltsv":
+            return "input.ltsv_schema is set"
         if t is GelfEncoder:
             # GELF output is columnar for every kernel format, so the
             # only possible blockers are the extras / the auto schema
@@ -654,8 +663,10 @@ def block_fetch_encode(fmt, handle, packed, encoder, merger,
             encode_passthrough_block,
             encode_rfc3164_3164_block,
             encode_rfc3164_gelf_block,
+            encode_rfc5424_block,
             rfc3164,
         )
+        from ..encoders.rfc5424 import RFC5424Encoder
 
         if device_rfc3164.route_ok(encoder, merger):
             res, fetch_s = device_rfc3164.fetch_encode(
@@ -669,7 +680,8 @@ def block_fetch_encode(fmt, handle, packed, encoder, merger,
         host_out = rfc3164.decode_rfc3164_fetch(handle)
         t1 = _time.perf_counter()
         from ..encoders.capnp import CapnpEncoder
-        from . import encode_capnp_block
+        from ..encoders.ltsv import LTSVEncoder
+        from . import encode_capnp_block, encode_ltsv_block
 
         fn3164 = {
             PassthroughEncoder:
@@ -678,6 +690,10 @@ def block_fetch_encode(fmt, handle, packed, encoder, merger,
                 encode_rfc3164_3164_block.encode_rfc3164_3164_block,
             CapnpEncoder:
                 encode_capnp_block.encode_rfc3164_capnp_block,
+            LTSVEncoder:
+                encode_ltsv_block.encode_rfc3164_ltsv_block,
+            RFC5424Encoder:
+                encode_rfc5424_block.encode_rfc3164_rfc5424_block,
         }.get(type(encoder),
               encode_rfc3164_gelf_block.encode_rfc3164_gelf_block)
         res = fn3164(
@@ -699,11 +715,18 @@ def block_fetch_encode(fmt, handle, packed, encoder, merger,
         host_out = ltsv.decode_ltsv_fetch(handle)
         t1 = _time.perf_counter()
         from ..encoders.capnp import CapnpEncoder
+        from ..encoders.ltsv import LTSVEncoder
 
         if type(encoder) is CapnpEncoder:
             from . import encode_capnp_block
 
             res = encode_capnp_block.encode_ltsv_capnp_block(
+                packed[2], packed[3], packed[4], host_out, packed[5],
+                packed[0].shape[1], encoder, merger, ltsv_decoder)
+        elif type(encoder) is LTSVEncoder:
+            from . import encode_ltsv_block
+
+            res = encode_ltsv_block.encode_ltsv_ltsv_block(
                 packed[2], packed[3], packed[4], host_out, packed[5],
                 packed[0].shape[1], encoder, merger, ltsv_decoder)
         else:

@@ -27,21 +27,102 @@ from .assemble import (
 from .materialize import _scalar_line, compute_ts
 
 
-def ts_scratch(out, n: int, ridx: np.ndarray, fmt_fn):
-    """Deduplicated formatted timestamps for the tier rows: repetitive
-    streams share few distinct stamps, and ``fmt_fn`` (json_f64,
-    display_f64, unix_to_rfc3339_ms...) is the only per-value Python.
-    Returns (scratch bytes, per-row offsets, per-row lengths)."""
-    ts = compute_ts({k: np.asarray(v)[:n][ridx]
-                     for k, v in out.items()
-                     if k in ("days", "sod", "off", "nanos")})
-    uniq, inv = np.unique(ts, return_inverse=True)
+def vals_scratch(vals: np.ndarray, fmt_fn):
+    """Deduplicated formatted values: repetitive streams share few
+    distinct stamps, and ``fmt_fn`` (json_f64, display_f64,
+    unix_to_rfc3339_ms...) is the only per-value Python.  Returns
+    (scratch bytes, per-row offsets, per-row lengths)."""
+    uniq, inv = np.unique(vals, return_inverse=True)
     strs = [fmt_fn(float(u)).encode("ascii") for u in uniq]
     scratch = b"".join(strs)
     ulen = np.fromiter((len(s) for s in strs), dtype=np.int64,
                        count=len(strs))
     uoff = exclusive_cumsum(ulen)[:-1]
     return scratch, uoff[inv], ulen[inv]
+
+
+def ts_scratch(out, n: int, ridx: np.ndarray, fmt_fn):
+    """vals_scratch over the calendar-channel timestamps."""
+    ts = compute_ts({k: np.asarray(v)[:n][ridx]
+                     for k, v in out.items()
+                     if k in ("days", "sod", "off", "nanos")})
+    return vals_scratch(ts, fmt_fn)
+
+
+def ltsv_extra_blob(extra) -> bytes:
+    """Pre-rendered ``ltsv_extra`` pairs, escaped once per config the
+    way _LTSVString.insert does (strip leading '_', tab/newline→space,
+    ':'→'_' in keys), each pair tab-terminated."""
+    parts = []
+    for k, v in extra:
+        k = k[1:] if k.startswith("_") else k
+        k = k.replace("\n", " ").replace("\t", " ").replace(":", "_")
+        v = v.replace("\t", " ").replace("\n", " ")
+        parts.append(f"{k}:{v}\t".encode("utf-8"))
+    return b"".join(parts)
+
+
+def ltsv_special_screen(chunk_arr: np.ndarray, starts64: np.ndarray,
+                        part_start: np.ndarray, nlen: np.ndarray,
+                        jmask: np.ndarray):
+    """LTSV special-key routing shared by the GELF/capnp/LTSV blocks:
+    specials match by NAME (the kernel's *_pos channels only catch the
+    last occurrence, but the scalar decoder routes every occurrence of
+    a repeated special), so the blocks screen by the first 8 key bytes.
+    Returns (special_name [n, P] mask, uniq_ok [n] — False where a
+    special name repeats and the row must take the oracle)."""
+    n, P = part_start.shape
+    key8 = (starts64[:, None, None] + part_start[:, :, None]
+            + np.arange(8, dtype=np.int64)[None, None, :])
+    km = chunk_arr[np.clip(key8, 0, max(chunk_arr.size - 1, 0))] \
+        if chunk_arr.size else np.zeros((n, P, 8), dtype=np.uint8)
+    special_name = np.zeros((n, P), dtype=bool)
+    uniq_ok = np.ones(n, dtype=bool)
+    for word in (b"time", b"host", b"message", b"level"):
+        match = jmask & (nlen == len(word))
+        for i, ch in enumerate(word[:8]):
+            match &= km[:, :, i] == ch
+        special_name |= match
+        uniq_ok &= match.sum(axis=1) <= 1
+    return special_name, uniq_ok
+
+
+def ltsv_ts_vals(out, n: int, ridx: np.ndarray, chunk_bytes: bytes,
+                 starts64: np.ndarray) -> np.ndarray:
+    """Per-row f64 timestamps for ltsv tier rows: rfc3339 rows combine
+    the calendar channels; unix-literal rows combine the kernel's exact
+    split-integer parse (ts_hi * 1e9 + ts_lo over 10**frac, correctly
+    rounded within 2**53); signed or 17+-digit stamps take an exact
+    per-row ``float(span)`` (ts_meta bit 16 is "has a sign CHARACTER",
+    not "negative")."""
+    kind = np.asarray(out["ts_kind"])[:n][ridx]
+    ts = compute_ts({k: np.where(kind == 0, np.asarray(v)[:n][ridx], 0)
+                     for k, v in out.items()
+                     if k in ("days", "sod", "off", "nanos")})
+    fl = np.flatnonzero(kind == 1)
+    if fl.size:
+        hi = np.asarray(out["ts_hi"])[:n][ridx][fl].astype(np.float64)
+        lo = np.asarray(out["ts_lo"])[:n][ridx][fl].astype(np.float64)
+        meta = np.asarray(out["ts_meta"])[:n][ridx][fl].astype(np.int64)
+        frac = meta & 255
+        ndig = (meta >> 8) & 255
+        signed = ((meta >> 16) & 1) == 1
+        fv = (hi * 1e9 + lo) / np.power(10.0, frac)
+        wide = np.flatnonzero(
+            signed | (ndig > 16)
+            | ((ndig == 16)
+               & ((hi > 9007199.0)
+                  | ((hi == 9007199.0) & (lo > 254740992.0)))))
+        if wide.size:
+            st_fl = starts64[ridx][fl]
+            tsa = (st_fl + np.asarray(out["ts_start"])[:n][ridx][fl]
+                   ).astype(np.int64)
+            tsb = (st_fl + np.asarray(out["ts_end"])[:n][ridx][fl]
+                   ).astype(np.int64)
+            for w in wide.tolist():
+                fv[w] = float(chunk_bytes[tsa[w]:tsb[w]])
+        ts[fl] = fv
+    return ts
 
 
 def sorted_pair_order(chunk_arr: np.ndarray, rop: np.ndarray,

@@ -509,20 +509,14 @@ def encode_ltsv_capnp_block(
     cand &= ~(jmask & (colon_pos < 0)).any(axis=1)
 
     chunk_arr = np.frombuffer(chunk_bytes, dtype=np.uint8)
-    # specials route by NAME (every occurrence), and repeated special
-    # names drop to the oracle — exactly the GELF block's screen
+    # specials route by NAME (every occurrence), repeated names drop to
+    # the oracle — shared screen (block_common.ltsv_special_screen)
+    from .block_common import ltsv_special_screen
+
     nlen = np.where(jmask, colon_pos - part_start, 0)
-    key8 = (starts64[:, None, None] + part_start[:, :, None]
-            + np.arange(8, dtype=np.int64)[None, None, :])
-    km = chunk_arr[np.clip(key8, 0, max(chunk_arr.size - 1, 0))] \
-        if chunk_arr.size else np.zeros((n, P, 8), dtype=np.uint8)
-    special_name = np.zeros((n, P), dtype=bool)
-    for word in (b"time", b"host", b"message", b"level"):
-        match = jmask & (nlen == len(word))
-        for i, ch in enumerate(word[:8]):
-            match &= km[:, :, i] == ch
-        special_name |= match
-        cand &= match.sum(axis=1) <= 1
+    special_name, uniq_ok = ltsv_special_screen(
+        chunk_arr, starts64, part_start, nlen, jmask)
+    cand &= uniq_ok
 
     ridx = np.flatnonzero(cand)
     st = starts64[ridx]
@@ -542,38 +536,11 @@ def encode_ltsv_capnp_block(
     fac = np.full(R, FACILITY_MISSING, dtype=np.int64)
     sev = np.where(level >= 0, level, SEVERITY_MISSING)
 
-    # timestamps: rfc3339 rows from the calendar channels; float rows
-    # from the exact split-integer parse (vectorized), with a per-row
-    # float(span) only for stamps past f64's exact-integer range
-    kind = ts_kind[ridx]
-    ts = compute_ts({k: np.where(kind == 0, np.asarray(v)[:n][ridx], 0)
-                     for k, v in out.items()
-                     if k in ("days", "sod", "off", "nanos")})
-    fl = np.flatnonzero(kind == 1)
-    if fl.size:
-        hi = np.asarray(out["ts_hi"])[:n][ridx][fl].astype(np.float64)
-        lo = np.asarray(out["ts_lo"])[:n][ridx][fl].astype(np.float64)
-        meta = np.asarray(out["ts_meta"])[:n][ridx][fl].astype(np.int64)
-        frac = meta & 255
-        ndig = (meta >> 8) & 255
-        # ts_meta bit 16 means "has a sign CHARACTER" ('+' or '-'), not
-        # "negative" (ltsv.py packs has_sign) — signed stamps take the
-        # exact per-row float(span) below rather than guessing the sign
-        signed = ((meta >> 16) & 1) == 1
-        fv = (hi * 1e9 + lo) / np.power(10.0, frac)
-        wide = np.flatnonzero(
-            signed | (ndig > 16)
-            | ((ndig == 16)
-               & ((hi > 9007199.0)
-                  | ((hi == 9007199.0) & (lo > 254740992.0)))))
-        if wide.size:
-            tsa = (st[fl] + np.asarray(out["ts_start"])[:n][ridx][fl]
-                   ).astype(np.int64)
-            tsb = (st[fl] + np.asarray(out["ts_end"])[:n][ridx][fl]
-                   ).astype(np.int64)
-            for w in wide.tolist():
-                fv[w] = float(chunk_bytes[tsa[w]:tsb[w]])
-        ts[fl] = fv
+    # timestamps: rfc3339 / split-integer / per-row-exact, shared with
+    # the LTSV self-encode block (block_common.ltsv_ts_vals)
+    from .block_common import ltsv_ts_vals
+
+    ts = ltsv_ts_vals(out, n, ridx, chunk_bytes, starts64)
 
     # pairs: non-special parts in part order, "_"-prefixed string values
     is_pair = jmask[ridx] & ~special_name[ridx]

@@ -157,23 +157,15 @@ def encode_ltsv_gelf_block(
 
     chunk_arr = np.frombuffer(chunk_bytes, dtype=np.uint8)
 
-    # pair table: parts whose key NAME is not one of the special keys.
-    # Matching by the kernel's special positions would only catch the
-    # last occurrence; the scalar decoder routes every occurrence of a
-    # repeated special key (later assignments overwrite), and errors if
-    # any occurrence fails to parse — so name-match here, and drop rows
-    # with repeated special names to the oracle for exact parity.
-    key8 = (starts64[:, None, None] + part_start[:, :, None]
-            + np.arange(8, dtype=np.int64)[None, None, :])
-    km = chunk_arr[np.clip(key8, 0, max(chunk_arr.size - 1, 0))] \
-        if chunk_arr.size else np.zeros((n, P, 8), dtype=np.uint8)
-    special_name = np.zeros((n, P), dtype=bool)
-    for word in (b"time", b"host", b"message", b"level"):
-        match = jmask & (nlen == len(word))
-        for i, ch in enumerate(word[:8]):
-            match &= km[:, :, i] == ch
-        special_name |= match
-        cand &= match.sum(axis=1) <= 1
+    # pair table: parts whose key NAME is not one of the special keys
+    # (shared screen, block_common.ltsv_special_screen — the kernel's
+    # special positions only catch the LAST occurrence; rows with
+    # repeated special names drop to the oracle for exact parity)
+    from .block_common import ltsv_special_screen
+
+    special_name, uniq_ok = ltsv_special_screen(
+        chunk_arr, starts64, part_start, nlen, jmask)
+    cand &= uniq_ok
     is_pair = jmask & ~special_name & cand[:, None]
 
     pc = is_pair.sum(axis=1).astype(np.int64)

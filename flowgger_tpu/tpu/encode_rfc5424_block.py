@@ -267,3 +267,83 @@ def encode_rfc5424_rfc5424_block(
                         final_buf, row_off, prefix_lens_tier, suffix,
                         syslen, merger, encoder)
 
+
+
+def encode_rfc3164_rfc5424_block(
+    chunk_bytes: bytes,
+    starts: np.ndarray,
+    orig_lens: np.ndarray,
+    out: Dict[str, np.ndarray],
+    n_real: int,
+    max_len: int,
+    encoder,
+    merger: Optional[Merger],
+) -> Optional[BlockResult]:
+    """rfc3164→RFC5424 relay upgrade (rfc5424_encoder.rs:28-93 over the
+    legacy Record shape): PRI digits when the line carried one (else
+    the encoder's <13> default), re-formatted ms-truncated RFC3339
+    stamp, host + message tail spans, and the constant "- - -"
+    proc/msgid/sd slots (appname is absent, so its slot is skipped —
+    exactly the scalar encoder's gating)."""
+    from .encode_ltsv_block import _ltsv_core
+    from .materialize_rfc3164 import _scalar_3164
+
+    spec = merger_suffix(merger)
+    if spec is None:
+        return None
+    suffix, syslen = spec
+
+    n = int(n_real)
+    starts64 = np.asarray(starts[:n], dtype=np.int64)
+    lens64 = np.asarray(orig_lens[:n], dtype=np.int64)
+    ok = np.asarray(out["ok"][:n], dtype=bool)
+    has_high = np.asarray(out["has_high"][:n], dtype=bool)
+    cand = ok & (lens64 <= max_len) & ~has_high
+    ridx = np.flatnonzero(cand)
+    R = ridx.size
+    if not R:
+        return finish_block(chunk_bytes, starts64, lens64, n, cand, ridx,
+                            b"", np.zeros(1, dtype=np.int64), None,
+                            suffix, syslen, merger, encoder,
+                            scalar_fn=_scalar_3164)
+    st = starts64[ridx]
+    host_a = st + np.asarray(out["host_start"])[:n][ridx].astype(np.int64)
+    host_l = (np.asarray(out["host_end"])[:n][ridx].astype(np.int64)
+              - np.asarray(out["host_start"])[:n][ridx].astype(np.int64))
+    msg_a = st + np.asarray(out["msg_start"])[:n][ridx].astype(np.int64)
+    msg_l = np.maximum(st + lens64[ridx] - msg_a, 0)
+    has_pri = np.asarray(out["has_pri"][:n], dtype=bool)[ridx]
+    fac = np.asarray(out["facility"])[:n][ridx].astype(np.int64)
+    sev = np.asarray(out["severity"])[:n][ridx].astype(np.int64)
+    pri = (fac << 3) + sev
+
+    scratch, ts_off, ts_len = ts_scratch(out, n, ridx,
+                                         unix_to_rfc3339_ms)
+    chunk_arr = np.frombuffer(chunk_bytes, dtype=np.uint8)
+    consts, offs = build_source(
+        b"<", b">1 ", b"<13>1 ", b" ", b" - - - ", b"0123456789",
+        suffix, scratch)
+    (o_lt, o_gt1, o_dflt, o_sp, o_tail, o_dec, o_sfx, o_ts) = offs
+    cbase = int(chunk_arr.size)
+    src = np.concatenate([chunk_arr, consts])
+
+    pri_d = decimal_segments(pri, cbase + o_dec, width=3)
+    pc = np.zeros(R, dtype=np.int64)
+    cols = (
+        (np.where(has_pri, cbase + o_lt, 0), np.where(has_pri, 1, 0)),
+        (pri_d[0][0::3], np.where(has_pri, pri_d[1][0::3], 0)),
+        (pri_d[0][1::3], np.where(has_pri, pri_d[1][1::3], 0)),
+        (pri_d[0][2::3], np.where(has_pri, pri_d[1][2::3], 0)),
+        (np.where(has_pri, cbase + o_gt1, cbase + o_dflt),
+         np.where(has_pri, len(b">1 "), len(b"<13>1 "))),
+        (cbase + o_ts + ts_off, ts_len),
+        (cbase + o_sp, 1),
+        (host_a, host_l),
+        (cbase + o_tail, len(b" - - - ")),
+        (msg_a, msg_l),
+        (cbase + o_sfx, len(suffix)),
+    )
+    return _ltsv_core(chunk_bytes, starts64, lens64, n, cand, ridx,
+                      src, cbase, pc, None, 0, 0,
+                      cols, (), suffix, syslen, merger, encoder,
+                      scalar_fn=_scalar_3164)

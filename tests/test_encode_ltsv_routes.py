@@ -1,0 +1,156 @@
+"""Columnar →LTSV routes added in round 5: ltsv→LTSV self re-encode and
+rfc3164→LTSV, byte-identical vs the scalar oracles (ltsv_encoder.rs
+semantics, incl. the tab→space value escape on full_message/message)."""
+
+import pytest
+
+from flowgger_tpu.config import Config
+from flowgger_tpu.decoders import DecodeError
+from flowgger_tpu.decoders.ltsv import LTSVDecoder
+from flowgger_tpu.decoders.rfc3164 import RFC3164Decoder
+from flowgger_tpu.encoders.ltsv import LTSVEncoder
+from flowgger_tpu.mergers import LineMerger, NulMerger, SyslenMerger
+from flowgger_tpu.tpu import pack
+from flowgger_tpu.tpu.batch import block_fetch_encode, block_submit
+
+ENC = LTSVEncoder(Config.from_string(""))
+ENC_EXTRA = LTSVEncoder(Config.from_string(
+    '[output.ltsv_extra]\nsource = "flowgger"\n"bad:key" = "v\tw"\n'))
+
+
+def scalar_frames(decoder, lines, merger, enc=ENC):
+    out = []
+    for ln in lines:
+        try:
+            rec = decoder.decode(ln.decode("utf-8"))
+        except (DecodeError, UnicodeDecodeError):
+            continue
+        payload = enc.encode(rec)
+        out.append(merger.frame(payload) if merger is not None else payload)
+    return out
+
+
+LTSV_LINES = [
+    b"time:2023-09-20T12:35:45.123Z\thost:web1\tstatus:200\t"
+    b"path:/api/x\tmessage:request served",
+    b"host:db2\ttime:2023-09-20T12:35:45Z\tuser:alice\tlevel:3\t"
+    b"message:login ok",
+    # unix-literal stamp re-formats as Rust Display
+    b"time:1511963055.637824\thost:h3\tmessage:micros\tk:v",
+    # no message, no pairs: bare host/time/full_message
+    b"time:2023-09-20T12:35:47Z\thost:h9",
+    # empty value pair + empty message value
+    b"time:2023-09-20T12:35:47Z\thost:h9\tempty:\tmessage:",
+]
+
+
+@pytest.mark.parametrize("merger", [LineMerger(), NulMerger(),
+                                    SyslenMerger()],
+                         ids=["line", "nul", "syslen"])
+def test_ltsv_ltsv_block(merger):
+    dec = LTSVDecoder(Config.from_string(""))
+    packed = pack.pack_lines_2d(LTSV_LINES * 3, 256)
+    handle = block_submit("ltsv", packed)
+    res, _, _ = block_fetch_encode("ltsv", handle, packed, ENC, merger,
+                                   dec)
+    assert res is not None
+    want = b"".join(scalar_frames(dec, LTSV_LINES * 3, merger))
+    assert res.block.data == want
+
+
+def test_ltsv_ltsv_block_extra_and_fallbacks():
+    dec = LTSVDecoder(Config.from_string(""))
+    mixed = LTSV_LINES + [
+        # repeated special name: oracle
+        b"time:2023-09-20T12:35:45Z\thost:a\thost:b\tmessage:rep",
+        # colon-less part: scalar notice
+        b"time:2023-09-20T12:35:45Z\thost:h\tnovalue\tmessage:m",
+        # non-ascii: off tier
+        "time:2023-09-20T12:35:45Z\thost:hé\tmessage:acc".encode(),
+        # apache stamp: decode fallback
+        b"time:[20/Sep/2023:12:35:45 +0000]\thost:h\tmessage:m",
+    ]
+    packed = pack.pack_lines_2d(mixed, 256)
+    handle = block_submit("ltsv", packed)
+    res, _, _ = block_fetch_encode("ltsv", handle, packed, ENC_EXTRA,
+                                   LineMerger(), dec)
+    assert res is not None
+    want = b"".join(scalar_frames(dec, mixed, LineMerger(),
+                                  enc=ENC_EXTRA))
+    assert res.block.data == want
+
+    # typed schema keeps the Record path
+    tdec = LTSVDecoder(Config.from_string(
+        '[input.ltsv_schema]\nstatus = "u64"\n'))
+    from flowgger_tpu.tpu.encode_ltsv_block import encode_ltsv_ltsv_block
+
+    assert encode_ltsv_ltsv_block(
+        packed[2], packed[3], packed[4], {}, 0, 256, ENC, LineMerger(),
+        decoder=tdec) is None
+
+
+@pytest.mark.parametrize("merger", [LineMerger(), NulMerger(),
+                                    SyslenMerger()],
+                         ids=["line", "nul", "syslen"])
+def test_rfc3164_ltsv_block(merger):
+    dec = RFC3164Decoder()
+    lines = [
+        b"<34>Oct 11 22:14:15 mymachine su: 'su root' failed for lonvick",
+        b"Oct 11 22:14:15 host app[42]: no pri here",
+        b"<13>Sep  7 01:02:03 h short",
+        # tabs in the message body: the vectorized value escape
+        b"<191>Dec 31 23:59:59 edge msg\twith\ttabs",
+    ]
+    packed = pack.pack_lines_2d(lines * 3, 256)
+    handle = block_submit("rfc3164", packed)
+    res, _, _ = block_fetch_encode("rfc3164", handle, packed, ENC, merger)
+    assert res is not None
+    want = b"".join(scalar_frames(dec, lines * 3, merger))
+    assert res.block.data == want
+
+
+def test_batch_handler_ltsv_ltsv_route():
+    import queue
+
+    from flowgger_tpu.block import EncodedBlock
+    from flowgger_tpu.tpu.batch import BatchHandler
+
+    dec = LTSVDecoder(Config.from_string(""))
+    tx = queue.Queue()
+    h = BatchHandler(tx, dec, ENC, Config.from_string(""), fmt="ltsv",
+                     start_timer=False, merger=LineMerger())
+    assert h._block_route_ok()
+    for ln in LTSV_LINES * 4:
+        h.handle_bytes(ln)
+    h.flush()
+    data = b""
+    while not tx.empty():
+        item = tx.get_nowait()
+        data += (item.data if isinstance(item, EncodedBlock)
+                 else LineMerger().frame(item))
+    want = b"".join(scalar_frames(dec, LTSV_LINES * 4, LineMerger()))
+    assert data == want
+
+
+@pytest.mark.parametrize("merger", [LineMerger(), NulMerger(),
+                                    SyslenMerger()],
+                         ids=["line", "nul", "syslen"])
+def test_rfc3164_rfc5424_block(merger):
+    """rfc3164→RFC5424 relay upgrade (round 5): PRI carried or
+    defaulted, ms-truncated rfc3339 stamp, '- - -' proc/msgid/sd."""
+    from flowgger_tpu.encoders.rfc5424 import RFC5424Encoder
+
+    enc = RFC5424Encoder(Config.from_string(""))
+    dec = RFC3164Decoder()
+    lines = [
+        b"<34>Oct 11 22:14:15 mymachine su: 'su root' failed for lonvick",
+        b"Oct 11 22:14:15 host app[42]: no pri here",
+        b"<191>Dec 31 23:59:59 edge msg with  spaces",
+        b"<0>Jan  1 00:00:00 z kern",
+    ]
+    packed = pack.pack_lines_2d(lines * 3, 256)
+    handle = block_submit("rfc3164", packed)
+    res, _, _ = block_fetch_encode("rfc3164", handle, packed, enc, merger)
+    assert res is not None
+    want = b"".join(scalar_frames(dec, lines * 3, merger, enc=enc))
+    assert res.block.data == want
