@@ -374,7 +374,7 @@ class BatchHandler(Handler):
         from ..encoders.capnp import CapnpEncoder
 
         if (type(self.encoder) is CapnpEncoder
-                and self.fmt in ("rfc5424", "rfc3164", "ltsv")):
+                and self.fmt in ("rfc5424", "rfc3164", "ltsv", "gelf")):
             # columnar capnp (the reference's default kafka output wire
             # format, mod.rs:104) from every kernel decoder; capnp_extra
             # is a constant blob on this route, so extras stay on the
@@ -412,6 +412,8 @@ class BatchHandler(Handler):
 
             return gelf_extra_consts_ltsv(self.encoder.extra) is not None
         if self.fmt == "gelf":
+            if type(self.encoder) is LTSVEncoder:
+                return True
             return (type(self.encoder) is GelfEncoder
                     and not self.encoder.extra)
         if self.fmt == "auto":
@@ -734,6 +736,7 @@ def block_fetch_encode(fmt, handle, packed, encoder, merger,
                 packed[2], packed[3], packed[4], host_out, packed[5],
                 packed[0].shape[1], encoder, merger, ltsv_decoder)
     elif fmt == "gelf":
+        from ..encoders.ltsv import LTSVEncoder
         from . import device_gelf_gelf, encode_gelf_gelf_block, gelf
 
         if device_gelf_gelf.route_ok(encoder, merger):
@@ -747,9 +750,24 @@ def block_fetch_encode(fmt, handle, packed, encoder, merger,
             t0 = _time.perf_counter()
         host_out = gelf.decode_gelf_fetch(handle)
         t1 = _time.perf_counter()
-        res = encode_gelf_gelf_block.encode_gelf_gelf_block(
-            packed[2], packed[3], packed[4], host_out, packed[5],
-            packed[0].shape[1], encoder, merger)
+        from ..encoders.capnp import CapnpEncoder
+
+        if type(encoder) is LTSVEncoder:
+            from . import encode_ltsv_block
+
+            res = encode_ltsv_block.encode_gelf_ltsv_block(
+                packed[2], packed[3], packed[4], host_out, packed[5],
+                packed[0].shape[1], encoder, merger)
+        elif type(encoder) is CapnpEncoder:
+            from . import encode_capnp_block
+
+            res = encode_capnp_block.encode_gelf_capnp_block(
+                packed[2], packed[3], packed[4], host_out, packed[5],
+                packed[0].shape[1], encoder, merger)
+        else:
+            res = encode_gelf_gelf_block.encode_gelf_gelf_block(
+                packed[2], packed[3], packed[4], host_out, packed[5],
+                packed[0].shape[1], encoder, merger)
     else:
         from . import device_gelf, rfc5424
 

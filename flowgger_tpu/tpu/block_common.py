@@ -87,6 +87,33 @@ def ltsv_special_screen(chunk_arr: np.ndarray, starts64: np.ndarray,
     return special_name, uniq_ok
 
 
+def gelf_sorted_pairs(chunk_arr, starts64, cand, is_pair, kabs, key_e,
+                      vabs_a, vabs_b, val_t, byte_at, cap: int):
+    """Flat pair table in sorted-ORIGINAL-key Record order for the
+    GELF-input routes (materialize_gelf routes sorted(obj.keys())).
+    Duplicate-key rows drop out of ``cand`` IN PLACE (dict last-wins
+    semantics go to the oracle).  Returns (rop_s — ORIGINAL row ids —,
+    ns_s stripped name starts so ``'_' + span`` is the final name,
+    ne_s, pv_t, pv_a, pv_b)."""
+    if not int(is_pair.sum()):
+        z = np.zeros(0, dtype=np.int64)
+        return z, z, z, z.copy(), z, z
+    prow, pcol = np.nonzero(is_pair)
+    rop = prow.astype(np.int64)
+    ns_abs = kabs[prow, pcol]
+    ne_abs = starts64[rop] + key_e[prow, pcol]
+    order, dup_rows = sorted_pair_order(chunk_arr, rop, ns_abs, ne_abs,
+                                        cap)
+    if dup_rows.size:
+        cand[dup_rows] = False
+        order = order[cand[rop[order]]]
+    rop_s = rop[order]
+    has_us = byte_at(ns_abs[order]) == ord("_")
+    return (rop_s, ns_abs[order] + has_us, ne_abs[order],
+            val_t[prow, pcol][order], vabs_a[prow, pcol][order],
+            vabs_b[prow, pcol][order])
+
+
 def ltsv_ts_vals(out, n: int, ridx: np.ndarray, chunk_bytes: bytes,
                  starts64: np.ndarray) -> np.ndarray:
     """Per-row f64 timestamps for ltsv tier rows: rfc3339 rows combine

@@ -116,7 +116,7 @@ def _extra_blob(extra: List[Tuple[str, str]]) -> bytes:
 
 def _capnp_assemble(chunk_bytes, starts64, lens64, n, cand, ridx,
                     texts, sid, pairs, ts, fac, sev, encoder, merger,
-                    suffix, syslen, scalar_fn=None):
+                    suffix, syslen, scalar_fn=None, typed=None):
     """Shared layout + assembly for every format wrapper, over
     ridx-selected [R] arrays.
 
@@ -127,9 +127,12 @@ def _capnp_assemble(chunk_bytes, starts64, lens64, n, cand, ridx,
     skipped set_text → NULL pointer).  ``sid``: ``(a, blen, gate)`` or
     None.  ``pairs``: ``(name_a, name_l, val_a, val_l, pvalid,
     has_sd)`` [R, P] / [R] or None — pair names emit with the ``"_"``
-    prefix and string discriminants.  ``ts``/``fac``/``sev``: [R]
-    float64 / uint8 values (missing already mapped to the *_MISSING
-    sentinels)."""
+    prefix; values are string-discriminant texts unless ``typed``
+    overrides.  ``typed``: optional (d0, d1, val_is_text) [R, P] int64
+    / int64 / bool — data word 0 (discriminant | bool bit 16), data
+    word 1 (f64/i64/u64 bit pattern), and whether the value carries a
+    text (strings only).  ``ts``/``fac``/``sev``: [R] float64 / uint8
+    values (missing already mapped to the *_MISSING sentinels)."""
     R = ridx.size
     final_buf = b""
     row_off = np.zeros(1, dtype=np.int64)
@@ -157,9 +160,14 @@ def _capnp_assemble(chunk_bytes, starts64, lens64, n, cand, ridx,
             P = name_a.shape[1]
             name_l = np.where(pvalid, name_l, 0)
             val_l = np.where(pvalid, val_l, 0)
+            if typed is not None:
+                d0_t, d1_t, val_is_text = typed
+                val_l = np.where(val_is_text, val_l, 0)
+            else:
+                val_is_text = np.ones_like(pvalid)
             k0 = pvalid.sum(axis=1).astype(np.int64)
             key_w = np.where(pvalid, _text_words(name_l + 1), 0)  # "_"+name
-            valw = np.where(pvalid, _text_words(val_l), 0)
+            valw = np.where(pvalid & val_is_text, _text_words(val_l), 0)
             pairs_w = np.where(has_sd, 1 + k0 * _PAIR_WORDS
                                + key_w.sum(axis=1) + valw.sum(axis=1), 0)
         else:
@@ -229,6 +237,9 @@ def _capnp_assemble(chunk_bytes, starts64, lens64, n, cand, ridx,
                 kv_w[:, p, 1] = cursor
                 cursor = cursor + valw[:, p]
             ewords = np.zeros((R, P, _PAIR_WORDS), dtype=np.int64)
+            if typed is not None:
+                ewords[:, :, 0] = np.where(pvalid, d0_t, 0)
+                ewords[:, :, 1] = np.where(pvalid, d1_t, 0)
             for p in range(P):
                 base = w_pairs + 1 + p * _PAIR_WORDS
                 ewords[:, p, 2] = np.where(
@@ -236,7 +247,7 @@ def _capnp_assemble(chunk_bytes, starts64, lens64, n, cand, ridx,
                     _list_ptr_words(base + PAIR_DATA_WORDS, kv_w[:, p, 0],
                                     name_l[:, p] + 2), 0)
                 ewords[:, p, 3] = np.where(
-                    pvalid[:, p],
+                    pvalid[:, p] & val_is_text[:, p],
                     _list_ptr_words(base + PAIR_DATA_WORDS + 1,
                                     kv_w[:, p, 1], val_l[:, p] + 1), 0)
             pscratch[:, 8:] = ewords.astype("<i8").view(np.uint8).reshape(
@@ -575,3 +586,156 @@ def encode_ltsv_capnp_block(
         (name_a, name_l2, val_a, val_l, pvalid, has_sd),
         ts, fac, sev, encoder, merger, suffix, syslen,
         scalar_fn=scalar_fn)
+
+
+def encode_gelf_capnp_block(
+    chunk_bytes: bytes,
+    starts: np.ndarray,
+    orig_lens: np.ndarray,
+    out: Dict[str, np.ndarray],
+    n_real: int,
+    max_len: int,
+    encoder,
+    merger: Optional[Merger],
+):
+    """gelf→capnp: the JSON tokenizer's spans through the decoder-
+    agnostic capnp encoder.  Pairs carry their TYPED discriminants —
+    strings as texts, bools/null as data bits, canonical ints (≤ 18
+    digits) parsed vectorially into i64/u64 words; float pair values
+    (a per-value parse+bit pattern) take the oracle.  Pair order is the
+    Record's: sorted ORIGINAL keys, duplicates → oracle."""
+    from .encode_gelf_gelf_block import _NAME_CAP, gelf_screen
+    from .gelf import VT_FALSE, VT_NULL, VT_NUMBER, VT_STRING, VT_TRUE
+    from .materialize_gelf import _scalar_gelf
+
+    spec = merger_suffix(merger)
+    if spec is None:
+        return None
+    suffix, syslen = spec
+
+    s = gelf_screen(chunk_bytes, starts, orig_lens, out, n_real, max_len)
+    n, starts64, lens64, cand = (s["n"], s["starts64"], s["lens64"],
+                                 s["cand"])
+    chunk_arr, chunk_pad = s["chunk_arr"], s["chunk_pad"]
+    kabs, key_e = s["kabs"], s["key_e"]
+    byte_at, vspan_at = s["byte_at"], s["vspan_at"]
+    is_pair = s["is_pair"] & cand[:, None]
+    vabs_a, vabs_b = s["vabs_a"], s["vabs_b"]
+    val_t = s["val_t"]
+
+    # ---- pair table in ORIGINAL-key sorted order (shared helper;
+    # drops duplicate-key rows from cand) --------------------------------
+    from .block_common import gelf_sorted_pairs
+
+    rop_s, ns_s, ne_s, pv_t, pv_a, pv_b = gelf_sorted_pairs(
+        chunk_arr, starts64, cand, is_pair, kabs, key_e, vabs_a, vabs_b,
+        val_t, byte_at, _NAME_CAP)
+
+    ridx = np.flatnonzero(cand)
+    R = ridx.size
+    if not R:
+        return _capnp_assemble(chunk_bytes, starts64, lens64, n, cand,
+                               ridx, [], None, None, None, None, None,
+                               encoder, merger, suffix, syslen,
+                               scalar_fn=_scalar_gelf)
+
+    # timestamps: per-unique float of the span (dedup dict)
+    tsa = s["tsa_all"][ridx]
+    tsb = s["tsb_all"][ridx]
+    cache = {}
+    ts = np.empty(R, dtype=np.float64)
+    for i, (a, b) in enumerate(zip(tsa.tolist(), tsb.tolist())):
+        key = chunk_bytes[a:b]
+        v = cache.get(key)
+        if v is None:
+            v = float(key)
+            cache[key] = v
+        ts[i] = v
+
+    lv_a, _ = vspan_at(s["lvl_f"])
+    sev = np.where(s["has_lvl"],
+                   chunk_pad[np.asarray(lv_a, dtype=np.int64)] - ord("0"),
+                   SEVERITY_MISSING)[ridx]
+    fac = np.full(R, FACILITY_MISSING, dtype=np.int64)
+
+    # ---- pair slots: [R, P] matrices in sorted order + typed words ------
+    if rop_s.size:
+        # rr maps each pair to its COMPACTED candidate row (slot matrix
+        # space); pc counts in that same space — a fallback row BEFORE
+        # a candidate row must not shift either
+        tpos = np.cumsum(cand) - 1
+        rr = tpos[rop_s]
+        pc = np.bincount(rr, minlength=R).astype(np.int64)
+        P = max(1, int(pc.max(initial=0)))
+        within = np.zeros(rop_s.size, dtype=np.int64)
+        if rop_s.size:
+            new_row = np.ones(rop_s.size, dtype=bool)
+            new_row[1:] = rop_s[1:] != rop_s[:-1]
+            run_starts = np.flatnonzero(new_row)
+            within = (np.arange(rop_s.size)
+                      - np.repeat(run_starts,
+                                  np.diff(np.append(run_starts,
+                                                    rop_s.size))))
+        name_a = np.zeros((R, P), dtype=np.int64)
+        name_l = np.zeros((R, P), dtype=np.int64)
+        val_a = np.zeros((R, P), dtype=np.int64)
+        val_l = np.zeros((R, P), dtype=np.int64)
+        pvalid = np.zeros((R, P), dtype=bool)
+        d0 = np.zeros((R, P), dtype=np.int64)
+        d1 = np.zeros((R, P), dtype=np.int64)
+        vtext = np.zeros((R, P), dtype=bool)
+        # vectorized canonical-int parse: <= 19-byte window incl sign
+        is_num = pv_t == VT_NUMBER
+        neg = chunk_pad[pv_a] == ord("-")
+        wnd = (pv_a[:, None]
+               + np.arange(19, dtype=np.int64)[None, :])
+        wb = chunk_pad[wnd]
+        wlen = pv_b - pv_a
+        in_w = (np.arange(19)[None, :] >= neg[:, None].astype(np.int64)) \
+            & (np.arange(19)[None, :] < wlen[:, None])
+        digs = np.where(in_w, wb - ord("0"), 0).astype(np.int64)
+        # right-align place values: digit at window index i has place
+        # (wlen - 1 - i)
+        place = wlen[:, None] - 1 - np.arange(19)[None, :]
+        mag = (digs * np.where(in_w, 10 ** np.clip(place, 0, 18), 0)
+               ).sum(axis=1)
+        ival = np.where(neg, -mag, mag)
+        disc = np.where(pv_t == VT_STRING, 0,
+                        np.where(pv_t == VT_TRUE, 1 | (1 << 16),
+                                 np.where(pv_t == VT_FALSE, 1,
+                                          np.where(pv_t == VT_NULL, 5,
+                                                   np.where(neg, 3, 4)))))
+        slot = (rr, within)
+        name_a[slot] = ns_s
+        name_l[slot] = ne_s - ns_s
+        val_a[slot] = pv_a
+        val_l[slot] = pv_b - pv_a
+        pvalid[slot] = True
+        d0[slot] = disc
+        d1[slot] = np.where(is_num, ival, 0)
+        vtext[slot] = pv_t == VT_STRING
+        has_sd = pc > 0
+        pairs = (name_a, name_l, val_a, val_l, pvalid, has_sd)
+        typed = (d0, d1, vtext)
+    else:
+        pairs = None
+        typed = None
+
+    zero = np.zeros(R, dtype=np.int64)
+    absent = np.zeros(R, dtype=bool)
+    host_a0, host_b0 = vspan_at(s["host_f"])
+    msg_a0, msg_b0 = vspan_at(s["short_f"])
+    full_a0, full_b0 = vspan_at(s["full_f"])
+    texts = [
+        (host_a0[ridx], (host_b0 - host_a0)[ridx], None),
+        (zero, zero, absent),          # appname
+        (zero, zero, absent),          # procid
+        (zero, zero, absent),          # msgid
+        (msg_a0[ridx], (msg_b0 - msg_a0)[ridx], s["has_short"][ridx]),
+        (full_a0[ridx], (full_b0 - full_a0)[ridx], s["has_full"][ridx]),
+    ]
+    return _capnp_assemble(
+        chunk_bytes, starts64, lens64, n, cand, ridx, texts,
+        (zero, zero, np.zeros(R, dtype=bool)),   # sd_id is None for gelf
+        pairs, ts, fac, sev, encoder, merger, suffix, syslen,
+        scalar_fn=_scalar_gelf, typed=typed)

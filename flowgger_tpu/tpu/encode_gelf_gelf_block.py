@@ -56,20 +56,15 @@ _KEYW = 16  # special names are <= 13 bytes
 _TSW = 24   # timestamp spans longer than this take the oracle
 
 
-def encode_gelf_gelf_block(
-    chunk_bytes: bytes,
-    starts: np.ndarray,
-    orig_lens: np.ndarray,
-    out: Dict[str, np.ndarray],
-    n_real: int,
-    max_len: int,
-    encoder,
-    merger: Optional[Merger],
-) -> Optional[BlockResult]:
-    spec = merger_suffix(merger)
-    if spec is None or encoder.extra:
-        return None
-    suffix, syslen = spec
+def gelf_screen(chunk_bytes, starts, orig_lens, out, n_real: int,
+                max_len: int):
+    """Shared GELF-input route screen (gelf→GELF / gelf→LTSV): row byte
+    screens, special-key routing via packed 8-byte words, per-special
+    validation (timestamp canonicality, version literals, bare-digit
+    level, clean host/short/full strings), and the pair value classes
+    every text re-emission route accepts (clean strings, bools, null,
+    canonical ints ≤ 18 digits).  Returns a dict of the candidate mask
+    plus every span/field the routes assemble from."""
 
     n = int(n_real)
     starts64 = np.asarray(starts[:n], dtype=np.int64)
@@ -241,6 +236,52 @@ def encode_gelf_gelf_block(
         | (val_t == VT_FALSE) | (val_t == VT_NULL) | int_ok
     cand &= (~is_pair | pair_ok).all(axis=1)
     cand &= np.where(jmask, klen, 0).max(axis=1, initial=0) <= _NAME_CAP
+
+    return dict(n=n, starts64=starts64, lens64=lens64, cand=cand,
+                chunk_arr=chunk_arr, chunk_pad=chunk_pad, kabs=kabs,
+                klen=klen, key_e=key_e, val_s=val_s, val_e=val_e,
+                val_t=val_t, val_esc=val_esc, jmask=jmask,
+                vabs_a=vabs_a, vabs_b=vabs_b,
+                is_pair=is_pair, is_special=is_special,
+                byte_at=byte_at, vt_at=vt_at, vspan_at=vspan_at,
+                vesc_at=vesc_at,
+                has_ts=has_ts, ts_f=ts_f, tsa_all=tsa_all,
+                tsb_all=tsb_all,
+                has_host=has_host, host_f=host_f,
+                has_short=has_short, short_f=short_f,
+                has_full=has_full, full_f=full_f,
+                has_lvl=has_lvl, lvl_f=lvl_f)
+
+
+def encode_gelf_gelf_block(
+    chunk_bytes: bytes,
+    starts: np.ndarray,
+    orig_lens: np.ndarray,
+    out: Dict[str, np.ndarray],
+    n_real: int,
+    max_len: int,
+    encoder,
+    merger: Optional[Merger],
+) -> Optional[BlockResult]:
+    spec = merger_suffix(merger)
+    if spec is None or encoder.extra:
+        return None
+    suffix, syslen = spec
+
+    s = gelf_screen(chunk_bytes, starts, orig_lens, out, n_real, max_len)
+    (n, starts64, lens64, cand, chunk_arr, kabs, klen, key_e, val_s,
+     val_e, val_t, jmask, is_pair, byte_at, vt_at, vspan_at) = (
+        s["n"], s["starts64"], s["lens64"], s["cand"], s["chunk_arr"],
+        s["kabs"], s["klen"], s["key_e"], s["val_s"], s["val_e"],
+        s["val_t"], s["jmask"], s["is_pair"], s["byte_at"], s["vt_at"],
+        s["vspan_at"])
+    has_ts, ts_f = s["has_ts"], s["ts_f"]
+    tsa_all, tsb_all = s["tsa_all"], s["tsb_all"]
+    has_host, host_f = s["has_host"], s["host_f"]
+    has_short, short_f = s["has_short"], s["short_f"]
+    has_full, full_f = s["has_full"], s["full_f"]
+    has_lvl, lvl_f = s["has_lvl"], s["lvl_f"]
+    vabs_a, vabs_b = s["vabs_a"], s["vabs_b"]
 
     # ---- sorted pair table (by FINAL name: leading '_' stripped) ---------
     is_pair = is_pair & cand[:, None]

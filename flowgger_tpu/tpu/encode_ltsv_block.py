@@ -471,3 +471,142 @@ def encode_rfc3164_ltsv_block(
                       src, cbase, pc, None, o_col, o_tab,
                       cols, (), suffix, syslen, merger, encoder,
                       scalar_fn=_scalar_3164)
+
+
+def encode_gelf_ltsv_block(
+    chunk_bytes: bytes,
+    starts: np.ndarray,
+    orig_lens: np.ndarray,
+    out: Dict[str, np.ndarray],
+    n_real: int,
+    max_len: int,
+    encoder,
+    merger: Optional[Merger],
+) -> Optional[BlockResult]:
+    """gelf→LTSV: the JSON tokenizer's spans through ltsv_encoder
+    semantics.  Pairs emit in the Record's construction order — sorted
+    by ORIGINAL key (materialize_gelf routes sorted(obj.keys()); the
+    GELF re-encode sorts by final name instead) — with the leading
+    ``_`` stripped back off; clean strings / canonical ints re-emit
+    verbatim, true/false/null are constants, and the timestamp
+    re-formats as Rust Display through the dedup scratch.  Duplicate
+    keys (dict last-wins), floats, and escaped strings take the
+    oracle."""
+    from ..utils.rustfmt import display_f64
+    from .encode_gelf_gelf_block import _NAME_CAP, gelf_screen
+    from .gelf import VT_FALSE, VT_NULL, VT_NUMBER, VT_STRING, VT_TRUE
+    from .materialize_gelf import _scalar_gelf
+
+    spec = merger_suffix(merger)
+    if spec is None:
+        return None
+    suffix, syslen = spec
+
+    s = gelf_screen(chunk_bytes, starts, orig_lens, out, n_real, max_len)
+    n, starts64, lens64, cand = (s["n"], s["starts64"], s["lens64"],
+                                 s["cand"])
+    chunk_arr, kabs, key_e = s["chunk_arr"], s["kabs"], s["key_e"]
+    byte_at, vt_at, vspan_at = s["byte_at"], s["vt_at"], s["vspan_at"]
+    is_pair = s["is_pair"] & cand[:, None]
+    vabs_a, vabs_b = s["vabs_a"], s["vabs_b"]
+    val_t = s["val_t"]
+
+    # ---- pair table in ORIGINAL-key sorted order (shared helper;
+    # drops duplicate-key rows from cand) --------------------------------
+    from .block_common import gelf_sorted_pairs
+
+    rop_s, ns_s, ne_s, pv_t, pv_a, pv_b = gelf_sorted_pairs(
+        chunk_arr, starts64, cand, is_pair, kabs, key_e, vabs_a, vabs_b,
+        val_t, byte_at, _NAME_CAP)
+
+    ridx = np.flatnonzero(cand)
+    R = ridx.size
+    if not R:
+        return finish_block(chunk_bytes, starts64, lens64, n, cand, ridx,
+                            b"", np.zeros(1, dtype=np.int64), None,
+                            suffix, syslen, merger, encoder,
+                            scalar_fn=_scalar_gelf)
+
+    # timestamps: dedupe span texts, per-unique float + Display
+    tsa = s["tsa_all"][ridx]
+    tsb = s["tsb_all"][ridx]
+    cache = {}
+    pieces = []
+    pos = 0
+    ts_off = np.empty(R, dtype=np.int64)
+    ts_len = np.empty(R, dtype=np.int64)
+    for i, (a, b) in enumerate(zip(tsa.tolist(), tsb.tolist())):
+        key = chunk_bytes[a:b]
+        hit = cache.get(key)
+        if hit is None:
+            txt = display_f64(float(key)).encode("ascii")
+            hit = (pos, len(txt))
+            cache[key] = hit
+            pieces.append(txt)
+            pos += len(txt)
+        ts_off[i] = hit[0]
+        ts_len[i] = hit[1]
+    scratch = b"".join(pieces)
+
+    extra_blob = ltsv_extra_blob(encoder.extra)
+    consts, offs = build_source(
+        b":", b"\t", b"host:", b"\ttime:", b"\tmessage:",
+        b"\tfull_message:", b"\tlevel:", b"true", b"false",
+        suffix, extra_blob, scratch)
+    (o_col, o_tab, o_host, o_time, o_msg, o_full, o_lvl, o_true,
+     o_false, o_sfx, o_extra, o_ts) = offs
+    cbase = int(chunk_arr.size)
+    src = np.concatenate([chunk_arr, consts])
+
+    # pair values: verbatim spans for strings/ints, consts for literals.
+    # pc counts in ORIGINAL row space then selects the candidate rows —
+    # rop_s carries original row ids (a fallback row BEFORE a candidate
+    # row must not shift the counts).
+    if rop_s.size:
+        is_txt = (pv_t == VT_STRING) | (pv_t == VT_NUMBER)
+        vs_r = np.where(is_txt, pv_a,
+                        np.where(pv_t == VT_TRUE, cbase + o_true,
+                                 np.where(pv_t == VT_FALSE,
+                                          cbase + o_false, 0)))
+        vln = np.where(is_txt, pv_b - pv_a,
+                       np.where(pv_t == VT_TRUE, 4,
+                                np.where(pv_t == VT_FALSE, 5, 0)))
+        pair_flat = (ns_s, ne_s, vs_r, vs_r + vln)
+        pc = np.bincount(rop_s, minlength=n)[ridx].astype(np.int64)
+    else:
+        pair_flat = None
+        pc = np.zeros(R, dtype=np.int64)
+
+    host_a, host_b = vspan_at(s["host_f"])
+    host_a, host_l = host_a[ridx], (host_b - host_a)[ridx]
+    sh_a, sh_b = vspan_at(s["short_f"])
+    msg_a, msg_l = sh_a[ridx], (sh_b - sh_a)[ridx]
+    has_msg = s["has_short"][ridx]
+    fm_a, fm_b = vspan_at(s["full_f"])
+    full_a, full_l = fm_a[ridx], (fm_b - fm_a)[ridx]
+    has_full = s["has_full"][ridx]
+    lv_a, _lv_b = vspan_at(s["lvl_f"])
+    lv_a = lv_a[ridx]
+    has_lvl = s["has_lvl"][ridx]
+
+    cols = (
+        (cbase + o_extra, len(extra_blob)),
+        (cbase + o_host, len(b"host:")),
+        (host_a, host_l),
+        (cbase + o_time, len(b"\ttime:")),
+        (cbase + o_ts + ts_off, ts_len),
+        (np.where(has_msg, cbase + o_msg, 0),
+         np.where(has_msg, len(b"\tmessage:"), 0)),
+        (msg_a, np.where(has_msg, msg_l, 0)),
+        (np.where(has_full, cbase + o_full, 0),
+         np.where(has_full, len(b"\tfull_message:"), 0)),
+        (full_a, np.where(has_full, full_l, 0)),
+        (np.where(has_lvl, cbase + o_lvl, 0),
+         np.where(has_lvl, len(b"\tlevel:"), 0)),
+        (lv_a, np.where(has_lvl, 1, 0)),
+        (cbase + o_sfx, len(suffix)),
+    )
+    return _ltsv_core(chunk_bytes, starts64, lens64, n, cand, ridx,
+                      src, cbase, pc, pair_flat, o_col, o_tab,
+                      cols, (), suffix, syslen, merger, encoder,
+                      scalar_fn=_scalar_gelf)

@@ -233,3 +233,54 @@ def test_capnp_block_ltsv_fallback_and_roundtrip():
         rec_bytes = bytes(res.block.data[a:b - 1])  # strip \n
         r = capnp_wire.parse_message(rec_bytes)
         assert r.get_hostname() is not None
+
+
+@pytest.mark.parametrize("merger", [LineMerger(), NulMerger(),
+                                    SyslenMerger()],
+                         ids=["line", "nul", "syslen"])
+def test_capnp_block_gelf(merger):
+    """gelf→capnp (round 5): typed pair discriminants — strings as
+    texts, bools/null as data bits, canonical ints parsed into i64/u64
+    words; floats and duplicate keys take the oracle."""
+    from flowgger_tpu.decoders.gelf import GelfDecoder
+
+    dec = GelfDecoder()
+    lines = [
+        b'{"version":"1.1","host":"web1","short_message":"req ok",'
+        b'"timestamp":1695213345.123,"level":6,"_status":200,"_b":true}',
+        b'{"host":"db2","timestamp":1695213345,"_user":"alice",'
+        b'"_z":null,"zeta":-17,"alpha":"two","_f":false}',
+        b'{"host":"h9","timestamp":0.5,"full_message":"the full text",'
+        b'"short_message":"s","_big":123456789012345678}',
+        b'{"host":"h","timestamp":3,"_k":"u","k":"b"}',
+    ]
+    # fallback rows FIRST: a non-candidate preceding candidates once
+    # misaligned the pair counts (compacted-vs-original row indexing)
+    mixed = [
+        # float pair: per-value bit pattern, oracle
+        b'{"host":"h","timestamp":4,"_f":1.25}',
+    ] + lines + [
+        # escaped string: oracle
+        b'{"host":"h","timestamp":5,"_m":"say \\"hi\\""}',
+        # 19-digit int: beyond the vectorized parse, oracle
+        b'{"host":"h","timestamp":6,"_n":1234567890123456789}',
+    ]
+    packed = pack.pack_lines_2d(lines * 3, 256)
+    handle = block_submit("gelf", packed)
+    res, _, _ = block_fetch_encode("gelf", handle, packed, ENC, merger)
+    assert res is not None
+    want = b"".join(_scalar_frames_for(dec, lines * 3, merger))
+    assert res.block.data == want
+
+    packed2 = pack.pack_lines_2d(mixed, 256)
+    handle2 = block_submit("gelf", packed2)
+    res2, _, _ = block_fetch_encode("gelf", handle2, packed2, ENC,
+                                    LineMerger())
+    assert res2 is not None
+    want2 = b"".join(_scalar_frames_for(dec, mixed, LineMerger()))
+    assert res2.block.data == want2
+    # round-trip through the reader: typed values survive
+    a, b = res2.block.bounds[1], res2.block.bounds[2]
+    r = capnp_wire.parse_message(bytes(res2.block.data[a:b - 1]))
+    assert dict((k, (v.kind, v.value)) for k, v in r.get_pairs()) == {
+        "_b": ("bool", True), "_status": ("u64", 200)}

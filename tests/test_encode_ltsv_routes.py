@@ -154,3 +154,47 @@ def test_rfc3164_rfc5424_block(merger):
     assert res is not None
     want = b"".join(scalar_frames(dec, lines * 3, merger, enc=enc))
     assert res.block.data == want
+
+
+@pytest.mark.parametrize("merger", [LineMerger(), NulMerger(),
+                                    SyslenMerger()],
+                         ids=["line", "nul", "syslen"])
+def test_gelf_ltsv_block(merger):
+    """gelf→LTSV (round 5): pairs in sorted-ORIGINAL-key Record order,
+    '_' stripped back off, literals/ints verbatim, Display stamps."""
+    from flowgger_tpu.decoders.gelf import GelfDecoder
+
+    dec = GelfDecoder()
+    lines = [
+        b'{"version":"1.1","host":"web1","short_message":"req ok",'
+        b'"timestamp":1695213345.123,"level":6,"_status":200,"_b":true}',
+        b'{"host":"db2","timestamp":1695213345,"_user":"alice",'
+        b'"_z":null,"zeta":1,"alpha":"two"}',
+        b'{"host":"h9","timestamp":0.5,"full_message":"the full text",'
+        b'"short_message":""}',
+        # mixed '_'-and-bare keys sort by ORIGINAL byte order
+        b'{"host":"h","timestamp":3,"_k":"u","k":"b"}',
+    ]
+    # fallback rows FIRST: a non-candidate preceding candidates once
+    # misaligned the pair counts (compacted-vs-original row indexing)
+    mixed = [
+        # float pair value: Display re-format is per-value, oracle
+        b'{"host":"h","timestamp":4,"_f":1.25}',
+    ] + lines + [
+        # escaped string: oracle
+        b'{"host":"h","timestamp":5,"_m":"say \\"hi\\""}',
+    ]
+    packed = pack.pack_lines_2d(lines * 3, 256)
+    handle = block_submit("gelf", packed)
+    res, _, _ = block_fetch_encode("gelf", handle, packed, ENC, merger)
+    assert res is not None
+    want = b"".join(scalar_frames(dec, lines * 3, merger))
+    assert res.block.data == want
+
+    packed2 = pack.pack_lines_2d(mixed, 256)
+    handle2 = block_submit("gelf", packed2)
+    res2, _, _ = block_fetch_encode("gelf", handle2, packed2, ENC,
+                                    LineMerger())
+    assert res2 is not None
+    want2 = b"".join(scalar_frames(dec, mixed, LineMerger()))
+    assert res2.block.data == want2
