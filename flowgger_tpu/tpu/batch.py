@@ -119,6 +119,10 @@ class BatchHandler(Handler):
                   or passthrough_ok))
             or (fmt in ("rfc3164", "ltsv", "gelf", "auto")
                 and type(encoder) is GelfEncoder)
+            or (fmt in ("rfc3164", "ltsv", "gelf")
+                and type(encoder) in (CapnpEncoder, LTSVEncoder))
+            or (fmt in ("rfc3164", "gelf")
+                and type(encoder) is RFC5424Encoder)
             or (fmt == "rfc3164"
                 and (passthrough_ok
                      or type(encoder) is RFC3164Encoder)))
@@ -412,7 +416,7 @@ class BatchHandler(Handler):
 
             return gelf_extra_consts_ltsv(self.encoder.extra) is not None
         if self.fmt == "gelf":
-            if type(self.encoder) is LTSVEncoder:
+            if type(self.encoder) in (LTSVEncoder, RFC5424Encoder):
                 return True
             return (type(self.encoder) is GelfEncoder
                     and not self.encoder.extra)
@@ -737,6 +741,7 @@ def block_fetch_encode(fmt, handle, packed, encoder, merger,
                 packed[0].shape[1], encoder, merger, ltsv_decoder)
     elif fmt == "gelf":
         from ..encoders.ltsv import LTSVEncoder
+        from ..encoders.rfc5424 import RFC5424Encoder
         from . import device_gelf_gelf, encode_gelf_gelf_block, gelf
 
         if device_gelf_gelf.route_ok(encoder, merger):
@@ -762,6 +767,12 @@ def block_fetch_encode(fmt, handle, packed, encoder, merger,
             from . import encode_capnp_block
 
             res = encode_capnp_block.encode_gelf_capnp_block(
+                packed[2], packed[3], packed[4], host_out, packed[5],
+                packed[0].shape[1], encoder, merger)
+        elif type(encoder) is RFC5424Encoder:
+            from . import encode_rfc5424_block
+
+            res = encode_rfc5424_block.encode_gelf_rfc5424_block(
                 packed[2], packed[3], packed[4], host_out, packed[5],
                 packed[0].shape[1], encoder, merger)
         else:

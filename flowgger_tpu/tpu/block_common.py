@@ -87,6 +87,45 @@ def ltsv_special_screen(chunk_arr: np.ndarray, starts64: np.ndarray,
     return special_name, uniq_ok
 
 
+def span_f64_scratch(chunk_bytes: bytes, tsa, tsb, fmt_fn):
+    """Dedup parse+format of per-row numeric SPANS in one dict pass
+    keyed on the span bytes (repetitive streams share few distinct
+    stamps; fmt_fn is the only per-unique Python).  Returns
+    (scratch bytes, per-row offsets, per-row lengths)."""
+    cache = {}
+    pieces = []
+    pos = 0
+    R = len(tsa)
+    off = np.empty(R, dtype=np.int64)
+    ln = np.empty(R, dtype=np.int64)
+    for i, (a, b) in enumerate(zip(tsa.tolist(), tsb.tolist())):
+        key = chunk_bytes[a:b]
+        hit = cache.get(key)
+        if hit is None:
+            txt = fmt_fn(float(key)).encode("ascii")
+            hit = (pos, len(txt))
+            cache[key] = hit
+            pieces.append(txt)
+            pos += len(txt)
+        off[i] = hit[0]
+        ln[i] = hit[1]
+    return b"".join(pieces), off, ln
+
+
+def span_f64_values(chunk_bytes: bytes, tsa, tsb) -> np.ndarray:
+    """Dedup parse of per-row numeric spans to f64 values."""
+    cache = {}
+    out = np.empty(len(tsa), dtype=np.float64)
+    for i, (a, b) in enumerate(zip(tsa.tolist(), tsb.tolist())):
+        key = chunk_bytes[a:b]
+        v = cache.get(key)
+        if v is None:
+            v = float(key)
+            cache[key] = v
+        out[i] = v
+    return out
+
+
 def gelf_sorted_pairs(chunk_arr, starts64, cand, is_pair, kabs, key_e,
                       vabs_a, vabs_b, val_t, byte_at, cap: int):
     """Flat pair table in sorted-ORIGINAL-key Record order for the

@@ -640,17 +640,10 @@ def encode_gelf_capnp_block(
                                scalar_fn=_scalar_gelf)
 
     # timestamps: per-unique float of the span (dedup dict)
-    tsa = s["tsa_all"][ridx]
-    tsb = s["tsb_all"][ridx]
-    cache = {}
-    ts = np.empty(R, dtype=np.float64)
-    for i, (a, b) in enumerate(zip(tsa.tolist(), tsb.tolist())):
-        key = chunk_bytes[a:b]
-        v = cache.get(key)
-        if v is None:
-            v = float(key)
-            cache[key] = v
-        ts[i] = v
+    from .block_common import span_f64_values
+
+    ts = span_f64_values(chunk_bytes, s["tsa_all"][ridx],
+                         s["tsb_all"][ridx])
 
     lv_a, _ = vspan_at(s["lvl_f"])
     sev = np.where(s["has_lvl"],

@@ -318,27 +318,11 @@ def encode_gelf_gelf_block(
 
     if R:
         # timestamps: dedupe the span texts in one dict pass before the
-        # per-value float/format work (repetitive streams share few
-        # distinct stamps; a dict of bytes keys beats a row-unique sort)
-        tsa = tsa_all[ridx]
-        tsb = tsb_all[ridx]
-        cache = {}
-        pieces = []
-        pos = 0
-        ts_off = np.empty(R, dtype=np.int64)
-        ts_len = np.empty(R, dtype=np.int64)
-        for i, (a, b) in enumerate(zip(tsa.tolist(), tsb.tolist())):
-            key = chunk_bytes[a:b]
-            hit = cache.get(key)
-            if hit is None:
-                txt = json_f64(float(key)).encode("ascii")
-                hit = (pos, len(txt))
-                cache[key] = hit
-                pieces.append(txt)
-                pos += len(txt)
-            ts_off[i] = hit[0]
-            ts_len[i] = hit[1]
-        scratch = b"".join(pieces)
+        # per-value float/format work (shared helper)
+        from .block_common import span_f64_scratch
+
+        scratch, ts_off, ts_len = span_f64_scratch(
+            chunk_bytes, tsa_all[ridx], tsb_all[ridx], json_f64)
 
         consts, offs = build_source(
             b"{", b'"_', b'"', b'":', b'",', b"true", b"false", b"null",

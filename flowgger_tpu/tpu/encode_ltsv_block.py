@@ -528,25 +528,10 @@ def encode_gelf_ltsv_block(
                             scalar_fn=_scalar_gelf)
 
     # timestamps: dedupe span texts, per-unique float + Display
-    tsa = s["tsa_all"][ridx]
-    tsb = s["tsb_all"][ridx]
-    cache = {}
-    pieces = []
-    pos = 0
-    ts_off = np.empty(R, dtype=np.int64)
-    ts_len = np.empty(R, dtype=np.int64)
-    for i, (a, b) in enumerate(zip(tsa.tolist(), tsb.tolist())):
-        key = chunk_bytes[a:b]
-        hit = cache.get(key)
-        if hit is None:
-            txt = display_f64(float(key)).encode("ascii")
-            hit = (pos, len(txt))
-            cache[key] = hit
-            pieces.append(txt)
-            pos += len(txt)
-        ts_off[i] = hit[0]
-        ts_len[i] = hit[1]
-    scratch = b"".join(pieces)
+    from .block_common import span_f64_scratch
+
+    scratch, ts_off, ts_len = span_f64_scratch(
+        chunk_bytes, s["tsa_all"][ridx], s["tsb_all"][ridx], display_f64)
 
     extra_blob = ltsv_extra_blob(encoder.extra)
     consts, offs = build_source(

@@ -347,3 +347,157 @@ def encode_rfc3164_rfc5424_block(
                       src, cbase, pc, None, 0, 0,
                       cols, (), suffix, syslen, merger, encoder,
                       scalar_fn=_scalar_3164)
+
+
+def encode_gelf_rfc5424_block(
+    chunk_bytes: bytes,
+    starts: np.ndarray,
+    orig_lens: np.ndarray,
+    out: Dict[str, np.ndarray],
+    n_real: int,
+    max_len: int,
+    encoder,
+    merger: Optional[Merger],
+) -> Optional[BlockResult]:
+    """gelf→RFC5424 (rfc5424_encoder.rs:28-93 over the GELF Record
+    shape): facility is always absent so PRI is the constant <13>
+    default; the stamp re-formats ms-truncated rfc3339 from the parsed
+    value; appname's slot is skipped, procid/msgid render "-", and the
+    typed pairs rebuild one SD block in sorted-ORIGINAL-key Record
+    order — ``[ name="value" ...]`` with nulls as bare names, bools as
+    constants, clean strings/canonical ints verbatim (record.rs:42-68
+    does not escape values, and the escape-free tier's strings cannot
+    contain a quote)."""
+    from .block_common import gelf_sorted_pairs
+    from .encode_gelf_gelf_block import _NAME_CAP, gelf_screen
+    from .gelf import VT_FALSE, VT_NULL, VT_NUMBER, VT_STRING, VT_TRUE
+    from .materialize_gelf import _scalar_gelf
+
+    spec = merger_suffix(merger)
+    if spec is None:
+        return None
+    suffix, syslen = spec
+
+    s = gelf_screen(chunk_bytes, starts, orig_lens, out, n_real, max_len)
+    n, starts64, lens64, cand = (s["n"], s["starts64"], s["lens64"],
+                                 s["cand"])
+    chunk_arr = s["chunk_arr"]
+    is_pair = s["is_pair"] & cand[:, None]
+
+    rop_s, ns_s, ne_s, pv_t, pv_a, pv_b = gelf_sorted_pairs(
+        chunk_arr, starts64, cand, is_pair, s["kabs"], s["key_e"],
+        s["vabs_a"], s["vabs_b"], s["val_t"], s["byte_at"], _NAME_CAP)
+
+    ridx = np.flatnonzero(cand)
+    R = ridx.size
+    if not R:
+        return finish_block(chunk_bytes, starts64, lens64, n, cand, ridx,
+                            b"", np.zeros(1, dtype=np.int64), None,
+                            suffix, syslen, merger, encoder,
+                            scalar_fn=_scalar_gelf)
+
+    # timestamps: per-unique span parse + rfc3339-ms format, one pass
+    from .block_common import span_f64_scratch
+
+    scratch, ts_off, ts_len = span_f64_scratch(
+        chunk_bytes, s["tsa_all"][ridx], s["tsb_all"][ridx],
+        unix_to_rfc3339_ms)
+
+    host_a0, host_b0 = s["vspan_at"](s["host_f"])
+    host_a, host_l = host_a0[ridx], (host_b0 - host_a0)[ridx]
+    msg_a0, msg_b0 = s["vspan_at"](s["short_f"])
+    msg_a, msg_l = msg_a0[ridx], (msg_b0 - msg_a0)[ridx]
+    has_msg = s["has_short"][ridx]
+
+    consts, offs = build_source(
+        b"<13>1 ", b" ", b" - - ", b"[", b"] ", b"- ", b' ', b'="',
+        b'"', b"true", b"false", suffix, scratch)
+    (o_pri, o_sp, o_tail3, o_open, o_close, o_dash2, o_psp, o_eq,
+     o_q, o_true, o_false, o_sfx, o_ts) = offs
+    cbase = int(chunk_arr.size)
+    src = np.concatenate([chunk_arr, consts])
+
+    # pc in ORIGINAL row space, selected down to the candidate rows
+    pc = (np.bincount(rop_s, minlength=n)[ridx].astype(np.int64)
+          if rop_s.size else np.zeros(R, dtype=np.int64))
+    has_sd = pc > 0
+
+    HEAD = 6
+    TAIL = 3
+    segc = HEAD + 5 * pc + TAIL
+    rstart = exclusive_cumsum(segc)[:-1]
+    S = int(segc.sum())
+    seg_src = np.zeros(S, dtype=np.int64)
+    seg_len = np.zeros(S, dtype=np.int64)
+
+    head = (
+        (cbase + o_pri, np.full(R, len(b"<13>1 "))),
+        (cbase + o_ts + ts_off, ts_len),
+        (np.full(R, cbase + o_sp), np.full(R, 1)),
+        (host_a, host_l),
+        (np.full(R, cbase + o_tail3), np.full(R, len(b" - - "))),
+        (np.full(R, cbase + o_open), np.where(has_sd, 1, 0)),
+    )
+    for k, (sv, lv) in enumerate(head):
+        seg_src[rstart + k] = sv
+        seg_len[rstart + k] = lv
+
+    if rop_s.size:
+        tpos = np.cumsum(cand) - 1
+        rr = tpos[rop_s]
+        new_row = np.ones(rop_s.size, dtype=bool)
+        new_row[1:] = rop_s[1:] != rop_s[:-1]
+        run_starts = np.flatnonzero(new_row)
+        within = (np.arange(rop_s.size)
+                  - np.repeat(run_starts,
+                              np.diff(np.append(run_starts,
+                                                rop_s.size))))
+        p0 = rstart[rr] + HEAD + 5 * within
+        is_null = pv_t == VT_NULL
+        is_txt = (pv_t == VT_STRING) | (pv_t == VT_NUMBER)
+        vs_r = np.where(is_txt, pv_a,
+                        np.where(pv_t == VT_TRUE, cbase + o_true,
+                                 np.where(pv_t == VT_FALSE,
+                                          cbase + o_false, 0)))
+        vln = np.where(is_txt, pv_b - pv_a,
+                       np.where(pv_t == VT_TRUE, 4,
+                                np.where(pv_t == VT_FALSE, 5, 0)))
+        seg_src[p0] = cbase + o_psp
+        seg_len[p0] = 1
+        seg_src[p0 + 1] = ns_s
+        seg_len[p0 + 1] = ne_s - ns_s
+        seg_src[p0 + 2] = cbase + o_eq
+        seg_len[p0 + 2] = np.where(is_null, 0, 2)
+        seg_src[p0 + 3] = vs_r
+        seg_len[p0 + 3] = np.where(is_null, 0, vln)
+        seg_src[p0 + 4] = cbase + o_q
+        seg_len[p0 + 4] = np.where(is_null, 0, 1)
+
+    fd = (rstart + HEAD + 5 * pc)[:, None] + np.arange(
+        TAIL, dtype=np.int64)[None, :]
+    tail_cols = (
+        (np.where(has_sd, cbase + o_close, cbase + o_dash2),
+         np.full(R, 2)),
+        (msg_a, np.where(has_msg, msg_l, 0)),
+        (np.full(R, cbase + o_sfx), np.full(R, len(suffix))),
+    )
+    fsrc = np.empty((R, TAIL), dtype=np.int64)
+    flen = np.empty((R, TAIL), dtype=np.int64)
+    for k, (sv, lv) in enumerate(tail_cols):
+        fsrc[:, k] = sv
+        flen[:, k] = lv
+    seg_src[fd] = fsrc
+    seg_len[fd] = flen
+
+    dst0 = exclusive_cumsum(seg_len)
+    body = concat_segments(src, seg_src, seg_len, dst0)
+    row_off = np.concatenate([dst0[rstart], dst0[-1:]])
+    prefix_lens_tier = None
+    if syslen:
+        final_buf, row_off, prefix_lens_tier = apply_syslen_prefix(
+            body, row_off, np.diff(row_off))
+    else:
+        final_buf = body.tobytes()
+    return finish_block(chunk_bytes, starts64, lens64, n, cand, ridx,
+                        final_buf, row_off, prefix_lens_tier, suffix,
+                        syslen, merger, encoder, scalar_fn=_scalar_gelf)

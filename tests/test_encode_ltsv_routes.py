@@ -119,17 +119,57 @@ def test_batch_handler_ltsv_ltsv_route():
     tx = queue.Queue()
     h = BatchHandler(tx, dec, ENC, Config.from_string(""), fmt="ltsv",
                      start_timer=False, merger=LineMerger())
-    assert h._block_route_ok()
+    assert h._fast_encode and h._block_route_ok()
     for ln in LTSV_LINES * 4:
         h.handle_bytes(ln)
     h.flush()
     data = b""
+    saw_block = False
     while not tx.empty():
         item = tx.get_nowait()
+        # the production path must ship EncodedBlocks (the _fast_encode
+        # gate once silently scalar-pathed every new route)
+        saw_block |= isinstance(item, EncodedBlock)
         data += (item.data if isinstance(item, EncodedBlock)
                  else LineMerger().frame(item))
+    assert saw_block
     want = b"".join(scalar_frames(dec, LTSV_LINES * 4, LineMerger()))
     assert data == want
+
+
+def test_block_gate_admits_every_route():
+    """Every (fmt, encoder) pair with a columnar block encoder must
+    pass the _fast_encode gate, or the route is production-dead."""
+    import queue
+
+    from flowgger_tpu.decoders.gelf import GelfDecoder
+    from flowgger_tpu.decoders.rfc5424 import RFC5424Decoder
+    from flowgger_tpu.encoders.capnp import CapnpEncoder
+    from flowgger_tpu.encoders.gelf import GelfEncoder
+    from flowgger_tpu.encoders.rfc5424 import RFC5424Encoder
+    from flowgger_tpu.tpu.batch import BatchHandler
+
+    decs = {"rfc5424": RFC5424Decoder(),
+            "rfc3164": RFC3164Decoder(),
+            "ltsv": LTSVDecoder(Config.from_string("")),
+            "gelf": GelfDecoder()}
+    combos = [
+        ("rfc5424", GelfEncoder), ("rfc5424", RFC5424Encoder),
+        ("rfc5424", LTSVEncoder), ("rfc5424", CapnpEncoder),
+        ("rfc3164", GelfEncoder), ("rfc3164", CapnpEncoder),
+        ("rfc3164", LTSVEncoder), ("rfc3164", RFC5424Encoder),
+        ("ltsv", GelfEncoder), ("ltsv", CapnpEncoder),
+        ("ltsv", LTSVEncoder),
+        ("gelf", GelfEncoder), ("gelf", LTSVEncoder),
+        ("gelf", CapnpEncoder), ("gelf", RFC5424Encoder),
+    ]
+    for fmt, enc_cls in combos:
+        h = BatchHandler(queue.Queue(), decs[fmt],
+                         enc_cls(Config.from_string("")),
+                         Config.from_string(""), fmt=fmt,
+                         start_timer=False, merger=LineMerger())
+        assert h._fast_encode, (fmt, enc_cls.__name__)
+        assert h._block_route_ok(), (fmt, enc_cls.__name__)
 
 
 @pytest.mark.parametrize("merger", [LineMerger(), NulMerger(),
@@ -198,3 +238,34 @@ def test_gelf_ltsv_block(merger):
     assert res2 is not None
     want2 = b"".join(scalar_frames(dec, mixed, LineMerger()))
     assert res2.block.data == want2
+
+
+@pytest.mark.parametrize("merger", [LineMerger(), NulMerger(),
+                                    SyslenMerger()],
+                         ids=["line", "nul", "syslen"])
+def test_gelf_rfc5424_block(merger):
+    """gelf→RFC5424 (round 5): constant <13> PRI (no facility),
+    rfc3339-ms stamps, '-' proc/msgid, one SD block with typed values
+    (nulls bare, bools constant, ints/strings verbatim)."""
+    from flowgger_tpu.decoders.gelf import GelfDecoder
+    from flowgger_tpu.encoders.rfc5424 import RFC5424Encoder
+
+    enc = RFC5424Encoder(Config.from_string(""))
+    dec = GelfDecoder()
+    lines = [
+        # fallback FIRST (float pair): ordering must not shift counts
+        b'{"host":"h","timestamp":4,"_f":1.25}',
+        b'{"version":"1.1","host":"web1","short_message":"req ok",'
+        b'"timestamp":1695213345.123,"level":6,"_status":200,"_b":true}',
+        b'{"host":"db2","timestamp":1695213345,"_user":"alice",'
+        b'"_z":null,"zeta":-17,"alpha":"two"}',
+        b'{"host":"h9","timestamp":0.5,"full_message":"ignored here",'
+        b'"short_message":""}',
+        b'{"host":"h2","timestamp":7}',
+    ]
+    packed = pack.pack_lines_2d(lines * 3, 256)
+    handle = block_submit("gelf", packed)
+    res, _, _ = block_fetch_encode("gelf", handle, packed, enc, merger)
+    assert res is not None
+    want = b"".join(scalar_frames(dec, lines * 3, merger, enc=enc))
+    assert res.block.data == want

@@ -129,7 +129,16 @@ def norm(bs):
         return m.group(0)
 
     out = re.sub(rb'time:([0-9.e+-]+)', repl_t, out)
-    if b'"timestamp":NOW' in out or b"time:NOW" in out:
+
+    # rfc5424-output form: a freshly minted rfc3339 text stamp (only
+    # now() rows carry today's date; corpus stamps are fixed past dates)
+    today = time.strftime("%Y-%m-%d", time.gmtime()).encode()
+    def repl_iso(m):
+        return b"TSNOW" if m.group(0)[:10] == today else m.group(0)
+
+    out = re.sub(rb'\d{4}-\d{2}-\d{2}T[0-9:.]+Z', repl_iso, out)
+    if (b'"timestamp":NOW' in out or b"time:NOW" in out
+            or b"TSNOW" in out):
         out = re.sub(rb'^[0-9]+ ', b'LEN ', out)
     return out
 
@@ -172,7 +181,7 @@ ROUTES = [
     ("rfc3164", RFC3164Decoder, [GelfEncoder, PassthroughEncoder, RFC3164Encoder, CapnpEncoder, LTSVEncoder, RFC5424Encoder], gen_rfc3164),
     ("ltsv", LTSVDecoder, [GelfEncoder, CapnpEncoder, LTSVEncoder], gen_ltsv),
     ("ltsv", TypedLTSVDecoder, [GelfEncoder, CapnpEncoder, LTSVEncoder], gen_ltsv_typed),
-    ("gelf", GelfDecoder, [GelfEncoder, LTSVEncoder, CapnpEncoder], gen_gelf),
+    ("gelf", GelfDecoder, [GelfEncoder, LTSVEncoder, CapnpEncoder, RFC5424Encoder], gen_gelf),
 ]
 MERGERS = [None, LineMerger(), NulMerger(), SyslenMerger()]
 fails = 0
