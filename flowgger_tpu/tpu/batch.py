@@ -121,7 +121,7 @@ class BatchHandler(Handler):
                 and type(encoder) is GelfEncoder)
             or (fmt in ("rfc3164", "ltsv", "gelf")
                 and type(encoder) in (CapnpEncoder, LTSVEncoder))
-            or (fmt in ("rfc3164", "gelf")
+            or (fmt in ("rfc3164", "ltsv", "gelf")
                 and type(encoder) is RFC5424Encoder)
             or (fmt == "rfc3164"
                 and (passthrough_ok
@@ -406,9 +406,9 @@ class BatchHandler(Handler):
             return self._passthrough_ok
         if self.fmt == "ltsv":
             # LTSV decode block-encodes GELF, LTSV (self re-encode),
-            # and capnp; typed-schema support (and its per-row
-            # fallbacks) lives in the encoders
-            if type(self.encoder) is LTSVEncoder:
+            # RFC5424, and capnp; typed-schema support (and its
+            # per-row fallbacks) lives in the encoders
+            if type(self.encoder) in (LTSVEncoder, RFC5424Encoder):
                 return not getattr(self.scalar.decoder, "schema", None)
             if type(self.encoder) is not GelfEncoder:
                 return False
@@ -461,8 +461,9 @@ class BatchHandler(Handler):
                 return "input.ltsv_schema is set"
             return no_columnar
         from ..encoders.ltsv import LTSVEncoder
+        from ..encoders.rfc5424 import RFC5424Encoder
 
-        if t is LTSVEncoder and self.fmt == "ltsv":
+        if t in (LTSVEncoder, RFC5424Encoder) and self.fmt == "ltsv":
             return "input.ltsv_schema is set"
         if t is GelfEncoder:
             # GELF output is columnar for every kernel format, so the
@@ -722,6 +723,7 @@ def block_fetch_encode(fmt, handle, packed, encoder, merger,
         t1 = _time.perf_counter()
         from ..encoders.capnp import CapnpEncoder
         from ..encoders.ltsv import LTSVEncoder
+        from ..encoders.rfc5424 import RFC5424Encoder
 
         if type(encoder) is CapnpEncoder:
             from . import encode_capnp_block
@@ -733,6 +735,12 @@ def block_fetch_encode(fmt, handle, packed, encoder, merger,
             from . import encode_ltsv_block
 
             res = encode_ltsv_block.encode_ltsv_ltsv_block(
+                packed[2], packed[3], packed[4], host_out, packed[5],
+                packed[0].shape[1], encoder, merger, ltsv_decoder)
+        elif type(encoder) is RFC5424Encoder:
+            from . import encode_rfc5424_block
+
+            res = encode_rfc5424_block.encode_ltsv_rfc5424_block(
                 packed[2], packed[3], packed[4], host_out, packed[5],
                 packed[0].shape[1], encoder, merger, ltsv_decoder)
         else:

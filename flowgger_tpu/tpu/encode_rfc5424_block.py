@@ -349,6 +349,96 @@ def encode_rfc3164_rfc5424_block(
                       scalar_fn=_scalar_3164)
 
 
+def _rfc5424_sd_assemble(chunk_bytes, chunk_arr, src, offs, starts64,
+                         lens64, n, cand, ridx, pc, ts_off, ts_len,
+                         host_a, host_l, msg_a, msg_l, has_msg, pairs,
+                         suffix, syslen, merger, encoder, scalar_fn):
+    """Shared RFC5424 row assembly for the Record-shaped routes
+    (gelf→RFC5424, ltsv→RFC5424): constant <13> PRI head, rfc3339-ms
+    stamp, host, " - - " proc/msgid slots, one SD block (or "- "),
+    optional message, framing suffix.
+
+    ``offs`` is the build_source offset tuple for the consts
+    ``("<13>1 ", " ", " - - ", "[", "] ", "- ", ' ', '="', '"',
+    suffix, scratch)``; ``pairs`` is None or ``(rr [T] compacted row
+    ids ASCENDING, ns, nlen, eqlen, vsrc, vlen, qlen)`` — the three
+    length columns let callers gate null values (bare names)."""
+    (o_pri, o_sp, o_tail3, o_open, o_close, o_dash2, o_psp, o_eq,
+     o_q, o_sfx, o_ts) = offs
+    cbase = int(chunk_arr.size)
+    R = ridx.size
+    has_sd = pc > 0
+
+    HEAD = 6
+    TAIL = 3
+    segc = HEAD + 5 * pc + TAIL
+    rstart = exclusive_cumsum(segc)[:-1]
+    S = int(segc.sum())
+    seg_src = np.zeros(S, dtype=np.int64)
+    seg_len = np.zeros(S, dtype=np.int64)
+
+    head = (
+        (np.full(R, cbase + o_pri), np.full(R, 6)),   # "<13>1 "
+        (cbase + o_ts + ts_off, ts_len),
+        (np.full(R, cbase + o_sp), np.full(R, 1)),
+        (host_a, host_l),
+        (np.full(R, cbase + o_tail3), np.full(R, 5)),  # " - - "
+        (np.full(R, cbase + o_open), np.where(has_sd, 1, 0)),
+    )
+    for k, (sv, lv) in enumerate(head):
+        seg_src[rstart + k] = sv
+        seg_len[rstart + k] = lv
+
+    if pairs is not None and pairs[0].size:
+        rr, ns, nlen, eqlen, vsrc, vlen, qlen = pairs
+        new_row = np.ones(rr.size, dtype=bool)
+        new_row[1:] = rr[1:] != rr[:-1]
+        run_starts = np.flatnonzero(new_row)
+        within = (np.arange(rr.size)
+                  - np.repeat(run_starts,
+                              np.diff(np.append(run_starts, rr.size))))
+        p0 = rstart[rr] + HEAD + 5 * within
+        seg_src[p0] = cbase + o_psp
+        seg_len[p0] = 1
+        seg_src[p0 + 1] = ns
+        seg_len[p0 + 1] = nlen
+        seg_src[p0 + 2] = cbase + o_eq
+        seg_len[p0 + 2] = eqlen
+        seg_src[p0 + 3] = vsrc
+        seg_len[p0 + 3] = vlen
+        seg_src[p0 + 4] = cbase + o_q
+        seg_len[p0 + 4] = qlen
+
+    fd = (rstart + HEAD + 5 * pc)[:, None] + np.arange(
+        TAIL, dtype=np.int64)[None, :]
+    tail_cols = (
+        (np.where(has_sd, cbase + o_close, cbase + o_dash2),
+         np.full(R, 2)),
+        (msg_a, np.where(has_msg, msg_l, 0)),
+        (np.full(R, cbase + o_sfx), np.full(R, len(suffix))),
+    )
+    fsrc = np.empty((R, TAIL), dtype=np.int64)
+    flen = np.empty((R, TAIL), dtype=np.int64)
+    for k, (sv, lv) in enumerate(tail_cols):
+        fsrc[:, k] = sv
+        flen[:, k] = lv
+    seg_src[fd] = fsrc
+    seg_len[fd] = flen
+
+    dst0 = exclusive_cumsum(seg_len)
+    body = concat_segments(src, seg_src, seg_len, dst0)
+    row_off = np.concatenate([dst0[rstart], dst0[-1:]])
+    prefix_lens_tier = None
+    if syslen:
+        final_buf, row_off, prefix_lens_tier = apply_syslen_prefix(
+            body, row_off, np.diff(row_off))
+    else:
+        final_buf = body.tobytes()
+    return finish_block(chunk_bytes, starts64, lens64, n, cand, ridx,
+                        final_buf, row_off, prefix_lens_tier, suffix,
+                        syslen, merger, encoder, scalar_fn=scalar_fn)
+
+
 def encode_gelf_rfc5424_block(
     chunk_bytes: bytes,
     starts: np.ndarray,
@@ -411,93 +501,135 @@ def encode_gelf_rfc5424_block(
 
     consts, offs = build_source(
         b"<13>1 ", b" ", b" - - ", b"[", b"] ", b"- ", b' ', b'="',
-        b'"', b"true", b"false", suffix, scratch)
-    (o_pri, o_sp, o_tail3, o_open, o_close, o_dash2, o_psp, o_eq,
-     o_q, o_true, o_false, o_sfx, o_ts) = offs
+        b'"', suffix, scratch, b"true", b"false")
+    o_true, o_false = offs[11], offs[12]
+    chunk_src = np.concatenate([chunk_arr, consts])
     cbase = int(chunk_arr.size)
-    src = np.concatenate([chunk_arr, consts])
 
     # pc in ORIGINAL row space, selected down to the candidate rows
     pc = (np.bincount(rop_s, minlength=n)[ridx].astype(np.int64)
           if rop_s.size else np.zeros(R, dtype=np.int64))
-    has_sd = pc > 0
 
-    HEAD = 6
-    TAIL = 3
-    segc = HEAD + 5 * pc + TAIL
-    rstart = exclusive_cumsum(segc)[:-1]
-    S = int(segc.sum())
-    seg_src = np.zeros(S, dtype=np.int64)
-    seg_len = np.zeros(S, dtype=np.int64)
-
-    head = (
-        (cbase + o_pri, np.full(R, len(b"<13>1 "))),
-        (cbase + o_ts + ts_off, ts_len),
-        (np.full(R, cbase + o_sp), np.full(R, 1)),
-        (host_a, host_l),
-        (np.full(R, cbase + o_tail3), np.full(R, len(b" - - "))),
-        (np.full(R, cbase + o_open), np.where(has_sd, 1, 0)),
-    )
-    for k, (sv, lv) in enumerate(head):
-        seg_src[rstart + k] = sv
-        seg_len[rstart + k] = lv
-
+    pairs = None
     if rop_s.size:
         tpos = np.cumsum(cand) - 1
         rr = tpos[rop_s]
-        new_row = np.ones(rop_s.size, dtype=bool)
-        new_row[1:] = rop_s[1:] != rop_s[:-1]
-        run_starts = np.flatnonzero(new_row)
-        within = (np.arange(rop_s.size)
-                  - np.repeat(run_starts,
-                              np.diff(np.append(run_starts,
-                                                rop_s.size))))
-        p0 = rstart[rr] + HEAD + 5 * within
         is_null = pv_t == VT_NULL
         is_txt = (pv_t == VT_STRING) | (pv_t == VT_NUMBER)
-        vs_r = np.where(is_txt, pv_a,
+        vsrc = np.where(is_txt, pv_a,
                         np.where(pv_t == VT_TRUE, cbase + o_true,
                                  np.where(pv_t == VT_FALSE,
                                           cbase + o_false, 0)))
-        vln = np.where(is_txt, pv_b - pv_a,
-                       np.where(pv_t == VT_TRUE, 4,
-                                np.where(pv_t == VT_FALSE, 5, 0)))
-        seg_src[p0] = cbase + o_psp
-        seg_len[p0] = 1
-        seg_src[p0 + 1] = ns_s
-        seg_len[p0 + 1] = ne_s - ns_s
-        seg_src[p0 + 2] = cbase + o_eq
-        seg_len[p0 + 2] = np.where(is_null, 0, 2)
-        seg_src[p0 + 3] = vs_r
-        seg_len[p0 + 3] = np.where(is_null, 0, vln)
-        seg_src[p0 + 4] = cbase + o_q
-        seg_len[p0 + 4] = np.where(is_null, 0, 1)
+        vlen = np.where(is_txt, pv_b - pv_a,
+                        np.where(pv_t == VT_TRUE, 4,
+                                 np.where(pv_t == VT_FALSE, 5, 0)))
+        pairs = (rr, ns_s, ne_s - ns_s,
+                 np.where(is_null, 0, 2),
+                 vsrc, np.where(is_null, 0, vlen),
+                 np.where(is_null, 0, 1))
 
-    fd = (rstart + HEAD + 5 * pc)[:, None] + np.arange(
-        TAIL, dtype=np.int64)[None, :]
-    tail_cols = (
-        (np.where(has_sd, cbase + o_close, cbase + o_dash2),
-         np.full(R, 2)),
-        (msg_a, np.where(has_msg, msg_l, 0)),
-        (np.full(R, cbase + o_sfx), np.full(R, len(suffix))),
+    return _rfc5424_sd_assemble(
+        chunk_bytes, chunk_arr, chunk_src, offs[:11], starts64, lens64,
+        n, cand, ridx, pc, ts_off, ts_len, host_a, host_l, msg_a, msg_l,
+        has_msg, pairs, suffix, syslen, merger, encoder, _scalar_gelf)
+
+
+def encode_ltsv_rfc5424_block(
+    chunk_bytes: bytes,
+    starts: np.ndarray,
+    orig_lens: np.ndarray,
+    out: Dict[str, np.ndarray],
+    n_real: int,
+    max_len: int,
+    encoder,
+    merger: Optional[Merger],
+    decoder=None,
+) -> Optional[BlockResult]:
+    """ltsv→RFC5424: facility is always absent so PRI is the constant
+    <13> default; stamps re-format ms-truncated rfc3339 (rfc3339 rows
+    from the calendar channels, unix literals from the split-integer
+    parse); pairs rebuild one SD block in PART order (the Record keeps
+    insertion order; record.rs:42-68 renders values unescaped, so raw
+    spans are exact).  Typed ``ltsv_schema`` keeps the Record path."""
+    from .block_common import (
+        ltsv_special_screen,
+        ltsv_ts_vals,
+        vals_scratch,
     )
-    fsrc = np.empty((R, TAIL), dtype=np.int64)
-    flen = np.empty((R, TAIL), dtype=np.int64)
-    for k, (sv, lv) in enumerate(tail_cols):
-        fsrc[:, k] = sv
-        flen[:, k] = lv
-    seg_src[fd] = fsrc
-    seg_len[fd] = flen
+    from .materialize_ltsv import _scalar_ltsv
 
-    dst0 = exclusive_cumsum(seg_len)
-    body = concat_segments(src, seg_src, seg_len, dst0)
-    row_off = np.concatenate([dst0[rstart], dst0[-1:]])
-    prefix_lens_tier = None
-    if syslen:
-        final_buf, row_off, prefix_lens_tier = apply_syslen_prefix(
-            body, row_off, np.diff(row_off))
-    else:
-        final_buf = body.tobytes()
-    return finish_block(chunk_bytes, starts64, lens64, n, cand, ridx,
-                        final_buf, row_off, prefix_lens_tier, suffix,
-                        syslen, merger, encoder, scalar_fn=_scalar_gelf)
+    spec = merger_suffix(merger)
+    if spec is None:
+        return None
+    if decoder is not None and getattr(decoder, "schema", None):
+        return None
+    suffix, syslen = spec
+
+    def scalar_fn(line):
+        return _scalar_ltsv(decoder, line)
+
+    n = int(n_real)
+    starts64 = np.asarray(starts[:n], dtype=np.int64)
+    lens64 = np.asarray(orig_lens[:n], dtype=np.int64)
+    ok = np.asarray(out["ok"][:n], dtype=bool)
+    has_high = np.asarray(out["has_high"][:n], dtype=bool)
+    n_parts = np.asarray(out["n_parts"])[:n].astype(np.int64)
+    part_start = np.asarray(out["part_start"])[:n]
+    part_end = np.asarray(out["part_end"])[:n]
+    colon_pos = np.asarray(out["colon_pos"])[:n]
+    host_pos = np.asarray(out["host_pos"])[:n]
+
+    P = part_start.shape[1]
+    jmask = np.arange(P)[None, :] < n_parts[:, None]
+    cand = ok & (lens64 <= max_len) & ~has_high & (host_pos >= 0)
+    cand &= ~(jmask & (colon_pos < 0)).any(axis=1)
+    chunk_arr = np.frombuffer(chunk_bytes, dtype=np.uint8)
+    nlen = np.where(jmask, colon_pos - part_start, 0)
+    special_name, uniq_ok = ltsv_special_screen(
+        chunk_arr, starts64, part_start, nlen, jmask)
+    cand &= uniq_ok
+
+    ridx = np.flatnonzero(cand)
+    R = ridx.size
+    if not R:
+        return finish_block(chunk_bytes, starts64, lens64, n, cand, ridx,
+                            b"", np.zeros(1, dtype=np.int64), None,
+                            suffix, syslen, merger, encoder,
+                            scalar_fn=scalar_fn)
+    st = starts64[ridx]
+
+    ts_vals = ltsv_ts_vals(out, n, ridx, chunk_bytes, starts64)
+    scratch, ts_off, ts_len = vals_scratch(ts_vals, unix_to_rfc3339_ms)
+
+    host_a = st + np.asarray(out["host_start"])[:n][ridx].astype(np.int64)
+    host_l = (np.asarray(out["host_end"])[:n][ridx].astype(np.int64)
+              - np.asarray(out["host_start"])[:n][ridx].astype(np.int64))
+    msg_a = st + np.asarray(out["msg_start"])[:n][ridx].astype(np.int64)
+    msg_l = (np.asarray(out["msg_end"])[:n][ridx].astype(np.int64)
+             - np.asarray(out["msg_start"])[:n][ridx].astype(np.int64))
+    has_msg = np.asarray(out["msg_pos"])[:n][ridx].astype(np.int64) >= 0
+
+    consts, offs = build_source(
+        b"<13>1 ", b" ", b" - - ", b"[", b"] ", b"- ", b' ', b'="',
+        b'"', suffix, scratch)
+    chunk_src = np.concatenate([chunk_arr, consts])
+
+    # pairs in PART order: non-special parts, raw name/value spans
+    is_pair = jmask[ridx] & ~special_name[ridx]
+    pc = is_pair.sum(axis=1).astype(np.int64)
+
+    pairs = None
+    if int(pc.sum()):
+        rr2, cc = np.nonzero(is_pair)
+        rop = rr2.astype(np.int64)
+        ns = st[rop] + part_start[ridx][rr2, cc].astype(np.int64)
+        ne = st[rop] + colon_pos[ridx][rr2, cc].astype(np.int64)
+        ve = st[rop] + part_end[ridx][rr2, cc].astype(np.int64)
+        T = rop.size
+        pairs = (rop, ns, ne - ns, np.full(T, 2), ne + 1, ve - ne - 1,
+                 np.full(T, 1))
+
+    return _rfc5424_sd_assemble(
+        chunk_bytes, chunk_arr, chunk_src, offs, starts64, lens64, n,
+        cand, ridx, pc, ts_off, ts_len, host_a, host_l, msg_a, msg_l,
+        has_msg, pairs, suffix, syslen, merger, encoder, scalar_fn)

@@ -159,7 +159,7 @@ def test_block_gate_admits_every_route():
         ("rfc3164", GelfEncoder), ("rfc3164", CapnpEncoder),
         ("rfc3164", LTSVEncoder), ("rfc3164", RFC5424Encoder),
         ("ltsv", GelfEncoder), ("ltsv", CapnpEncoder),
-        ("ltsv", LTSVEncoder),
+        ("ltsv", LTSVEncoder), ("ltsv", RFC5424Encoder),
         ("gelf", GelfEncoder), ("gelf", LTSVEncoder),
         ("gelf", CapnpEncoder), ("gelf", RFC5424Encoder),
     ]
@@ -266,6 +266,29 @@ def test_gelf_rfc5424_block(merger):
     packed = pack.pack_lines_2d(lines * 3, 256)
     handle = block_submit("gelf", packed)
     res, _, _ = block_fetch_encode("gelf", handle, packed, enc, merger)
+    assert res is not None
+    want = b"".join(scalar_frames(dec, lines * 3, merger, enc=enc))
+    assert res.block.data == want
+
+
+@pytest.mark.parametrize("merger", [LineMerger(), NulMerger(),
+                                    SyslenMerger()],
+                         ids=["line", "nul", "syslen"])
+def test_ltsv_rfc5424_block(merger):
+    """ltsv→RFC5424 (round 5): constant <13> PRI, rfc3339-ms stamps
+    (rfc3339 + unix-literal forms), SD pairs in part order."""
+    from flowgger_tpu.encoders.rfc5424 import RFC5424Encoder
+
+    enc = RFC5424Encoder(Config.from_string(""))
+    dec = LTSVDecoder(Config.from_string(""))
+    lines = [
+        # fallback FIRST (repeated special): ordering safety
+        b"time:2023-09-20T12:35:45Z\thost:a\thost:b\tmessage:rep",
+    ] + LTSV_LINES
+    packed = pack.pack_lines_2d(lines * 3, 256)
+    handle = block_submit("ltsv", packed)
+    res, _, _ = block_fetch_encode("ltsv", handle, packed, enc, merger,
+                                   dec)
     assert res is not None
     want = b"".join(scalar_frames(dec, lines * 3, merger, enc=enc))
     assert res.block.data == want

@@ -179,8 +179,8 @@ def corpus(n, gen):
 ROUTES = [
     ("rfc5424", RFC5424Decoder, [GelfEncoder, PassthroughEncoder, RFC5424Encoder, LTSVEncoder, CapnpEncoder], gen_rfc5424),
     ("rfc3164", RFC3164Decoder, [GelfEncoder, PassthroughEncoder, RFC3164Encoder, CapnpEncoder, LTSVEncoder, RFC5424Encoder], gen_rfc3164),
-    ("ltsv", LTSVDecoder, [GelfEncoder, CapnpEncoder, LTSVEncoder], gen_ltsv),
-    ("ltsv", TypedLTSVDecoder, [GelfEncoder, CapnpEncoder, LTSVEncoder], gen_ltsv_typed),
+    ("ltsv", LTSVDecoder, [GelfEncoder, CapnpEncoder, LTSVEncoder, RFC5424Encoder], gen_ltsv),
+    ("ltsv", TypedLTSVDecoder, [GelfEncoder, CapnpEncoder, LTSVEncoder, RFC5424Encoder], gen_ltsv_typed),
     ("gelf", GelfDecoder, [GelfEncoder, LTSVEncoder, CapnpEncoder, RFC5424Encoder], gen_gelf),
 ]
 MERGERS = [None, LineMerger(), NulMerger(), SyslenMerger()]
