@@ -225,14 +225,16 @@ def encode_auto_gelf_blocks(packed, encoder, merger, ltsv_decoder=None,
                             route_state=None, sharded_for=None):
     """Block-encode a mixed batch: classify, submit every class's kernel
     (device work for independent classes overlaps via JAX async
-    dispatch), run each class's columnar GELF route on its row subset,
-    and merge the per-class buffers back into input order with one
-    segment gather.  Returns a BlockResult or None when any leg is
-    inapplicable (typed ltsv_schema, gelf_extra, unsupported merger) —
-    the caller then uses the Record path."""
+    dispatch), run each class's columnar encode route — GELF, capnp,
+    LTSV, or RFC5424, all four classes support each (round 5) — on its
+    row subset, and merge the per-class buffers back into input order
+    with one segment gather.  Returns a BlockResult or None when any
+    leg is inapplicable (typed ltsv_schema, gelf_extra, unsupported
+    merger) — the caller then uses the Record path."""
     import numpy as np
 
     from ..block import EncodedBlock
+    from ..encoders.gelf import GelfEncoder
     from .assemble import concat_segments, exclusive_cumsum
     from .block_common import BlockResult, merger_suffix
     from . import pack as packmod
@@ -241,7 +243,11 @@ def encode_auto_gelf_blocks(packed, encoder, merger, ltsv_decoder=None,
     if ltsv_decoder is None:
         ltsv_decoder = LTSVDecoder(Config.from_string(""))
     spec = merger_suffix(merger)
-    if spec is None or encoder.extra:
+    if spec is None:
+        return None
+    # gelf_extra needs static placement the gelf leg cannot provide;
+    # capnp_extra / ltsv_extra render inside their legs
+    if type(encoder) is GelfEncoder and encoder.extra:
         return None
     if ltsv_decoder.schema:
         return None

@@ -118,11 +118,8 @@ class BatchHandler(Handler):
                                     LTSVEncoder, CapnpEncoder)
                   or passthrough_ok))
             or (fmt in ("rfc3164", "ltsv", "gelf", "auto")
-                and type(encoder) is GelfEncoder)
-            or (fmt in ("rfc3164", "ltsv", "gelf")
-                and type(encoder) in (CapnpEncoder, LTSVEncoder))
-            or (fmt in ("rfc3164", "ltsv", "gelf")
-                and type(encoder) is RFC5424Encoder)
+                and type(encoder) in (GelfEncoder, CapnpEncoder,
+                                      LTSVEncoder, RFC5424Encoder))
             or (fmt == "rfc3164"
                 and (passthrough_ok
                      or type(encoder) is RFC3164Encoder)))
@@ -421,8 +418,12 @@ class BatchHandler(Handler):
             return (type(self.encoder) is GelfEncoder
                     and not self.encoder.extra)
         if self.fmt == "auto":
-            return (type(self.encoder) is GelfEncoder
-                    and not self.encoder.extra
+            # every class leg supports all four columnar encoders
+            # (round 5); gelf_extra still needs static placement
+            if type(self.encoder) is GelfEncoder and self.encoder.extra:
+                return False
+            return (type(self.encoder) in (GelfEncoder, CapnpEncoder,
+                                           LTSVEncoder, RFC5424Encoder)
                     and not (self._auto_ltsv and self._auto_ltsv.schema))
         if type(self.encoder) is GelfEncoder:
             # extras with static placement ride the columnar route as
@@ -455,16 +456,15 @@ class BatchHandler(Handler):
                        f"encoder for input format '{self.fmt}'")
         from ..encoders.capnp import CapnpEncoder
 
-        if t is CapnpEncoder:
-            if self.fmt == "ltsv":
-                # the only capnp blocker on the ltsv route
-                return "input.ltsv_schema is set"
-            return no_columnar
         from ..encoders.ltsv import LTSVEncoder
         from ..encoders.rfc5424 import RFC5424Encoder
 
-        if t in (LTSVEncoder, RFC5424Encoder) and self.fmt == "ltsv":
-            return "input.ltsv_schema is set"
+        if t in (CapnpEncoder, LTSVEncoder, RFC5424Encoder):
+            if self.fmt in ("ltsv", "auto"):
+                # every class leg supports these encoders; the only
+                # blocker left is the typed schema on the ltsv leg
+                return "input.ltsv_schema is set"
+            return no_columnar
         if t is GelfEncoder:
             # GELF output is columnar for every kernel format, so the
             # only possible blockers are the extras / the auto schema

@@ -292,3 +292,60 @@ def test_ltsv_rfc5424_block(merger):
     assert res is not None
     want = b"".join(scalar_frames(dec, lines * 3, merger, enc=enc))
     assert res.block.data == want
+
+
+@pytest.mark.parametrize("enc_name", ["capnp", "ltsv", "rfc5424"])
+def test_auto_non_gelf_block_routes(enc_name):
+    """auto→{capnp, LTSV, RFC5424} (round 5): every class leg supports
+    the encoder, so mixed batches block-encode per class and merge back
+    into input order."""
+    import queue
+
+    from flowgger_tpu.block import EncodedBlock
+    from flowgger_tpu.decoders.gelf import GelfDecoder
+    from flowgger_tpu.decoders.rfc5424 import RFC5424Decoder
+    from flowgger_tpu.encoders.capnp import CapnpEncoder
+    from flowgger_tpu.encoders.rfc5424 import RFC5424Encoder
+    from flowgger_tpu.tpu.batch import BatchHandler
+
+    enc = {"capnp": CapnpEncoder, "ltsv": LTSVEncoder,
+           "rfc5424": RFC5424Encoder}[enc_name](Config.from_string(""))
+    mixed = [
+        b"<13>1 2023-09-20T12:35:45Z h5424 app 1 m [sd@1 k=\"v\"] hi",
+        b"time:2023-09-20T12:35:45Z\thost:hltsv\tk:v\tmessage:lt",
+        b'{"host":"hgelf","timestamp":1695213345,"_k":"v",'
+        b'"short_message":"ge"}',
+        b"<34>Oct 11 22:14:15 h3164 su: legacy line",
+    ] * 4
+    tx = queue.Queue()
+    h = BatchHandler(tx, RFC5424Decoder(), enc, Config.from_string(""),
+                     fmt="auto", start_timer=False, merger=LineMerger())
+    assert h._fast_encode and h._block_route_ok()
+    for ln in mixed:
+        h.handle_bytes(ln)
+    h.flush()
+    data = b""
+    saw_block = False
+    while not tx.empty():
+        item = tx.get_nowait()
+        saw_block |= isinstance(item, EncodedBlock)
+        data += (item.data if isinstance(item, EncodedBlock)
+                 else LineMerger().frame(item))
+    assert saw_block
+    # scalar want: classify per line like the auto scalar path
+    want = b""
+    decs = {"5424": RFC5424Decoder(), "3164": RFC3164Decoder(),
+            "ltsv": LTSVDecoder(Config.from_string("")),
+            "gelf": GelfDecoder()}
+    for ln in mixed:
+        t = ln.decode()
+        if t.startswith("{"):
+            d = decs["gelf"]
+        elif "\t" in t:
+            d = decs["ltsv"]
+        elif t.startswith("<13>1 "):
+            d = decs["5424"]
+        else:
+            d = decs["3164"]
+        want += LineMerger().frame(enc.encode(d.decode(t)))
+    assert data == want
