@@ -284,6 +284,7 @@ def test_record_path_cliff_warns_at_startup(capsys):
     JSON route, an encoder with no columnar path for the input format)
     must say so once at construction, naming the key."""
     from flowgger_tpu.decoders.ltsv import LTSVDecoder
+    from flowgger_tpu.encoders.rfc3164 import RFC3164Encoder
     from flowgger_tpu.encoders.rfc5424 import RFC5424Encoder
 
     enc_extra = GelfEncoder(Config.from_string(
@@ -294,12 +295,20 @@ def test_record_path_cliff_warns_at_startup(capsys):
     err = capsys.readouterr().err
     assert "output.gelf_extra" in err and "block route disabled" in err
 
+    # ltsv→RFC5424 became a columnar route in round 5: no warning
     BatchHandler(queue.Queue(), LTSVDecoder(Config.from_string("")),
                  RFC5424Encoder(Config.from_string("")),
                  Config.from_string(""), fmt="ltsv",
                  start_timer=False, merger=LineMerger())
+    assert "block route disabled" not in capsys.readouterr().err
+
+    # ltsv→RFC3164 (relay downgrade) still has no columnar encoder
+    BatchHandler(queue.Queue(), LTSVDecoder(Config.from_string("")),
+                 RFC3164Encoder(Config.from_string("")),
+                 Config.from_string(""), fmt="ltsv",
+                 start_timer=False, merger=LineMerger())
     err = capsys.readouterr().err
-    assert "RFC5424Encoder" in err and "block route disabled" in err
+    assert "RFC3164Encoder" in err and "block route disabled" in err
 
     # engaged routes: no warning (incl. the new capnp columnar route)
     from flowgger_tpu.encoders.capnp import CapnpEncoder
