@@ -9,7 +9,10 @@ set -euo pipefail
 cd "$(dirname "$0")"
 
 echo "== python test suite (virtual 8-device CPU mesh) =="
-python -m pytest tests/ -q
+python -m pytest tests/ -q -m "not faults"
+
+echo "== fault-injection suite (robustness degradation paths) =="
+JAX_PLATFORMS=cpu python -m pytest tests/ -q -m "faults and not slow"
 
 echo "== native build =="
 make -C native -s

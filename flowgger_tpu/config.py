@@ -7,7 +7,10 @@ raises ``ConfigError`` with the same messages the reference panics with.
 
 from __future__ import annotations
 
-import tomllib
+try:
+    import tomllib
+except ModuleNotFoundError:  # Python < 3.11: tomli is API-compatible
+    import tomli as tomllib
 from typing import Any, Optional
 
 
@@ -66,6 +69,14 @@ class Config:
         if isinstance(v, bool) or not isinstance(v, int):
             raise ConfigError(err)
         return v
+
+    def lookup_float(self, path: str, err: str, default: Optional[float] = None) -> Optional[float]:
+        v = self.lookup(path)
+        if v is None:
+            return default
+        if isinstance(v, bool) or not isinstance(v, (int, float)):
+            raise ConfigError(err)
+        return float(v)
 
     def lookup_bool(self, path: str, err: str, default: Optional[bool] = None) -> Optional[bool]:
         v = self.lookup(path)

@@ -7,8 +7,13 @@ Each of ``input.redis_threads`` workers:
    re-enqueued, giving at-least-once delivery);
 2. loops BRPOPLPUSH main → tmp, processes the message, then LREMs it
    from tmp.
-Connection loss logs ``Redis connection lost, aborting`` and exits the
-process with status 1 (the reference's supervisor-restart contract).
+Connection loss *reconnects in-process* with the shared RetryPolicy
+(jittered exponential backoff, ``input.redis_retry_*`` keys) — the
+reliable-queue drain on reconnect re-enqueues in-flight messages, so
+at-least-once delivery holds across reconnects exactly as it does
+across process restarts.  Only an exhausted retry budget (when
+``input.redis_retry_attempts`` is set; default unlimited) falls back to
+the reference's exit-1 supervisor-restart contract.
 Wire protocol is the built-in RESP client (utils/resp.py) — no redis-py
 dependency.
 """
@@ -17,14 +22,18 @@ from __future__ import annotations
 
 import sys
 import threading
+import time
 
 from . import Input
 from ..config import Config
 from ..utils.resp import RespClient, RespError
+from ..utils.retry import RetryPolicy, retry_config_kwargs
 
 DEFAULT_CONNECT = "127.0.0.1"
 DEFAULT_QUEUE_KEY = "logs"
 DEFAULT_THREADS = 1
+DEFAULT_RETRY_INIT = 200
+DEFAULT_RETRY_MAX = 10_000
 
 
 class RedisWorker:
@@ -76,15 +85,30 @@ class RedisInput(Input):
         self.threads = config.lookup_int(
             "input.redis_threads", "input.redis_threads must be a 32-bit integer",
             DEFAULT_THREADS)
+        self._retry_kw = retry_config_kwargs(
+            config, "input.redis",
+            init_ms=DEFAULT_RETRY_INIT, max_ms=DEFAULT_RETRY_MAX)
         self.exit_on_failure = True  # tests disable to keep pytest alive
 
     def _worker(self, tid: int, handler_factory):
-        try:
-            worker = RedisWorker(tid, self.connect, self.queue_key,
-                                 handler_factory())
-            worker.run()
-        except RuntimeError as e:
-            print(f"Redis connection lost, aborting - {e}", file=sys.stderr)
+        handler = handler_factory()
+        policy = RetryPolicy(metric="input_reconnects", **self._retry_kw)
+        while True:
+            policy.mark()
+            started = time.monotonic()
+            try:
+                worker = RedisWorker(tid, self.connect, self.queue_key,
+                                     handler)
+                worker.run()
+                return  # unreachable today; future clean-shutdown hook
+            except (RuntimeError, OSError) as e:
+                print(f"Redis connection lost - {e}", file=sys.stderr)
+                policy.note_run(started)  # stable runs earn a fresh budget
+                if policy.backoff() is None:
+                    print("Redis connection lost, aborting", file=sys.stderr)
+                    break
+                print(f"Reconnecting to Redis [{self.connect}] "
+                      f"(attempt #{policy.attempts})", file=sys.stderr)
         if self.exit_on_failure:
             import os
 

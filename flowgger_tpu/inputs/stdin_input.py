@@ -15,6 +15,23 @@ from ..splitters import get_splitter
 DEFAULT_FRAMING = "line"
 
 
+class _PipeStream:
+    """``read(n)`` that returns as soon as *some* bytes arrive.
+
+    ``BufferedReader.read(n)`` on a pipe blocks until n bytes or EOF, so
+    a daemon fed over a still-open pipe would sit on buffered lines
+    indefinitely; ``read1`` returns after one raw read — the reference's
+    ``BufReader`` fill semantics."""
+
+    def __init__(self, buf):
+        self.buf = buf
+
+    def read(self, n: int) -> bytes:
+        if hasattr(self.buf, "read1"):
+            return self.buf.read1(n)
+        return self.buf.read(n)
+
+
 class StdinInput(Input):
     def __init__(self, config: Config):
         framing = config.lookup("input.framing")
@@ -28,4 +45,4 @@ class StdinInput(Input):
 
     def accept(self, handler_factory) -> None:
         splitter = get_splitter(self.framing)
-        splitter.run(sys.stdin.buffer, handler_factory())
+        splitter.run(_PipeStream(sys.stdin.buffer), handler_factory())

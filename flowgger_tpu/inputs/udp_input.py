@@ -85,36 +85,57 @@ class UdpInput(Input):
             from ..utils import recvmmsg as _rm
 
             if _rm.available():
-                self._accept_batched(sock, handler)
-                return
+                if self._accept_batched(sock, handler):
+                    return  # socket closed: normal exit
+                # the syscall exists but doesn't work (sandboxed/old
+                # kernels return EINVAL/ENOSYS): degrade to recvfrom
+                # instead of silently killing the input
+                print("recvmmsg unusable on this kernel; falling back to "
+                      "per-datagram recvfrom", file=sys.stderr)
+        import errno
+
         while True:
             try:
                 data, _src = sock.recvfrom(MAX_UDP_PACKET_SIZE)
-            except OSError:
+            except OSError as e:
+                # a closed socket must end the loop (so the pipeline can
+                # drain), not busy-spin on EBADF forever
+                if e.errno == errno.EBADF or sock.fileno() < 0:
+                    return
                 continue
             handle_record_maybe_compressed(data, handler)
 
     @staticmethod
-    def _accept_batched(sock, handler) -> None:
+    def _accept_batched(sock, handler) -> bool:
         """recvmmsg fast path for span-capable handlers: up to 64
         datagrams per syscall; plain datagrams compact into one chunk
         and flow as frame spans with zero per-datagram Python, while
         compressed ones (zlib/gzip magic) take the sniffing path.
         Relative ordering between plain and compressed datagrams of one
-        batch is unspecified — UDP guarantees no ordering anyway."""
+        batch is unspecified — UDP guarantees no ordering anyway.
+
+        Returns True on a normal exit (socket closed) and False when the
+        syscall itself is unusable before ever delivering a batch, so
+        the caller can fall back to the scalar recvfrom loop."""
+        import errno
         import numpy as np
 
         from ..tpu.assemble import concat_segments, exclusive_cumsum
         from ..utils.recvmmsg import BatchReceiver
 
         rx = BatchReceiver(sock)
+        delivered = False
         while True:
             try:
                 got = rx.recv_batch()
-            except OSError:
-                return
+            except OSError as e:
+                if not delivered and e.errno in (
+                        errno.EINVAL, errno.ENOSYS, errno.EOPNOTSUPP):
+                    return False
+                return True
             if got is None:
                 continue
+            delivered = True
             buf, starts, lens = got
             b0 = buf[starts]
             b1 = buf[starts + 1]

@@ -29,6 +29,13 @@ KNOWN_KEYS = {
     "input.tpu_batch_size", "input.tpu_flush_ms", "input.tpu_max_line_len",
     "input.tpu_coordinator", "input.tpu_num_processes",
     "input.tpu_process_id", "input.tpu_mesh", "input.tpu_sp",
+    # robustness layer
+    "input.queue_policy",
+    "input.tpu_breaker", "input.tpu_breaker_failures",
+    "input.tpu_breaker_cooldown_ms", "input.tpu_breaker_window",
+    "input.tpu_breaker_fallback_ratio",
+    "input.redis_retry_init", "input.redis_retry_max",
+    "input.redis_retry_attempts",
     # [output] — per-output config sites
     "output.type", "output.format", "output.framing", "output.connect",
     "output.timeout", "output.file_path", "output.file_buffer_size",
@@ -43,15 +50,22 @@ KNOWN_KEYS = {
     "output.tls_async", "output.tls_recovery_delay_init",
     "output.tls_recovery_delay_max", "output.tls_recovery_probe_time",
     "output.syslog_prepend_timestamp",
+    "output.kafka_retry_init", "output.kafka_retry_max",
+    "output.kafka_retry_attempts",
     # [metrics] — observability extension
     "metrics.interval", "metrics.path", "metrics.jsonl",
     "metrics.jax_profile_dir",
+    # [supervisor] — thread crash/restart policy
+    "supervisor.max_restarts", "supervisor.backoff_init",
+    "supervisor.backoff_max",
 }
 
 # tables whose sub-keys are user-defined
 FREE_TABLES = {
     "input.ltsv_schema", "input.ltsv_suffixes",
     "output.gelf_extra", "output.ltsv_extra", "output.capnp_extra",
+    # fault-injection sites (validated by utils.faultinject at boot)
+    "faults",
 }
 
 

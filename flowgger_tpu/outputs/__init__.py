@@ -30,11 +30,21 @@ def stream_bytes(item, merger: Optional[Merger]):
 
 
 class Output:
+    # set by Pipeline.start_output: sink workers then spawn supervised
+    # (crash → restart with backoff, thread_crashes/thread_restarts
+    # metrics) instead of dying silently
+    supervisor = None
+
     def start(self, arx, merger: Optional[Merger]):
         raise NotImplementedError
 
+    def spawn(self, target, name: str) -> threading.Thread:
+        return spawn_worker(target, name, self.supervisor)
 
-def spawn_worker(target, name: str) -> threading.Thread:
+
+def spawn_worker(target, name: str, supervisor=None) -> threading.Thread:
+    if supervisor is not None:
+        return supervisor.spawn(target, name)
     t = threading.Thread(target=target, name=name, daemon=True)
     t.start()
     return t

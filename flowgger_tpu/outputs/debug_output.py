@@ -9,7 +9,7 @@ from __future__ import annotations
 
 import sys
 
-from . import Output, SHUTDOWN, spawn_worker, stream_bytes
+from . import Output, SHUTDOWN, stream_bytes
 
 
 class DebugOutput(Output):
@@ -28,4 +28,4 @@ class DebugOutput(Output):
                 sys.stdout.flush()
                 arx.task_done()
 
-        return spawn_worker(run, "debug-output")
+        return self.spawn(run, "debug-output")

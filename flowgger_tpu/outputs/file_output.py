@@ -11,8 +11,9 @@ from __future__ import annotations
 
 import sys
 
-from . import Output, SHUTDOWN, spawn_worker, stream_bytes
+from . import Output, SHUTDOWN, stream_bytes
 from ..block import EncodedBlock
+from ..utils import faultinject as _faults
 from ..utils.metrics import registry as _metrics
 from ..config import Config, ConfigError
 from ..encoders import validate_time_format_input
@@ -90,25 +91,75 @@ class FileOutput(Output):
             raise RuntimeError(f"Cannot open file to {self.path}")
 
         rotating = self.rotation_size > 0 or self.rotation_time > 0
+        # boxes, not closure variables: a supervised restart re-enters
+        # run() and must (a) swap in a fresh writer when the old fd went
+        # bad, and (b) deliver the retained item whose write failed —
+        # retention beats a queue requeue (no drop, no reorder, no
+        # blocking put from the sole consumer)
+        wbox = [writer]
+        carry = [None]
 
         def run():
+            if wbox[0] is None:
+                wbox[0] = self.open_writer()
+                if wbox[0] is None:
+                    # supervisor backoff handles the retry pacing
+                    raise RuntimeError(f"Cannot reopen file {self.path}")
             while True:
-                item = arx.get()
+                if carry[0] is not None:
+                    item, from_queue = carry[0], False
+                else:
+                    item, from_queue = arx.get(), True
                 if item is SHUTDOWN:
-                    if hasattr(writer, "flush"):
-                        writer.flush()
+                    if hasattr(wbox[0], "flush"):
+                        wbox[0].flush()
                     arx.task_done()
                     return
-                if isinstance(item, EncodedBlock) and rotating:
-                    # preserve the reference's per-message rotation
-                    # trigger granularity (rotating_file.rs:346-363)
-                    for framed in item.iter_framed():
-                        writer.write(framed)
-                    _metrics.inc("output_written", len(item))
-                else:
-                    data, count = stream_bytes(item, merger)
-                    writer.write(data)
-                    _metrics.inc("output_written", count)
-                arx.task_done()
+                written = 0
+                try:
+                    if _faults.enabled():
+                        _faults.maybe_raise("sink_write", OSError)
+                    if isinstance(item, EncodedBlock) and rotating:
+                        # preserve the reference's per-message rotation
+                        # trigger granularity (rotating_file.rs:346-363)
+                        for framed in item.iter_framed():
+                            wbox[0].write(framed)
+                            written += 1
+                        _metrics.inc("output_written", len(item))
+                    else:
+                        data, count = stream_bytes(item, merger)
+                        wbox[0].write(data)
+                        _metrics.inc("output_written", count)
+                except OSError:
+                    _metrics.inc("output_errors")
+                    if from_queue:
+                        arx.task_done()
+                    if (isinstance(item, EncodedBlock) and written
+                            and self.buffer_size == 0):
+                        # unbuffered writer: a successful write() call
+                        # reached the fd, so retain only the unwritten
+                        # tail — already-written frames must not
+                        # duplicate on redelivery.  With a BufferedWriter
+                        # a write() may only have buffered (a flush-time
+                        # failure would lose trimmed frames), so the
+                        # whole block is retained instead: at-least-once.
+                        _metrics.inc("output_written", written)
+                        item = EncodedBlock(
+                            item.data, item.bounds[written:],
+                            None if item.prefix_lens is None
+                            else item.prefix_lens[written:],
+                            item.suffix_len)
+                    carry[0] = item
+                    # the fd may be what broke: reopen on restart
+                    try:
+                        if hasattr(wbox[0], "close"):
+                            wbox[0].close()
+                    except OSError:
+                        pass
+                    wbox[0] = None
+                    raise
+                carry[0] = None
+                if from_queue:
+                    arx.task_done()
 
-        return spawn_worker(run, "file-output")
+        return self.spawn(run, "file-output")

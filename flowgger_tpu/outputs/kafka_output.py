@@ -15,18 +15,21 @@ protocol client.
 from __future__ import annotations
 
 import sys
-import threading
 
 from . import Output, SHUTDOWN
 from ..block import EncodedBlock
 from ..config import Config, ConfigError
 from ..utils.kafka_wire import KafkaError, KafkaProducer
+from ..utils.retry import RetryExhausted, RetryPolicy, retry_config_kwargs
 
 KAFKA_DEFAULT_ACKS = 0
 KAFKA_DEFAULT_COALESCE = 1
 KAFKA_DEFAULT_COMPRESSION = "none"
 KAFKA_DEFAULT_THREADS = 1
 KAFKA_DEFAULT_TIMEOUT = 60_000
+KAFKA_DEFAULT_RETRY_INIT = 250
+KAFKA_DEFAULT_RETRY_MAX = 10_000
+KAFKA_DEFAULT_RETRY_ATTEMPTS = 3
 
 
 class KafkaOutput(Output):
@@ -64,23 +67,55 @@ class KafkaOutput(Output):
         if compression not in ("none", "gzip", "snappy"):
             raise ConfigError("Unsupported compression method")
         self.compression = compression
+        # retry-before-dying: the reference exits the process on the
+        # first unresponsive broker; here each connect/send gets
+        # output.kafka_retry_attempts tries with jittered exponential
+        # backoff first, and only exhaustion keeps the exit contract
+        self._retry_kw = retry_config_kwargs(
+            config, "output.kafka",
+            init_ms=KAFKA_DEFAULT_RETRY_INIT,
+            max_ms=KAFKA_DEFAULT_RETRY_MAX,
+            max_attempts=KAFKA_DEFAULT_RETRY_ATTEMPTS)
         self.exit_on_failure = True  # tests disable to keep pytest alive
 
+    def _send_retrying(self, policy, producer, batch) -> None:
+        """send_all with backoff; raises RetryExhausted when the broker
+        stays unresponsive through the whole retry budget."""
+        def send():
+            producer.send_all(self.topic, batch)
+
+        policy.run(send, retry_on=(KafkaError,),
+                   on_error=lambda e: print(
+                       f"Kafka send failed, retrying: [{e}]",
+                       file=sys.stderr))
+        policy.note_success()
+
     def _worker(self, arx, merger):
-        try:
+        policy = RetryPolicy(metric="sink_reconnects", **self._retry_kw)
+
+        def connect():
             producer = KafkaProducer(self.brokers, self.acks, self.timeout_ms,
                                      self.compression)
             producer.refresh_metadata(self.topic)
-        except KafkaError as e:
+            return producer
+
+        try:
+            producer = policy.run(
+                connect, retry_on=(KafkaError, OSError),
+                on_error=lambda e: print(
+                    f"Unable to connect to Kafka, retrying: [{e}]",
+                    file=sys.stderr))
+        except RetryExhausted as e:
             print(f"Unable to connect to Kafka: [{e}]")
             return self._die()
+        policy.note_success()
         queue_buf = []
         while True:
             item = arx.get()
             if item is SHUTDOWN:
                 try:
-                    producer.send_all(self.topic, queue_buf)
-                except KafkaError as e:
+                    self._send_retrying(policy, producer, queue_buf)
+                except RetryExhausted as e:
                     print(f"Kafka not responsive: [{e}]")
                     arx.task_done()
                     return self._die()
@@ -92,8 +127,8 @@ class KafkaOutput(Output):
                 queue_buf.append(item)
             if len(queue_buf) >= max(1, self.coalesce):
                 try:
-                    producer.send_all(self.topic, queue_buf)
-                except KafkaError as e:
+                    self._send_retrying(policy, producer, queue_buf)
+                except RetryExhausted as e:
                     print(f"Kafka not responsive: [{e}]")
                     arx.task_done()
                     return self._die()
@@ -109,10 +144,5 @@ class KafkaOutput(Output):
     def start(self, arx, merger):
         if merger is not None:
             print("Output framing is ignored with the Kafka output", file=sys.stderr)
-        threads = []
-        for _ in range(self.threads):
-            t = threading.Thread(target=self._worker, args=(arx, merger),
-                                 daemon=True, name="kafka-output")
-            t.start()
-            threads.append(t)
-        return threads
+        return [self.spawn(lambda: self._worker(arx, merger), "kafka-output")
+                for _ in range(self.threads)]

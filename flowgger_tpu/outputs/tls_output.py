@@ -22,10 +22,12 @@ import socket
 import ssl
 import sys
 import threading
-import time
 
 from . import Output, SHUTDOWN, stream_bytes
 from ..config import Config, ConfigError
+from ..utils import faultinject as _faults
+from ..utils.metrics import registry as _metrics
+from ..utils.retry import RetryPolicy
 
 DEFAULT_RECOVERY_DELAY_INIT = 1
 DEFAULT_RECOVERY_DELAY_MAX = 10_000
@@ -33,6 +35,10 @@ DEFAULT_RECOVERY_PROBE_TIME = 30_000
 DEFAULT_ASYNC = False
 DEFAULT_TIMEOUT = 3600
 DEFAULT_THREADS = 1
+
+# carry-slot stand-in for a consumed SHUTDOWN sentinel (which is None,
+# the slot's empty value): a failed final flush must not lose shutdown
+_CARRY_SHUTDOWN = object()
 
 
 class _Cluster:
@@ -122,7 +128,11 @@ class TlsOutput(Output):
         self.ctx = ctx
 
     # -- worker ------------------------------------------------------------
-    def _handle_connection(self, arx, merger, endpoint: str):
+    def _handle_connection(self, arx, merger, endpoint: str, carry: list):
+        """``carry`` is this worker's one-item retention slot: a message
+        whose write failed rides there (never back through the queue —
+        no drop, no reorder, no blocking put from the sole consumer) and
+        is delivered first on the next connection."""
         host, _, port = endpoint.rpartition(":")
         if not host or not port.isdigit():
             # malformed endpoint: treated as a failed connection so the
@@ -141,14 +151,32 @@ class TlsOutput(Output):
         buf = bytearray()
         try:
             while True:
-                item = arx.get()
+                if carry[0] is not None:
+                    item = (SHUTDOWN if carry[0] is _CARRY_SHUTDOWN
+                            else carry[0])
+                    from_queue = False
+                else:
+                    item, from_queue = arx.get(), True
                 if item is SHUTDOWN:
                     if buf:
-                        tls.sendall(bytes(buf))
-                    arx.task_done()
+                        try:
+                            tls.sendall(bytes(buf))
+                        except OSError:
+                            # shutdown must survive the reconnect: carry
+                            # it (the async-buffered bytes are lost with
+                            # the connection, as in the reference)
+                            carry[0] = _CARRY_SHUTDOWN
+                            if from_queue:
+                                arx.task_done()
+                            raise
+                    carry[0] = None
+                    if from_queue:
+                        arx.task_done()
                     return True
                 data, _ = stream_bytes(item, merger)
                 try:
+                    if _faults.enabled():
+                        _faults.maybe_raise("sink_write", BrokenPipeError)
                     if self.async_:
                         buf.extend(data)
                         if len(buf) >= 8192:
@@ -157,12 +185,15 @@ class TlsOutput(Output):
                     else:
                         tls.sendall(data)
                 except OSError:
-                    # connection died with the message in hand: requeue it
-                    # so the next connection delivers it
-                    arx.task_done()
-                    arx.put(item)
+                    # connection died with the message in hand: retain it
+                    # for redelivery on the next connection
+                    carry[0] = item
+                    if from_queue:
+                        arx.task_done()
                     raise
-                arx.task_done()
+                carry[0] = None
+                if from_queue:
+                    arx.task_done()
         finally:
             try:
                 tls.close()
@@ -170,12 +201,26 @@ class TlsOutput(Output):
                 pass
 
     def _worker(self, arx, merger):
-        recovery_delay = float(self.recovery_delay_init)
+        # the reference's randomized additive backoff with a stability
+        # probe (tls_output.rs:163-172), expressed as the shared policy;
+        # every backoff bumps sink_reconnects
+        policy = RetryPolicy(
+            init_ms=self.recovery_delay_init, max_ms=self.recovery_delay_max,
+            mode="additive", probe_ms=self.recovery_probe_time,
+            metric="sink_reconnects")
+        carry = [None]  # one-item retention slot (see _handle_connection)
+        prev_endpoint = None
         while True:
-            last_recovery = time.monotonic()
+            policy.mark()
             endpoint = self.cluster.next_endpoint()
+            if prev_endpoint is not None and endpoint != prev_endpoint:
+                # an actual rotation to another cluster member — a
+                # same-endpoint reconnect is only counted by
+                # sink_reconnects
+                _metrics.inc("sink_failovers")
+            prev_endpoint = endpoint
             try:
-                if self._handle_connection(arx, merger, endpoint):
+                if self._handle_connection(arx, merger, endpoint, carry):
                     return  # graceful shutdown
             except ConnectionRefusedError:
                 print(f"Connection to {endpoint} refused", file=sys.stderr)
@@ -185,19 +230,9 @@ class TlsOutput(Output):
             except OSError as e:
                 print(f"Error while communicating with {endpoint} - {e}",
                       file=sys.stderr)
-            elapsed_ms = (time.monotonic() - last_recovery) * 1000.0
-            if elapsed_ms > self.recovery_probe_time:
-                recovery_delay = float(self.recovery_delay_init)
-            elif recovery_delay < self.recovery_delay_max:
-                recovery_delay += random.uniform(0.0, recovery_delay)
-            time.sleep(round(recovery_delay) / 1000.0)
+            policy.backoff()  # unlimited policy: never exhausts
             print("Attempting to reconnect", file=sys.stderr)
 
     def start(self, arx, merger):
-        threads = []
-        for _ in range(self.threads):
-            t = threading.Thread(target=self._worker, args=(arx, merger),
-                                 daemon=True, name="tls-output")
-            t.start()
-            threads.append(t)
-        return threads
+        return [self.spawn(lambda: self._worker(arx, merger), "tls-output")
+                for _ in range(self.threads)]

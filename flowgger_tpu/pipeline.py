@@ -197,16 +197,31 @@ class Pipeline:
         queue_size = config.lookup_int(
             "input.queuesize", "input.queuesize must be a size integer", DEFAULT_QUEUE_SIZE
         )
-        self.tx: "queue.Queue[Optional[bytes]]" = queue.Queue(maxsize=queue_size)
+        queue_policy = config.lookup_str(
+            "input.queue_policy",
+            'input.queue_policy must be "block", "drop_newest" or "drop_oldest"',
+            "block")
+        from .utils.bounded_queue import POLICIES, PolicyQueue
+
+        if queue_policy not in POLICIES:
+            raise ConfigError(
+                'input.queue_policy must be "block", "drop_newest" or '
+                '"drop_oldest"')
+        self.tx: "queue.Queue[Optional[bytes]]" = PolicyQueue(
+            maxsize=queue_size, policy=queue_policy)
         self.input_format = input_format
         self.config = config
         self._handlers: list = []
         import threading
 
         self._handler_lock = threading.Lock()
+        from .supervise import Supervisor
+        from .utils import faultinject as _faultinject
         from .utils import metrics as _metrics_mod
 
         _metrics_mod.configure_from(config)
+        _faultinject.configure_from(config)
+        self.supervisor = Supervisor(config)
         if input_format in _TPU_FORMATS:
             # multi-host: join the JAX process group before any device
             # op so the decode mesh's dp axis can span every host's
@@ -243,6 +258,10 @@ class Pipeline:
         return handler
 
     def start_output(self):
+        # sinks spawn their consumer threads through the supervisor so a
+        # crashed worker restarts (with backoff + metrics) instead of
+        # silently wedging the bounded queue
+        self.output.supervisor = self.supervisor
         return self.output.start(self.tx, self.merger)
 
     def _drain(self, threads):
@@ -260,8 +279,18 @@ class Pipeline:
             self.tx.put(SHUTDOWN)
         for t in threads:
             t.join(timeout=30)
+        import sys
+
         from .utils import metrics as _metrics_mod
 
+        stragglers = [t for t in threads if t.is_alive()]
+        if stragglers:
+            # a sink that ignored SHUTDOWN for 30s is abandoned, not
+            # silently forgotten: name it and count it
+            _metrics_mod.registry.inc("drain_stragglers", len(stragglers))
+            names = ", ".join(t.name for t in stragglers)
+            print(f"drain: {len(stragglers)} output thread(s) still alive "
+                  f"after 30s, abandoning: [{names}]", file=sys.stderr)
         _metrics_mod.registry.final_flush()
         _metrics_mod.stop_jax_profiler()
 
@@ -287,7 +316,11 @@ class Pipeline:
         if not isinstance(threads, list):
             threads = [threads]
         self._install_signal_handlers(threads)
-        self.input.accept(self.handler_factory)
+        # the accept loop runs supervised: a crash in the transport
+        # restarts it (bounded by [supervisor] config) instead of
+        # killing the daemon while consumers still hold the queue
+        self.supervisor.run(self.input.accept, "input-accept",
+                            (self.handler_factory,))
         # Input ended (EOF on stdin, etc.): drain before exiting rather
         # than killing the daemon consumers mid-write.
         self._drain(threads)

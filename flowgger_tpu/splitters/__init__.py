@@ -144,8 +144,14 @@ class LineAssembler:
 def _read_stream(stream):
     """Yield chunks until EOF; idle timeouts print the reference's
     WouldBlock close notice (line_splitter.rs:26-33) and end the stream."""
+    from ..utils import faultinject as _faults
+
     while True:
         try:
+            if _faults.enabled():
+                # chaos site: a reset here closes this connection like a
+                # real peer reset; the accept loop keeps serving
+                _faults.maybe_raise("input_socket", ConnectionResetError)
             chunk = stream.read(_CHUNK)
         except TimeoutError:
             print(

@@ -1,0 +1,114 @@
+"""Thread supervision: crashed pipeline threads restart instead of
+silently dying.
+
+The reference delegates recovery to an external supervisor — several
+components exit the whole process on error (kafka_output.rs,
+redis_input.rs) and a panicked output thread simply stops consuming,
+wedging the bounded queue.  This module gives the pipeline an in-process
+supervisor: input-accept and output-consumer threads run inside a
+restart loop with the shared ``RetryPolicy`` backoff, crashes and
+restarts are counted (``thread_crashes`` / ``thread_restarts``), and a
+thread that exhausts its restart budget logs loudly instead of wedging
+silently.
+
+Config (all optional)::
+
+    [supervisor]
+    max_restarts = 16     # per thread between stable runs; absent = unlimited
+    backoff_init = 100    # ms
+    backoff_max = 30000   # ms
+
+A supervised target that *returns* is treated as a clean exit (output
+workers return on the SHUTDOWN sentinel); only exceptions trigger a
+restart.  A run that stays up longer than ``backoff_max`` resets the
+thread's restart budget, so a daemon that crashes once a day never
+exhausts it.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+import traceback
+from typing import Optional
+
+from .utils.metrics import registry as _metrics
+from .utils.retry import RetryPolicy
+
+DEFAULT_BACKOFF_INIT_MS = 100
+DEFAULT_BACKOFF_MAX_MS = 30_000
+
+
+class Supervisor:
+    def __init__(self, config=None):
+        if config is not None:
+            self.max_restarts: Optional[int] = config.lookup_int(
+                "supervisor.max_restarts",
+                "supervisor.max_restarts must be an integer", None)
+            self.backoff_init = config.lookup_int(
+                "supervisor.backoff_init",
+                "supervisor.backoff_init must be an integer (ms)",
+                DEFAULT_BACKOFF_INIT_MS)
+            self.backoff_max = config.lookup_int(
+                "supervisor.backoff_max",
+                "supervisor.backoff_max must be an integer (ms)",
+                DEFAULT_BACKOFF_MAX_MS)
+        else:
+            self.max_restarts = None
+            self.backoff_init = DEFAULT_BACKOFF_INIT_MS
+            self.backoff_max = DEFAULT_BACKOFF_MAX_MS
+
+    def _policy(self) -> RetryPolicy:
+        return RetryPolicy(init_ms=self.backoff_init, max_ms=self.backoff_max,
+                           max_attempts=self.max_restarts,
+                           metric="thread_restarts")
+
+    def run(self, target, name: str, args: tuple = (),
+            exhausted: str = "return") -> None:
+        """Run ``target(*args)`` in the calling thread under supervision:
+        restart on crash with backoff until it returns normally or the
+        restart budget is spent.
+
+        ``exhausted`` controls budget exhaustion: ``"return"`` (input
+        loops — the pipeline then drains and exits gracefully) or
+        ``"exit"`` (queue consumers — a dead sole consumer would wedge
+        every producer on the bounded queue forever, so honor the
+        reference's exit-1 external-supervisor contract instead)."""
+        policy = self._policy()
+        while True:
+            started = time.monotonic()
+            try:
+                target(*args)
+                return
+            except SystemExit:
+                raise
+            except BaseException:  # noqa: BLE001 - supervision boundary
+                _metrics.inc("thread_crashes")
+                print(f"supervised thread [{name}] crashed:",
+                      file=sys.stderr)
+                traceback.print_exc()
+                policy.note_run(started)  # long runs earn a fresh budget
+                if policy.backoff() is None:
+                    print(
+                        f"supervised thread [{name}] exceeded its restart "
+                        f"budget ({policy.attempts} restarts), giving up",
+                        file=sys.stderr)
+                    if exhausted == "exit":
+                        import os
+
+                        os._exit(1)
+                    return
+                print(f"restarting [{name}] "
+                      f"(restart #{policy.attempts})", file=sys.stderr)
+
+    def spawn(self, target, name: str, args: tuple = (),
+              exhausted: str = "exit") -> threading.Thread:
+        """Start a daemon thread running ``target`` under supervision.
+        Spawned threads default to ``exhausted="exit"`` — they are queue
+        consumers whose silent death would wedge the pipeline."""
+        t = threading.Thread(target=self.run, args=(target, name, args,
+                                                    exhausted),
+                             daemon=True, name=name)
+        t.start()
+        return t

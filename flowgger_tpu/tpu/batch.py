@@ -25,6 +25,7 @@ from ..config import Config
 from ..encoders import EncodeError
 from ..splitters import Handler, ScalarHandler
 from ..record import Record
+from ..utils import faultinject as _faults
 from ..utils.metrics import registry as _metrics
 
 DEFAULT_BATCH_SIZE = 16384
@@ -53,6 +54,14 @@ class BatchHandler(Handler):
         # scalar path for fallback rows and capnp handle_record
         self.scalar = ScalarHandler(tx, decoder, encoder)
         cfg = config or Config.from_string("")
+        self._cfg = cfg
+        # device-decode circuit breaker: trips the whole handler onto the
+        # scalar-oracle path on sustained device failure (None = disabled
+        # via input.tpu_breaker = false, legacy fail-fast behavior)
+        from .breaker import DecodeBreaker
+
+        self._breaker = DecodeBreaker.from_config(cfg)
+        self._auto_scalars: dict = {}  # per-class oracles for auto fallback
         self.batch_size = cfg.lookup_int(
             "input.tpu_batch_size", "input.tpu_batch_size must be an integer",
             DEFAULT_BATCH_SIZE)
@@ -305,30 +314,25 @@ class BatchHandler(Handler):
 
         region = b"".join(chunks)
         sep = self.ingest_sep
-        if self._kernel_fn is None:
-            # formats without a columnar kernel: split once in C speed
-            lines = region.split(sep)
-            lines.pop()  # regions end with the separator
-            if self.ingest_strip_cr:
-                lines = [ln[:-1] if ln.endswith(b"\r") else ln
-                         for ln in lines]
-            for raw in lines:
-                self.scalar.handle_bytes(raw)
+        if self._kernel_fn is None or not self._device_allowed():
+            # no columnar kernel, or the breaker is open: split once in
+            # C speed and run the scalar oracle per line
+            self._scalar_region(region, sep)
             return
-        self._dispatch_packed(pack.pack_region_2d(
+        self._guarded_dispatch(pack.pack_region_2d(
             region, self.max_len, sep=sep[0],
             strip_cr=self.ingest_strip_cr))
 
     def _decode_spans(self, span_chunks, span_sets) -> None:
         from . import pack
 
-        if self._kernel_fn is None:
+        if self._kernel_fn is None or not self._device_allowed():
             for chunk, (starts, lens) in zip(span_chunks, span_sets):
                 for s, ln in zip(starts.tolist(), lens.tolist()):
-                    self.scalar.handle_bytes(chunk[s:s + ln])
+                    self._scalar_handle(chunk[s:s + ln])
             return
-        self._dispatch_packed(pack.pack_spans_2d(span_chunks, span_sets,
-                                                 self.max_len))
+        self._guarded_dispatch(pack.pack_spans_2d(span_chunks, span_sets,
+                                                  self.max_len))
 
     def _dispatch_packed(self, packed) -> None:
         """Route one packed tuple through the right decode/encode tier."""
@@ -344,19 +348,119 @@ class BatchHandler(Handler):
         self._emit(_decode_packed(self.fmt, packed, self.scalar.decoder))
 
     def _decode_batch(self, lines: List[bytes]) -> None:
-        if self._kernel_fn is None:
-            # formats without a columnar kernel yet: scalar per line
+        if self._kernel_fn is None or not self._device_allowed():
+            # no columnar kernel (or breaker open): scalar per line
             for raw in lines:
-                self.scalar.handle_bytes(raw)
+                self._scalar_handle(raw)
             return
-        if self._fast_encode:
-            from . import pack
+        try:
+            _faults.maybe_raise("device_decode")
+            if self._fast_encode:
+                from . import pack
 
-            packed = pack.pack_lines_2d(lines, self.max_len)
-            self._emit_fast(packed)
+                packed = pack.pack_lines_2d(lines, self.max_len)
+                self._emit_fast(packed)
+            else:
+                results = self._kernel_fn(lines)
+                self._emit(results)
+        except Exception as e:  # noqa: BLE001 - device degradation boundary
+            if self._breaker is None:
+                raise
+            self._device_failed(e)
+            for raw in lines:
+                self._scalar_handle(raw)
             return
-        results = self._kernel_fn(lines)
-        self._emit(results)
+        self._record_sync_success()
+
+    # -- degradation / circuit breaker -------------------------------------
+    def _device_allowed(self) -> bool:
+        return self._breaker is None or self._breaker.allow()
+
+    def _device_failed(self, e: BaseException) -> None:
+        print(f"device decode failed ({type(e).__name__}: {e}); "
+              f"re-decoding the batch through the scalar oracle",
+              file=sys.stderr)
+        self._breaker.record_failure(e)
+
+    def _record_sync_success(self) -> None:
+        """A device batch completed synchronously (no deferred fetch)."""
+        if self._breaker is not None and not self._inflight:
+            self._breaker.record_success()
+
+    def _guarded_dispatch(self, packed) -> None:
+        """Route one packed tuple to the device tier, degrading to the
+        scalar oracle (same bytes, no lines lost) on any device/XLA
+        error when the breaker is armed."""
+        depth0 = len(self._inflight)
+        try:
+            _faults.maybe_raise("device_decode")
+            self._dispatch_packed(packed)
+        except Exception as e:  # noqa: BLE001 - device degradation boundary
+            if self._breaker is None:
+                raise
+            while len(self._inflight) > depth0:  # drop half-queued work
+                self._inflight.pop()
+            self._device_failed(e)
+            self._scalar_fallback_packed(packed)
+            return
+        if len(self._inflight) == depth0:
+            # completed synchronously; deferred batches are judged at
+            # fetch time in _pop_emit instead
+            self._record_sync_success()
+
+    def _scalar_handle(self, raw: bytes) -> None:
+        """One line through the right scalar oracle, honoring the
+        splitter flags set on this handler."""
+        if self.fmt == "auto":
+            handler = self._auto_scalar_for(raw)
+        else:
+            handler = self.scalar
+        handler.quiet_empty = self.quiet_empty
+        handler.bare_errors = self.bare_errors
+        handler.handle_bytes(raw)
+
+    def _auto_scalar_for(self, raw: bytes) -> ScalarHandler:
+        """auto format: classify the line host-side (same decision table
+        as the device kernel) and use that class's scalar oracle, so the
+        degraded path stays byte-identical to the columnar one."""
+        from .autodetect import F_GELF, F_LTSV, F_RFC3164, F_RFC5424, classify
+
+        cls = classify(raw)
+        handler = self._auto_scalars.get(cls)
+        if handler is None:
+            if cls == F_RFC5424:
+                decoder = self.scalar.decoder
+            elif cls == F_LTSV:
+                decoder = self._auto_ltsv or self._auto_ltsv_decoder(self._cfg)
+            elif cls == F_GELF:
+                from ..decoders import GelfDecoder
+
+                decoder = GelfDecoder(self._cfg)
+            else:
+                from ..decoders import RFC3164Decoder
+
+                decoder = RFC3164Decoder(self._cfg)
+            handler = ScalarHandler(self.tx, decoder, self.encoder)
+            self._auto_scalars[cls] = handler
+        return handler
+
+    def _scalar_region(self, region: bytes, sep: bytes) -> None:
+        lines = region.split(sep)
+        lines.pop()  # regions end with the separator
+        if self.ingest_strip_cr:
+            lines = [ln[:-1] if ln.endswith(b"\r") else ln
+                     for ln in lines]
+        for raw in lines:
+            self._scalar_handle(raw)
+
+    def _scalar_fallback_packed(self, packed) -> None:
+        """Re-decode one packed tuple's rows through the scalar oracle:
+        the pack keeps the raw chunk plus per-row start/length vectors,
+        so the original line bytes reconstruct exactly."""
+        _batch, _lens, chunk, starts, orig_lens, n_real = packed
+        for i in range(n_real):
+            s = int(starts[i])
+            self._scalar_handle(bytes(chunk[s:s + int(orig_lens[i])]))
 
     def _block_route_ok(self) -> bool:
         """Cheap applicability check, evaluated before any kernel work so
@@ -516,9 +620,22 @@ class BatchHandler(Handler):
         self._emit(_decode_packed(self.fmt, packed, self.scalar.decoder))
 
     def _pop_emit(self) -> None:
+        handle, packed = self._inflight.popleft()
+        try:
+            _faults.maybe_raise("device_decode")
+            self._pop_emit_inner(handle, packed)
+        except Exception as e:  # noqa: BLE001 - device degradation boundary
+            if self._breaker is None:
+                raise
+            self._device_failed(e)
+            self._scalar_fallback_packed(packed)
+            return
+        if self._breaker is not None:
+            self._breaker.record_success()
+
+    def _pop_emit_inner(self, handle, packed) -> None:
         import time as _time
 
-        handle, packed = self._inflight.popleft()
         t0 = _time.perf_counter()
         if self.fmt == "auto":
             from .autodetect import decode_auto_packed, encode_auto_gelf_blocks
@@ -555,6 +672,8 @@ class BatchHandler(Handler):
 
     def _emit_block(self, res, n_real: int) -> None:
         _metrics.inc("input_lines", n_real)
+        if self._breaker is not None:
+            self._breaker.observe_batch(n_real, res.fallback_rows)
         if res.fallback_rows:
             _metrics.inc("fallback_rows", res.fallback_rows)
         for error, line in res.errors:

@@ -1,0 +1,197 @@
+"""Device-decode circuit breaker: keep the stream flowing when the
+accelerator path degrades.
+
+The batched decode path has a validated scalar fallback (the oracle
+decoders produce byte-identical output at lower throughput — the same
+property simdjson relies on to treat its fast path as optional).  This
+breaker makes the switch automatic and observable:
+
+- ``CLOSED``    — device path in use (normal);
+- ``OPEN``      — tripped: every batch decodes through the scalar
+  oracle; after ``cooldown_ms`` the next batch probes the device again;
+- ``HALF_OPEN`` — one probe batch in flight on the device; success
+  closes the breaker, failure re-opens it and restarts the cooldown.
+
+Trips on either of two signals:
+
+- ``failures`` consecutive device/XLA exceptions (each failed batch is
+  re-decoded by the oracle in place, so no lines are lost);
+- a sustained kernel-fallback ratio: when the last ``window`` batches
+  pushed more than ``fallback_ratio`` of their rows through the per-row
+  oracle anyway, the device round-trip is pure overhead and the breaker
+  trips proactively.
+
+State is exported as the ``device_breaker_state`` gauge (0 closed,
+1 open, 2 half-open) plus ``breaker_trips`` / ``breaker_recoveries``
+counters, and every transition is logged to stderr.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+from ..utils.metrics import registry as _metrics
+
+CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
+_STATE_GAUGE = {CLOSED: 0, OPEN: 1, HALF_OPEN: 2}
+
+DEFAULT_FAILURES = 3
+DEFAULT_COOLDOWN_MS = 5_000
+DEFAULT_WINDOW = 8
+DEFAULT_FALLBACK_RATIO = 0.95
+
+
+class DecodeBreaker:
+    def __init__(self, failures: int = DEFAULT_FAILURES,
+                 cooldown_ms: int = DEFAULT_COOLDOWN_MS,
+                 window: int = DEFAULT_WINDOW,
+                 fallback_ratio: Optional[float] = DEFAULT_FALLBACK_RATIO,
+                 clock=time.monotonic):
+        self.failures = max(1, failures)
+        self.cooldown_ms = cooldown_ms
+        self.window = max(1, window)
+        self.fallback_ratio = fallback_ratio
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._state = CLOSED
+        self._consecutive = 0
+        self._opened_at = 0.0
+        self._ratios: "deque[float]" = deque(maxlen=self.window)
+        self._trip_reason: Optional[str] = None  # "errors" | "ratio"
+        self._probe_ratio: Optional[float] = None
+        self.transitions: list = []  # (monotonic, from, to) history
+        # init without clobbering: another handler's breaker may already
+        # be publishing a non-closed state on the shared gauge
+        _metrics.init_gauge("device_breaker_state", 0)
+
+    @classmethod
+    def from_config(cls, config) -> Optional["DecodeBreaker"]:
+        """``input.tpu_breaker_*`` keys; returns None (no breaker, legacy
+        fail-fast behavior) when ``input.tpu_breaker = false``."""
+        enabled = config.lookup_bool(
+            "input.tpu_breaker", "input.tpu_breaker must be a boolean", True)
+        if not enabled:
+            return None
+        failures = config.lookup_int(
+            "input.tpu_breaker_failures",
+            "input.tpu_breaker_failures must be an integer",
+            DEFAULT_FAILURES)
+        cooldown = config.lookup_int(
+            "input.tpu_breaker_cooldown_ms",
+            "input.tpu_breaker_cooldown_ms must be an integer (ms)",
+            DEFAULT_COOLDOWN_MS)
+        window = config.lookup_int(
+            "input.tpu_breaker_window",
+            "input.tpu_breaker_window must be an integer (batches)",
+            DEFAULT_WINDOW)
+        ratio = config.lookup_float(
+            "input.tpu_breaker_fallback_ratio",
+            "input.tpu_breaker_fallback_ratio must be a number in (0, 1]",
+            DEFAULT_FALLBACK_RATIO)
+        if ratio is not None and not 0.0 < ratio <= 1.0:
+            from ..config import ConfigError
+
+            raise ConfigError(
+                "input.tpu_breaker_fallback_ratio must be a number in (0, 1]")
+        return cls(failures=failures, cooldown_ms=cooldown, window=window,
+                   fallback_ratio=ratio)
+
+    # -- state machine -----------------------------------------------------
+    def _transition(self, new: str, count_trip: bool = True) -> None:
+        old, self._state = self._state, new
+        self.transitions.append((self._clock(), old, new))
+        _metrics.set_gauge("device_breaker_state", _STATE_GAUGE[new])
+        if new == OPEN:
+            # re-opens after an uncured probe are the SAME logical trip:
+            # breaker_trips counts trip events, not cooldown cycles
+            if count_trip:
+                _metrics.inc("breaker_trips")
+            self._opened_at = self._clock()
+        elif new == CLOSED and old != CLOSED:
+            _metrics.inc("breaker_recoveries")
+        print(f"device-decode breaker: {old} -> {new}", file=sys.stderr)
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    def allow(self) -> bool:
+        """May this batch take the device path?  In OPEN state, the first
+        call after the cooldown becomes the half-open probe; everything
+        else stays on the oracle."""
+        with self._lock:
+            if self._state == CLOSED:
+                return True
+            if self._state == OPEN:
+                elapsed_ms = (self._clock() - self._opened_at) * 1000.0
+                if elapsed_ms >= self.cooldown_ms:
+                    self._transition(HALF_OPEN)
+                    return True  # this batch is the probe
+                return False
+            return False  # HALF_OPEN: probe already in flight
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._consecutive = 0
+            if self._state == HALF_OPEN:
+                if (self._trip_reason == "ratio"
+                        and self.fallback_ratio is not None
+                        and self._probe_ratio is not None
+                        and self._probe_ratio > self.fallback_ratio):
+                    # the device is healthy but the stream still pushes
+                    # nearly every row through the oracle: a "success"
+                    # doesn't cure a ratio trip — stay open (one probe
+                    # per cooldown, not an open/close flap every window)
+                    self._probe_ratio = None
+                    self._transition(OPEN, count_trip=False)
+                    return
+                self._ratios.clear()
+                self._trip_reason = None
+                self._probe_ratio = None
+                self._transition(CLOSED)
+
+    def record_failure(self, error: BaseException) -> None:
+        _metrics.inc("device_decode_errors")
+        with self._lock:
+            if self._state == HALF_OPEN:
+                # failed probe: back to cooldown (same logical trip)
+                self._transition(OPEN, count_trip=False)
+                return
+            self._consecutive += 1
+            if self._state == CLOSED and self._consecutive >= self.failures:
+                print(
+                    f"device-decode breaker tripping after "
+                    f"{self._consecutive} consecutive device errors "
+                    f"(last: {error})", file=sys.stderr)
+                self._trip_reason = "errors"
+                self._transition(OPEN)
+
+    def observe_batch(self, n_rows: int, fallback_rows: int) -> None:
+        """Feed one successful device batch's oracle-fallback share; a
+        full window above the threshold trips the breaker (the device
+        round-trip is not earning its keep)."""
+        if self.fallback_ratio is None or n_rows <= 0:
+            return
+        with self._lock:
+            if self._state == HALF_OPEN:
+                # the probe batch's own ratio: record_success consults it
+                # to decide whether a ratio trip is actually cured
+                self._probe_ratio = fallback_rows / n_rows
+                return
+            if self._state != CLOSED:
+                return
+            self._ratios.append(fallback_rows / n_rows)
+            if (len(self._ratios) == self.window
+                    and min(self._ratios) > self.fallback_ratio):
+                print(
+                    f"device-decode breaker tripping: fallback ratio > "
+                    f"{self.fallback_ratio} over the last {self.window} "
+                    f"batches", file=sys.stderr)
+                self._ratios.clear()
+                self._trip_reason = "ratio"
+                self._transition(OPEN)

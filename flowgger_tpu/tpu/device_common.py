@@ -17,6 +17,9 @@ TPU shape of that fusion, for every format pair that rides it.
 
 from __future__ import annotations
 
+import os
+import sys
+import threading
 from functools import lru_cache, partial
 from typing import Dict
 
@@ -29,6 +32,111 @@ from .materialize import compute_ts
 
 _I32 = jnp.int32
 _U8 = jnp.uint8
+
+# -- compile watchdog --------------------------------------------------------
+# The device-encode kernels are large; on some hosts/backends their XLA
+# compile can take minutes (observed: effectively unbounded on old CPU
+# containers).  The fast path is optional — a compile must never stall
+# the stream — so the first call of each kernel phase runs under a
+# wall-clock deadline: on timeout the compile keeps warming the jit
+# cache in a daemon thread while every batch meanwhile declines to the
+# host block-encode path (same bytes), and once the background compile
+# lands the device tier engages normally.
+COMPILE_TIMEOUT_ENV = "FLOWGGER_COMPILE_TIMEOUT_MS"
+COMPILE_TIMEOUT_MS_DEFAULT = 15_000
+
+_compile_slots: Dict[str, threading.Event] = {}
+_compile_ready = set()  # names that have completed once: call inline
+_compile_lock = threading.Lock()
+_compile_warned = set()
+# single-flight: at most ONE background kernel compile at a time.  The
+# big device-encode compiles are multi-GB XLA jobs; running several
+# concurrently (plus the foreground's own jit work) has crashed the
+# process on constrained hosts.  Queued compiles wait here — their
+# guarded callers decline instantly in the meantime.
+_compile_sema = threading.Semaphore(1)
+
+
+class CompileTimeout(Exception):
+    """A device-encode kernel is still compiling; decline this batch."""
+
+
+def _compile_deadline_s() -> float:
+    try:
+        ms = int(os.environ.get(COMPILE_TIMEOUT_ENV,
+                                COMPILE_TIMEOUT_MS_DEFAULT))
+    except ValueError:
+        ms = COMPILE_TIMEOUT_MS_DEFAULT
+    return ms / 1000.0
+
+
+def guarded_compile_call(name: str, fn, *args):
+    """Run a (potentially compiling) jit call with a deadline.
+
+    Raises CompileTimeout when the call exceeds the deadline — the call
+    finishes in a background daemon thread so the jit cache still warms
+    — or instantly while that background run is still going.  A value
+    of ``FLOWGGER_COMPILE_TIMEOUT_MS=0`` disables the watchdog."""
+    timeout = _compile_deadline_s()
+    if timeout <= 0:
+        return fn(*args)
+    done = threading.Event()
+    with _compile_lock:
+        if name in _compile_ready:
+            # jit cache warm for this name+shape: call inline (also the
+            # landing path for background compiles — the worker marks
+            # readiness itself, so a landed kernel never re-queues
+            # behind another kernel's compile on the semaphore)
+            _compile_slots.pop(name, None)
+            ready = True
+        else:
+            ready = False
+            pending = _compile_slots.get(name)
+            if pending is not None and not pending.is_set():
+                from ..utils.metrics import registry as _reg
+
+                _reg.inc("device_encode_compile_declines")
+                raise CompileTimeout(name)
+            # claim the slot inside this same critical section so two
+            # threads can never spawn duplicate compiles of one kernel
+            # (a finished-but-errored slot is simply replaced)
+            _compile_slots[name] = done
+    if ready:
+        return fn(*args)
+    box: dict = {}
+
+    def run():
+        try:
+            with _compile_sema:
+                box["result"] = fn(*args)
+        except BaseException as e:  # noqa: BLE001 - ferried to the caller
+            box["error"] = e
+        else:
+            with _compile_lock:
+                _compile_ready.add(name)
+        finally:
+            done.set()
+
+    threading.Thread(target=run, daemon=True,
+                     name=f"xla-compile:{name}").start()
+    if not done.wait(timeout):
+        from ..utils.metrics import registry as _reg
+
+        _reg.inc("device_encode_compile_declines")
+        if name not in _compile_warned:
+            _compile_warned.add(name)
+            print(
+                f"device-encode kernel [{name}] still compiling after "
+                f"{timeout:.0f}s; using the host encode path until it "
+                "lands", file=sys.stderr)
+        raise CompileTimeout(name)
+    with _compile_lock:
+        _compile_slots.pop(name, None)
+        if "error" not in box:
+            _compile_ready.add(name)
+    if "error" in box:
+        raise box["error"]
+    return box["result"]
 
 TS_W = 32          # timestamp text slot width (longest json_f64 ≈ 25)
 E_CAP = 56         # max JSON escapes per row on the device tier
@@ -432,7 +540,20 @@ def fetch_encode_driver(kernel, out, batch_dev, lens_dev, packed, encoder,
             return t1["tier"], extra
         return t1, None
 
-    tier1, extra1 = probe(kernel)
+    # compile-watchdog slot names: stable per kernel module + shape
+    # (closures are rebuilt per batch; the jit cache underneath is not)
+    kname = f"{getattr(kernel, '__module__', 'device')}:{tuple(batch_dev.shape)}"
+
+    def _declined_compile():
+        if route_state is not None:
+            route_state["cooldown"] = cooldown
+        return None, t_fetch
+
+    wide_adopted = False
+    try:
+        tier1, extra1 = guarded_compile_call(f"{kname}:probe", probe, kernel)
+    except CompileTimeout:
+        return _declined_compile()
     if extra1:
         out = {**out, **extra1}
     tier1_np = _fetch(tier1)[:n]
@@ -458,15 +579,24 @@ def fetch_encode_driver(kernel, out, batch_dev, lens_dev, packed, encoder,
             route_state["wide_cooldown"] = wide_cd - 1
         else:
             out_w, kernel_w = wide()
-            tier1w, extraw = probe(kernel_w)
-            cand1w = _fetch(tier1w)[:n] & (lens64 <= max_len)
-            if (1.0 - cand1w.mean()) <= fallback_frac:
-                _metrics.inc("device_encode_wide_batches")
-                kernel, out, cand1 = kernel_w, out_w, cand1w
-                if extraw:
-                    out = {**out, **extraw}
-            elif route_state is not None:
-                route_state["wide_cooldown"] = cooldown
+            try:
+                tier1w, extraw = guarded_compile_call(
+                    f"{kname}:probe-wide", probe, kernel_w)
+            except CompileTimeout:
+                tier1w = None
+            if tier1w is None:
+                if route_state is not None:
+                    route_state["wide_cooldown"] = cooldown
+            else:
+                cand1w = _fetch(tier1w)[:n] & (lens64 <= max_len)
+                if (1.0 - cand1w.mean()) <= fallback_frac:
+                    _metrics.inc("device_encode_wide_batches")
+                    kernel, out, cand1 = kernel_w, out_w, cand1w
+                    wide_adopted = True
+                    if extraw:
+                        out = {**out, **extraw}
+                elif route_state is not None:
+                    route_state["wide_cooldown"] = cooldown
 
     if n and (1.0 - cand1.mean()) > fallback_frac:
         _metrics.inc("device_encode_declined")
@@ -490,8 +620,16 @@ def fetch_encode_driver(kernel, out, batch_dev, lens_dev, packed, encoder,
     cand1_full[:n] = cand1
     small["ok"] = small["ok"].astype(bool) & cand1_full
     ts_text, ts_len = ts_text_block(small, ts_vals_fn)
-    acc, out_len, tier = kernel(jnp.asarray(ts_text),
-                                jnp.asarray(ts_len), True)
+    # wide kernels get their own watchdog slot: the narrow assemble
+    # being warm says nothing about the (bigger) wide compile
+    asm_slot = f"{kname}:assemble-wide" if wide_adopted else \
+        f"{kname}:assemble"
+    try:
+        acc, out_len, tier = guarded_compile_call(
+            asm_slot, kernel, jnp.asarray(ts_text),
+            jnp.asarray(ts_len), True)
+    except CompileTimeout:
+        return _declined_compile()
 
     # full-N fetches (tiny): the host must recompute the compaction
     # layout with the exact integer math the device used, including any
@@ -511,10 +649,17 @@ def fetch_encode_driver(kernel, out, batch_dev, lens_dev, packed, encoder,
     G = COMPACT_G
     gated = np.where(tier_full, len_full, 0)
     total_bytes = int(gated.sum())
+    flat = None
     if (total_bytes and ridx.size
             and N_acc * OW > total_bytes * COMPACT_MIN_SAVING):
         # device-side row compaction: D2H ≈ sum(out_len), G-aligned
-        flat = _compact_kernel(acc, out_len, tier)
+        try:
+            flat = guarded_compile_call(
+                f"{kname}:compact-wide" if wide_adopted
+                else f"{kname}:compact", _compact_kernel, acc, out_len, tier)
+        except CompileTimeout:
+            flat = None  # full-width fetch below until the compile lands
+    if flat is not None:
         used = (gated + (G - 1)) // G
         base = np.cumsum(used) - used
         total_groups = int(used.sum())

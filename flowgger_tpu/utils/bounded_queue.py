@@ -1,0 +1,73 @@
+"""Bounded pipeline queue with an overflow policy.
+
+The reference's ``sync_channel`` blocks producers when the queue is full
+(backpressure all the way to the socket).  That stays the default, but a
+collector in front of a slow sink sometimes prefers shedding load to
+stalling ingest, so the queue grows a policy:
+
+    [input]
+    queue_policy = "block"        # reference parity (default)
+                 | "drop_newest"  # full queue: discard the incoming item
+                 | "drop_oldest"  # full queue: discard the oldest item
+
+Every shed message bumps the ``queue_dropped`` counter.  The SHUTDOWN
+sentinel (``None``) is exempt: it always uses a blocking put and is
+never dropped, so graceful drain survives any policy.
+
+The ``queue_pressure`` fault-injection site makes a put behave as if the
+queue were full (deterministically, see ``utils.faultinject``), so the
+drop paths are testable without actually wedging a sink.
+"""
+
+from __future__ import annotations
+
+import queue
+
+from . import faultinject
+from .metrics import registry as _metrics
+
+POLICIES = ("block", "drop_newest", "drop_oldest")
+
+
+class PolicyQueue(queue.Queue):
+    def __init__(self, maxsize: int = 0, policy: str = "block"):
+        if policy not in POLICIES:
+            raise ValueError(f"unknown queue policy: {policy}")
+        super().__init__(maxsize)
+        self.policy = policy
+
+    def put(self, item, block: bool = True, timeout=None):
+        if item is None or self.policy == "block":
+            # sentinel delivery and reference-parity backpressure
+            if item is not None and faultinject.enabled():
+                # under block policy the pressure site only counts
+                faultinject.fire("queue_pressure")
+            return super().put(item, block, timeout)
+        pressured = faultinject.enabled() and faultinject.fire("queue_pressure")
+        while True:
+            try:
+                if pressured:
+                    raise queue.Full
+                return super().put(item, block=False)
+            except queue.Full:
+                if self.policy == "drop_newest":
+                    _metrics.inc("queue_dropped")
+                    return
+                # drop_oldest: make room, then retry the put
+                try:
+                    old = super().get(block=False)
+                except queue.Empty:
+                    # raced another consumer; room exists now
+                    pressured = False
+                    continue
+                if old is None:
+                    # never shed the shutdown sentinel: put it back and
+                    # drop the incoming item instead (task_done balances
+                    # the re-put so unfinished-task accounting holds)
+                    super().put(old)
+                    self.task_done()
+                    _metrics.inc("queue_dropped")
+                    return
+                self.task_done()
+                _metrics.inc("queue_dropped")
+                pressured = False

@@ -1,0 +1,156 @@
+"""Deterministic fault injection for the robustness test family.
+
+Faults are declared per *site* — a named choke point the pipeline checks
+as it runs — with a deterministic trigger spec, so a test (or a chaos
+run) can make the Nth device decode explode, reset an input socket
+mid-stream, or fail a sink write, and assert the degradation path
+recovers without losing the stream.
+
+Configuration, either source merges into one plan (env wins):
+
+    [faults]                       # TOML table, values are spec strings
+    device_decode = "every:3"      # fire on every 3rd check
+    input_socket = "once:5"        # fire on the 5th check only
+    sink_write = "first:2"         # fire on checks 1..2
+    queue_pressure = "after:10"    # fire on every check past the 10th
+
+    FLOWGGER_FAULTS="device_decode=every:3,sink_write=once:2"
+
+Sites wired in (each names the exception type it surfaces):
+
+- ``device_decode``  — raised inside BatchHandler's device dispatch/fetch
+  (``InjectedFault``), exercising the decode circuit breaker;
+- ``input_socket``   — ``ConnectionResetError`` from input socket reads;
+- ``sink_write``     — ``OSError`` from sink write paths (tls/file);
+- ``queue_pressure`` — makes the bounded queue report Full to producers.
+
+Counters are per-site, process-wide, and thread-safe; numbering is
+1-based (``once:1`` fires on the first check).  The module is inert —
+one dict lookup per check — unless a plan is configured.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+from typing import Dict, Optional, Tuple
+
+ENV_VAR = "FLOWGGER_FAULTS"
+
+KNOWN_SITES = ("device_decode", "input_socket", "sink_write", "queue_pressure")
+
+
+class InjectedFault(Exception):
+    """The device_decode site's synthetic failure."""
+
+
+class FaultInjectError(Exception):
+    """Bad fault spec at configure time."""
+
+
+def _parse_spec(site: str, spec: str) -> Optional[Tuple[str, int]]:
+    spec = spec.strip().lower()
+    if spec in ("off", "none", ""):
+        return None
+    kind, _, arg = spec.partition(":")
+    if kind not in ("every", "once", "after", "first") or not arg.isdigit():
+        raise FaultInjectError(
+            f"fault spec for [{site}] must be off|every:N|once:N|after:N|"
+            f"first:N, got [{spec}]")
+    n = int(arg)
+    if n < 1:
+        raise FaultInjectError(f"fault spec for [{site}]: N must be >= 1")
+    return kind, n
+
+
+class FaultPlan:
+    def __init__(self, specs: Dict[str, str]):
+        self._rules: Dict[str, Tuple[str, int]] = {}
+        self._counts: Dict[str, int] = {}
+        self._lock = threading.Lock()
+        for site, spec in specs.items():
+            parsed = _parse_spec(site, spec)
+            if parsed is not None:
+                self._rules[site] = parsed
+                self._counts[site] = 0
+
+    def fire(self, site: str) -> bool:
+        """Count one check of ``site``; True when the fault triggers."""
+        rule = self._rules.get(site)
+        if rule is None:
+            return False
+        with self._lock:
+            self._counts[site] += 1
+            n = self._counts[site]
+        kind, arg = rule
+        if kind == "every":
+            return n % arg == 0
+        if kind == "once":
+            return n == arg
+        if kind == "after":
+            return n > arg
+        return n <= arg  # first:N
+
+    def count(self, site: str) -> int:
+        with self._lock:
+            return self._counts.get(site, 0)
+
+
+_plan: Optional[FaultPlan] = None
+
+
+def enabled() -> bool:
+    return _plan is not None
+
+
+def fire(site: str) -> bool:
+    """One deterministic check of a fault site (1-based numbering)."""
+    return _plan is not None and _plan.fire(site)
+
+
+def maybe_raise(site: str, exc_type: type = InjectedFault) -> None:
+    if _plan is not None and _plan.fire(site):
+        raise exc_type(f"injected fault at site [{site}]")
+
+
+def reset() -> None:
+    """Drop the active plan (tests)."""
+    global _plan
+    _plan = None
+
+
+def configure(specs: Dict[str, str]) -> None:
+    """Install a plan directly (tests / programmatic chaos runs)."""
+    global _plan
+    _plan = FaultPlan(specs) if specs else None
+
+
+def configure_from(config) -> None:
+    """Pipeline boot: merge the ``[faults]`` config table with the
+    ``FLOWGGER_FAULTS`` env (env wins per site).  No sources → inert."""
+    specs: Dict[str, str] = {}
+    table = config.lookup_table("faults", "[faults] must be a table")
+    if table:
+        for site, spec in table.items():
+            if not isinstance(spec, str):
+                raise FaultInjectError(
+                    f"[faults] {site} must be a spec string")
+            specs[site] = spec
+    env = os.environ.get(ENV_VAR, "")
+    for part in filter(None, (p.strip() for p in env.split(","))):
+        site, eq, spec = part.partition("=")
+        if not eq:
+            raise FaultInjectError(
+                f"{ENV_VAR} entries must look like site=spec, got [{part}]")
+        specs[site.strip()] = spec
+    for site in specs:
+        if site not in KNOWN_SITES:
+            # hard error: a typo'd site would silently inject nothing
+            # and let a fault-free run pass as a robustness validation
+            raise FaultInjectError(
+                f"unknown fault site [{site}] (known: "
+                f"{', '.join(KNOWN_SITES)})")
+    configure(specs)
+    if specs:
+        print(f"faultinject: active plan {specs}", file=sys.stderr)

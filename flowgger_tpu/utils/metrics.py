@@ -29,6 +29,10 @@ _COUNTERS = (
     "input_lines", "decoded_records", "decode_errors", "encode_errors",
     "invalid_utf8", "enqueued", "output_written", "output_errors",
     "batches", "batch_lines", "fallback_rows",
+    # robustness / supervision layer
+    "queue_dropped", "drain_stragglers", "sink_reconnects", "sink_failovers",
+    "thread_crashes", "thread_restarts", "input_reconnects",
+    "device_decode_errors", "breaker_trips", "breaker_recoveries",
 )
 
 
@@ -73,6 +77,7 @@ class Registry:
         self._lock = threading.Lock()
         self._counters: Dict[str, int] = {name: 0 for name in _COUNTERS}
         self._seconds: Dict[str, float] = {}
+        self._gauges: Dict[str, float] = {}
         self.batch_seconds = Histogram()
         self._reporter: Optional[threading.Thread] = None
         self._stop = threading.Event()
@@ -80,6 +85,23 @@ class Registry:
     def inc(self, name: str, value: int = 1):
         with self._lock:
             self._counters[name] = self._counters.get(name, 0) + value
+
+    def set_gauge(self, name: str, value: float):
+        """Point-in-time values (e.g. device_breaker_state: 0 closed,
+        1 open, 2 half-open) — reported alongside counters."""
+        with self._lock:
+            self._gauges[name] = value
+
+    def init_gauge(self, name: str, value: float):
+        """Make a gauge visible in reports without clobbering a live
+        value (e.g. a second BatchHandler must not mask an open
+        breaker's state with a fresh 0)."""
+        with self._lock:
+            self._gauges.setdefault(name, value)
+
+    def get_gauge(self, name: str, default: float = 0):
+        with self._lock:
+            return self._gauges.get(name, default)
 
     def add_seconds(self, name: str, value: float):
         """Accumulate a per-stage wall-clock share (pipeline stage
@@ -95,9 +117,11 @@ class Registry:
         with self._lock:
             counters = dict(self._counters)
             seconds = {k: round(v, 6) for k, v in self._seconds.items()}
+            gauges = dict(self._gauges)
         snap: Dict[str, object] = {"ts": round(time.time(), 3)}
         snap.update(counters)
         snap.update(seconds)
+        snap.update(gauges)
         snap["batch_seconds"] = self.batch_seconds.snapshot()
         return snap
 
@@ -106,6 +130,7 @@ class Registry:
             for k in self._counters:
                 self._counters[k] = 0
             self._seconds.clear()
+            self._gauges.clear()
         self.batch_seconds = Histogram()
 
     # -- periodic reporter -------------------------------------------------

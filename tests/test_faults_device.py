@@ -1,0 +1,437 @@
+"""Device-decode circuit breaker: injected device faults must degrade
+the batch handler to the scalar oracle with byte-identical output and
+zero message loss, then recover after the cooldown."""
+
+import io
+import queue
+
+import pytest
+
+from flowgger_tpu.config import Config
+from flowgger_tpu.tpu.breaker import CLOSED, HALF_OPEN, OPEN, DecodeBreaker
+from flowgger_tpu.utils import faultinject
+from flowgger_tpu.utils.metrics import registry
+
+pytestmark = pytest.mark.faults
+
+LINES = [
+    b"<23>1 2015-08-05T15:53:45.637824Z host-a app 69 42 - the quick brown fox",
+    b"<165>1 2003-10-11T22:14:15.003Z mymachine evntslog - ID47 "
+    b'[exampleSDID@32473 iut="3" eventSource="App"] BOMAn application event',
+    b"not a valid syslog line at all",
+    b"<13>1 2024-01-01T00:00:00Z h app p m - plain message",
+    b"",
+]
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    registry.reset()
+    faultinject.reset()
+    yield
+    faultinject.reset()
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self):
+        return self.t
+
+
+# ---------------------------------------------------------------------------
+# DecodeBreaker state machine
+# ---------------------------------------------------------------------------
+
+def test_breaker_trips_after_consecutive_failures():
+    clock = FakeClock()
+    b = DecodeBreaker(failures=3, cooldown_ms=1000, clock=clock)
+    assert b.state == CLOSED and b.allow()
+    for _ in range(2):
+        b.record_failure(RuntimeError("xla"))
+    assert b.state == CLOSED  # below threshold
+    b.record_failure(RuntimeError("xla"))
+    assert b.state == OPEN
+    assert not b.allow()  # cooldown not elapsed
+    assert registry.get_gauge("device_breaker_state") == 1
+    assert registry.get("breaker_trips") == 1
+
+
+def test_breaker_success_resets_consecutive_count():
+    b = DecodeBreaker(failures=2, clock=FakeClock())
+    b.record_failure(RuntimeError("x"))
+    b.record_success()
+    b.record_failure(RuntimeError("x"))
+    assert b.state == CLOSED  # never two in a row
+
+
+def test_breaker_half_open_probe_recovers():
+    clock = FakeClock()
+    b = DecodeBreaker(failures=1, cooldown_ms=1000, clock=clock)
+    b.record_failure(RuntimeError("x"))
+    assert b.state == OPEN
+    clock.t += 1.5  # past cooldown
+    assert b.allow()  # this call IS the probe
+    assert b.state == HALF_OPEN
+    assert not b.allow()  # only one probe at a time
+    b.record_success()
+    assert b.state == CLOSED
+    assert registry.get("breaker_recoveries") == 1
+    assert registry.get_gauge("device_breaker_state") == 0
+
+
+def test_breaker_failed_probe_reopens():
+    clock = FakeClock()
+    b = DecodeBreaker(failures=1, cooldown_ms=1000, clock=clock)
+    b.record_failure(RuntimeError("x"))
+    clock.t += 1.5
+    assert b.allow()
+    b.record_failure(RuntimeError("probe died"))
+    assert b.state == OPEN
+    clock.t += 0.5
+    assert not b.allow()  # cooldown restarted from the failed probe
+
+
+def test_breaker_trips_on_sustained_fallback_ratio():
+    b = DecodeBreaker(failures=99, window=3, fallback_ratio=0.5,
+                      clock=FakeClock())
+    for _ in range(2):
+        b.observe_batch(10, 9)
+    assert b.state == CLOSED  # window not yet full
+    b.observe_batch(10, 9)
+    assert b.state == OPEN
+    # one healthy batch inside the window prevents the trip
+    b2 = DecodeBreaker(failures=99, window=3, fallback_ratio=0.5,
+                       clock=FakeClock())
+    for fb in (9, 1, 9):
+        b2.observe_batch(10, fb)
+    assert b2.state == CLOSED
+
+
+def test_breaker_ratio_trip_not_cured_by_healthy_probe():
+    """A ratio trip means the device round-trip is wasted work, not that
+    the device is broken — a successful probe whose batch is still
+    nearly-all-fallback must re-open instead of flapping closed."""
+    clock = FakeClock()
+    b = DecodeBreaker(failures=99, window=2, fallback_ratio=0.5,
+                      cooldown_ms=1000, clock=clock)
+    b.observe_batch(10, 9)
+    b.observe_batch(10, 9)
+    assert b.state == OPEN
+    clock.t += 1.5
+    assert b.allow()  # probe
+    b.observe_batch(10, 9)  # probe batch still 90% fallback
+    b.record_success()
+    assert b.state == OPEN  # not cured: stays open for another cooldown
+    # a probe whose batch genuinely uses the device tier closes it
+    clock.t += 1.5
+    assert b.allow()
+    b.observe_batch(10, 1)
+    b.record_success()
+    assert b.state == CLOSED
+
+
+def test_breaker_config_gating():
+    assert DecodeBreaker.from_config(Config.from_string(
+        "[input]\ntpu_breaker = false\n")) is None
+    b = DecodeBreaker.from_config(Config.from_string(
+        "[input]\ntpu_breaker_failures = 7\ntpu_breaker_cooldown_ms = 9\n"
+        "tpu_breaker_window = 5\ntpu_breaker_fallback_ratio = 0.5\n"))
+    assert (b.failures, b.cooldown_ms, b.window, b.fallback_ratio) == (
+        7, 9, 5, 0.5)
+    from flowgger_tpu.config import ConfigError
+
+    with pytest.raises(ConfigError, match="fallback_ratio"):
+        DecodeBreaker.from_config(Config.from_string(
+            "[input]\ntpu_breaker_fallback_ratio = 1.5\n"))
+
+
+# ---------------------------------------------------------------------------
+# BatchHandler degradation: byte-identical output, no loss
+# ---------------------------------------------------------------------------
+
+def _run_handler(fault_spec=None, breaker_cfg="", lines=None, repeats=4):
+    """Feed the same stream through a BatchHandler (rfc5424 block route,
+    passthrough encoder: pure host encode after the device decode) and
+    return the drained sink items as flat bytes."""
+    from flowgger_tpu.decoders.rfc5424 import RFC5424Decoder
+    from flowgger_tpu.encoders.passthrough import PassthroughEncoder
+    from flowgger_tpu.mergers import LineMerger
+    from flowgger_tpu.outputs import stream_bytes
+    from flowgger_tpu.tpu.batch import BatchHandler
+
+    faultinject.reset()
+    if fault_spec:
+        faultinject.configure({"device_decode": fault_spec})
+    cfg = Config.from_string(
+        "[input]\ntpu_batch_size = 4\n" + breaker_cfg)
+    tx = queue.Queue()
+    merger = LineMerger()
+    handler = BatchHandler(tx, RFC5424Decoder(cfg), PassthroughEncoder(cfg),
+                           cfg, fmt="rfc5424", start_timer=False,
+                           merger=merger)
+    chunk = b"".join(ln + b"\n" for ln in (lines or LINES))
+    for _ in range(repeats):  # one device batch per cycle
+        handler.ingest_chunk(chunk)
+        handler.flush()
+    out = b""
+    while not tx.empty():
+        data, _ = stream_bytes(tx.get_nowait(), merger)
+        out += data
+    return out, handler
+
+
+def test_device_fault_output_byte_identical():
+    """Acceptance: with a device fault every other batch, sink bytes are
+    identical to the fault-free run — the breaker degrades, nothing is
+    lost, and the gauge shows the transition."""
+    clean, _ = _run_handler()
+    registry.reset()
+    faulty, handler = _run_handler(
+        fault_spec="every:2",
+        breaker_cfg="tpu_breaker_failures = 2\n"
+                    "tpu_breaker_cooldown_ms = 3600000\n")
+    assert faulty == clean and clean.count(b"\n") >= 8
+    assert handler._breaker.state == OPEN
+    assert registry.get("breaker_trips") == 1
+    assert registry.get("device_decode_errors") >= 2
+    assert registry.get_gauge("device_breaker_state") == 1
+    # transitions were recorded (observed in metrics + history)
+    assert [(a, b) for _, a, b in handler._breaker.transitions] == [
+        (CLOSED, OPEN)]
+
+
+def test_device_fault_every_batch_full_scalar():
+    """failures=1 + fault on the first check: everything decodes through
+    the oracle from the first batch on; output still identical."""
+    clean, _ = _run_handler()
+    registry.reset()
+    faulty, handler = _run_handler(
+        fault_spec="first:1000",
+        breaker_cfg="tpu_breaker_failures = 1\n"
+                    "tpu_breaker_cooldown_ms = 3600000\n")
+    assert faulty == clean
+    assert handler._breaker.state == OPEN
+
+
+def test_breaker_disabled_propagates_device_fault():
+    with pytest.raises(faultinject.InjectedFault):
+        _run_handler(fault_spec="first:1000",
+                     breaker_cfg="tpu_breaker = false\n")
+
+
+def test_breaker_open_skips_device_checks():
+    """Once open, batches bypass the device tier entirely: the fault
+    site stops being consulted (no wasted device dispatches)."""
+    _, handler = _run_handler(
+        fault_spec="first:1000",
+        breaker_cfg="tpu_breaker_failures = 1\n"
+                    "tpu_breaker_cooldown_ms = 3600000\n")
+    import flowgger_tpu.utils.faultinject as fi
+
+    checks_when_open = fi._plan.count("device_decode")
+    # a fresh stream through the (still open) handler adds no checks
+    handler.ingest_chunk(b"".join(ln + b"\n" for ln in LINES))
+    handler.flush()
+    assert fi._plan.count("device_decode") == checks_when_open
+
+
+def test_auto_format_scalar_fallback_byte_identical():
+    """auto_tpu: the breaker fallback classifies per line host-side and
+    uses each class's oracle — mixed-format streams stay byte-identical
+    when degraded."""
+    from flowgger_tpu.decoders.rfc5424 import RFC5424Decoder
+    from flowgger_tpu.encoders.ltsv import LTSVEncoder
+    from flowgger_tpu.mergers import LineMerger
+    from flowgger_tpu.outputs import stream_bytes
+    from flowgger_tpu.tpu.batch import BatchHandler
+
+    mixed = [
+        b"<23>1 2015-08-05T15:53:45.637824Z host-a app 69 42 - rfc5424 row",
+        b'{"version":"1.1","host":"h","short_message":"gelf row",'
+        b'"timestamp":1438790025.5}',
+        b"time:[10/Oct/2000:13:55:36 -0700]\thost:10.0.0.1\tmsg:ltsv row",
+        b"<34>Oct 11 22:14:15 mymachine su: legacy 3164 row",
+    ] * 6
+
+    def run(spec, breaker_cfg=""):
+        faultinject.reset()
+        if spec:
+            faultinject.configure({"device_decode": spec})
+        cfg = Config.from_string("[input]\ntpu_batch_size = 6\n" + breaker_cfg)
+        tx = queue.Queue()
+        merger = LineMerger()
+        h = BatchHandler(tx, RFC5424Decoder(cfg), LTSVEncoder(cfg), cfg,
+                         fmt="auto", start_timer=False, merger=merger)
+        h.ingest_chunk(b"".join(ln + b"\n" for ln in mixed))
+        h.flush()
+        out = b""
+        while not tx.empty():
+            data, _ = stream_bytes(tx.get_nowait(), merger)
+            out += data
+        return out
+
+    clean = run(None)
+    degraded = run("first:1000",
+                   "tpu_breaker_failures = 1\n"
+                   "tpu_breaker_cooldown_ms = 3600000\n")
+    assert degraded == clean and clean.count(b"\n") == len(mixed)
+
+
+def test_breaker_recovers_via_half_open_probe_in_handler():
+    """End-to-end recovery: trip on injected faults, wait out a tiny
+    cooldown, and the next batch probes the device path and closes the
+    breaker again."""
+    import time
+
+    from flowgger_tpu.decoders.rfc5424 import RFC5424Decoder
+    from flowgger_tpu.encoders.passthrough import PassthroughEncoder
+    from flowgger_tpu.mergers import LineMerger
+    from flowgger_tpu.tpu.batch import BatchHandler
+
+    faultinject.configure({"device_decode": "first:2"})
+    cfg = Config.from_string(
+        "[input]\ntpu_batch_size = 4\ntpu_breaker_failures = 2\n"
+        "tpu_breaker_cooldown_ms = 50\n")
+    tx = queue.Queue()
+    handler = BatchHandler(tx, RFC5424Decoder(cfg), PassthroughEncoder(cfg),
+                           cfg, fmt="rfc5424", start_timer=False,
+                           merger=LineMerger())
+    stream = b"".join(ln + b"\n" for ln in LINES)
+    for _ in range(2):  # faults 1..2: each batch fails at dispatch
+        handler.ingest_chunk(stream)
+        handler.flush()
+    assert handler._breaker.state == OPEN
+    time.sleep(0.1)  # cooldown elapses
+    handler.ingest_chunk(stream)
+    handler.flush()  # probe succeeds (fault plan exhausted after 2)
+    assert handler._breaker.state == CLOSED
+    states = [(a, b) for _, a, b in handler._breaker.transitions]
+    assert states == [(CLOSED, OPEN), (OPEN, HALF_OPEN), (HALF_OPEN, CLOSED)]
+    assert registry.get("breaker_recoveries") == 1
+    # every line of both streams made it out
+    n = 0
+    while not tx.empty():
+        tx.get_nowait()
+        n += 1
+    assert n > 0
+
+
+# ---------------------------------------------------------------------------
+# Compile watchdog (device-encode tier)
+# ---------------------------------------------------------------------------
+
+def _isolated_watchdog(monkeypatch):
+    """Give the test its own single-flight semaphore and slot table so
+    real background kernel compiles from other tests can't queue it."""
+    import threading
+
+    from flowgger_tpu.tpu import device_common as dc
+
+    monkeypatch.setattr(dc, "_compile_sema", threading.Semaphore(1))
+    monkeypatch.setattr(dc, "_compile_slots", {})
+    monkeypatch.setattr(dc, "_compile_ready", set())
+    return dc
+
+
+def test_compile_watchdog_declines_then_lands(monkeypatch):
+    """A slow kernel compile times out (decline), keeps running in the
+    background, and once landed the same slot serves calls inline."""
+    import threading
+    import time
+
+    dc = _isolated_watchdog(monkeypatch)
+    monkeypatch.setenv(dc.COMPILE_TIMEOUT_ENV, "50")
+    gate = threading.Event()
+    calls = []
+
+    def slow_compile():
+        calls.append(1)
+        gate.wait(5.0)
+        return 42
+
+    with pytest.raises(dc.CompileTimeout):
+        dc.guarded_compile_call("test:slow-kernel", slow_compile)
+    # still compiling: instant decline, no second worker spawned
+    with pytest.raises(dc.CompileTimeout):
+        dc.guarded_compile_call("test:slow-kernel", slow_compile)
+    assert len(calls) == 1
+    assert registry.get("device_encode_compile_declines") == 2
+    gate.set()
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        try:
+            assert dc.guarded_compile_call(
+                "test:slow-kernel", slow_compile) == 42
+            break
+        except dc.CompileTimeout:
+            time.sleep(0.02)
+    else:
+        pytest.fail("background compile never landed")
+    # warm now: served inline without a worker thread
+    n = len(calls)
+    assert dc.guarded_compile_call("test:slow-kernel", slow_compile) == 42
+    assert len(calls) == n + 1
+
+
+def test_compile_watchdog_disabled_by_env(monkeypatch):
+    dc = _isolated_watchdog(monkeypatch)
+    monkeypatch.setenv(dc.COMPILE_TIMEOUT_ENV, "0")
+    assert dc.guarded_compile_call("test:inline", lambda: "x") == "x"
+
+
+def test_compile_watchdog_propagates_errors(monkeypatch):
+    dc = _isolated_watchdog(monkeypatch)
+    monkeypatch.setenv(dc.COMPILE_TIMEOUT_ENV, "5000")
+
+    def boom():
+        raise RuntimeError("xla says no")
+
+    with pytest.raises(RuntimeError, match="xla says no"):
+        dc.guarded_compile_call("test:boom", boom)
+
+
+# ---------------------------------------------------------------------------
+# End-to-end pipeline acceptance (config-driven [faults] table)
+# ---------------------------------------------------------------------------
+
+def _run_pipeline(tmp_path, name, faults_toml=""):
+    from flowgger_tpu.pipeline import Pipeline
+    from flowgger_tpu.splitters import LineSplitter
+
+    faultinject.reset()
+    out = tmp_path / name
+    config = Config.from_string(
+        '[input]\ntype = "stdin"\nformat = "rfc5424_tpu"\n'
+        "tpu_batch_size = 4\ntpu_breaker_failures = 1\n"
+        "tpu_breaker_cooldown_ms = 3600000\n"
+        '[output]\ntype = "file"\nformat = "passthrough"\n'
+        f'framing = "line"\nfile_path = "{out}"\n' + faults_toml)
+    pipeline = Pipeline(config)
+    threads = pipeline.start_output()
+    if not isinstance(threads, list):
+        threads = [threads]
+    handler = pipeline.handler_factory()
+    stream = b"".join(ln + b"\n" for ln in LINES) * 6
+    LineSplitter().run(io.BytesIO(stream), handler)
+    pipeline._drain(threads)
+    return out.read_bytes(), pipeline
+
+
+def test_e2e_fault_injected_run_matches_clean_run(tmp_path):
+    """ISSUE acceptance: device-decode exception every N batches → sink
+    output byte-identical to a fault-free run, breaker state transitions
+    visible in metrics."""
+    clean_bytes, _ = _run_pipeline(tmp_path, "clean.log")
+    registry.reset()
+    faulty_bytes, pipeline = _run_pipeline(
+        tmp_path, "faulty.log",
+        '[faults]\ndevice_decode = "every:2"\n')
+    assert faulty_bytes == clean_bytes and clean_bytes
+    handler = pipeline._handlers[0]
+    assert handler._breaker.state == OPEN
+    assert registry.get("breaker_trips") == 1
+    assert registry.get_gauge("device_breaker_state") == 1
+    snap = registry.snapshot()
+    assert snap["device_breaker_state"] == 1  # gauge visible in reports

@@ -1,0 +1,185 @@
+"""I/O fault injection: input socket resets, sink write failures, and
+supervised sink-worker restarts — the stream must survive all three."""
+
+import queue
+import socket
+import threading
+import time
+
+import pytest
+
+from flowgger_tpu.config import Config
+from flowgger_tpu.supervise import Supervisor
+from flowgger_tpu.utils import faultinject
+from flowgger_tpu.utils.metrics import registry
+
+pytestmark = pytest.mark.faults
+
+LINE = "<23>1 2015-08-05T15:53:45.637824Z testhostname appname 69 42 - m%d"
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    registry.reset()
+    faultinject.reset()
+    yield
+    faultinject.reset()
+
+
+def test_input_socket_reset_keeps_accept_loop_alive():
+    """An injected connection reset closes one TCP connection; lines
+    already received are delivered and a new connection keeps flowing."""
+    from flowgger_tpu.inputs.tcp_input import TcpInput
+    from flowgger_tpu.decoders.rfc5424 import RFC5424Decoder
+    from flowgger_tpu.encoders.passthrough import PassthroughEncoder
+    from flowgger_tpu.splitters import ScalarHandler
+
+    cfg = Config.from_string('[input]\nlisten = "127.0.0.1:0"\ntimeout = 5\n')
+    inp = TcpInput(cfg)
+    tx = queue.Queue()
+
+    def factory():
+        return ScalarHandler(tx, RFC5424Decoder(cfg), PassthroughEncoder(cfg))
+
+    t = threading.Thread(target=inp.accept, args=(factory,), daemon=True)
+    t.start()
+    deadline = time.time() + 10
+    while inp.bound_port is None and time.time() < deadline:
+        time.sleep(0.01)
+
+    # reset fires on this connection's SECOND read check: the first read
+    # delivers line 0, then the connection dies
+    faultinject.configure({"input_socket": "once:2"})
+    c1 = socket.create_connection(("127.0.0.1", inp.bound_port))
+    c1.sendall((LINE % 0 + "\n").encode())
+    assert tx.get(timeout=10) == (LINE % 0).encode()
+    # ...the injected reset now closes c1 server-side; a new connection
+    # proves the accept loop survived
+    c2 = socket.create_connection(("127.0.0.1", inp.bound_port))
+    c2.sendall((LINE % 1 + "\n").encode())
+    assert tx.get(timeout=10) == (LINE % 1).encode()
+    c1.close()
+    c2.close()
+
+
+def test_tls_sink_write_fault_redelivers(session_pem):
+    """An injected write failure on the TLS sink retains the message,
+    reconnects (bumping sink_reconnects) and delivers it on the next
+    connection — nothing lost, nothing reordered through the queue."""
+    import test_outputs_net as net
+
+    from flowgger_tpu.mergers import LineMerger
+    from flowgger_tpu.outputs import SHUTDOWN
+    from flowgger_tpu.outputs.tls_output import TlsOutput
+
+    received = []
+    stop = threading.Event()
+    port = net._tls_sink(session_pem, received, stop)
+    faultinject.configure({"sink_write": "once:1"})
+    config = Config.from_string(
+        f'[output]\nconnect = ["127.0.0.1:{port}"]\n'
+        "tls_recovery_delay_init = 1\n")
+    out = TlsOutput(config)
+    tx = queue.Queue()
+    threads = out.start(tx, LineMerger())
+    tx.put(b"survives-the-fault")
+    deadline = time.time() + 15
+    while not any(b"survives-the-fault" in r for r in received) \
+            and time.time() < deadline:
+        time.sleep(0.05)
+    tx.put(SHUTDOWN)
+    for t in threads:
+        t.join(timeout=10)
+    stop.set()
+    assert any(b"survives-the-fault" in r for r in received)
+    assert registry.get("sink_reconnects") >= 1
+    # single-endpoint cluster: reconnects are NOT failovers
+    assert registry.get("sink_failovers") == 0
+
+
+def test_file_sink_write_fault_supervised_restart(tmp_path):
+    """A file-sink write error crashes the worker; the supervisor
+    restarts it and the requeued message is redelivered."""
+    from flowgger_tpu.outputs import SHUTDOWN
+    from flowgger_tpu.outputs.file_output import FileOutput
+
+    out_path = tmp_path / "out.log"
+    faultinject.configure({"sink_write": "once:1"})
+    config = Config.from_string(
+        f'[output]\nfile_path = "{out_path}"\n')
+    out = FileOutput(config)
+    sup = Supervisor(None)
+    sup.backoff_init = 1
+    sup.backoff_max = 10
+    out.supervisor = sup
+    tx = queue.Queue()
+    thread = out.start(tx, None)
+    tx.put(b"first\n")
+    tx.put(b"second\n")
+    deadline = time.time() + 10
+    while out_path.read_bytes().count(b"\n") < 2 if out_path.exists() \
+            else True:
+        if time.time() > deadline:
+            break
+        time.sleep(0.05)
+    tx.put(SHUTDOWN)
+    thread.join(timeout=10)
+    data = out_path.read_bytes()
+    assert b"first\n" in data and b"second\n" in data
+    assert registry.get("thread_crashes") == 1
+    assert registry.get("thread_restarts") == 1
+    assert registry.get("output_errors") == 1
+
+
+def test_kafka_send_retries_then_succeeds():
+    """Kafka adopts the shared RetryPolicy: a broker that appears after
+    a failed connect attempt is reached on retry instead of killing the
+    process."""
+    import test_outputs_net as net
+
+    from flowgger_tpu.outputs import SHUTDOWN
+    from flowgger_tpu.outputs.kafka_output import KafkaOutput
+
+    received = []
+    ports = []
+    net._fake_kafka(received, ports)
+    config = Config.from_string(
+        f'[output]\nkafka_brokers = ["127.0.0.1:{ports[0]}"]\n'
+        'kafka_topic = "logs"\nkafka_acks = 1\n'
+        "kafka_retry_init = 1\nkafka_retry_max = 5\nkafka_retry_attempts = 3\n")
+    out = KafkaOutput(config)
+    out.exit_on_failure = False
+    assert out._retry_kw == dict(init_ms=1, max_ms=5, max_attempts=3)
+    tx = queue.Queue()
+    threads = out.start(tx, None)
+    tx.put(b"retry-path-msg")
+    deadline = time.time() + 10
+    while not received and time.time() < deadline:
+        time.sleep(0.05)
+    tx.put(SHUTDOWN)
+    for t in threads:
+        t.join(timeout=5)
+    assert received and b"retry-path-msg" in received[0]
+
+
+def test_kafka_connect_retries_then_gives_up():
+    """Unreachable broker: the worker burns its retry budget (observable
+    as sink_reconnects) and then honors the exit contract — here
+    disabled, so it returns instead of wedging."""
+    from flowgger_tpu.outputs.kafka_output import KafkaOutput
+
+    dead = socket.create_server(("127.0.0.1", 0))
+    port = dead.getsockname()[1]
+    dead.close()  # connection refused from now on
+    config = Config.from_string(
+        f'[output]\nkafka_brokers = ["127.0.0.1:{port}"]\n'
+        'kafka_topic = "logs"\nkafka_timeout = 200\n'
+        "kafka_retry_init = 1\nkafka_retry_max = 5\nkafka_retry_attempts = 2\n")
+    out = KafkaOutput(config)
+    out.exit_on_failure = False
+    tx = queue.Queue()
+    threads = out.start(tx, None)
+    for t in threads:
+        t.join(timeout=15)
+    assert all(not t.is_alive() for t in threads)
+    assert registry.get("sink_reconnects") == 2

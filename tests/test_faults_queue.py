@@ -1,0 +1,111 @@
+"""Queue overflow policy + artificial queue-pressure fault injection."""
+
+import pytest
+
+from flowgger_tpu.utils import faultinject
+from flowgger_tpu.utils.bounded_queue import PolicyQueue
+from flowgger_tpu.utils.metrics import registry
+
+pytestmark = pytest.mark.faults
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    registry.reset()
+    faultinject.reset()
+    yield
+    faultinject.reset()
+
+
+def _drain(q):
+    out = []
+    while not q.empty():
+        out.append(q.get_nowait())
+    return out
+
+
+def test_block_policy_is_default_queue():
+    q = PolicyQueue(maxsize=2)
+    q.put(b"a")
+    q.put(b"b")
+    assert q.policy == "block"
+    assert _drain(q) == [b"a", b"b"]
+    assert registry.get("queue_dropped") == 0
+
+
+def test_drop_newest_sheds_incoming():
+    q = PolicyQueue(maxsize=2, policy="drop_newest")
+    q.put(b"a")
+    q.put(b"b")
+    q.put(b"c")  # full: incoming item is shed
+    assert _drain(q) == [b"a", b"b"]
+    assert registry.get("queue_dropped") == 1
+
+
+def test_drop_oldest_sheds_head():
+    q = PolicyQueue(maxsize=2, policy="drop_oldest")
+    q.put(b"a")
+    q.put(b"b")
+    q.put(b"c")  # full: oldest item is shed, newest enqueued
+    assert _drain(q) == [b"b", b"c"]
+    assert registry.get("queue_dropped") == 1
+
+
+def test_shutdown_sentinel_never_dropped():
+    q = PolicyQueue(maxsize=1, policy="drop_oldest")
+    q.put(None)  # SHUTDOWN sentinel
+    q.put(b"x")  # would normally shed the head — but not the sentinel
+    assert _drain(q) == [None]
+    assert registry.get("queue_dropped") == 1
+
+
+def test_unfinished_task_accounting_survives_drops():
+    """task_done bookkeeping must stay balanced when items are shed, or
+    a later queue.join() would wedge."""
+    q = PolicyQueue(maxsize=1, policy="drop_oldest")
+    q.put(b"a")
+    q.put(b"b")  # sheds a
+    q.get_nowait()
+    q.task_done()
+    q.join()  # returns only if every put was matched by task_done/drop
+
+
+def test_invalid_policy_rejected():
+    with pytest.raises(ValueError, match="queue policy"):
+        PolicyQueue(maxsize=1, policy="bogus")
+
+
+def test_queue_pressure_fault_site():
+    """Deterministic pressure: the first two puts behave as if the queue
+    were full, engaging the drop policy without a slow sink."""
+    faultinject.configure({"queue_pressure": "first:2"})
+    q = PolicyQueue(maxsize=16, policy="drop_newest")
+    q.put(b"a")  # pressured -> shed
+    q.put(b"b")  # pressured -> shed
+    q.put(b"c")  # delivered
+    assert _drain(q) == [b"c"]
+    assert registry.get("queue_dropped") == 2
+
+
+def test_queue_pressure_drop_oldest_makes_room():
+    faultinject.configure({"queue_pressure": "once:2"})
+    q = PolicyQueue(maxsize=16, policy="drop_oldest")
+    q.put(b"a")
+    q.put(b"b")  # pressured: sheds a, then delivers b
+    q.put(b"c")
+    assert _drain(q) == [b"b", b"c"]
+    assert registry.get("queue_dropped") == 1
+
+
+def test_pipeline_config_queue_policy():
+    from flowgger_tpu.config import Config, ConfigError
+    from flowgger_tpu.pipeline import Pipeline
+
+    p = Pipeline(Config.from_string(
+        '[input]\ntype = "stdin"\nqueue_policy = "drop_oldest"\n'
+        'queuesize = 4\n[output]\ntype = "debug"\n'))
+    assert p.tx.policy == "drop_oldest" and p.tx.maxsize == 4
+    with pytest.raises(ConfigError, match="queue_policy"):
+        Pipeline(Config.from_string(
+            '[input]\ntype = "stdin"\nqueue_policy = "bogus"\n'
+            '[output]\ntype = "debug"\n'))

@@ -417,7 +417,9 @@ def test_udp_batched_recvmmsg_tpu(tmp_path):
                  ("127.0.0.1", inp.bound_port))
         s.sendto(b"", ("127.0.0.1", inp.bound_port))  # zero-length span
     got = []
-    deadline = time.time() + 10
+    # generous deadline: a cold box pays the decode-kernel compile plus
+    # a device-encode watchdog decline before the first batch lands
+    deadline = time.time() + 45
     while len(got) < 42 and time.time() < deadline:
         try:
             item = tx.get(timeout=0.2)

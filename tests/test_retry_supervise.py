@@ -1,0 +1,188 @@
+"""Unit tests for the shared RetryPolicy and the thread Supervisor."""
+
+import threading
+import time
+
+import pytest
+
+from flowgger_tpu.config import Config
+from flowgger_tpu.supervise import Supervisor
+from flowgger_tpu.utils.metrics import registry
+from flowgger_tpu.utils.retry import (
+    RetryExhausted,
+    RetryPolicy,
+    policy_from_config,
+)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+
+def _upper(a, b):
+    return b  # deterministic "jitter": always the upper bound
+
+
+def test_exponential_full_jitter_caps():
+    slept = []
+    p = RetryPolicy(init_ms=100, max_ms=400, rng=_upper,
+                    sleep=lambda s: slept.append(s * 1000))
+    for _ in range(4):
+        p.backoff()
+    # 100 * 2^n capped at 400
+    assert slept == [100, 200, 400, 400]
+
+
+def test_exponential_max_attempts_and_run():
+    p = RetryPolicy(init_ms=1, max_ms=1, max_attempts=2, sleep=lambda s: None)
+    calls = []
+
+    def fn():
+        calls.append(1)
+        raise ValueError("boom")
+
+    with pytest.raises(RetryExhausted) as ei:
+        p.run(fn, retry_on=(ValueError,))
+    assert isinstance(ei.value.__cause__, ValueError)
+    assert len(calls) == 3  # initial try + 2 retries
+
+
+def test_run_returns_value_and_note_success_resets():
+    p = RetryPolicy(init_ms=1, max_ms=1, max_attempts=1, sleep=lambda s: None)
+    state = {"n": 0}
+
+    def flaky():
+        state["n"] += 1
+        if state["n"] < 2:
+            raise OSError("first")
+        return "ok"
+
+    assert p.run(flaky, retry_on=(OSError,)) == "ok"
+    assert p.attempts == 1
+    p.note_success()
+    assert p.attempts == 0 and not p.exhausted()
+
+
+def test_additive_parity_with_reference_backoff():
+    """mode="additive" reproduces the reference TLS recovery loop:
+    delay += uniform(0, delay) capped at max, reset after probe_ms of
+    stability (tls_output.rs:163-172)."""
+    clock = FakeClock()
+    p = RetryPolicy(init_ms=100, max_ms=10_000, mode="additive",
+                    probe_ms=30_000, rng=_upper, sleep=lambda s: None,
+                    clock=clock)
+    p.mark()
+    assert p.next_delay_ms() == 200   # 100 + uniform(0,100)->100
+    assert p.next_delay_ms() == 400
+    # a long stable window resets the delay to init (no growth that
+    # round — reference if/elif structure)
+    p.mark()
+    clock.t += 31.0
+    assert p.next_delay_ms() == 100
+
+
+def test_additive_delay_stops_growing_at_max():
+    p = RetryPolicy(init_ms=100, max_ms=150, mode="additive", rng=_upper,
+                    sleep=lambda s: None)
+    p.mark()
+    assert p.next_delay_ms() == 200   # grows past max once (reference quirk)
+    assert p.next_delay_ms() == 200   # then stays
+
+
+def test_deadline_exhaustion():
+    clock = FakeClock()
+    p = RetryPolicy(init_ms=1, max_ms=1, deadline_ms=5_000, clock=clock,
+                    sleep=lambda s: None)
+    assert p.backoff() is not None
+    clock.t += 6.0
+    assert p.backoff() is None
+
+
+def test_policy_from_config():
+    config = Config.from_string(
+        "[output]\nkafka_retry_init = 7\nkafka_retry_max = 70\n"
+        "kafka_retry_attempts = 2\n")
+    p = policy_from_config(config, "output.kafka")
+    assert p.init_ms == 7 and p.max_ms == 70 and p.max_attempts == 2
+
+
+def test_invalid_policy_args():
+    with pytest.raises(ValueError, match="mode"):
+        RetryPolicy(mode="bogus")
+    with pytest.raises(ValueError, match="max_ms"):
+        RetryPolicy(init_ms=100, max_ms=10)
+
+
+# ---------------------------------------------------------------------------
+# Supervisor
+# ---------------------------------------------------------------------------
+
+def _fast_supervisor(max_restarts=None):
+    sup = Supervisor(None)
+    sup.backoff_init = 1
+    # keep the stable-run threshold (backoff_max) far above a crash
+    # loop's iteration time, or slow boxes "earn" budget resets; sleeps
+    # stay tiny because they're uniform(0, init * 2^attempt)
+    sup.backoff_max = 5000
+    sup.max_restarts = max_restarts
+    return sup
+
+
+def test_supervisor_restarts_until_clean_exit():
+    registry.reset()
+    sup = _fast_supervisor()
+    state = {"n": 0}
+
+    def target():
+        state["n"] += 1
+        if state["n"] < 3:
+            raise RuntimeError("crash")
+
+    sup.run(target, "test-thread")
+    assert state["n"] == 3
+    assert registry.get("thread_crashes") == 2
+    assert registry.get("thread_restarts") == 2
+
+
+def test_supervisor_gives_up_after_budget():
+    registry.reset()
+    sup = _fast_supervisor(max_restarts=2)
+    state = {"n": 0}
+
+    def target():
+        state["n"] += 1
+        raise RuntimeError("always")
+
+    sup.run(target, "doomed")  # returns instead of raising
+    assert state["n"] == 3     # initial + 2 restarts
+    assert registry.get("thread_crashes") == 3
+
+
+def test_supervisor_spawn_runs_in_thread():
+    registry.reset()
+    sup = _fast_supervisor()
+    done = threading.Event()
+    state = {"n": 0}
+
+    def target():
+        state["n"] += 1
+        if state["n"] < 2:
+            raise RuntimeError("once")
+        done.set()
+
+    t = sup.spawn(target, "spawned")
+    assert done.wait(timeout=5)
+    t.join(timeout=5)
+    assert not t.is_alive()
+    assert registry.get("thread_restarts") == 1
+
+
+def test_supervisor_config_keys():
+    config = Config.from_string(
+        "[supervisor]\nmax_restarts = 4\nbackoff_init = 5\nbackoff_max = 6\n")
+    sup = Supervisor(config)
+    assert (sup.max_restarts, sup.backoff_init, sup.backoff_max) == (4, 5, 6)

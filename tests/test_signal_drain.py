@@ -1,0 +1,81 @@
+"""SIGTERM/SIGINT drain path: a real daemon process receiving SIGTERM
+mid-stream must flush every queued message to the sink before exiting.
+
+The sink runs with a large in-memory buffer (output.file_buffer_size),
+so nothing reaches disk until the drain's flush — the on-disk content
+after SIGTERM proves the signal handler ran the full drain: SHUTDOWN
+sentinels, worker join, sink flush."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+N_LINES = 500
+LINE = "<23>1 2015-08-05T15:53:45.637824Z testhostname appname 69 %d - msg %d"
+
+
+def _write_config(tmp_path, out_path, metrics_path):
+    cfg = tmp_path / "drain.toml"
+    cfg.write_text(
+        '[input]\ntype = "stdin"\nformat = "rfc5424"\n'
+        '[output]\ntype = "file"\nformat = "passthrough"\n'
+        'framing = "line"\n'
+        f'file_path = "{out_path}"\n'
+        "file_buffer_size = 1048576\n"  # hold everything in memory
+        "[metrics]\ninterval = 1\n"
+        f'path = "{metrics_path}"\n')
+    return cfg
+
+
+def _enqueued(metrics_path) -> int:
+    """Latest enqueued count from the daemon's metrics JSONL."""
+    if not os.path.exists(metrics_path):
+        return 0
+    lines = [ln for ln in open(metrics_path).read().splitlines() if ln]
+    if not lines:
+        return 0
+    return json.loads(lines[-1]).get("enqueued", 0)
+
+
+@pytest.mark.parametrize("signum", [signal.SIGTERM, signal.SIGINT])
+def test_signal_mid_stream_drains_all_queued_messages(tmp_path, signum):
+    out_path = tmp_path / "sink.log"
+    metrics_path = tmp_path / "metrics.jsonl"
+    cfg = _write_config(tmp_path, out_path, metrics_path)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "flowgger_tpu", str(cfg)],
+        stdin=subprocess.PIPE, stderr=subprocess.DEVNULL, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    try:
+        payload = "".join(
+            LINE % (i, i) + "\n" for i in range(N_LINES)).encode()
+        proc.stdin.write(payload)
+        proc.stdin.flush()
+        # stdin stays OPEN: the daemon is mid-stream, not at EOF.  Wait
+        # until the metrics reporter confirms every line was ingested.
+        deadline = time.time() + 60
+        while _enqueued(metrics_path) < N_LINES:
+            assert time.time() < deadline, (
+                f"daemon ingested {_enqueued(metrics_path)}/{N_LINES} "
+                "lines before timeout")
+            assert proc.poll() is None, "daemon died prematurely"
+            time.sleep(0.1)
+        # nothing may have reached disk yet (1MB sink buffer) — the
+        # signal-triggered drain is what must flush it
+        proc.send_signal(signum)
+        assert proc.wait(timeout=60) == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10)
+    data = out_path.read_bytes()
+    got = data.decode().splitlines()
+    assert len(got) == N_LINES
+    assert got[0] == LINE % (0, 0) and got[-1] == LINE % (N_LINES - 1,
+                                                          N_LINES - 1)
