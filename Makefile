@@ -1,0 +1,24 @@
+# Developer shortcuts; ci.sh remains the canonical CI entry point.
+.PHONY: flowcheck flowcheck-baseline test native lint ci
+
+# static analysis gate (FC01-FC05); pure ast, runs in seconds
+flowcheck:
+	python -m flowgger_tpu.analysis --format text .
+
+# freeze current findings (then edit the "reason" fields in
+# .flowcheck-baseline.json — see README "Static analysis")
+flowcheck-baseline:
+	python -m flowgger_tpu.analysis --write-baseline .
+
+test:
+	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow'
+
+native:
+	$(MAKE) -C native -s
+
+lint:
+	python -m flowgger_tpu --check flowgger.toml
+	python -m flowgger_tpu --check examples/multihost-dp.toml
+
+ci:
+	./ci.sh

@@ -8,6 +8,11 @@
 set -euo pipefail
 cd "$(dirname "$0")"
 
+echo "== flowcheck (static analysis: trace-safety, thread discipline, =="
+echo "==            byte-identity contracts, exception hygiene, keys) =="
+# pure-ast, no JAX import: fails on any non-baselined FC01-FC05 finding
+python -m flowgger_tpu.analysis --format text .
+
 echo "== python test suite (virtual 8-device CPU mesh) =="
 python -m pytest tests/ -q -m "not faults"
 

@@ -1,0 +1,94 @@
+"""CLI: ``python -m flowgger_tpu.analysis [root] [options]``.
+
+Exit codes: 0 = clean (no non-baselined findings), 1 = findings,
+2 = usage/internal error (unknown rule, malformed baseline, bad root).
+Pure ``ast`` + stdlib — no JAX import, so this runs in seconds and
+gates CI before the test suite starts.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from . import baseline as baseline_mod
+from .core import all_rules, run_check
+from .reporters import RENDERERS
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="flowcheck",
+        description="AST-based invariant checker for flowgger-tpu "
+                    "(trace-safety, thread discipline, byte-identity "
+                    "contracts, exception hygiene, config-key drift)")
+    parser.add_argument("root", nargs="?", default=".",
+                        help="scan root (default: current directory)")
+    parser.add_argument("--format", choices=sorted(RENDERERS),
+                        default="text", help="report format")
+    parser.add_argument("--rules", metavar="FC01,FC02,...",
+                        help="comma-separated subset of rules to run")
+    parser.add_argument("--baseline", metavar="FILE",
+                        help="baseline file (default: "
+                             f"<root>/{baseline_mod.DEFAULT_BASELINE} "
+                             "when present)")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="ignore any baseline file")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="freeze current findings into the baseline "
+                             "file and exit 0")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print the rule catalog and exit")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule in all_rules().values():
+            print(f"{rule.id}  {rule.title}")
+        return 0
+
+    root = os.path.abspath(args.root)
+    if not os.path.isdir(root):
+        print(f"flowcheck: scan root {args.root!r} is not a directory",
+              file=sys.stderr)
+        return 2
+
+    rule_ids = None
+    if args.rules:
+        rule_ids = [r.strip().upper() for r in args.rules.split(",")
+                    if r.strip()]
+
+    baseline_path = args.baseline or os.path.join(
+        root, baseline_mod.DEFAULT_BASELINE)
+    baseline_keys = None
+    if not args.no_baseline and not args.write_baseline:
+        if os.path.exists(baseline_path):
+            try:
+                baseline_keys = baseline_mod.load(baseline_path)
+            except baseline_mod.BaselineError as e:
+                print(f"flowcheck: {e}", file=sys.stderr)
+                return 2
+        elif args.baseline:
+            print(f"flowcheck: baseline {args.baseline!r} not found",
+                  file=sys.stderr)
+            return 2
+
+    try:
+        result = run_check(root, rule_ids=rule_ids,
+                           baseline_keys=baseline_keys)
+    except KeyError as e:
+        print(f"flowcheck: {e.args[0]}", file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        baseline_mod.write(baseline_path, result.findings)
+        print(f"flowcheck: wrote {len(result.findings)} finding(s) to "
+              f"{baseline_path}")
+        return 0
+
+    print(RENDERERS[args.format](result))
+    return 1 if result.findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

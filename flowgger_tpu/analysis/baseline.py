@@ -1,0 +1,92 @@
+"""flowcheck baseline: freeze pre-existing findings so CI gates on new
+ones only.
+
+The baseline is a committed JSON file (default
+``.flowcheck-baseline.json`` at the scan root) listing findings by
+``(rule, path, message)`` — line numbers drift with unrelated edits and
+are deliberately not part of the identity.  Each entry carries a
+``reason`` so a frozen finding documents *why* it is allowed to exist;
+entries are consumed as a multiset (``count``), so two identical
+swallows in one file need a baseline count of 2.
+
+Workflow: ``python -m flowgger_tpu.analysis --write-baseline`` freezes
+the current findings (reasons default to "baselined"; edit them), and a
+later clean run means every entry can be deleted — the file shrinking
+to ``[]`` is the goal state, enforced by review rather than tooling.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Tuple
+
+from .core import Finding
+
+DEFAULT_BASELINE = ".flowcheck-baseline.json"
+
+Key = Tuple[str, str, str]
+
+
+class BaselineError(Exception):
+    """Unreadable or malformed baseline file."""
+
+
+def load(path: str) -> Dict[Key, int]:
+    """Baseline file -> multiset of finding keys."""
+    try:
+        with open(path, "r", encoding="utf-8") as fd:
+            entries = json.load(fd)
+    except OSError as e:
+        raise BaselineError(f"cannot read baseline {path}: {e}")
+    except ValueError as e:
+        raise BaselineError(f"baseline {path} is not valid JSON: {e}")
+    if not isinstance(entries, list):
+        raise BaselineError(f"baseline {path} must be a JSON list")
+    keys: Dict[Key, int] = {}
+    for i, entry in enumerate(entries):
+        if not isinstance(entry, dict) or not all(
+                isinstance(entry.get(k), str)
+                for k in ("rule", "path", "message")):
+            raise BaselineError(
+                f"baseline {path} entry {i} needs string rule/path/message")
+        key = (entry["rule"], entry["path"], entry["message"])
+        count = entry.get("count", 1)
+        if not isinstance(count, int) or count < 1:
+            raise BaselineError(
+                f"baseline {path} entry {i}: count must be a positive int")
+        keys[key] = keys.get(key, 0) + count
+    return keys
+
+
+def write(path: str, findings: List[Finding]) -> None:
+    """Freeze ``findings`` (the active, non-baselined ones) to ``path``.
+
+    Regeneration is non-destructive: an entry already present in the
+    old baseline keeps its hand-edited ``reason``; only genuinely new
+    entries get the placeholder.
+    """
+    old_reasons: Dict[Key, str] = {}
+    if os.path.exists(path):
+        try:
+            with open(path, "r", encoding="utf-8") as fd:
+                for entry in json.load(fd):
+                    key = (entry["rule"], entry["path"], entry["message"])
+                    reason = entry.get("reason")
+                    if isinstance(reason, str):
+                        old_reasons.setdefault(key, reason)
+        except (OSError, ValueError, KeyError, TypeError):
+            pass  # unreadable old baseline: fall through to placeholders
+    counted: Dict[Key, int] = {}
+    for f in findings:
+        counted[f.key] = counted.get(f.key, 0) + 1
+    placeholder = "baselined — replace with why this finding is deliberate"
+    entries = [{
+        "rule": rule, "path": rel, "message": message, "count": count,
+        "reason": old_reasons.get((rule, rel, message), placeholder),
+    } for (rule, rel, message), count in sorted(counted.items())]
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as fd:
+        json.dump(entries, fd, indent=2, sort_keys=True)
+        fd.write("\n")
+    os.replace(tmp, path)

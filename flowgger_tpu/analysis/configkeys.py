@@ -1,0 +1,183 @@
+"""Derive the config-key namespace from ``config.lookup*`` call sites.
+
+``lint.py`` used to hand-maintain ``KNOWN_KEYS`` — a list that drifted
+the moment anyone added a lookup without updating it (``metrics.jsonl``
+sat in it for two PRs; it was never a key, it was the *example value* of
+``metrics.path``).  This module walks the package source with ``ast``
+and derives the namespace from what the code actually reads:
+
+- a literal first argument to ``.lookup`` / ``.lookup_str`` /
+  ``.lookup_int`` / ``.lookup_float`` / ``.lookup_bool`` is a known key;
+- a literal first argument to ``.lookup_table`` is a free-form table
+  (user-defined sub-keys: ltsv_schema, *_extra, faults);
+- calls through registered *forwarders* — helpers that build key paths
+  from a literal prefix argument — expand to the keys the helper reads
+  (``retry_config_kwargs(config, "output.kafka")`` reads the three
+  ``output.kafka_retry_*`` keys; a ``tcp_config_parse(config)`` call
+  reads its default ``threads_key``, ``input.tcp_threads``, and a
+  literal ``threads_key=`` argument would be picked up the same way).
+
+Any other non-literal lookup path is *underivable*; flowcheck FC05
+flags it so the namespace stays machine-checkable.  ``lint.py`` imports
+``derived_namespace`` instead of a hand-written set, which makes this
+class of drift structurally impossible.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import dotted_name, literal_str
+
+LOOKUP_METHODS = {
+    "lookup", "lookup_str", "lookup_int", "lookup_float", "lookup_bool",
+}
+TABLE_METHODS = {"lookup_table"}
+
+# helpers whose non-literal lookup paths are derived from their call
+# sites instead: name -> (prefix argument index, suffixes added to the
+# literal prefix; None = the prefix IS the key)
+RETRY_SUFFIXES = ("_retry_init", "_retry_max", "_retry_attempts")
+FORWARDERS: Dict[str, Tuple[int, Optional[Tuple[str, ...]]]] = {
+    "retry_config_kwargs": (1, RETRY_SUFFIXES),
+    "policy_from_config": (1, RETRY_SUFFIXES),
+    "tcp_config_parse": (1, None),
+}
+# keyword spelling of each forwarder's prefix argument
+_FORWARDER_KW = {"retry_config_kwargs": "prefix", "policy_from_config": "prefix",
+                 "tcp_config_parse": "threads_key"}
+# a forwarder called without its prefix argument uses its default
+_FORWARDER_DEFAULT = {"tcp_config_parse": "input.tcp_threads"}
+
+
+@dataclass
+class DerivedNamespace:
+    keys: Set[str] = field(default_factory=set)
+    free_tables: Set[str] = field(default_factory=set)
+    # (rel, line, enclosing function name) of lookups whose path is not
+    # a string literal and whose enclosing function is not a forwarder
+    dynamic_sites: List[Tuple[str, int, str]] = field(default_factory=list)
+    # key -> first (rel, line) that reads it, for FC05 diagnostics
+    read_sites: Dict[str, Tuple[str, int]] = field(default_factory=dict)
+
+
+def _forwarder_prefix(call: ast.Call, name: str) -> Optional[str]:
+    idx, _ = FORWARDERS[name]
+    if len(call.args) > idx:
+        return literal_str(call.args[idx])
+    kw_name = _FORWARDER_KW[name]
+    for kw in call.keywords:
+        if kw.arg == kw_name:
+            return literal_str(kw.value)
+    return _FORWARDER_DEFAULT.get(name)
+
+
+def scan_tree(tree: ast.Module, rel: str, ns: DerivedNamespace) -> None:
+    """Accumulate one file's lookup/forwarder sites into ``ns``."""
+    # enclosing-function names, for the forwarder exemption
+    func_of: Dict[ast.AST, str] = {}
+
+    def annotate(node: ast.AST, fname: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            inner = fname
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                inner = child.name
+            func_of[child] = inner
+            annotate(child, inner)
+
+    annotate(tree, "<module>")
+
+    def record(key: str, line: int) -> None:
+        ns.keys.add(key)
+        ns.read_sites.setdefault(key, (rel, line))
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        # config.lookup*("dotted.key", ...)
+        if isinstance(func, ast.Attribute) and (
+                func.attr in LOOKUP_METHODS or func.attr in TABLE_METHODS):
+            if not node.args:
+                continue
+            key = literal_str(node.args[0])
+            if key is None:
+                fname = func_of.get(node, "<module>")
+                if (fname not in FORWARDERS
+                        and fname not in LOOKUP_METHODS
+                        and fname not in TABLE_METHODS):
+                    # the Config.lookup_* wrappers themselves and
+                    # registered forwarders are the two places a
+                    # variable path is expected
+                    ns.dynamic_sites.append((rel, node.lineno, fname))
+                continue
+            if func.attr in TABLE_METHODS:
+                ns.free_tables.add(key)
+                ns.read_sites.setdefault(key, (rel, node.lineno))
+            else:
+                record(key, node.lineno)
+            continue
+        # forwarder(config, "literal.prefix", ...)
+        callee = dotted_name(func)
+        short = callee.rsplit(".", 1)[-1] if callee else None
+        if short in FORWARDERS:
+            prefix = _forwarder_prefix(node, short)
+            if prefix is None:
+                # a forwarder delegating to another forwarder with its
+                # own (variable) prefix resolves at ITS call sites
+                fname = func_of.get(node, "<module>")
+                if fname not in FORWARDERS:
+                    ns.dynamic_sites.append((rel, node.lineno, fname))
+                continue
+            _, suffixes = FORWARDERS[short]
+            if suffixes is None:
+                record(prefix, node.lineno)
+            else:
+                for suffix in suffixes:
+                    record(prefix + suffix, node.lineno)
+
+
+def namespace_from_sources(files: List[Tuple[str, ast.Module]]
+                           ) -> DerivedNamespace:
+    ns = DerivedNamespace()
+    for rel, tree in files:
+        scan_tree(tree, rel, ns)
+    return ns
+
+
+_CACHE: Dict[str, DerivedNamespace] = {}
+
+
+def derived_namespace(package_root: Optional[str] = None) -> DerivedNamespace:
+    """Namespace read from the ``flowgger_tpu`` package source (cached).
+
+    Default root: the installed package directory itself — ``lint.py``
+    calls this with no argument, so ``--check`` validates configs
+    against whatever keys *this* build of the code actually reads.
+    """
+    if package_root is None:
+        package_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    package_root = os.path.abspath(package_root)
+    if package_root in _CACHE:
+        return _CACHE[package_root]
+    files: List[Tuple[str, ast.Module]] = []
+    for dirpath, dirnames, filenames in os.walk(package_root):
+        dirnames[:] = sorted(d for d in dirnames
+                             if d != "__pycache__" and d != "analysis"
+                             and not d.startswith("."))
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            try:
+                with open(path, "r", encoding="utf-8") as fd:
+                    tree = ast.parse(fd.read())
+            except (OSError, SyntaxError, ValueError):
+                continue
+            files.append((os.path.relpath(path, package_root), tree))
+    ns = namespace_from_sources(files)
+    _CACHE[package_root] = ns
+    return ns

@@ -1,0 +1,288 @@
+"""flowcheck engine: file discovery, suppressions, rule registry, runner.
+
+Everything here is pure ``ast`` + stdlib so the checker can run in CI
+environments (and pre-commit hooks) without the JAX toolchain — the same
+Python 3.10/tomli floor the pipeline itself supports.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+# directories never scanned (tooling, build output, reference corpora);
+# tests/ is excluded from the *per-file* rule scan — it is the oracle
+# layer the invariants are checked against, and FC03 reads it separately
+# through Project.test_files
+EXCLUDED_DIRS = {
+    ".git", "__pycache__", ".pytest_cache", ".mypy_cache", ".ruff_cache",
+    "node_modules", "native", "tools", "examples",
+}
+EXCLUDED_FILES = {"bench.py"}
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*flowcheck:\s*disable=([A-Za-z0-9_,\s]+?)"
+    r"(?:\s*--\s*(?P<reason>.*))?\s*$")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation at a location.
+
+    Baseline identity is ``(rule, path, message)`` — line numbers drift
+    with unrelated edits, so they are reported but not matched on.
+    """
+
+    rule: str
+    path: str          # posix-style path relative to the scan root
+    line: int
+    col: int
+    message: str
+
+    @property
+    def key(self) -> Tuple[str, str, str]:
+        return (self.rule, self.path, self.message)
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+
+
+class Suppressions:
+    """Per-line ``# flowcheck: disable=RULE[,RULE] [-- reason]`` map.
+
+    A trailing comment covers its own line; a comment alone on a line
+    covers the next line holding code (so a suppression can sit above a
+    long statement without breaking line length).
+    """
+
+    def __init__(self, source: str):
+        self._rules_by_line: Dict[int, Set[str]] = {}
+        lines = source.splitlines()
+        for idx, text in enumerate(lines):
+            m = _SUPPRESS_RE.search(text)
+            if not m:
+                continue
+            rules = {r.strip().upper() for r in m.group(1).split(",")
+                     if r.strip()}
+            lineno = idx + 1
+            self._rules_by_line.setdefault(lineno, set()).update(rules)
+            if text.lstrip().startswith("#"):
+                # standalone comment: also covers the next code line
+                for j in range(idx + 1, len(lines)):
+                    nxt = lines[j].strip()
+                    if nxt and not nxt.startswith("#"):
+                        self._rules_by_line.setdefault(j + 1, set()).update(
+                            rules)
+                        break
+
+    def covers(self, line: int, rule: str) -> bool:
+        rules = self._rules_by_line.get(line)
+        return rules is not None and (rule in rules or "ALL" in rules)
+
+
+@dataclass
+class Module:
+    """One parsed source file under the scan root."""
+
+    path: str                    # absolute
+    rel: str                     # posix relpath from the scan root
+    source: str
+    tree: ast.Module
+    suppressions: Suppressions
+
+    @property
+    def name(self) -> str:
+        return os.path.splitext(os.path.basename(self.rel))[0]
+
+
+@dataclass
+class Project:
+    """The scan root plus every parsed module and the test tree."""
+
+    root: str
+    modules: List[Module] = field(default_factory=list)
+    test_files: List[str] = field(default_factory=list)  # rel posix paths
+    _parse_cache: Dict[str, Optional[ast.Module]] = field(
+        default_factory=dict, repr=False)
+
+    def parse(self, rel: str) -> Optional[ast.Module]:
+        """AST of any file under the root (cached); None if unreadable."""
+        if rel not in self._parse_cache:
+            try:
+                with open(os.path.join(self.root, rel), "r",
+                          encoding="utf-8") as fd:
+                    self._parse_cache[rel] = ast.parse(fd.read())
+            except (OSError, SyntaxError, ValueError):
+                self._parse_cache[rel] = None
+        return self._parse_cache[rel]
+
+    def exists(self, rel: str) -> bool:
+        return os.path.exists(os.path.join(self.root, rel))
+
+
+class Rule:
+    """Base class for flowcheck rules.
+
+    Subclasses register with ``@register`` and implement ``check``
+    (per-module) and/or ``check_project`` (whole-tree rules like FC03 /
+    FC05).  ``scope`` filters which files a per-module rule sees.
+    """
+
+    id: str = "FC00"
+    title: str = ""
+
+    def scope(self, rel: str) -> bool:
+        return True
+
+    def check(self, module: Module, project: Project) -> Iterable[Finding]:
+        return ()
+
+    def check_project(self, project: Project) -> Iterable[Finding]:
+        return ()
+
+
+_REGISTRY: Dict[str, Rule] = {}
+
+
+def register(cls):
+    """Class decorator: instantiate and register a Rule by its id."""
+    rule = cls()
+    _REGISTRY[rule.id] = rule
+    return cls
+
+
+def all_rules() -> Dict[str, Rule]:
+    _load_rules()
+    return dict(sorted(_REGISTRY.items()))
+
+
+def _load_rules() -> None:
+    # import-for-effect: each rule module registers itself
+    from .rules import (  # noqa: F401
+        fc01_trace,
+        fc02_threads,
+        fc03_oracle,
+        fc04_exceptions,
+        fc05_configkeys,
+    )
+
+
+# -- discovery ---------------------------------------------------------------
+
+def _iter_py_files(root: str) -> Iterable[str]:
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(
+            d for d in dirnames
+            if d not in EXCLUDED_DIRS and not d.startswith("."))
+        for fn in sorted(filenames):
+            if fn.endswith(".py") and fn not in EXCLUDED_FILES:
+                yield os.path.join(dirpath, fn)
+
+
+def _relposix(path: str, root: str) -> str:
+    return os.path.relpath(path, root).replace(os.sep, "/")
+
+
+def load_project(root: str) -> Project:
+    """Parse every scannable file under ``root`` into a Project.
+
+    ``tests/`` (outside ``tests/fixtures``) is catalogued for the
+    cross-reference rules but excluded from the per-file scan; files
+    that fail to parse are skipped (a syntax error is the compiler's
+    finding, not ours).
+    """
+    root = os.path.abspath(root)
+    project = Project(root=root)
+    for path in _iter_py_files(root):
+        rel = _relposix(path, root)
+        parts = rel.split("/")
+        if "tests" in parts:
+            if "fixtures" not in parts:
+                project.test_files.append(rel)
+            continue
+        try:
+            with open(path, "r", encoding="utf-8") as fd:
+                source = fd.read()
+            tree = ast.parse(source)
+        except (OSError, SyntaxError, ValueError):
+            continue
+        project.modules.append(Module(
+            path=path, rel=rel, source=source, tree=tree,
+            suppressions=Suppressions(source)))
+    return project
+
+
+# -- runner ------------------------------------------------------------------
+
+@dataclass
+class CheckResult:
+    findings: List[Finding]          # active (non-suppressed, non-baselined)
+    baselined: List[Finding]
+    suppressed_count: int
+    project: Project
+
+
+def run_check(root: str, rule_ids: Optional[Sequence[str]] = None,
+              baseline_keys: Optional[Dict[Tuple[str, str, str], int]] = None,
+              ) -> CheckResult:
+    """Run the (selected) rules over ``root`` and partition the findings
+    into active / baselined, dropping suppressed ones."""
+    rules = all_rules()
+    if rule_ids is not None:
+        unknown = [r for r in rule_ids if r not in rules]
+        if unknown:
+            raise KeyError(f"unknown rule id(s): {', '.join(unknown)}")
+        rules = {rid: rules[rid] for rid in rule_ids}
+    project = load_project(root)
+    raw: List[Finding] = []
+    suppress_map = {m.rel: m.suppressions for m in project.modules}
+    for rule in rules.values():
+        for module in project.modules:
+            if rule.scope(module.rel):
+                raw.extend(rule.check(module, project))
+        raw.extend(rule.check_project(project))
+
+    suppressed = 0
+    visible: List[Finding] = []
+    for f in raw:
+        sup = suppress_map.get(f.path)
+        if sup is not None and sup.covers(f.line, f.rule):
+            suppressed += 1
+        else:
+            visible.append(f)
+    visible.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+
+    remaining = dict(baseline_keys or {})
+    active: List[Finding] = []
+    baselined: List[Finding] = []
+    for f in visible:
+        if remaining.get(f.key, 0) > 0:
+            remaining[f.key] -= 1
+            baselined.append(f)
+        else:
+            active.append(f)
+    return CheckResult(findings=active, baselined=baselined,
+                       suppressed_count=suppressed, project=project)
+
+
+# -- shared AST helpers ------------------------------------------------------
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for a Name/Attribute chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def literal_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None

@@ -1,0 +1,85 @@
+"""flowcheck output formats: human text, machine JSON, and SARIF 2.1.0.
+
+SARIF is the lingua franca of code-scanning UIs (GitHub code scanning
+ingests it directly); JSON is the stable shape scripts and the test
+suite consume; text is for humans and CI logs.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import List
+
+from .core import CheckResult, Finding, all_rules
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+                "master/Schemata/sarif-schema-2.1.0.json")
+
+
+def render_text(result: CheckResult) -> str:
+    lines: List[str] = [f.render() for f in result.findings]
+    lines.append(
+        f"flowcheck: {len(result.findings)} finding(s), "
+        f"{len(result.baselined)} baselined, "
+        f"{result.suppressed_count} suppressed, "
+        f"{len(result.project.modules)} file(s) scanned")
+    return "\n".join(lines)
+
+
+def _finding_dict(f: Finding) -> dict:
+    return {"rule": f.rule, "path": f.path, "line": f.line, "col": f.col,
+            "message": f.message}
+
+
+def render_json(result: CheckResult) -> str:
+    payload = {
+        "tool": "flowcheck",
+        "root": result.project.root,
+        "findings": [_finding_dict(f) for f in result.findings],
+        "baselined": [_finding_dict(f) for f in result.baselined],
+        "counts": {
+            "findings": len(result.findings),
+            "baselined": len(result.baselined),
+            "suppressed": result.suppressed_count,
+            "files_scanned": len(result.project.modules),
+        },
+    }
+    return json.dumps(payload, indent=2, sort_keys=True)
+
+
+def render_sarif(result: CheckResult) -> str:
+    rules = [{
+        "id": rule.id,
+        "name": type(rule).__name__,
+        "shortDescription": {"text": rule.title},
+    } for rule in all_rules().values()]
+    results = [{
+        "ruleId": f.rule,
+        "level": "error",
+        "message": {"text": f.message},
+        "locations": [{
+            "physicalLocation": {
+                "artifactLocation": {"uri": f.path},
+                "region": {"startLine": f.line,
+                           "startColumn": max(1, f.col + 1)},
+            },
+        }],
+    } for f in result.findings]
+    payload = {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [{
+            "tool": {"driver": {
+                "name": "flowcheck",
+                "informationUri":
+                    "https://github.com/awslabs/flowgger",
+                "rules": rules,
+            }},
+            "results": results,
+        }],
+    }
+    return json.dumps(payload, indent=2, sort_keys=True)
+
+
+RENDERERS = {"text": render_text, "json": render_json, "sarif": render_sarif}

@@ -1,0 +1,6 @@
+"""flowcheck rule plug-ins.
+
+Each module defines one rule class decorated with ``@core.register``;
+``core.all_rules()`` imports this package for effect.  Adding a rule =
+adding a module here and importing it from ``core._load_rules``.
+"""

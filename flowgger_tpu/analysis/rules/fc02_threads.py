@@ -1,0 +1,223 @@
+"""FC02 — thread discipline in the supervised pipeline.
+
+The supervisor/breaker/queue layer (PR 2) runs a dozen threads over
+shared mutable state.  Two invariants keep that sane, and both are
+checkable from the AST:
+
+1. **Guarded read-modify-write.**  An augmented assignment to an
+   attribute (``self.count += 1`` and friends) from a function that
+   runs on its own thread — a ``threading.Thread``/``Timer`` target, a
+   ``Supervisor.spawn``/``spawn_worker`` worker, or anything those call
+   module-locally — must sit inside a ``with <...lock...>:`` block.
+   Unshared counters belong in locals; shared ones belong behind a lock
+   or in ``utils.metrics`` (whose registry takes its own lock).
+   Plain stores are deliberately not flagged: a GIL-atomic flag write
+   (``self.open_failed = True``) is a legitimate publication idiom, the
+   lost-update hazard is specific to read-modify-write.
+
+2. **No blocking call while holding a lock.**  Inside any ``with``
+   whose context expression names a lock, calls that can block
+   indefinitely (queue ``get``/``put``, socket ``recv``/``accept``/
+   ``connect``/``send*``, ``time.sleep``, ``Thread.join``,
+   ``subprocess.run``) turn every other thread contending on that lock
+   into a convoy — the exact wedge class the bounded-queue layer
+   exists to avoid.  ``Condition.wait`` is exempt (it releases the
+   lock); so is ``dict.get(key)`` (only zero-argument ``.get()`` —
+   the queue signature — is considered blocking).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set
+
+from ..core import Finding, Module, Project, Rule, dotted_name, register
+
+_BLOCKING_ATTRS = {
+    "join", "recv", "recvfrom", "recv_into", "accept", "connect",
+    "sendall", "send", "put",
+}
+_BLOCKING_CALLS = {
+    "time.sleep", "subprocess.run", "subprocess.check_call",
+    "subprocess.check_output", "select.select",
+}
+_SPAWN_FUNCS = {"spawn_worker"}
+_SPAWN_METHODS = {"spawn"}
+
+
+def _lockish(expr: ast.AST) -> bool:
+    name = dotted_name(expr) or ""
+    if "lock" in name.lower():
+        return True
+    # threading.Lock()/RLock() constructed inline
+    if isinstance(expr, ast.Call):
+        inner = dotted_name(expr.func) or ""
+        return inner.split(".")[-1] in ("Lock", "RLock")
+    return False
+
+
+def _callable_name(node: ast.AST) -> Optional[str]:
+    """Local function name a callable expression refers to: a bare
+    Name, ``self.method``, ``obj.method`` (method name), or the
+    function(s) a ``lambda`` body calls."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return None
+
+
+class _Index(ast.NodeVisitor):
+    """Functions/methods by name plus the set of thread-target names."""
+
+    def __init__(self, tree: ast.Module):
+        self.functions: Dict[str, ast.FunctionDef] = {}
+        self.targets: Set[str] = set()
+        self._collect_defs(tree)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                self._scan_call(node)
+
+    def _collect_defs(self, tree: ast.Module) -> None:
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # first definition wins; names are unique enough per module
+                self.functions.setdefault(node.name, node)
+
+    def _add_target(self, expr: ast.AST) -> None:
+        if isinstance(expr, ast.Lambda):
+            for sub in ast.walk(expr.body):
+                if isinstance(sub, ast.Call):
+                    name = _callable_name(sub.func)
+                    if name in self.functions:
+                        self.targets.add(name)
+            return
+        name = _callable_name(expr)
+        if name in self.functions:
+            self.targets.add(name)
+
+    def _scan_call(self, call: ast.Call) -> None:
+        callee = dotted_name(call.func) or ""
+        short = callee.split(".")[-1]
+        if short == "Thread":
+            for kw in call.keywords:
+                if kw.arg == "target":
+                    self._add_target(kw.value)
+        elif short == "Timer":
+            if len(call.args) >= 2:
+                self._add_target(call.args[1])
+        elif short in _SPAWN_FUNCS and call.args:
+            self._add_target(call.args[0])
+        elif (isinstance(call.func, ast.Attribute)
+              and call.func.attr in _SPAWN_METHODS and call.args):
+            self._add_target(call.args[0])
+
+    def thread_reachable(self) -> Set[str]:
+        """Module-local call-graph closure under the thread targets."""
+        seen: Set[str] = set()
+        queue = list(self.targets)
+        while queue:
+            name = queue.pop()
+            if name in seen:
+                continue
+            seen.add(name)
+            fn = self.functions.get(name)
+            if fn is None:
+                continue
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call):
+                    callee = _callable_name(node.func)
+                    if callee in self.functions and callee not in seen:
+                        queue.append(callee)
+        return seen
+
+
+def _with_lock_lines(fn: ast.FunctionDef) -> Set[int]:
+    """Line numbers covered by a lock-guarded ``with`` inside ``fn``
+    (nested function bodies excluded — they run later, elsewhere)."""
+    lines: Set[int] = set()
+
+    def visit(node: ast.AST, in_nested: bool) -> None:
+        for child in ast.iter_child_nodes(node):
+            nested = in_nested or isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda))
+            if (not in_nested and isinstance(child, ast.With)
+                    and any(_lockish(item.context_expr)
+                            for item in child.items)):
+                end = getattr(child, "end_lineno", child.lineno)
+                lines.update(range(child.lineno, end + 1))
+            visit(child, nested)
+
+    visit(fn, False)
+    return lines
+
+
+@register
+class ThreadDiscipline(Rule):
+    id = "FC02"
+    title = "thread discipline (guarded counters, no blocking under locks)"
+
+    def check(self, module: Module, project: Project) -> Iterable[Finding]:
+        index = _Index(module.tree)
+        findings: List[Finding] = []
+
+        # (1) unguarded attribute read-modify-write on thread paths
+        for name in index.thread_reachable():
+            fn = index.functions.get(name)
+            if fn is None:
+                continue
+            guarded = _with_lock_lines(fn)
+            for node in ast.walk(fn):
+                if (isinstance(node, ast.AugAssign)
+                        and isinstance(node.target, ast.Attribute)
+                        and node.lineno not in guarded):
+                    target = dotted_name(node.target) or node.target.attr
+                    findings.append(Finding(
+                        self.id, module.rel, node.lineno, node.col_offset,
+                        f"unguarded read-modify-write of shared attribute "
+                        f"'{target}' in thread-target '{name}' (guard with "
+                        f"a lock or use utils.metrics counters)"))
+
+        # (2) blocking calls while holding a lock — any function
+        for fn in index.functions.values():
+            self._check_lock_bodies(fn, module, findings)
+        return findings
+
+    def _check_lock_bodies(self, fn: ast.FunctionDef, module: Module,
+                           findings: List[Finding]) -> None:
+        def visit(node: ast.AST, holding: bool) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child,
+                              (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.Lambda)):
+                    continue  # separate execution context
+                hold = holding
+                if isinstance(child, ast.With) and any(
+                        _lockish(item.context_expr)
+                        for item in child.items):
+                    hold = True
+                if holding and isinstance(child, ast.Call):
+                    self._flag_blocking(child, fn, module, findings)
+                visit(child, hold)
+
+        visit(fn, False)
+
+    def _flag_blocking(self, call: ast.Call, fn: ast.FunctionDef,
+                       module: Module, findings: List[Finding]) -> None:
+        callee = dotted_name(call.func)
+        blocked = None
+        if callee in _BLOCKING_CALLS:
+            blocked = f"{callee}()"
+        elif isinstance(call.func, ast.Attribute):
+            attr = call.func.attr
+            if attr in _BLOCKING_ATTRS:
+                blocked = f".{attr}()"
+            elif attr == "get" and not call.args:
+                # zero-arg .get() is the queue signature; dict.get(key)
+                # always has arguments
+                blocked = ".get()"
+        if blocked:
+            findings.append(Finding(
+                self.id, module.rel, call.lineno, call.col_offset,
+                f"blocking call {blocked} while holding a lock in "
+                f"'{fn.name}'"))

@@ -1,0 +1,99 @@
+"""FC04 — exception hygiene in supervised threads, sinks, transports.
+
+A degraded path that swallows its trigger is invisible: the stream
+keeps flowing, the operator sees nothing, and the next symptom is data
+loss.  The robustness layer's contract (README "Robustness and
+degradation") is that every degradation is *observable* — it counts a
+metric, logs to stderr, or re-raises into the supervisor.
+
+Flagged, within the supervised/sink/transport scope (``outputs/``,
+``inputs/``, ``utils/``, ``supervise.py``, ``pipeline.py``,
+``tpu/breaker.py``):
+
+- bare ``except:`` — always (it eats ``KeyboardInterrupt``/
+  ``SystemExit``; catch ``Exception`` and let the supervisor see the
+  rest);
+- ``except BaseException`` without an unconditional re-raise;
+- *silent* handlers: a body that is only ``pass``/``continue``/
+  ``return``/constant assignments, with no call (metric, log, recovery)
+  and no ``raise``.
+
+Deliberate swallows (closing an fd that already failed, best-effort
+teardown) stay allowed via an inline suppression **with a reason**::
+
+    except OSError:  # flowcheck: disable=FC04 -- fd already dead; close is best-effort
+        pass
+
+Parse-layer code (decoders/encoders/materializers) is out of scope:
+its ``except DecodeError: return error-value`` shape is the per-line
+error contract, not a swallow.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from ..core import Finding, Module, Project, Rule, register
+
+_SCOPE_DIRS = {"outputs", "inputs", "utils"}
+_SCOPE_FILES = {"supervise.py", "pipeline.py", "breaker.py"}
+
+
+def _has_unconditional_raise(body: List[ast.stmt]) -> bool:
+    for stmt in body:
+        if isinstance(stmt, ast.Raise):
+            return True
+    return False
+
+
+def _is_silent(body: List[ast.stmt]) -> bool:
+    """True when the handler body cannot possibly observe the error:
+    no call, no raise — only pass/continue/break/return/assignments of
+    call-free expressions."""
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if isinstance(node, (ast.Raise, ast.Call)):
+                return False
+    return True
+
+
+@register
+class ExceptionHygiene(Rule):
+    id = "FC04"
+    title = "exception hygiene (no swallowed errors in supervised code)"
+
+    def scope(self, rel: str) -> bool:
+        parts = rel.split("/")
+        if parts[-1] in _SCOPE_FILES:
+            return True
+        return any(p in _SCOPE_DIRS for p in parts[:-1])
+
+    def check(self, module: Module, project: Project) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if node.type is None:
+                findings.append(Finding(
+                    self.id, module.rel, node.lineno, node.col_offset,
+                    "bare 'except:' swallows KeyboardInterrupt/SystemExit; "
+                    "catch Exception (or narrower) instead"))
+                continue
+            caught = [n.id for n in ast.walk(node.type)
+                      if isinstance(n, ast.Name)]
+            if ("BaseException" in caught
+                    and not _has_unconditional_raise(node.body)):
+                findings.append(Finding(
+                    self.id, module.rel, node.lineno, node.col_offset,
+                    "'except BaseException' without re-raise hides "
+                    "interpreter shutdown; re-raise or catch Exception"))
+                continue
+            if _is_silent(node.body):
+                exc = ast.unparse(node.type)
+                findings.append(Finding(
+                    self.id, module.rel, node.lineno, node.col_offset,
+                    f"silent 'except {exc}' — degraded paths must count "
+                    f"a metric, log, or re-raise (suppress with a reason "
+                    f"if deliberate)"))
+        return findings

@@ -1,0 +1,119 @@
+"""FC05 — config-key drift between ``lint.py`` and the code.
+
+``--check`` validates user configs against a known-key namespace; that
+namespace is only worth anything if it matches the keys the code
+actually reads.  This rule derives the read-namespace from every
+``config.lookup*`` call site (``analysis.configkeys``) and checks it
+against the declaration module (any scanned ``lint.py``):
+
+- a **literal** ``KNOWN_KEYS`` set (the pre-reconcile shape) is diffed
+  both ways: keys read but undeclared, and keys declared but never
+  read, are findings;
+- a literal ``DECLARED_ONLY`` set (the post-reconcile escape hatch for
+  keys read through paths the AST cannot see) must not contain keys
+  that ARE derivable — a redundant entry is drift waiting to happen;
+- every lookup whose key path is not a string literal must sit inside
+  a registered forwarder (``configkeys.FORWARDERS``); anything else
+  makes the namespace underivable and is flagged at the call site.
+
+``lint.py`` importing ``derived_namespace()`` (instead of hand-writing
+the set) is what makes the drift structurally impossible; this rule is
+the CI tripwire for the parts that stay hand-written.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Set
+
+from ..configkeys import DerivedNamespace, namespace_from_sources
+from ..core import Finding, Module, Project, Rule, register
+
+
+def _literal_str_set(tree: ast.Module, name: str) -> Optional[Set[str]]:
+    """The literal string elements of ``NAME = {...}`` / frozenset({...})
+    at module level, or None when no such assignment exists."""
+    for node in tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        if not any(isinstance(t, ast.Name) and t.id == name
+                   for t in node.targets):
+            continue
+        value = node.value
+        if (isinstance(value, ast.Call)
+                and getattr(value.func, "id", None) == "frozenset"):
+            value = value.args[0] if value.args else ast.Set(elts=[])
+        if isinstance(value, (ast.Set, ast.Tuple, ast.List)):
+            out = set()
+            for el in value.elts:
+                if isinstance(el, ast.Constant) and isinstance(el.value, str):
+                    out.add(el.value)
+            return out
+        # computed (e.g. derived_namespace() union) — not a literal set,
+        # so there is nothing to diff against
+        return None
+    return None
+
+
+@register
+class ConfigKeyDrift(Rule):
+    id = "FC05"
+    title = "config-key drift (lint.py namespace vs lookup call sites)"
+
+    def check_project(self, project: Project) -> Iterable[Finding]:
+        lint_mod = None
+        for module in project.modules:
+            if module.rel.rsplit("/", 1)[-1] == "lint.py":
+                lint_mod = module
+                break
+        sources = [(m.rel, m.tree) for m in project.modules
+                   if "analysis" not in m.rel.split("/")]
+        ns = namespace_from_sources(sources)
+        findings: List[Finding] = []
+        findings.extend(self._dynamic_site_findings(ns))
+        if lint_mod is not None:
+            findings.extend(self._lint_findings(lint_mod, ns))
+        return findings
+
+    def _dynamic_site_findings(self, ns: DerivedNamespace) -> List[Finding]:
+        out = []
+        for rel, line, fname in ns.dynamic_sites:
+            out.append(Finding(
+                self.id, rel, line, 0,
+                f"config lookup with a non-literal key path in "
+                f"'{fname}' — use a literal, or register the helper in "
+                f"analysis.configkeys.FORWARDERS so the namespace stays "
+                f"derivable"))
+        return out
+
+    def _lint_findings(self, lint_mod: Module,
+                       ns: DerivedNamespace) -> List[Finding]:
+        findings: List[Finding] = []
+        known = _literal_str_set(lint_mod.tree, "KNOWN_KEYS")
+        free = _literal_str_set(lint_mod.tree, "FREE_TABLES") or set()
+        declared_only = _literal_str_set(lint_mod.tree, "DECLARED_ONLY")
+        if known is not None:
+            # pre-reconcile shape: hand-maintained set, diff both ways
+            for key in sorted(ns.keys - known):
+                rel, line = ns.read_sites.get(key, (lint_mod.rel, 1))
+                findings.append(Finding(
+                    self.id, rel, line, 0,
+                    f"config key '{key}' is read here but not declared "
+                    f"in lint.py KNOWN_KEYS"))
+            for key in sorted(known - ns.keys):
+                findings.append(Finding(
+                    self.id, lint_mod.rel, 1, 0,
+                    f"config key '{key}' is declared in KNOWN_KEYS but "
+                    f"never read by any lookup site (dead key?)"))
+            for table in sorted(ns.free_tables - free - known):
+                findings.append(Finding(
+                    self.id, lint_mod.rel, 1, 0,
+                    f"free-form table '{table}' is read via lookup_table "
+                    f"but not declared in FREE_TABLES"))
+        if declared_only:
+            for key in sorted(declared_only & ns.keys):
+                findings.append(Finding(
+                    self.id, lint_mod.rel, 1, 0,
+                    f"DECLARED_ONLY entry '{key}' is derivable from the "
+                    f"lookup sites — remove the redundant declaration"))
+        return findings

@@ -58,7 +58,7 @@ class FileWorker:
                     self.path,
                     _ino.IN_MODIFY | _ino.IN_DELETE_SELF | _ino.IN_MOVE_SELF
                     | _ino.IN_ATTRIB | _ino.IN_CLOSE_WRITE)
-            except OSError:
+            except OSError:  # flowcheck: disable=FC04 -- no inotify watch: the poll loop below still tails the file
                 watcher = None
         try:
             while not self.stop.is_set():
@@ -69,8 +69,8 @@ class FileWorker:
                 # drained: check for truncation/deletion
                 try:
                     size = os.path.getsize(self.path)
-                except OSError:
-                    return  # file removed
+                except OSError:  # flowcheck: disable=FC04 -- file removed (logrotate); reap() starts a fresh worker
+                    return
                 if size < fd.tell():
                     fd.seek(0, os.SEEK_END)
                 if hasattr(self.handler, "flush"):
@@ -170,7 +170,7 @@ class FileInput(Input):
                         try:
                             wd = ino.add_watch(d, dir_mask)
                             watched[wd] = d
-                        except OSError:
+                        except OSError:  # flowcheck: disable=FC04 -- directory vanished mid-walk; the next event rescans
                             pass
 
         def rescan_files():

@@ -58,7 +58,7 @@ class RedisWorker:
             try:
                 if self.cnx.rpoplpush(tmp_key, queue_key) is None:
                     break
-            except RespError:
+            except RespError:  # flowcheck: disable=FC04 -- recovery drain only; the main BRPOPLPUSH loop raises on real errors
                 break
         while True:
             try:

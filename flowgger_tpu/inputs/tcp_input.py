@@ -71,7 +71,12 @@ class TcpInput(Input):
         while True:
             try:
                 client, peer = listener.accept()
-            except OSError:
+            except OSError as e:
+                # closed listener on shutdown — but also EMFILE and
+                # friends, which must not look like a clean EOF
+                import sys
+
+                print(f"TCP accept loop exiting: {e}", file=sys.stderr)
                 return
             client.settimeout(self.timeout)
             print(f"Connection over TCP from [{peer[0]}:{peer[1]}]")
@@ -85,7 +90,7 @@ class TcpInput(Input):
         finally:
             try:
                 client.close()
-            except OSError:
+            except OSError:  # flowcheck: disable=FC04 -- fd already dead; close is best-effort
                 pass
 
 

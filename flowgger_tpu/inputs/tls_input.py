@@ -115,7 +115,10 @@ class TlsInput(Input):
         while True:
             try:
                 client, peer = listener.accept()
-            except OSError:
+            except OSError as e:
+                # closed listener on shutdown — but also EMFILE and
+                # friends, which must not look like a clean EOF
+                print(f"TLS accept loop exiting: {e}", file=sys.stderr)
                 return
             client.settimeout(self.timeout)
             print(f"Connection over TLS from [{peer[0]}:{peer[1]}]")
@@ -129,7 +132,7 @@ class TlsInput(Input):
             print(f"TLS handshake failed: {e}", file=sys.stderr)
             try:
                 client.close()
-            except OSError:
+            except OSError:  # flowcheck: disable=FC04 -- handshake already logged; close is best-effort
                 pass
             return
         splitter = get_splitter(self.framing)
@@ -138,7 +141,7 @@ class TlsInput(Input):
         finally:
             try:
                 tls_sock.close()
-            except OSError:
+            except OSError:  # flowcheck: disable=FC04 -- fd already dead; close is best-effort
                 pass
 
 

@@ -128,6 +128,7 @@ class UdpInput(Input):
         while True:
             try:
                 got = rx.recv_batch()
+            # flowcheck: disable=FC04 -- availability probe: False falls back to the recvfrom loop
             except OSError as e:
                 if not delivered and e.errno in (
                         errno.EINVAL, errno.ENOSYS, errno.EOPNOTSUPP):

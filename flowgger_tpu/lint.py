@@ -7,6 +7,17 @@ flag is the cheap insurance layer on top: it walks every leaf key in
 the file, flags anything outside the known namespace, and suggests the
 nearest known key.  Table-valued free-form namespaces
 (ltsv_schema/ltsv_suffixes/*_extra) accept arbitrary sub-keys.
+
+The namespace is **derived from the code**, not hand-maintained: the
+``analysis.configkeys`` AST pass collects every literal
+``config.lookup*`` path in the package (plus forwarder expansions like
+the ``*_retry_*`` families), so a key is "known" exactly when some
+code path reads it.  The previous hand-written set had drifted four
+keys deep — ``metrics.jsonl`` (a config *value* mistaken for a key),
+``input.tls_threads``, and the output-side TLS
+``compatibility_level``/``compression`` pair, none of which any code
+read — and flowcheck FC05 now fails CI if the derivation ever stops
+covering a read or a ``DECLARED_ONLY`` entry goes stale.
 """
 
 from __future__ import annotations
@@ -14,59 +25,23 @@ from __future__ import annotations
 import difflib
 from typing import List
 
+from .analysis.configkeys import derived_namespace
 from .config import Config
 
-KNOWN_KEYS = {
-    # [input] — mod.rs:101-109 + per-input config_parse sites
-    "input.type", "input.format", "input.framing", "input.framed",
-    "input.listen", "input.timeout", "input.queuesize", "input.src",
-    "input.tcp_threads", "input.tls_threads",
-    "input.tls_cert", "input.tls_key", "input.tls_ciphers",
-    "input.tls_compatibility_level", "input.tls_compression",
-    "input.tls_verify_peer", "input.tls_ca_file",
-    "input.redis_connect", "input.redis_queue_key", "input.redis_threads",
-    # TPU extensions
-    "input.tpu_batch_size", "input.tpu_flush_ms", "input.tpu_max_line_len",
-    "input.tpu_coordinator", "input.tpu_num_processes",
-    "input.tpu_process_id", "input.tpu_mesh", "input.tpu_sp",
-    # robustness layer
-    "input.queue_policy",
-    "input.tpu_breaker", "input.tpu_breaker_failures",
-    "input.tpu_breaker_cooldown_ms", "input.tpu_breaker_window",
-    "input.tpu_breaker_fallback_ratio",
-    "input.redis_retry_init", "input.redis_retry_max",
-    "input.redis_retry_attempts",
-    # [output] — per-output config sites
-    "output.type", "output.format", "output.framing", "output.connect",
-    "output.timeout", "output.file_path", "output.file_buffer_size",
-    "output.file_rotation_size", "output.file_rotation_time",
-    "output.file_rotation_maxfiles", "output.file_rotation_timeformat",
-    "output.kafka_brokers", "output.kafka_topic", "output.kafka_acks",
-    "output.kafka_timeout", "output.kafka_threads", "output.kafka_coalesce",
-    "output.kafka_compression",
-    "output.tls_cert", "output.tls_key", "output.tls_ciphers",
-    "output.tls_compatibility_level", "output.tls_compression",
-    "output.tls_verify_peer", "output.tls_ca_file", "output.tls_threads",
-    "output.tls_async", "output.tls_recovery_delay_init",
-    "output.tls_recovery_delay_max", "output.tls_recovery_probe_time",
-    "output.syslog_prepend_timestamp",
-    "output.kafka_retry_init", "output.kafka_retry_max",
-    "output.kafka_retry_attempts",
-    # [metrics] — observability extension
-    "metrics.interval", "metrics.path", "metrics.jsonl",
-    "metrics.jax_profile_dir",
-    # [supervisor] — thread crash/restart policy
-    "supervisor.max_restarts", "supervisor.backoff_init",
-    "supervisor.backoff_max",
-}
+# Keys that are legitimately configurable but read through paths the
+# AST derivation cannot see.  Empty by design — add a key here ONLY if
+# a new dynamic lookup pattern cannot be expressed as a
+# configkeys.FORWARDERS entry, and leave a comment saying where it is
+# read.  flowcheck FC05 flags entries that are in fact derivable.
+DECLARED_ONLY = frozenset()
 
-# tables whose sub-keys are user-defined
-FREE_TABLES = {
-    "input.ltsv_schema", "input.ltsv_suffixes",
-    "output.gelf_extra", "output.ltsv_extra", "output.capnp_extra",
-    # fault-injection sites (validated by utils.faultinject at boot)
-    "faults",
-}
+_NAMESPACE = derived_namespace()
+
+KNOWN_KEYS = frozenset(_NAMESPACE.keys) | DECLARED_ONLY
+
+# tables whose sub-keys are user-defined (every lookup_table site:
+# ltsv_schema/ltsv_suffixes, the *_extra tables, and [faults])
+FREE_TABLES = frozenset(_NAMESPACE.free_tables)
 
 
 def _walk(table, prefix: str, out: List[str]):
@@ -95,8 +70,22 @@ def lint_config(config: Config) -> List[str]:
 
 
 def check_file(config_file: str) -> int:
-    """CLI ``--check`` entry: parse + lint; returns the exit code."""
-    config = Config.from_path(config_file)
+    """CLI ``--check`` entry: parse + lint.
+
+    Exit-code contract (tested by tests/test_lint.py): 0 = clean,
+    1 = unknown keys, 2 = the file is unreadable or not valid TOML —
+    scripts gating a deploy on ``--check`` can tell "typo in a key"
+    from "config missing entirely".
+    """
+    import sys
+
+    from .config import ConfigError
+
+    try:
+        config = Config.from_path(config_file)
+    except (OSError, ConfigError) as e:
+        print(f"error: {config_file}: {e}", file=sys.stderr)
+        return 2
     warnings = lint_config(config)
     for w in warnings:
         print(f"warning: {w}")

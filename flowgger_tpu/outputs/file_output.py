@@ -154,7 +154,7 @@ class FileOutput(Output):
                     try:
                         if hasattr(wbox[0], "close"):
                             wbox[0].close()
-                    except OSError:
+                    except OSError:  # flowcheck: disable=FC04 -- fd already failed; the write error re-raises below
                         pass
                     wbox[0] = None
                     raise

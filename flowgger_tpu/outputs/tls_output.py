@@ -197,7 +197,7 @@ class TlsOutput(Output):
         finally:
             try:
                 tls.close()
-            except OSError:
+            except OSError:  # flowcheck: disable=FC04 -- fd already dead; close is best-effort
                 pass
 
     def _worker(self, arx, merger):

@@ -272,7 +272,18 @@ class Pipeline:
             try:
                 handler.flush()
             except Exception:  # noqa: BLE001 - best-effort during shutdown
-                pass
+                # the batch is lost either way, but losing it silently
+                # would make a truncated output file look like an input
+                # problem: say so and count it
+                import sys
+                import traceback
+
+                from .utils.metrics import registry as _metrics
+
+                _metrics.inc("drain_flush_errors")
+                print("drain: final flush failed, batch lost:",
+                      file=sys.stderr)
+                traceback.print_exc()
         from .outputs import SHUTDOWN
 
         for _ in threads:

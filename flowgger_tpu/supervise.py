@@ -83,6 +83,7 @@ class Supervisor:
                 return
             except SystemExit:
                 raise
+            # flowcheck: disable=FC04 -- supervision boundary: the crash is counted, logged, and restarted (SystemExit re-raised above)
             except BaseException:  # noqa: BLE001 - supervision boundary
                 _metrics.inc("thread_crashes")
                 print(f"supervised thread [{name}] crashed:",

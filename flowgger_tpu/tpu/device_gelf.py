@@ -35,7 +35,14 @@ formatted host-side (native threaded formatter) and uploaded as a
 else rides the decode call's device-resident channels.
 """
 
+
 from __future__ import annotations
+
+# byte-identity contract (flowcheck FC03): the scalar counterpart
+# this route must stay byte-identical to, and the differential
+# test that enforces it
+SCALAR_ORACLE = "flowgger_tpu.encoders.gelf:GelfEncoder"
+DIFF_TEST = "tests/test_device_gelf.py::test_device_matches_scalar_and_engages"
 
 import os
 from functools import partial

@@ -38,7 +38,14 @@ Reference parity: gelf_decoder.rs:34-125 (decode semantics),
 gelf_encoder.rs:51-116 (sorted-key canonical emit).
 """
 
+
 from __future__ import annotations
+
+# byte-identity contract (flowcheck FC03): the scalar counterpart
+# this route must stay byte-identical to, and the differential
+# test that enforces it
+SCALAR_ORACLE = "flowgger_tpu.encoders.gelf:GelfEncoder"
+DIFF_TEST = "tests/test_device_gelf_gelf.py::test_device_gelf_gelf_matches_scalar_and_engages"
 
 from functools import partial
 

@@ -24,7 +24,14 @@ fallback of the rfc5424 device sorter, no typed ``ltsv_schema`` (gated
 at the route), ASCII rows within the JSON-escape budget.
 """
 
+
 from __future__ import annotations
+
+# byte-identity contract (flowcheck FC03): the scalar counterpart
+# this route must stay byte-identical to, and the differential
+# test that enforces it
+SCALAR_ORACLE = "flowgger_tpu.encoders.gelf:GelfEncoder"
+DIFF_TEST = "tests/test_device_ltsv.py::test_device_ltsv_matches_scalar_and_engages"
 
 from functools import partial
 

@@ -15,7 +15,14 @@ the host tier (encode_rfc3164_gelf_block.py), whose byte constants this
 kernel shares so fallback splices can never diverge.
 """
 
+
 from __future__ import annotations
+
+# byte-identity contract (flowcheck FC03): the scalar counterpart
+# this route must stay byte-identical to, and the differential
+# test that enforces it
+SCALAR_ORACLE = "flowgger_tpu.encoders.gelf:GelfEncoder"
+DIFF_TEST = "tests/test_device_rfc3164.py::test_device_3164_matches_scalar_and_engages"
 
 from functools import partial
 

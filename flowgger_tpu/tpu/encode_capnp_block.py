@@ -37,7 +37,14 @@ tests/test_encode_capnp_block.py):
   per-row ``float(span)`` for the rare 17+-digit stamp.
 """
 
+
 from __future__ import annotations
+
+# byte-identity contract (flowcheck FC03): the scalar counterpart
+# this route must stay byte-identical to, and the differential
+# test that enforces it
+SCALAR_ORACLE = "flowgger_tpu.encoders.capnp:CapnpEncoder"
+DIFF_TEST = "tests/test_encode_capnp_block.py::test_capnp_block_matches_scalar"
 
 from typing import Dict, List, Optional, Tuple
 

@@ -27,7 +27,14 @@ the tail constant and syslen's length prefix is rendered inline; the
 result is an EncodedBlock the sinks write wholesale.
 """
 
+
 from __future__ import annotations
+
+# byte-identity contract (flowcheck FC03): the scalar counterpart
+# this route must stay byte-identical to, and the differential
+# test that enforces it
+SCALAR_ORACLE = "flowgger_tpu.encoders.gelf:GelfEncoder"
+DIFF_TEST = "tests/test_encode_gelf_block.py::test_block_matches_scalar_corpus"
 
 from typing import Dict, Optional
 

@@ -14,7 +14,14 @@ framing) fall back.  SD names containing ``:`` (the only key escape)
 are screened per-span.
 """
 
+
 from __future__ import annotations
+
+# byte-identity contract (flowcheck FC03): the scalar counterpart
+# this route must stay byte-identical to, and the differential
+# test that enforces it
+SCALAR_ORACLE = "flowgger_tpu.encoders.ltsv:LTSVEncoder"
+DIFF_TEST = "tests/test_encode_ltsv_routes.py::test_ltsv_ltsv_block"
 
 from typing import Dict, Optional
 

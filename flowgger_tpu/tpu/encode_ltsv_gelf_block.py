@@ -18,7 +18,14 @@ value" notice), and non-ASCII bytes re-run the scalar oracle, keeping
 bytes identical to decoder→GelfEncoder.
 """
 
+
 from __future__ import annotations
+
+# byte-identity contract (flowcheck FC03): the scalar counterpart
+# this route must stay byte-identical to, and the differential
+# test that enforces it
+SCALAR_ORACLE = "flowgger_tpu.encoders.gelf:GelfEncoder"
+DIFF_TEST = "tests/test_encode_gelf_block.py::test_ltsv_gelf_block_route_matches_scalar"
 
 from typing import Dict, Optional
 

@@ -8,7 +8,14 @@ Per row: [syslen prefix digits +] ``chunk[full_start : trim_end]``
 non-ASCII) take the scalar oracle via block_common.finish_block.
 """
 
+
 from __future__ import annotations
+
+# byte-identity contract (flowcheck FC03): the scalar counterpart
+# this route must stay byte-identical to, and the differential
+# test that enforces it
+SCALAR_ORACLE = "flowgger_tpu.encoders.passthrough:PassthroughEncoder"
+DIFF_TEST = "tests/test_encode_gelf_block.py::test_passthrough_block_matches_scalar"
 
 from typing import Dict, Optional
 

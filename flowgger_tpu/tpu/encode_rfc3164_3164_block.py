@@ -16,7 +16,14 @@ text, which is inherently per-call — those configs keep the Record
 path.  Rows outside the tier re-run the scalar oracle, byte-identical
 in every case."""
 
+
 from __future__ import annotations
+
+# byte-identity contract (flowcheck FC03): the scalar counterpart
+# this route must stay byte-identical to, and the differential
+# test that enforces it
+SCALAR_ORACLE = "flowgger_tpu.encoders.rfc3164:RFC3164Encoder"
+DIFF_TEST = "tests/test_device_rfc3164.py::test_3164_self_encode_block_matches_scalar"
 
 from typing import Dict, Optional
 

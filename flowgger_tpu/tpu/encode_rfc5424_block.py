@@ -11,7 +11,14 @@ via ``pair_sd`` attribution.  Rows outside the tier take the scalar
 oracle through block_common.finish_block.
 """
 
+
 from __future__ import annotations
+
+# byte-identity contract (flowcheck FC03): the scalar counterpart
+# this route must stay byte-identical to, and the differential
+# test that enforces it
+SCALAR_ORACLE = "flowgger_tpu.encoders.rfc5424:RFC5424Encoder"
+DIFF_TEST = "tests/test_encode_gelf_block.py::test_rfc5424_block_route_matches_scalar"
 
 from typing import Dict, Optional
 

@@ -48,7 +48,7 @@ def _get_libc():
                                     use_errno=True)
                 _libc.inotify_init1
                 _libc.inotify_add_watch
-            except (OSError, AttributeError):
+            except (OSError, AttributeError):  # flowcheck: disable=FC04 -- availability probe; caller falls back to polling
                 _libc = False
         return _libc
 
@@ -96,13 +96,13 @@ class Inotify:
             return []
         try:
             r, _, _ = select.select([self.fd], [], [], timeout_s)
-        except (OSError, ValueError):
+        except (OSError, ValueError):  # flowcheck: disable=FC04 -- fd closed mid-select; caller treats [] as quiet
             return []
         if not r:
             return []
         try:
             buf = os.read(self.fd, 65536)
-        except OSError:
+        except OSError:  # flowcheck: disable=FC04 -- watch fd gone; caller treats [] as quiet
             return []
         events = []
         pos = 0
@@ -120,5 +120,5 @@ class Inotify:
             self._closed = True
             try:
                 os.close(self.fd)
-            except OSError:
+            except OSError:  # flowcheck: disable=FC04 -- fd already dead; close is best-effort
                 pass

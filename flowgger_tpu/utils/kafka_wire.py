@@ -240,13 +240,13 @@ class KafkaProducer:
             self._conns.pop(addr, None)
             try:
                 sock.close()
-            except OSError:
+            except OSError:  # flowcheck: disable=FC04 -- fd already dead; close is best-effort
                 pass
             return (0, 0), False
         finally:
             try:
                 sock.settimeout(old_timeout)
-            except OSError:
+            except OSError:  # flowcheck: disable=FC04 -- socket died during negotiation; the caller reconnects
                 pass
         rd = _Reader(data)
         rd.i32()  # correlation
@@ -290,7 +290,7 @@ class KafkaProducer:
             self._conns.pop(addr, None)
             try:
                 sock.close()
-            except OSError:
+            except OSError:  # flowcheck: disable=FC04 -- fd already dead; close is best-effort
                 pass
             raise KafkaError(str(e))
         rd = _Reader(data)
@@ -420,6 +420,6 @@ class KafkaProducer:
         for sock in self._conns.values():
             try:
                 sock.close()
-            except OSError:
+            except OSError:  # flowcheck: disable=FC04 -- fd already dead; close is best-effort
                 pass
         self._conns.clear()

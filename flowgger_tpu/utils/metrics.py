@@ -30,7 +30,8 @@ _COUNTERS = (
     "invalid_utf8", "enqueued", "output_written", "output_errors",
     "batches", "batch_lines", "fallback_rows",
     # robustness / supervision layer
-    "queue_dropped", "drain_stragglers", "sink_reconnects", "sink_failovers",
+    "queue_dropped", "drain_stragglers", "drain_flush_errors",
+    "sink_reconnects", "sink_failovers",
     "thread_crashes", "thread_restarts", "input_reconnects",
     "device_decode_errors", "breaker_trips", "breaker_recoveries",
 )
@@ -217,6 +218,6 @@ def stop_jax_profiler() -> None:
         import jax
 
         jax.profiler.stop_trace()
-    except Exception:  # noqa: BLE001
+    except Exception:  # noqa: BLE001  # flowcheck: disable=FC04 -- shutdown best-effort; profiling must never block drain
         pass
     _profiling = False

@@ -53,7 +53,7 @@ def _get_libc():
                               use_errno=True)
             lib.recvmmsg
             _libc = lib
-        except (OSError, AttributeError):
+        except (OSError, AttributeError):  # flowcheck: disable=FC04 -- availability probe; caller falls back to recvfrom
             _libc = False
     return _libc
 

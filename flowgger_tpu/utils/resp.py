@@ -30,7 +30,7 @@ class RespClient:
     def close(self):
         try:
             self.sock.close()
-        except OSError:
+        except OSError:  # flowcheck: disable=FC04 -- fd already dead; close is best-effort
             pass
 
     # -- wire --------------------------------------------------------------

@@ -88,7 +88,7 @@ class RotatingFile:
             src = self._build_file_path(file_num - 1)
             try:
                 os.rename(src, dest)
-            except OSError:
+            except OSError:  # flowcheck: disable=FC04 -- gaps in the rotation chain are expected (missing older files)
                 pass
             dest = src
         self.open()

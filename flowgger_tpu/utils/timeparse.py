@@ -182,7 +182,7 @@ def _tz_offset_nanos(tzname: str, year: int, month: int, day: int,
         import datetime as _dt
 
         tz = ZoneInfo(tzname)
-    except Exception:
+    except Exception:  # flowcheck: disable=FC04 -- parse contract: None means "no zoneinfo"; caller logs once
         return None
     local = _dt.datetime(year, month, day, hour, minute, sec, tzinfo=tz)
     off = local.utcoffset()

@@ -1,0 +1,23 @@
+"""FC01 fixture: trace-safe kernel — static branches, shape branches,
+device-side selects, and host impurities only OUTSIDE the jit closure."""
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnames=("flag",))
+def kernel(x, flag):
+    if flag:                    # static arg: fine
+        x = x + 1
+    if x.shape[0] > 4:          # shape access: static, fine
+        x = x * 2
+    if x is None:               # identity check: fine
+        return x
+    return jnp.where(x > 0, x, 0)
+
+
+def host_path(x):
+    print("host side", time.time())   # not reachable from the jit root
+    return x

@@ -1,0 +1,11 @@
+"""FC01 fixture: a violation silenced by an inline suppression."""
+import functools
+import time
+
+import jax
+
+
+@functools.partial(jax.jit, static_argnames=())
+def kernel(x):
+    t = time.time()  # flowcheck: disable=FC01 -- fixture: deliberate trace-time clock
+    return x + t

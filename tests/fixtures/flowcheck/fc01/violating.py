@@ -1,0 +1,20 @@
+"""FC01 fixture: every impurity class inside a jit-reachable function."""
+import functools
+import random
+import time
+
+import jax
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def kernel(x, n):
+    if x:                       # line 11: traced branch
+        pass
+    t = time.time()             # line 13: wall clock
+    r = random.random()         # line 14: host RNG
+    print("tracing", t, r)      # line 15: I/O
+    return helper(x)
+
+
+def helper(x):
+    return x.item()             # line 20: host sync, reachable from kernel

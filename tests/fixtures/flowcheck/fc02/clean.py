@@ -1,0 +1,17 @@
+"""FC02 fixture: counter guarded, blocking call outside the lock."""
+import threading
+import time
+
+
+class Worker:
+    def __init__(self):
+        self.count = 0
+        self._lock = threading.Lock()
+
+    def start(self):
+        threading.Thread(target=self.run, daemon=True).start()
+
+    def run(self):
+        with self._lock:
+            self.count += 1
+        time.sleep(1)

@@ -1,0 +1,17 @@
+"""FC02 fixture: unguarded counter + blocking call under a lock."""
+import threading
+import time
+
+
+class Worker:
+    def __init__(self):
+        self.count = 0
+        self._lock = threading.Lock()
+
+    def start(self):
+        threading.Thread(target=self.run, daemon=True).start()
+
+    def run(self):
+        self.count += 1          # line 15: unguarded read-modify-write
+        with self._lock:
+            time.sleep(1)        # line 17: blocking while holding a lock

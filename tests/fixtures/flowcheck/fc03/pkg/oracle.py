@@ -1,0 +1,6 @@
+"""FC03 fixture: the scalar oracle counterpart."""
+
+
+class Demo:
+    def encode(self, record):
+        return record

@@ -1,0 +1,5 @@
+"""FC03 fixture: the differential test the registrations point at."""
+
+
+def test_demo_matches_scalar():
+    pass

@@ -1,0 +1,8 @@
+"""FC03 fixture: registrations that do not resolve."""
+
+SCALAR_ORACLE = "pkg.missing:Nope"
+DIFF_TEST = "tests/test_device_demo.py::test_not_there"
+
+
+def fetch_encode(handle):
+    return handle

@@ -1,0 +1,5 @@
+"""FC03 fixture: a device route with NO contract registration."""
+
+
+def fetch_encode(handle):
+    return handle

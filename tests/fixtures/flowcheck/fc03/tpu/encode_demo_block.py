@@ -1,0 +1,8 @@
+"""FC03 fixture: a fully registered block-encode route (clean)."""
+
+SCALAR_ORACLE = "pkg.oracle:Demo"
+DIFF_TEST = "tests/test_device_demo.py::test_demo_matches_scalar"
+
+
+def encode_demo_block(rows):
+    return rows

@@ -1,0 +1,15 @@
+"""FC04 fixture: handlers that observe their errors."""
+import sys
+
+
+def sink_loop(items, metrics):
+    for item in items:
+        try:
+            item.write()
+        except OSError as e:
+            metrics.inc("output_errors")
+            print(f"write failed: {e}", file=sys.stderr)
+        try:
+            item.close()
+        except OSError:
+            raise

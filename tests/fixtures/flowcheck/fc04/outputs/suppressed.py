@@ -1,0 +1,9 @@
+"""FC04 fixture: a deliberate swallow with a reasoned suppression."""
+
+
+def sink_loop(items):
+    for item in items:
+        try:
+            item.close()
+        except OSError:  # flowcheck: disable=FC04 -- fixture: fd already dead
+            pass

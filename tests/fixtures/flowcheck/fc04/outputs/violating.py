@@ -1,0 +1,17 @@
+"""FC04 fixture: every swallow class in sink scope."""
+
+
+def sink_loop(items):
+    for item in items:
+        try:
+            item.write()
+        except:                  # line 8: bare except
+            pass
+        try:
+            item.flush()
+        except OSError:          # line 12: silent swallow
+            pass
+        try:
+            item.close()
+        except BaseException:    # line 16: BaseException without re-raise
+            item = None

@@ -1,0 +1,9 @@
+"""FC05 fixture: lookup sites the lint namespace must match."""
+
+
+def build(config, key):
+    kind = config.lookup_str("input.type", "input.type must be a string")
+    fmt = config.lookup("input.format")          # read, undeclared -> finding
+    table = config.lookup_table("faults", "[faults] must be a table")
+    dyn = config.lookup_int(key, "dynamic")      # non-literal -> finding
+    return kind, fmt, table, dyn

@@ -1,0 +1,14 @@
+"""FC05 fixture: a hand-maintained namespace that drifted."""
+
+KNOWN_KEYS = {
+    "input.type",
+    "input.dead_key",        # declared, never read -> finding
+}
+
+FREE_TABLES = {
+    "faults",
+}
+
+DECLARED_ONLY = frozenset({
+    "input.type",            # derivable -> redundant entry finding
+})
