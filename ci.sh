@@ -13,6 +13,10 @@ echo "==            byte-identity contracts, exception hygiene, keys) =="
 # pure-ast, no JAX import: fails on any non-baselined FC01-FC05 finding
 python -m flowgger_tpu.analysis --format text .
 
+echo "== overlap-executor smoke (tiny batch, CPU backend, <60s) =="
+# asserts the in-flight submit/fetch window sustains >= the serial e2e
+JAX_PLATFORMS=cpu timeout 120 python bench.py --smoke
+
 echo "== python test suite (virtual 8-device CPU mesh) =="
 python -m pytest tests/ -q -m "not faults"
 

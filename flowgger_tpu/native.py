@@ -147,10 +147,12 @@ def split_chunk_native(chunk: bytes, strip_cr: bool = True
 
 
 def pack_chunk_native(chunk: bytes, starts: np.ndarray, lens: np.ndarray,
-                      max_len: int, n_rows: int
+                      max_len: int, n_rows: int,
+                      n_threads: Optional[int] = None
                       ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
     """Dense [n_rows, max_len] batch + clipped lens from a contiguous
-    chunk; rows past len(starts) are zeroed."""
+    chunk; rows past len(starts) are zeroed.  ``n_threads`` overrides
+    the library's default memcpy thread count (``input.pack_threads``)."""
     lib = _load()
     if lib is None:
         return None
@@ -165,7 +167,7 @@ def pack_chunk_native(chunk: bytes, starts: np.ndarray, lens: np.ndarray,
             buf.ctypes.data, buf.size,
             starts.ctypes.data, in_lens.ctypes.data, n,
             max_len, batch.ctypes.data, lens_out.ctypes.data,
-            _DEFAULT_THREADS)
+            n_threads or _DEFAULT_THREADS)
     return batch, lens_out
 
 

@@ -246,9 +246,13 @@ class Pipeline:
                     return self._handlers[0]
                 from .tpu.batch import BatchHandler
 
+                # the handler's in-flight fetcher thread spawns through
+                # the supervisor: a crashed fetcher restarts (with
+                # backoff + metrics) instead of wedging the window
                 handler = BatchHandler(
                     self.tx, self.decoder, self.encoder, self.config,
                     fmt=_TPU_FORMATS[self.input_format], merger=self.merger,
+                    supervisor=self.supervisor,
                 )
                 self._handlers.append(handler)
                 return handler
@@ -267,10 +271,16 @@ class Pipeline:
     def _drain(self, threads):
         """Flush pending batches and drain the queue through the sinks —
         the reference loses in-flight queue contents on shutdown
-        (SURVEY.md §5 checkpoint/resume); we flush instead."""
+        (SURVEY.md §5 checkpoint/resume); we flush instead.  For batch
+        handlers ``flush()`` also fences the in-flight submit/fetch
+        window (tpu/overlap.py), so every batch the overlap executor
+        still holds reaches the queue before SHUTDOWN is enqueued."""
         for handler in self._handlers:
             try:
                 handler.flush()
+                close = getattr(handler, "close", None)
+                if close is not None:
+                    close()
             except Exception:  # noqa: BLE001 - best-effort during shutdown
                 # the batch is lost either way, but losing it silently
                 # would make a truncated output file look like an input

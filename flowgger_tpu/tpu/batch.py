@@ -16,7 +16,6 @@ from __future__ import annotations
 
 import sys
 import threading
-from collections import deque
 from typing import List, Optional
 
 import numpy as np
@@ -39,7 +38,7 @@ _NO_MERGER = object()  # sentinel: block mode only when the caller wires a merge
 class BatchHandler(Handler):
     def __init__(self, tx, decoder, encoder, config: Optional[Config] = None,
                  fmt: str = "rfc5424", start_timer: bool = True,
-                 merger=_NO_MERGER):
+                 merger=_NO_MERGER, supervisor=None):
         from . import apply_platform_env
 
         apply_platform_env()
@@ -71,6 +70,20 @@ class BatchHandler(Handler):
         self.max_len = cfg.lookup_int(
             "input.tpu_max_line_len", "input.tpu_max_line_len must be an integer",
             DEFAULT_MAX_LINE_LEN)
+        pack_threads = cfg.lookup_int(
+            "input.pack_threads",
+            "input.pack_threads must be an integer (threads)", None)
+        if pack_threads is not None:
+            if pack_threads < 1:
+                from ..config import ConfigError
+
+                raise ConfigError("input.pack_threads must be >= 1")
+            # only an explicit key touches the (module-wide) pack
+            # setting, so a later default-configured handler can never
+            # silently reset another handler's thread slicing
+            from . import pack as _pack_mod
+
+            _pack_mod.configure_pack_threads(pack_threads)
         self._lines: List[bytes] = []
         self._chunks: List[bytes] = []      # complete-line regions (fast path)
         self._chunk_lines = 0
@@ -81,11 +94,20 @@ class BatchHandler(Handler):
         # serializes batch decodes so a timer flush racing a size flush
         # cannot reorder output
         self._decode_lock = threading.Lock()
-        # block-route double buffering: batch N decodes on-device while
-        # batch N-1's bytes are assembled on host (JAX dispatch is
-        # async; the fetch is the completion barrier).  Drained on
-        # timer/EOF flushes so latency stays bounded by one batch.
-        self._inflight = deque()
+        # overlap executor: the block route submits batches into a
+        # bounded in-flight window whose fetcher thread runs the D2H
+        # fetch + block encode + enqueue behind the ingest thread's
+        # pack/dispatch — device compute, transfer, and host work
+        # overlap instead of summing (tpu/overlap.py).  Every
+        # synchronous-emit path fences the window first so blocks reach
+        # the merger in strict batch order.
+        from .overlap import (InflightWindow, RouteEconomics,
+                              inflight_depth_from_config)
+
+        self._econ = RouteEconomics.from_config(cfg)
+        self._window = InflightWindow(
+            inflight_depth_from_config(cfg), self._pop_emit,
+            name=f"tpu-{fmt}", supervisor=supervisor)
         self._timer: Optional[threading.Timer] = None
         self._start_timer = start_timer
         # per-handler hysteresis for the device-encode route (declines /
@@ -205,13 +227,15 @@ class BatchHandler(Handler):
             self.flush(drain=False)
 
     def handle_record(self, record: Record) -> None:
+        self._window.fence()  # keep queue order vs in-flight batches
         self.scalar.handle_record(record)
 
     def flush(self, drain: bool = True) -> None:
-        """Decode pending input.  ``drain=False`` (size-triggered
-        flushes) leaves the newest block-route batch in flight so its
-        device decode overlaps the next batch's host work; timer and
-        end-of-stream flushes drain everything."""
+        """Decode pending input.  Block-route batches are *submitted*
+        into the in-flight window (the fetcher thread fetches and emits
+        them behind us, in order); ``drain=True`` (timer and
+        end-of-stream flushes) additionally fences the window so every
+        submitted batch has reached the queue before returning."""
         with self._lock:
             lines, self._lines = self._lines, []
             chunks, self._chunks = self._chunks, []
@@ -233,22 +257,20 @@ class BatchHandler(Handler):
                 self._decode_spans(*spans)
             if lines:
                 self._decode_batch(lines)
-            keep = 0 if drain else 1
-            while len(self._inflight) > keep:
-                self._pop_emit()
+            _metrics.add_seconds("dispatch_seconds",
+                                 _time.perf_counter() - t0)
+            if drain:
+                self._window.fence()
             _metrics.inc("batches")
             _metrics.inc("batch_lines", _metrics.get("input_lines") - n0)
             _metrics.batch_seconds.observe(_time.perf_counter() - t0)
-        if self._inflight and self._start_timer:
-            # a batch stays in flight with no new input guaranteed: arm
-            # the flush timer so the latency bound (one batch window)
-            # holds even if the stream pauses at a batch boundary
-            with self._lock:
-                if self._timer is None:
-                    self._timer = threading.Timer(self.flush_ms / 1000.0,
-                                                  self.flush)
-                    self._timer.daemon = True
-                    self._timer.start()
+
+    def close(self) -> None:
+        """Fence and stop the in-flight window's fetcher thread; the
+        handler stays usable (a later submit respawns it).  Called at
+        pipeline drain so long-lived processes don't accumulate idle
+        fetcher threads across handler generations."""
+        self._window.close()
 
     # -- multi-chip mesh ---------------------------------------------------
     def _sharded_for(self, fmt: str):
@@ -316,7 +338,9 @@ class BatchHandler(Handler):
         sep = self.ingest_sep
         if self._kernel_fn is None or not self._device_allowed():
             # no columnar kernel, or the breaker is open: split once in
-            # C speed and run the scalar oracle per line
+            # C speed and run the scalar oracle per line (after fencing
+            # the window so older device batches keep their place)
+            self._window.fence()
             self._scalar_region(region, sep)
             return
         self._guarded_dispatch(pack.pack_region_2d(
@@ -327,6 +351,7 @@ class BatchHandler(Handler):
         from . import pack
 
         if self._kernel_fn is None or not self._device_allowed():
+            self._window.fence()
             for chunk, (starts, lens) in zip(span_chunks, span_sets):
                 for s, ln in zip(starts.tolist(), lens.tolist()):
                     self._scalar_handle(chunk[s:s + ln])
@@ -334,22 +359,28 @@ class BatchHandler(Handler):
         self._guarded_dispatch(pack.pack_spans_2d(span_chunks, span_sets,
                                                   self.max_len))
 
-    def _dispatch_packed(self, packed) -> None:
-        """Route one packed tuple through the right decode/encode tier."""
+    def _dispatch_packed(self, packed, deferred=None) -> None:
+        """Route one packed tuple through the right decode/encode tier.
+        ``deferred`` (single-element list) is set True when the batch
+        was submitted to the in-flight window instead of emitted
+        synchronously."""
         if self._fast_encode:
-            self._emit_fast(packed)
+            self._emit_fast(packed, deferred)
             return
         if self.fmt == "auto":
             from .autodetect import decode_auto_packed
 
+            self._window.fence()
             self._emit(decode_auto_packed(packed, self.max_len,
                                           self._auto_ltsv))
             return
+        self._window.fence()
         self._emit(_decode_packed(self.fmt, packed, self.scalar.decoder))
 
     def _decode_batch(self, lines: List[bytes]) -> None:
         if self._kernel_fn is None or not self._device_allowed():
             # no columnar kernel (or breaker open): scalar per line
+            self._window.fence()
             for raw in lines:
                 self._scalar_handle(raw)
             return
@@ -362,11 +393,13 @@ class BatchHandler(Handler):
                 self._emit_fast(packed)
             else:
                 results = self._kernel_fn(lines)
+                self._window.fence()
                 self._emit(results)
         except Exception as e:  # noqa: BLE001 - device degradation boundary
             if self._breaker is None:
                 raise
             self._device_failed(e)
+            self._window.fence()
             for raw in lines:
                 self._scalar_handle(raw)
             return
@@ -384,26 +417,27 @@ class BatchHandler(Handler):
 
     def _record_sync_success(self) -> None:
         """A device batch completed synchronously (no deferred fetch)."""
-        if self._breaker is not None and not self._inflight:
+        if self._breaker is not None and self._window.pending() == 0:
             self._breaker.record_success()
 
     def _guarded_dispatch(self, packed) -> None:
         """Route one packed tuple to the device tier, degrading to the
         scalar oracle (same bytes, no lines lost) on any device/XLA
         error when the breaker is armed."""
-        depth0 = len(self._inflight)
+        deferred = [False]
         try:
             _faults.maybe_raise("device_decode")
-            self._dispatch_packed(packed)
+            self._dispatch_packed(packed, deferred)
         except Exception as e:  # noqa: BLE001 - device degradation boundary
             if self._breaker is None:
                 raise
-            while len(self._inflight) > depth0:  # drop half-queued work
-                self._inflight.pop()
             self._device_failed(e)
+            # drain the in-flight window before emitting this batch's
+            # scalar re-decode, so mid-window failures keep batch order
+            self._window.fence()
             self._scalar_fallback_packed(packed)
             return
-        if len(self._inflight) == depth0:
+        if not deferred[0]:
             # completed synchronously; deferred batches are judged at
             # fetch time in _pop_emit instead
             self._record_sync_success()
@@ -590,22 +624,26 @@ class BatchHandler(Handler):
             return "output.syslog_prepend_timestamp is set"
         return no_columnar
 
-    def _emit_fast(self, packed) -> None:
+    def _emit_fast(self, packed, deferred=None) -> None:
         """Span→bytes encode for one packed tuple: the columnar block
-        route when engaged, else the per-row fast path (gelf/passthrough
-        only), else the Record path."""
+        route when engaged (submitted into the in-flight window; the
+        fetcher thread fetches and emits behind us), else the per-row
+        fast path (gelf/passthrough only), else the Record path."""
         if self._block_route_ok():
+            if deferred is not None:
+                deferred[0] = True
             if self.fmt == "auto":
                 # the auto merger submits its per-class kernels at fetch
-                # time; defer everything (no cross-batch overlap here)
-                self._inflight.append((None, packed))
+                # time, on the fetcher thread
+                self._window.submit((None, packed))
                 return
-            self._inflight.append((block_submit(
+            self._window.submit((block_submit(
                 self.fmt, packed, self._sharded_for(self.fmt)), packed))
             return
         from ..encoders.gelf import GelfEncoder
         from ..encoders.passthrough import PassthroughEncoder
 
+        self._window.fence()
         if self.fmt == "rfc5424" and type(self.encoder) in (
                 GelfEncoder, PassthroughEncoder):
             self._emit_encoded(
@@ -619,11 +657,17 @@ class BatchHandler(Handler):
             return
         self._emit(_decode_packed(self.fmt, packed, self.scalar.decoder))
 
-    def _pop_emit(self) -> None:
-        handle, packed = self._inflight.popleft()
+    def _pop_emit(self, entry) -> None:
+        """Fetch + encode + enqueue one in-flight entry; runs on the
+        window's fetcher thread, in submit order."""
+        handle, packed = entry
+        import time as _time
+
+        t0 = _time.perf_counter()
+        stats: dict = {}
         try:
             _faults.maybe_raise("device_decode")
-            self._pop_emit_inner(handle, packed)
+            self._pop_emit_inner(handle, packed, stats)
         except Exception as e:  # noqa: BLE001 - device degradation boundary
             if self._breaker is None:
                 raise
@@ -632,8 +676,17 @@ class BatchHandler(Handler):
             return
         if self._breaker is not None:
             self._breaker.record_success()
+        path = stats.get("path")
+        if path is not None:
+            # feed the device-vs-host encode-route economics with this
+            # batch's measured wall share (tpu/overlap.py); wall burned
+            # by a declined device attempt (compile-watchdog waits) is
+            # the device tier's fault, not the host path's — subtract it
+            self._econ.observe(
+                path, int(packed[5]),
+                _time.perf_counter() - t0 - stats.get("declined_s", 0.0))
 
-    def _pop_emit_inner(self, handle, packed) -> None:
+    def _pop_emit_inner(self, handle, packed, stats=None) -> None:
         import time as _time
 
         t0 = _time.perf_counter()
@@ -657,7 +710,10 @@ class BatchHandler(Handler):
         ltsv_dec = self.scalar.decoder if self.fmt == "ltsv" else None
         res, fetch_s, declined_s = block_fetch_encode(
             self.fmt, handle, packed, self.encoder, self._merger,
-            ltsv_dec, self._device_route_state)
+            ltsv_dec, self._device_route_state,
+            allow_device=self._econ.allow_device(), stats=stats)
+        if stats is not None:
+            stats["declined_s"] = declined_s
         if res is None:
             # the route declined after the fact (e.g. an oversized
             # ltsv_schema or a configured suffix): Record path
@@ -767,11 +823,17 @@ def block_submit(fmt, packed, sharded=None):
 
 
 def block_fetch_encode(fmt, handle, packed, encoder, merger,
-                       ltsv_decoder=None, route_state=None):
+                       ltsv_decoder=None, route_state=None,
+                       allow_device=True, stats=None):
     """Block on a submitted kernel and run the format's columnar block
     encoder; returns (BlockResult-or-None, fetch_seconds,
     declined_seconds) — the last is wall time burned by a declined
-    device-encode attempt, so callers can keep stage metrics additive."""
+    device-encode attempt, so callers can keep stage metrics additive.
+
+    ``allow_device=False`` skips the device-encode tier outright (the
+    route economics measured the host block path as cheaper on this
+    backend); ``stats`` (optional dict) gets ``stats["path"]`` set to
+    ``"device"`` or ``"host"`` for whichever tier produced the block."""
     import time as _time
 
     t0 = _time.perf_counter()
@@ -794,10 +856,12 @@ def block_fetch_encode(fmt, handle, packed, encoder, merger,
         )
         from ..encoders.rfc5424 import RFC5424Encoder
 
-        if device_rfc3164.route_ok(encoder, merger):
+        if allow_device and device_rfc3164.route_ok(encoder, merger):
             res, fetch_s = device_rfc3164.fetch_encode(
                 handle, packed, encoder, merger, route_state)
             if res is not None:
+                if stats is not None:
+                    stats["path"] = "device"
                 return res, fetch_s, 0.0
             declined_s = _time.perf_counter() - t0
             _metrics.add_seconds("device_encode_declined_seconds",
@@ -828,11 +892,14 @@ def block_fetch_encode(fmt, handle, packed, encoder, merger,
     elif fmt == "ltsv":
         from . import device_ltsv, encode_ltsv_gelf_block, ltsv
 
-        if device_ltsv.route_ok(encoder, merger, ltsv_decoder):
+        if allow_device and device_ltsv.route_ok(encoder, merger,
+                                                 ltsv_decoder):
             res, fetch_s = device_ltsv.fetch_encode(
                 handle, packed, encoder, merger, route_state,
                 ltsv_decoder)
             if res is not None:
+                if stats is not None:
+                    stats["path"] = "device"
                 return res, fetch_s, 0.0
             declined_s = _time.perf_counter() - t0
             _metrics.add_seconds("device_encode_declined_seconds",
@@ -871,10 +938,12 @@ def block_fetch_encode(fmt, handle, packed, encoder, merger,
         from ..encoders.rfc5424 import RFC5424Encoder
         from . import device_gelf_gelf, encode_gelf_gelf_block, gelf
 
-        if device_gelf_gelf.route_ok(encoder, merger):
+        if allow_device and device_gelf_gelf.route_ok(encoder, merger):
             res, fetch_s = device_gelf_gelf.fetch_encode(
                 handle, packed, encoder, merger, route_state)
             if res is not None:
+                if stats is not None:
+                    stats["path"] = "device"
                 return res, fetch_s, 0.0
             declined_s = _time.perf_counter() - t0
             _metrics.add_seconds("device_encode_declined_seconds",
@@ -909,11 +978,13 @@ def block_fetch_encode(fmt, handle, packed, encoder, merger,
     else:
         from . import device_gelf, rfc5424
 
-        if device_gelf.route_ok(encoder, merger):
+        if allow_device and device_gelf.route_ok(encoder, merger):
             res, fetch_s = device_gelf.fetch_encode(handle, packed,
                                                     encoder, merger,
                                                     route_state)
             if res is not None:
+                if stats is not None:
+                    stats["path"] = "device"
                 return res, fetch_s, 0.0
             # charge the declined attempt to its own metric, not to the
             # host path's fetch or encode share
@@ -924,6 +995,8 @@ def block_fetch_encode(fmt, handle, packed, encoder, merger,
         host_out = rfc5424.decode_rfc5424_fetch(handle)
         t1 = _time.perf_counter()
         res = _encode_block_from_host(host_out, packed, encoder, merger)
+    if stats is not None and res is not None:
+        stats["path"] = "host"
     return res, t1 - t0, declined_s
 
 

@@ -141,7 +141,11 @@ def guarded_compile_call(name: str, fn, *args):
 TS_W = 32          # timestamp text slot width (longest json_f64 ≈ 25)
 E_CAP = 56         # max JSON escapes per row on the device tier
 
-COMPACT_G = 32     # group granularity (bytes) of on-device compaction
+# group granularity (bytes) of on-device compaction: 8 keeps the mean
+# per-row padding at ~G/2 = 4 bytes (it was 16 at the old G=32 — most
+# of the gap between fetched and emitted bytes/row) for ~2 extra barrel
+# stages, each a fused elementwise pass
+COMPACT_G = 8
 # skip compaction when padded size is within this factor of the real
 # output (the extra device passes would not pay for the smaller fetch)
 COMPACT_MIN_SAVING = 1.15
@@ -322,6 +326,45 @@ def _compact_kernel(acc, out_len, tier, *, G: int = COMPACT_G):
     return x.reshape(-1)
 
 
+def splice_elided_rows(body: np.ndarray, row_off: np.ndarray,
+                       ts_lens: np.ndarray, head: bytes, ts_label: bytes,
+                       tail: bytes):
+    """Rebuild full output rows from constant-elided device rows.
+
+    Output compaction 2.0: the head constant, the timestamp-label
+    constant, and the tail constant (+ framing suffix) are identical for
+    every row and at host-computable positions — the head leads, the
+    timestamp text is the row's final ``ts_lens[i]`` bytes, the tail
+    trails — so the kernel skips assembling them and the D2H transfer
+    ships only the variable bytes.  This splice restores the exact
+    host-tier bytes with one segment gather (5 segments/row, native
+    concat when available).  Returns (full body, full row_off)."""
+    from .assemble import concat_segments, exclusive_cumsum
+
+    R = row_off.size - 1
+    lens = np.diff(row_off).astype(np.int64)
+    deco = np.frombuffer(head + ts_label + tail, dtype=np.uint8)
+    src = np.concatenate([np.asarray(body, dtype=np.uint8), deco])
+    B = int(np.asarray(body).size)
+    h, lb, tl = len(head), len(ts_label), len(tail)
+    ts = np.asarray(ts_lens, dtype=np.int64)
+    pre = lens - ts  # variable bytes before the timestamp text
+    seg_src = np.stack([
+        np.full(R, B, dtype=np.int64),
+        row_off[:-1].astype(np.int64),
+        np.full(R, B + h, dtype=np.int64),
+        row_off[:-1].astype(np.int64) + pre,
+        np.full(R, B + h + lb, dtype=np.int64),
+    ], axis=1).ravel()
+    seg_len = np.stack([
+        np.full(R, h, dtype=np.int64), pre,
+        np.full(R, lb, dtype=np.int64), ts,
+        np.full(R, tl, dtype=np.int64),
+    ], axis=1).ravel()
+    out = concat_segments(src, seg_src, seg_len)
+    return out, exclusive_cumsum(lens + h + lb + tl)
+
+
 def ts_text_block(small: Dict[str, np.ndarray], ts_vals_fn=None):
     """Format per-row timestamp digits host-side.  The native threaded
     formatter (fg_format_f64_json: to_chars shortest round-trip,
@@ -487,7 +530,7 @@ def fetch_encode_driver(kernel, out, batch_dev, lens_dev, packed, encoder,
                         scalar_fn, fallback_frac: float,
                         decline_limit: int, cooldown: int,
                         ts_keys=("days", "sod", "off", "nanos"),
-                        ts_vals_fn=None, wide=None):
+                        ts_vals_fn=None, wide=None, elide=None):
     """Shared fetch flow for every device-encode format:
 
     1. phase-1 tier probe (``kernel(..., assemble=False)`` — XLA
@@ -496,9 +539,15 @@ def fetch_encode_driver(kernel, out, batch_dev, lens_dev, packed, encoder,
        assembly or the host timestamp formatting;
     2. decline hysteresis via ``route_state`` (caller-owned dict);
     3. timestamp text upload (native formatter), full kernel;
-    4. on-device row compaction when it saves >15% of the fetch;
-    5. syslen prefixing (host splice over the output-sized body);
-    6. fallback splicing through ``finish_block``.
+    4. on-device row compaction when it saves >15% of the fetch, with
+       row lengths fetched as u16 and the uncompacted fallback trimmed
+       on device to the batch's real row count and max row length;
+    5. constant elision (``elide=(head, ts_label, tail)``): the kernel
+       skipped those row-constant segments, the splice restores them
+       host-side, and the D2H ships only variable bytes — the step that
+       brings fetched bytes/row at or under emitted bytes/row;
+    6. syslen prefixing (host splice over the output-sized body);
+    7. fallback splicing through ``finish_block``.
 
     Returns (BlockResult | None, fetch_seconds); None = caller should
     use the span-fetch host path."""
@@ -633,9 +682,12 @@ def fetch_encode_driver(kernel, out, batch_dev, lens_dev, packed, encoder,
 
     # full-N fetches (tiny): the host must recompute the compaction
     # layout with the exact integer math the device used, including any
-    # dp-padding rows beyond n
+    # dp-padding rows beyond n.  Lengths ride D2H as u16 (they are
+    # bounded by OW) — half the width of the old i32 fetch.
+    N_acc, OW = acc.shape
     tier_full = _fetch(tier)
-    len_full = _fetch(out_len).astype(np.int64)
+    len_full = _fetch(out_len.astype(jnp.uint16) if OW <= 0xFFFF
+                      else out_len).astype(np.int64)
     tier_np = tier_full[:n]
     len_np = len_full[:n]
 
@@ -645,7 +697,6 @@ def fetch_encode_driver(kernel, out, batch_dev, lens_dev, packed, encoder,
     cand = tier_np & cand1
     ridx = np.flatnonzero(cand)
 
-    N_acc, OW = acc.shape
     G = COMPACT_G
     gated = np.where(tier_full, len_full, 0)
     total_bytes = int(gated.sum())
@@ -658,7 +709,7 @@ def fetch_encode_driver(kernel, out, batch_dev, lens_dev, packed, encoder,
                 f"{kname}:compact-wide" if wide_adopted
                 else f"{kname}:compact", _compact_kernel, acc, out_len, tier)
         except CompileTimeout:
-            flat = None  # full-width fetch below until the compile lands
+            flat = None  # trimmed-width fetch below until the compile lands
     if flat is not None:
         used = (gated + (G - 1)) // G
         base = np.cumsum(used) - used
@@ -672,8 +723,29 @@ def fetch_encode_driver(kernel, out, batch_dev, lens_dev, packed, encoder,
         grp = comp[gidx]
         body = grp[np.arange(G)[None, :] < gv[:, None]]
         row_off = exclusive_cumsum(len_np[ridx])
+        _metrics.inc("fetch_bytes_saved",
+                     max(0, N_acc * OW - total_groups * G))
     elif ridx.size:
-        out_np = _fetch(acc)[:n]
+        # compaction skipped (or its compile pending): still trim the
+        # fetched matrix on device to the real row count and the batch's
+        # max gated row length instead of shipping the padded [N, OW].
+        # maxw quantizes up to 128 so the slice program count stays
+        # bounded, and the slice itself runs under the compile watchdog
+        # (a data-dependent shape is a fresh XLA program; on a hung
+        # remote compile the plain full-matrix transfer below cannot
+        # stall — it is a pure copy of an existing buffer)
+        maxw = min(OW, -(-max(int(gated[:n].max()), 1) // 128) * 128)
+        try:
+            trimmed = guarded_compile_call(
+                f"{kname}:trim:{maxw}", lambda: acc[:n, :maxw])
+        except CompileTimeout:
+            trimmed = None
+        if trimmed is not None:
+            out_np = _fetch(trimmed)
+            _metrics.inc("fetch_bytes_saved",
+                         max(0, N_acc * OW - n * maxw))
+        else:
+            out_np = _fetch(acc)[:n]
         rows = out_np[ridx]
         m = np.arange(rows.shape[1])[None, :] < len_np[ridx, None]
         body = rows[m]
@@ -681,6 +753,12 @@ def fetch_encode_driver(kernel, out, batch_dev, lens_dev, packed, encoder,
     else:
         body = np.zeros(0, dtype=np.uint8)
         row_off = np.zeros(1, dtype=np.int64)
+
+    if elide is not None and ridx.size:
+        # restore the head / timestamp-label / tail constants the kernel
+        # left out of the transfer (byte-identical by construction)
+        body, row_off = splice_elided_rows(
+            body, row_off, np.asarray(ts_len, dtype=np.int64)[ridx], *elide)
 
     prefix_lens_tier = None
     if syslen and ridx.size:

@@ -5,6 +5,11 @@ so the host fetch is ~``sum(out_len)`` bytes — truly output-sized —
 instead of ~24 span channels or the padded matrix (the reference fuses
 decode→encode per line in its hot loop, line_splitter.rs:44-54 →
 gelf_encoder.rs:59-115 — this is the batched-TPU shape of that fusion).
+The row-constant head, timestamp-label, and tail segments never cross
+PCIe at all: the kernel runs with ``elide=True`` and the driver splices
+those exact host-tier bytes back after the fetch
+(device_common.splice_elided_rows), which is what brings fetched
+bytes/row *under* emitted bytes/row.
 
 Everything is gather-free (the environment's recorded XLA-on-TPU fact:
 dynamic gathers lower near-serially — never gather):
@@ -125,10 +130,11 @@ def _bank(suffix: bytes, extras: Tuple[Tuple[str, str], ...] = ()
 
 
 @partial(jax.jit, static_argnames=("suffix", "max_sd", "impl",
-                                   "assemble", "extras"))
+                                   "assemble", "extras", "elide"))
 def _encode_kernel(batch, lens, dec, ts_text, ts_len, *, suffix: bytes,
                    max_sd: int, impl: str, assemble: bool = True,
-                   extras: Tuple[Tuple[str, str], ...] = ()):
+                   extras: Tuple[Tuple[str, str], ...] = (),
+                   elide: bool = False):
     N, L = batch.shape
     bank, off, parts = _bank(suffix, extras)
     OW = _out_width(L, L + E_CAP + len(bank) + TS_W)
@@ -199,7 +205,12 @@ def _encode_kernel(batch, lens, dec, ts_text, ts_len, *, suffix: bytes,
             ln = jnp.where(gate, ln, 0)
         segs.append((s, ln))
 
-    add_const("open")
+    if not elide:
+        # constant-elision mode skips the row-constant head, timestamp
+        # label, and tail segments: the host splice restores them after
+        # an output-sized (variable-bytes-only) D2H fetch
+        # (device_common.splice_elided_rows)
+        add_const("open")
     for p in range(P):
         pv = p < pair_count
         add_const("p0", pv)
@@ -232,9 +243,11 @@ def _encode_kernel(batch, lens, dec, ts_text, ts_len, *, suffix: bytes,
     msg_empty = trim_e <= msg_s
     segs.append((jnp.where(msg_empty, cbase + off["dash"], msg_s),
                  jnp.where(msg_empty, 1, trim_e - msg_s)))
-    add_const("ts")
+    if not elide:
+        add_const("ts")
     segs.append((zero + tbase, ts_len.astype(_I32)))
-    add_const("tail")
+    if not elide:
+        add_const("tail")
 
     out_len = segs[0][1]
     for _, ln in segs[1:]:
@@ -294,12 +307,18 @@ def fetch_encode(handle, packed, encoder, merger, route_state=None):
     suffix, syslen = merger_suffix(merger)
     impl = best_scan_impl()
     extras = tuple((k, v) for k, v in getattr(encoder, "extra", ()))
+    # constant elision: the head, timestamp-label, and tail constants
+    # never cross PCIe — the kernel skips them and the driver splices
+    # these exact host-tier bytes back (same _bank the kernel uses, so
+    # the two sides cannot disagree)
+    _, _, parts = _bank(suffix, extras)
+    elide_spec = (parts["open"], parts["ts"], parts["tail"] + suffix)
 
     def kernel(ts_text, ts_len, assemble):
         return _encode_kernel(batch_dev, lens_dev, dict(out), ts_text,
                               ts_len, suffix=suffix, max_sd=max_sd,
                               impl=impl, assemble=assemble,
-                              extras=extras)
+                              extras=extras, elide=True)
 
     def wide():
         """Pair-budget escalation: re-decode the batch on-device at the
@@ -316,7 +335,8 @@ def fetch_encode(handle, packed, encoder, merger, route_state=None):
             return _encode_kernel(batch_dev, lens_dev, dict(out_w),
                                   ts_text, ts_len, suffix=suffix,
                                   max_sd=max_sd, impl=impl,
-                                  assemble=assemble, extras=extras)
+                                  assemble=assemble, extras=extras,
+                                  elide=True)
         return out_w, kernel_w
 
     from .materialize import _scalar_line
@@ -325,4 +345,4 @@ def fetch_encode(handle, packed, encoder, merger, route_state=None):
         kernel, out, batch_dev, lens_dev, packed, encoder, merger,
         route_state, suffix, syslen, scalar_fn=_scalar_line,
         fallback_frac=FALLBACK_FRAC, decline_limit=DECLINE_LIMIT,
-        cooldown=COOLDOWN, wide=wide)
+        cooldown=COOLDOWN, wide=wide, elide=elide_spec)

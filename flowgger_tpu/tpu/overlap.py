@@ -1,0 +1,284 @@
+"""Overlapped batch execution: the in-flight submit/fetch window and
+the device-vs-host route economics.
+
+The serial BatchHandler shape — pack, dispatch, fetch, encode, sink,
+one batch at a time — sums every stage's latency, so the e2e rate is
+bounded by the *slowest sequential path* instead of the slowest *stage*
+(BENCH r5: device_fetch alone was 7.94s of an 8.08s batch wall).
+ParPaRaw (arxiv 1905.13415) and simdjson (1902.08318) both get their
+throughput from stage pipelining; this module is the flowgger-tpu shape
+of that idea:
+
+``InflightWindow``
+    A bounded window of submitted device batches (``input.tpu_inflight``,
+    default 2).  The ingest thread packs and *submits* batch N+1 while a
+    dedicated fetcher thread *fetches/encodes/enqueues* batch N — device
+    compute, D2H transfer, and host encode overlap instead of summing.
+    Strict batch ordering is structural: one fetcher thread pops a FIFO,
+    so blocks reach the merger in submit order no matter how long any
+    fetch takes.  A full window blocks ``submit`` (``overlap_stall_
+    seconds``) — backpressure flows to the splitter and from there to
+    the socket, exactly like the bounded queue it feeds.
+
+    Failure semantics: the pop function owns degradation (the device-
+    decode circuit breaker re-decodes a failed batch through the scalar
+    oracle *at its position in the window*, so byte-identity and
+    ordering survive mid-window device failures).  An exception the pop
+    function chooses to propagate (breaker disabled = legacy fail-fast)
+    is stashed and re-raised on the ingest thread at the next
+    ``fence()``/``submit()`` — batches behind the failed one still drain
+    in order first.
+
+``RouteEconomics``
+    The device-encode tier is gated by *applicability* (route_ok) and
+    *health* (decline hysteresis), but never by *profitability*: on a
+    backend where the kernels execute slowly (CPU fallback, a wedged
+    relay), the device tier can cost more wall time than the host block
+    encode it replaces while every probe still "succeeds".  This tracker
+    keeps an EWMA of measured seconds/row for both paths and routes
+    batches to the cheaper one, re-probing the loser periodically
+    (``input.tpu_encode_probe_every``) so a recovered device wins back
+    the traffic.  On a real TPU the device tier wins the comparison and
+    nothing changes; on this container's CPU backend the host path wins
+    ~8x and the executor becomes host-stage-bound, which is the point.
+
+Metrics: ``inflight_depth`` gauge, ``overlap_stall_seconds``,
+``dispatch_seconds`` (submit-side pack+dispatch, recorded by the
+handler), ``fetch_seconds`` (fetch-behind stage wall), and
+``encode_route_device`` / ``encode_route_host`` batch counters.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable, Optional
+
+from ..utils.metrics import registry as _metrics
+
+DEFAULT_INFLIGHT = 2
+DEFAULT_PROBE_EVERY = 256
+# the loser path must be this much slower (seconds/row) before traffic
+# moves; hysteresis against flapping on noisy single-batch samples
+ECON_MARGIN = 1.5
+# EWMA weight of the newest sample (small history, fast adaptation)
+ECON_ALPHA = 0.4
+# a device tier at or under this measured seconds/row is performing at
+# accelerator levels — no host path can beat it, so the comparison
+# sample (one host-routed batch) is never paid.  Only a device tier
+# slower than ~100K rows/s (CPU fallback, wedged relay) triggers the
+# host probe at all.
+DEVICE_OK_SPR = 1e-5
+
+
+class InflightWindow:
+    """Bounded FIFO of submitted batches with a fetch-behind worker.
+
+    ``pop_fn(entry)`` runs on the fetcher thread and must do the fetch +
+    encode + enqueue for one entry; entries complete in submit order.
+    ``depth=0`` disables the worker: ``submit`` pops inline (strictly
+    serial, the pre-overlap behavior) — the degenerate window tests and
+    single-threaded debugging use this.
+    """
+
+    def __init__(self, depth: int, pop_fn: Callable, name: str = "tpu",
+                 supervisor=None):
+        self.depth = max(0, int(depth))
+        self._pop_fn = pop_fn
+        self._name = name
+        self._supervisor = supervisor
+        self._lock = threading.Lock()
+        self._nonfull = threading.Condition(self._lock)
+        self._nonempty = threading.Condition(self._lock)
+        self._idle = threading.Condition(self._lock)
+        self._queue: deque = deque()
+        self._popping = False      # fetcher is inside pop_fn
+        self._pending_exc: Optional[BaseException] = None
+        self._closed = False
+        self._thread: Optional[threading.Thread] = None
+        _metrics.init_gauge("inflight_depth", 0)
+
+    # -- ingest side -------------------------------------------------------
+    def submit(self, entry) -> None:
+        """Queue one submitted batch; blocks while the window is full
+        (backpressure), re-raising any stashed fetcher exception."""
+        if self.depth == 0:
+            self._pop_fn(entry)
+            return
+        self._ensure_thread()
+        t0 = time.perf_counter()
+        with self._lock:
+            self._raise_pending_locked()
+            while len(self._queue) + (1 if self._popping else 0) >= self.depth:
+                self._nonfull.wait(timeout=0.5)
+                self._raise_pending_locked()
+            self._queue.append(entry)
+            _metrics.set_gauge("inflight_depth",
+                               len(self._queue) + (1 if self._popping else 0))
+            self._nonempty.notify()
+        stalled = time.perf_counter() - t0
+        if stalled > 1e-4:
+            _metrics.add_seconds("overlap_stall_seconds", stalled)
+
+    def fence(self) -> None:
+        """Block until every submitted batch has been fetched and
+        emitted (the in-flight window is empty and the fetcher idle),
+        then re-raise any exception the fetcher stashed.  This is the
+        ordering barrier every synchronous-emit path takes before
+        bypassing the window (breaker-open scalar batches, Record-path
+        encodes, shutdown drain)."""
+        if self.depth == 0:
+            return
+        with self._lock:
+            while self._queue or self._popping:
+                self._idle.wait(timeout=0.5)
+            self._raise_pending_locked()
+
+    def pending(self) -> int:
+        with self._lock:
+            return len(self._queue) + (1 if self._popping else 0)
+
+    def close(self) -> None:
+        """Stop the fetcher after the queue drains (tests/shutdown)."""
+        if self.depth == 0 or self._thread is None:
+            return
+        self.fence()
+        with self._lock:
+            self._closed = True
+            self._nonempty.notify_all()
+        self._thread.join(timeout=5)
+
+    # -- fetcher side ------------------------------------------------------
+    def _raise_pending_locked(self) -> None:
+        if self._pending_exc is not None:
+            exc, self._pending_exc = self._pending_exc, None
+            raise exc
+
+    def _ensure_thread(self) -> None:
+        with self._lock:
+            if self._thread is not None and self._thread.is_alive():
+                return
+            self._closed = False
+            name = f"{self._name}-fetch"
+            if self._supervisor is not None:
+                self._thread = self._supervisor.spawn(
+                    self._run, name, exhausted="exit")
+            else:
+                self._thread = threading.Thread(
+                    target=self._run, name=name, daemon=True)
+                self._thread.start()
+
+    def _run(self) -> None:
+        while True:
+            with self._lock:
+                while not self._queue and not self._closed:
+                    self._nonempty.wait(timeout=0.5)
+                if self._closed and not self._queue:
+                    self._idle.notify_all()
+                    return
+                entry = self._queue.popleft()
+                self._popping = True
+                _metrics.set_gauge("inflight_depth", len(self._queue) + 1)
+                self._nonfull.notify()
+            t0 = time.perf_counter()
+            try:
+                self._pop_fn(entry)
+            except BaseException as e:  # noqa: BLE001 - ferried to ingest
+                # the pop fn already owns degradation (breaker + scalar
+                # fallback); anything it lets out is the legacy fail-
+                # fast contract and belongs on the ingest thread
+                exc = e
+            else:
+                exc = None
+            _metrics.add_seconds("fetch_seconds", time.perf_counter() - t0)
+            with self._lock:
+                if exc is not None and self._pending_exc is None:
+                    self._pending_exc = exc
+                self._popping = False
+                _metrics.set_gauge("inflight_depth", len(self._queue))
+                self._nonfull.notify()
+                if not self._queue:
+                    self._idle.notify_all()
+
+
+class RouteEconomics:
+    """Measured seconds/row for the device-encode tier vs the host
+    block-encode path; ``allow_device()`` routes each batch to the
+    cheaper one with periodic re-probes of the loser.
+
+    Probing order: the device tier goes first; while its measured
+    seconds/row stays at accelerator levels (``DEVICE_OK_SPR``) the host
+    path is never paid at all.  Only a device tier measuring slow buys
+    one host batch for the comparison, after which the loser re-probes
+    every ``probe_every`` batches.  ``enabled=False`` pins the legacy
+    always-device behavior."""
+
+    def __init__(self, enabled: bool = True,
+                 probe_every: int = DEFAULT_PROBE_EVERY,
+                 margin: float = ECON_MARGIN,
+                 ok_spr: float = DEVICE_OK_SPR):
+        self.enabled = enabled
+        self.probe_every = max(2, int(probe_every))
+        self.margin = margin
+        self.ok_spr = ok_spr
+        self._lock = threading.Lock()
+        self._spr = {"device": None, "host": None}  # EWMA seconds/row
+        self._batches = 0
+
+    def allow_device(self) -> bool:
+        if not self.enabled:
+            return True
+        with self._lock:
+            self._batches += 1
+            dev, host = self._spr["device"], self._spr["host"]
+            if dev is None:
+                return True          # no device sample yet: probe it
+            if host is None:
+                # healthy accelerator: never pay the host comparison;
+                # a slow-measuring device buys one host batch to compare
+                return dev <= self.ok_spr
+            probe = self._batches % self.probe_every == 0
+            if dev > host * self.margin:
+                return probe         # device losing: re-probe on schedule
+            if host > dev * self.margin:
+                return not probe     # host losing: re-sample it on schedule
+            return True              # within noise: prefer the device tier
+
+    def observe(self, path: str, rows: int, seconds: float) -> None:
+        if not self.enabled or rows <= 0 or path not in self._spr:
+            return
+        spr = seconds / rows
+        with self._lock:
+            prev = self._spr[path]
+            self._spr[path] = spr if prev is None else (
+                prev + ECON_ALPHA * (spr - prev))
+        _metrics.inc(f"encode_route_{path}")
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"device_s_per_row": self._spr["device"],
+                    "host_s_per_row": self._spr["host"],
+                    "batches": self._batches}
+
+    @classmethod
+    def from_config(cls, config) -> "RouteEconomics":
+        enabled = config.lookup_bool(
+            "input.tpu_encode_economics",
+            "input.tpu_encode_economics must be a boolean", True)
+        probe_every = config.lookup_int(
+            "input.tpu_encode_probe_every",
+            "input.tpu_encode_probe_every must be an integer (batches)",
+            DEFAULT_PROBE_EVERY)
+        return cls(enabled=enabled, probe_every=probe_every)
+
+
+def inflight_depth_from_config(config) -> int:
+    from ..config import ConfigError
+
+    depth = config.lookup_int(
+        "input.tpu_inflight",
+        "input.tpu_inflight must be an integer (batches)", DEFAULT_INFLIGHT)
+    if depth < 0:
+        raise ConfigError("input.tpu_inflight must be >= 0")
+    return depth

@@ -16,6 +16,18 @@ import numpy as np
 _MIN_ROWS = 256
 _MIN_BYTES = 1 << 14
 
+# thread-sliced pack (``input.pack_threads``): the dense pack is a pure
+# bytes→ndarray scatter with no cross-row state, so rows slice evenly
+# across threads.  1 = single Python-side slice (the native memcpy tier
+# keeps its own internal default); >1 overrides the native thread count
+# AND slices the numpy fallback, which otherwise runs single-threaded.
+_PACK_THREADS = 1
+
+
+def configure_pack_threads(n: int) -> None:
+    global _PACK_THREADS
+    _PACK_THREADS = max(1, int(n))
+
 
 def _next_pow2(n: int) -> int:
     p = 1
@@ -55,21 +67,36 @@ def _split(chunk: bytes, strip_cr: bool = True, sep: int = 10):
 def _pack_dense(chunk: bytes, starts: np.ndarray, lens: np.ndarray,
                 max_len: int, np_rows: int) -> Tuple[np.ndarray, np.ndarray]:
     """(batch [np_rows, max_len] u8, clipped lens [np_rows]) — native
-    threaded memcpy or the numpy clip/mask/gather fallback."""
+    threaded memcpy or the (optionally thread-sliced) numpy
+    clip/mask/gather fallback."""
     from .. import native
 
-    packed = native.pack_chunk_native(chunk, starts, lens, max_len, np_rows)
+    nt = _PACK_THREADS
+    packed = native.pack_chunk_native(chunk, starts, lens, max_len, np_rows,
+                                      n_threads=nt if nt > 1 else None)
     if packed is not None:
         return packed
     n = len(starts)
     buf = np.frombuffer(chunk, dtype=np.uint8)
     lens_c = np.minimum(lens, max_len)
     batch = np.zeros((np_rows, max_len), dtype=np.uint8)
-    if n:
-        idx = starts[:, None] + np.arange(max_len, dtype=np.int32)[None, :]
+    col = np.arange(max_len, dtype=np.int32)[None, :]
+
+    def _fill(a: int, b: int) -> None:
+        idx = starts[a:b, None] + col
         np.clip(idx, 0, max(buf.size - 1, 0), out=idx)
-        mask = np.arange(max_len, dtype=np.int32)[None, :] < lens_c[:, None]
-        np.multiply(buf[idx], mask, out=batch[:n], casting="unsafe")
+        mask = col < lens_c[a:b, None]
+        np.multiply(buf[idx], mask, out=batch[a:b], casting="unsafe")
+
+    if n:
+        if nt > 1 and n >= 4 * nt:
+            from concurrent.futures import ThreadPoolExecutor
+
+            bounds = [(i * n // nt, (i + 1) * n // nt) for i in range(nt)]
+            with ThreadPoolExecutor(max_workers=nt) as ex:
+                list(ex.map(lambda ab: _fill(*ab), bounds))
+        else:
+            _fill(0, n)
     lens_p = np.zeros(np_rows, dtype=np.int32)
     lens_p[:n] = lens_c
     return batch, lens_p

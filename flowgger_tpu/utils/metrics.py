@@ -14,6 +14,11 @@ Counter names: input_lines, decoded_records, decode_errors,
 encode_errors, invalid_utf8, enqueued, output_written, output_errors,
 batches, batch_lines, fallback_rows.  ``batch_seconds`` is a histogram
 (count/sum/min/max/p50/p99 over a sliding window).
+
+Overlap executor stages report as cumulative seconds
+(``dispatch_seconds`` submit-side pack+dispatch, ``fetch_seconds``
+fetch-behind wall, ``overlap_stall_seconds`` window backpressure) plus
+the ``inflight_depth`` gauge — see tpu/overlap.py.
 """
 
 from __future__ import annotations
@@ -34,6 +39,9 @@ _COUNTERS = (
     "sink_reconnects", "sink_failovers",
     "thread_crashes", "thread_restarts", "input_reconnects",
     "device_decode_errors", "breaker_trips", "breaker_recoveries",
+    # overlap executor (tpu/overlap.py): D2H bytes the compaction +
+    # constant-elision path avoided, and encode-route economics picks
+    "fetch_bytes_saved", "encode_route_device", "encode_route_host",
 )
 
 

@@ -279,6 +279,27 @@ def test_compact_kernel_matches_numpy():
         assert (got == acc[i, :gated[i]]).all(), f"row {i}"
 
 
+def test_splice_elided_rows_restores_exact_bytes():
+    """Constant elision round-trip: variable-only device rows (with the
+    timestamp text as each row's final ts_len bytes) plus the elided
+    head/label/tail constants must reassemble to the exact full rows."""
+    from flowgger_tpu.tpu.device_common import splice_elided_rows
+
+    rows = [b"VAR-ONE-tsA", b"second-var-22", b"x-t3", b"-9"]
+    ts = np.array([3, 2, 2, 1], dtype=np.int64)
+    body = np.frombuffer(b"".join(rows), dtype=np.uint8)
+    row_off = np.concatenate(
+        [[0], np.cumsum([len(r) for r in rows])]).astype(np.int64)
+    head, label, tail = b"{", b'","timestamp":', b',"version":"1.1"}\0'
+    out, off = splice_elided_rows(body, row_off, ts, head, label, tail)
+    want = b"".join(
+        head + r[:len(r) - t] + label + r[len(r) - t:] + tail
+        for r, t in zip(rows, ts.tolist()))
+    assert bytes(out) == want
+    full = [len(r) + len(head) + len(label) + len(tail) for r in rows]
+    assert off.tolist() == np.concatenate([[0], np.cumsum(full)]).tolist()
+
+
 def test_record_path_cliff_warns_at_startup(capsys):
     """A config that can never engage the block route (any *_extra on a
     JSON route, an encoder with no columnar path for the input format)

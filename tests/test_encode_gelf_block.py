@@ -313,9 +313,9 @@ def test_ltsv_block_newline_escaping():
 
 
 def test_pipelined_flushes_preserve_order_and_drain():
-    """Size-triggered flushes leave one batch in flight (device decode
-    overlapping host encode); order across batches is preserved and a
-    final flush drains everything."""
+    """Size-triggered flushes submit batches into the in-flight window
+    (the fetcher thread fetches/encodes behind the ingest thread); order
+    across batches is preserved and a final flush fences the window."""
     lines = [
         f'<13>1 2015-08-05T15:53:45.{i:03d}Z host{i} app {i} m '
         f'[sd@1 k="{i}"] message {i}'.encode()
@@ -328,9 +328,8 @@ def test_pipelined_flushes_preserve_order_and_drain():
                      start_timer=False, merger=merger)
     for ln in lines:
         h.handle_bytes(ln)  # triggers drain=False flushes every 8 lines
-    assert len(h._inflight) == 1  # one batch still in flight
-    h.flush()                      # EOF drain
-    assert len(h._inflight) == 0
+    h.flush()                      # EOF drain: fences the window
+    assert h._window.pending() == 0
     got = []
     while not tx.empty():
         got.extend(tx.get_nowait().iter_framed())
