@@ -13,12 +13,20 @@ echo "==            byte-identity contracts, exception hygiene, keys) =="
 # pure-ast, no JAX import: fails on any non-baselined FC01-FC05 finding
 python -m flowgger_tpu.analysis --format text .
 
-echo "== overlap-executor smoke (tiny batch, CPU backend, <60s) =="
+echo "== overlap-executor smoke (forced 4-device CPU, <120s) =="
 # asserts the in-flight submit/fetch window sustains >= the serial e2e
-JAX_PLATFORMS=cpu timeout 120 python bench.py --smoke
+# AND 2-lane dispatch sustains >= 0.92x the 1-lane executor (jitter
+# tolerance for small hosts; the ratio itself is in the JSON line)
+JAX_PLATFORMS=cpu timeout 240 python bench.py --smoke
 
 echo "== python test suite (virtual 8-device CPU mesh) =="
 python -m pytest tests/ -q -m "not faults"
+
+echo "== lane-dispatch suite (forced 2-device CPU) =="
+# real multi-lane placement/ordering for tests/test_lanes.py only; the
+# rest of the suite keeps its usual device setup so timings stay stable
+XLA_FLAGS=--xla_force_host_platform_device_count=2 JAX_PLATFORMS=cpu \
+  python -m pytest tests/test_lanes.py -q -m "not faults"
 
 echo "== fault-injection suite (robustness degradation paths) =="
 JAX_PLATFORMS=cpu python -m pytest tests/ -q -m "faults and not slow"

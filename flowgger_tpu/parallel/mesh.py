@@ -9,6 +9,19 @@ shards the same way; ``ShardedDecode`` wraps the jitted sharded kernel
 together with the input placement (pad rows to a dp multiple, then
 ``jax.device_put`` with the batch sharding) so the production
 BatchHandler can swap it in for the single-chip submit path.
+
+Mesh vs lane dispatch (tpu/overlap.py LaneSet): the mesh shards ONE
+batch across every chip (lowest latency per batch, one compiled
+program, cross-chip synchronization per dispatch); lane dispatch gives
+each chip its OWN whole batches (highest throughput, zero cross-chip
+traffic, per-chip degradation).  The production BatchHandler defaults
+to lanes on multi-chip hosts and disables the mesh when more than one
+lane resolves — ``input.tpu_mesh = "on"`` pins the mesh instead (and is
+a config error combined with ``input.tpu_lanes > 1``).  Multi-host
+deployments compose identically either way: each host lane-dispatches
+(or meshes) only its own ingest stream over its own chips, with the
+process group joined by ``parallel/distributed.py``'s
+``tpu_coordinator*`` keys.
 """
 
 from __future__ import annotations

@@ -229,6 +229,13 @@ class Pipeline:
             from .parallel.distributed import init_distributed
 
             init_distributed(config)
+            # persistent XLA compile cache (input.tpu_compile_cache_dir)
+            # must be wired before the first kernel dispatch so every
+            # compile this process pays — including the handler's
+            # startup prewarm — lands in it (no key = no-op)
+            from .tpu.device_common import setup_compile_cache
+
+            setup_compile_cache(config)
 
     def handler_factory(self):
         if self.input_format in _TPU_FORMATS:
@@ -272,9 +279,10 @@ class Pipeline:
         """Flush pending batches and drain the queue through the sinks —
         the reference loses in-flight queue contents on shutdown
         (SURVEY.md §5 checkpoint/resume); we flush instead.  For batch
-        handlers ``flush()`` also fences the in-flight submit/fetch
-        window (tpu/overlap.py), so every batch the overlap executor
-        still holds reaches the queue before SHUTDOWN is enqueued."""
+        handlers ``flush()`` also fences **every** dispatch lane of the
+        in-flight submit/fetch executor (tpu/overlap.py LaneSet), so
+        every batch any lane still holds reaches the queue — in batch
+        order — before SHUTDOWN is enqueued."""
         for handler in self._handlers:
             try:
                 handler.flush()

@@ -9,7 +9,11 @@ supervisor: input-accept and output-consumer threads run inside a
 restart loop with the shared ``RetryPolicy`` backoff, crashes and
 restarts are counted (``thread_crashes`` / ``thread_restarts``), and a
 thread that exhausts its restart budget logs loudly instead of wedging
-silently.
+silently.  The overlap executor's per-lane fetcher threads
+(tpu/overlap.py ``LaneSet`` → ``InflightWindow._run``) and the startup
+kernel-prewarm worker (tpu/device_common.py) spawn through ``spawn``
+too, so a crashed lane restarts with backoff instead of wedging its
+share of the in-flight window.
 
 Config (all optional)::
 

@@ -94,20 +94,6 @@ class BatchHandler(Handler):
         # serializes batch decodes so a timer flush racing a size flush
         # cannot reorder output
         self._decode_lock = threading.Lock()
-        # overlap executor: the block route submits batches into a
-        # bounded in-flight window whose fetcher thread runs the D2H
-        # fetch + block encode + enqueue behind the ingest thread's
-        # pack/dispatch — device compute, transfer, and host work
-        # overlap instead of summing (tpu/overlap.py).  Every
-        # synchronous-emit path fences the window first so blocks reach
-        # the merger in strict batch order.
-        from .overlap import (InflightWindow, RouteEconomics,
-                              inflight_depth_from_config)
-
-        self._econ = RouteEconomics.from_config(cfg)
-        self._window = InflightWindow(
-            inflight_depth_from_config(cfg), self._pop_emit,
-            name=f"tpu-{fmt}", supervisor=supervisor)
         self._timer: Optional[threading.Timer] = None
         self._start_timer = start_timer
         # per-handler hysteresis for the device-encode route (declines /
@@ -116,7 +102,9 @@ class BatchHandler(Handler):
         # multi-chip mesh: rows shard over dp, bytes over sp (SURVEY
         # §2.8 mapping).  "auto" engages whenever more than one real
         # device is visible; "on" also engages on the virtual CPU mesh
-        # (tests); "off" disables.
+        # (tests); "off" disables.  Lane dispatch (below) supersedes the
+        # mesh when it resolves to >1 lane — each chip then decodes its
+        # own batches instead of a shard of every batch.
         self._mesh = None
         self._mesh_checked = False
         self._sharded: dict = {}
@@ -132,6 +120,58 @@ class BatchHandler(Handler):
             from ..config import ConfigError
 
             raise ConfigError("input.tpu_sp must be >= 1")
+        # shape bucketing: pack row counts quantize to a small geometric
+        # grid so steady-state traffic compiles a handful of shapes
+        # (padding rows are masked — emitted bytes never change).  Like
+        # pack_threads, only an explicit key touches the module-wide
+        # grid so a default handler can't reset another's buckets.
+        from . import pack as _pack_mod
+
+        shape_buckets = cfg.lookup_int(
+            "input.tpu_shape_buckets",
+            "input.tpu_shape_buckets must be an integer (bucket count)",
+            None)
+        if shape_buckets is not None:
+            if shape_buckets < 1:
+                from ..config import ConfigError
+
+                raise ConfigError("input.tpu_shape_buckets must be >= 1")
+            _pack_mod.configure_shape_buckets(
+                _pack_mod.shape_bucket_grid(shape_buckets, self.batch_size))
+        # overlap executor: the block route submits batches into a set
+        # of per-device lanes (tpu/overlap.py LaneSet) — default one
+        # lane (the PR 4 in-flight window); with multiple real devices
+        # (or an explicit input.tpu_lanes) batches round-robin across
+        # lanes, each with its own fetcher thread, submit-ahead depth,
+        # and route economics, while the LaneSet's FIFO sequencer keeps
+        # blocks reaching the merger in strict batch order.  Every
+        # synchronous-emit path fences ALL lanes first.
+        from .overlap import (LaneSet, RouteEconomics,
+                              inflight_depth_from_config, resolve_lanes)
+
+        lanes, lane_devs = resolve_lanes(cfg, self._mesh_mode)
+        if lanes > 1:
+            # lanes own the devices; the sharded mesh would re-shard
+            # each lane's batch across every chip and serialize them
+            self._mesh_mode = "off"
+        self._lane_devices = lane_devs
+        self._econs = [
+            RouteEconomics.from_config(
+                cfg, label=f"lane{i}" if lanes > 1 else None)
+            for i in range(lanes)
+        ]
+        self._window = LaneSet(
+            inflight_depth_from_config(cfg), self._pop_emit, lanes=lanes,
+            name=f"tpu-{fmt}", supervisor=supervisor)
+        # persistent compile cache (input.tpu_compile_cache_dir): wire
+        # before any kernel dispatch so every compile below lands in it
+        from .device_common import setup_compile_cache
+
+        self._compile_cache_dir = setup_compile_cache(cfg)
+        self._prewarm_cfg = cfg.lookup_bool(
+            "input.tpu_prewarm", "input.tpu_prewarm must be a boolean",
+            None)
+        self._supervisor = supervisor
         # direct span->bytes encodes for rfc5424 routes
         from ..encoders.capnp import CapnpEncoder
         from ..encoders.gelf import GelfEncoder
@@ -177,6 +217,37 @@ class BatchHandler(Handler):
                     f"flowgger-tpu: columnar block route disabled for "
                     f"format '{fmt}' ({reason}); throughput falls to the "
                     f"per-record path (~30x slower)", file=sys.stderr)
+        # background kernel prewarm: compile the configured format's
+        # decode (+ engaged device-encode) kernels for the shape-bucket
+        # grid now, so the first real batch of each steady-state shape
+        # never eats a cold compile or a watchdog decline.  Default: on
+        # exactly when a persistent compile cache is configured (the
+        # production signal); input.tpu_prewarm forces either way.
+        # auto format skips (its per-class legs compile lazily per mix).
+        prewarm = self._prewarm_cfg
+        if prewarm is None:
+            prewarm = self._compile_cache_dir is not None
+        if (prewarm and self._block_mode and fmt != "auto"
+                and self._kernel_fn is not None and self._block_route_ok()):
+            from . import pack as _pack_mod
+            from .device_common import prewarm_kernels
+
+            grid = (_pack_mod.active_bucket_grid()
+                    or (_pack_mod.bucket_rows(self.batch_size),))
+            prewarm_kernels(
+                fmt, self.max_len, grid, encoder=self.encoder,
+                merger=self._merger,
+                ltsv_decoder=(self.scalar.decoder if fmt == "ltsv"
+                              else None),
+                supervisor=supervisor,
+                devices=[d for d in self._lane_devices if d is not None]
+                or None)
+
+    @property
+    def _econ(self):
+        """Lane-0 route economics (single-lane compatibility alias;
+        multi-lane callers read ``_econs``)."""
+        return self._econs[0]
 
     # -- Handler interface -------------------------------------------------
     def ingest_chunk(self, region: bytes) -> None:
@@ -432,9 +503,16 @@ class BatchHandler(Handler):
             if self._breaker is None:
                 raise
             self._device_failed(e)
-            # drain the in-flight window before emitting this batch's
-            # scalar re-decode, so mid-window failures keep batch order
-            self._window.fence()
+            # drain every lane before emitting this batch's scalar
+            # re-decode, so mid-window failures keep batch order.  A
+            # second ferried failure surfacing from the fence must not
+            # leak past this boundary and drop the current batch: the
+            # fence has fully drained by the time it re-raises, so
+            # record the failure and continue to the fallback
+            try:
+                self._window.fence()
+            except Exception as fe:  # noqa: BLE001 - device degradation boundary
+                self._device_failed(fe)
             self._scalar_fallback_packed(packed)
             return
         if not deferred[0]:
@@ -626,19 +704,25 @@ class BatchHandler(Handler):
 
     def _emit_fast(self, packed, deferred=None) -> None:
         """Span→bytes encode for one packed tuple: the columnar block
-        route when engaged (submitted into the in-flight window; the
-        fetcher thread fetches and emits behind us), else the per-row
+        route when engaged (submitted onto the next dispatch lane; that
+        lane's fetcher thread fetches and encodes behind us, and the
+        LaneSet sequencer emits in strict batch order), else the per-row
         fast path (gelf/passthrough only), else the Record path."""
         if self._block_route_ok():
             if deferred is not None:
                 deferred[0] = True
+            lane = self._window.next_lane()
+            if len(self._lane_devices) > 1:
+                _metrics.inc(f"lane{lane}_rows", int(packed[5]))
             if self.fmt == "auto":
                 # the auto merger submits its per-class kernels at fetch
-                # time, on the fetcher thread
-                self._window.submit((None, packed))
+                # time, on the lane's fetcher thread (default device:
+                # the per-class legs share one jit cache)
+                self._window.submit(lane, (None, packed))
                 return
-            self._window.submit((block_submit(
-                self.fmt, packed, self._sharded_for(self.fmt)), packed))
+            self._window.submit(lane, (block_submit(
+                self.fmt, packed, self._sharded_for(self.fmt),
+                self._lane_devices[lane]), packed))
             return
         from ..encoders.gelf import GelfEncoder
         from ..encoders.passthrough import PassthroughEncoder
@@ -657,38 +741,65 @@ class BatchHandler(Handler):
             return
         self._emit(_decode_packed(self.fmt, packed, self.scalar.decoder))
 
-    def _pop_emit(self, entry) -> None:
-        """Fetch + encode + enqueue one in-flight entry; runs on the
-        window's fetcher thread, in submit order."""
-        handle, packed = entry
+    def _pop_emit(self, payload, lane: int = 0):
+        """Fetch + encode one in-flight entry on a lane fetcher thread
+        (concurrent across lanes); returns the emit closure the LaneSet
+        sequencer runs in global submit order."""
+        handle, packed = payload
         import time as _time
 
         t0 = _time.perf_counter()
         stats: dict = {}
+        econ = self._econs[lane % len(self._econs)]
         try:
             _faults.maybe_raise("device_decode")
-            self._pop_emit_inner(handle, packed, stats)
+            emit = self._pop_emit_inner(handle, packed, stats, econ)
         except Exception as e:  # noqa: BLE001 - device degradation boundary
             if self._breaker is None:
                 raise
             self._device_failed(e)
-            self._scalar_fallback_packed(packed)
-            return
-        if self._breaker is not None:
-            self._breaker.record_success()
+            # emitted under the sequencer turnstile: the scalar re-
+            # decode still lands at the batch's position in the stream
+            return lambda: self._scalar_fallback_packed(packed)
+        # measure the route's compute wall now — the sequencer wait
+        # ahead of emission is cross-lane scheduling, not route cost
+        compute_s = _time.perf_counter() - t0 - stats.get("declined_s", 0.0)
         path = stats.get("path")
-        if path is not None:
-            # feed the device-vs-host encode-route economics with this
-            # batch's measured wall share (tpu/overlap.py); wall burned
-            # by a declined device attempt (compile-watchdog waits) is
-            # the device tier's fault, not the host path's — subtract it
-            self._econ.observe(
-                path, int(packed[5]),
-                _time.perf_counter() - t0 - stats.get("declined_s", 0.0))
 
-    def _pop_emit_inner(self, handle, packed, stats=None) -> None:
+        def finish():
+            try:
+                emit()
+            except Exception as e:  # noqa: BLE001 - device degradation boundary
+                # the emit closure is still inside the degradation
+                # boundary (it ran inside _pop_emit_inner pre-lanes): a
+                # failure here re-decodes the batch through the scalar
+                # oracle at its sequenced position instead of ferrying
+                # and losing the lines
+                if self._breaker is None:
+                    raise
+                self._device_failed(e)
+                self._scalar_fallback_packed(packed)
+                return
+            if self._breaker is not None:
+                self._breaker.record_success()
+            if path is not None:
+                # feed this lane's device-vs-host encode-route economics
+                # (tpu/overlap.py) with the measured wall share; wall
+                # burned by a declined device attempt (compile-watchdog
+                # waits) is the device tier's fault, not the host
+                # path's — already subtracted
+                econ.observe(path, int(packed[5]), compute_s)
+
+        return finish
+
+    def _pop_emit_inner(self, handle, packed, stats=None, econ=None):
+        """Fetch + encode one entry; returns a zero-arg emit closure
+        (runs later, under the sequencer) so lanes can compute
+        concurrently without reordering the merger stream."""
         import time as _time
 
+        if econ is None:
+            econ = self._econs[0]
         t0 = _time.perf_counter()
         if self.fmt == "auto":
             from .autodetect import decode_auto_packed, encode_auto_gelf_blocks
@@ -698,33 +809,31 @@ class BatchHandler(Handler):
                                           self._device_route_state,
                                           self._sharded_for)
             if res is None:
-                self._emit(decode_auto_packed(packed, self.max_len,
-                                              self._auto_ltsv))
-                return
+                results = decode_auto_packed(packed, self.max_len,
+                                             self._auto_ltsv)
+                return lambda: self._emit(results)
             # per-leg fetch time is folded into encode_seconds here: the
             # merger interleaves four kernels' fetches with their encodes
             _metrics.add_seconds("encode_seconds",
                                  _time.perf_counter() - t0)
-            self._emit_block(res, packed[5])
-            return
+            return lambda: self._emit_block(res, packed[5])
         ltsv_dec = self.scalar.decoder if self.fmt == "ltsv" else None
         res, fetch_s, declined_s = block_fetch_encode(
             self.fmt, handle, packed, self.encoder, self._merger,
             ltsv_dec, self._device_route_state,
-            allow_device=self._econ.allow_device(), stats=stats)
+            allow_device=econ.allow_device(), stats=stats)
         if stats is not None:
             stats["declined_s"] = declined_s
         if res is None:
             # the route declined after the fact (e.g. an oversized
             # ltsv_schema or a configured suffix): Record path
-            self._emit(_decode_packed(self.fmt, packed,
-                                      self.scalar.decoder))
-            return
+            results = _decode_packed(self.fmt, packed, self.scalar.decoder)
+            return lambda: self._emit(results)
         t2 = _time.perf_counter()
         _metrics.add_seconds("device_fetch_seconds", fetch_s)
         _metrics.add_seconds("encode_seconds",
                              t2 - t0 - fetch_s - declined_s)
-        self._emit_block(res, packed[5])
+        return lambda: self._emit_block(res, packed[5])
 
     def _emit_block(self, res, n_real: int) -> None:
         _metrics.inc("input_lines", n_real)
@@ -800,26 +909,36 @@ class BatchHandler(Handler):
             self.tx.put(encoded)
 
 
-def block_submit(fmt, packed, sharded=None):
+def block_submit(fmt, packed, sharded=None, device=None):
     """Dispatch one packed tuple's kernel asynchronously (JAX futures);
     pair with block_fetch_encode.  ``sharded`` (parallel.mesh.
-    ShardedDecode) swaps in the multi-chip mesh kernel."""
+    ShardedDecode) swaps in the multi-chip mesh kernel.  ``device``
+    (lane dispatch) commits the inputs to that device before the jit
+    call, so the decode — and every downstream device-encode stage that
+    reuses the handle's device arrays — runs on the lane's chip."""
+    batch, lens = packed[0], packed[1]
+    if device is not None and sharded is None:
+        import jax
+
+        # committed placement: the jit executes on the lane device and
+        # jnp.asarray inside the submit fns is a no-op on these
+        batch = jax.device_put(batch, device)
+        lens = jax.device_put(lens, device)
     if fmt == "rfc3164":
         from . import rfc3164
 
-        return rfc3164.decode_rfc3164_submit(packed[0], packed[1], sharded)
+        return rfc3164.decode_rfc3164_submit(batch, lens, sharded)
     if fmt == "ltsv":
         from . import ltsv
 
-        return ltsv.decode_ltsv_submit(packed[0], packed[1], sharded)
+        return ltsv.decode_ltsv_submit(batch, lens, sharded)
     if fmt == "gelf":
         from . import gelf
 
-        return gelf.decode_gelf_submit(packed[0], packed[1], sharded)
+        return gelf.decode_gelf_submit(batch, lens, sharded)
     from . import rfc5424
 
-    return rfc5424.decode_rfc5424_submit(packed[0], packed[1],
-                                         sharded=sharded)
+    return rfc5424.decode_rfc5424_submit(batch, lens, sharded=sharded)
 
 
 def block_fetch_encode(fmt, handle, packed, encoder, merger,

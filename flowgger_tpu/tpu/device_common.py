@@ -138,6 +138,157 @@ def guarded_compile_call(name: str, fn, *args):
         raise box["error"]
     return box["result"]
 
+# -- persistent compile cache + prewarm --------------------------------------
+# A fresh (rows, max_len) shape costs a full XLA compile — >60s for the
+# encode kernels on constrained hosts, which the watchdog converts into
+# host-path declines: the device tier spends its first minutes per shape
+# losing the route-economics race it should win.  Two fixes compose:
+# the persistent compilation cache (``input.tpu_compile_cache_dir``)
+# makes every compile a once-per-machine cost, and the background
+# prewarm compiles the configured format's kernels for the shape-bucket
+# grid at startup so the first real batch hits a warm jit cache.  Cache
+# traffic is observable as ``compile_cache_hits``/``compile_cache_
+# misses`` counters (a second cold process of the same config should
+# report zero misses for the prewarmed kernels).
+
+_cache_state_lock = threading.Lock()
+_cache_dir_installed = None
+_cache_listener_installed = False
+
+
+def _install_cache_listener() -> None:
+    """Bridge JAX's compilation-cache monitoring events into the metrics
+    registry (idempotent; the listener registry is process-global)."""
+    global _cache_listener_installed
+    with _cache_state_lock:
+        if _cache_listener_installed:
+            return
+        _cache_listener_installed = True
+    from jax import monitoring as _monitoring
+
+    from ..utils.metrics import registry as _reg
+
+    def _on_event(event, **_kw):
+        # event names are stable-ish across jax versions; match the leaf
+        if event.endswith("/cache_hits"):
+            _reg.inc("compile_cache_hits")
+        elif event.endswith("/cache_misses"):
+            _reg.inc("compile_cache_misses")
+
+    _monitoring.register_event_listener(_on_event)
+
+
+def enable_compile_cache(cache_dir: str) -> str:
+    """Point JAX's persistent compilation cache at ``cache_dir`` and
+    start counting hits/misses.  Thresholds are dropped to zero so even
+    the small decode kernels persist — on hosts where the big encode
+    compiles never finish inside the watchdog, the cheap kernels are
+    exactly the ones worth never recompiling."""
+    cache_dir = os.path.expanduser(cache_dir)
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    for knob, val in (("jax_persistent_cache_min_compile_time_secs", 0.0),
+                      ("jax_persistent_cache_min_entry_size_bytes", 0)):
+        try:
+            jax.config.update(knob, val)
+        except Exception:  # noqa: BLE001 - knob names vary across jax versions
+            pass
+    try:
+        # jax latches the use-the-cache decision at the first compile;
+        # a process that already compiled something (tests, a handler
+        # built before the config was read) must reset that memo or the
+        # new cache dir is silently ignored
+        from jax._src import compilation_cache as _cc
+
+        _cc.reset_cache()
+    except Exception:  # noqa: BLE001 - private API; harmless if gone
+        pass
+    _install_cache_listener()
+    with _cache_state_lock:
+        global _cache_dir_installed
+        _cache_dir_installed = cache_dir
+    return cache_dir
+
+
+def setup_compile_cache(config):
+    """Wire ``input.tpu_compile_cache_dir`` (no key = no cache, the
+    stock JAX behavior).  Returns the directory when installed."""
+    cache_dir = config.lookup_str(
+        "input.tpu_compile_cache_dir",
+        "input.tpu_compile_cache_dir must be a string (directory)", None)
+    if not cache_dir:
+        return None
+    return enable_compile_cache(cache_dir)
+
+
+def _zero_packed(rows: int, max_len: int):
+    """A zero-row packed tuple of device shape [rows, max_len] — the
+    cheapest input that still compiles every kernel phase (n_real = 0:
+    nothing is emitted, fetched bodies are empty)."""
+    return (np.zeros((rows, max_len), dtype=np.uint8),
+            np.zeros(rows, dtype=np.int32), b"",
+            np.zeros(rows, dtype=np.int32),
+            np.zeros(0, dtype=np.int32), 0)
+
+
+def prewarm_kernels(fmt: str, max_len: int, row_buckets, encoder=None,
+                    merger=None, ltsv_decoder=None, supervisor=None,
+                    devices=None):
+    """Background-compile ``fmt``'s decode kernel — and, when the
+    device-encode route applies (encoder+merger given), its encode
+    phases — for every shape in ``row_buckets``.
+
+    Runs on one daemon thread (spawned through the pipeline Supervisor
+    when given, so a crash restarts with backoff instead of silently
+    losing the warmup).  The cheap decode compiles run directly on this
+    thread — the prewarm worker IS the off-stream background the
+    watchdog would otherwise provide, and queueing them on the
+    watchdog's single-flight semaphore would starve them forever behind
+    a stuck encode compile.  The huge device-encode compiles keep their
+    existing ``FLOWGGER_COMPILE_TIMEOUT_MS`` watchdog + single-flight
+    path inside ``fetch_encode_driver`` (a timeout there declines
+    cleanly while the compile keeps warming).  ``devices`` (lane
+    dispatch) warms one executable per lane device — jit caches key on
+    placement, so a default-device warmup would leave lanes 1..N cold.
+    With a persistent cache installed every landed compile also becomes
+    a once-per-machine cost.  Returns the thread."""
+    buckets = [int(b) for b in row_buckets]
+    devs = list(devices) if devices else [None]
+
+    def run():
+        from ..utils.metrics import registry as _reg
+        from .batch import block_fetch_encode, block_submit
+
+        for rows in buckets:
+            for di, dev in enumerate(devs):
+                packed = _zero_packed(rows, max_len)
+                name = f"prewarm:{fmt}:{rows}x{max_len}:d{di}"
+                try:
+                    # the jit *call* compiles synchronously, right here
+                    # on the prewarm thread
+                    handle = block_submit(fmt, packed, None, dev)
+                    if encoder is not None and merger is not None:
+                        # device-encode probe/assemble compiles are
+                        # guarded inside fetch_encode_driver; a timeout
+                        # there simply declines to the host block path
+                        # while the compile keeps warming in background
+                        block_fetch_encode(fmt, handle, packed, encoder,
+                                           merger, ltsv_decoder,
+                                           route_state={})
+                    _reg.inc("prewarmed_shapes")
+                except CompileTimeout:
+                    continue  # still compiling in the watchdog's worker
+                except Exception as e:  # noqa: BLE001 - warmup must never kill ingest
+                    print(f"kernel prewarm [{name}] failed: "
+                          f"{type(e).__name__}: {e}", file=sys.stderr)
+
+    if supervisor is not None:
+        return supervisor.spawn(run, "tpu-prewarm", exhausted="return")
+    t = threading.Thread(target=run, daemon=True, name="tpu-prewarm")
+    t.start()
+    return t
+
+
 TS_W = 32          # timestamp text slot width (longest json_f64 ≈ 25)
 E_CAP = 56         # max JSON escapes per row on the device tier
 
@@ -589,9 +740,16 @@ def fetch_encode_driver(kernel, out, batch_dev, lens_dev, packed, encoder,
             return t1["tier"], extra
         return t1, None
 
-    # compile-watchdog slot names: stable per kernel module + shape
-    # (closures are rebuilt per batch; the jit cache underneath is not)
-    kname = f"{getattr(kernel, '__module__', 'device')}:{tuple(batch_dev.shape)}"
+    # compile-watchdog slot names: stable per kernel module + shape +
+    # device (closures are rebuilt per batch; the jit cache underneath
+    # is not; lane dispatch compiles one executable per device, so each
+    # lane's compile needs its own watchdog slot)
+    try:
+        _dev = ",".join(sorted(str(d) for d in batch_dev.devices()))
+    except Exception:  # noqa: BLE001 - tracers/older arrays have no .devices()
+        _dev = "default"
+    kname = (f"{getattr(kernel, '__module__', 'device')}:"
+             f"{tuple(batch_dev.shape)}:{_dev}")
 
     def _declined_compile():
         if route_state is not None:

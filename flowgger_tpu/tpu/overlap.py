@@ -42,10 +42,26 @@ of that idea:
     nothing changes; on this container's CPU backend the host path wins
     ~8x and the executor becomes host-stage-bound, which is the point.
 
-Metrics: ``inflight_depth`` gauge, ``overlap_stall_seconds``,
-``dispatch_seconds`` (submit-side pack+dispatch, recorded by the
-handler), ``fetch_seconds`` (fetch-behind stage wall), and
-``encode_route_device`` / ``encode_route_host`` batch counters.
+``LaneSet``
+    N per-device lanes, each an ``InflightWindow`` with its own fetcher
+    thread and submit-ahead depth, fed round-robin by the ingest thread
+    (ParPaRaw's parallel-lane shape: log decode has no cross-record
+    state, so lanes never need to talk).  The pop function runs
+    concurrently across lanes but returns an *emit closure* instead of
+    enqueueing directly; a single FIFO sequencer (a ticket turnstile)
+    runs those closures in global submit order, so blocks reach the
+    merger in exactly the order batches were ingested no matter which
+    lane finished first.  ``fence()`` fences **all** lanes — every
+    synchronous-emit path (breaker degradation, Record path, shutdown
+    drain) keeps its ordering barrier across the whole lane set.
+
+Metrics: ``inflight_depth`` gauge (total in-flight across lanes),
+``lane_depth`` (deepest lane) and per-lane ``lane{i}_depth`` gauges,
+``overlap_stall_seconds``, ``dispatch_seconds`` (submit-side
+pack+dispatch, recorded by the handler), ``fetch_seconds``
+(fetch-behind stage wall), and ``encode_route_device`` /
+``encode_route_host`` batch counters (per-lane seconds/row ride as
+``lane{i}_route_*_spr`` gauges).
 """
 
 from __future__ import annotations
@@ -83,11 +99,12 @@ class InflightWindow:
     """
 
     def __init__(self, depth: int, pop_fn: Callable, name: str = "tpu",
-                 supervisor=None):
+                 supervisor=None, gauge: str = "inflight_depth"):
         self.depth = max(0, int(depth))
         self._pop_fn = pop_fn
         self._name = name
         self._supervisor = supervisor
+        self._gauge = gauge
         self._lock = threading.Lock()
         self._nonfull = threading.Condition(self._lock)
         self._nonempty = threading.Condition(self._lock)
@@ -97,7 +114,7 @@ class InflightWindow:
         self._pending_exc: Optional[BaseException] = None
         self._closed = False
         self._thread: Optional[threading.Thread] = None
-        _metrics.init_gauge("inflight_depth", 0)
+        _metrics.init_gauge(gauge, 0)
 
     # -- ingest side -------------------------------------------------------
     def submit(self, entry) -> None:
@@ -114,7 +131,7 @@ class InflightWindow:
                 self._nonfull.wait(timeout=0.5)
                 self._raise_pending_locked()
             self._queue.append(entry)
-            _metrics.set_gauge("inflight_depth",
+            _metrics.set_gauge(self._gauge,
                                len(self._queue) + (1 if self._popping else 0))
             self._nonempty.notify()
         stalled = time.perf_counter() - t0
@@ -179,7 +196,7 @@ class InflightWindow:
                     return
                 entry = self._queue.popleft()
                 self._popping = True
-                _metrics.set_gauge("inflight_depth", len(self._queue) + 1)
+                _metrics.set_gauge(self._gauge, len(self._queue) + 1)
                 self._nonfull.notify()
             t0 = time.perf_counter()
             try:
@@ -196,10 +213,212 @@ class InflightWindow:
                 if exc is not None and self._pending_exc is None:
                     self._pending_exc = exc
                 self._popping = False
-                _metrics.set_gauge("inflight_depth", len(self._queue))
+                _metrics.set_gauge(self._gauge, len(self._queue))
                 self._nonfull.notify()
                 if not self._queue:
                     self._idle.notify_all()
+
+
+class _Sequencer:
+    """FIFO ticket turnstile: emits happen in ticket order.
+
+    ``ticket()`` hands out monotonically increasing tickets at submit
+    time; a lane that finished its fetch+encode calls ``wait_turn(t)``
+    before emitting and ``done(t)`` after (or instead, when it failed
+    and has nothing to emit — ``done`` alone releases the turnstile so
+    one failed batch can never wedge the lanes behind it).  ``done`` is
+    idempotent and order-independent: completed tickets park in a set
+    and the cursor advances over every contiguous finished ticket."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._issued = 0
+        self._next = 0
+        self._finished = set()
+
+    def ticket(self) -> int:
+        with self._lock:
+            t = self._issued
+            self._issued += 1
+            return t
+
+    def wait_turn(self, ticket: int) -> None:
+        with self._lock:
+            while self._next != ticket:
+                self._cond.wait(timeout=0.5)
+
+    def done(self, ticket: int) -> None:
+        with self._lock:
+            if ticket < self._next:
+                return  # already advanced past (idempotent)
+            self._finished.add(ticket)
+            while self._next in self._finished:
+                self._finished.discard(self._next)
+                self._next += 1
+            self._cond.notify_all()
+
+
+class LaneSet:
+    """N per-device dispatch lanes behind one FIFO sequencer.
+
+    Each lane is an ``InflightWindow`` (own fetcher thread, own
+    submit-ahead ``depth``); ``submit`` assigns a global ticket and
+    round-robins entries across lanes, so device decode / D2H / host
+    encode for several batches run concurrently on several devices while
+    the sequencer still emits blocks in strict submit order.
+
+    Pop contract (different from ``InflightWindow``'s!): ``pop_fn(
+    payload, lane)`` runs concurrently on the lane fetcher threads and
+    must return either ``None`` or a zero-argument *emit closure*; the
+    LaneSet runs that closure under the sequencer turnstile.  An
+    exception out of ``pop_fn`` keeps the InflightWindow ferry contract
+    (stashed, re-raised on the ingest thread at the lane's next
+    ``submit``/``fence``) and releases the failed ticket so later
+    batches still drain in order.
+
+    ``lanes=1`` is byte-for-byte the PR 4 single-window executor (the
+    turnstile is always open for the only in-order lane)."""
+
+    def __init__(self, depth: int, pop_fn: Callable, lanes: int = 1,
+                 name: str = "tpu", supervisor=None):
+        self.lanes = max(1, int(lanes))
+        self.depth = max(0, int(depth))
+        self._pop_fn = pop_fn
+        self._seq = _Sequencer()
+        self._rr = 0
+        self._submit_lock = threading.Lock()
+        multi = self.lanes > 1
+        self._windows = [
+            InflightWindow(
+                depth, self._lane_pop, name=f"{name}-lane{i}" if multi
+                else name, supervisor=supervisor,
+                gauge=f"lane{i}_depth" if multi else "inflight_depth")
+            for i in range(self.lanes)
+        ]
+        if multi:
+            _metrics.init_gauge("inflight_depth", 0)
+            _metrics.init_gauge("lane_depth", 0)
+
+    # -- ingest side -------------------------------------------------------
+    def next_lane(self) -> int:
+        """Reserve the next round-robin lane index (callers that need
+        the lane's device *before* building the submit payload)."""
+        with self._submit_lock:
+            lane = self._rr
+            self._rr = (self._rr + 1) % self.lanes
+            return lane
+
+    def submit(self, lane: int, payload) -> None:
+        """Ticket + enqueue one batch on ``lane``; blocks while that
+        lane's window is full (backpressure), re-raising any ferried
+        fetcher exception.  Tickets are issued in call order under one
+        lock, so emission order is exactly submission order."""
+        with self._submit_lock:
+            ticket = self._seq.ticket()
+            try:
+                self._windows[lane % self.lanes].submit(
+                    (ticket, lane, payload))
+            except BaseException:
+                # the window refused the entry (ferried fetcher
+                # exception re-raised, depth-0 inline pop failed):
+                # release the ticket or the sequencer wedges every
+                # later batch behind a turn that can never come
+                self._seq.done(ticket)
+                raise
+        self._update_depth_gauges()
+
+    def fence(self) -> None:
+        """Fence every lane (and therefore the sequencer: an empty lane
+        set has run every emit closure).  All lanes are fenced even when
+        one re-raises a ferried exception — the first exception
+        propagates after the others have drained, so a synchronous emit
+        after a throwing fence still cannot overtake in-flight work."""
+        pending_exc = None
+        for w in self._windows:
+            try:
+                w.fence()
+            except BaseException as e:  # noqa: BLE001 - ferried, re-raised below
+                if pending_exc is None:
+                    pending_exc = e
+        self._update_depth_gauges()
+        if pending_exc is not None:
+            raise pending_exc
+
+    def pending(self) -> int:
+        return sum(w.pending() for w in self._windows)
+
+    def close(self) -> None:
+        for w in self._windows:
+            w.close()
+
+    def _update_depth_gauges(self) -> None:
+        if self.lanes <= 1:
+            return  # the single window owns inflight_depth itself
+        depths = [w.pending() for w in self._windows]
+        _metrics.set_gauge("inflight_depth", sum(depths))
+        _metrics.set_gauge("lane_depth", max(depths))
+
+    # -- lane fetcher side -------------------------------------------------
+    def _lane_pop(self, entry) -> None:
+        """Runs on a lane's fetcher thread: compute (concurrent), then
+        emit under the sequencer turnstile (strict submit order)."""
+        ticket, lane, payload = entry
+        try:
+            emit = self._pop_fn(payload, lane)
+            self._seq.wait_turn(ticket)
+            if emit is not None:
+                emit()
+        finally:
+            # always release the turnstile — a failed batch (ferried
+            # fail-fast exception) must not wedge the lanes behind it
+            self._seq.done(ticket)
+
+
+def resolve_lanes(config, mesh_mode: str = "auto"):
+    """Resolve ``input.tpu_lanes`` to (lane_count, per-lane devices).
+
+    Default ("auto", same precedent as ``input.tpu_mesh``): one lane per
+    local device when more than one *real* accelerator is visible, else
+    1 — so CPU test meshes and single-chip hosts keep the PR 4
+    single-window executor.  An explicit integer engages anywhere
+    (tests/benches set ``tpu_lanes = 2`` on the forced-host CPU mesh);
+    more lanes than devices cycle over them (extra lanes still overlap
+    host encode).  Lane dispatch and the sharded decode mesh are
+    mutually exclusive — lanes give each chip its *own* batches (no
+    cross-chip sync on the hot path), the mesh shards one batch across
+    chips — so ``tpu_lanes > 1`` with ``tpu_mesh = "on"`` is a config
+    error, and auto-resolved lanes > 1 disable the mesh.  Multi-host:
+    lanes span only this host's chips (``jax.local_devices()``), like
+    the mesh's dp axis — each host lane-dispatches its own stream.
+
+    Lane 0 of a single-lane set stays on the default device (``None``)
+    so the resolved setup is identical to the pre-lane executor."""
+    from ..config import ConfigError
+
+    req = config.lookup_int(
+        "input.tpu_lanes",
+        "input.tpu_lanes must be an integer (device lanes)", None)
+    if req is not None and req < 1:
+        raise ConfigError("input.tpu_lanes must be >= 1")
+    if req is not None and req > 1 and mesh_mode == "on":
+        raise ConfigError(
+            'input.tpu_lanes > 1 and input.tpu_mesh = "on" are mutually '
+            "exclusive (lanes give each chip its own batches; the mesh "
+            "shards one batch across chips)")
+    if req == 1:
+        return 1, [None]
+    import jax
+
+    if req is None:
+        if mesh_mode == "on" or jax.default_backend() == "cpu":
+            return 1, [None]
+        devs = list(jax.local_devices())
+        if len(devs) <= 1:
+            return 1, [None]
+        return len(devs), devs
+    devs = list(jax.local_devices())
+    return req, [devs[i % len(devs)] for i in range(req)]
 
 
 class RouteEconomics:
@@ -217,11 +436,15 @@ class RouteEconomics:
     def __init__(self, enabled: bool = True,
                  probe_every: int = DEFAULT_PROBE_EVERY,
                  margin: float = ECON_MARGIN,
-                 ok_spr: float = DEVICE_OK_SPR):
+                 ok_spr: float = DEVICE_OK_SPR,
+                 label: Optional[str] = None):
         self.enabled = enabled
         self.probe_every = max(2, int(probe_every))
         self.margin = margin
         self.ok_spr = ok_spr
+        # label ("lane0", ...) exports this tracker's EWMAs as gauges —
+        # per-lane economics so one sick chip degrades alone, visibly
+        self.label = label
         self._lock = threading.Lock()
         self._spr = {"device": None, "host": None}  # EWMA seconds/row
         self._batches = 0
@@ -251,9 +474,11 @@ class RouteEconomics:
         spr = seconds / rows
         with self._lock:
             prev = self._spr[path]
-            self._spr[path] = spr if prev is None else (
-                prev + ECON_ALPHA * (spr - prev))
+            ewma = spr if prev is None else prev + ECON_ALPHA * (spr - prev)
+            self._spr[path] = ewma
         _metrics.inc(f"encode_route_{path}")
+        if self.label is not None:
+            _metrics.set_gauge(f"{self.label}_route_{path}_spr", ewma)
 
     def snapshot(self) -> dict:
         with self._lock:
@@ -262,7 +487,8 @@ class RouteEconomics:
                     "batches": self._batches}
 
     @classmethod
-    def from_config(cls, config) -> "RouteEconomics":
+    def from_config(cls, config, label: Optional[str] = None
+                    ) -> "RouteEconomics":
         enabled = config.lookup_bool(
             "input.tpu_encode_economics",
             "input.tpu_encode_economics must be a boolean", True)
@@ -270,7 +496,7 @@ class RouteEconomics:
             "input.tpu_encode_probe_every",
             "input.tpu_encode_probe_every must be an integer (batches)",
             DEFAULT_PROBE_EVERY)
-        return cls(enabled=enabled, probe_every=probe_every)
+        return cls(enabled=enabled, probe_every=probe_every, label=label)
 
 
 def inflight_depth_from_config(config) -> int:

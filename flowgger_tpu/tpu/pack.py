@@ -4,17 +4,38 @@ The arena replaces the reference's per-line ``Vec<u8>`` channel payloads
 (mod.rs:461-468): lines live in one contiguous chunk described by
 offset/length vectors; the dense pack is a native threaded memcpy
 (flowgger_tpu/native.py) with a vectorized numpy fallback.  Shapes are
-bucketed to powers of two to bound XLA recompilations.
+bucketed to bound XLA recompilations: by default every power of two,
+or — with ``input.tpu_shape_buckets`` configured — a small geometric
+grid (``configure_shape_buckets``) so steady-state traffic hits a
+handful of compiled shapes instead of one per pow2 (simdjson's lesson:
+the parallel-decode win evaporates when per-input setup cost isn't
+amortized; each fresh (rows, max_len) shape is a fresh XLA compile).
+Padding rows have length 0 and fall outside ``n_real``, so bucket
+choice never changes emitted bytes.  Every packed shape is recorded in
+the ``distinct_compiled_shapes`` gauge — the number to watch when a
+varied-length stream is compile-thrashing.
 """
 
 from __future__ import annotations
 
-from typing import List, Tuple
+import threading
+from typing import List, Optional, Tuple
 
 import numpy as np
 
 _MIN_ROWS = 256
 _MIN_BYTES = 1 << 14
+
+# row-bucket grid (sorted tuple) set by configure_shape_buckets; None =
+# legacy every-power-of-two bucketing.  Module-wide like _PACK_THREADS:
+# only an explicit config key touches it (BatchHandler guards), so a
+# default-configured handler never resets another handler's grid.
+_SHAPE_BUCKETS: Optional[Tuple[int, ...]] = None
+
+# every (rows, max_len) shape this process has packed — the gauge that
+# proves (or disproves) shape-bucket amortization
+_shapes_seen: set = set()
+_shapes_lock = threading.Lock()
 
 # thread-sliced pack (``input.pack_threads``): the dense pack is a pure
 # bytes→ndarray scatter with no cross-row state, so rows slice evenly
@@ -34,6 +55,63 @@ def _next_pow2(n: int) -> int:
     while p < n:
         p <<= 1
     return p
+
+
+def shape_bucket_grid(n_buckets: int, cap_rows: int) -> Tuple[int, ...]:
+    """A geometric grid of ``n_buckets`` row counts from ``_MIN_ROWS``
+    up to (the next power of two covering) ``cap_rows``, each rounded up
+    to a power of two and deduplicated — so a grid request can yield
+    fewer, never more, distinct shapes."""
+    top = _next_pow2(max(int(cap_rows), _MIN_ROWS))
+    if n_buckets <= 1 or top <= _MIN_ROWS:
+        return (top,)
+    ratio = (top / _MIN_ROWS) ** (1.0 / (n_buckets - 1))
+    vals = {top}
+    for i in range(n_buckets):
+        vals.add(min(top, _next_pow2(int(round(_MIN_ROWS * ratio ** i)))))
+    return tuple(sorted(vals))
+
+
+def configure_shape_buckets(grid) -> None:
+    """Install the row-bucket grid (an iterable of row counts), or
+    ``None`` to restore legacy every-power-of-two bucketing."""
+    global _SHAPE_BUCKETS
+    _SHAPE_BUCKETS = (tuple(sorted({int(g) for g in grid}))
+                      if grid else None)
+
+
+def active_bucket_grid() -> Optional[Tuple[int, ...]]:
+    return _SHAPE_BUCKETS
+
+
+def bucket_rows(n: int) -> int:
+    """Padded row count for ``n`` real rows: the smallest grid bucket
+    that fits, or (legacy / beyond the grid top) the next power of two.
+    Rows above the top can happen — a flush dispatches *all* pending
+    lines, which can exceed ``tpu_batch_size`` when a large region
+    arrives at once — and must still pack rather than truncate."""
+    n = max(int(n), 1)
+    if _SHAPE_BUCKETS:
+        for b in _SHAPE_BUCKETS:
+            if b >= n:
+                return b
+    return max(_MIN_ROWS, _next_pow2(n))
+
+
+def shapes_seen() -> set:
+    """Copy of every (rows, max_len) shape packed so far (tests diff
+    this around a stream to bound compile churn)."""
+    with _shapes_lock:
+        return set(_shapes_seen)
+
+
+def _note_shape(rows: int, max_len: int) -> None:
+    with _shapes_lock:
+        _shapes_seen.add((rows, max_len))
+        count = len(_shapes_seen)
+    from ..utils.metrics import registry as _metrics
+
+    _metrics.set_gauge("distinct_compiled_shapes", count)
 
 
 def _split_np(chunk: bytes, strip_cr: bool = True, sep: int = 10
@@ -104,7 +182,8 @@ def _pack_dense(chunk: bytes, starts: np.ndarray, lens: np.ndarray,
 
 def _finish(chunk: bytes, starts: np.ndarray, lens: np.ndarray, n: int,
             max_len: int):
-    np_rows = max(_MIN_ROWS, _next_pow2(max(n, 1)))
+    np_rows = bucket_rows(n)
+    _note_shape(np_rows, max_len)
     batch, lens_p = _pack_dense(chunk, starts, lens, max_len, np_rows)
     starts_p = np.zeros(np_rows, dtype=np.int32)
     starts_p[:n] = starts
@@ -155,10 +234,11 @@ def pack_spans_2d(chunks: List[bytes], span_sets: List[Tuple[np.ndarray, np.ndar
 
 def subset_packed(packed, idx: np.ndarray):
     """Row-subset of a packed tuple (auto-detect partitioning): rows
-    re-bucketed to a power of two so kernel shapes stay cached."""
+    re-bucketed through the same grid so kernel shapes stay cached."""
     batch, lens, chunk, starts, orig_lens, _n = packed
     m = int(idx.size)
-    rows = max(_MIN_ROWS, _next_pow2(max(m, 1)))
+    rows = bucket_rows(m)
+    _note_shape(rows, batch.shape[1])
     b2 = np.zeros((rows, batch.shape[1]), dtype=np.uint8)
     l2 = np.zeros(rows, dtype=np.int32)
     s2 = np.zeros(rows, dtype=np.int32)

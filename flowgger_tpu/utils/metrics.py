@@ -19,6 +19,16 @@ Overlap executor stages report as cumulative seconds
 (``dispatch_seconds`` submit-side pack+dispatch, ``fetch_seconds``
 fetch-behind wall, ``overlap_stall_seconds`` window backpressure) plus
 the ``inflight_depth`` gauge — see tpu/overlap.py.
+
+Lane dispatch / compile stability (tpu/overlap.py LaneSet,
+tpu/device_common.py cache+prewarm, tpu/pack.py bucketing):
+``lane_depth`` (deepest lane) and per-lane ``lane{i}_depth`` gauges,
+``lane{i}_rows`` counters, per-lane ``lane{i}_route_{device,host}_spr``
+EWMA gauges, ``distinct_compiled_shapes`` gauge (every (rows, max_len)
+shape packed so far), and the ``compile_cache_hits`` /
+``compile_cache_misses`` / ``prewarmed_shapes`` counters — a second
+cold process of an identical config with ``input.tpu_compile_cache_dir``
+set should report zero misses.
 """
 
 from __future__ import annotations
@@ -42,6 +52,9 @@ _COUNTERS = (
     # overlap executor (tpu/overlap.py): D2H bytes the compaction +
     # constant-elision path avoided, and encode-route economics picks
     "fetch_bytes_saved", "encode_route_device", "encode_route_host",
+    # compile stability (tpu/device_common.py): persistent-cache
+    # traffic and startup kernel prewarm progress
+    "compile_cache_hits", "compile_cache_misses", "prewarmed_shapes",
 )
 
 

@@ -1,0 +1,628 @@
+"""Multi-device lane dispatch + shape bucketing + compile cache/prewarm.
+
+Lane ordering/fencing tests run on any device count (two lanes on one
+device still exercise the round-robin, the FIFO sequencer, and the
+fence-all paths); the genuinely multi-device placement checks skip on a
+single-device backend.  ci.sh additionally runs this module under
+``XLA_FLAGS=--xla_force_host_platform_device_count=2``.
+"""
+
+import queue
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from flowgger_tpu.config import Config, ConfigError
+from flowgger_tpu.tpu.overlap import LaneSet, resolve_lanes
+from flowgger_tpu.utils import faultinject
+from flowgger_tpu.utils.metrics import registry
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    registry.reset()
+    faultinject.reset()
+    yield
+    faultinject.reset()
+
+
+# ---------------------------------------------------------------------------
+# LaneSet: FIFO sequencing across lanes
+# ---------------------------------------------------------------------------
+
+def test_laneset_emits_in_submit_order_across_lanes():
+    """Lanes compute concurrently with wildly skewed latencies; the
+    sequencer must still run every emit closure in submit order."""
+    done = []
+
+    def pop(item, lane):
+        time.sleep(0.004 if item % 3 == 0 else 0.0)
+        return lambda: done.append(item)
+
+    ls = LaneSet(2, pop, lanes=3)
+    for i in range(30):
+        ls.submit(ls.next_lane(), i)
+    ls.fence()
+    assert done == list(range(30))
+    ls.close()
+
+
+def test_laneset_single_lane_matches_window_contract():
+    done = []
+    ls = LaneSet(2, lambda item, lane: (lambda: done.append(item)), lanes=1)
+    for i in range(12):
+        ls.submit(ls.next_lane(), i)
+    ls.fence()
+    assert done == list(range(12))
+    assert ls.pending() == 0
+    ls.close()
+
+
+def test_laneset_none_emit_is_allowed():
+    seen = []
+
+    def pop(item, lane):
+        seen.append(item)
+        return None  # nothing to emit; ticket must still release
+
+    ls = LaneSet(2, pop, lanes=2)
+    for i in range(8):
+        ls.submit(ls.next_lane(), i)
+    ls.fence()
+    assert sorted(seen) == list(range(8))
+    ls.close()
+
+
+def test_laneset_pop_exception_releases_sequencer_and_ferries():
+    """A fail-fast pop (breaker disabled contract) must not wedge the
+    lanes behind it: its ticket releases, later batches emit in order,
+    and the exception surfaces on the ingest thread at that lane's next
+    submit/fence (the InflightWindow ferry contract).  The pops hold on
+    a gate until every batch is submitted, so the ferry target here is
+    deterministically the fence."""
+    done = []
+    gate = threading.Event()
+
+    def pop(item, lane):
+        gate.wait(5.0)
+        if item == 3:
+            raise RuntimeError("device died")
+        return lambda: done.append(item)
+
+    ls = LaneSet(4, pop, lanes=2)
+    for i in range(8):
+        ls.submit(ls.next_lane(), i)
+    gate.set()
+    with pytest.raises(RuntimeError, match="device died"):
+        ls.fence()
+    ls.fence()  # consumed; lane set stays usable
+    assert done == [0, 1, 2, 4, 5, 6, 7]
+    ls.submit(ls.next_lane(), 9)
+    ls.fence()
+    assert done[-1] == 9
+    ls.close()
+
+
+def test_laneset_ferried_submit_raise_releases_ticket():
+    """A submit that re-raises a ferried exception issued a ticket the
+    window never queued — that ticket must release or the sequencer
+    wedges every later batch behind a turn that can never come."""
+    done = []
+
+    def pop(item, lane):
+        if item == "boom":
+            raise RuntimeError("boom")
+        return lambda: done.append(item)
+
+    ls = LaneSet(2, pop, lanes=1)
+    ls.submit(0, "boom")
+    deadline = time.time() + 5
+    while ls.pending() and time.time() < deadline:
+        time.sleep(0.01)
+    with pytest.raises(RuntimeError, match="boom"):
+        ls.submit(0, "a")  # ferry re-raised; "a" never queued
+    ls.submit(0, "b")
+    ls.submit(0, "c")
+    ls.fence()
+    assert done == ["b", "c"]
+    ls.close()
+
+
+def test_emit_failure_degrades_to_scalar_at_position():
+    """An exception during the sequenced emit (sink hiccup) with the
+    breaker armed must re-decode the batch through the scalar oracle at
+    its position — not ferry to the ingest thread and lose the lines."""
+    from flowgger_tpu.decoders.rfc5424 import RFC5424Decoder
+    from flowgger_tpu.encoders.passthrough import PassthroughEncoder
+    from flowgger_tpu.mergers import LineMerger
+    from flowgger_tpu.outputs import stream_bytes
+    from flowgger_tpu.tpu.batch import BatchHandler
+
+    class FlakyQueue(queue.Queue):
+        fails = 1
+
+        def put(self, item, *a, **k):
+            if self.fails:
+                self.fails -= 1
+                raise RuntimeError("sink hiccup")
+            super().put(item, *a, **k)
+
+    cfg = Config.from_string(
+        "[input]\ntpu_batch_size = 5\ntpu_inflight = 2\ntpu_lanes = 2\n")
+    tx = FlakyQueue()
+    merger = LineMerger()
+    handler = BatchHandler(tx, RFC5424Decoder(cfg), PassthroughEncoder(cfg),
+                           cfg, fmt="rfc5424", start_timer=False,
+                           merger=merger)
+    valid = [ln for ln in LINES if ln != b"not a valid syslog line at all"]
+    for _ in range(6):
+        handler.ingest_chunk(b"".join(ln + b"\n" for ln in valid))
+    handler.flush()  # must not raise: the emit failure degrades
+    handler.close()
+    out = b""
+    while not tx.empty():
+        data, _ = stream_bytes(tx.get_nowait(), merger)
+        out += data
+    # the failed block re-emitted through the scalar oracle: every line
+    # still present exactly once, in order
+    assert out == b"".join(ln + b"\n" for ln in valid) * 6
+    assert registry.get("device_decode_errors") >= 1
+
+
+def test_laneset_fence_fences_all_lanes():
+    gates = [threading.Event(), threading.Event()]
+    done = []
+
+    def pop(item, lane):
+        gates[lane].wait(5.0)
+        return lambda: done.append(item)
+
+    ls = LaneSet(2, pop, lanes=2)
+    ls.submit(0, "a")
+    ls.submit(1, "b")
+    t = threading.Thread(target=ls.fence)
+    t.start()
+    time.sleep(0.05)
+    assert t.is_alive()          # both lanes still in flight
+    gates[0].set()
+    time.sleep(0.05)
+    assert t.is_alive()          # lane 1 still holds the fence
+    gates[1].set()
+    t.join(timeout=5)
+    assert not t.is_alive() and done == ["a", "b"]
+    ls.close()
+
+
+def test_laneset_depth_gauges():
+    ls = LaneSet(2, lambda item, lane: None, lanes=2)
+    for i in range(8):
+        ls.submit(ls.next_lane(), i)
+    ls.fence()
+    snap = registry.snapshot()
+    assert snap.get("lane0_depth") == 0 and snap.get("lane1_depth") == 0
+    assert snap.get("lane_depth") == 0 and snap.get("inflight_depth") == 0
+    ls.close()
+
+
+# ---------------------------------------------------------------------------
+# lane resolution (config -> lanes, devices)
+# ---------------------------------------------------------------------------
+
+def test_resolve_lanes_auto_is_single_on_cpu():
+    lanes, devs = resolve_lanes(Config.from_string(""), "auto")
+    assert lanes == 1 and devs == [None]
+
+
+def test_resolve_lanes_explicit_engages_on_cpu():
+    import jax
+
+    lanes, devs = resolve_lanes(
+        Config.from_string("[input]\ntpu_lanes = 2\n"), "auto")
+    assert lanes == 2 and len(devs) == 2
+    # more lanes than devices cycle over them
+    n = len(jax.local_devices())
+    lanes, devs = resolve_lanes(
+        Config.from_string(f"[input]\ntpu_lanes = {n + 1}\n"), "off")
+    assert lanes == n + 1 and devs[n] == devs[0]
+
+
+def test_resolve_lanes_validation():
+    with pytest.raises(ConfigError):
+        resolve_lanes(Config.from_string("[input]\ntpu_lanes = 0\n"))
+    with pytest.raises(ConfigError):
+        resolve_lanes(Config.from_string("[input]\ntpu_lanes = 2\n"), "on")
+    # explicit single lane never conflicts with the mesh
+    assert resolve_lanes(
+        Config.from_string("[input]\ntpu_lanes = 1\n"), "on") == (1, [None])
+
+
+def test_handler_config_validation():
+    from flowgger_tpu.decoders.rfc5424 import RFC5424Decoder
+    from flowgger_tpu.encoders.passthrough import PassthroughEncoder
+    from flowgger_tpu.mergers import LineMerger
+    from flowgger_tpu.tpu.batch import BatchHandler
+
+    for bad in ("tpu_lanes = -2\n", "tpu_shape_buckets = 0\n",
+                'tpu_lanes = 2\ntpu_mesh = "on"\n'):
+        cfg = Config.from_string("[input]\n" + bad)
+        with pytest.raises(ConfigError):
+            BatchHandler(queue.Queue(), RFC5424Decoder(cfg),
+                         PassthroughEncoder(cfg), cfg, fmt="rfc5424",
+                         start_timer=False, merger=LineMerger())
+
+
+# ---------------------------------------------------------------------------
+# BatchHandler across lanes: ordering + byte identity
+# ---------------------------------------------------------------------------
+
+LINES = [
+    b"<23>1 2015-08-05T15:53:45.637824Z host-a app 69 42 - the quick brown fox",
+    b"<165>1 2003-10-11T22:14:15.003Z mymachine evntslog - ID47 "
+    b'[exampleSDID@32473 iut="3" eventSource="App"] BOMAn application event',
+    b"not a valid syslog line at all",
+    b"<13>1 2024-01-01T00:00:00Z h app p m - plain message",
+    b"<13>1 2024-06-01T00:00:00.5Z h2 app2 p m - second message",
+]
+
+
+def _stream_handler(lanes, fault_spec=None, breaker_cfg="", repeats=12,
+                    extra_cfg=""):
+    """Feed repeats x LINES through the rfc5424 block route with the
+    given lane count; returns (drained sink bytes in queue order,
+    handler)."""
+    from flowgger_tpu.decoders.rfc5424 import RFC5424Decoder
+    from flowgger_tpu.encoders.passthrough import PassthroughEncoder
+    from flowgger_tpu.mergers import LineMerger
+    from flowgger_tpu.outputs import stream_bytes
+    from flowgger_tpu.tpu.batch import BatchHandler
+
+    faultinject.reset()
+    if fault_spec:
+        faultinject.configure({"device_decode": fault_spec})
+    cfg = Config.from_string(
+        "[input]\ntpu_batch_size = 5\ntpu_inflight = 2\n"
+        + (f"tpu_lanes = {lanes}\n" if lanes else "")
+        + breaker_cfg + extra_cfg)
+    tx = queue.Queue()
+    merger = LineMerger()
+    handler = BatchHandler(tx, RFC5424Decoder(cfg), PassthroughEncoder(cfg),
+                           cfg, fmt="rfc5424", start_timer=False,
+                           merger=merger)
+    for _ in range(repeats):  # one device batch per cycle
+        handler.ingest_chunk(b"".join(ln + b"\n" for ln in LINES))
+    handler.flush()
+    handler.close()
+    out = b""
+    while not tx.empty():
+        data, _ = stream_bytes(tx.get_nowait(), merger)
+        out += data
+    return out, handler
+
+
+def test_two_lane_stream_matches_single_lane_bytes_and_order():
+    single, _ = _stream_handler(lanes=None)
+    double, handler = _stream_handler(lanes=2)
+    assert double == single and single.count(b"\n") >= 48
+    assert handler._window.pending() == 0
+    # both lanes actually carried traffic
+    snap = registry.snapshot()
+    assert snap.get("lane0_rows", 0) > 0 and snap.get("lane1_rows", 0) > 0
+
+
+def test_three_lanes_on_fewer_devices_still_byte_identical():
+    single, _ = _stream_handler(lanes=None)
+    tripled, _ = _stream_handler(lanes=3)
+    assert tripled == single
+
+
+@pytest.mark.faults
+def test_device_fault_mid_stream_keeps_order_and_bytes_across_lanes():
+    """A device killed mid-stream on one lane must leave the merger
+    output byte-identical: the failed batch re-decodes through the
+    scalar oracle at its sequenced position while other lanes' batches
+    stay put."""
+    clean, _ = _stream_handler(lanes=2)
+    registry.reset()
+    faulty, _ = _stream_handler(
+        lanes=2, fault_spec="every:3",
+        breaker_cfg="tpu_breaker_failures = 3\n"
+                    "tpu_breaker_cooldown_ms = 1\n")
+    assert faulty == clean
+    assert registry.get("device_decode_errors") >= 2
+
+
+@pytest.mark.faults
+def test_breaker_trip_fences_all_lanes_before_scalar_batches():
+    """When the breaker opens mid-stream, later batches take the
+    ingest-side scalar path — which must fence EVERY lane first so a
+    still-in-flight batch on any lane cannot be overtaken."""
+    from flowgger_tpu.tpu.breaker import OPEN
+
+    clean, _ = _stream_handler(lanes=2)
+    registry.reset()
+    faulty, handler = _stream_handler(
+        lanes=2, fault_spec="first:6",
+        breaker_cfg="tpu_breaker_failures = 2\n"
+                    "tpu_breaker_cooldown_ms = 3600000\n")
+    assert faulty == clean
+    assert handler._breaker.state == OPEN
+    assert registry.get("breaker_trips") == 1
+
+
+def test_drain_flush_fences_all_lanes():
+    """flush(drain=True) + close (the pipeline._drain / SIGTERM path)
+    must leave nothing in flight on any lane and the full stream on the
+    queue."""
+    out, handler = _stream_handler(lanes=3, repeats=8)
+    assert handler._window.pending() == 0
+    assert out.count(b"\n") == 8 * 4  # 4 valid lines per cycle
+
+
+def test_multi_device_lanes_place_batches_on_distinct_devices():
+    import jax
+
+    if jax.local_device_count() < 2:
+        pytest.skip("needs >= 2 devices")
+    from flowgger_tpu.tpu import pack
+    from flowgger_tpu.tpu.batch import block_submit
+
+    lines = [b"<13>1 2024-01-01T00:00:00Z h a p m - hello %d" % i
+             for i in range(64)]
+    packed = pack.pack_lines_2d(lines, 128)
+    devs = jax.local_devices()[:2]
+    handles = [block_submit("rfc5424", packed, device=d) for d in devs]
+    for h, d in zip(handles, devs):
+        placed = h[5] if len(h) > 5 else h[1]  # batch_dev on the handle
+        assert list(placed.devices()) == [d]
+
+
+# ---------------------------------------------------------------------------
+# shape bucketing: byte identity + bounded compile shapes
+# ---------------------------------------------------------------------------
+
+def _varied_lines(rng, n):
+    out = []
+    for i in range(n):
+        msg = "x" * rng.randrange(1, 120)
+        out.append(
+            (f"<13>1 2024-03-0{1 + i % 9}T0{i % 9}:00:0{i % 9}Z h{i} app "
+             f"{i} m - {msg}").encode())
+    return out
+
+
+@pytest.mark.parametrize("framing", ["line", "nul", "syslen"])
+def test_bucketed_pad_byte_identical_to_exact_pad(framing):
+    """Bucketed row padding must not change emitted bytes for any
+    merger framing — padding rows are masked (differential vs the
+    scalar-oracle-backed single-config stream)."""
+    import random
+
+    from flowgger_tpu.decoders.rfc5424 import RFC5424Decoder
+    from flowgger_tpu.encoders.rfc5424 import RFC5424Encoder
+    from flowgger_tpu.mergers import LineMerger, NulMerger, SyslenMerger
+    from flowgger_tpu.outputs import stream_bytes
+    from flowgger_tpu.tpu import pack
+    from flowgger_tpu.tpu.batch import BatchHandler
+
+    merger_cls = {"line": LineMerger, "nul": NulMerger,
+                  "syslen": SyslenMerger}[framing]
+
+    def run(buckets):
+        cfg = Config.from_string(
+            "[input]\ntpu_batch_size = 64\ntpu_max_line_len = 256\n"
+            + (f"tpu_shape_buckets = {buckets}\n" if buckets else ""))
+        tx = queue.Queue()
+        merger = merger_cls()
+        h = BatchHandler(tx, RFC5424Decoder(cfg), RFC5424Encoder(cfg), cfg,
+                         fmt="rfc5424", start_timer=False, merger=merger)
+        rng = random.Random(7)
+        for size in (3, 64, 17, 120, 64, 5):
+            h.ingest_chunk(b"".join(
+                ln + b"\n" for ln in _varied_lines(rng, size)))
+        h.flush()
+        h.close()
+        out = b""
+        while not tx.empty():
+            data, _ = stream_bytes(tx.get_nowait(), merger)
+            out += data
+        return out
+
+    try:
+        exact = run(None)          # legacy pow2 buckets
+        bucketed = run(2)          # coarse 2-bucket grid
+    finally:
+        pack.configure_shape_buckets(None)
+    assert bucketed == exact and len(exact) > 0
+
+
+def test_varied_stream_stays_within_bucket_grid():
+    """50 varied-length batches through a K-bucket grid must compile at
+    most K distinct (rows, max_len) shapes (the distinct_compiled_shapes
+    gauge tracks the process-wide set; diff it around the stream)."""
+    import random
+
+    from flowgger_tpu.decoders.rfc5424 import RFC5424Decoder
+    from flowgger_tpu.encoders.passthrough import PassthroughEncoder
+    from flowgger_tpu.mergers import LineMerger
+    from flowgger_tpu.tpu import pack
+    from flowgger_tpu.tpu.batch import BatchHandler
+
+    # max_len 256 shares the [*, 256] decode compiles with the framing
+    # tests above; batch 512 keeps the 50-batch stream cheap
+    cfg = Config.from_string(
+        "[input]\ntpu_batch_size = 512\ntpu_max_line_len = 256\n"
+        "tpu_shape_buckets = 2\n")
+    tx = queue.Queue()
+    try:
+        h = BatchHandler(tx, RFC5424Decoder(cfg), PassthroughEncoder(cfg),
+                         cfg, fmt="rfc5424", start_timer=False,
+                         merger=LineMerger())
+        grid = pack.active_bucket_grid()
+        assert grid is not None and len(grid) <= 2
+        rng = random.Random(11)
+        before = pack.shapes_seen()
+        for _ in range(50):
+            n = rng.randrange(1, 512)
+            h.ingest_chunk(b"".join(
+                ln + b"\n" for ln in _varied_lines(rng, n)))
+            h.flush()
+        h.close()
+        new = {s for s in pack.shapes_seen() - before if s[1] == 256}
+        assert 0 < len(new) <= len(grid)
+        assert all(rows in grid for rows, _ in new)
+        assert registry.get_gauge("distinct_compiled_shapes") >= len(new)
+    finally:
+        pack.configure_shape_buckets(None)
+
+
+def test_bucket_grid_shapes():
+    from flowgger_tpu.tpu import pack
+
+    assert pack.shape_bucket_grid(3, 16384) == (256, 2048, 16384)
+    assert pack.shape_bucket_grid(1, 5000) == (8192,)
+    grid = pack.shape_bucket_grid(4, 8192)
+    assert grid[0] == 256 and grid[-1] == 8192 and len(grid) <= 4
+    try:
+        pack.configure_shape_buckets((256, 2048))
+        assert pack.bucket_rows(1) == 256
+        assert pack.bucket_rows(256) == 256
+        assert pack.bucket_rows(257) == 2048
+        # beyond the grid top: fall back to pow2 rather than truncate
+        assert pack.bucket_rows(5000) == 8192
+    finally:
+        pack.configure_shape_buckets(None)
+
+
+# ---------------------------------------------------------------------------
+# prewarm + persistent compile cache
+# ---------------------------------------------------------------------------
+
+def test_prewarm_compiles_bucket_grid(tmp_path, monkeypatch):
+    """Prewarm (device-encode killed: this container can't compile those
+    kernels) must land one warm decode per bucket shape and count it."""
+    monkeypatch.setenv("FLOWGGER_DEVICE_ENCODE", "0")
+    from flowgger_tpu.encoders.passthrough import PassthroughEncoder
+    from flowgger_tpu.mergers import LineMerger
+    from flowgger_tpu.tpu.device_common import prewarm_kernels
+
+    t = prewarm_kernels(
+        "rfc5424", 64, [256, 512],
+        encoder=PassthroughEncoder(Config.from_string("")),
+        merger=LineMerger())
+    t.join(timeout=180)
+    assert not t.is_alive()
+    assert registry.get("prewarmed_shapes") == 2
+
+
+def test_handler_prewarms_when_cache_dir_set(tmp_path, monkeypatch):
+    """input.tpu_compile_cache_dir implies prewarm-by-default; the cache
+    dir is created and populated, and cache monitoring counts traffic."""
+    monkeypatch.setenv("FLOWGGER_DEVICE_ENCODE", "0")
+    import os
+
+    import jax
+
+    from flowgger_tpu.decoders.rfc5424 import RFC5424Decoder
+    from flowgger_tpu.encoders.passthrough import PassthroughEncoder
+    from flowgger_tpu.mergers import LineMerger
+    from flowgger_tpu.tpu.batch import BatchHandler
+
+    cache = tmp_path / "xla-cache"
+    # max_len 96 is unique to this test: the prewarm must pay a FRESH
+    # compile (an in-process jit-cache hit would persist nothing)
+    cfg = Config.from_string(
+        "[input]\ntpu_batch_size = 64\ntpu_max_line_len = 96\n"
+        f'tpu_compile_cache_dir = "{cache}"\n')
+    tx = queue.Queue()
+    old = {k: getattr(jax.config, k)
+           for k in ("jax_compilation_cache_dir",
+                     "jax_persistent_cache_min_compile_time_secs",
+                     "jax_persistent_cache_min_entry_size_bytes")}
+    try:
+        h = BatchHandler(tx, RFC5424Decoder(cfg), PassthroughEncoder(cfg),
+                         cfg, fmt="rfc5424", start_timer=False,
+                         merger=LineMerger())
+        # the prewarm worker compiles decode directly on its own thread
+        # (never queued behind a stuck encode compile), so this is just
+        # one small [256, 96] compile away
+        deadline = time.time() + 90
+        while (registry.get("prewarmed_shapes") < 1
+               and time.time() < deadline):
+            time.sleep(0.1)
+        h.close()
+        assert registry.get("prewarmed_shapes") >= 1
+        assert cache.is_dir() and len(os.listdir(cache)) > 0
+        assert (registry.get("compile_cache_hits")
+                + registry.get("compile_cache_misses")) > 0
+    finally:
+        # un-point the process-global cache config from the tmp dir
+        # (pytest deletes it) so the rest of the suite doesn't pay
+        # serialize+write — or hit ENOENT — on every later compile
+        for k, v in old.items():
+            jax.config.update(k, v)
+        from jax._src import compilation_cache as _cc
+
+        _cc.reset_cache()
+
+
+@pytest.mark.slow
+def test_second_cold_process_hits_cache_with_zero_misses(tmp_path):
+    """ISSUE acceptance: with input.tpu_compile_cache_dir set, a second
+    cold process of the same config performs 0 fresh top-level kernel
+    compiles — every compile request is a cache hit."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    cache = tmp_path / "xla-cache"
+    script = r"""
+import json, os, queue, sys
+from flowgger_tpu.config import Config
+from flowgger_tpu.decoders.rfc5424 import RFC5424Decoder
+from flowgger_tpu.encoders.passthrough import PassthroughEncoder
+from flowgger_tpu.mergers import LineMerger
+from flowgger_tpu.outputs import stream_bytes
+from flowgger_tpu.tpu.batch import BatchHandler
+from flowgger_tpu.utils.metrics import registry
+
+cfg = Config.from_string(
+    "[input]\ntpu_batch_size = 64\ntpu_max_line_len = 64\n"
+    "tpu_shape_buckets = 1\n"
+    'tpu_compile_cache_dir = "CACHEDIR"\n'
+    'tpu_prewarm = false\n')
+tx = queue.Queue()
+merger = LineMerger()
+h = BatchHandler(tx, RFC5424Decoder(cfg), PassthroughEncoder(cfg), cfg,
+                 fmt="rfc5424", start_timer=False, merger=merger)
+h.ingest_chunk(b"".join(
+    b"<13>1 2024-01-01T00:00:00Z h a p m - msg %d\n" % i
+    for i in range(50)))
+h.flush(); h.close()
+out = b""
+while not tx.empty():
+    data, _ = stream_bytes(tx.get_nowait(), merger)
+    out += data
+print(json.dumps({"hits": registry.get("compile_cache_hits"),
+                  "misses": registry.get("compile_cache_misses"),
+                  "shapes": registry.get_gauge("distinct_compiled_shapes"),
+                  "lines": out.count(b"\n")}))
+""".replace("CACHEDIR", str(cache).replace("\\", "/"))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "FLOWGGER_DEVICE_ENCODE": "0"}
+
+    def run_once():
+        r = subprocess.run([sys.executable, "-c", script], env=env,
+                           capture_output=True, text=True, timeout=600)
+        assert r.returncode == 0, r.stderr
+        return json.loads(r.stdout.strip().splitlines()[-1])
+
+    first = run_once()
+    second = run_once()
+    assert first["lines"] == second["lines"] == 50
+    assert first["misses"] > 0           # cold: populated the cache
+    assert second["misses"] == 0         # warm: zero fresh compiles
+    assert second["hits"] > 0
+    assert second["shapes"] == 1         # one bucket -> one shape
