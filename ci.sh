@@ -28,6 +28,9 @@ echo "== lane-dispatch suite (forced 2-device CPU) =="
 XLA_FLAGS=--xla_force_host_platform_device_count=2 JAX_PLATFORMS=cpu \
   python -m pytest tests/test_lanes.py -q -m "not faults"
 
+echo "== multi-tenant serving suite (admission, fair queue, templates) =="
+JAX_PLATFORMS=cpu python -m pytest tests/test_tenancy.py -q -m "not faults"
+
 echo "== fault-injection suite (robustness degradation paths) =="
 JAX_PLATFORMS=cpu python -m pytest tests/ -q -m "faults and not slow"
 
@@ -41,6 +44,7 @@ make -C native -s tsan-check
 echo "== config lint =="
 python -m flowgger_tpu --check flowgger.toml
 python -m flowgger_tpu --check examples/multihost-dp.toml
+python -m flowgger_tpu --check examples/tenants.toml
 
 echo "== bench smoke (CPU backend, bounded) =="
 JAX_PLATFORMS=cpu FLOWGGER_BENCH_SMOKE=1 timeout 600 python bench.py

@@ -10,11 +10,32 @@ TPU batch handler own per-connection batch arenas the same way.
 
 from __future__ import annotations
 
+import inspect
+
+
+def make_handler(handler_factory, peer=None):
+    """Build one connection's handler, passing the transport's source
+    identity (peer IP, file path) when the factory accepts it — the
+    tenancy layer resolves ``peer`` to a tenant for admission.  Plain
+    zero-arg factories (tests, embedded pipelines) keep working."""
+    if peer is None:
+        return handler_factory()
+    try:
+        params = inspect.signature(handler_factory).parameters
+    except (TypeError, ValueError):
+        return handler_factory()
+    if "peer" in params or any(
+            p.kind is inspect.Parameter.VAR_KEYWORD for p in params.values()):
+        return handler_factory(peer=peer)
+    return handler_factory()
+
 
 class Input:
     def accept(self, handler_factory) -> None:
         """Run the transport forever (blocking).  ``handler_factory()``
-        returns a fresh ``splitters.Handler`` per connection/worker."""
+        returns a fresh ``splitters.Handler`` per connection/worker;
+        transports that know their peer build handlers through
+        ``make_handler(handler_factory, peer)`` instead."""
         raise NotImplementedError
 
 

@@ -101,8 +101,12 @@ class FileInput(Input):
         workers = {}
 
         def start_worker(path: str, from_tail: bool):
-            worker = FileWorker(path, handler_factory(), from_tail,
-                                self.use_inotify)
+            from . import make_handler
+
+            # the path is the source identity: [tenants.*] peers entries
+            # may name watched files, not just addresses
+            worker = FileWorker(path, make_handler(handler_factory, path),
+                                from_tail, self.use_inotify)
             t = threading.Thread(target=worker.run, daemon=True,
                                  name=f"file-worker-{path}")
             t.start()

@@ -80,13 +80,16 @@ class TcpInput(Input):
                 return
             client.settimeout(self.timeout)
             print(f"Connection over TCP from [{peer[0]}:{peer[1]}]")
-            threading.Thread(target=self._handle_client, args=(client,),
-                             daemon=True).start()
+            threading.Thread(target=self._handle_client,
+                             args=(client, peer[0]), daemon=True).start()
 
-    def _handle_client(self, client: socket.socket):
+    def _handle_client(self, client: socket.socket, peer_ip=None):
+        from . import make_handler
+
         splitter = get_splitter(self.framing)
         try:
-            splitter.run(SocketStream(client), self._handler_factory())
+            splitter.run(SocketStream(client),
+                         make_handler(self._handler_factory, peer_ip))
         finally:
             try:
                 client.close()
@@ -110,10 +113,13 @@ class TcpCoInput(TcpInput):
         timeout = self.timeout
 
         async def handle(reader: "asyncio.StreamReader", writer):
+            from . import make_handler
+
             peer = writer.get_extra_info("peername")
             if peer:
                 print(f"Connection over TCP from [{peer[0]}:{peer[1]}]")
-            handler = handler_factory()
+            handler = make_handler(handler_factory,
+                                   peer[0] if peer else None)
             splitter = get_splitter(framing)
             stream = _AsyncBridgeStream(reader, timeout)
             # splitters are synchronous; run each connection's split loop

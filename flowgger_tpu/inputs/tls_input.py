@@ -122,10 +122,10 @@ class TlsInput(Input):
                 return
             client.settimeout(self.timeout)
             print(f"Connection over TLS from [{peer[0]}:{peer[1]}]")
-            threading.Thread(target=self._handle_client, args=(client,),
-                             daemon=True).start()
+            threading.Thread(target=self._handle_client,
+                             args=(client, peer[0]), daemon=True).start()
 
-    def _handle_client(self, client: socket.socket):
+    def _handle_client(self, client: socket.socket, peer_ip=None):
         try:
             tls_sock = self.ctx.wrap_socket(client, server_side=True)
         except (ssl.SSLError, OSError) as e:
@@ -135,9 +135,12 @@ class TlsInput(Input):
             except OSError:  # flowcheck: disable=FC04 -- handshake already logged; close is best-effort
                 pass
             return
+        from . import make_handler
+
         splitter = get_splitter(self.framing)
         try:
-            splitter.run(SocketStream(tls_sock), self._handler_factory())
+            splitter.run(SocketStream(tls_sock),
+                         make_handler(self._handler_factory, peer_ip))
         finally:
             try:
                 tls_sock.close()
@@ -159,10 +162,13 @@ class TlsCoInput(TlsInput):
         ctx = self.ctx
 
         async def handle(reader, writer):
+            from . import make_handler
+
             peer = writer.get_extra_info("peername")
             if peer:
                 print(f"Connection over TLS from [{peer[0]}:{peer[1]}]")
-            handler = handler_factory()
+            handler = make_handler(handler_factory,
+                                   peer[0] if peer else None)
             splitter = get_splitter(framing)
             stream = _AsyncBridgeStream(reader, timeout)
             loop = asyncio.get_running_loop()

@@ -85,6 +85,10 @@ class UdpInput(Input):
             from ..utils import recvmmsg as _rm
 
             if _rm.available():
+                # NOTE (tenancy): the recvmmsg fast path aggregates many
+                # sources per syscall, so admission is listener-level —
+                # the whole socket charges the default tenant.  The
+                # per-datagram loop below resolves tenants per source IP.
                 if self._accept_batched(sock, handler):
                     return  # socket closed: normal exit
                 # the syscall exists but doesn't work (sandboxed/old
@@ -94,16 +98,30 @@ class UdpInput(Input):
                       "per-datagram recvfrom", file=sys.stderr)
         import errno
 
+        from . import make_handler
+
+        # per-source handlers so [tenants.*] peers match UDP senders;
+        # bounded cache (spoofed-source floods must not grow it forever)
+        per_src: dict = {}
         while True:
             try:
-                data, _src = sock.recvfrom(MAX_UDP_PACKET_SIZE)
+                data, src = sock.recvfrom(MAX_UDP_PACKET_SIZE)
             except OSError as e:
                 # a closed socket must end the loop (so the pipeline can
                 # drain), not busy-spin on EBADF forever
                 if e.errno == errno.EBADF or sock.fileno() < 0:
                     return
                 continue
-            handle_record_maybe_compressed(data, handler)
+            h = handler
+            if src:
+                h = per_src.get(src[0])
+                if h is None:
+                    if len(per_src) >= 1024:
+                        per_src.clear()
+                    h = make_handler(handler_factory, src[0])
+                    h.bare_errors = True
+                    per_src[src[0]] = h
+            handle_record_maybe_compressed(data, h)
 
     @staticmethod
     def _accept_batched(sock, handler) -> bool:

@@ -207,10 +207,32 @@ class Pipeline:
             raise ConfigError(
                 'input.queue_policy must be "block", "drop_newest" or '
                 '"drop_oldest"')
-        self.tx: "queue.Queue[Optional[bytes]]" = PolicyQueue(
-            maxsize=queue_size, policy=queue_policy)
+        # multi-tenant serving: a configured [tenants] table (or a
+        # tenant.default_* rate) builds the tenant registry, swaps the
+        # single bounded queue for the weighted-fair multi-queue, and
+        # makes handler_factory wrap every connection in token-bucket
+        # admission.  Unconfigured -> None, and the pipeline builds the
+        # exact pre-tenancy objects below (zero added overhead)
+        from .tenancy.registry import TenantRegistry
+
+        self.tenants = TenantRegistry.from_config(
+            config, fallback_policy=queue_policy)
+        if self.tenants is not None:
+            from .tenancy.fairqueue import WeightedFairQueue
+
+            self.tx: "queue.Queue[Optional[bytes]]" = WeightedFairQueue(
+                maxsize=queue_size, registry=self.tenants)
+        else:
+            self.tx = PolicyQueue(maxsize=queue_size, policy=queue_policy)
         self.input_format = input_format
         self.config = config
+        # template mining for scalar pipelines (the batch handler owns
+        # its own miner set; building both would double-count)
+        self._scalar_miners = None
+        if input_format not in _TPU_FORMATS:
+            from .tenancy.templates import TemplateMinerSet
+
+            self._scalar_miners = TemplateMinerSet.from_config(config)
         self._handlers: list = []
         import threading
 
@@ -237,7 +259,19 @@ class Pipeline:
 
             setup_compile_cache(config)
 
-    def handler_factory(self):
+    def handler_factory(self, peer=None):
+        """Per-connection handler.  ``peer`` is the transport's source
+        identity (peer IP for tcp/tls, the path for file inputs, None
+        for peerless transports) — with tenancy configured it selects
+        the tenant whose admission buckets the connection charges."""
+        handler = self._base_handler()
+        if self.tenants is not None:
+            from .tenancy.admission import AdmissionHandler
+
+            return AdmissionHandler(handler, self.tenants.resolve(peer))
+        return handler
+
+    def _base_handler(self):
         if self.input_format in _TPU_FORMATS:
             # ONE batch handler shared by every connection thread: the
             # reference's per-connection decode state is per-line and
@@ -263,10 +297,35 @@ class Pipeline:
                 )
                 self._handlers.append(handler)
                 return handler
+        # ScalarHandlers are stateless (no buffered batch, flush is a
+        # no-op) so they are NOT tracked for drain — tracking every
+        # per-connection (and, for UDP tenancy, per-source) handler
+        # would grow _handlers unboundedly in a long-lived process
         handler = ScalarHandler(self.tx, self.decoder, self.encoder)
-        with self._handler_lock:
-            self._handlers.append(handler)
+        handler.record_hook = self._scalar_record_hook()
         return handler
+
+    def _scalar_record_hook(self):
+        """Template mining/enrichment for scalar (non-*_tpu) pipelines:
+        the batch handler wires its own miners (tpu/batch.py); without
+        this, ``tenant.templates = "on"`` on a scalar pipeline would
+        silently mine nothing."""
+        if self._scalar_miners is None:
+            return None
+        from .encoders import GelfEncoder
+        from .tenancy.templates import make_gelf_enricher
+
+        if self._scalar_miners.enrich and type(self.encoder) is GelfEncoder:
+            return make_gelf_enricher(self._scalar_miners)
+        from .tenancy import current_or_default
+
+        miners = self._scalar_miners
+
+        def mine(record, tenant=None):
+            miners.observe_msg(tenant or current_or_default(),
+                               record.msg or "")
+
+        return mine
 
     def start_output(self):
         # sinks spawn their consumer threads through the supervisor so a
@@ -283,6 +342,11 @@ class Pipeline:
         in-flight submit/fetch executor (tpu/overlap.py LaneSet), so
         every batch any lane still holds reaches the queue — in batch
         order — before SHUTDOWN is enqueued."""
+        # from here on, queue sheds also count queue_shed_during_drain:
+        # a drain test can tell shed lines from delivered lines
+        mark = getattr(self.tx, "mark_draining", None)
+        if mark is not None:
+            mark()
         for handler in self._handlers:
             try:
                 handler.flush()

@@ -56,6 +56,11 @@ class ScalarHandler(Handler):
     enqueue; errors go to stderr and drop the message
     (line_splitter.rs:17-54)."""
 
+    # applied to every decoded Record before encode (tenancy template
+    # enrichment keeps the degraded scalar path byte-identical to the
+    # Record route it falls back from); None = zero-cost no-op
+    record_hook = None
+
     def __init__(self, tx, decoder, encoder):
         self.tx = tx
         self.decoder = decoder
@@ -76,6 +81,8 @@ class ScalarHandler(Handler):
         _metrics.inc("input_lines")
         try:
             record = self.decoder.decode(line)
+            if self.record_hook is not None:
+                self.record_hook(record)
             encoded = self.encoder.encode(record)
         except DecodeError as e:
             _metrics.inc("decode_errors")
@@ -99,6 +106,8 @@ class ScalarHandler(Handler):
 
     def handle_record(self, record: Record) -> None:
         try:
+            if self.record_hook is not None:
+                self.record_hook(record)
             encoded = self.encoder.encode(record)
         except EncodeError as e:
             print(e, file=sys.stderr)

@@ -1,0 +1,55 @@
+"""Multi-tenant serving: per-tenant admission, weighted-fair QoS, and
+online template mining over the decoded columns.
+
+A collector fronting many sources cannot let one flooding listener
+degrade everyone: with a single bounded queue, the drop policy sheds
+victims indiscriminately.  This package adds the tenancy layer:
+
+- ``registry``  — tenant specs keyed by source listener/peer (the
+  ``[tenants]`` config table plus ``tenant.default_*`` keys);
+- ``admission`` — per-tenant token-bucket admission (lines/sec and
+  bytes/sec with burst) applied at input-accept, *before* the queue;
+- ``fairqueue`` — per-tenant sub-queues with deficit-round-robin
+  dequeue and noisiest-tenant-first load shedding under global
+  pressure (SHUTDOWN stays unsheddable);
+- ``templates`` — an optional USTEP-style evolving template tree
+  (arxiv 2304.12331) mining message templates from the TPU-decoded
+  columnar batches — the first stage that *consumes* the decoded
+  columns instead of re-serializing them.
+
+Everything here is opt-in: with no ``[tenants]`` table and
+``tenant.templates`` off, the pipeline builds the exact same objects
+it did before this package existed (PolicyQueue, bare handlers) and
+pays zero overhead.
+
+This module itself stays import-light (no config/metrics/JAX): the hot
+path (``tpu/batch.py`` ingest) only needs the thread-local tenant tag
+set by the admission wrapper on each connection thread.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+DEFAULT_TENANT = "default"
+
+_tls = threading.local()
+
+
+def set_current(name: Optional[str]) -> None:
+    """Tag the calling thread with the tenant whose traffic it is
+    carrying (admission wrapper; one connection thread serves one
+    tenant).  ``None`` clears the tag."""
+    _tls.tenant = name
+
+
+def current_name() -> Optional[str]:
+    """The calling thread's tenant tag, or None off a tagged thread
+    (batch fetcher threads, timers, tests)."""
+    return getattr(_tls, "tenant", None)
+
+
+def current_or_default() -> str:
+    name = current_name()
+    return DEFAULT_TENANT if name is None else name

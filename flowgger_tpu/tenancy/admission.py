@@ -1,0 +1,217 @@
+"""Per-tenant token-bucket admission, applied at input-accept.
+
+``AdmissionHandler`` wraps the pipeline's per-connection handler: every
+framed region is charged against the connection's tenant buckets
+(lines/sec and bytes/sec, with burst) *before* it reaches the batch
+arena or the queue.  A tenant over its rate is shed right here — the
+flood never consumes pack/decode/queue capacity, so well-behaved
+tenants keep their exact bytes and ordering (the hard bar: admission
+only ever removes a misbehaving tenant's own input, it never touches
+anyone else's stream or reorders what it admits).
+
+Admission granularity is the splitter's delivery unit: per line on the
+scalar path, per complete-line region on the chunked fast path, per
+span set on the syslen path — all-or-nothing per call, so the decision
+costs one bucket check regardless of region size and can never split a
+region (which would re-frame another tenant's carry).  Size bursts
+accordingly (a region is at most one socket read, <= 64 KiB).
+
+The ``tenant_flood`` fault site makes admission checks of *rate-limited*
+tenants deterministically deny (unlimited tenants never check the site,
+so a chaos plan targets exactly the tenants a test marks with a finite
+rate).
+
+Metrics per tenant: ``tenant_{name}_lines`` / ``_bytes`` (admitted),
+``_drops`` (admission denials, lines), and the ``tenant_{name}_state``
+gauge (0 admitting, 1 throttled, 2 queue-shed) — plus the aggregate
+``tenant_lines/bytes/drops`` counters.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+from typing import Optional
+
+from ..splitters import Handler
+from ..utils import faultinject as _faults
+from ..utils.metrics import registry as _metrics
+from . import set_current
+from .registry import TenantSpec
+
+# tenant_state gauge values
+STATE_OK = 0
+STATE_THROTTLED = 1
+STATE_SHED = 2
+
+
+class TokenBucket:
+    """Monotonic-clock token bucket; ``rate <= 0`` = unlimited."""
+
+    def __init__(self, rate: float, burst: float, clock=None):
+        self.rate = float(rate)
+        self.burst = float(burst) if burst > 0 else float(rate)
+        self._clock = clock or time.monotonic
+        self._tokens = self.burst
+        self._last = self._clock()
+        self._lock = threading.Lock()
+
+    def try_take(self, n: float) -> bool:
+        if self.rate <= 0:
+            return True
+        with self._lock:
+            now = self._clock()
+            if now > self._last:
+                self._tokens = min(self.burst,
+                                   self._tokens + (now - self._last) * self.rate)
+                self._last = now
+            if self._tokens >= n:
+                self._tokens -= n
+                return True
+            return False
+
+
+class TenantState:
+    """One tenant's shared admission/QoS state: every connection of the
+    tenant charges the same bucket pair; the fair queue reads the spec's
+    weight/policy through here too."""
+
+    def __init__(self, spec: TenantSpec, clock=None):
+        self.spec = spec
+        self.name = spec.name
+        self.lines_bucket = TokenBucket(spec.rate, spec.burst, clock)
+        self.bytes_bucket = TokenBucket(spec.byte_rate, spec.byte_burst, clock)
+        self._m_lines = f"tenant_{spec.name}_lines"
+        self._m_bytes = f"tenant_{spec.name}_bytes"
+        self._m_drops = f"tenant_{spec.name}_drops"
+        self._m_shed = f"tenant_{spec.name}_shed"
+        self._m_state = f"tenant_{spec.name}_state"
+        self._last_notice = 0.0
+        self._gauge_state = STATE_OK
+        _metrics.init_gauge(self._m_state, STATE_OK)
+
+    def admit(self, lines: int, nbytes: int) -> bool:
+        """Charge one delivery unit; False = shed it (already counted)."""
+        denied = (self.spec.limited and _faults.enabled()
+                  and _faults.fire("tenant_flood"))
+        if not denied:
+            # charge lines first: a lines-denied unit must not drain the
+            # byte bucket (and vice versa matters less — byte flood with
+            # few lines is the rarer shape; one-sided drain is bounded)
+            if not self.lines_bucket.try_take(lines):
+                denied = True
+            elif not self.bytes_bucket.try_take(nbytes):
+                denied = True
+        if not denied:
+            _metrics.inc(self._m_lines, lines)
+            _metrics.inc(self._m_bytes, nbytes)
+            _metrics.inc("tenant_lines", lines)
+            _metrics.inc("tenant_bytes", nbytes)
+            self._set_state(STATE_OK)
+            return True
+        _metrics.inc(self._m_drops, lines)
+        _metrics.inc("tenant_drops", lines)
+        self._set_state(STATE_THROTTLED)
+        now = time.monotonic()
+        if now - self._last_notice >= 5.0:
+            # rate-limited notice: a sustained flood must not turn
+            # stderr into a second flood
+            self._last_notice = now
+            print(f"tenant [{self.name}] over admission rate; shedding "
+                  f"(tenant_{self.name}_drops counts lines)",
+                  file=sys.stderr)
+        return False
+
+    def _set_state(self, state: int) -> None:
+        # gauge write only on transitions: the steady state costs one
+        # attribute compare per delivery unit, not a registry lock
+        if self._gauge_state != state:
+            self._gauge_state = state
+            _metrics.set_gauge(self._m_state, state)
+
+    def count_shed(self, lines: int = 1) -> None:
+        """A queued item of this tenant was load-shed under global
+        pressure (fairqueue calls this)."""
+        _metrics.inc(self._m_shed, lines)
+        _metrics.inc("tenant_shed", lines)
+        self._set_state(STATE_SHED)
+
+
+class AdmissionHandler(Handler):
+    """Per-connection wrapper: tags the connection thread with its
+    tenant, charges admission, forwards admitted input to the shared
+    inner handler.  Exposes ``ingest_chunk``/``ingest_spans`` only when
+    the inner handler does, so splitter fast-path dispatch (hasattr
+    checks) is unchanged."""
+
+    def __init__(self, inner: Handler, tenant: TenantState):
+        self._inner = inner
+        self._tenant = tenant
+        if hasattr(inner, "ingest_chunk"):
+            self.ingest_chunk = self._ingest_chunk
+        if hasattr(inner, "ingest_spans"):
+            self.ingest_spans = self._ingest_spans
+
+    # splitters configure these ON the handler they receive; forward to
+    # the shared inner handler where the batch/error paths read them
+    @property
+    def quiet_empty(self):
+        return self._inner.quiet_empty
+
+    @quiet_empty.setter
+    def quiet_empty(self, v):
+        self._inner.quiet_empty = v
+
+    @property
+    def bare_errors(self):
+        return self._inner.bare_errors
+
+    @bare_errors.setter
+    def bare_errors(self, v):
+        self._inner.bare_errors = v
+
+    @property
+    def ingest_sep(self):
+        return self._inner.ingest_sep
+
+    @ingest_sep.setter
+    def ingest_sep(self, v):
+        self._inner.ingest_sep = v
+
+    @property
+    def ingest_strip_cr(self):
+        return self._inner.ingest_strip_cr
+
+    @ingest_strip_cr.setter
+    def ingest_strip_cr(self, v):
+        self._inner.ingest_strip_cr = v
+
+    def handle_bytes(self, raw: bytes) -> None:
+        if self._tenant.admit(1, len(raw)):
+            set_current(self._tenant.name)
+            self._inner.handle_bytes(raw)
+
+    def _ingest_chunk(self, region: bytes) -> None:
+        n = region.count(self._inner.ingest_sep)
+        if self._tenant.admit(n, len(region)):
+            set_current(self._tenant.name)
+            self._inner.ingest_chunk(region)
+
+    def _ingest_spans(self, chunk: bytes, starts, lens) -> None:
+        if self._tenant.admit(len(starts), int(lens.sum())):
+            set_current(self._tenant.name)
+            self._inner.ingest_spans(chunk, starts, lens)
+
+    def handle_record(self, record) -> None:
+        if self._tenant.admit(1, 0):
+            set_current(self._tenant.name)
+            self._inner.handle_record(record)
+
+    def flush(self) -> None:
+        self._inner.flush()
+
+    def close(self) -> None:
+        close = getattr(self._inner, "close", None)
+        if close is not None:
+            close()

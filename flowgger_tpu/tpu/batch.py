@@ -24,6 +24,7 @@ from ..config import Config
 from ..encoders import EncodeError
 from ..splitters import Handler, ScalarHandler
 from ..record import Record
+from .. import tenancy as _tenancy
 from ..utils import faultinject as _faults
 from ..utils.metrics import registry as _metrics
 
@@ -90,6 +91,36 @@ class BatchHandler(Handler):
         self._span_chunks: List[bytes] = []  # syslen regions + frame spans
         self._span_sets: List = []
         self._span_count = 0
+        # online template mining (tenancy/templates.py): None unless
+        # tenant.templates = "on" — the off path tracks nothing and the
+        # only residue is `is None` checks
+        from ..tenancy.templates import TemplateMinerSet
+
+        self._miners = TemplateMinerSet.from_config(cfg)
+        # per-ingest (tenant, line-count) runs, parallel to the pending
+        # chunk/span/line arenas, so rows attribute to the tenant whose
+        # connection delivered them (ingestion order is pack order) for
+        # mining AND for the fair queue's lane choice on Record-route
+        # emits; tracked while mining or while the ingest thread
+        # carries a tenant tag (tenancy enabled)
+        self._chunk_runs: List = []
+        self._span_runs: List = []
+        self._line_runs: List = []
+        # template-ID enrichment rides the Record route (per-row JSON
+        # fields don't fit the constant-segment block encoders), GELF
+        # output only
+        self._enrich_hook = None
+        if self._miners is not None and self._miners.enrich:
+            from ..encoders.gelf import GelfEncoder as _Gelf
+            from ..tenancy.templates import make_gelf_enricher
+
+            if type(encoder) is _Gelf:
+                self._enrich_hook = make_gelf_enricher(self._miners)
+                self.scalar.record_hook = self._enrich_hook
+        # block routes with mined span channels pin the host encode path
+        # (the miner consumes the fetched decode columns)
+        self._mine_block = (self._miners is not None
+                            and fmt in ("rfc5424", "rfc3164", "ltsv"))
         self._lock = threading.Lock()
         # serializes batch decodes so a timer flush racing a size flush
         # cannot reorder output
@@ -256,9 +287,14 @@ class BatchHandler(Handler):
         per-message Python objects; native code does the framing at
         flush (the separator rides ``ingest_sep``, set by the splitter).
         """
+        tag = _tenancy.current_name()
         with self._lock:
             self._chunks.append(region)
-            self._chunk_lines += region.count(self.ingest_sep)
+            n = region.count(self.ingest_sep)
+            self._chunk_lines += n
+            if self._miners is not None or tag is not None:
+                self._chunk_runs.append(
+                    (tag or _tenancy.DEFAULT_TENANT, n))
             full = self._pending_locked() >= self.batch_size
             if not full and self._timer is None and self._start_timer:
                 self._timer = threading.Timer(self.flush_ms / 1000.0, self.flush)
@@ -271,10 +307,14 @@ class BatchHandler(Handler):
         """Fast path fed by SyslenSplitter: a region plus pre-scanned
         frame offset/length arrays — zero per-message Python for the
         reference's ``framed=true`` mode."""
+        tag = _tenancy.current_name()
         with self._lock:
             self._span_chunks.append(chunk)
             self._span_sets.append((starts, lens))
             self._span_count += len(starts)
+            if self._miners is not None or tag is not None:
+                self._span_runs.append(
+                    (tag or _tenancy.DEFAULT_TENANT, len(starts)))
             full = self._pending_locked() >= self.batch_size
             if not full and self._timer is None and self._start_timer:
                 self._timer = threading.Timer(self.flush_ms / 1000.0, self.flush)
@@ -287,8 +327,15 @@ class BatchHandler(Handler):
         return self._chunk_lines + self._span_count + len(self._lines)
 
     def handle_bytes(self, raw: bytes) -> None:
+        tag = _tenancy.current_name()
         with self._lock:
             self._lines.append(raw)
+            if self._miners is not None or tag is not None:
+                tenant = tag or _tenancy.DEFAULT_TENANT
+                if self._line_runs and self._line_runs[-1][0] == tenant:
+                    self._line_runs[-1][1] += 1
+                else:
+                    self._line_runs.append([tenant, 1])
             full = self._pending_locked() >= self.batch_size
             if not full and self._timer is None and self._start_timer:
                 self._timer = threading.Timer(self.flush_ms / 1000.0, self.flush)
@@ -314,6 +361,9 @@ class BatchHandler(Handler):
             spans = (self._span_chunks, self._span_sets)
             self._span_chunks, self._span_sets = [], []
             self._span_count = 0
+            chunk_runs, self._chunk_runs = self._chunk_runs, []
+            span_runs, self._span_runs = self._span_runs, []
+            line_runs, self._line_runs = self._line_runs, []
             if self._timer is not None:
                 self._timer.cancel()
                 self._timer = None
@@ -323,11 +373,11 @@ class BatchHandler(Handler):
             t0 = _time.perf_counter()
             n0 = _metrics.get("input_lines")
             if chunks:
-                self._decode_chunks(chunks)
+                self._decode_chunks(chunks, chunk_runs or None)
             if spans[0]:
-                self._decode_spans(*spans)
+                self._decode_spans(*spans, runs=span_runs or None)
             if lines:
-                self._decode_batch(lines)
+                self._decode_batch(lines, line_runs or None)
             _metrics.add_seconds("dispatch_seconds",
                                  _time.perf_counter() - t0)
             if drain:
@@ -402,7 +452,7 @@ class BatchHandler(Handler):
 
         return LTSVDecoder(config)
 
-    def _decode_chunks(self, chunks: List[bytes]) -> None:
+    def _decode_chunks(self, chunks: List[bytes], runs=None) -> None:
         from . import pack
 
         region = b"".join(chunks)
@@ -416,9 +466,9 @@ class BatchHandler(Handler):
             return
         self._guarded_dispatch(pack.pack_region_2d(
             region, self.max_len, sep=sep[0],
-            strip_cr=self.ingest_strip_cr))
+            strip_cr=self.ingest_strip_cr), runs)
 
-    def _decode_spans(self, span_chunks, span_sets) -> None:
+    def _decode_spans(self, span_chunks, span_sets, runs=None) -> None:
         from . import pack
 
         if self._kernel_fn is None or not self._device_allowed():
@@ -428,27 +478,28 @@ class BatchHandler(Handler):
                     self._scalar_handle(chunk[s:s + ln])
             return
         self._guarded_dispatch(pack.pack_spans_2d(span_chunks, span_sets,
-                                                  self.max_len))
+                                                  self.max_len), runs)
 
-    def _dispatch_packed(self, packed, deferred=None) -> None:
+    def _dispatch_packed(self, packed, deferred=None, runs=None) -> None:
         """Route one packed tuple through the right decode/encode tier.
         ``deferred`` (single-element list) is set True when the batch
         was submitted to the in-flight window instead of emitted
         synchronously."""
         if self._fast_encode:
-            self._emit_fast(packed, deferred)
+            self._emit_fast(packed, deferred, runs)
             return
         if self.fmt == "auto":
             from .autodetect import decode_auto_packed
 
             self._window.fence()
             self._emit(decode_auto_packed(packed, self.max_len,
-                                          self._auto_ltsv))
+                                          self._auto_ltsv), runs)
             return
         self._window.fence()
-        self._emit(_decode_packed(self.fmt, packed, self.scalar.decoder))
+        self._emit(_decode_packed(self.fmt, packed, self.scalar.decoder),
+                   runs)
 
-    def _decode_batch(self, lines: List[bytes]) -> None:
+    def _decode_batch(self, lines: List[bytes], runs=None) -> None:
         if self._kernel_fn is None or not self._device_allowed():
             # no columnar kernel (or breaker open): scalar per line
             self._window.fence()
@@ -461,11 +512,11 @@ class BatchHandler(Handler):
                 from . import pack
 
                 packed = pack.pack_lines_2d(lines, self.max_len)
-                self._emit_fast(packed)
+                self._emit_fast(packed, runs=runs)
             else:
                 results = self._kernel_fn(lines)
                 self._window.fence()
-                self._emit(results)
+                self._emit(results, runs)
         except Exception as e:  # noqa: BLE001 - device degradation boundary
             if self._breaker is None:
                 raise
@@ -491,14 +542,14 @@ class BatchHandler(Handler):
         if self._breaker is not None and self._window.pending() == 0:
             self._breaker.record_success()
 
-    def _guarded_dispatch(self, packed) -> None:
+    def _guarded_dispatch(self, packed, runs=None) -> None:
         """Route one packed tuple to the device tier, degrading to the
         scalar oracle (same bytes, no lines lost) on any device/XLA
         error when the breaker is armed."""
         deferred = [False]
         try:
             _faults.maybe_raise("device_decode")
-            self._dispatch_packed(packed, deferred)
+            self._dispatch_packed(packed, deferred, runs)
         except Exception as e:  # noqa: BLE001 - device degradation boundary
             if self._breaker is None:
                 raise
@@ -553,6 +604,7 @@ class BatchHandler(Handler):
 
                 decoder = RFC3164Decoder(self._cfg)
             handler = ScalarHandler(self.tx, decoder, self.encoder)
+            handler.record_hook = self.scalar.record_hook
             self._auto_scalars[cls] = handler
         return handler
 
@@ -579,6 +631,10 @@ class BatchHandler(Handler):
         an inapplicable route never pays a wasted device decode."""
         if not self._block_mode or self.fmt not in ("rfc5424", "rfc3164",
                                                      "ltsv", "gelf", "auto"):
+            return False
+        if self._enrich_hook is not None:
+            # per-row _template_id fields don't fit the constant-segment
+            # block encoders: enrichment rides the Record path
             return False
         from ..encoders.gelf import GelfEncoder
         from ..encoders.ltsv import LTSVEncoder
@@ -659,6 +715,9 @@ class BatchHandler(Handler):
         key whose removal would still leave the route disabled."""
         if self._block_route_ok():
             return None
+        if self._enrich_hook is not None:
+            return ("tenant.template_enrich is set (per-record "
+                    "_template_id rides the Record path)")
         from ..encoders.gelf import GelfEncoder
         from ..encoders.passthrough import PassthroughEncoder
         from .block_common import merger_suffix
@@ -702,7 +761,7 @@ class BatchHandler(Handler):
             return "output.syslog_prepend_timestamp is set"
         return no_columnar
 
-    def _emit_fast(self, packed, deferred=None) -> None:
+    def _emit_fast(self, packed, deferred=None, runs=None) -> None:
         """Span→bytes encode for one packed tuple: the columnar block
         route when engaged (submitted onto the next dispatch lane; that
         lane's fetcher thread fetches and encodes behind us, and the
@@ -718,34 +777,39 @@ class BatchHandler(Handler):
                 # the auto merger submits its per-class kernels at fetch
                 # time, on the lane's fetcher thread (default device:
                 # the per-class legs share one jit cache)
-                self._window.submit(lane, (None, packed))
+                self._window.submit(lane, (None, packed, runs))
                 return
             self._window.submit(lane, (block_submit(
                 self.fmt, packed, self._sharded_for(self.fmt),
-                self._lane_devices[lane]), packed))
+                self._lane_devices[lane]), packed, runs))
             return
         from ..encoders.gelf import GelfEncoder
         from ..encoders.passthrough import PassthroughEncoder
 
         self._window.fence()
-        if self.fmt == "rfc5424" and type(self.encoder) in (
-                GelfEncoder, PassthroughEncoder):
+        if (self.fmt == "rfc5424" and self._enrich_hook is None
+                and type(self.encoder) in (GelfEncoder,
+                                           PassthroughEncoder)):
+            # per-row span->bytes encode; with template enrichment on,
+            # fall through to the Record path below so every row gets
+            # its _template_id stamped before encode
             self._emit_encoded(
-                _encode_packed_rfc5424_gelf(packed, self.encoder))
+                _encode_packed_rfc5424_gelf(packed, self.encoder), runs)
             return
         if self.fmt == "auto":
             from .autodetect import decode_auto_packed
 
             self._emit(decode_auto_packed(packed, self.max_len,
-                                          self._auto_ltsv))
+                                          self._auto_ltsv), runs)
             return
-        self._emit(_decode_packed(self.fmt, packed, self.scalar.decoder))
+        self._emit(_decode_packed(self.fmt, packed, self.scalar.decoder),
+                   runs)
 
     def _pop_emit(self, payload, lane: int = 0):
         """Fetch + encode one in-flight entry on a lane fetcher thread
         (concurrent across lanes); returns the emit closure the LaneSet
         sequencer runs in global submit order."""
-        handle, packed = payload
+        handle, packed, runs = payload
         import time as _time
 
         t0 = _time.perf_counter()
@@ -753,7 +817,7 @@ class BatchHandler(Handler):
         econ = self._econs[lane % len(self._econs)]
         try:
             _faults.maybe_raise("device_decode")
-            emit = self._pop_emit_inner(handle, packed, stats, econ)
+            emit = self._pop_emit_inner(handle, packed, stats, econ, runs)
         except Exception as e:  # noqa: BLE001 - device degradation boundary
             if self._breaker is None:
                 raise
@@ -792,7 +856,8 @@ class BatchHandler(Handler):
 
         return finish
 
-    def _pop_emit_inner(self, handle, packed, stats=None, econ=None):
+    def _pop_emit_inner(self, handle, packed, stats=None, econ=None,
+                        runs=None):
         """Fetch + encode one entry; returns a zero-arg emit closure
         (runs later, under the sequencer) so lanes can compute
         concurrently without reordering the merger stream."""
@@ -811,28 +876,47 @@ class BatchHandler(Handler):
             if res is None:
                 results = decode_auto_packed(packed, self.max_len,
                                              self._auto_ltsv)
-                return lambda: self._emit(results)
+                return lambda: self._emit(results, runs)
             # per-leg fetch time is folded into encode_seconds here: the
             # merger interleaves four kernels' fetches with their encodes
             _metrics.add_seconds("encode_seconds",
                                  _time.perf_counter() - t0)
             return lambda: self._emit_block(res, packed[5])
         ltsv_dec = self.scalar.decoder if self.fmt == "ltsv" else None
+        mined: list = []
+        column_tap = None
+        if self._mine_block:
+            # pure span extraction on this (concurrent) fetcher thread;
+            # the observe itself runs inside the sequenced emit closure
+            # below, so template IDs assign in batch order and stay
+            # stable across runs and lane counts
+            column_tap = lambda host_out: mined.append(
+                self._miners.extract_block(self.fmt, packed, host_out))
         res, fetch_s, declined_s = block_fetch_encode(
             self.fmt, handle, packed, self.encoder, self._merger,
             ltsv_dec, self._device_route_state,
-            allow_device=econ.allow_device(), stats=stats)
+            # mining consumes the fetched decode columns: pin the host
+            # block path while it is on (the device-encode tier elides
+            # exactly the channels the miner reads)
+            allow_device=econ.allow_device() and not self._mine_block,
+            stats=stats, column_tap=column_tap)
         if stats is not None:
             stats["declined_s"] = declined_s
         if res is None:
             # the route declined after the fact (e.g. an oversized
             # ltsv_schema or a configured suffix): Record path
             results = _decode_packed(self.fmt, packed, self.scalar.decoder)
-            return lambda: self._emit(results)
+            return lambda: self._emit(results, runs)
         t2 = _time.perf_counter()
         _metrics.add_seconds("device_fetch_seconds", fetch_s)
         _metrics.add_seconds("encode_seconds",
                              t2 - t0 - fetch_s - declined_s)
+        if mined and mined[0] is not None:
+            def emit_mined():
+                self._miners.observe_rows(mined[0], runs)
+                self._emit_block(res, packed[5])
+
+            return emit_mined
         return lambda: self._emit_block(res, packed[5])
 
     def _emit_block(self, res, n_real: int) -> None:
@@ -859,10 +943,19 @@ class BatchHandler(Handler):
             _metrics.inc("enqueued", count)
             self.tx.put(res.block)
 
-    def _emit_encoded(self, results) -> None:
+    def _emit_encoded(self, results, runs=None) -> None:
         """Emit pre-encoded bytes from the span->bytes fast path."""
         _metrics.inc("input_lines", len(results))
-        for res in results:
+        expanded = self._expand_runs(runs, len(results))
+        prev_tag = _tenancy.current_name() if expanded is not None else None
+        try:
+            self._emit_encoded_rows(results, expanded)
+        finally:
+            if expanded is not None:
+                _tenancy.set_current(prev_tag)
+
+    def _emit_encoded_rows(self, results, expanded) -> None:
+        for i, res in enumerate(results):
             if res.encoded is None:
                 if res.error == "__utf8__":
                     _metrics.inc("invalid_utf8")
@@ -878,11 +971,38 @@ class BatchHandler(Handler):
                 continue
             _metrics.inc("decoded_records")
             _metrics.inc("enqueued")
+            if expanded is not None:
+                _tenancy.set_current(expanded[i])
             self.tx.put(res.encoded)
 
-    def _emit(self, results) -> None:
+    def _emit(self, results, runs=None) -> None:
         _metrics.inc("input_lines", len(results))
-        for res in results:
+        # Per-row tenant attribution via the ingest-order runs when they
+        # cover this batch (results are in row order, error rows
+        # included): drives both mining/enrichment AND the fair queue's
+        # lane choice, so a mixed-tenant Record-route batch never lands
+        # wholesale on whichever tenant's thread happened to flush.  A
+        # run mismatch falls back to the emitting thread's tag rather
+        # than smearing rows across tenants non-deterministically.
+        expanded = self._expand_runs(runs, len(results))
+        default_tenant = None
+        if self._miners is not None and expanded is None:
+            default_tenant = _tenancy.current_or_default()
+        prev_tag = _tenancy.current_name() if expanded is not None else None
+        try:
+            self._emit_rows(results, expanded, default_tenant)
+        finally:
+            if expanded is not None:
+                _tenancy.set_current(prev_tag)
+
+    @staticmethod
+    def _expand_runs(runs, n_rows: int):
+        if runs and sum(n for _, n in runs) == n_rows:
+            return [t for t, n in runs for _ in range(n)]
+        return None
+
+    def _emit_rows(self, results, expanded, default_tenant) -> None:
+        for i, res in enumerate(results):
             if res.record is None:
                 if res.error == "__utf8__":
                     _metrics.inc("invalid_utf8")
@@ -896,6 +1016,14 @@ class BatchHandler(Handler):
                     if not (self.quiet_empty and not stripped):
                         print(f"{res.error}: [{stripped}]", file=sys.stderr)
                 continue
+            if self._miners is not None:
+                tenant = expanded[i] if expanded is not None else default_tenant
+                # with enrichment the hook both mines and stamps
+                # _template_id pre-encode
+                if self._enrich_hook is not None:
+                    self._enrich_hook(res.record, tenant)
+                else:
+                    self._miners.observe_msg(tenant, res.record.msg or "")
             try:
                 encoded = self.encoder.encode(res.record)
             except EncodeError as e:
@@ -906,6 +1034,10 @@ class BatchHandler(Handler):
                 continue
             _metrics.inc("decoded_records")
             _metrics.inc("enqueued")
+            if expanded is not None:
+                # lane attribution for the fair queue: the put rides
+                # the row's own tenant tag, not the flusher's
+                _tenancy.set_current(expanded[i])
             self.tx.put(encoded)
 
 
@@ -943,7 +1075,7 @@ def block_submit(fmt, packed, sharded=None, device=None):
 
 def block_fetch_encode(fmt, handle, packed, encoder, merger,
                        ltsv_decoder=None, route_state=None,
-                       allow_device=True, stats=None):
+                       allow_device=True, stats=None, column_tap=None):
     """Block on a submitted kernel and run the format's columnar block
     encoder; returns (BlockResult-or-None, fetch_seconds,
     declined_seconds) — the last is wall time burned by a declined
@@ -952,7 +1084,11 @@ def block_fetch_encode(fmt, handle, packed, encoder, merger,
     ``allow_device=False`` skips the device-encode tier outright (the
     route economics measured the host block path as cheaper on this
     backend); ``stats`` (optional dict) gets ``stats["path"]`` set to
-    ``"device"`` or ``"host"`` for whichever tier produced the block."""
+    ``"device"`` or ``"host"`` for whichever tier produced the block.
+    ``column_tap`` (template mining) is called with the fetched decode
+    channels on the host path — callers that set it pass
+    ``allow_device=False`` so the channels are actually fetched; a tap
+    failure is contained (counted + logged), never a lost batch."""
     import time as _time
 
     t0 = _time.perf_counter()
@@ -988,6 +1124,7 @@ def block_fetch_encode(fmt, handle, packed, encoder, merger,
             t0 = _time.perf_counter()
         host_out = rfc3164.decode_rfc3164_fetch(handle)
         t1 = _time.perf_counter()
+        _tap_columns(column_tap, host_out)
         from ..encoders.capnp import CapnpEncoder
         from ..encoders.ltsv import LTSVEncoder
         from . import encode_capnp_block, encode_ltsv_block
@@ -1026,6 +1163,7 @@ def block_fetch_encode(fmt, handle, packed, encoder, merger,
             t0 = _time.perf_counter()
         host_out = ltsv.decode_ltsv_fetch(handle)
         t1 = _time.perf_counter()
+        _tap_columns(column_tap, host_out)
         from ..encoders.capnp import CapnpEncoder
         from ..encoders.ltsv import LTSVEncoder
         from ..encoders.rfc5424 import RFC5424Encoder
@@ -1113,10 +1251,25 @@ def block_fetch_encode(fmt, handle, packed, encoder, merger,
             t0 = _time.perf_counter()
         host_out = rfc5424.decode_rfc5424_fetch(handle)
         t1 = _time.perf_counter()
+        _tap_columns(column_tap, host_out)
         res = _encode_block_from_host(host_out, packed, encoder, merger)
     if stats is not None and res is not None:
         stats["path"] = "host"
     return res, t1 - t0, declined_s
+
+
+def _tap_columns(column_tap, host_out) -> None:
+    """Run the template-mining column tap over one fetched kernel
+    output; mining is a statistics stage, so a tap failure is counted
+    and logged but never costs the batch."""
+    if column_tap is None:
+        return
+    try:
+        column_tap(host_out)
+    except Exception as e:  # noqa: BLE001 - stats stage, never lose the batch
+        _metrics.inc("template_tap_errors")
+        print(f"template column tap failed ({type(e).__name__}: {e}); "
+              "batch not mined", file=sys.stderr)
 
 
 def _encode_block_from_host(host_out, packed, encoder, merger):

@@ -10,13 +10,26 @@ stalling ingest, so the queue grows a policy:
                  | "drop_newest"  # full queue: discard the incoming item
                  | "drop_oldest"  # full queue: discard the oldest item
 
-Every shed message bumps the ``queue_dropped`` counter.  The SHUTDOWN
-sentinel (``None``) is exempt: it always uses a blocking put and is
-never dropped, so graceful drain survives any policy.
+Every shed message bumps the ``queue_dropped`` counter plus the
+per-cause ``queue_dropped_{policy}`` label, so a graph can tell which
+policy (and, on the tenancy fair queue, which tenant) paid.  Once the
+pipeline enters its drain phase (``mark_draining``, called at SIGTERM/
+EOF before the final flush), sheds additionally count
+``queue_shed_during_drain`` — a drain test can then distinguish shed
+lines from delivered lines instead of inferring loss from a short
+output file.
+
+The SHUTDOWN sentinel (``None``) is exempt: it always uses a blocking
+put and is never dropped, so graceful drain survives any policy.
 
 The ``queue_pressure`` fault-injection site makes a put behave as if the
 queue were full (deterministically, see ``utils.faultinject``), so the
 drop paths are testable without actually wedging a sink.
+
+Multi-tenant pipelines (a configured ``[tenants]`` table) swap this
+class for ``tenancy.fairqueue.WeightedFairQueue`` — per-tenant FIFO
+lanes, weighted-fair dequeue, noisiest-tenant-first shedding — with the
+same queue surface and the same sentinel/drain exemptions.
 """
 
 from __future__ import annotations
@@ -35,6 +48,18 @@ class PolicyQueue(queue.Queue):
             raise ValueError(f"unknown queue policy: {policy}")
         super().__init__(maxsize)
         self.policy = policy
+        self.draining = False
+
+    def mark_draining(self) -> None:
+        """Pipeline drain entered: subsequent sheds also count
+        ``queue_shed_during_drain`` (see module docstring)."""
+        self.draining = True
+
+    def _count_drop(self) -> None:
+        _metrics.inc("queue_dropped")
+        _metrics.inc(f"queue_dropped_{self.policy}")
+        if self.draining:
+            _metrics.inc("queue_shed_during_drain")
 
     def put(self, item, block: bool = True, timeout=None):
         if item is None or self.policy == "block":
@@ -51,7 +76,7 @@ class PolicyQueue(queue.Queue):
                 return super().put(item, block=False)
             except queue.Full:
                 if self.policy == "drop_newest":
-                    _metrics.inc("queue_dropped")
+                    self._count_drop()
                     return
                 # drop_oldest: make room, then retry the put
                 try:
@@ -66,8 +91,8 @@ class PolicyQueue(queue.Queue):
                     # the re-put so unfinished-task accounting holds)
                     super().put(old)
                     self.task_done()
-                    _metrics.inc("queue_dropped")
+                    self._count_drop()
                     return
                 self.task_done()
-                _metrics.inc("queue_dropped")
+                self._count_drop()
                 pressured = False

@@ -22,7 +22,11 @@ Sites wired in (each names the exception type it surfaces):
   (``InjectedFault``), exercising the decode circuit breaker;
 - ``input_socket``   — ``ConnectionResetError`` from input socket reads;
 - ``sink_write``     — ``OSError`` from sink write paths (tls/file);
-- ``queue_pressure`` — makes the bounded queue report Full to producers.
+- ``queue_pressure`` — makes the bounded queue report Full to producers;
+- ``tenant_flood``   — makes admission checks of *rate-limited* tenants
+  deny as if their token bucket were empty (unlimited tenants never
+  check the site, so a plan targets exactly the tenants a test marks
+  with a finite rate — see tenancy/admission.py).
 
 Counters are per-site, process-wide, and thread-safe; numbering is
 1-based (``once:1`` fires on the first check).  The module is inert —
@@ -38,7 +42,8 @@ from typing import Dict, Optional, Tuple
 
 ENV_VAR = "FLOWGGER_FAULTS"
 
-KNOWN_SITES = ("device_decode", "input_socket", "sink_write", "queue_pressure")
+KNOWN_SITES = ("device_decode", "input_socket", "sink_write",
+               "queue_pressure", "tenant_flood")
 
 
 class InjectedFault(Exception):

@@ -29,6 +29,19 @@ shape packed so far), and the ``compile_cache_hits`` /
 ``compile_cache_misses`` / ``prewarmed_shapes`` counters — a second
 cold process of an identical config with ``input.tpu_compile_cache_dir``
 set should report zero misses.
+
+Multi-tenant serving (tenancy/): per-tenant ``tenant_{name}_lines`` /
+``_bytes`` (admitted), ``_drops`` (admission denials), ``_shed``
+(queue-pressure sheds) counters and the ``tenant_{name}_state`` gauge
+(0 admitting / 1 throttled / 2 shed), plus the aggregate
+``tenant_lines/bytes/drops/shed``.  Queue sheds carry per-cause labels:
+``queue_dropped_{drop_newest,drop_oldest,shed_noisiest}`` alongside the
+aggregate ``queue_dropped``, and ``queue_shed_during_drain`` after the
+pipeline enters its drain phase.  Template mining reports
+``template_hits``, the ``tenant_templates_distinct`` gauge (and its
+per-tenant form), and the per-template ``tenant_{name}_template_{id}``
+counter family (capped; overflow ids fold into
+``tenant_{name}_template_overflow``).
 """
 
 from __future__ import annotations
@@ -55,6 +68,18 @@ _COUNTERS = (
     # compile stability (tpu/device_common.py): persistent-cache
     # traffic and startup kernel prewarm progress
     "compile_cache_hits", "compile_cache_misses", "prewarmed_shapes",
+    # multi-tenant serving (tenancy/): aggregate admission and shed
+    # counters — the per-tenant family (tenant_{name}_lines/_bytes/
+    # _drops/_shed, tenant_{name}_state gauge) materializes on first
+    # use, keyed by tenant name
+    "tenant_lines", "tenant_bytes", "tenant_drops", "tenant_shed",
+    # queue sheds that happened after the pipeline entered its drain
+    # phase (bounded_queue.mark_draining): lets a SIGTERM test tell
+    # shed lines from delivered lines
+    "queue_shed_during_drain",
+    # online template mining (tenancy/templates.py): rows mined; the
+    # per-template family is tenant_{name}_template_{id} (+ _overflow)
+    "template_hits",
 )
 
 

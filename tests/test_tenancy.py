@@ -1,0 +1,933 @@
+"""Multi-tenant serving: registry, token-bucket admission, weighted-fair
+queue, tenant-flood isolation, and online template mining."""
+
+import queue as queue_mod
+import threading
+
+import pytest
+
+from flowgger_tpu.config import Config, ConfigError
+from flowgger_tpu import tenancy
+from flowgger_tpu.tenancy.admission import AdmissionHandler, TokenBucket
+from flowgger_tpu.tenancy.fairqueue import WeightedFairQueue
+from flowgger_tpu.tenancy.registry import TenantRegistry
+from flowgger_tpu.tenancy.templates import TemplateMiner, TemplateMinerSet
+from flowgger_tpu.utils import faultinject
+from flowgger_tpu.utils.metrics import registry
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    registry.reset()
+    faultinject.reset()
+    tenancy.set_current(None)
+    yield
+    faultinject.reset()
+    tenancy.set_current(None)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def _registry(toml: str, clock=None) -> TenantRegistry:
+    return TenantRegistry.from_config(Config.from_string(toml), clock=clock)
+
+
+TWO_TENANTS = """
+[tenants.flood]
+peers = ["10.0.0.0/8"]
+rate = 5
+[tenants.good]
+peers = ["192.0.2.7"]
+"""
+
+
+# ---------------------------------------------------------------------------
+# registry: parsing + resolution
+# ---------------------------------------------------------------------------
+
+def test_registry_disabled_without_config():
+    assert TenantRegistry.from_config(Config.from_string("")) is None
+    assert TenantRegistry.from_config(Config.from_string(
+        '[input]\ntype = "stdin"\n')) is None
+
+
+def test_registry_enabled_by_default_rate_alone():
+    reg = _registry("[tenant]\ndefault_rate = 100\n")
+    assert reg is not None and reg.default.rate == 100
+    assert reg.default.burst == 200  # 2x rate
+
+
+def test_registry_resolution_cidr_exact_and_fallback():
+    reg = _registry(TWO_TENANTS)
+    assert reg.resolve_name("10.200.3.4") == "flood"
+    assert reg.resolve_name("192.0.2.7") == "good"
+    assert reg.resolve_name("203.0.113.9") == "default"
+    assert reg.resolve_name(None) == "default"
+    assert reg.resolve_name("/var/log/app.log") == "default"
+
+
+def test_registry_first_declared_match_wins_over_exact():
+    """Resolution is first match in declaration order: a CIDR declared
+    before an exact-IP tenant captures that IP (the broad rate limit
+    must not be bypassable by a later exact entry)."""
+    reg = _registry('[tenants.fleet]\npeers = ["10.0.0.0/8"]\n'
+                    '[tenants.vip]\npeers = ["10.1.2.3"]\n')
+    assert reg.resolve_name("10.1.2.3") == "fleet"
+    # declared the other way around, the exact entry wins
+    reg2 = _registry('[tenants.vip]\npeers = ["10.1.2.3"]\n'
+                     '[tenants.fleet]\npeers = ["10.0.0.0/8"]\n')
+    assert reg2.resolve_name("10.1.2.3") == "vip"
+    assert reg2.resolve_name("10.9.9.9") == "fleet"
+
+
+def test_registry_file_path_and_star_peers():
+    reg = _registry('[tenants.logs]\npeers = ["/var/log/app.log"]\n'
+                    '[tenants.rest]\npeers = ["*"]\n')
+    assert reg.resolve_name("/var/log/app.log") == "logs"
+    assert reg.resolve_name("8.8.8.8") == "rest"
+
+
+def test_registry_defaults_inherited_and_overridden():
+    reg = _registry("[tenant]\ndefault_weight = 3\n"
+                    'default_queue_policy = "drop_newest"\n'
+                    "[tenants.a]\n[tenants.b]\nweight = 7\n"
+                    'queue_policy = "block"\n')
+    assert reg.spec("a").weight == 3 and reg.spec("a").queue_policy == "drop_newest"
+    assert reg.spec("b").weight == 7 and reg.spec("b").queue_policy == "block"
+
+
+def test_registry_validation_errors():
+    with pytest.raises(ConfigError, match="unknown key"):
+        _registry("[tenants.a]\nrte = 5\n")
+    with pytest.raises(ConfigError, match="queue_policy"):
+        _registry('[tenants.a]\nqueue_policy = "bogus"\n')
+    with pytest.raises(ConfigError, match="weight"):
+        _registry("[tenants.a]\nweight = 0\n")
+    with pytest.raises(ConfigError, match="peers"):
+        _registry("[tenants.a]\npeers = [5]\n")
+    with pytest.raises(ConfigError, match="default_queue_policy"):
+        _registry('[tenant]\ndefault_rate = 1\ndefault_queue_policy = "x"\n')
+
+
+# ---------------------------------------------------------------------------
+# token buckets + admission
+# ---------------------------------------------------------------------------
+
+def test_token_bucket_rate_and_burst():
+    clock = FakeClock()
+    b = TokenBucket(rate=10, burst=20, clock=clock)
+    assert sum(b.try_take(1) for _ in range(30)) == 20  # burst drained
+    clock.t += 0.5
+    assert sum(b.try_take(1) for _ in range(30)) == 5   # refill 10/s
+
+
+def test_token_bucket_unlimited():
+    b = TokenBucket(rate=0, burst=0)
+    assert all(b.try_take(10**9) for _ in range(100))
+
+
+def test_admission_handler_sheds_and_counts():
+    clock = FakeClock()
+    reg = _registry(TWO_TENANTS, clock=clock)
+
+    class Sink:
+        def __init__(self):
+            self.chunks = []
+            self.ingest_sep = b"\n"
+            self.ingest_strip_cr = True
+            self.quiet_empty = False
+            self.bare_errors = False
+
+        def ingest_chunk(self, region):
+            self.chunks.append(region)
+
+        def flush(self):
+            pass
+
+    sink = Sink()
+    h = AdmissionHandler(sink, reg.resolve("10.1.1.1"))
+    region = b"one\ntwo\n"
+    for _ in range(10):
+        h.ingest_chunk(region)  # 2 lines each; burst = 10 lines
+    assert len(sink.chunks) == 5
+    assert registry.get("tenant_flood_lines") == 10
+    assert registry.get("tenant_flood_bytes") == 5 * len(region)
+    assert registry.get("tenant_flood_drops") == 10
+    assert registry.snapshot().get("tenant_flood_state") == 1
+    # the unlimited tenant admits everything and never throttles
+    g = AdmissionHandler(sink, reg.resolve("192.0.2.7"))
+    for _ in range(50):
+        g.ingest_chunk(region)
+    assert registry.get("tenant_good_drops") == 0
+    assert registry.snapshot().get("tenant_good_state") == 0
+
+
+def test_admission_handler_mirrors_fast_path_surface():
+    class ScalarOnly:
+        quiet_empty = False
+        bare_errors = False
+        ingest_sep = b"\n"
+        ingest_strip_cr = True
+
+        def handle_bytes(self, raw):
+            pass
+
+    reg = _registry(TWO_TENANTS)
+    h = AdmissionHandler(ScalarOnly(), reg.resolve(None))
+    # a scalar inner handler must not suddenly grow the chunk fast path
+    assert not hasattr(h, "ingest_chunk") and not hasattr(h, "ingest_spans")
+
+
+def test_admission_sets_thread_tenant_tag():
+    reg = _registry(TWO_TENANTS)
+    seen = []
+
+    class Sink:
+        quiet_empty = False
+        bare_errors = False
+        ingest_sep = b"\n"
+        ingest_strip_cr = True
+
+        def handle_bytes(self, raw):
+            seen.append(tenancy.current_name())
+
+    AdmissionHandler(Sink(), reg.resolve("10.0.0.1")).handle_bytes(b"x")
+    assert seen == ["flood"]
+
+
+@pytest.mark.faults
+def test_tenant_flood_fault_site_targets_rate_limited_tenants():
+    """The tenant_flood site denies admission checks of rate-limited
+    tenants only: unlimited tenants never consult it, so the plan's
+    deterministic numbering lands entirely on the flooder."""
+    faultinject.configure({"tenant_flood": "every:2"})
+    clock = FakeClock()
+    reg = _registry(TWO_TENANTS, clock=clock)
+    flood, good = reg.resolve("10.0.0.1"), reg.resolve("192.0.2.7")
+    results = [flood.admit(1, 1) for _ in range(6)]
+    assert results == [True, False, True, False, True, False]
+    assert all(good.admit(1, 1) for _ in range(20))  # site untouched
+    assert registry.get("tenant_good_drops") == 0
+
+
+# ---------------------------------------------------------------------------
+# weighted-fair queue
+# ---------------------------------------------------------------------------
+
+def _drain_queue(q):
+    out = []
+    while True:
+        try:
+            out.append(q.get_nowait())
+        except queue_mod.Empty:
+            return out
+
+
+def test_fairqueue_single_lane_fifo():
+    q = WeightedFairQueue(maxsize=10)
+    for i in range(5):
+        q.put(b"%d" % i)
+    assert _drain_queue(q) == [b"0", b"1", b"2", b"3", b"4"]
+
+
+def test_fairqueue_weighted_share():
+    """A weight-3 tenant drains ~3x the bytes of a weight-1 tenant over
+    one DRR cycle window."""
+    reg = _registry("[tenants.heavy]\nweight = 3\n[tenants.light]\nweight = 1\n")
+    q = WeightedFairQueue(registry=reg)
+    item = b"x" * 1024
+    tenancy.set_current("heavy")
+    for _ in range(64):
+        q.put(item)
+    tenancy.set_current("light")
+    for _ in range(64):
+        q.put(item)
+    tenancy.set_current(None)
+    first = [q.get_nowait() for _ in range(32)]
+    del first
+    depths = q.lane_depths()
+    # heavy drained ~3x light's items from the interleaved window
+    assert depths["heavy"] < depths["light"]
+    assert (64 - depths["heavy"]) >= 2 * (64 - depths["light"])
+
+
+def test_fairqueue_per_lane_fifo_under_interleave():
+    reg = _registry("[tenants.a]\n[tenants.b]\nweight = 2\n")
+    q = WeightedFairQueue(registry=reg)
+    for i in range(10):
+        tenancy.set_current("a" if i % 2 == 0 else "b")
+        q.put(b"%c%d" % (ord("a") + i % 2, i))
+    tenancy.set_current(None)
+    out = _drain_queue(q)
+    a_items = [x for x in out if x.startswith(b"a")]
+    b_items = [x for x in out if x.startswith(b"b")]
+    assert a_items == sorted(a_items) and b_items == sorted(b_items)
+    assert len(out) == 10
+
+
+def test_fairqueue_shutdown_after_data_and_unsheddable():
+    reg = _registry('[tenants.a]\nqueue_policy = "drop_oldest"\n')
+    q = WeightedFairQueue(maxsize=2, registry=reg)
+    q.put(None)  # SHUTDOWN first — must still deliver last, never shed
+    tenancy.set_current("a")
+    for i in range(5):
+        q.put(b"%d" % i)  # maxsize 2: sheds oldest, sentinel exempt
+    tenancy.set_current(None)
+    out = _drain_queue(q)
+    assert out[-1] is None and all(x is not None for x in out[:-1])
+    assert registry.get("queue_dropped") == 3
+    assert registry.get("tenant_a_shed") == 3
+
+
+def test_fairqueue_sheds_noisiest_first():
+    """Global pressure from a well-behaved put degrades the noisiest
+    sheddable tenant, not the victim's own lane."""
+    reg = _registry('[tenants.noisy]\nqueue_policy = "drop_oldest"\n'
+                    '[tenants.quiet]\nqueue_policy = "drop_oldest"\n')
+    q = WeightedFairQueue(maxsize=6, registry=reg)
+    tenancy.set_current("noisy")
+    for i in range(5):
+        q.put(b"n%d" % i)
+    tenancy.set_current("quiet")
+    q.put(b"q0")
+    q.put(b"q1")  # full: noisy (5 items) is the victim, not quiet
+    tenancy.set_current(None)
+    out = _drain_queue(q)
+    assert b"q0" in out and b"q1" in out
+    assert b"n0" not in out  # noisy's head shed
+    assert registry.get("tenant_noisy_shed") == 1
+    assert registry.get("tenant_quiet_shed") == 0
+    assert registry.get("queue_dropped_shed_noisiest") == 1
+
+
+def test_fairqueue_block_lane_never_shed():
+    reg = _registry('[tenants.b]\nqueue_policy = "block"\n'
+                    '[tenants.d]\nqueue_policy = "drop_newest"\n')
+    q = WeightedFairQueue(maxsize=3, registry=reg)
+    tenancy.set_current("b")
+    for i in range(3):
+        q.put(b"b%d" % i)
+    tenancy.set_current("d")
+    q.put(b"d0")  # full; only sheddable lane is d's own (empty) -> drop incoming
+    tenancy.set_current(None)
+    out = _drain_queue(q)
+    assert out == [b"b0", b"b1", b"b2"]
+    assert registry.get("queue_dropped_drop_newest") == 1
+    assert registry.get("tenant_d_shed") == 1
+
+
+def test_fairqueue_blocks_and_wakes_producer():
+    reg = _registry('[tenants.a]\nqueue_policy = "block"\n')
+    q = WeightedFairQueue(maxsize=1, registry=reg)
+    tenancy.set_current("a")
+    q.put(b"first")
+    done = threading.Event()
+
+    def produce():
+        tenancy.set_current("a")
+        q.put(b"second")  # blocks until the consumer makes room
+        done.set()
+
+    t = threading.Thread(target=produce, daemon=True)
+    t.start()
+    assert not done.wait(0.1)
+    assert q.get(timeout=1) == b"first"
+    assert done.wait(2)
+    assert q.get(timeout=1) == b"second"
+    tenancy.set_current(None)
+
+
+def test_fairqueue_put_nowait_and_timeout_raise_full():
+    """queue.Queue parity: a non-blocking (or timed-out) put on a full
+    queue whose lanes are all block-policy raises Full instead of
+    waiting forever."""
+    reg = _registry('[tenants.a]\nqueue_policy = "block"\n')
+    q = WeightedFairQueue(maxsize=1, registry=reg)
+    tenancy.set_current("a")
+    q.put(b"first")
+    with pytest.raises(queue_mod.Full):
+        q.put_nowait(b"second")
+    with pytest.raises(queue_mod.Full):
+        q.put(b"second", timeout=0.01)
+    tenancy.set_current(None)
+    assert q.get_nowait() == b"first"
+
+
+def test_fairqueue_queue_dropped_counts_items_not_lines():
+    """queue_dropped keeps PolicyQueue units (one shed item = one
+    drop) even for multi-line blocks; tenant_{t}_shed counts lines."""
+    import numpy as np
+
+    from flowgger_tpu.block import EncodedBlock
+
+    reg = _registry('[tenant]\ndefault_queue_policy = "drop_newest"\n'
+                    "default_rate = 1\n")
+    q = WeightedFairQueue(maxsize=1, registry=reg)
+    blk = EncodedBlock(b"a\nb\nc\n", np.array([0, 2, 4, 6], np.int64),
+                       suffix_len=1)
+    q.put(blk)
+    q.put(blk)  # full -> own-lane drop_newest shed of a 3-line block
+    assert registry.get("queue_dropped") == 1
+    assert registry.get("tenant_default_shed") == 3
+
+
+def test_fairqueue_task_accounting_survives_sheds():
+    reg = _registry('[tenants.a]\nqueue_policy = "drop_oldest"\n')
+    q = WeightedFairQueue(maxsize=1, registry=reg)
+    tenancy.set_current("a")
+    q.put(b"a")
+    q.put(b"b")  # sheds a
+    tenancy.set_current(None)
+    assert q.get_nowait() == b"b"
+    q.task_done()
+    q.join()  # wedges if shed items leaked unfinished-task counts
+
+
+def test_fairqueue_block_items_ride_default_lane():
+    import numpy as np
+
+    from flowgger_tpu.block import EncodedBlock
+
+    reg = _registry(TWO_TENANTS)
+    q = WeightedFairQueue(registry=reg)
+    tenancy.set_current("flood")
+    blk = EncodedBlock(b"ab\ncd\n", np.array([0, 3, 6], dtype=np.int64),
+                       suffix_len=1)
+    q.put(blk)
+    tenancy.set_current(None)
+    assert q.lane_depths() == {"default": 1}
+    assert q.get_nowait() is blk
+
+
+@pytest.mark.faults
+def test_fairqueue_queue_pressure_site():
+    faultinject.configure({"queue_pressure": "first:2"})
+    reg = _registry('[tenants.a]\nqueue_policy = "drop_newest"\n')
+    q = WeightedFairQueue(maxsize=16, registry=reg)
+    tenancy.set_current("a")
+    q.put(b"a")  # pressured -> shed
+    q.put(b"b")  # pressured -> shed
+    q.put(b"c")  # delivered
+    tenancy.set_current(None)
+    assert _drain_queue(q) == [b"c"]
+    assert registry.get("queue_dropped") == 2
+
+
+# ---------------------------------------------------------------------------
+# drain-phase shed accounting (PolicyQueue + fair queue)
+# ---------------------------------------------------------------------------
+
+def test_policy_queue_labels_and_drain_shed_counter():
+    from flowgger_tpu.utils.bounded_queue import PolicyQueue
+
+    q = PolicyQueue(maxsize=1, policy="drop_newest")
+    q.put(b"a")
+    q.put(b"b")  # shed, pre-drain
+    assert registry.get("queue_dropped_drop_newest") == 1
+    assert registry.get("queue_shed_during_drain") == 0
+    q.mark_draining()
+    q.put(b"c")  # shed during drain
+    assert registry.get("queue_shed_during_drain") == 1
+    assert registry.get("queue_dropped") == 2
+
+
+def test_fairqueue_drain_shed_counter():
+    reg = _registry('[tenants.a]\nqueue_policy = "drop_newest"\n')
+    q = WeightedFairQueue(maxsize=1, registry=reg)
+    tenancy.set_current("a")
+    q.put(b"a")
+    q.mark_draining()
+    q.put(b"b")
+    tenancy.set_current(None)
+    assert registry.get("queue_shed_during_drain") == 1
+
+
+def test_pipeline_drain_marks_queue():
+    from flowgger_tpu.pipeline import Pipeline
+
+    p = Pipeline(Config.from_string(
+        '[input]\ntype = "stdin"\n[output]\ntype = "debug"\n'))
+    threads = p.start_output()
+    p._drain(threads if isinstance(threads, list) else [threads])
+    assert p.tx.draining
+
+
+# ---------------------------------------------------------------------------
+# pipeline wiring
+# ---------------------------------------------------------------------------
+
+def test_pipeline_default_path_has_no_tenancy_objects():
+    """Zero-overhead-when-off: an unconfigured pipeline builds the exact
+    pre-tenancy objects — PolicyQueue, unwrapped handlers, no miners."""
+    from flowgger_tpu.pipeline import Pipeline
+    from flowgger_tpu.splitters import ScalarHandler
+    from flowgger_tpu.utils.bounded_queue import PolicyQueue
+
+    p = Pipeline(Config.from_string(
+        '[input]\ntype = "stdin"\n[output]\ntype = "debug"\n'))
+    assert p.tenants is None and type(p.tx) is PolicyQueue
+    assert type(p.handler_factory()) is ScalarHandler
+
+
+def test_pipeline_tenancy_wires_queue_and_admission():
+    from flowgger_tpu.pipeline import Pipeline
+
+    p = Pipeline(Config.from_string(
+        '[input]\ntype = "stdin"\n[output]\ntype = "debug"\n'
+        + TWO_TENANTS))
+    assert type(p.tx) is WeightedFairQueue
+    h = p.handler_factory(peer="10.3.3.3")
+    assert type(h) is AdmissionHandler and h._tenant.name == "flood"
+    assert p.handler_factory(peer=None)._tenant.name == "default"
+
+
+def test_make_handler_compat():
+    from flowgger_tpu.inputs import make_handler
+
+    calls = []
+    assert make_handler(lambda: calls.append("plain") or "h") == "h"
+
+    def factory(peer=None):
+        calls.append(peer)
+        return "h2"
+
+    assert make_handler(factory, "10.0.0.1") == "h2"
+    assert calls == ["plain", "10.0.0.1"]
+
+
+# ---------------------------------------------------------------------------
+# tenant-flood isolation: the acceptance bar
+# ---------------------------------------------------------------------------
+
+GOOD_LINE = (b"<13>1 2024-01-01T00:00:%02dZ good-host app %d g - "
+             b"good message number %d")
+FLOOD_LINE = (b"<13>1 2024-01-01T00:00:%02dZ flood-host app %d f - "
+              b"flood flood flood %d")
+
+
+def _flood_run(lanes, framing, flood=True, fault_spec=None):
+    """Drive interleaved good/flood traffic through admission + the
+    shared rfc5424 block-route handler; returns (merged output bytes,
+    snapshot) — the flooder sends 10x its admitted token rate."""
+    from flowgger_tpu.decoders.rfc5424 import RFC5424Decoder
+    from flowgger_tpu.encoders.passthrough import PassthroughEncoder
+    from flowgger_tpu.mergers import LineMerger, NulMerger
+    from flowgger_tpu.outputs import stream_bytes
+    from flowgger_tpu.tpu.batch import BatchHandler
+
+    registry.reset()
+    faultinject.reset()
+    if fault_spec:
+        faultinject.configure({"tenant_flood": fault_spec})
+    clock = FakeClock()
+    # flooder: 10 lines/sec, burst 20; good: unlimited
+    reg = _registry("[tenants.flood]\npeers = [\"10.0.0.0/8\"]\nrate = 10\n"
+                    "[tenants.good]\npeers = [\"192.0.2.7\"]\n", clock=clock)
+    cfg = Config.from_string(
+        "[input]\ntpu_batch_size = 10\ntpu_inflight = 2\n"
+        + (f"tpu_lanes = {lanes}\n" if lanes else ""))
+    sep, merger = ((b"\n", LineMerger()) if framing == "line"
+                   else (b"\0", NulMerger()))
+    tx = queue_mod.Queue()
+    inner = BatchHandler(tx, RFC5424Decoder(cfg), PassthroughEncoder(cfg),
+                         cfg, fmt="rfc5424", start_timer=False, merger=merger)
+    inner.ingest_sep = sep
+    inner.ingest_strip_cr = framing == "line"
+    good = AdmissionHandler(inner, reg.resolve("192.0.2.7"))
+    flooder = AdmissionHandler(inner, reg.resolve("10.9.9.9"))
+    seq = 0
+    for burst in range(10):
+        region = b"".join(GOOD_LINE % (burst, i, seq + i) + sep
+                          for i in range(5))
+        seq += 5
+        good.ingest_chunk(region)
+        if flood:
+            # 10x the flooder's rate: 100 lines over a frozen second
+            flooder.ingest_chunk(b"".join(
+                FLOOD_LINE % (burst, i, i) + sep for i in range(10)))
+    inner.flush()
+    inner.close()
+    out = b""
+    while not tx.empty():
+        data, _ = stream_bytes(tx.get_nowait(), merger)
+        out += data
+    return out, registry.snapshot()
+
+
+def _good_subset(out: bytes, sep: bytes):
+    return [ln for ln in out.split(sep) if b"good-host" in ln]
+
+
+@pytest.mark.faults
+@pytest.mark.parametrize("framing", ["line", "nul"])
+@pytest.mark.parametrize("lanes", [None, 2])
+def test_flood_isolation_byte_identical_good_tenant(lanes, framing):
+    """Acceptance: one tenant flooding at 10x its token rate is shed at
+    admission while the well-behaved tenant's output stays byte-
+    identical and in-order vs a no-flood run — line and nul framings,
+    1-lane and 2-lane dispatch — and only the flooder's counters move."""
+    sep = b"\n" if framing == "line" else b"\0"
+    baseline, _ = _flood_run(lanes, framing, flood=False)
+    flooded, snap = _flood_run(lanes, framing, flood=True)
+    good_clean = _good_subset(baseline, sep)
+    good_flood = _good_subset(flooded, sep)
+    assert good_flood == good_clean  # byte-identical AND in-order
+    assert len(good_clean) == 50
+    # the flood was actually shed: admitted <= burst(20), rest dropped
+    assert snap["tenant_flood_drops"] >= 80
+    assert snap.get("tenant_good_drops", 0) == 0
+    assert snap["tenant_good_lines"] == 50
+    # some flood lines were admitted (burst) and decoded normally
+    assert 0 < flooded.count(b"flood-host") <= 20
+
+
+@pytest.mark.faults
+def test_flood_isolation_via_fault_site():
+    """Same isolation bar driven by the deterministic tenant_flood site:
+    every admission check of the rate-limited flooder denies, the good
+    tenant's stream is untouched."""
+    baseline, _ = _flood_run(None, "line", flood=False)
+    flooded, snap = _flood_run(None, "line", flood=True, fault_spec="every:1")
+    assert _good_subset(flooded, b"\n") == _good_subset(baseline, b"\n")
+    assert flooded.count(b"flood-host") == 0  # every flood chunk denied
+    assert snap["tenant_flood_drops"] == 100
+    assert snap.get("tenant_good_drops", 0) == 0
+
+
+# ---------------------------------------------------------------------------
+# template mining
+# ---------------------------------------------------------------------------
+
+def test_miner_clusters_and_wildcards():
+    m = TemplateMiner()
+    a = m.observe("Accepted password from 10.0.0.1 port 22")
+    b = m.observe("Accepted password from 10.9.9.9 port 2222")
+    c = m.observe("Failed password for root")
+    assert a == b and a != c
+    assert m.template(a) == "Accepted password from <*> port <*>"
+    assert m.distinct() == 2
+
+
+def test_miner_ids_stable_across_runs():
+    corpus = [f"job {i} finished in {i * 3} ms" for i in range(50)]
+    corpus += [f"user u{i} logged in" for i in range(50)]
+    corpus += ["disk sda1 failed", "disk sdb2 failed"]
+
+    def mine():
+        m = TemplateMiner()
+        return [m.observe(line) for line in corpus], m.templates()
+
+    ids1, t1 = mine()
+    ids2, t2 = mine()
+    assert ids1 == ids2 and t1 == t2
+
+
+def test_miner_template_cap_returns_unmined():
+    m = TemplateMiner(max_templates=2)
+    assert m.observe("alpha beta") != 0
+    assert m.observe("gamma delta epsilon") != 0
+    assert m.observe("zeta eta theta iota") == 0  # capped
+    assert m.distinct() == 2
+
+
+def test_miner_set_per_tenant_isolation_and_gauges():
+    ms = TemplateMinerSet()
+    ms.observe_msg("a", "user alice logged in")
+    ms.observe_msg("b", "user bob logged in")
+    assert ms.miner("a").distinct() == 1 and ms.miner("b").distinct() == 1
+    snap = registry.snapshot()
+    assert snap["template_hits"] == 2
+    assert snap["tenant_templates_distinct"] == 2
+    assert snap["tenant_a_templates_distinct"] == 1
+    assert registry.get("tenant_a_template_1") == 1
+
+
+def test_miner_set_config_gate():
+    assert TemplateMinerSet.from_config(Config.from_string("")) is None
+    assert TemplateMinerSet.from_config(Config.from_string(
+        '[tenant]\ntemplates = "off"\n')) is None
+    ms = TemplateMinerSet.from_config(Config.from_string(
+        '[tenant]\ntemplates = "on"\ntemplate_sim = 0.7\n'))
+    assert ms is not None and ms.sim == 0.7
+    with pytest.raises(ConfigError, match="templates"):
+        TemplateMinerSet.from_config(Config.from_string(
+            '[tenant]\ntemplates = "maybe"\n'))
+    with pytest.raises(ConfigError, match="template_enrich"):
+        TemplateMinerSet.from_config(Config.from_string(
+            "[tenant]\ntemplate_enrich = true\n"))
+    with pytest.raises(ConfigError, match="template_sim"):
+        TemplateMinerSet.from_config(Config.from_string(
+            '[tenant]\ntemplates = "on"\ntemplate_sim = 1.5\n'))
+
+
+MINE_LINES = [
+    b"<13>1 2024-01-01T00:00:00Z h app p m - session 101 opened for user alice",
+    b"<13>1 2024-01-01T00:00:01Z h app p m - session 202 opened for user bob",
+    b"<13>1 2024-01-01T00:00:02Z h app p m - disk sda1 failed",
+]
+
+
+def _mine_block_run(lanes=None, tenant="alpha"):
+    from flowgger_tpu.decoders.rfc5424 import RFC5424Decoder
+    from flowgger_tpu.encoders.passthrough import PassthroughEncoder
+    from flowgger_tpu.mergers import LineMerger
+    from flowgger_tpu.outputs import stream_bytes
+    from flowgger_tpu.tpu.batch import BatchHandler
+
+    registry.reset()
+    cfg = Config.from_string(
+        "[input]\ntpu_batch_size = 3\ntpu_inflight = 2\n"
+        + (f"tpu_lanes = {lanes}\n" if lanes else "")
+        + '[tenant]\ntemplates = "on"\n')
+    tx = queue_mod.Queue()
+    merger = LineMerger()
+    h = BatchHandler(tx, RFC5424Decoder(cfg), PassthroughEncoder(cfg),
+                     cfg, fmt="rfc5424", start_timer=False, merger=merger)
+    tenancy.set_current(tenant)
+    for _ in range(4):
+        h.ingest_chunk(b"".join(ln + b"\n" for ln in MINE_LINES))
+    tenancy.set_current(None)
+    h.flush()
+    h.close()
+    out = b""
+    while not tx.empty():
+        data, _ = stream_bytes(tx.get_nowait(), merger)
+        out += data
+    return out, h
+
+
+def test_block_route_mining_consumes_decoded_columns():
+    """Mining on the columnar block route: templates come from the
+    kernel's message span channels, attributed to the ingesting tenant,
+    and the emitted bytes are untouched."""
+    out, h = _mine_block_run()
+    assert h._miners is not None and h._block_route_ok()
+    miner = h._miners.miner("alpha")
+    assert miner.distinct() == 2
+    assert "session <*> opened for user <*>" in miner.templates().values()
+    snap = registry.snapshot()
+    assert snap["template_hits"] == 12
+    assert snap["tenant_alpha_templates_distinct"] == 2
+    # mining never perturbs output bytes
+    plain, _ = _stream_plain()
+    assert out == plain
+
+
+def test_block_route_mining_stable_across_lanes():
+    out1, h1 = _mine_block_run()
+    out2, h2 = _mine_block_run(lanes=2)
+    assert out1 == out2
+    assert h1._miners.miner("alpha").templates() == \
+        h2._miners.miner("alpha").templates()
+
+
+def _stream_plain():
+    from flowgger_tpu.decoders.rfc5424 import RFC5424Decoder
+    from flowgger_tpu.encoders.passthrough import PassthroughEncoder
+    from flowgger_tpu.mergers import LineMerger
+    from flowgger_tpu.outputs import stream_bytes
+    from flowgger_tpu.tpu.batch import BatchHandler
+
+    cfg = Config.from_string("[input]\ntpu_batch_size = 3\ntpu_inflight = 2\n")
+    tx = queue_mod.Queue()
+    merger = LineMerger()
+    h = BatchHandler(tx, RFC5424Decoder(cfg), PassthroughEncoder(cfg),
+                     cfg, fmt="rfc5424", start_timer=False, merger=merger)
+    for _ in range(4):
+        h.ingest_chunk(b"".join(ln + b"\n" for ln in MINE_LINES))
+    h.flush()
+    h.close()
+    out = b""
+    while not tx.empty():
+        data, _ = stream_bytes(tx.get_nowait(), merger)
+        out += data
+    return out, h
+
+
+def test_mining_off_by_default_zero_residue():
+    _out, h = _stream_plain()
+    assert h._miners is None and h._enrich_hook is None
+    assert h._chunk_runs == [] and h._mine_block is False
+    assert registry.get("template_hits") == 0
+
+
+def test_record_route_mining_attributes_rows_by_ingest_runs():
+    """A mixed-tenant batch on the Record route mines each row into its
+    own tenant's miner — attribution follows the ingest runs, not
+    whichever thread happened to trigger the flush."""
+    from flowgger_tpu.decoders.rfc5424 import RFC5424Decoder
+    from flowgger_tpu.encoders.gelf import GelfEncoder
+    from flowgger_tpu.mergers import NulMerger
+    from flowgger_tpu.tpu.batch import BatchHandler
+
+    cfg = Config.from_string(
+        "[input]\ntpu_batch_size = 64\n"
+        '[tenant]\ntemplates = "on"\ntemplate_enrich = true\n')
+    tx = queue_mod.Queue()
+    h = BatchHandler(tx, RFC5424Decoder(cfg), GelfEncoder(cfg), cfg,
+                     fmt="rfc5424", start_timer=False, merger=NulMerger())
+    tenancy.set_current("alpha")
+    h.ingest_chunk(b"<13>1 2024-01-01T00:00:00Z h a p m - alpha says hello\n")
+    tenancy.set_current("beta")
+    h.ingest_chunk(b"<13>1 2024-01-01T00:00:01Z h a p m - beta says goodbye\n")
+    tenancy.set_current("neither")  # the flushing thread's tag is a red herring
+    h.flush()
+    h.close()
+    tenancy.set_current(None)
+    assert "alpha says hello" in h._miners.miner("alpha").templates().values()
+    assert "beta says goodbye" in h._miners.miner("beta").templates().values()
+    assert h._miners.miner("neither").distinct() == 0
+
+
+def test_record_route_rows_land_on_their_own_queue_lanes():
+    """Record-route emits lane each row by its ingest tenant on the
+    fair queue — not by whichever thread triggered the flush — so
+    pressure shedding can never pick a well-behaved tenant's rows out
+    of a noisier tenant's lane."""
+    from flowgger_tpu.decoders.rfc5424 import RFC5424Decoder
+    from flowgger_tpu.encoders.gelf import GelfEncoder
+    from flowgger_tpu.mergers import NulMerger
+    from flowgger_tpu.tpu.batch import BatchHandler
+
+    reg = _registry("[tenants.a]\n[tenants.b]\n")
+    cfg = Config.from_string(
+        "[input]\ntpu_batch_size = 64\n"
+        '[tenant]\ntemplates = "on"\ntemplate_enrich = true\n')
+    tx = WeightedFairQueue(registry=reg)
+    h = BatchHandler(tx, RFC5424Decoder(cfg), GelfEncoder(cfg), cfg,
+                     fmt="rfc5424", start_timer=False, merger=NulMerger())
+    tenancy.set_current("a")
+    h.ingest_chunk(b"<13>1 2024-01-01T00:00:00Z h a p m - from tenant a\n")
+    tenancy.set_current("b")
+    h.ingest_chunk(b"<13>1 2024-01-01T00:00:01Z h a p m - from tenant b\n")
+    tenancy.set_current("neither")
+    h.flush()
+    h.close()
+    tenancy.set_current(None)
+    assert h._miners is not None
+    depths = tx.lane_depths()
+    assert depths.get("a") == 1 and depths.get("b") == 1
+    assert "neither" not in depths
+
+
+def test_udp_per_source_tenant_resolution():
+    """UDP datagrams resolve tenants per source IP on the per-datagram
+    path: a [tenants.*] peers entry for the sender's address charges
+    that tenant's buckets, not the default tenant's."""
+    import socket
+    import threading
+    import time
+
+    from flowgger_tpu.pipeline import Pipeline
+
+    p = Pipeline(Config.from_string(
+        '[input]\ntype = "udp"\nlisten = "127.0.0.1:0"\n'
+        'format = "rfc5424"\n[output]\ntype = "debug"\n'
+        '[tenants.local]\npeers = ["127.0.0.1"]\n'))
+    t = threading.Thread(target=p.input.accept, args=(p.handler_factory,),
+                         daemon=True)
+    t.start()
+    deadline = time.time() + 10
+    while p.input.bound_port is None:
+        assert time.time() < deadline
+        time.sleep(0.01)
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    s.sendto(b"<13>1 2024-01-01T00:00:00Z h app p m - over udp",
+             ("127.0.0.1", p.input.bound_port))
+    deadline = time.time() + 10
+    while registry.get("tenant_local_lines") < 1:
+        assert time.time() < deadline, registry.snapshot()
+        time.sleep(0.02)
+    s.close()
+    assert registry.get("tenant_local_lines") == 1
+    assert registry.get("tenant_default_lines") == 0
+
+
+def test_scalar_pipeline_mines_templates():
+    """tenant.templates = "on" engages on scalar (non-*_tpu) pipelines
+    too: the pipeline wires a record hook onto its ScalarHandlers."""
+    from flowgger_tpu.pipeline import Pipeline
+
+    p = Pipeline(Config.from_string(
+        '[input]\ntype = "stdin"\nformat = "rfc5424"\n'
+        '[output]\ntype = "debug"\nformat = "gelf"\n'
+        '[tenant]\ntemplates = "on"\ntemplate_enrich = true\n'))
+    h = p.handler_factory()
+    h.handle_bytes(b"<13>1 2024-01-01T00:00:00Z h app p m - scalar mined")
+    out = p.tx.get_nowait()
+    assert b'"_template_id":1' in out
+    assert p._scalar_miners.miner("default").distinct() == 1
+    # and the tpu path must NOT double-build a pipeline-level miner set
+    p2 = Pipeline(Config.from_string(
+        '[input]\ntype = "stdin"\nformat = "rfc5424_tpu"\n'
+        '[output]\ntype = "debug"\nformat = "gelf"\n'
+        '[tenant]\ntemplates = "on"\n'))
+    assert p2._scalar_miners is None
+
+
+def test_tenant_template_opt_out():
+    """[tenants.<name>] templates = false excludes that tenant from
+    mining while others keep mining."""
+    ms = TemplateMinerSet.from_config(Config.from_string(
+        '[tenant]\ntemplates = "on"\n'
+        "[tenants.quiet]\ntemplates = false\n[tenants.chatty]\n"))
+    assert ms.observe_msg("quiet", "user alice logged in") == 0
+    assert ms.observe_msg("chatty", "user alice logged in") == 1
+    assert ms.miner("quiet").distinct() == 0
+    assert registry.get("template_hits") == 1
+    ms.observe_rows(["a b c", "d e f"],
+                    [("quiet", 1), ("chatty", 1)])
+    assert ms.miner("quiet").distinct() == 0
+    # chatty gained only its own row ("d e f"); quiet's "a b c" skipped
+    assert ms.miner("chatty").distinct() == 2
+
+
+def test_fairqueue_drop_cause_label_matches_lane_policy():
+    """An incoming-item discard on a drop_oldest lane whose own queue is
+    empty is labeled drop_oldest, not drop_newest."""
+    reg = _registry('[tenants.b]\nqueue_policy = "block"\n'
+                    '[tenants.d]\nqueue_policy = "drop_oldest"\n')
+    q = WeightedFairQueue(maxsize=2, registry=reg)
+    tenancy.set_current("b")
+    q.put(b"b0")
+    q.put(b"b1")
+    tenancy.set_current("d")
+    q.put(b"d0")  # full; nothing sheddable; d's own lane empty
+    tenancy.set_current(None)
+    assert registry.get("queue_dropped_drop_oldest") == 1
+    assert registry.get("queue_dropped_drop_newest") == 0
+
+
+def test_gelf_enrichment_stamps_template_id():
+    from flowgger_tpu.decoders.rfc5424 import RFC5424Decoder
+    from flowgger_tpu.encoders.gelf import GelfEncoder
+    from flowgger_tpu.mergers import NulMerger
+    from flowgger_tpu.tpu.batch import BatchHandler
+
+    cfg = Config.from_string(
+        "[input]\ntpu_batch_size = 2\n"
+        '[tenant]\ntemplates = "on"\ntemplate_enrich = true\n')
+    tx = queue_mod.Queue()
+    h = BatchHandler(tx, RFC5424Decoder(cfg), GelfEncoder(cfg), cfg,
+                     fmt="rfc5424", start_timer=False, merger=NulMerger())
+    # enrichment rides the Record route: the block route must disengage
+    assert not h._block_route_ok()
+    assert "template_enrich" in h._route_cliff_reason()
+    h.ingest_chunk(
+        b"<13>1 2024-01-01T00:00:00Z h app p m - login from 10.1.1.1\n"
+        b"<13>1 2024-01-01T00:00:01Z h app p m - login from 10.2.2.2\n")
+    h.flush()
+    h.close()
+    items = _drain_queue(tx)
+    assert len(items) == 2
+    assert all(b'"_template_id":1' in item for item in items)
+    # the scalar fallback path stamps the same id (byte-consistency)
+    h.scalar.handle_bytes(
+        b"<13>1 2024-01-01T00:00:02Z h app p m - login from 10.3.3.3")
+    assert b'"_template_id":1' in tx.get_nowait()
