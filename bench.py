@@ -24,6 +24,7 @@ every byte written came off the device, which is equally honest.
 """
 
 import json
+import os
 import random
 import sys
 import time
@@ -826,6 +827,150 @@ def bench_tenancy(extra, lines):
     return ok
 
 
+def bench_fused_routes(extra, smoke):
+    """Fused decode→encode route matrix (tpu/fused_routes.py): per
+    route, emit the fused tier's fetched-vs-emitted bytes/row, the
+    split host path's fetched bytes/row (every decode channel crosses
+    D2H there), eager lines/s, and two gates:
+
+    1. the fused output is byte-identical to the split path's on the
+       corpus (framing included), and
+    2. fused fetched bytes/row <= the split DEVICE path's (the
+       two-program decode→encode pipeline the fusion replaces) AND
+       below the route's own emitted bytes/row (the device-resident
+       span channels + constant-elision claim).  The split HOST path's
+       span-channel fetch rides along as context — it can be smaller
+       than output-sized on channel-light formats (rfc3164) because it
+       re-assembles output host-side from the host-resident chunk,
+       which is exactly the host CPU cost the fused tier removes.
+
+    The fused programs run eagerly (``jax.disable_jit()``) where this
+    host's XLA cannot compile them — rates are then labeled
+    ``cpu-fallback-eager`` and are NOT the accelerator claim, but the
+    byte-level gates hold identically in both modes."""
+    import numpy as np
+
+    import jax
+
+    from flowgger_tpu.config import Config
+    from flowgger_tpu.decoders.gelf import GelfDecoder
+    from flowgger_tpu.decoders.ltsv import LTSVDecoder
+    from flowgger_tpu.decoders.rfc3164 import RFC3164Decoder
+    from flowgger_tpu.decoders.rfc5424 import RFC5424Decoder
+    from flowgger_tpu.encoders.gelf import GelfEncoder
+    from flowgger_tpu.mergers import LineMerger
+    from flowgger_tpu.tpu import fused_routes, gelf, ltsv, pack, rfc3164, rfc5424
+    from flowgger_tpu.tpu.batch import block_fetch_encode, block_submit
+    from flowgger_tpu.utils.metrics import registry as reg
+
+    cfg = Config.from_string("")
+    enc = GelfEncoder(cfg)
+    merger = LineMerger()
+    n = 512 if smoke else 1024
+    corpora = {
+        "rfc5424_gelf": ("rfc5424", RFC5424Decoder(cfg), [
+            f'<34>1 2015-08-05T15:53:45.8Z host{i % 3} app 42 m '
+            f'[x@9 a="v{i}" b="w{i}"] hello msg {i}'.encode()
+            for i in range(n)]),
+        "rfc3164_gelf": ("rfc3164", RFC3164Decoder(cfg), [
+            f'<34>Aug  5 15:53:45 host{i % 3} app[42]: legacy message '
+            f'body {i}'.encode() for i in range(n)]),
+        "ltsv_gelf": ("ltsv", LTSVDecoder(cfg), [
+            f'host:h{i % 3}\ttime:2015-08-05T15:53:45Z\tuser:u{i % 7}\t'
+            f'req:GET /idx {i}\tstatus:200\tmessage:done {i}'.encode()
+            for i in range(n)]),
+        "gelf_gelf": ("gelf", GelfDecoder(cfg), [
+            ('{"version":"1.1","host":"h%d","short_message":"request %d '
+             'done","timestamp":1438790025.5,"_user":"u%d",'
+             '"_status":"200"}' % (i % 3, i, i % 7)).encode()
+            for i in range(n)]),
+    }
+    fetchers = {"rfc5424": rfc5424.decode_rfc5424_fetch,
+                "rfc3164": rfc3164.decode_rfc3164_fetch,
+                "ltsv": ltsv.decode_ltsv_fetch,
+                "gelf": gelf.decode_gelf_fetch}
+    # the fused byte-gates need the device-encode tier armed and, on
+    # hosts whose XLA can't compile the fused programs, an inline eager
+    # run instead of a watchdog decline
+    saved = {k: os.environ.get(k) for k in
+             ("FLOWGGER_DEVICE_ENCODE", "FLOWGGER_COMPILE_TIMEOUT_MS",
+              "FLOWGGER_FUSED_COMPILE_TIMEOUT_MS")}
+    os.environ["FLOWGGER_DEVICE_ENCODE"] = "1"
+    os.environ["FLOWGGER_COMPILE_TIMEOUT_MS"] = "0"
+    os.environ["FLOWGGER_FUSED_COMPILE_TIMEOUT_MS"] = "0"
+    routes_out = {}
+    ok = True
+    try:
+        for name, (fmt, decoder, lines) in corpora.items():
+            packed = pack.pack_lines_2d(lines, 256)
+            ltsv_dec = decoder if fmt == "ltsv" else None
+            route = fused_routes.route_for(fmt, enc, merger, ltsv_dec)
+            # split HOST reference: block-path bytes + its span-channel
+            # D2H volume (context only — it trades D2H for host CPU)
+            handle = block_submit(fmt, packed)
+            host_bpr = sum(np.asarray(v).nbytes for v in
+                           fetchers[fmt](handle).values()) / n
+            res_split, _, _ = block_fetch_encode(
+                fmt, handle, packed, enc, merger, ltsv_dec,
+                route_state={}, allow_device=False)
+            # split DEVICE reference: the two-program decode→encode
+            # pipeline the fusion replaces; counter delta = exact D2H
+            dev0 = reg.get("device_encode_fetch_bytes")
+            with jax.disable_jit():
+                res_dev, _, _ = block_fetch_encode(
+                    fmt, block_submit(fmt, packed), packed, enc,
+                    merger, ltsv_dec, route_state={}, allow_device=True)
+            split_dev_bpr = (reg.get("device_encode_fetch_bytes")
+                             - dev0) / n
+            fus0 = reg.get("device_encode_fetch_bytes")
+            t0 = time.perf_counter()
+            with jax.disable_jit():
+                fh = fused_routes.submit(route, packed)
+                res_fused, _ = fused_routes.fetch_encode(
+                    fh, packed, enc, merger, ltsv_dec, {})
+            wall = time.perf_counter() - t0
+            fused_bytes = reg.get("device_encode_fetch_bytes") - fus0
+            identical = (
+                res_fused is not None
+                and list(res_fused.block.iter_framed())
+                == list(res_split.block.iter_framed())
+                and res_dev is not None
+                and list(res_dev.block.iter_framed())
+                == list(res_split.block.iter_framed()))
+            fetch_bpr = reg.get_gauge(f"fetch_bytes_per_row_{name}")
+            emit_bpr = reg.get_gauge(f"emit_bytes_per_row_{name}")
+            routes_out[name] = {
+                "fetch_bytes_per_row": fetch_bpr,
+                "emit_bytes_per_row": emit_bpr,
+                "split_device_fetch_bytes_per_row":
+                    round(split_dev_bpr, 1),
+                "split_host_fetch_bytes_per_row": round(host_bpr, 1),
+                "fetch_under_emit": bool(fetch_bpr < emit_bpr),
+                "byte_identical_to_split": bool(identical),
+                "lines_per_sec": round(n / max(wall, 1e-9)),
+            }
+            ok &= identical and fused_bytes <= split_dev_bpr * n \
+                and fetch_bpr < emit_bpr
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    payload = {
+        "metric": "fused_routes",
+        "rows": n,
+        # eager execution of the fused programs — NOT an accelerator
+        # rate; the byte/fetch gates are mode-independent
+        "backend": "cpu-fallback-eager",
+        "routes": routes_out,
+        "ok": bool(ok),
+    }
+    extra["fused_routes"] = routes_out
+    print(json.dumps(payload))
+    return ok
+
+
 def smoke_main():
     """``bench.py --smoke``: the CI gate for the overlap executor.
 
@@ -881,7 +1026,14 @@ def smoke_main():
     # tenancy section: admission-overhead micro-gate (<3% of per-chunk
     # e2e cost), template mining rate + ID stability, off-path structure
     tenancy_ok = bench_tenancy(extra, lines)
+    # fused route matrix: byte-identical to the split path + fetched
+    # bytes/row at or under the split path's (and under emitted)
+    fused_ok = bench_fused_routes(extra, smoke=True)
     wall = time.perf_counter() - t_start
+    # the fused gates run the four fused programs eagerly where this
+    # host can't compile them (~40s on a 2-core box), so the smoke
+    # budget is 240s — still bounded, still a CI-friendly gate
+    budget = 240
     print(json.dumps({
         "metric": "e2e_overlap_smoke",
         "e2e_lines_per_sec": serial,
@@ -891,8 +1043,15 @@ def smoke_main():
         "overlap_vs_serial": round(overlap / max(serial, 1), 2),
         "multilane_vs_single_lane": round(multilane / max(overlap, 1), 2),
         "wall_seconds": round(wall, 1),
-        "ok": bool(ok and lanes_ok and tenancy_ok and wall < 120),
+        "ok": bool(ok and lanes_ok and tenancy_ok and fused_ok
+                   and wall < budget),
     }))
+    if not fused_ok:
+        print("SMOKE FAIL: fused-route gates missed (byte identity vs "
+              "the split path, or fetched bytes/row above the split "
+              "path's / the emitted bytes/row — see the fused_routes "
+              "JSON line)", file=sys.stderr)
+        sys.exit(1)
     if not tenancy_ok:
         print("SMOKE FAIL: tenancy gates missed (admission overhead, "
               "template stability, or off-path residue — see the "
@@ -906,8 +1065,8 @@ def smoke_main():
         print(f"SMOKE FAIL: 2-lane dispatch below {LANE_TOL:.2f}x the "
               "1-lane rate", file=sys.stderr)
         sys.exit(1)
-    if wall >= 120:
-        print(f"SMOKE FAIL: {wall:.0f}s exceeds the 120s budget",
+    if wall >= budget:
+        print(f"SMOKE FAIL: {wall:.0f}s exceeds the {budget}s budget",
               file=sys.stderr)
         sys.exit(1)
 
@@ -1033,6 +1192,10 @@ def main():
     extra = {"batch_latency_ms": lat_ms}
     bench_fallback_corpora(jax, jnp, extra, smoke or cpu_fallback)
     bench_host_scaling(lines[:65_536], extra, smoke or cpu_fallback)
+    # fused decode→encode route matrix (before the overlap sections:
+    # its eager fallback leaves no background compiles behind, but the
+    # overlap section's cold device-encode shapes must still run last)
+    bench_fused_routes(extra, smoke or cpu_fallback)
     bench_e2e(lines[:E2E_BATCH], jax, jnp, extra)
     bench_other_configs(jax, jnp, dev, cpu_fallback, smoke, extra)
     # last: a cold device-encode shape here leaves a background compile

@@ -13,14 +13,21 @@ echo "==            byte-identity contracts, exception hygiene, keys) =="
 # pure-ast, no JAX import: fails on any non-baselined FC01-FC05 finding
 python -m flowgger_tpu.analysis --format text .
 
-echo "== overlap-executor smoke (forced 4-device CPU, <120s) =="
-# asserts the in-flight submit/fetch window sustains >= the serial e2e
-# AND 2-lane dispatch sustains >= 0.92x the 1-lane executor (jitter
-# tolerance for small hosts; the ratio itself is in the JSON line)
-JAX_PLATFORMS=cpu timeout 240 python bench.py --smoke
+echo "== overlap-executor + fused-route smoke (forced 4-device CPU, <240s) =="
+# asserts the in-flight submit/fetch window sustains >= the serial e2e,
+# 2-lane dispatch sustains >= 0.92x the 1-lane executor (jitter
+# tolerance for small hosts; the ratio itself is in the JSON line),
+# AND the fused decode→encode routes emit byte-identical output with
+# fetched bytes/row under emitted on every route (fused_routes line)
+JAX_PLATFORMS=cpu timeout 480 python bench.py --smoke
 
 echo "== python test suite (virtual 8-device CPU mesh) =="
-python -m pytest tests/ -q -m "not faults"
+# slow-marked tests are excluded here (pytest.ini tier-1 contract);
+# both current ones still run in CI: the lanes cold-process cache test
+# in the 2-device step below, and the fused deep fuzz via its own
+# dedicated step (running the in-suite wrapper here would execute the
+# same ~10-minute fuzz twice per CI pass)
+python -m pytest tests/ -q -m "not faults and not slow"
 
 echo "== lane-dispatch suite (forced 2-device CPU) =="
 # real multi-lane placement/ordering for tests/test_lanes.py only; the
@@ -33,6 +40,13 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_tenancy.py -q -m "not faults"
 
 echo "== fault-injection suite (robustness degradation paths) =="
 JAX_PLATFORMS=cpu python -m pytest tests/ -q -m "faults and not slow"
+
+echo "== fused-route deep fuzz (slow: eager route matrix vs scalar oracle) =="
+# every fused route (rfc5424/rfc3164/ltsv/gelf -> GELF) over randomized
+# framing vs its scalar oracle, run eagerly so it holds even where this
+# host's XLA cannot compile the fused programs; the larger-budget
+# version is `python tools/deep_fuzz.py --routes fused <seed> <trials>`
+JAX_PLATFORMS=cpu timeout 900 python tools/deep_fuzz.py --routes fused 1 2
 
 echo "== native build =="
 make -C native -s

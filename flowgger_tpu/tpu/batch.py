@@ -151,6 +151,18 @@ class BatchHandler(Handler):
             from ..config import ConfigError
 
             raise ConfigError("input.tpu_sp must be >= 1")
+        # fused decode→encode routes (tpu/fused_routes.py): "auto"
+        # (default) runs the single-program fused tier whenever the
+        # (in-format, out-format) route has a registered fused program,
+        # declining to the split decode/encode path under the compile
+        # watchdog; "off" pins the split path; "on" is "auto" plus a
+        # startup notice when this config can never fuse
+        self._fuse_mode = cfg.lookup_str(
+            "input.tpu_fuse", "input.tpu_fuse must be a string", "auto")
+        if self._fuse_mode not in ("auto", "on", "off"):
+            from ..config import ConfigError
+
+            raise ConfigError("input.tpu_fuse must be auto, on or off")
         # shape bucketing: pack row counts quantize to a small geometric
         # grid so steady-state traffic compiles a handful of shapes
         # (padding rows are masked — emitted bytes never change).  Like
@@ -248,6 +260,17 @@ class BatchHandler(Handler):
                     f"flowgger-tpu: columnar block route disabled for "
                     f"format '{fmt}' ({reason}); throughput falls to the "
                     f"per-record path (~30x slower)", file=sys.stderr)
+            elif self._fuse_mode == "on" and self._fused_route() is None:
+                # the REAL runtime gate (_fused_route), not just
+                # route_for: template mining and a mesh-owned format
+                # also pin the split path, and "on" promises a notice
+                # whenever this config can never fuse
+                print(
+                    'flowgger-tpu: input.tpu_fuse = "on" but this '
+                    f"config cannot fuse format '{fmt}' (no registered "
+                    "fused program for the route, template mining on, "
+                    "or a sharded mesh owns the format); using the "
+                    "split decode/encode path", file=sys.stderr)
         # background kernel prewarm: compile the configured format's
         # decode (+ engaged device-encode) kernels for the shape-bucket
         # grid now, so the first real batch of each steady-state shape
@@ -272,7 +295,13 @@ class BatchHandler(Handler):
                               else None),
                 supervisor=supervisor,
                 devices=[d for d in self._lane_devices if d is not None]
-                or None)
+                or None,
+                # warm the fused program only when dispatch can
+                # actually use it — _fused_route() is the same gate
+                # _emit_fast consults (fuse mode, template mining,
+                # sharded mesh), so prewarm never background-compiles
+                # a program that is never dispatched
+                fused_route=self._fused_route())
 
     @property
     def _econ(self):
@@ -761,6 +790,24 @@ class BatchHandler(Handler):
             return "output.syslog_prepend_timestamp is set"
         return no_columnar
 
+    def _fused_route(self):
+        """The registered fused decode→encode route for this handler's
+        config, or None: fuse mode off, auto format (its per-class legs
+        submit at fetch time), template mining on (the miner consumes
+        host-fetched decode columns the fused tier never materializes),
+        the sharded mesh owning the batch, or simply no fused program
+        for this (format, encoder, merger)."""
+        if (self._fuse_mode == "off" or self.fmt == "auto"
+                or self._mine_block):
+            return None
+        if self._sharded_for(self.fmt) is not None:
+            return None
+        from . import fused_routes
+
+        return fused_routes.route_for(
+            self.fmt, self.encoder, self._merger,
+            self.scalar.decoder if self.fmt == "ltsv" else None)
+
     def _emit_fast(self, packed, deferred=None, runs=None) -> None:
         """Span→bytes encode for one packed tuple: the columnar block
         route when engaged (submitted onto the next dispatch lane; that
@@ -779,6 +826,25 @@ class BatchHandler(Handler):
                 # the per-class legs share one jit cache)
                 self._window.submit(lane, (None, packed, runs))
                 return
+            route = self._fused_route()
+            if route is not None:
+                from . import fused_routes
+
+                state = fused_routes.cooldown_state(
+                    self._device_route_state, route)
+                if state.get("cooldown", 0) > 0:
+                    # fused tier cooling down after declines: stay on
+                    # the split submit below for this batch
+                    state["cooldown"] -= 1
+                elif self._econs[lane % len(self._econs)].allow_fused():
+                    # commit inputs to the lane device now; the fused
+                    # program itself dispatches on the lane fetcher
+                    # thread, where a compile-watchdog wait can never
+                    # stall ingest
+                    self._window.submit(lane, (fused_routes.submit(
+                        route, packed, self._lane_devices[lane]),
+                        packed, runs))
+                    return
             self._window.submit(lane, (block_submit(
                 self.fmt, packed, self._sharded_for(self.fmt),
                 self._lane_devices[lane]), packed, runs))
@@ -883,6 +949,36 @@ class BatchHandler(Handler):
                                  _time.perf_counter() - t0)
             return lambda: self._emit_block(res, packed[5])
         ltsv_dec = self.scalar.decoder if self.fmt == "ltsv" else None
+        from . import fused_routes as _fr
+
+        fused_declined_s = 0.0
+        if isinstance(handle, _fr.FusedHandle):
+            tf0 = _time.perf_counter()
+            fres, ffetch_s = _fr.fetch_encode(
+                handle, packed, self.encoder, self._merger, ltsv_dec,
+                self._device_route_state)
+            if fres is not None:
+                if stats is not None:
+                    stats["path"] = "fused"
+                    stats["declined_s"] = 0.0
+                _metrics.add_seconds("device_fetch_seconds", ffetch_s)
+                _metrics.add_seconds(
+                    "encode_seconds",
+                    _time.perf_counter() - tf0 - ffetch_s)
+                return lambda: self._emit_block(fres, packed[5])
+            # fused tier declined (compile pending, cooldown, or tier
+            # fraction): fall back to the split path right here on the
+            # lane fetcher thread — re-dispatch the split decode on the
+            # same lane device and continue down the existing ladder.
+            # The wall burned by the declined fused attempt is charged
+            # to the decline metric, not to the split path's economics
+            # sample (subtracted via stats["declined_s"] below).
+            fused_declined_s = _time.perf_counter() - tf0
+            _metrics.add_seconds("device_encode_declined_seconds",
+                                 fused_declined_s)
+            _metrics.inc("fused_fallbacks")
+            _metrics.inc(f"fused_fallbacks_{handle.route.name}")
+            handle = block_submit(self.fmt, packed, None, handle.device)
         mined: list = []
         column_tap = None
         if self._mine_block:
@@ -901,7 +997,7 @@ class BatchHandler(Handler):
             allow_device=econ.allow_device() and not self._mine_block,
             stats=stats, column_tap=column_tap)
         if stats is not None:
-            stats["declined_s"] = declined_s
+            stats["declined_s"] = declined_s + fused_declined_s
         if res is None:
             # the route declined after the fact (e.g. an oversized
             # ltsv_schema or a configured suffix): Record path

@@ -21,7 +21,7 @@ import os
 import sys
 import threading
 from functools import lru_cache, partial
-from typing import Dict
+from typing import Dict, Optional
 
 import jax
 import jax.numpy as jnp
@@ -49,12 +49,27 @@ _compile_slots: Dict[str, threading.Event] = {}
 _compile_ready = set()  # names that have completed once: call inline
 _compile_lock = threading.Lock()
 _compile_warned = set()
+# cumulative decline count, independent of the (resettable) metrics
+# registry — tests/conftest.py reads it to turn a watchdog-declined
+# differential test into an informative xfail
+_decline_total = 0
 # single-flight: at most ONE background kernel compile at a time.  The
 # big device-encode compiles are multi-GB XLA jobs; running several
 # concurrently (plus the foreground's own jit work) has crashed the
 # process on constrained hosts.  Queued compiles wait here — their
 # guarded callers decline instantly in the meantime.
 _compile_sema = threading.Semaphore(1)
+# slot name currently holding _compile_sema ("name" key present iff a
+# compile is in flight).  A fresh guarded call observing an in-flight
+# compile declines immediately instead of waiting out a deadline its
+# own queued compile can never meet (the foreground used to stall a
+# full FLOWGGER_COMPILE_TIMEOUT_MS per fresh kernel+shape behind one
+# wedged compile).  A box rather than a bare global so each worker
+# thread clears exactly the instance it marked — tests that swap in an
+# isolated semaphore swap this box alongside it, and an in-flight
+# worker from before the swap can neither corrupt the new box nor
+# leave a stale name in the restored one.
+_compile_active_box: Dict[str, str] = {}
 
 
 class CompileTimeout(Exception):
@@ -70,17 +85,41 @@ def _compile_deadline_s() -> float:
     return ms / 1000.0
 
 
-def guarded_compile_call(name: str, fn, *args):
+def compile_decline_count() -> int:
+    """Process-cumulative watchdog declines (never reset — unlike the
+    metrics registry counter of the same event)."""
+    return _decline_total
+
+
+_decline_count_lock = threading.Lock()
+
+
+def _count_decline() -> None:
+    global _decline_total
+    from ..utils.metrics import registry as _reg
+
+    _reg.inc("device_encode_compile_declines")
+    with _decline_count_lock:
+        _decline_total += 1
+
+
+def guarded_compile_call(name: str, fn, *args, timeout_s=None):
     """Run a (potentially compiling) jit call with a deadline.
 
     Raises CompileTimeout when the call exceeds the deadline — the call
     finishes in a background daemon thread so the jit cache still warms
     — or instantly while that background run is still going.  A value
-    of ``FLOWGGER_COMPILE_TIMEOUT_MS=0`` disables the watchdog."""
-    timeout = _compile_deadline_s()
+    of ``FLOWGGER_COMPILE_TIMEOUT_MS=0`` disables the watchdog.
+    ``timeout_s`` overrides the deadline for this call (the fused-route
+    tier runs its first-compile waits under a tighter budget)."""
+    timeout = _compile_deadline_s() if timeout_s is None else timeout_s
     if timeout <= 0:
         return fn(*args)
     done = threading.Event()
+    # pair the semaphore with its active-slot box at call time, so the
+    # worker marks/clears the same instances the busy check reads even
+    # if a test swaps the module globals mid-flight
+    sema, active = _compile_sema, _compile_active_box
     with _compile_lock:
         if name in _compile_ready:
             # jit cache warm for this name+shape: call inline (also the
@@ -93,22 +132,27 @@ def guarded_compile_call(name: str, fn, *args):
             ready = False
             pending = _compile_slots.get(name)
             if pending is not None and not pending.is_set():
-                from ..utils.metrics import registry as _reg
-
-                _reg.inc("device_encode_compile_declines")
+                _count_decline()
                 raise CompileTimeout(name)
             # claim the slot inside this same critical section so two
             # threads can never spawn duplicate compiles of one kernel
             # (a finished-but-errored slot is simply replaced)
             _compile_slots[name] = done
+            busy = active.get("name")
     if ready:
         return fn(*args)
     box: dict = {}
 
     def run():
         try:
-            with _compile_sema:
-                box["result"] = fn(*args)
+            with sema:
+                with _compile_lock:
+                    active["name"] = name
+                try:
+                    box["result"] = fn(*args)
+                finally:
+                    with _compile_lock:
+                        active.pop("name", None)
         except BaseException as e:  # noqa: BLE001 - ferried to the caller
             box["error"] = e
         else:
@@ -119,10 +163,24 @@ def guarded_compile_call(name: str, fn, *args):
 
     threading.Thread(target=run, daemon=True,
                      name=f"xla-compile:{name}").start()
+    if busy is not None:
+        # another kernel's compile holds the single-flight semaphore
+        # RIGHT NOW, so this one cannot even start XLA work before the
+        # deadline — waiting it out is provably futile.  Decline
+        # immediately (the queued thread still warms the cache once the
+        # semaphore frees); the batch takes the host path meanwhile.
+        # On healthy hosts the semaphore is almost always free, so this
+        # path only engages while a compile is genuinely in flight.
+        _count_decline()
+        if name not in _compile_warned:
+            _compile_warned.add(name)
+            print(
+                f"device-encode kernel [{name}] queued behind the "
+                f"in-flight [{busy}] compile; using the host encode "
+                "path until it lands", file=sys.stderr)
+        raise CompileTimeout(name)
     if not done.wait(timeout):
-        from ..utils.metrics import registry as _reg
-
-        _reg.inc("device_encode_compile_declines")
+        _count_decline()
         if name not in _compile_warned:
             _compile_warned.add(name)
             print(
@@ -155,6 +213,17 @@ _cache_state_lock = threading.Lock()
 _cache_dir_installed = None
 _cache_listener_installed = False
 
+# Kernel ABI revision folded into the persistent-cache directory layout.
+# JAX's cache key covers the traced computation, NOT our kernel-level
+# contracts: a signature/layout change (the PR 4 ``_encode_kernel``
+# elide rework silently invalidated every cached encode entry) leaves
+# stale entries of the OLD kernels poisoning the dir forever and makes
+# "second cold process compiles nothing" silently false after an
+# upgrade.  Bump this whenever a kernel signature, segment layout, or
+# channel contract changes; old revisions keep their own subdirectory
+# and die with ordinary cache cleanup.
+KERNEL_ABI = 7
+
 
 def _install_cache_listener() -> None:
     """Bridge JAX's compilation-cache monitoring events into the metrics
@@ -183,8 +252,13 @@ def enable_compile_cache(cache_dir: str) -> str:
     start counting hits/misses.  Thresholds are dropped to zero so even
     the small decode kernels persist — on hosts where the big encode
     compiles never finish inside the watchdog, the cheap kernels are
-    exactly the ones worth never recompiling."""
-    cache_dir = os.path.expanduser(cache_dir)
+    exactly the ones worth never recompiling.
+
+    The configured directory is versioned by ``KERNEL_ABI``
+    (``<dir>/kabi-<N>``): entries compiled against an older kernel ABI
+    can neither be loaded by mistake nor mask a needed recompile."""
+    cache_dir = os.path.join(os.path.expanduser(cache_dir),
+                             f"kabi-{KERNEL_ABI}")
     os.makedirs(cache_dir, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", cache_dir)
     for knob, val in (("jax_persistent_cache_min_compile_time_secs", 0.0),
@@ -233,7 +307,7 @@ def _zero_packed(rows: int, max_len: int):
 
 def prewarm_kernels(fmt: str, max_len: int, row_buckets, encoder=None,
                     merger=None, ltsv_decoder=None, supervisor=None,
-                    devices=None):
+                    devices=None, fused_route=None):
     """Background-compile ``fmt``'s decode kernel — and, when the
     device-encode route applies (encoder+merger given), its encode
     phases — for every shape in ``row_buckets``.
@@ -275,6 +349,15 @@ def prewarm_kernels(fmt: str, max_len: int, row_buckets, encoder=None,
                         block_fetch_encode(fmt, handle, packed, encoder,
                                            merger, ltsv_decoder,
                                            route_state={})
+                        if fused_route is not None:
+                            # warm the fused single-program route too —
+                            # same guarded/decline semantics
+                            from . import fused_routes as _fr
+
+                            fh = _fr.submit(fused_route, packed, dev)
+                            _fr.fetch_encode(fh, packed, encoder,
+                                             merger, ltsv_decoder,
+                                             route_state={})
                     _reg.inc("prewarmed_shapes")
                 except CompileTimeout:
                     continue  # still compiling in the watchdog's worker
@@ -681,7 +764,9 @@ def fetch_encode_driver(kernel, out, batch_dev, lens_dev, packed, encoder,
                         scalar_fn, fallback_frac: float,
                         decline_limit: int, cooldown: int,
                         ts_keys=("days", "sod", "off", "nanos"),
-                        ts_vals_fn=None, wide=None, elide=None):
+                        ts_vals_fn=None, wide=None, elide=None,
+                        kname_prefix=None, compile_timeout_s=None,
+                        route_label=None, small_fetch_fn=None):
     """Shared fetch flow for every device-encode format:
 
     1. phase-1 tier probe (``kernel(..., assemble=False)`` — XLA
@@ -699,6 +784,15 @@ def fetch_encode_driver(kernel, out, batch_dev, lens_dev, packed, encoder,
        brings fetched bytes/row at or under emitted bytes/row;
     6. syslen prefixing (host splice over the output-sized body);
     7. fallback splicing through ``finish_block``.
+
+    ``kname_prefix`` overrides the compile-watchdog slot namespace (the
+    fused-route closures all live in one module — without it two routes
+    at the same shape would share a slot and mask each other's pending
+    compiles); ``compile_timeout_s`` overrides the watchdog deadline for
+    every guarded call in this flow; ``route_label`` (fused routes)
+    exports per-route ``fetch_bytes_per_row_{label}`` /
+    ``emit_bytes_per_row_{label}`` gauges and the ``fused_rows``
+    counters.
 
     Returns (BlockResult | None, fetch_seconds); None = caller should
     use the span-fetch host path."""
@@ -748,8 +842,12 @@ def fetch_encode_driver(kernel, out, batch_dev, lens_dev, packed, encoder,
         _dev = ",".join(sorted(str(d) for d in batch_dev.devices()))
     except Exception:  # noqa: BLE001 - tracers/older arrays have no .devices()
         _dev = "default"
-    kname = (f"{getattr(kernel, '__module__', 'device')}:"
+    kname = (f"{kname_prefix or getattr(kernel, '__module__', 'device')}:"
              f"{tuple(batch_dev.shape)}:{_dev}")
+
+    def _guarded(slot, fn, *args):
+        return guarded_compile_call(slot, fn, *args,
+                                    timeout_s=compile_timeout_s)
 
     def _declined_compile():
         if route_state is not None:
@@ -758,7 +856,7 @@ def fetch_encode_driver(kernel, out, batch_dev, lens_dev, packed, encoder,
 
     wide_adopted = False
     try:
-        tier1, extra1 = guarded_compile_call(f"{kname}:probe", probe, kernel)
+        tier1, extra1 = _guarded(f"{kname}:probe", probe, kernel)
     except CompileTimeout:
         return _declined_compile()
     if extra1:
@@ -787,7 +885,7 @@ def fetch_encode_driver(kernel, out, batch_dev, lens_dev, packed, encoder,
         else:
             out_w, kernel_w = wide()
             try:
-                tier1w, extraw = guarded_compile_call(
+                tier1w, extraw = _guarded(
                     f"{kname}:probe-wide", probe, kernel_w)
             except CompileTimeout:
                 tier1w = None
@@ -817,7 +915,13 @@ def fetch_encode_driver(kernel, out, batch_dev, lens_dev, packed, encoder,
     if route_state is not None:
         route_state["declines"] = 0
 
-    small = {k: _fetch(out[k]) for k in ("ok",) + tuple(ts_keys)}
+    if small_fetch_fn is not None:
+        # route-provided small-channel fetch (fused ltsv): narrowed
+        # dtypes and kind-conditional channel skips keep the fixed
+        # per-row D2H overhead under the elided-constant savings
+        small = small_fetch_fn(out, _fetch)
+    else:
+        small = {k: _fetch(out[k]) for k in ("ok",) + tuple(ts_keys)}
     # only phase-1 candidates get host timestamp formatting (ADVICE r4):
     # tier-rejected rows (e.g. LTSV float-stamp rows) may hold garbage
     # days/sod and their text is discarded anyway.  Phase-2 acceptance
@@ -832,7 +936,7 @@ def fetch_encode_driver(kernel, out, batch_dev, lens_dev, packed, encoder,
     asm_slot = f"{kname}:assemble-wide" if wide_adopted else \
         f"{kname}:assemble"
     try:
-        acc, out_len, tier = guarded_compile_call(
+        acc, out_len, tier = _guarded(
             asm_slot, kernel, jnp.asarray(ts_text),
             jnp.asarray(ts_len), True)
     except CompileTimeout:
@@ -863,7 +967,7 @@ def fetch_encode_driver(kernel, out, batch_dev, lens_dev, packed, encoder,
             and N_acc * OW > total_bytes * COMPACT_MIN_SAVING):
         # device-side row compaction: D2H ≈ sum(out_len), G-aligned
         try:
-            flat = guarded_compile_call(
+            flat = _guarded(
                 f"{kname}:compact-wide" if wide_adopted
                 else f"{kname}:compact", _compact_kernel, acc, out_len, tier)
         except CompileTimeout:
@@ -894,7 +998,7 @@ def fetch_encode_driver(kernel, out, batch_dev, lens_dev, packed, encoder,
         # stall — it is a pure copy of an existing buffer)
         maxw = min(OW, -(-max(int(gated[:n].max()), 1) // 128) * 128)
         try:
-            trimmed = guarded_compile_call(
+            trimmed = _guarded(
                 f"{kname}:trim:{maxw}", lambda: acc[:n, :maxw])
         except CompileTimeout:
             trimmed = None
@@ -929,6 +1033,23 @@ def fetch_encode_driver(kernel, out, batch_dev, lens_dev, packed, encoder,
     _metrics.inc("device_encode_scalar_rows", int(n - ridx.size))
     _metrics.inc("device_encode_fetch_bytes", fetched[0])
     _metrics.inc("device_encode_out_bytes", len(final_buf))
+    if route_label is not None:
+        _metrics.inc("fused_rows", int(ridx.size))
+        _metrics.inc(f"fused_rows_{route_label}", int(ridx.size))
+        if ridx.size:
+            # ONE denominator for both gauges (tier rows): dividing
+            # fetch by all n rows diluted it whenever fallback rows
+            # existed, reporting fetch<emit even when per-tier-row
+            # fetch exceeded emit.  Tier-row fetch is the conservative
+            # reading — the batch-wide small fetches are all charged to
+            # the tier rows.
+            _metrics.set_gauge(f"fetch_bytes_per_row_{route_label}",
+                               round(fetched[0] / int(ridx.size), 1))
+            # tier-row emitted width (splice constants included), the
+            # number the fetch gauge must stay under
+            _metrics.set_gauge(
+                f"emit_bytes_per_row_{route_label}",
+                round(float(row_off[-1]) / int(ridx.size), 1))
     res = finish_block(chunk, starts64, lens64, n, cand, ridx, final_buf,
                        row_off, prefix_lens_tier, suffix, syslen, merger,
                        encoder, scalar_fn=scalar_fn)

@@ -129,6 +129,13 @@ def _bank(suffix: bytes, extras: Tuple[Tuple[str, str], ...] = ()
     return bank, offs, parts
 
 
+def elide_spec(suffix: bytes, extras=()):
+    """(head, ts-label, tail) constants the elided kernel skips and the
+    host splice restores — single source shared with the fused route."""
+    _, _, parts = _bank(suffix, extras)
+    return (parts["open"], parts["ts"], parts["tail"] + suffix)
+
+
 @partial(jax.jit, static_argnames=("suffix", "max_sd", "impl",
                                    "assemble", "extras", "elide"))
 def _encode_kernel(batch, lens, dec, ts_text, ts_len, *, suffix: bytes,
@@ -311,8 +318,7 @@ def fetch_encode(handle, packed, encoder, merger, route_state=None):
     # never cross PCIe — the kernel skips them and the driver splices
     # these exact host-tier bytes back (same _bank the kernel uses, so
     # the two sides cannot disagree)
-    _, _, parts = _bank(suffix, extras)
-    elide_spec = (parts["open"], parts["ts"], parts["tail"] + suffix)
+    espec = elide_spec(suffix, extras)
 
     def kernel(ts_text, ts_len, assemble):
         return _encode_kernel(batch_dev, lens_dev, dict(out), ts_text,
@@ -345,4 +351,4 @@ def fetch_encode(handle, packed, encoder, merger, route_state=None):
         kernel, out, batch_dev, lens_dev, packed, encoder, merger,
         route_state, suffix, syslen, scalar_fn=_scalar_line,
         fallback_frac=FALLBACK_FRAC, decline_limit=DECLINE_LIMIT,
-        cooldown=COOLDOWN, wide=wide, elide=elide_spec)
+        cooldown=COOLDOWN, wide=wide, elide=espec)

@@ -95,9 +95,9 @@ _PARTS = {
 }
 
 
-@partial(jax.jit, static_argnames=("suffix", "assemble"))
+@partial(jax.jit, static_argnames=("suffix", "assemble", "elide"))
 def _encode_kernel(batch, lens, dec, ts_text, ts_len, *, suffix: bytes,
-                   assemble: bool = True):
+                   assemble: bool = True, elide: bool = False):
     N, L = batch.shape
     bank, off = build_bank(dict(_PARTS), suffix)
     F = dec["key_start"].shape[1]
@@ -360,7 +360,11 @@ def _encode_kernel(batch, lens, dec, ts_text, ts_len, *, suffix: bytes,
     cbase = L
     tbase = L + len(bank)
     zero = jnp.zeros((N,), dtype=_I32)
-    segs = [(zero + (cbase + off["open"]), zero + 1)]
+    # elide=True: the "{" head, '"timestamp":' label, and
+    # ',"version":"1.1"}'+suffix tail stay off the device row — the
+    # host splice restores them (device_common.splice_elided_rows)
+    segs = ([] if elide
+            else [(zero + (cbase + off["open"]), zero + 1)])
     for p in range(F):
         pv = p < pair_count
         us = cols["us"][p] == 1
@@ -409,12 +413,15 @@ def _encode_kernel(batch, lens, dec, ts_text, ts_len, *, suffix: bytes,
         (jnp.where(has_short, short_a, cbase + off["dash"]),
          jnp.where(has_short, short_b - short_a, 1)),
         (zero + (cbase + off["qc"]), zero + 2),
-        (zero + (cbase + off["ts"]), zero + len(_PARTS["ts"])),
-        (zero + tbase, ts_len.astype(_I32)),
-        (zero + (cbase + off["comma"]), zero + 1),
-        (zero + (cbase + off["tail"]),
-         zero + len(_PARTS["tail"]) + len(suffix)),
     ]
+    if not elide:
+        segs.append((zero + (cbase + off["ts"]),
+                     zero + len(_PARTS["ts"])))
+    segs.append((zero + tbase, ts_len.astype(_I32)))
+    if not elide:
+        segs.append((zero + (cbase + off["comma"]), zero + 1))
+        segs.append((zero + (cbase + off["tail"]),
+                     zero + len(_PARTS["tail"]) + len(suffix)))
 
     out_len = segs[0][1]
     for _, ln in segs[1:]:
@@ -439,6 +446,30 @@ def route_ok(encoder, merger) -> bool:
     return gelf_route_ok(encoder, merger, lambda e: False)
 
 
+TS_KEYS = ("ts_hi", "ts_lo", "ts_meta")
+
+
+def ts_vals_gelf(small, okh):
+    """Combine the kernel's split-integer parse; sign rides
+    ts_meta bit 16 (canonical JSON allows negative stamps).  Shared
+    by the split and fused gelf→GELF tiers."""
+    import numpy as np
+
+    hi = small["ts_hi"].astype(np.float64)
+    lo = small["ts_lo"].astype(np.float64)
+    meta = small["ts_meta"]
+    frac = (meta & 255).astype(np.int64)
+    sign = np.where((meta >> 16) & 1, -1.0, 1.0)
+    return sign * (hi * 1e9 + lo) / np.power(10.0, frac)
+
+
+def elide_spec(suffix: bytes):
+    """(head, ts-label, tail) constants the elided kernel skips and the
+    host splice restores — single source shared with the fused route."""
+    return (_PARTS["open"], _PARTS["ts"],
+            _PARTS["comma"] + _PARTS["tail"] + suffix)
+
+
 def fetch_encode(handle, packed, encoder, merger, route_state=None):
     """Device gelf→GELF encode for a submitted gelf decode handle;
     returns (BlockResult | None, fetch_seconds)."""
@@ -450,7 +481,8 @@ def fetch_encode(handle, packed, encoder, merger, route_state=None):
 
     def kernel(ts_text, ts_len, assemble):
         return _encode_kernel(batch_dev, lens_dev, dict(out), ts_text,
-                              ts_len, suffix=suffix, assemble=assemble)
+                              ts_len, suffix=suffix, assemble=assemble,
+                              elide=True)
 
     def wide():
         """16-field escalation: re-decode wider (the [N, F] field axis
@@ -465,25 +497,13 @@ def fetch_encode(handle, packed, encoder, merger, route_state=None):
         def kernel_w(ts_text, ts_len, assemble):
             return _encode_kernel(batch_dev, lens_dev, dict(out_w),
                                   ts_text, ts_len, suffix=suffix,
-                                  assemble=assemble)
+                                  assemble=assemble, elide=True)
         return out_w, kernel_w
-
-    def ts_vals_fn(small, okh):
-        """Combine the kernel's split-integer parse; sign rides
-        ts_meta bit 16 (canonical JSON allows negative stamps)."""
-        import numpy as np
-
-        hi = small["ts_hi"].astype(np.float64)
-        lo = small["ts_lo"].astype(np.float64)
-        meta = small["ts_meta"]
-        frac = (meta & 255).astype(np.int64)
-        sign = np.where((meta >> 16) & 1, -1.0, 1.0)
-        return sign * (hi * 1e9 + lo) / np.power(10.0, frac)
 
     return fetch_encode_driver(
         kernel, out, batch_dev, lens_dev, packed, encoder, merger,
         route_state, suffix, syslen, scalar_fn=_scalar_gelf,
         fallback_frac=FALLBACK_FRAC, decline_limit=DECLINE_LIMIT,
         cooldown=COOLDOWN,
-        ts_keys=("ts_hi", "ts_lo", "ts_meta"), ts_vals_fn=ts_vals_fn,
-        wide=wide)
+        ts_keys=TS_KEYS, ts_vals_fn=ts_vals_gelf,
+        wide=wide, elide=elide_spec(suffix))

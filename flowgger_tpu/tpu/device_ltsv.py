@@ -115,11 +115,18 @@ def _bank(suffix: bytes, extras=()):
     return bank, offs, parts
 
 
+def elide_spec(suffix: bytes, extras=()):
+    """(head, ts-label, tail) constants the elided kernel skips and the
+    host splice restores — single source shared with the fused route."""
+    _, _, parts = _bank(suffix, extras)
+    return (parts["open"], parts["ts"], parts["tail"] + suffix)
+
+
 @partial(jax.jit, static_argnames=("suffix", "impl", "assemble",
-                                   "extras", "max_pairs"))
+                                   "extras", "max_pairs", "elide"))
 def _encode_kernel(batch, lens, dec, ts_text, ts_len, *, suffix: bytes,
                    impl: str, assemble: bool = True, extras=(),
-                   max_pairs: int = MAX_DEV_PAIRS):
+                   max_pairs: int = MAX_DEV_PAIRS, elide: bool = False):
     N, L = batch.shape
     bank, off, parts = _bank(suffix, extras)
     OW = _out_width(L, L + E_CAP + len(bank) + TS_W)
@@ -206,8 +213,11 @@ def _encode_kernel(batch, lens, dec, ts_text, ts_len, *, suffix: bytes,
     cbase = EW
     tbase = EW + len(bank)
     zero = jnp.zeros((N,), dtype=_I32)
-    segs = [(zero + (cbase + off["open"]),
-             zero + len(parts["open"]))]
+    # elide=True: the row-constant head/ts-label/tail segments stay off
+    # the device row; the host splice restores them post-fetch
+    # (device_common.splice_elided_rows)
+    segs = [] if elide else [(zero + (cbase + off["open"]),
+                              zero + len(parts["open"]))]
     for p in range(max_pairs):
         pv = p < pair_count
         segs.append((zero + (cbase + off["p0"]),
@@ -244,11 +254,14 @@ def _encode_kernel(batch, lens, dec, ts_text, ts_len, *, suffix: bytes,
          jnp.where(has_msg, 1, len(_C_DASH))),
         (msg_s, jnp.where(has_msg, msg_e - msg_s, 0)),
         (zero + qsrc, jnp.where(has_msg, 1, 0)),
-        (zero + (cbase + off["ts"]), zero + len(parts["ts"])),
-        (zero + tbase, ts_len.astype(_I32)),
-        (zero + (cbase + off["tail"]),
-         zero + len(parts["tail"]) + len(suffix)),
     ]
+    if not elide:
+        segs.append((zero + (cbase + off["ts"]),
+                     zero + len(parts["ts"])))
+    segs.append((zero + tbase, ts_len.astype(_I32)))
+    if not elide:
+        segs.append((zero + (cbase + off["tail"]),
+                     zero + len(parts["tail"]) + len(suffix)))
 
     out_len = segs[0][1]
     for _, ln in segs[1:]:
@@ -302,6 +315,29 @@ def route_ok(encoder, merger, decoder=None) -> bool:
         lambda e: gelf_extra_consts_ltsv(e) is not None)
 
 
+TS_KEYS = ("days", "sod", "off", "nanos", "ts_kind",
+           "ts_hi", "ts_lo", "ts_meta")
+
+
+def ts_vals_ltsv(small, okh):
+    """rfc3339 rows combine days/sod/off/nanos; float-span rows
+    combine the kernel's exact split-integer parse (vectorized —
+    no per-row Python).  Shared by the split and fused ltsv tiers."""
+    import numpy as np
+
+    from .materialize import compute_ts
+
+    kind = small["ts_kind"]
+    rfc = okh & (kind == 0)
+    masked = {k: np.where(rfc, small[k], 0)
+              for k in ("days", "sod", "off", "nanos")}
+    vals = compute_ts(masked)
+    fv = ((small["ts_hi"].astype(np.float64) * 1e9
+           + small["ts_lo"].astype(np.float64))
+          / np.power(10.0, (small["ts_meta"] & 255).astype(np.int64)))
+    return np.where(okh & (kind == 1), fv, vals)
+
+
 def fetch_encode(handle, packed, encoder, merger, route_state=None,
                  decoder=None):
     """Device ltsv→GELF encode for a submitted ltsv decode handle;
@@ -313,11 +349,15 @@ def fetch_encode(handle, packed, encoder, merger, route_state=None,
     suffix, syslen = merger_suffix(merger)
     impl = best_scan_impl()
     extras = tuple((k, v) for k, v in getattr(encoder, "extra", ()))
+    # constant elision, extended from the rfc5424→GELF leg: head /
+    # ts-label / tail never cross PCIe, the splice restores them
+    espec = elide_spec(suffix, extras)
 
     def kernel(ts_text, ts_len, assemble):
         return _encode_kernel(batch_dev, lens_dev, dict(out), ts_text,
                               ts_len, suffix=suffix, impl=impl,
-                              assemble=assemble, extras=extras)
+                              assemble=assemble, extras=extras,
+                              elide=True)
 
     def wide():
         """16-pair escalation kernel (lazy: compiled only when a batch
@@ -326,35 +366,15 @@ def fetch_encode(handle, packed, encoder, merger, route_state=None,
             return _encode_kernel(batch_dev, lens_dev, dict(out), ts_text,
                                   ts_len, suffix=suffix, impl=impl,
                                   assemble=assemble, extras=extras,
-                                  max_pairs=WIDE_DEV_PAIRS)
+                                  max_pairs=WIDE_DEV_PAIRS, elide=True)
         return out, kernel_w
 
     def scalar_fn(line):
         return _scalar_ltsv(decoder, line)
 
-    def ts_vals_fn(small, okh):
-        """rfc3339 rows combine days/sod/off/nanos; float-span rows
-        combine the kernel's exact split-integer parse (vectorized —
-        no per-row Python)."""
-        import numpy as np
-
-        from .materialize import compute_ts
-
-        kind = small["ts_kind"]
-        rfc = okh & (kind == 0)
-        masked = {k: np.where(rfc, small[k], 0)
-                  for k in ("days", "sod", "off", "nanos")}
-        vals = compute_ts(masked)
-        fv = ((small["ts_hi"].astype(np.float64) * 1e9
-               + small["ts_lo"].astype(np.float64))
-              / np.power(10.0, (small["ts_meta"] & 255).astype(np.int64)))
-        return np.where(okh & (kind == 1), fv, vals)
-
     return fetch_encode_driver(
         kernel, out, batch_dev, lens_dev, packed, encoder, merger,
         route_state, suffix, syslen, scalar_fn=scalar_fn,
         fallback_frac=FALLBACK_FRAC, decline_limit=DECLINE_LIMIT,
-        cooldown=COOLDOWN,
-        ts_keys=("days", "sod", "off", "nanos", "ts_kind",
-                 "ts_hi", "ts_lo", "ts_meta"),
-        ts_vals_fn=ts_vals_fn, wide=wide)
+        cooldown=COOLDOWN, ts_keys=TS_KEYS,
+        ts_vals_fn=ts_vals_ltsv, wide=wide, elide=espec)

@@ -88,10 +88,18 @@ def _bank(suffix: bytes, extras=()):
     return bank, offs, parts
 
 
+def elide_spec(suffix: bytes, extras=()):
+    """(head, ts-label, tail) constants the elided kernel skips and the
+    host splice restores — single source shared with the fused route."""
+    _, _, parts = _bank(suffix, extras)
+    return (parts["open"], parts["ts"], parts["tail"] + suffix)
+
+
 @partial(jax.jit, static_argnames=("suffix", "impl", "assemble",
-                                   "extras"))
+                                   "extras", "elide"))
 def _encode_kernel(batch, lens, dec, ts_text, ts_len, *, suffix: bytes,
-                   impl: str, assemble: bool = True, extras=()):
+                   impl: str, assemble: bool = True, extras=(),
+                   elide: bool = False):
     N, L = batch.shape
     bank, off, parts = _bank(suffix, extras)
     OW = _out_width(L, L + E_CAP + len(bank) + TS_W)
@@ -111,8 +119,14 @@ def _encode_kernel(batch, lens, dec, ts_text, ts_len, *, suffix: bytes,
     cbase = EW
     tbase = EW + len(bank)
     zero = jnp.zeros((N,), dtype=_I32)
-    segs = [
+    # constant-elision mode (elide=True) skips the row-constant head,
+    # timestamp-label, and tail segments: the host splice restores them
+    # after an output-sized variable-bytes-only D2H fetch
+    # (device_common.splice_elided_rows — same contract as device_gelf)
+    segs = [] if elide else [
         (zero + (cbase + off["open"]), zero + len(parts["open"])),
+    ]
+    segs += [
         (zero, row_e),                                   # full_message
         (zero + (cbase + off["host"]), zero + len(parts["host"])),
         (host_s, jnp.maximum(host_e - host_s, 0)),
@@ -131,11 +145,13 @@ def _encode_kernel(batch, lens, dec, ts_text, ts_len, *, suffix: bytes,
          jnp.where(has_pri, len(parts["short_p"]),
                    len(parts["short_n"]))),
         (msg_s, jnp.maximum(row_e - msg_s, 0)),          # short_message
-        (zero + (cbase + off["ts"]), zero + len(parts["ts"])),
-        (zero + tbase, ts_len.astype(_I32)),
-        (zero + (cbase + off["tail"]),
-         zero + len(parts["tail"]) + len(suffix)),
     ]
+    if not elide:
+        segs.append((zero + (cbase + off["ts"]), zero + len(parts["ts"])))
+    segs.append((zero + tbase, ts_len.astype(_I32)))
+    if not elide:
+        segs.append((zero + (cbase + off["tail"]),
+                     zero + len(parts["tail"]) + len(suffix)))
 
     out_len = segs[0][1]
     for _, ln in segs[1:]:
@@ -177,14 +193,19 @@ def fetch_encode(handle, packed, encoder, merger, route_state=None):
     suffix, syslen = merger_suffix(merger)
     impl = best_scan_impl()
     extras = tuple((k, v) for k, v in getattr(encoder, "extra", ()))
+    # constant elision (PR 4's rfc5424→GELF win, extended here): the
+    # head, timestamp-label, and tail constants never cross PCIe — the
+    # splice restores the exact host-tier bytes (same _bank both sides)
+    espec = elide_spec(suffix, extras)
 
     def kernel(ts_text, ts_len, assemble):
         return _encode_kernel(batch_dev, lens_dev, dict(out), ts_text,
                               ts_len, suffix=suffix, impl=impl,
-                              assemble=assemble, extras=extras)
+                              assemble=assemble, extras=extras,
+                              elide=True)
 
     return fetch_encode_driver(
         kernel, out, batch_dev, lens_dev, packed, encoder, merger,
         route_state, suffix, syslen, scalar_fn=_scalar_3164,
         fallback_frac=FALLBACK_FRAC, decline_limit=DECLINE_LIMIT,
-        cooldown=COOLDOWN)
+        cooldown=COOLDOWN, elide=espec)

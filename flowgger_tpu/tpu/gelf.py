@@ -360,6 +360,13 @@ def decode_gelf_fetch(handle):
                           RESCUE_MAX_FIELDS)
 
 
-@functools.partial(jax.jit, static_argnames=("max_fields",))
-def decode_gelf_jit(batch, lens, max_fields=DEFAULT_MAX_FIELDS):
-    return decode_gelf(batch, lens, max_fields=max_fields)
+@functools.partial(jax.jit, static_argnames=("max_fields", "demand"))
+def decode_gelf_jit(batch, lens, max_fields=DEFAULT_MAX_FIELDS,
+                    demand=None):
+    """``demand`` (static frozenset): keep only the channels the
+    consumer reads so XLA dead-code-eliminates the rest (fused
+    gelf→GELF route)."""
+    out = decode_gelf(batch, lens, max_fields=max_fields)
+    if demand is not None:
+        out = {k: v for k, v in out.items() if k in demand}
+    return out

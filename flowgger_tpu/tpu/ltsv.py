@@ -327,9 +327,15 @@ def decode_ltsv(batch: jnp.ndarray, lens: jnp.ndarray,
     }
 
 
-@functools.partial(jax.jit, static_argnames=("max_parts",))
-def decode_ltsv_jit(batch, lens, max_parts=DEFAULT_MAX_PARTS):
-    return decode_ltsv(batch, lens, max_parts=max_parts)
+@functools.partial(jax.jit, static_argnames=("max_parts", "demand"))
+def decode_ltsv_jit(batch, lens, max_parts=DEFAULT_MAX_PARTS, demand=None):
+    """``demand`` (static frozenset): keep only the channels the
+    consumer reads so XLA dead-code-eliminates the rest — the fused
+    ltsv→GELF route drops e.g. the raw ts span channels."""
+    out = decode_ltsv(batch, lens, max_parts=max_parts)
+    if demand is not None:
+        out = {k: v for k, v in out.items() if k in demand}
+    return out
 
 
 def decode_ltsv_submit(batch, lens, sharded=None):

@@ -446,8 +446,42 @@ class RouteEconomics:
         # per-lane economics so one sick chip degrades alone, visibly
         self.label = label
         self._lock = threading.Lock()
-        self._spr = {"device": None, "host": None}  # EWMA seconds/row
+        # EWMA seconds/row per path: "fused" (single-program
+        # decode→encode, tpu/fused_routes.py), "device" (split decode +
+        # device encode), "host" (split decode + host block encode)
+        self._spr = {"fused": None, "device": None, "host": None}
         self._batches = 0
+        self._fused_batches = 0
+
+    def allow_fused(self) -> bool:
+        """Fused-vs-split arm of the economics, decided at submit time
+        (the fused/split choice changes what gets dispatched).  Probing
+        order mirrors allow_device: the fused tier goes first; while it
+        measures at accelerator speed the split path is never paid.  A
+        slow fused tier buys split batches for the comparison, after
+        which the loser re-probes every ``probe_every`` batches.  The
+        split path's own device-vs-host economics stay in
+        ``allow_device`` — this arm only picks which pipeline runs."""
+        if not self.enabled:
+            return True
+        with self._lock:
+            self._fused_batches += 1
+            fused = self._spr["fused"]
+            split = [v for v in (self._spr["device"], self._spr["host"])
+                     if v is not None]
+            best_split = min(split) if split else None
+            if fused is None:
+                return True          # no fused sample yet: probe it
+            if best_split is None:
+                # healthy fused tier: never pay the split comparison; a
+                # slow-measuring one buys split batches to compare
+                return fused <= self.ok_spr
+            probe = self._fused_batches % self.probe_every == 0
+            if fused > best_split * self.margin:
+                return probe         # fused losing: re-probe on schedule
+            if best_split > fused * self.margin:
+                return not probe     # split losing: re-sample on schedule
+            return True              # within noise: prefer fused
 
     def allow_device(self) -> bool:
         if not self.enabled:
@@ -482,7 +516,8 @@ class RouteEconomics:
 
     def snapshot(self) -> dict:
         with self._lock:
-            return {"device_s_per_row": self._spr["device"],
+            return {"fused_s_per_row": self._spr["fused"],
+                    "device_s_per_row": self._spr["device"],
                     "host_s_per_row": self._spr["host"],
                     "batches": self._batches}
 

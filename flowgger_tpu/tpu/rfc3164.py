@@ -205,9 +205,15 @@ def decode_rfc3164(batch: jnp.ndarray, lens: jnp.ndarray, year,
     }
 
 
-@functools.partial(jax.jit, static_argnames=())
-def decode_rfc3164_jit(batch, lens, year):
-    return decode_rfc3164(batch, lens, year)
+@functools.partial(jax.jit, static_argnames=("demand",))
+def decode_rfc3164_jit(batch, lens, year, demand=None):
+    """``demand`` (static frozenset): keep only the channels the
+    consumer reads so XLA dead-code-eliminates the rest (the fused
+    rfc3164→GELF route drops e.g. the facility channel)."""
+    out = decode_rfc3164(batch, lens, year)
+    if demand is not None:
+        out = {k: v for k, v in out.items() if k in demand}
+    return out
 
 
 def decode_rfc3164_submit(batch, lens, sharded=None):

@@ -824,11 +824,22 @@ def decode_rfc5424(batch: jnp.ndarray, lens: jnp.ndarray,
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("max_sd", "max_pairs", "extract_impl"))
+                   static_argnames=("max_sd", "max_pairs", "extract_impl",
+                                    "demand"))
 def decode_rfc5424_jit(batch, lens, max_sd=DEFAULT_MAX_SD,
-                       max_pairs=DEFAULT_MAX_PAIRS, extract_impl="sum"):
-    return decode_rfc5424(batch, lens, max_sd=max_sd, max_pairs=max_pairs,
-                          extract_impl=extract_impl)
+                       max_pairs=DEFAULT_MAX_PAIRS, extract_impl="sum",
+                       demand=None):
+    """``demand`` (static frozenset of channel names, On-Demand parsing
+    per arxiv 2312.17149) keeps only the channels the consumer actually
+    reads: dropping a channel from the traced output makes every
+    computation feeding only it dead code, so XLA never materializes the
+    fields the output format elides (e.g. msgid/facility on the GELF
+    route).  None = the full channel dict (host materializers)."""
+    out = decode_rfc5424(batch, lens, max_sd=max_sd, max_pairs=max_pairs,
+                         extract_impl=extract_impl)
+    if demand is not None:
+        out = {k: v for k, v in out.items() if k in demand}
+    return out
 
 
 _PAIR_KEYS = ("name_start", "name_end", "val_start", "val_end",

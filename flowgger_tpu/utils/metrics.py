@@ -30,6 +30,18 @@ shape packed so far), and the ``compile_cache_hits`` /
 cold process of an identical config with ``input.tpu_compile_cache_dir``
 set should report zero misses.
 
+Fused decode→encode routes (tpu/fused_routes.py): ``fused_rows`` (rows
+emitted through a fused single-program route, plus the per-route
+``fused_rows_{route}`` family), ``fused_fallbacks`` (batches that
+declined from the fused tier to the split path, plus
+``fused_fallbacks_{route}``), and the per-route
+``fetch_bytes_per_row_{route}`` / ``emit_bytes_per_row_{route}`` gauges
+— the fused acceptance is fetch under emit on every route.  Fused
+compile-watchdog declines fold into the shared
+``device_encode_compile_declines`` counter; per-lane fused-vs-split
+economics export as ``lane{i}_route_fused_spr`` alongside the
+device/host gauges.
+
 Multi-tenant serving (tenancy/): per-tenant ``tenant_{name}_lines`` /
 ``_bytes`` (admitted), ``_drops`` (admission denials), ``_shed``
 (queue-pressure sheds) counters and the ``tenant_{name}_state`` gauge

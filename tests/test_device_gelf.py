@@ -93,6 +93,7 @@ CLEAN = [
 
 @pytest.mark.parametrize("merger", [None, LineMerger(), NulMerger()],
                          ids=["noop", "line", "nul"])
+@pytest.mark.requires_device_encode_compile
 def test_device_matches_scalar_and_engages(merger):
     n0 = metrics.get("device_encode_rows")
     res, _ = run_device(CLEAN * 3, merger)
@@ -102,6 +103,7 @@ def test_device_matches_scalar_and_engages(merger):
     assert res.block.data == want
 
 
+@pytest.mark.requires_device_encode_compile
 def test_device_fallback_splicing(monkeypatch):
     monkeypatch.setattr(device_gelf, "FALLBACK_FRAC", 1.1)
     mixed = [
@@ -128,6 +130,7 @@ def test_device_declines_on_heavy_fallback():
     assert res is None
 
 
+@pytest.mark.requires_device_encode_compile
 def test_ambiguous_long_names_fall_back(monkeypatch):
     monkeypatch.setattr(device_gelf, "FALLBACK_FRAC", 1.1)
     lines = [
@@ -144,6 +147,7 @@ def test_ambiguous_long_names_fall_back(monkeypatch):
     assert res.block.data == want
 
 
+@pytest.mark.requires_device_encode_compile
 def test_sorted_pair_order_device():
     lines = [
         b'<13>1 2023-09-20T12:35:45.123Z h a - - '
@@ -155,6 +159,7 @@ def test_sorted_pair_order_device():
     assert res.block.data == want
 
 
+@pytest.mark.requires_device_encode_compile
 def test_timestamp_forms_device():
     lines = [
         b'<13>1 2023-09-20T12:35:45Z h a - - - integral seconds',
@@ -169,6 +174,7 @@ def test_timestamp_forms_device():
     assert res.block.data == want
 
 
+@pytest.mark.requires_device_encode_compile
 def test_device_fuzz_vs_scalar(monkeypatch):
     monkeypatch.setattr(device_gelf, "FALLBACK_FRAC", 1.1)
     rng = random.Random(42)
@@ -194,6 +200,7 @@ def test_device_fuzz_vs_scalar(monkeypatch):
         assert res.block.data == want
 
 
+@pytest.mark.requires_device_encode_compile
 def test_batch_handler_uses_device_engine():
     tx = queue.Queue()
     h = BatchHandler(tx, ORACLE, ENC, Config.from_string(""),
@@ -216,6 +223,7 @@ def test_device_disabled_by_env(monkeypatch):
     assert not device_gelf.route_ok(ENC, LineMerger())
 
 
+@pytest.mark.requires_device_encode_compile
 def test_decline_hysteresis():
     bad = [b"not a syslog line"] * 20 + [CLEAN[0]]
     packed = pack.pack_lines_2d(bad, 256)
@@ -236,6 +244,7 @@ def test_decline_hysteresis():
     assert state["cooldown"] == device_gelf.COOLDOWN - 1
 
 
+@pytest.mark.requires_device_encode_compile
 def test_compaction_fetch_is_output_sized():
     """On-device row compaction: highly variable row lengths, some
     fallback rows mixed in — output must stay byte-identical to the
@@ -342,6 +351,7 @@ def test_record_path_cliff_warns_at_startup(capsys):
         assert "block route disabled" not in capsys.readouterr().err
 
 
+@pytest.mark.requires_device_encode_compile
 def test_device_syslen_framing_matches_scalar():
     """Syslen framing on the device route: the length prefix is spliced
     host-side over the output-sized device body; bytes must equal the
@@ -361,6 +371,7 @@ def _extra_enc(pairs_toml):
     return GelfEncoder(Config.from_string(f"[output.gelf_extra]\n{pairs_toml}"))
 
 
+@pytest.mark.requires_device_encode_compile
 def test_gelf_extra_static_slots_device_and_host():
     """gelf_extra as constant segments: keys covering every static
     insertion slot (before pairs, between each fixed key, after
@@ -432,6 +443,7 @@ def test_gelf_extra_dynamic_keys_take_record_path():
     assert h2._block_route_ok()
 
 
+@pytest.mark.requires_device_encode_compile
 def test_device_gelf_wide_pair_escalation():
     """Round-5: a 7..16-pair SD stream declines the 6-pair tier but
     rides the 16-pair wide kernel (re-decode at the rescue width +

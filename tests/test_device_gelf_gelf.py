@@ -52,6 +52,7 @@ CLEAN = [
 @pytest.mark.parametrize("merger", [None, LineMerger(), NulMerger(),
                                     SyslenMerger()],
                          ids=["noop", "line", "nul", "syslen"])
+@pytest.mark.requires_device_encode_compile
 def test_device_gelf_gelf_matches_scalar_and_engages(merger):
     n0 = metrics.get("device_encode_rows")
     res, _ = run_device(CLEAN * 4, merger)
@@ -61,6 +62,7 @@ def test_device_gelf_gelf_matches_scalar_and_engages(merger):
     assert res.block.data == want
 
 
+@pytest.mark.requires_device_encode_compile
 def test_device_gelf_gelf_fallback_splicing(monkeypatch):
     monkeypatch.setattr(device_gelf_gelf, "FALLBACK_FRAC", 1.1)
     mixed = [
@@ -91,6 +93,7 @@ def test_device_gelf_gelf_fallback_splicing(monkeypatch):
     assert res.block.data == want
 
 
+@pytest.mark.requires_device_encode_compile
 def test_device_gelf_gelf_wide_field_escalation():
     """9..16-field objects decline the 8-field decode but ride the
     16-field re-decode through the wide hook."""
@@ -109,6 +112,7 @@ def test_device_gelf_gelf_wide_field_escalation():
     assert res.block.data == b"".join(scalar_frames(rows, LineMerger()))
 
 
+@pytest.mark.requires_device_encode_compile
 def test_device_gelf_gelf_fuzz_vs_scalar(monkeypatch):
     monkeypatch.setattr(device_gelf_gelf, "FALLBACK_FRAC", 1.1)
     rng = random.Random(29)

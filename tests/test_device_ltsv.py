@@ -55,6 +55,7 @@ CLEAN = [
 @pytest.mark.parametrize("merger", [None, LineMerger(), NulMerger(),
                                     SyslenMerger()],
                          ids=["noop", "line", "nul", "syslen"])
+@pytest.mark.requires_device_encode_compile
 def test_device_ltsv_matches_scalar_and_engages(merger):
     n0 = metrics.get("device_encode_rows")
     res, _ = run_device(CLEAN * 4, merger)
@@ -64,6 +65,7 @@ def test_device_ltsv_matches_scalar_and_engages(merger):
     assert res.block.data == want
 
 
+@pytest.mark.requires_device_encode_compile
 def test_device_ltsv_fallback_splicing(monkeypatch):
     monkeypatch.setattr(device_ltsv, "FALLBACK_FRAC", 1.1)
     mixed = [
@@ -88,6 +90,7 @@ def test_device_ltsv_fallback_splicing(monkeypatch):
     assert res.block.data == want
 
 
+@pytest.mark.requires_device_encode_compile
 def test_device_ltsv_fuzz_vs_scalar(monkeypatch):
     monkeypatch.setattr(device_ltsv, "FALLBACK_FRAC", 1.1)
     rng = random.Random(13)
@@ -112,6 +115,7 @@ def test_device_ltsv_fuzz_vs_scalar(monkeypatch):
         assert res.block.data == want
 
 
+@pytest.mark.requires_device_encode_compile
 def test_batch_handler_ltsv_uses_device_engine():
     tx = queue.Queue()
     h = BatchHandler(tx, ORACLE, ENC, Config.from_string(""),
@@ -198,6 +202,7 @@ def test_ltsv_gelf_extra_static_slots_host_tier():
     assert gelf_extra_consts_ltsv(bad.extra) is None
 
 
+@pytest.mark.requires_device_encode_compile
 def test_device_ltsv_unix_literal_stamps_ride_device_tier():
     """Round-5: unsigned unix-literal stamps within f64's exact-integer
     range decode + encode fully on-device (the split-integer parse);
@@ -236,6 +241,7 @@ def test_device_ltsv_unix_literal_stamps_ride_device_tier():
     assert res2.block.data == b"".join(scalar_frames(mixed, LineMerger()))
 
 
+@pytest.mark.requires_device_encode_compile
 def test_device_ltsv_wide_pair_escalation():
     """Round-5: 7..16-pair LTSV rows ride the 16-pair wide kernel."""
     pairs10 = [

@@ -52,6 +52,7 @@ CLEAN = [
 @pytest.mark.parametrize("merger", [None, LineMerger(), NulMerger(),
                                     SyslenMerger()],
                          ids=["noop", "line", "nul", "syslen"])
+@pytest.mark.requires_device_encode_compile
 def test_device_3164_matches_scalar_and_engages(merger):
     n0 = metrics.get("device_encode_rows")
     res, _ = run_device(CLEAN * 4, merger)
@@ -61,6 +62,7 @@ def test_device_3164_matches_scalar_and_engages(merger):
     assert res.block.data == want
 
 
+@pytest.mark.requires_device_encode_compile
 def test_device_3164_fallback_splicing(monkeypatch):
     monkeypatch.setattr(device_rfc3164, "FALLBACK_FRAC", 1.1)
     mixed = [
@@ -77,6 +79,7 @@ def test_device_3164_fallback_splicing(monkeypatch):
     assert res.block.data == want
 
 
+@pytest.mark.requires_device_encode_compile
 def test_device_3164_fuzz_vs_scalar(monkeypatch):
     monkeypatch.setattr(device_rfc3164, "FALLBACK_FRAC", 1.1)
     rng = random.Random(7)
@@ -99,6 +102,7 @@ def test_device_3164_fuzz_vs_scalar(monkeypatch):
         assert res.block.data == want
 
 
+@pytest.mark.requires_device_encode_compile
 def test_batch_handler_3164_uses_device_engine():
     tx = queue.Queue()
     h = BatchHandler(tx, ORACLE, ENC, Config.from_string(""),
@@ -115,6 +119,7 @@ def test_batch_handler_3164_uses_device_engine():
     assert data == b"".join(scalar_frames(CLEAN * 4, LineMerger()))
 
 
+@pytest.mark.requires_device_encode_compile
 def test_device_3164_compaction_fetch_is_output_sized():
     rng = random.Random(3)
     lines = []
@@ -132,6 +137,7 @@ def test_device_3164_compaction_fetch_is_output_sized():
     assert fetched < len(res.block.data) * 1.2 + 64 * len(lines)
 
 
+@pytest.mark.requires_device_encode_compile
 def test_3164_gelf_extra_static_slots():
     """gelf_extra on the rfc3164→GELF pair: keys covering every static
     slot of THIS layout (incl. the dual-form level→short slot exercised

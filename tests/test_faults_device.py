@@ -330,6 +330,7 @@ def _isolated_watchdog(monkeypatch):
     from flowgger_tpu.tpu import device_common as dc
 
     monkeypatch.setattr(dc, "_compile_sema", threading.Semaphore(1))
+    monkeypatch.setattr(dc, "_compile_active_box", {})
     monkeypatch.setattr(dc, "_compile_slots", {})
     monkeypatch.setattr(dc, "_compile_ready", set())
     return dc
@@ -379,6 +380,43 @@ def test_compile_watchdog_disabled_by_env(monkeypatch):
     dc = _isolated_watchdog(monkeypatch)
     monkeypatch.setenv(dc.COMPILE_TIMEOUT_ENV, "0")
     assert dc.guarded_compile_call("test:inline", lambda: "x") == "x"
+
+
+def test_compile_watchdog_busy_declines_fresh_slot_instantly(monkeypatch):
+    """While one compile holds the single-flight semaphore, a FRESH
+    slot declines immediately (its queued compile cannot start before
+    any deadline) instead of stalling the stream a full timeout — and
+    once the semaphore frees, the queued compile lands normally."""
+    import threading
+    import time
+
+    dc = _isolated_watchdog(monkeypatch)
+    monkeypatch.setenv(dc.COMPILE_TIMEOUT_ENV, "30000")
+    gate = threading.Event()
+
+    def wedged():
+        gate.wait(10.0)
+        return "first"
+
+    with pytest.raises(dc.CompileTimeout):
+        dc.guarded_compile_call("test:wedged", wedged, timeout_s=0.05)
+    t0 = time.monotonic()
+    with pytest.raises(dc.CompileTimeout):
+        # 30s deadline, but the decline must come back instantly: the
+        # wedged compile above still holds the semaphore
+        dc.guarded_compile_call("test:fresh", lambda: "second")
+    assert time.monotonic() - t0 < 5.0
+    gate.set()
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        try:
+            assert dc.guarded_compile_call(
+                "test:fresh", lambda: "second") == "second"
+            break
+        except dc.CompileTimeout:
+            time.sleep(0.02)
+    else:
+        pytest.fail("queued compile never landed after the semaphore freed")
 
 
 def test_compile_watchdog_propagates_errors(monkeypatch):

@@ -2,11 +2,42 @@
 (input fmt, encoder, merger) block route vs the scalar pipeline.
 
 Usage: python tools/deep_fuzz.py [seed] [trials]
+       python tools/deep_fuzz.py --routes fused [seed] [trials]
 Prints per-route mismatches (none expected) and a FAILURES count.
 A bounded version runs in CI as tests/test_cross_route_fuzz.py.
+
+``--routes fused`` fuzzes the fused decode→encode tier
+(flowgger_tpu/tpu/fused_routes.py) instead: every registered fused
+route (rfc5424/rfc3164/ltsv/gelf → GELF) over line/nul/syslen framing
+against its scalar oracle, run eagerly (``jax.disable_jit()``) so the
+byte-identity claim is checked even on hosts whose XLA cannot compile
+the fused programs.  ci.sh runs a bounded pass as its slow fuzz step.
 """
 import os, queue, random, re, sys, time
 os.environ["JAX_PLATFORMS"] = "cpu"
+
+FUSED_MODE = False
+if "--routes" in sys.argv:
+    i = sys.argv.index("--routes")
+    if i + 1 >= len(sys.argv) or sys.argv[i + 1] != "fused":
+        print("--routes takes exactly one value: fused", file=sys.stderr)
+        sys.exit(2)
+    del sys.argv[i:i + 2]
+    FUSED_MODE = True
+
+if FUSED_MODE:
+    # fused mode runs the programs eagerly (disable_jit below): inline
+    # guarded calls can never hang, so the watchdog comes off entirely
+    os.environ["FLOWGGER_COMPILE_TIMEOUT_MS"] = "0"
+    os.environ["FLOWGGER_FUSED_COMPILE_TIMEOUT_MS"] = "0"
+else:
+    # classic mode compiles for real: keep the shared watchdog, and
+    # bound the fused tier's first-compile waits so its decline ladder
+    # doesn't tax the split-route fuzz on hosts that can't compile it
+    # (every fresh shape the fuzz generates would otherwise pay one
+    # full wait before declining — 50ms keeps the aggregate negligible;
+    # the background compiles keep warming either way)
+    os.environ.setdefault("FLOWGGER_FUSED_COMPILE_TIMEOUT_MS", "50")
 import jax; jax.config.update("jax_platforms", "cpu")
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 from flowgger_tpu.config import Config
@@ -175,6 +206,135 @@ def corpus(n, gen):
         else:
             out.append(gen())
     return out
+
+if FUSED_MODE:
+    from flowgger_tpu.tpu import fused_routes as _fr
+    from flowgger_tpu.tpu import pack as _pack
+
+    # tier-friendly value alphabet: the shared rnd_val leans on é /
+    # RFC5424 value escapes, which correctly push rows OFF the fused
+    # tier — a corpus full of them declines whole batches instead of
+    # fuzzing the fused assembly.  Mutations below still inject the
+    # broken/off-tier rows that exercise the scalar-fallback splicing.
+    def rnd_val_tier():
+        # interior spaces only (the kernels' fast-path grammars reject
+        # leading/trailing-space fields, correctly routing them to the
+        # scalar oracle — mutations cover that; here we want tier rows)
+        v = "".join(rng.choice("abcxyz ~.,:}{")
+                    for _ in range(rng.randrange(1, 12))).strip()
+        return v or f"v{rng.randrange(10)}"
+
+    def gen_rfc5424_fused():
+        # 1..4 SD pairs with UNIQUE keys: the fused tier has no
+        # wide-pair escalation and duplicate names take the dict
+        # last-wins scalar path, so a pair-heavy/dup-heavy corpus would
+        # decline whole batches instead of fuzzing the assembly;
+        # off-tier rows still appear via mutation
+        if rng.random() < 0.8:
+            keys = rng.sample(range(20), rng.randrange(1, 5))
+            pairs = " ".join(f'k{k}="{rnd_val_tier()}"' for k in keys)
+            sd = f"[b@9 {pairs}]"
+        else:
+            sd = "-"
+        frac = f".{rng.randrange(1, 999999)}" if rng.random() < 0.5 else ""
+        return (f"<{rng.randrange(200)}>1 2015-08-05T15:53:45{frac}Z "
+                f"host{rng.randrange(5)} app {rng.randrange(100)} m {sd} "
+                f"msg {rnd_val_tier()}").encode()
+
+    def gen_rfc3164_fused():
+        return (f"<{rng.randrange(200)}>Aug  5 15:53:45 "
+                f"host{rng.randrange(5)} app[{rng.randrange(100)}]: "
+                f"legacy {rnd_val_tier()}").encode()
+
+    def gen_ltsv_fused():
+        parts = [f"host:h{rng.randrange(5)}",
+                 rng.choice(["time:1438790025.5",
+                             "time:2015-08-05T15:53:45Z",
+                             "time:1438790025"])]
+        parts += [f"k{k}:{rnd_val_tier()}"
+                  for k in rng.sample(range(9), rng.randrange(0, 4))]
+        if rng.random() < 0.7:
+            parts.append(f"message:{rnd_val_tier()}")
+        rng.shuffle(parts)
+        return "\t".join(parts).encode()
+
+    def gen_gelf_fused():
+        import json as _json
+
+        obj = {"host": f"h{rng.randrange(5)}",
+               "timestamp": rng.choice([1438790025, 1438790025.42, -5])}
+        for k in rng.sample(range(9), rng.randrange(0, 5)):
+            obj[f"k{k}"] = rng.choice(
+                [rnd_val_tier(), rng.randrange(1, 99),
+                 True, False, None])
+        if rng.random() < 0.5:
+            obj["short_message"] = rnd_val_tier()
+        if rng.random() < 0.3:
+            obj["level"] = rng.randrange(0, 8)
+        return _json.dumps(obj).encode()
+
+    FUSED_GENS = {"rfc5424": gen_rfc5424_fused,
+                  "rfc3164": gen_rfc3164_fused,
+                  "ltsv": gen_ltsv_fused, "gelf": gen_gelf_fused}
+    FUSED_DECS = {"rfc5424": RFC5424Decoder, "rfc3164": RFC3164Decoder,
+                  "ltsv": LTSVDecoder, "gelf": GelfDecoder}
+
+    def fused_corpus(n, gen):
+        # mostly-clean stream with a ~3% mutation rate: enough broken
+        # rows to fuzz the scalar-fallback splicing, few enough that
+        # the tier-fraction gate (5%) keeps the batch on the fused tier
+        out = []
+        for _ in range(n):
+            if rng.random() < 0.03:
+                b = bytearray(gen())
+                if b:
+                    b[rng.randrange(len(b))] = rng.randrange(256)
+                out.append(bytes(b))
+            else:
+                out.append(gen())
+        return out
+
+    fails = engaged = 0
+    for trial in range(int(sys.argv[2]) if len(sys.argv) > 2 else 4):
+        for fmt, gen in FUSED_GENS.items():
+            dec = FUSED_DECS[fmt](CFG)
+            enc = GelfEncoder(CFG)
+            merger = rng.choice([LineMerger(), NulMerger(),
+                                 SyslenMerger()])
+            ltsv_dec = dec if fmt == "ltsv" else None
+            lines = fused_corpus(160, gen)
+            route = _fr.route_for(fmt, enc, merger, ltsv_dec)
+            packed = _pack.pack_lines_2d(lines, 256)
+            with jax.disable_jit():
+                h = _fr.submit(route, packed)
+                res, _ = _fr.fetch_encode(h, packed, enc, merger,
+                                          ltsv_dec, {})
+            want = []
+            for ln in lines:
+                try:
+                    want.append(merger.frame(
+                        enc.encode(dec.decode(ln.decode("utf-8")))))
+                except Exception:
+                    continue
+            if res is None:
+                print(f"DECLINED fmt={fmt} trial={trial} "
+                      "(tier fraction over budget this corpus)")
+                continue
+            engaged += 1
+            got = list(res.block.iter_framed())
+            if got != want:
+                fails += 1
+                print(f"FUSED MISMATCH fmt={fmt} "
+                      f"merger={type(merger).__name__} trial={trial}")
+                for w, g in zip(want, got):
+                    if w != g:
+                        print("  WANT:", w[:140])
+                        print("  GOT :", g[:140])
+                        break
+                if len(want) != len(got):
+                    print("  count:", len(want), "vs", len(got))
+    print("ENGAGED:", engaged, "FAILURES:", fails)
+    sys.exit(1 if fails or not engaged else 0)
 
 ROUTES = [
     ("rfc5424", RFC5424Decoder, [GelfEncoder, PassthroughEncoder, RFC5424Encoder, LTSVEncoder, CapnpEncoder], gen_rfc5424),
