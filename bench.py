@@ -971,6 +971,190 @@ def bench_fused_routes(extra, smoke):
     return ok
 
 
+AOT_BOOT_LINES = 50
+
+
+def _aot_boot_script(framing: str, art_dir: str) -> str:
+    """A cold-boot worker: rfc5424→GELF over the given framing, with
+    (artifact boot) or without (JIT boot) ``input.tpu_aot_dir``.
+    Prints one JSON line: counters + emitted bytes + the wall time
+    from interpreter start to the first fully-emitted batch."""
+    aot_key = f'tpu_aot_dir = "{art_dir}"\n' if art_dir else ""
+    return (
+        "import time; T0 = time.time()\n"
+        "import json, queue\n"
+        "from flowgger_tpu.config import Config\n"
+        "from flowgger_tpu.decoders.rfc5424 import RFC5424Decoder\n"
+        "from flowgger_tpu.encoders.gelf import GelfEncoder\n"
+        "from flowgger_tpu.mergers import LineMerger, NulMerger, "
+        "SyslenMerger\n"
+        "from flowgger_tpu.outputs import stream_bytes\n"
+        "from flowgger_tpu.tpu.batch import BatchHandler\n"
+        "from flowgger_tpu.utils.metrics import registry\n"
+        "merger = {'line': LineMerger, 'nul': NulMerger, "
+        f"'syslen': SyslenMerger}}[{framing!r}]()\n"
+        "cfg = Config.from_string(\n"
+        "    '[input]\\ntpu_batch_size = 64\\ntpu_max_line_len = 64\\n'\n"
+        "    'tpu_shape_buckets = 1\\ntpu_prewarm = false\\n'\n"
+        f"    {aot_key!r})\n"
+        "tx = queue.Queue()\n"
+        "h = BatchHandler(tx, RFC5424Decoder(cfg), GelfEncoder(cfg), "
+        "cfg, fmt='rfc5424', start_timer=False, merger=merger)\n"
+        "h.ingest_chunk(b''.join(\n"
+        "    b'<13>1 2024-01-01T00:00:00Z h a p m - msg %d\\n' % i\n"
+        f"    for i in range({AOT_BOOT_LINES})))\n"
+        "h.flush(); h.close()\n"
+        "t_first = time.time() - T0\n"
+        "out = b''\n"
+        "while not tx.empty():\n"
+        "    data, _ = stream_bytes(tx.get_nowait(), merger)\n"
+        "    out += data\n"
+        "print(json.dumps({'misses': registry.get("
+        "'compile_cache_misses'), 'hits': registry.get("
+        "'compile_cache_hits'), 'aot_hits': registry.get('aot_hits'), "
+        "'aot_rejects': registry.get('aot_rejects'), "
+        "'first_batch_s': round(t_first, 2), 'out': out.hex()}))\n")
+
+
+def bench_aot(extra, smoke):
+    """Zero-JIT boot (tpu/aot.py) smoke gates:
+
+    1. build + **warm** a CPU-platform decode artifact set in a temp
+       dir (in a subprocess — the builder points JAX's persistent
+       cache inside the artifact dir, which must not leak here);
+    2. per framing (line/nul/syslen), boot a COLD subprocess with
+       ``input.tpu_aot_dir``: gate ``compile_cache_misses == 0`` AND
+       ``aot_hits > 0`` (zero fresh kernel compiles — the exported
+       programs' StableHLO→executable step hits the warmed xla-cache
+       shipped in the artifact dir) AND the emitted bytes are
+       byte-identical to the scalar oracle;
+    3. boot a cold JIT subprocess of the same config for the
+       time-to-first-emitted-batch comparison (BENCH_r08.json);
+    4. TPU-platform fused-route artifacts build-only on this host:
+       serialize + deserialize/manifest round trip (`validate`).
+    """
+    import subprocess
+    import tempfile
+
+    from flowgger_tpu.config import Config
+    from flowgger_tpu.decoders.rfc5424 import RFC5424Decoder
+    from flowgger_tpu.encoders.gelf import GelfEncoder
+    from flowgger_tpu.mergers import LineMerger, NulMerger, SyslenMerger
+
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "FLOWGGER_DEVICE_ENCODE": "0"}
+
+    def run(code):
+        try:
+            r = subprocess.run([sys.executable, "-c", code], env=env,
+                               capture_output=True, text=True,
+                               timeout=300)
+        except subprocess.TimeoutExpired:
+            # a wedged boot must fail THIS gate, not abort the whole
+            # smoke before the summary JSON prints
+            print("aot smoke subprocess timed out (300s)",
+                  file=sys.stderr)
+            return None
+        if r.returncode != 0:
+            print(f"aot smoke subprocess failed:\n{r.stderr}",
+                  file=sys.stderr)
+            return None
+        lines = r.stdout.strip().splitlines()
+        if not lines:
+            print("aot smoke subprocess printed nothing",
+                  file=sys.stderr)
+            return None
+        return lines[-1]
+
+    with tempfile.TemporaryDirectory() as td:
+        art = os.path.join(td, "artifacts")
+        t0 = time.perf_counter()
+        built = run(
+            "from flowgger_tpu.tpu import aot\n"
+            f"aot.build_artifacts({art!r}, platforms=('cpu',), "
+            "families=('decode',), formats=('rfc5424',), "
+            "rows_grid=(256,), max_len=64, framings=('line',), "
+            "warm=True, quiet=True)\n"
+            "print('built')\n")
+        build_s = time.perf_counter() - t0
+        if built is None:
+            print(json.dumps({"metric": "aot_smoke", "ok": False,
+                              "stage": "build"}))
+            return False
+
+        # scalar-oracle expected bytes per framing
+        cfg0 = Config.from_string("")
+        dec, enc = RFC5424Decoder(cfg0), GelfEncoder(cfg0)
+        lines = [b"<13>1 2024-01-01T00:00:00Z h a p m - msg %d" % i
+                 for i in range(AOT_BOOT_LINES)]
+        mergers = {"line": LineMerger(), "nul": NulMerger(),
+                   "syslen": SyslenMerger()}
+        expected = {
+            fr: b"".join(m.frame(enc.encode(dec.decode(ln.decode())))
+                         for ln in lines)
+            for fr, m in mergers.items()}
+
+        boots = {}
+        ok = True
+        for fr in ("line", "nul", "syslen"):
+            line_out = run(_aot_boot_script(fr, art))
+            if line_out is None:
+                ok = False
+                continue
+            b = json.loads(line_out)
+            b["oracle_identical"] = bytes.fromhex(b.pop("out")) == \
+                expected[fr]
+            b["zero_fresh_compiles"] = (b["misses"] == 0
+                                        and b["aot_hits"] > 0
+                                        and b["aot_rejects"] == 0)
+            boots[fr] = b
+            ok = ok and b["oracle_identical"] and b["zero_fresh_compiles"]
+
+        jit_line = run(_aot_boot_script("line", ""))
+        jit_boot = json.loads(jit_line) if jit_line else {}
+        if jit_line:
+            ok = ok and bytes.fromhex(
+                jit_boot.pop("out")) == expected["line"]
+        else:
+            ok = False
+
+        # TPU-platform export is build-only here (this host cannot
+        # execute it): the acceptance is serialize + deserialize +
+        # manifest-validation round trip for all four fused routes
+        tpu_art = os.path.join(td, "tpu-artifacts")
+        t1 = time.perf_counter()
+        tpu_ok = run(
+            "from flowgger_tpu.tpu import aot\n"
+            f"aot.build_artifacts({tpu_art!r}, platforms=('tpu',), "
+            "families=('fused',), rows_grid=(256,), max_len=64, "
+            "framings=('line',), quiet=True)\n"
+            f"s = aot.validate_artifacts({tpu_art!r}, quiet=True)\n"
+            "assert all(s[f'tpu/fused_{r}'] == 2 for r in "
+            "aot.FUSED_ROUTES), s\n"
+            "print('tpu-roundtrip-ok')\n") == "tpu-roundtrip-ok"
+        tpu_s = time.perf_counter() - t1
+        ok = ok and tpu_ok
+
+    aot_first = boots.get("line", {}).get("first_batch_s")
+    jit_first = jit_boot.get("first_batch_s")
+    payload = {
+        "metric": "aot_smoke",
+        # cpu-fallback: decode-family artifacts on the CPU backend —
+        # boot-time ratio is the claim, not an accelerator rate
+        "backend": "cpu-fallback",
+        "build_warm_seconds": round(build_s, 1),
+        "tpu_export_roundtrip_seconds": round(tpu_s, 1),
+        "tpu_fused_roundtrip_ok": tpu_ok,
+        "boots": boots,
+        "jit_boot_first_batch_s": jit_first,
+        "aot_boot_first_batch_s": aot_first,
+        "ok": bool(ok),
+    }
+    print(json.dumps(payload))
+    extra["aot_smoke"] = payload
+    return bool(ok)
+
+
 def smoke_main():
     """``bench.py --smoke``: the CI gate for the overlap executor.
 
@@ -1029,11 +1213,16 @@ def smoke_main():
     # fused route matrix: byte-identical to the split path + fetched
     # bytes/row at or under the split path's (and under emitted)
     fused_ok = bench_fused_routes(extra, smoke=True)
+    # zero-JIT boot: artifact-booted cold subprocess must perform zero
+    # fresh kernel compiles and match the scalar oracle per framing;
+    # TPU fused artifacts must round-trip build-only
+    aot_ok = bench_aot(extra, smoke=True)
     wall = time.perf_counter() - t_start
     # the fused gates run the four fused programs eagerly where this
-    # host can't compile them (~40s on a 2-core box), so the smoke
-    # budget is 240s — still bounded, still a CI-friendly gate
-    budget = 240
+    # host can't compile them (~40s on a 2-core box), and the AOT
+    # section adds ~5 cold subprocess boots + the TPU export (~80s),
+    # so the smoke budget is 360s — still bounded, still CI-friendly
+    budget = 360
     print(json.dumps({
         "metric": "e2e_overlap_smoke",
         "e2e_lines_per_sec": serial,
@@ -1044,8 +1233,14 @@ def smoke_main():
         "multilane_vs_single_lane": round(multilane / max(overlap, 1), 2),
         "wall_seconds": round(wall, 1),
         "ok": bool(ok and lanes_ok and tenancy_ok and fused_ok
-                   and wall < budget),
+                   and aot_ok and wall < budget),
     }))
+    if not aot_ok:
+        print("SMOKE FAIL: zero-JIT boot gates missed (fresh compiles "
+              "on an artifact boot, scalar-oracle mismatch, or the "
+              "TPU fused-route export round trip — see the aot_smoke "
+              "JSON line)", file=sys.stderr)
+        sys.exit(1)
     if not fused_ok:
         print("SMOKE FAIL: fused-route gates missed (byte identity vs "
               "the split path, or fetched bytes/row above the split "

@@ -13,13 +13,16 @@ echo "==            byte-identity contracts, exception hygiene, keys) =="
 # pure-ast, no JAX import: fails on any non-baselined FC01-FC05 finding
 python -m flowgger_tpu.analysis --format text .
 
-echo "== overlap-executor + fused-route smoke (forced 4-device CPU, <240s) =="
+echo "== overlap-executor + fused-route + zero-JIT-boot smoke (<360s) =="
 # asserts the in-flight submit/fetch window sustains >= the serial e2e,
 # 2-lane dispatch sustains >= 0.92x the 1-lane executor (jitter
 # tolerance for small hosts; the ratio itself is in the JSON line),
-# AND the fused decode→encode routes emit byte-identical output with
-# fetched bytes/row under emitted on every route (fused_routes line)
-JAX_PLATFORMS=cpu timeout 480 python bench.py --smoke
+# the fused decode→encode routes emit byte-identical output with
+# fetched bytes/row under emitted on every route (fused_routes line),
+# AND an artifact-booted cold subprocess performs zero fresh kernel
+# compiles with scalar-oracle-identical bytes per framing while the
+# TPU fused-route export round-trips build-only (aot_smoke line)
+JAX_PLATFORMS=cpu timeout 600 python bench.py --smoke
 
 echo "== python test suite (virtual 8-device CPU mesh) =="
 # slow-marked tests are excluded here (pytest.ini tier-1 contract);
@@ -34,6 +37,19 @@ echo "== lane-dispatch suite (forced 2-device CPU) =="
 # rest of the suite keeps its usual device setup so timings stay stable
 XLA_FLAGS=--xla_force_host_platform_device_count=2 JAX_PLATFORMS=cpu \
   python -m pytest tests/test_lanes.py -q -m "not faults"
+
+echo "== zero-JIT boot: AOT cold-boot zero-compile acceptance (slow) =="
+# builds + warms a CPU-platform artifact set, then boots a COLD
+# subprocess against input.tpu_aot_dir: compile_cache_misses must be 0
+# with aot_hits > 0 and output byte-identical to a JIT-booted process.
+# TPU-platform export is build-only on this host (no TPU to execute
+# it); its acceptance — serialize + deserialize + manifest-validation
+# round trip for all four fused routes — runs in the main suite
+# (test_aot.py::test_tpu_fused_routes_serialize_and_roundtrip).
+# outer cap must dominate the test's own 600s-per-subprocess budgets
+# (3 subprocesses) so a slow run fails inside pytest with diagnostics
+# instead of a bare SIGKILL; measured ~20s on the 2-core container
+JAX_PLATFORMS=cpu timeout 1900 python -m pytest tests/test_aot.py -q -m "slow"
 
 echo "== multi-tenant serving suite (admission, fair queue, templates) =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_tenancy.py -q -m "not faults"

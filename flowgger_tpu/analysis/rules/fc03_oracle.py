@@ -14,11 +14,14 @@ be declared where the kernel lives so the checker — and the next reader
   strings) — the differential test(s) that enforce the contract.  The
   file must exist and define the named test function.
 
-Applies to ``tpu/device_*.py``, ``tpu/encode_*_block.py``, and
-``tpu/fused_*.py`` modules (the fused decode→encode route tier carries
-the same byte-identity obligation as the split kernels it composes).
-``device_common.py`` is shared kernel infrastructure (segment engine,
-compile watchdog) with no route of its own and is exempt.
+Applies to ``tpu/device_*.py``, ``tpu/encode_*_block.py``,
+``tpu/fused_*.py`` (the fused decode→encode route tier carries the
+same byte-identity obligation as the split kernels it composes), and
+``tpu/aot.py`` (an AOT-loaded exported program replaces a jit compile
+at dispatch — the swap must be byte-invisible, so the loader carries
+the contract too).  ``device_common.py`` is shared kernel
+infrastructure (segment engine, compile watchdog) with no route of
+its own and is exempt.
 """
 
 from __future__ import annotations
@@ -30,9 +33,9 @@ from typing import Iterable, List, Optional, Tuple
 from ..core import Finding, Module, Project, Rule, register
 
 _PATTERNS = ("*tpu/device_*.py", "*tpu/encode_*_block.py",
-             "*tpu/fused_*.py",
+             "*tpu/fused_*.py", "*tpu/aot.py",
              "tpu/device_*.py", "tpu/encode_*_block.py",
-             "tpu/fused_*.py")
+             "tpu/fused_*.py", "tpu/aot.py")
 _EXEMPT_BASENAMES = {"device_common.py"}
 
 

@@ -251,6 +251,13 @@ class Pipeline:
             from .parallel.distributed import init_distributed
 
             init_distributed(config)
+            # zero-JIT boot: load the AOT artifact store first
+            # (input.tpu_aot_dir; no key = no-op) — when it carries a
+            # warmed xla-cache and no explicit cache dir is configured,
+            # it points JAX's persistent cache inside the artifact dir
+            from .tpu.aot import setup_aot
+
+            setup_aot(config)
             # persistent XLA compile cache (input.tpu_compile_cache_dir)
             # must be wired before the first kernel dispatch so every
             # compile this process pays — including the handler's

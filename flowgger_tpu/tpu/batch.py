@@ -206,6 +206,17 @@ class BatchHandler(Handler):
         self._window = LaneSet(
             inflight_depth_from_config(cfg), self._pop_emit, lanes=lanes,
             name=f"tpu-{fmt}", supervisor=supervisor)
+        # zero-JIT boot (input.tpu_aot_dir): install — or, when the
+        # pipeline already loaded it, revalidate against this handler's
+        # max_len + bucket grid — the AOT artifact store before any
+        # kernel dispatch.  Loaded programs replace trace+compile at
+        # every call site below; the JIT + watchdog + persistent-cache
+        # ladder stays the fallback for any miss/reject.
+        from . import pack as _pack_aot
+        from .aot import setup_aot
+
+        setup_aot(cfg, max_len=self.max_len,
+                  grid=_pack_aot.active_bucket_grid())
         # persistent compile cache (input.tpu_compile_cache_dir): wire
         # before any kernel dispatch so every compile below lands in it
         from .device_common import setup_compile_cache

@@ -247,6 +247,18 @@ def _install_cache_listener() -> None:
     _monitoring.register_event_listener(_on_event)
 
 
+# every persistent-cache knob enable_compile_cache mutates, paired
+# with the value it sets — the ONE place both the enable loop and the
+# snapshot/restore sites (tpu/aot.py, the test fixtures, via
+# CACHE_KNOBS) derive from, so a knob added here is set AND restored
+CACHE_KNOB_SETTINGS = (
+    ("jax_persistent_cache_min_compile_time_secs", 0.0),
+    ("jax_persistent_cache_min_entry_size_bytes", 0),
+)
+CACHE_KNOBS = (("jax_compilation_cache_dir",)
+               + tuple(k for k, _ in CACHE_KNOB_SETTINGS))
+
+
 def enable_compile_cache(cache_dir: str) -> str:
     """Point JAX's persistent compilation cache at ``cache_dir`` and
     start counting hits/misses.  Thresholds are dropped to zero so even
@@ -261,8 +273,7 @@ def enable_compile_cache(cache_dir: str) -> str:
                              f"kabi-{KERNEL_ABI}")
     os.makedirs(cache_dir, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", cache_dir)
-    for knob, val in (("jax_persistent_cache_min_compile_time_secs", 0.0),
-                      ("jax_persistent_cache_min_entry_size_bytes", 0)):
+    for knob, val in CACHE_KNOB_SETTINGS:
         try:
             jax.config.update(knob, val)
         except Exception:  # noqa: BLE001 - knob names vary across jax versions
@@ -331,9 +342,23 @@ def prewarm_kernels(fmt: str, max_len: int, row_buckets, encoder=None,
 
     def run():
         from ..utils.metrics import registry as _reg
+        from .aot import prewarm_covered
         from .batch import block_fetch_encode, block_submit
 
         for rows in buckets:
+            # zero-JIT boot: a bucket whose every program is already
+            # AOT-loaded needs no background compile — the store's
+            # exported programs replace trace+compile at dispatch.  On
+            # a fully artifact-booted process the prewarm thread is
+            # idle (one log line per skipped route)
+            if prewarm_covered(fmt, rows, max_len, encoder=encoder,
+                               merger=merger, fused_route=fused_route,
+                               ltsv_decoder=ltsv_decoder):
+                _reg.inc("prewarm_aot_skips")
+                print(f"kernel prewarm: {fmt}@{rows}x{max_len} "
+                      "AOT-loaded; skipping background compile",
+                      file=sys.stderr)
+                continue
             for di, dev in enumerate(devs):
                 packed = _zero_packed(rows, max_len)
                 name = f"prewarm:{fmt}:{rows}x{max_len}:d{di}"

@@ -326,6 +326,14 @@ def fetch_encode(handle, packed, encoder, merger, route_state=None):
                               impl=impl, assemble=assemble,
                               extras=extras, elide=True)
 
+    # zero-JIT boot: a loaded AOT artifact replaces the trace+compile
+    # (same program, byte-identical); misses/rejects fall through to
+    # the jit closure under the same watchdog
+    from .aot import encode_wrap
+
+    kernel = encode_wrap("device_gelf", kernel, batch_dev, lens_dev,
+                         dict(out), suffix, impl, extras, max_sd=max_sd)
+
     def wide():
         """Pair-budget escalation: re-decode the batch on-device at the
         decode rescue width (16 SD pairs) and encode from those

@@ -484,6 +484,15 @@ def fetch_encode(handle, packed, encoder, merger, route_state=None):
                               ts_len, suffix=suffix, assemble=assemble,
                               elide=True)
 
+    # zero-JIT boot: consult the AOT artifact store before compiling
+    # (this route never engages with extras — route_ok gates them — so
+    # the impl/extras args are key bookkeeping only)
+    from .aot import encode_wrap
+    from .rfc5424 import best_scan_impl as _impl
+
+    kernel = encode_wrap("device_gelf_gelf", kernel, batch_dev,
+                         lens_dev, dict(out), suffix, _impl(), ())
+
     def wide():
         """16-field escalation: re-decode wider (the [N, F] field axis
         sizes every loop in the kernel).  16 rather than the 24-field

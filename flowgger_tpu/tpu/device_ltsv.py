@@ -359,6 +359,12 @@ def fetch_encode(handle, packed, encoder, merger, route_state=None,
                               assemble=assemble, extras=extras,
                               elide=True)
 
+    # zero-JIT boot: consult the AOT artifact store before compiling
+    from .aot import encode_wrap
+
+    kernel = encode_wrap("device_ltsv", kernel, batch_dev, lens_dev,
+                         dict(out), suffix, impl, extras)
+
     def wide():
         """16-pair escalation kernel (lazy: compiled only when a batch
         declines at the 6-pair width)."""

@@ -204,6 +204,12 @@ def fetch_encode(handle, packed, encoder, merger, route_state=None):
                               assemble=assemble, extras=extras,
                               elide=True)
 
+    # zero-JIT boot: consult the AOT artifact store before compiling
+    from .aot import encode_wrap
+
+    kernel = encode_wrap("device_rfc3164", kernel, batch_dev, lens_dev,
+                         dict(out), suffix, impl, extras)
+
     return fetch_encode_driver(
         kernel, out, batch_dev, lens_dev, packed, encoder, merger,
         route_state, suffix, syslen, scalar_fn=_scalar_3164,

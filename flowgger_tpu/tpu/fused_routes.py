@@ -290,6 +290,11 @@ class FusedRoute:
     def make_kernel(self, handle, encoder, merger, ltsv_decoder=None):
         """Build the fused kernel closure plus the driver kwargs
         (scalar oracle, ts channel recipe, elide constants)."""
+        # zero-JIT boot: fused_wrap makes each closure consult the AOT
+        # artifact store per call (a hit runs the exported program —
+        # the same trace, byte-identical); misses/rejects fall through
+        # to the fused jit under the same compile watchdog
+        from .aot import fused_wrap
         from .block_common import merger_suffix
         from .rfc5424 import best_scan_impl
 
@@ -313,6 +318,8 @@ class FusedRoute:
                     impl=impl, assemble=assemble, extras=extras,
                     demand=demand)
 
+            kernel = fused_wrap(self.name, kernel, (b, ln, year),
+                               suffix, impl, extras)
             kw.update(scalar_fn=_scalar_3164,
                       elide=elide_spec(suffix, extras))
             return kernel, kw
@@ -325,6 +332,8 @@ class FusedRoute:
                     b, ln, ts_text, ts_len, suffix=suffix, impl=impl,
                     assemble=assemble, extras=extras, demand=demand)
 
+            kernel = fused_wrap(self.name, kernel, (b, ln), suffix,
+                               impl, extras)
             kw.update(scalar_fn=lambda line: _scalar_ltsv(ltsv_decoder,
                                                           line),
                       ts_vals_fn=ts_vals_ltsv,
@@ -340,6 +349,8 @@ class FusedRoute:
                     b, ln, ts_text, ts_len, suffix=suffix,
                     assemble=assemble, demand=demand)
 
+            kernel = fused_wrap(self.name, kernel, (b, ln), suffix,
+                               impl, extras)
             kw.update(scalar_fn=_scalar_gelf, ts_keys=TS_KEYS,
                       ts_vals_fn=ts_vals_gelf, elide=elide_spec(suffix))
             return kernel, kw
@@ -354,6 +365,8 @@ class FusedRoute:
                 suffix=suffix, impl=impl, assemble=assemble,
                 extras=extras, demand=demand)
 
+        kernel = fused_wrap(self.name, kernel, (b, ln), suffix, impl,
+                           extras)
         kw.update(scalar_fn=_scalar_line,
                   elide=elide_spec(suffix, extras))
         return kernel, kw

@@ -328,8 +328,14 @@ def decode_gelf_submit(batch, lens, sharded=None):
     if sharded is not None:
         b, ln = sharded.put(batch, lens)
         return (sharded.fn(b, ln), b, ln, batch, lens)
+    from .aot import decode_call
+
     b, ln = jnp.asarray(batch), jnp.asarray(lens)
-    return (decode_gelf_jit(b, ln), b, ln, batch, lens)
+    # zero-JIT boot: a loaded AOT artifact replaces the trace+compile
+    out = decode_call("gelf", (b, ln))
+    if out is None:
+        out = decode_gelf_jit(b, ln)
+    return (out, b, ln, batch, lens)
 
 
 _FIELD_KEYS = ("key_start", "key_end", "val_start", "val_end", "val_type",

@@ -349,8 +349,14 @@ def decode_ltsv_submit(batch, lens, sharded=None):
     if sharded is not None:
         b, ln = sharded.put(batch, lens)
         return sharded.fn(b, ln), b, ln
+    from .aot import decode_call
+
     b, ln = jnp.asarray(batch), jnp.asarray(lens)
-    return decode_ltsv_jit(b, ln), b, ln
+    # zero-JIT boot: a loaded AOT artifact replaces the trace+compile
+    out = decode_call("ltsv", (b, ln))
+    if out is None:
+        out = decode_ltsv_jit(b, ln)
+    return out, b, ln
 
 
 def decode_ltsv_fetch(handle):

@@ -230,8 +230,15 @@ def decode_rfc3164_submit(batch, lens, sharded=None):
     if sharded is not None:
         b, ln = sharded.put(batch, lens)
         return sharded.fn(b, ln, jnp.int32(current_year_utc())), b, ln
+    from .aot import decode_call
+
     b, ln = jnp.asarray(batch), jnp.asarray(lens)
-    return decode_rfc3164_jit(b, ln, jnp.int32(current_year_utc())), b, ln
+    year = jnp.int32(current_year_utc())
+    # zero-JIT boot: a loaded AOT artifact replaces the trace+compile
+    out = decode_call("rfc3164", (b, ln, year))
+    if out is None:
+        out = decode_rfc3164_jit(b, ln, year)
+    return out, b, ln
 
 
 def decode_rfc3164_fetch(handle):

@@ -863,9 +863,16 @@ def decode_rfc5424_submit(batch, lens, max_sd: int = DEFAULT_MAX_SD,
         batch_dev, lens_dev = sharded.put(batch, lens)
         out = sharded.fn(batch_dev, lens_dev)
     else:
+        from .aot import decode_call
+
         batch_dev, lens_dev = jnp.asarray(batch), jnp.asarray(lens)
-        out = decode_rfc5424_jit(batch_dev, lens_dev,
-                                 max_sd=max_sd, extract_impl=impl)
+        # zero-JIT boot: a loaded AOT artifact replaces the trace+compile
+        # (same channels, byte-identical by construction); None → jit
+        out = decode_call("rfc5424", (batch_dev, lens_dev),
+                          {"max_sd": max_sd, "extract_impl": impl})
+        if out is None:
+            out = decode_rfc5424_jit(batch_dev, lens_dev,
+                                     max_sd=max_sd, extract_impl=impl)
     # the handle keeps the original *host* arrays (rescue_refetch slices
     # them without a device round-trip) plus the uploaded *device*
     # arrays so downstream device-side stages (tpu/device_gelf.py) can
@@ -942,8 +949,14 @@ def best_scan_impl() -> str:
     """MXU matmul scans on accelerators (tri-matrix dot: 8.8ms vs 21.8ms
     per [1M,256] scan channel on v5e — the matmul trades O(L) extra
     FLOPs for ~6 fewer memory passes, a good trade only where a systolic
-    array makes the FLOPs free); plain cumsum on the CPU backend."""
-    return "lax" if jax.default_backend() == "cpu" else "mm"
+    array makes the FLOPs free); plain cumsum on the CPU backend.
+
+    The platform->impl mapping is single-sourced in aot._scan_impl_for:
+    the AOT builder stamps it into every fused/encode artifact key, and
+    a drift between the two would make every artifact silently miss."""
+    from .aot import _scan_impl_for
+
+    return _scan_impl_for(jax.default_backend())
 
 
 def best_extract_impl() -> str:

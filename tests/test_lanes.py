@@ -536,10 +536,9 @@ def test_handler_prewarms_when_cache_dir_set(tmp_path, monkeypatch):
         "[input]\ntpu_batch_size = 64\ntpu_max_line_len = 96\n"
         f'tpu_compile_cache_dir = "{cache}"\n')
     tx = queue.Queue()
-    old = {k: getattr(jax.config, k)
-           for k in ("jax_compilation_cache_dir",
-                     "jax_persistent_cache_min_compile_time_secs",
-                     "jax_persistent_cache_min_entry_size_bytes")}
+    from flowgger_tpu.tpu.device_common import CACHE_KNOBS
+
+    old = {k: getattr(jax.config, k) for k in CACHE_KNOBS}
     try:
         h = BatchHandler(tx, RFC5424Decoder(cfg), PassthroughEncoder(cfg),
                          cfg, fmt="rfc5424", start_timer=False,

@@ -1,114 +1,42 @@
 #!/usr/bin/env python
-"""AOT path for the Pallas rfc5424 kernel (VERDICT r4 task #3).
+"""DEPRECATED shim — the AOT pipeline moved to ``flowgger_tpu.tpu.aot``.
 
-The relay's remote *interactive* Mosaic compile has hung every attempt
-since round 1.  This tool splits the pipeline so the hang surface is
-minimized and cacheable:
+This tool was the 114-line single-kernel proof (VERDICT r4 task #3):
+lower + serialize the Pallas rfc5424 kernel for TPU on any host, then
+deserialize + differential-check it on a live TPU.  That flow is now
+the ``pallas`` verb of the first-class artifact pipeline, which also
+exports the full route matrix (all four block decoders, the split
+device-encode kernels, and the fused decode→encode programs) with a
+versioned manifest and a strict-validating loader:
 
-1. ``export`` (no TPU needed, runs on any host): lower + serialize the
-   Pallas kernel for the TPU platform via ``jax.export`` — the Mosaic
-   lowering to the custom-call payload happens entirely host-side.
-   Artifact: ``tools/pallas_rfc5424_tpu.jaxexport`` (~90KB).
-2. ``run`` (needs the relay): deserialize, call on the TPU — the only
-   remote step left is XLA compiling the custom call, and the
-   persistent compilation cache (``FLOWGGER_JAX_CACHE``, default
-   ``~/.cache/flowgger_jax``) makes even that a one-time cost: once a
-   single run survives, every later session reuses the binary.
-   Differential-checks the outputs against the XLA kernel.
+    python -m flowgger_tpu.tpu.aot build --out DIR --platforms cpu,tpu
+    python -m flowgger_tpu.tpu.aot validate DIR
+    python -m flowgger_tpu.tpu.aot pallas export   # this tool's export
+    python -m flowgger_tpu.tpu.aot pallas run      # this tool's run
 
-Usage:
-    python tools/pallas_aot.py export
-    python tools/pallas_aot.py run      # on a session with a live TPU
+The legacy verbs keep working here (same artifact path, same output)
+so existing relay scripts don't break; new automation should call the
+module CLI directly.
 """
 
-import functools
 import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-ART = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                   "pallas_rfc5424_tpu.jaxexport")
-N, L, MAX_SD, MAX_PAIRS = 4096, 256, 2, 6
 
+def main() -> int:
+    mode = sys.argv[1] if len(sys.argv) > 1 else "export"
+    if mode not in ("export", "run"):
+        print(__doc__, file=sys.stderr)
+        return 2
+    print("tools/pallas_aot.py is deprecated; delegating to "
+          f"`python -m flowgger_tpu.tpu.aot pallas {mode}`",
+          file=sys.stderr)
+    from flowgger_tpu.tpu.aot import main as aot_main
 
-def _cache_dir():
-    d = os.environ.get("FLOWGGER_JAX_CACHE",
-                       os.path.expanduser("~/.cache/flowgger_jax"))
-    os.makedirs(d, exist_ok=True)
-    return d
-
-
-def do_export():
-    import jax
-
-    jax.config.update("jax_platforms", "cpu")
-    import jax.numpy as jnp
-    from jax import export
-
-    from flowgger_tpu.tpu import rfc5424 as R
-
-    fn = functools.partial(R.decode_rfc5424_pallas, max_sd=MAX_SD,
-                           max_pairs=MAX_PAIRS)
-    b = jnp.zeros((N, L), jnp.uint8)
-    ln = jnp.zeros((N,), jnp.int32)
-    exp = export.export(jax.jit(fn), platforms=["tpu"])(b, ln)
-    blob = exp.serialize()
-    with open(ART, "wb") as f:
-        f.write(blob)
-    print(f"exported {len(blob)} bytes -> {ART}")
-
-
-def do_run():
-    import numpy as np
-
-    import jax
-
-    jax.config.update("jax_compilation_cache_dir", _cache_dir())
-    devs = jax.devices()
-    print("devices:", devs)
-    import jax.numpy as jnp
-    from jax import export
-
-    from flowgger_tpu.tpu import rfc5424 as R
-
-    with open(ART, "rb") as f:
-        exp = export.deserialize(f.read())
-
-    lines = [
-        b'<13>1 2023-09-20T12:35:45.123Z host app 123 MSGID '
-        b'[ex@32473 k="v" a="b"] hello world',
-        b'<34>1 2003-10-11T22:14:15.003Z mymachine.example.com su - '
-        b'ID47 - su root failed',
-    ] * (N // 2)
-    batch = np.zeros((N, L), np.uint8)
-    lens = np.zeros((N,), np.int32)
-    for i, s in enumerate(lines[:N]):
-        batch[i, :len(s)] = np.frombuffer(s, np.uint8)
-        lens[i] = len(s)
-
-    out = exp.call(jnp.asarray(batch), jnp.asarray(lens))
-    out = [np.asarray(o) for o in out]
-    ref = R.decode_rfc5424_jit(jnp.asarray(batch), jnp.asarray(lens),
-                               max_sd=MAX_SD, max_pairs=MAX_PAIRS)
-    keys = list(R._KEYS_1D) + list(R._KEYS_SD) + list(R._KEYS_PAIR)
-    bad = 0
-    for k, o in zip(keys, out):
-        r = np.asarray(ref[k]).astype(np.int64)
-        o2 = o.astype(np.int64)
-        if o2.ndim == 2 and o2.shape[1] == 1:
-            o2 = o2[:, 0]
-        if not (o2 == r.reshape(o2.shape)).all():
-            bad += 1
-            print(f"MISMATCH {k}")
-    print("PALLAS AOT DIFFERENTIAL:", "FAIL" if bad else "OK",
-          f"({len(keys)} channels)")
-    sys.exit(1 if bad else 0)
+    return aot_main(["pallas", mode])
 
 
 if __name__ == "__main__":
-    mode = sys.argv[1] if len(sys.argv) > 1 else "export"
-    if mode == "export":
-        do_export()
-    else:
-        do_run()
+    sys.exit(main())
