@@ -1,0 +1,32 @@
+"""Fleet federation: multi-host lane scale-out.
+
+One host = one pipeline = one LaneSet over local chips (PR 5).  This
+package federates N of them into a fleet with exactly three powers —
+**membership** (who is in, coordinator-rendezvous then full-mesh
+heartbeats), **health export** (per-host HTTP endpoint a load balancer
+consumes), and **drain-on-departure** (SIGTERM or missed-heartbeat
+eviction reuses the pipeline's fence-all drain so in-flight batches
+emit byte-identically while peers absorb new traffic).  It never adds a
+collective: logs are embarrassingly data-parallel, so host failure
+degrades that host alone.
+
+    membership.py — the joining/active/suspect/draining/departed state
+                    machine, deterministic rank tie-breaks, gauges
+    health.py     — per-host HTTP health + heartbeat endpoint
+    federation.py — the Fleet agent: config spec, heartbeat ticker,
+                    eviction ladder, rejoin-after-backoff
+
+See README "Multi-host fleet" for topology, key surface, the health
+document schema, and the failure ladder.
+"""
+
+from .federation import Fleet, FleetSpec, fleet_spec  # noqa: F401
+from .membership import (  # noqa: F401
+    ACTIVE,
+    DEPARTED,
+    DRAINING,
+    JOINING,
+    SUSPECT,
+    FleetStateError,
+    Membership,
+)

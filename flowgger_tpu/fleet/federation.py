@@ -1,0 +1,532 @@
+"""Fleet federation: N single-host pipelines become one fleet.
+
+Topology (coordinator-rendezvous, then full-mesh peer heartbeats):
+every host runs its own LaneSet over its own chips and its own
+ingest/output stack — the fleet layer adds only *membership* and
+*observability* on top, never a collective:
+
+1. each host starts its :class:`~flowgger_tpu.fleet.health.HealthService`
+   and heartbeats the configured coordinator (rank 0's endpoint);
+2. heartbeat replies carry the responder's roster, so every host
+   discovers every peer's address through the coordinator (gossip);
+3. from then on hosts heartbeat **all** known peers directly — the
+   coordinator is only the bootstrap address, and its death degrades
+   rendezvous for *new* joiners, never the running fleet;
+4. the per-host :class:`~flowgger_tpu.fleet.membership.Membership`
+   ages peers through the missed-heartbeat ladder (active → suspect →
+   draining/evicted → departed) and exports the view.
+
+Config — all under ``[input]`` beside the ``tpu_*`` family (one
+``flowgger.toml`` per host, same file everywhere except the rank)::
+
+    tpu_fleet = true                      # master switch
+    tpu_fleet_bind = "0.0.0.0"            # health/heartbeat listen host
+    tpu_fleet_port = 8476                 # listen port (0 = ephemeral)
+    tpu_fleet_advertise = "10.0.0.2:8476" # addr peers dial (default
+                                          # bind:port)
+    tpu_fleet_coordinator = "10.0.0.1:8476"  # rank 0's endpoint;
+                                          # optional on rank 0 itself
+    tpu_fleet_heartbeat_ms = 500          # ticker interval
+    tpu_fleet_suspect_ms = 2000           # missed-heartbeat -> suspect
+    tpu_fleet_evict_ms = 5000             # -> draining (evicted)
+    tpu_fleet_depart_ms = 2000            # evicted -> departed grace
+    tpu_fleet_rejoin_backoff_ms = 1000    # self-eviction rejoin backoff
+
+Rank and fleet size default from the ``jax.distributed`` spec
+(``input.tpu_process_id`` / ``tpu_num_processes``) so a multi-host JAX
+config grows fleet membership with three added lines; fleet-only
+deployments (scalar pipelines, heterogeneous hosts) set
+``tpu_fleet_rank`` / ``tpu_fleet_hosts`` instead.
+
+Failure semantics: heartbeats ride the ticker thread (supervised),
+every send is a short-lived HTTP POST under a hard socket timeout, and
+a dead peer costs one timed-out connect per interval — the decode hot
+path never waits on the fleet.  A host that discovers its own eviction
+(a reply's view of it says draining/departed at its incarnation) backs
+off through ``Supervisor.fleet_policy`` and rejoins with a fresh
+incarnation (counted as ``fleet_rejoins``).
+
+Fault sites (``utils/faultinject.py``): ``peer_partition`` drops
+inbound heartbeats (optionally only from ``FLOWGGER_PARTITION_PEER``),
+``host_kill`` SIGKILLs this process from the ticker — both
+deterministic, for the multi-process acceptance tests.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+from ..config import Config, ConfigError
+from ..utils import faultinject
+from ..utils.metrics import registry as _global_registry
+from .health import HealthService, PartitionDrop
+from .membership import (
+    ACTIVE,
+    DEPARTED,
+    DRAINING,
+    JOINING,
+    Membership,
+)
+
+DEFAULT_HEARTBEAT_MS = 500
+DEFAULT_SUSPECT_MS = 2_000
+DEFAULT_EVICT_MS = 5_000
+DEFAULT_DEPART_MS = 2_000
+DEFAULT_REJOIN_BACKOFF_MS = 1_000
+
+PARTITION_PEER_ENV = "FLOWGGER_PARTITION_PEER"
+
+# health-document schema version; tests/resources/healthz_schema.json
+# is the golden copy a CI test validates real payloads against
+HEALTH_SCHEMA = 1
+
+
+@dataclass
+class FleetSpec:
+    rank: int
+    hosts: int
+    bind: str
+    port: int
+    advertise: Optional[str]
+    coordinator: Optional[str]
+    heartbeat_ms: int
+    suspect_ms: int
+    evict_ms: int
+    depart_ms: int
+    rejoin_backoff_ms: int
+
+
+def _check_mesh_conflict(config: Config) -> None:
+    """Config-time lanes-vs-mesh resolution for fleet hosts: PR 5 lane
+    dispatch supersedes the sharded decode mesh whenever more than one
+    lane resolves, so a fleet config pinning both is an error *now*,
+    not a silently-unused mesh at the first batch."""
+    mesh_mode = config.lookup_str(
+        "input.tpu_mesh", "input.tpu_mesh must be a string", "auto")
+    lanes = config.lookup_int(
+        "input.tpu_lanes",
+        "input.tpu_lanes must be an integer (device lanes)", None)
+    if mesh_mode == "on" and lanes is not None and lanes > 1:
+        raise ConfigError(
+            'input.tpu_lanes > 1 and input.tpu_mesh = "on" are mutually '
+            "exclusive on a fleet host (lanes give each chip its own "
+            "batches; the mesh shards one batch across chips) — drop "
+            "one of the two keys")
+
+
+def fleet_spec(config: Config) -> Optional[FleetSpec]:
+    """Parse the ``input.tpu_fleet_*`` family; None when the config
+    doesn't ask for fleet membership.  Validation raises ``ConfigError``
+    with the key name, matching the reference's config error style."""
+    enabled = config.lookup_bool(
+        "input.tpu_fleet", "input.tpu_fleet must be a boolean", False)
+    if not enabled:
+        return None
+    _check_mesh_conflict(config)
+    # rank/size: fleet keys win, jax.distributed spec is the default
+    dist_rank = config.lookup_int(
+        "input.tpu_process_id", "input.tpu_process_id must be an integer")
+    dist_hosts = config.lookup_int(
+        "input.tpu_num_processes",
+        "input.tpu_num_processes must be an integer")
+    rank = config.lookup_int(
+        "input.tpu_fleet_rank", "input.tpu_fleet_rank must be an integer",
+        dist_rank if dist_rank is not None else 0)
+    hosts = config.lookup_int(
+        "input.tpu_fleet_hosts", "input.tpu_fleet_hosts must be an integer",
+        dist_hosts if dist_hosts is not None else 1)
+    if hosts < 1:
+        raise ConfigError("input.tpu_fleet_hosts must be >= 1")
+    if not 0 <= rank < hosts:
+        raise ConfigError(
+            "input.tpu_fleet_rank must be in [0, tpu_fleet_hosts)")
+    bind = config.lookup_str(
+        "input.tpu_fleet_bind", "input.tpu_fleet_bind must be a string",
+        "127.0.0.1")
+    port = config.lookup_int(
+        "input.tpu_fleet_port",
+        "input.tpu_fleet_port must be an integer (0 = ephemeral)", 0)
+    if not 0 <= port < 65536:
+        raise ConfigError("input.tpu_fleet_port must be in [0, 65536)")
+    advertise = config.lookup_str(
+        "input.tpu_fleet_advertise",
+        "input.tpu_fleet_advertise must be a host:port string")
+    if advertise is None and hosts > 1 and bind in ("0.0.0.0", "::", ""):
+        # the advertise default is bind:port — a wildcard bind would
+        # gossip "0.0.0.0:port", which every peer resolves to ITSELF
+        # and the healthy host gets evicted fleet-wide.  Catch it at
+        # config time, not as a mystery eviction in production
+        raise ConfigError(
+            "input.tpu_fleet_advertise is required when "
+            "tpu_fleet_bind is a wildcard address (peers cannot dial "
+            f"\"{bind}\")")
+    coordinator = config.lookup_str(
+        "input.tpu_fleet_coordinator",
+        "input.tpu_fleet_coordinator must be a host:port string")
+    if coordinator is None and rank != 0 and hosts > 1:
+        raise ConfigError(
+            "input.tpu_fleet_coordinator is required on ranks > 0 "
+            "(rank 0's health endpoint is the rendezvous address)")
+    heartbeat_ms = config.lookup_int(
+        "input.tpu_fleet_heartbeat_ms",
+        "input.tpu_fleet_heartbeat_ms must be an integer (ms)",
+        DEFAULT_HEARTBEAT_MS)
+    suspect_ms = config.lookup_int(
+        "input.tpu_fleet_suspect_ms",
+        "input.tpu_fleet_suspect_ms must be an integer (ms)",
+        DEFAULT_SUSPECT_MS)
+    evict_ms = config.lookup_int(
+        "input.tpu_fleet_evict_ms",
+        "input.tpu_fleet_evict_ms must be an integer (ms)",
+        DEFAULT_EVICT_MS)
+    depart_ms = config.lookup_int(
+        "input.tpu_fleet_depart_ms",
+        "input.tpu_fleet_depart_ms must be an integer (ms)",
+        DEFAULT_DEPART_MS)
+    rejoin_ms = config.lookup_int(
+        "input.tpu_fleet_rejoin_backoff_ms",
+        "input.tpu_fleet_rejoin_backoff_ms must be an integer (ms)",
+        DEFAULT_REJOIN_BACKOFF_MS)
+    if heartbeat_ms < 1:
+        raise ConfigError("input.tpu_fleet_heartbeat_ms must be >= 1")
+    if not heartbeat_ms < suspect_ms < evict_ms:
+        raise ConfigError(
+            "fleet deadlines must satisfy tpu_fleet_heartbeat_ms < "
+            "tpu_fleet_suspect_ms < tpu_fleet_evict_ms")
+    return FleetSpec(rank=rank, hosts=hosts, bind=bind, port=port,
+                     advertise=advertise, coordinator=coordinator,
+                     heartbeat_ms=heartbeat_ms, suspect_ms=suspect_ms,
+                     evict_ms=evict_ms, depart_ms=depart_ms,
+                     rejoin_backoff_ms=rejoin_ms)
+
+
+def _http_post_json(addr: str, path: str, doc: dict, timeout: float,
+                    registry=_global_registry) -> Optional[dict]:
+    """One short-lived POST; None on any failed delivery — a fleet
+    send failing is normal life under partition/churn, so it is counted
+    (``fleet_hb_send_errors``), not logged.  ``addr`` is remote input
+    (gossip can relay anything), so even parsing it stays inside the
+    failure path: a malformed peer entry costs one counted miss, never
+    the ticker thread."""
+    import http.client
+
+    conn = None
+    try:
+        host, _, port = addr.rpartition(":")
+        conn = http.client.HTTPConnection(host, int(port), timeout=timeout)
+        body = json.dumps(doc).encode()
+        conn.request("POST", path, body=body,
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        data = resp.read()
+        if resp.status != 200:
+            # a 503 (partitioned / draining listener) is a failed
+            # delivery too — uncounted it would make a partition with
+            # live listeners look like a clean network
+            registry.inc("fleet_hb_send_errors")
+            return None
+        out = json.loads(data)
+        return out if isinstance(out, dict) else None
+    except (OSError, ValueError):
+        registry.inc("fleet_hb_send_errors")
+        return None
+    finally:
+        if conn is not None:
+            conn.close()
+
+
+class Fleet:
+    """One host's fleet agent: health service + heartbeat ticker +
+    membership, wired into the pipeline's drain lifecycle."""
+
+    def __init__(self, spec: FleetSpec, supervisor=None, registry=None,
+                 on_drain=None):
+        self.spec = spec
+        self.supervisor = supervisor
+        self._registry = registry if registry is not None else _global_registry
+        self._on_drain_cb = on_drain
+        self._stop = threading.Event()
+        self._ticker: Optional[threading.Thread] = None
+        self._draining = False  # voluntary drain: disables rejoin
+        self._lock = threading.Lock()
+        self.membership: Optional[Membership] = None
+        self.service: Optional[HealthService] = None
+        self._rejoin_policy = None  # lazily built; persists across rejoins
+        self._started = time.monotonic()
+
+    @classmethod
+    def from_config(cls, config: Config, supervisor=None, registry=None,
+                    on_drain=None) -> Optional["Fleet"]:
+        spec = fleet_spec(config)
+        if spec is None:
+            return None
+        return cls(spec, supervisor=supervisor, registry=registry,
+                   on_drain=on_drain)
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        spec = self.spec
+        self.service = HealthService(
+            spec.bind, spec.port, payload=self.health_payload,
+            healthy=self._lb_healthy, on_heartbeat=self.on_heartbeat,
+            on_drain=self._drain_requested)
+        advertise = spec.advertise or \
+            f"{spec.bind}:{self.service.port}"
+        self.membership = Membership(
+            rank=spec.rank, addr=advertise, suspect_ms=spec.suspect_ms,
+            evict_ms=spec.evict_ms, depart_ms=spec.depart_ms,
+            registry=self._registry)
+        self.service.start(self.supervisor)
+        self.membership.activate()
+        print(f"fleet: rank {spec.rank}/{spec.hosts} active, "
+              f"health endpoint http://{self.service.addr}/healthz",
+              file=sys.stderr)
+        if self.supervisor is not None:
+            self._ticker = self.supervisor.spawn(
+                self._tick_loop, "fleet-ticker", exhausted="return")
+        else:
+            self._ticker = threading.Thread(
+                target=self._tick_loop, daemon=True, name="fleet-ticker")
+            self._ticker.start()
+
+    def enter_draining(self, sync_wave: bool = True) -> None:
+        """Drain-on-departure, phase 1 (SIGTERM / fleetctl / EOF): the
+        host stops being routable (healthz flips to 503) and announces
+        ``draining`` to every peer so they absorb new traffic while
+        this host's ``Pipeline._drain`` fence-all/straggler machinery
+        flushes in-flight batches byte-identically.
+
+        ``sync_wave=False`` fires the announce wave on its own thread —
+        the ``POST /drain`` handler uses it so its HTTP reply never
+        waits out one socket timeout per unreachable peer."""
+        if self.membership is None:
+            return
+        with self._lock:
+            if self._draining:
+                return
+            self._draining = True
+        self.membership.mark_draining()
+        if sync_wave:
+            self._send_heartbeats()  # don't wait a tick: announce now
+        else:
+            threading.Thread(target=self._send_heartbeats, daemon=True,
+                             name="fleet-drain-wave").start()
+
+    def shutdown(self) -> None:
+        """Drain-on-departure, phase 2: in-flight batches are flushed,
+        announce ``departed`` and stop the fleet threads."""
+        if self.membership is not None:
+            with self._lock:
+                self._draining = True
+            if self.membership.local.state != DEPARTED:
+                self.membership.mark_departed()
+                self._send_heartbeats()
+        self._stop.set()
+        if self.service is not None:
+            self.service.stop()
+
+    def wait_active(self, hosts: int, timeout: float = 60.0) -> bool:
+        """Block until ``hosts`` members are active (tests/bench
+        rendezvous barrier; never used on the decode path)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.membership is not None and \
+                    self.membership.counts()[ACTIVE] >= hosts:
+                return True
+            time.sleep(0.02)
+        return False
+
+    # -- ticker ------------------------------------------------------------
+    def _tick_loop(self) -> None:
+        interval = self.spec.heartbeat_ms / 1000.0
+        while not self._stop.wait(interval):
+            if faultinject.enabled() and faultinject.fire("host_kill"):
+                # deterministic hard host loss for the acceptance
+                # tests: SIGKILL, no drain, no goodbye — peers must
+                # discover it through the missed-heartbeat ladder
+                import signal
+
+                print("faultinject: host_kill firing — SIGKILL",
+                      file=sys.stderr, flush=True)
+                os.kill(os.getpid(), signal.SIGKILL)
+            self._send_heartbeats()
+            if self.membership is not None:
+                self.membership.tick()
+
+    def _heartbeat_doc(self) -> dict:
+        local = self.membership.local
+        return {"op": "hb", "rank": local.rank, "addr": local.addr,
+                "state": local.state, "incarnation": local.incarnation}
+
+    def _send_heartbeats(self) -> None:
+        if self.membership is None:
+            return
+        local = self.membership.local
+        targets: Dict[str, Optional[int]] = {}
+        if self.spec.coordinator and self.spec.coordinator != local.addr:
+            targets[self.spec.coordinator] = None
+        for rank, addr in self.membership.heartbeat_targets():
+            if addr != local.addr:
+                targets[addr] = rank
+        timeout = max(0.05, min(1.0, self.spec.heartbeat_ms / 1000.0))
+        doc = self._heartbeat_doc()
+        for addr, rank in targets.items():
+            reply = _http_post_json(addr, "/hb", doc, timeout,
+                                    registry=self._registry)
+            if reply is None:
+                continue
+            self._absorb_reply(reply)
+
+    def _absorb_reply(self, reply: dict) -> None:
+        """A heartbeat reply is liveness proof for the responder, a
+        roster to gossip-merge, and possibly the news of our own
+        eviction."""
+        sender = reply.get("from")
+        if isinstance(sender, dict):
+            try:
+                s_rank = int(sender["rank"])
+                if faultinject.enabled():
+                    # a partition blocks BOTH directions: when the named
+                    # peer answers our heartbeat, the reply is liveness
+                    # proof too, and it must drop with the site.  (The
+                    # unnamed everything-partition is handled inbound —
+                    # the receiver 503s, so no reply reaches here.)
+                    named = self._partition_peer()
+                    if named == s_rank and faultinject.fire(
+                            "peer_partition"):
+                        return
+                self.membership.note_heartbeat(
+                    s_rank, str(sender["addr"]),
+                    str(sender.get("state", ACTIVE)),
+                    int(sender.get("incarnation", 0)))
+            except (KeyError, TypeError, ValueError):
+                self._registry.inc("fleet_hb_send_errors")
+        for entry in reply.get("roster", []):
+            if not isinstance(entry, dict):
+                continue
+            try:
+                self.membership.note_roster(
+                    int(entry["rank"]), str(entry["addr"]),
+                    str(entry["state"]), int(entry.get("incarnation", 0)))
+            except (KeyError, TypeError, ValueError):
+                self._registry.inc("fleet_hb_send_errors")
+        view = reply.get("view")
+        if isinstance(view, dict):
+            self._maybe_rejoin(view)
+
+    def _maybe_rejoin(self, view: dict) -> None:
+        """A peer's view of *us* says draining/departed at our own (or
+        a higher) incarnation: the fleet evicted us.  Back off through
+        the supervisor's fleet ladder, then rejoin with a fresh
+        incarnation — the fleet-granularity analog of the PR 2 thread
+        restart."""
+        local = self.membership.local
+        with self._lock:
+            voluntary = self._draining
+        if voluntary or view.get("state") not in (DRAINING, DEPARTED):
+            return
+        try:
+            seen_inc = int(view.get("incarnation", 0))
+        except (TypeError, ValueError):
+            return
+        if seen_inc < local.incarnation:
+            return  # stale view of a life we already left behind
+        if self.supervisor is not None:
+            if self._rejoin_policy is None:
+                self._rejoin_policy = self.supervisor.fleet_policy(
+                    init_ms=self.spec.rejoin_backoff_ms)
+            if self._rejoin_policy.backoff() is None:
+                print("fleet: rejoin budget exhausted, staying departed",
+                      file=sys.stderr)
+                self._stop.set()
+                return
+        else:
+            self._registry.inc("fleet_rejoins")
+            time.sleep(self.spec.rejoin_backoff_ms / 1000.0)
+        inc = self.membership.local_rejoin()
+        print(f"fleet: evicted by peers (view: {view.get('state')}); "
+              f"rejoining as incarnation {inc}", file=sys.stderr)
+        self._send_heartbeats()
+
+    # -- inbound (health service callbacks) --------------------------------
+    def _partition_peer(self) -> Optional[int]:
+        raw = os.environ.get(PARTITION_PEER_ENV)
+        if raw is None or not raw.strip().lstrip("-").isdigit():
+            return None
+        return int(raw)
+
+    def on_heartbeat(self, msg: dict) -> dict:
+        """Inbound ``POST /hb``: tie-break + absorb, reply with our
+        roster, our identity, and our view of the sender."""
+        try:
+            rank = int(msg["rank"])
+            addr = str(msg["addr"])
+            state = str(msg.get("state", ACTIVE))
+            inc = int(msg.get("incarnation", 0))
+        except (KeyError, TypeError, ValueError) as e:
+            raise PartitionDrop() from e  # malformed == undeliverable
+        if faultinject.enabled():
+            named = self._partition_peer()
+            if (named is None or named == rank) \
+                    and faultinject.fire("peer_partition"):
+                raise PartitionDrop()
+        accepted = self.membership.note_heartbeat(rank, addr, state, inc)
+        local = self.membership.local
+        return {
+            "ok": bool(accepted),
+            "from": {"rank": local.rank, "addr": local.addr,
+                     "state": local.state,
+                     "incarnation": local.incarnation},
+            "roster": self.membership.roster(),
+            "view": self.membership.view_of(rank),
+        }
+
+    def _drain_requested(self) -> dict:
+        """Inbound ``POST /drain`` (fleetctl): flip to draining and
+        kick the pipeline's drain path off-thread — the HTTP reply must
+        not wait out a full queue flush, nor (sync_wave=False) one
+        socket timeout per dead peer."""
+        self.enter_draining(sync_wave=False)
+        if self._on_drain_cb is not None:
+            t = threading.Thread(target=self._on_drain_cb, daemon=True,
+                                 name="fleet-drain-request")
+            t.start()
+        state = self.membership.local.state if self.membership else DRAINING
+        return {"ok": True, "state": state}
+
+    # -- health document ---------------------------------------------------
+    def _lb_healthy(self) -> bool:
+        if self.membership is None:
+            return False
+        return self.membership.local.state in (JOINING, ACTIVE)
+
+    def health_payload(self) -> Dict[str, object]:
+        """The ``GET /healthz`` document.  Schema is golden-file-tested
+        (tests/resources/healthz_schema.json) — additive changes bump
+        ``HEALTH_SCHEMA``."""
+        local = self.membership.local if self.membership else None
+        counts = self.membership.counts() if self.membership else {}
+        return {
+            "schema": HEALTH_SCHEMA,
+            "ts": round(time.time(), 3),
+            "uptime_s": round(time.monotonic() - self._started, 3),
+            "host": {
+                "rank": local.rank if local else -1,
+                "addr": local.addr if local else "",
+                "state": local.state if local else "down",
+                "incarnation": local.incarnation if local else 0,
+                "draining": bool(self._draining),
+            },
+            "fleet": {
+                "hosts": self.spec.hosts,
+                "counts": counts,
+                "peers": self.membership.roster() if self.membership else [],
+            },
+            "metrics": self._registry.snapshot(),
+        }

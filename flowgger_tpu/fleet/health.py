@@ -1,0 +1,164 @@
+"""Per-host health export + heartbeat transport: one small HTTP
+endpoint per fleet host.
+
+A load balancer (or ``tools/fleetctl.py``, or a peer) talks to it:
+
+- ``GET /healthz`` — the full health document: local host identity and
+  lifecycle state, the fleet view (per-peer states, last-heartbeat
+  ages, ``fleet_hosts_*`` counts), and the complete metrics-registry
+  snapshot (lane economics, breaker state, queue depth, aot_hits,
+  tenant aggregates — everything ``utils/metrics.py`` reports).
+  Status code is the contract for dumb LBs: **200** while the host
+  should receive traffic (joining/active), **503** once it should not
+  (draining/departed), so ``GET /healthz`` drops out of rotation the
+  moment drain-on-departure begins.
+- ``POST /hb`` (and ``/join``, the same handler — a join is just a
+  first heartbeat) — the peer heartbeat exchange: body carries the
+  sender's identity, the JSON reply carries this host's roster (the
+  gossip channel) and its view of the sender (how an evicted host
+  finds out).
+- ``POST /drain`` — ask this host to drain: flips it to ``draining``
+  and triggers the pipeline's SIGTERM drain path when one is attached
+  (``fleetctl drain``).
+
+Transport choice: plain HTTP over TCP, one short-lived connection per
+exchange, every socket under a hard timeout.  No JAX collectives, no
+long-lived connections a dead peer could wedge — a peer that stops
+answering costs exactly one timed-out connect per heartbeat interval,
+on a background thread, never on the decode path.
+
+The server threads run daemonized under ``ThreadingHTTPServer``; the
+accept loop itself is spawned through the pipeline ``Supervisor`` so a
+crashed exporter restarts with backoff instead of silently going dark
+(see federation.py).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, Optional
+
+MAX_BODY = 1 << 20  # heartbeat bodies are ~100s of bytes; cap abuse
+
+
+class PartitionDrop(Exception):
+    """Raised by the heartbeat sink when the ``peer_partition`` fault
+    site fires: the exchange is dropped as if the network ate it."""
+
+
+class HealthService:
+    """The HTTP listener.  ``on_heartbeat``/``on_drain``/``payload``
+    are injected by ``federation.Fleet`` (tests inject fakes)."""
+
+    def __init__(self, bind: str, port: int,
+                 payload: Callable[[], Dict[str, object]],
+                 healthy: Callable[[], bool],
+                 on_heartbeat: Optional[Callable[[dict], dict]] = None,
+                 on_drain: Optional[Callable[[], dict]] = None):
+        self._payload = payload
+        self._healthy = healthy
+        self._on_heartbeat = on_heartbeat
+        self._on_drain = on_drain
+        service = self
+
+        class Handler(BaseHTTPRequestHandler):
+            # one heartbeat per connection; keep-alive would pin a
+            # server thread per peer for no benefit
+            protocol_version = "HTTP/1.0"
+
+            def log_message(self, fmt, *args):  # noqa: N802 - stdlib name
+                pass  # health probes at 1Hz+ would flood stderr
+
+            def _reply(self, code: int, doc: Dict[str, object]) -> None:
+                body = json.dumps(doc).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802 - stdlib name
+                if self.path.split("?")[0] != "/healthz":
+                    self._reply(404, {"error": "unknown path",
+                                      "paths": ["/healthz"]})
+                    return
+                code = 200 if service._healthy() else 503
+                self._reply(code, service._payload())
+
+            def do_POST(self):  # noqa: N802 - stdlib name
+                path = self.path.split("?")[0]
+                if path == "/drain":
+                    if service._on_drain is None:
+                        self._reply(501, {"error": "no drain hook"})
+                        return
+                    self._reply(200, service._on_drain())
+                    return
+                if path not in ("/hb", "/join"):
+                    self._reply(404, {"error": "unknown path",
+                                      "paths": ["/hb", "/join", "/drain"]})
+                    return
+                if service._on_heartbeat is None:
+                    self._reply(501, {"error": "no heartbeat sink"})
+                    return
+                try:
+                    length = min(int(self.headers.get("Content-Length", 0)),
+                                 MAX_BODY)
+                    msg = json.loads(self.rfile.read(length) or b"{}")
+                    if not isinstance(msg, dict):
+                        raise ValueError("heartbeat body must be an object")
+                except (ValueError, OSError) as e:
+                    self._reply(400, {"error": f"bad heartbeat: {e}"})
+                    return
+                try:
+                    self._reply(200, service._on_heartbeat(msg))
+                except PartitionDrop:
+                    # injected partition: answer like a flaky network
+                    # path would — the sender sees a failed delivery
+                    self._reply(503, {"error": "partitioned"})
+
+        self._server = ThreadingHTTPServer((bind, port), Handler)
+        self._server.daemon_threads = True
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        """The bound port (resolves ``port=0`` ephemeral binds)."""
+        return self._server.server_address[1]
+
+    @property
+    def addr(self) -> str:
+        host = self._server.server_address[0]
+        return f"{host}:{self.port}"
+
+    def start(self, supervisor=None) -> None:
+        """Serve until ``stop()``.  With a pipeline ``Supervisor`` the
+        accept loop restarts on crash; without one (tests, fleetctl
+        smoke) it runs on a plain daemon thread."""
+        if self._thread is not None:
+            return
+        if supervisor is not None:
+            # a dead health endpoint takes the host out of LB rotation,
+            # not the process down: exhausted budget returns
+            self._thread = supervisor.spawn(
+                self._server.serve_forever, "fleet-health",
+                exhausted="return")
+        else:
+            self._thread = threading.Thread(
+                target=self._server.serve_forever, daemon=True,
+                name="fleet-health")
+            self._thread.start()
+
+    def stop(self) -> None:
+        if self._thread is None:
+            return
+        try:
+            self._server.shutdown()
+            self._server.server_close()
+        except OSError as e:
+            # a half-closed listener at teardown is not worth a crash,
+            # but say so — silent shutdown bugs hide port leaks
+            print(f"fleet-health: shutdown error: {e}", file=sys.stderr)
+        self._thread = None

@@ -1,0 +1,390 @@
+"""Fleet membership: the per-host state machine that federates N
+single-host pipelines into one fleet view.
+
+Logs are embarrassingly data-parallel (SURVEY.md §2.8 — no cross-record
+communication to preserve), so fleet membership is *advisory*: it never
+gates the decode hot path.  Each host keeps its own view of every peer,
+built purely from heartbeat observations, and exports it through the
+health endpoint for a load balancer to act on.  There is no consensus
+round and no JAX collective anywhere in this module — a dead peer can
+never block a live host's decode.
+
+Per-host lifecycle (the PR 2 breaker/supervisor ladder at fleet
+granularity)::
+
+    joining ──► active ──► draining ──► departed
+                  │  ▲                      │
+                  ▼  │ (heartbeat resumes)  │ rejoin: fresh
+                suspect ──► draining        │ incarnation only
+                (missed     (evicted)       ▼
+                 heartbeats)              joining ...
+
+- ``joining``   — announced (rendezvous/roster) but no direct heartbeat
+  proof of liveness yet;
+- ``active``    — heartbeating within ``suspect_ms``;
+- ``suspect``   — heartbeats missing past ``suspect_ms``; cured by the
+  next heartbeat (suspect → active);
+- ``draining``  — the host is flushing in-flight batches.  Entered
+  voluntarily (SIGTERM / ``fleetctl drain`` — the host announces it) or
+  by *eviction* (heartbeats missing past ``evict_ms``: peers assume the
+  host is gone and treat it as draining so the load balancer stops
+  routing to it while any straggling output flushes);
+- ``departed``  — terminal for this incarnation.  ``draining`` is
+  deliberately unreachable from ``departed``: a departed rank can only
+  come back by *rejoining* with a strictly higher incarnation, which
+  restarts the ladder at ``joining``.
+
+Rank tie-breaks are deterministic: when two hosts claim the same rank,
+the strictly higher incarnation wins; on equal incarnations the
+incumbent (first observed) keeps the rank and the newcomer is rejected.
+No clock comparison, no address ordering — the same inputs produce the
+same winner on every host.
+
+Exported metrics (consumed by the health endpoint and any scraper):
+``fleet_hosts_{joining,active,suspect,draining,departed}`` gauges (the
+local host counts toward its own state), per-peer
+``fleet_peer{rank}_state`` / ``fleet_peer{rank}_hb_age_ms`` gauges, and
+the ``fleet_evictions`` counter.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+JOINING, ACTIVE, SUSPECT, DRAINING, DEPARTED = (
+    "joining", "active", "suspect", "draining", "departed")
+STATES = (JOINING, ACTIVE, SUSPECT, DRAINING, DEPARTED)
+STATE_GAUGE = {JOINING: 0, ACTIVE: 1, SUSPECT: 2, DRAINING: 3, DEPARTED: 4}
+
+DEFAULT_SUSPECT_MS = 2_000
+DEFAULT_EVICT_MS = 5_000
+DEFAULT_DEPART_MS = 2_000
+
+_ALLOWED = frozenset({
+    (JOINING, ACTIVE),
+    (JOINING, DRAINING),    # SIGTERM (or eviction) before any heartbeat
+    (ACTIVE, SUSPECT),
+    (SUSPECT, ACTIVE),      # heartbeat resumed within the evict window
+    (ACTIVE, DRAINING),
+    (SUSPECT, DRAINING),
+    (DRAINING, DEPARTED),
+    (DEPARTED, JOINING),    # rejoin — requires a fresh incarnation
+})
+
+
+class FleetStateError(Exception):
+    """An illegal membership transition was requested explicitly (the
+    heartbeat paths never raise: stale gossip is ignored, not fatal)."""
+
+
+@dataclass
+class PeerView:
+    """One host as seen from here.  ``last_hb`` is this host's monotonic
+    clock at the last liveness proof (a direct heartbeat either way);
+    ``evicted`` marks a draining state entered by missed heartbeats
+    rather than the peer's own announcement."""
+
+    rank: int
+    addr: str
+    state: str = JOINING
+    incarnation: int = 0
+    last_hb: float = 0.0
+    evicted: bool = False
+
+
+class Membership:
+    """Thread-safe fleet view for one host.  All mutation funnels
+    through ``_transition`` so the ladder above is enforced everywhere
+    and every change lands in ``transitions`` (the test- and
+    debug-visible history, same idiom as ``tpu/breaker.py``)."""
+
+    def __init__(self, rank: int, addr: str, incarnation: int = 0,
+                 suspect_ms: int = DEFAULT_SUSPECT_MS,
+                 evict_ms: int = DEFAULT_EVICT_MS,
+                 depart_ms: int = DEFAULT_DEPART_MS,
+                 clock=time.monotonic, registry=None):
+        if suspect_ms >= evict_ms:
+            raise ValueError("suspect_ms must be < evict_ms "
+                             "(suspect is the rung before eviction)")
+        self.rank = rank
+        self.suspect_ms = suspect_ms
+        self.evict_ms = evict_ms
+        self.depart_ms = depart_ms
+        self._clock = clock
+        if registry is None:
+            from ..utils.metrics import registry as _global_registry
+
+            registry = _global_registry
+        self._metrics = registry
+        self._lock = threading.Lock()
+        self._peers: Dict[int, PeerView] = {}
+        self._peers[rank] = PeerView(rank=rank, addr=addr, state=JOINING,
+                                     incarnation=incarnation,
+                                     last_hb=self._clock())
+        self.transitions: List[Tuple[float, int, str, str]] = []
+        with self._lock:
+            self._publish_gauges()
+
+    # -- core transition (callers hold self._lock) -------------------------
+    def _transition(self, peer: PeerView, new: str) -> bool:
+        old = peer.state
+        if old == new:
+            return False
+        if (old, new) not in _ALLOWED:
+            raise FleetStateError(
+                f"illegal fleet transition for rank {peer.rank}: "
+                f"{old} -> {new}"
+                + (" (departed is terminal for an incarnation; rejoin "
+                   "with a higher incarnation instead)"
+                   if old == DEPARTED else ""))
+        peer.state = new
+        self.transitions.append((self._clock(), peer.rank, old, new))
+        return True
+
+    def _publish_gauges(self) -> None:
+        counts = {s: 0 for s in STATES}
+        now = self._clock()
+        for peer in self._peers.values():
+            counts[peer.state] += 1
+            self._metrics.set_gauge(f"fleet_peer{peer.rank}_state",
+                                    STATE_GAUGE[peer.state])
+            age_ms = 0.0 if peer.rank == self.rank else \
+                (now - peer.last_hb) * 1000.0
+            self._metrics.set_gauge(f"fleet_peer{peer.rank}_hb_age_ms",
+                                    round(age_ms, 1))
+        for state, n in counts.items():
+            self._metrics.set_gauge(f"fleet_hosts_{state}", n)
+
+    # -- local lifecycle ---------------------------------------------------
+    @property
+    def local(self) -> PeerView:
+        with self._lock:
+            peer = self._peers[self.rank]
+            return PeerView(rank=peer.rank, addr=peer.addr, state=peer.state,
+                            incarnation=peer.incarnation,
+                            last_hb=peer.last_hb, evicted=peer.evicted)
+
+    def activate(self) -> None:
+        """Local host is up (service listening): joining → active."""
+        with self._lock:
+            self._transition(self._peers[self.rank], ACTIVE)
+            self._publish_gauges()
+
+    def local_rejoin(self) -> int:
+        """The fleet evicted *us* (a peer's view answered that our rank
+        is draining/departed at our incarnation).  Bump the incarnation
+        and restart the local ladder — peers accept the comeback only
+        because the incarnation is strictly higher.  Returns the new
+        incarnation."""
+        with self._lock:
+            peer = self._peers[self.rank]
+            peer.incarnation += 1
+            peer.evicted = False
+            if peer.state != JOINING:
+                # departed is the only legal source of a rejoin; walk the
+                # ladder explicitly so the history stays legible
+                if peer.state in (ACTIVE, SUSPECT):
+                    self._transition(peer, DRAINING)
+                if peer.state == DRAINING:
+                    self._transition(peer, DEPARTED)
+                self._transition(peer, JOINING)
+            self._transition(peer, ACTIVE)
+            peer.last_hb = self._clock()
+            self._publish_gauges()
+            return peer.incarnation
+
+    # -- peer observations -------------------------------------------------
+    def note_heartbeat(self, rank: int, addr: str, state: str = ACTIVE,
+                       incarnation: int = 0) -> bool:
+        """One direct liveness proof (inbound heartbeat, or a reply to
+        ours).  Returns False when the claim loses its tie-break and was
+        ignored (stale incarnation, or a rank collision the incumbent
+        wins)."""
+        if state not in STATES or rank == self.rank:
+            # the local lifecycle is driven locally — a remote claim to
+            # our rank never rewrites it (see view_of/local_rejoin for
+            # how an evicted host learns its fate)
+            return False
+        with self._lock:
+            peer = self._peers.get(rank)
+            if peer is None:
+                peer = PeerView(rank=rank, addr=addr,
+                                incarnation=incarnation,
+                                last_hb=self._clock())
+                self._peers[rank] = peer
+                self.transitions.append((self._clock(), rank, "", JOINING))
+            else:
+                if incarnation < peer.incarnation:
+                    return False  # stale duplicate of an older life
+                if incarnation == peer.incarnation:
+                    if peer.state == DEPARTED:
+                        # departed is terminal per incarnation: only a
+                        # strictly fresher life can resurrect the rank
+                        return False
+                    if addr != peer.addr:
+                        # rank collision, equal incarnation: incumbent
+                        # wins, deterministically, on every host
+                        return False
+                else:
+                    # higher incarnation always wins the rank: fold the
+                    # old life to departed first so the ladder holds
+                    if peer.state in (ACTIVE, SUSPECT, JOINING):
+                        self._transition(peer, DRAINING)
+                    if peer.state == DRAINING:
+                        self._transition(peer, DEPARTED)
+                    self._transition(peer, JOINING)
+                    peer.incarnation = incarnation
+                    peer.evicted = False
+                peer.addr = addr
+            peer.last_hb = self._clock()
+            if state == DRAINING:
+                if peer.state in (JOINING, ACTIVE, SUSPECT):
+                    self._transition(peer, DRAINING)
+            elif state == DEPARTED:
+                if peer.state in (JOINING, ACTIVE, SUSPECT):
+                    self._transition(peer, DRAINING)
+                if peer.state == DRAINING:
+                    self._transition(peer, DEPARTED)
+            else:
+                # a live (joining/active) claim cures suspicion; a
+                # draining peer heartbeating stays draining (one-way)
+                if peer.state in (JOINING, SUSPECT):
+                    self._transition(peer, ACTIVE)
+                    peer.evicted = False
+            self._publish_gauges()
+            return True
+
+    def note_roster(self, rank: int, addr: str, state: str,
+                    incarnation: int = 0) -> None:
+        """Gossip (a roster entry relayed by another host): introduces
+        *new* peers, but never overrides a state we learned first-hand —
+        only direct heartbeats move an already-known peer.  Live gossip
+        states (joining/active/suspect) enter as ``joining`` (hearsay is
+        not liveness proof; we heartbeat the peer directly and promote
+        on its reply), while ``draining``/``departed`` enter as
+        announced — a cleanly-departed host must not be resurrected,
+        dialed for ``evict_ms``, and then counted as a spurious
+        eviction by every fresh joiner."""
+        if rank == self.rank or state not in STATES:
+            return
+        entry_state = state if state in (DRAINING, DEPARTED) else JOINING
+        with self._lock:
+            if rank in self._peers:
+                return
+            self._peers[rank] = PeerView(rank=rank, addr=addr,
+                                         state=entry_state,
+                                         incarnation=incarnation,
+                                         last_hb=self._clock())
+            self.transitions.append((self._clock(), rank, "", entry_state))
+            self._publish_gauges()
+
+    def mark_draining(self, rank: Optional[int] = None) -> None:
+        """Explicit drain (SIGTERM / fleetctl): flips the host to
+        draining.  Raises ``FleetStateError`` from ``departed`` —
+        draining is unreachable from the terminal state."""
+        rank = self.rank if rank is None else rank
+        with self._lock:
+            peer = self._peers.get(rank)
+            if peer is None or peer.state == DRAINING:
+                return
+            self._transition(peer, DRAINING)
+            self._publish_gauges()
+
+    def mark_departed(self, rank: Optional[int] = None) -> None:
+        """Drain complete: draining → departed.  Departure always passes
+        through draining so in-flight batches get their flush window."""
+        rank = self.rank if rank is None else rank
+        with self._lock:
+            peer = self._peers.get(rank)
+            if peer is None or peer.state == DEPARTED:
+                return
+            if peer.state in (JOINING, ACTIVE, SUSPECT):
+                self._transition(peer, DRAINING)
+            self._transition(peer, DEPARTED)
+            self._publish_gauges()
+
+    # -- ageing (the fleet supervisor's ladder) ----------------------------
+    def tick(self, now: Optional[float] = None) -> None:
+        """Age every remote peer against the heartbeat deadlines:
+        ``suspect_ms`` → suspect, ``evict_ms`` → evicted (treated as
+        draining so the LB stops routing while stragglers flush),
+        ``evict_ms + depart_ms`` → departed."""
+        now = self._clock() if now is None else now
+        with self._lock:
+            for peer in self._peers.values():
+                if peer.rank == self.rank or peer.state == DEPARTED:
+                    continue
+                age_ms = (now - peer.last_hb) * 1000.0
+                if peer.state == ACTIVE and age_ms > self.suspect_ms:
+                    self._transition(peer, SUSPECT)
+                if (peer.state in (SUSPECT, JOINING)
+                        and age_ms > self.evict_ms):
+                    self._transition(peer, DRAINING)
+                    peer.evicted = True
+                    self._metrics.inc("fleet_evictions")
+                if (peer.state == DRAINING
+                        and age_ms > self.evict_ms + self.depart_ms):
+                    # evicted drainers age out; so does a VOLUNTARY
+                    # drainer that announced draining and then died
+                    # mid-flush — without this it would sit draining
+                    # forever, costing every peer one timed-out
+                    # connect per interval for the rest of the fleet's
+                    # life
+                    self._transition(peer, DEPARTED)
+            self._publish_gauges()
+
+    # -- read side ---------------------------------------------------------
+    def counts(self) -> Dict[str, int]:
+        with self._lock:
+            out = {s: 0 for s in STATES}
+            for peer in self._peers.values():
+                out[peer.state] += 1
+            return out
+
+    def get(self, rank: int) -> Optional[PeerView]:
+        with self._lock:
+            peer = self._peers.get(rank)
+            if peer is None:
+                return None
+            return PeerView(rank=peer.rank, addr=peer.addr, state=peer.state,
+                            incarnation=peer.incarnation,
+                            last_hb=peer.last_hb, evicted=peer.evicted)
+
+    def heartbeat_targets(self) -> List[Tuple[int, str]]:
+        """(rank, addr) of every remote peer worth heartbeating — the
+        departed are left in peace until they rejoin."""
+        with self._lock:
+            return [(p.rank, p.addr) for p in self._peers.values()
+                    if p.rank != self.rank and p.state != DEPARTED]
+
+    def roster(self) -> List[Dict[str, object]]:
+        """JSON-safe snapshot of every peer (self included) — the
+        gossip payload carried on heartbeat replies."""
+        now = self._clock()
+        with self._lock:
+            out = []
+            for peer in sorted(self._peers.values(), key=lambda p: p.rank):
+                age_ms = 0.0 if peer.rank == self.rank else \
+                    (now - peer.last_hb) * 1000.0
+                out.append({
+                    "rank": peer.rank,
+                    "addr": peer.addr,
+                    "state": peer.state,
+                    "incarnation": peer.incarnation,
+                    "hb_age_ms": round(age_ms, 1),
+                    "evicted": peer.evicted,
+                })
+            return out
+
+    def view_of(self, rank: int) -> Optional[Dict[str, object]]:
+        """This host's opinion of one rank (heartbeat replies carry the
+        sender's entry so an evicted host can discover its own
+        eviction and rejoin)."""
+        peer = self.get(rank)
+        if peer is None:
+            return None
+        return {"rank": peer.rank, "state": peer.state,
+                "incarnation": peer.incarnation, "evicted": peer.evicted}

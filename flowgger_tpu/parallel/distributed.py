@@ -16,6 +16,12 @@ Config keys (all under ``[input]``, alongside the other tpu_* keys):
     tpu_process_id = 0                  # this host's rank
 
 See ``examples/multihost-dp.toml`` for a complete dp-over-DCN config.
+
+The JAX process group is only half the multi-host story: membership,
+per-host health export, and drain-on-departure live in
+``flowgger_tpu/fleet`` (``input.tpu_fleet_*`` keys, which default their
+rank/size from the spec above) — the heartbeat layer deliberately runs
+beside, not through, JAX so a dead peer never blocks decode.
 """
 
 from __future__ import annotations
@@ -64,11 +70,37 @@ def init_distributed(config: Config) -> bool:
     return True
 
 
-def make_global_decode_mesh(sp: int = 1):
+def make_global_decode_mesh(config: Optional[Config] = None, sp: int = 1):
     """Mesh over every device in the process group (all hosts): rows
     over ``dp`` (spanning DCN — embarrassingly parallel, no cross-host
     collectives on the decode path), bytes over ``sp`` (inside a host).
-    Call after ``init_distributed``."""
+    Call after ``init_distributed``.
+
+    Since PR 5, lane dispatch supersedes the sharded mesh whenever more
+    than one lane resolves — each chip gets its *own* batches, and a
+    global mesh would be built and never consulted.  Passing the
+    ``config`` makes that conflict a ``ConfigError`` at config time
+    (the fleet path always does) instead of silently constructing dead
+    weight: callers that genuinely want the sharded-mesh path must pin
+    ``tpu_mesh = "on"`` and leave ``tpu_lanes`` at 1/absent."""
+    if config is not None:
+        mesh_mode = config.lookup_str(
+            "input.tpu_mesh", "input.tpu_mesh must be a string", "auto")
+        if mesh_mode == "off":
+            raise ConfigError(
+                'input.tpu_mesh = "off": refusing to build a global '
+                "decode mesh this config will never consult")
+        lanes = config.lookup_int(
+            "input.tpu_lanes",
+            "input.tpu_lanes must be an integer (device lanes)", None)
+        if lanes is not None and lanes > 1:
+            raise ConfigError(
+                "input.tpu_lanes > 1: lane dispatch supersedes the "
+                "sharded decode mesh (each chip gets its own batches), "
+                "so a global decode mesh would be dead weight — drop "
+                'tpu_lanes or set tpu_mesh = "on" with tpu_lanes = 1')
+        sp = config.lookup_int(
+            "input.tpu_sp", "input.tpu_sp must be an integer", sp)
     from .mesh import make_decode_mesh
     import jax
 

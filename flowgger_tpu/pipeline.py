@@ -244,6 +244,16 @@ class Pipeline:
         _metrics_mod.configure_from(config)
         _faultinject.configure_from(config)
         self.supervisor = Supervisor(config)
+        # fleet federation (input.tpu_fleet = true): membership +
+        # health export + drain-on-departure for multi-host lane
+        # scale-out.  Construction is cheap and socket-free; run()
+        # starts the listener/ticker.  Unconfigured -> None, zero
+        # added overhead (fleet/federation.py)
+        from .fleet import Fleet
+
+        self.fleet = Fleet.from_config(
+            config, supervisor=self.supervisor,
+            on_drain=self._fleet_drain_signal)
         if input_format in _TPU_FORMATS:
             # multi-host: join the JAX process group before any device
             # op so the decode mesh's dp axis can span every host's
@@ -349,6 +359,12 @@ class Pipeline:
         in-flight submit/fetch executor (tpu/overlap.py LaneSet), so
         every batch any lane still holds reaches the queue — in batch
         order — before SHUTDOWN is enqueued."""
+        # drain-on-departure, phase 1: stop being routable and announce
+        # `draining` to fleet peers BEFORE the flush, so a load
+        # balancer stops sending new traffic while in-flight batches
+        # emit byte-identically through the fence-all path below
+        if self.fleet is not None:
+            self.fleet.enter_draining()
         # from here on, queue sheds also count queue_shed_during_drain:
         # a drain test can tell shed lines from delivered lines
         mark = getattr(self.tx, "mark_draining", None)
@@ -393,6 +409,10 @@ class Pipeline:
                   f"after 30s, abandoning: [{names}]", file=sys.stderr)
         _metrics_mod.registry.final_flush()
         _metrics_mod.stop_jax_profiler()
+        # drain-on-departure, phase 2: every queued batch reached the
+        # sinks — announce `departed` and stop the fleet threads
+        if self.fleet is not None:
+            self.fleet.shutdown()
 
     def _install_signal_handlers(self, threads):
         import os
@@ -411,11 +431,25 @@ class Pipeline:
         signal.signal(signal.SIGTERM, handle)
         signal.signal(signal.SIGINT, handle)
 
+    def _fleet_drain_signal(self):
+        """`POST /drain` on the health endpoint (fleetctl drain): route
+        through the SIGTERM path so a remote drain and a local one are
+        the same code — fence lanes, flush, drain the queue, exit."""
+        import os
+        import signal
+
+        os.kill(os.getpid(), signal.SIGTERM)
+
     def run(self):
         threads = self.start_output()
         if not isinstance(threads, list):
             threads = [threads]
         self._install_signal_handlers(threads)
+        # fleet membership goes live only once the pipeline can serve:
+        # sinks are up, signal handlers (the drain path peers rely on)
+        # are installed
+        if self.fleet is not None:
+            self.fleet.start()
         # the accept loop runs supervised: a crash in the transport
         # restarts it (bounded by [supervisor] config) instead of
         # killing the daemon while consumers still hold the queue

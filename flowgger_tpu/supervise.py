@@ -15,12 +15,20 @@ kernel-prewarm worker (tpu/device_common.py) spawn through ``spawn``
 too, so a crashed lane restarts with backoff instead of wedging its
 share of the in-flight window.
 
+The same ladder maps onto *hosts* at fleet granularity: a host whose
+heartbeats vanish walks missed-heartbeat → suspect → evicted in its
+peers' membership views (fleet/membership.py), and a host that
+discovers its own eviction rejoins through ``fleet_policy()`` — the
+fleet-level restart policy this module owns — with backoff and a
+bounded budget, exactly like a crashed thread.
+
 Config (all optional)::
 
     [supervisor]
     max_restarts = 16     # per thread between stable runs; absent = unlimited
     backoff_init = 100    # ms
     backoff_max = 30000   # ms
+    fleet_max_rejoins = 8 # host rejoins after eviction; absent = unlimited
 
 A supervised target that *returns* is treated as a clean exit (output
 workers return on the SHUTDOWN sentinel); only exceptions trigger a
@@ -58,15 +66,32 @@ class Supervisor:
                 "supervisor.backoff_max",
                 "supervisor.backoff_max must be an integer (ms)",
                 DEFAULT_BACKOFF_MAX_MS)
+            self.fleet_max_rejoins: Optional[int] = config.lookup_int(
+                "supervisor.fleet_max_rejoins",
+                "supervisor.fleet_max_rejoins must be an integer", None)
         else:
             self.max_restarts = None
             self.backoff_init = DEFAULT_BACKOFF_INIT_MS
             self.backoff_max = DEFAULT_BACKOFF_MAX_MS
+            self.fleet_max_rejoins = None
 
     def _policy(self) -> RetryPolicy:
         return RetryPolicy(init_ms=self.backoff_init, max_ms=self.backoff_max,
                            max_attempts=self.max_restarts,
                            metric="thread_restarts")
+
+    def fleet_policy(self, init_ms: Optional[int] = None) -> RetryPolicy:
+        """The restart ladder at fleet granularity: backoff between a
+        host's rejoin attempts after the fleet evicted it (missed
+        heartbeats), bounded by ``supervisor.fleet_max_rejoins``.  Each
+        backoff counts ``fleet_rejoins`` — the host-level analog of
+        ``thread_restarts``."""
+        return RetryPolicy(
+            init_ms=self.backoff_init if init_ms is None else init_ms,
+            max_ms=max(self.backoff_max,
+                       init_ms if init_ms is not None else 0),
+            max_attempts=self.fleet_max_rejoins,
+            metric="fleet_rejoins")
 
     def run(self, target, name: str, args: tuple = (),
             exhausted: str = "return") -> None:

@@ -26,7 +26,16 @@ Sites wired in (each names the exception type it surfaces):
 - ``tenant_flood``   — makes admission checks of *rate-limited* tenants
   deny as if their token bucket were empty (unlimited tenants never
   check the site, so a plan targets exactly the tenants a test marks
-  with a finite rate — see tenancy/admission.py).
+  with a finite rate — see tenancy/admission.py);
+- ``peer_partition`` — the fleet heartbeat receiver drops the inbound
+  exchange as if the network ate it (the sender sees a failed
+  delivery).  Checked once per inbound heartbeat; set
+  ``FLOWGGER_PARTITION_PEER=<rank>`` to partition only the named peer
+  (absent = every peer) — see fleet/federation.py;
+- ``host_kill``      — the fleet ticker SIGKILLs its own process on the
+  firing tick: a deterministic hard host loss (no drain, no goodbye)
+  for the multi-process acceptance tests.  ``once:N`` kills on the Nth
+  tick, i.e. ~N x tpu_fleet_heartbeat_ms after fleet start.
 
 Counters are per-site, process-wide, and thread-safe; numbering is
 1-based (``once:1`` fires on the first check).  The module is inert —
@@ -43,7 +52,8 @@ from typing import Dict, Optional, Tuple
 ENV_VAR = "FLOWGGER_FAULTS"
 
 KNOWN_SITES = ("device_decode", "input_socket", "sink_write",
-               "queue_pressure", "tenant_flood")
+               "queue_pressure", "tenant_flood", "peer_partition",
+               "host_kill")
 
 
 class InjectedFault(Exception):

@@ -54,6 +54,16 @@ pipeline enters its drain phase.  Template mining reports
 per-tenant form), and the per-template ``tenant_{name}_template_{id}``
 counter family (capped; overflow ids fold into
 ``tenant_{name}_template_overflow``).
+
+Fleet federation (fleet/): ``fleet_hosts_{joining,active,suspect,
+draining,departed}`` gauges (the local host counts toward its own
+state), per-peer ``fleet_peer{rank}_state`` (0..4 in ladder order) and
+``fleet_peer{rank}_hb_age_ms`` gauges, plus the ``fleet_evictions`` /
+``fleet_rejoins`` / ``fleet_hb_send_errors`` counters.  The whole
+``snapshot()`` is what each host's HTTP health endpoint serves under
+``metrics`` (fleet/health.py) — it is JSON-safe by construction
+(counters and gauges are numbers, ``batch_seconds`` a flat dict), so
+the health document needs no second serialization layer.
 """
 
 from __future__ import annotations
@@ -92,6 +102,14 @@ _COUNTERS = (
     # online template mining (tenancy/templates.py): rows mined; the
     # per-template family is tenant_{name}_template_{id} (+ _overflow)
     "template_hits",
+    # fleet federation (fleet/): peers evicted by the missed-heartbeat
+    # ladder, local rejoins after a discovered self-eviction, and
+    # heartbeat deliveries that failed in transit (partition/churn —
+    # normal life at fleet scale, counted not logged).  The state
+    # gauges (fleet_hosts_{joining,active,suspect,draining,departed},
+    # fleet_peer{rank}_state, fleet_peer{rank}_hb_age_ms) materialize
+    # when membership starts
+    "fleet_evictions", "fleet_rejoins", "fleet_hb_send_errors",
 )
 
 

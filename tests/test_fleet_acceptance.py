@@ -1,0 +1,177 @@
+"""Fleet federation multi-process acceptance (the ISSUE 9 tentpole
+gate): a real 2-host localhost fleet — two processes joining one
+``jax.distributed`` group AND the fleet heartbeat layer — streams
+per-host corpora through the production ``BatchHandler`` while the
+harness SIGKILLs host 1 mid-stream (the deterministic ``host_kill``
+fault site).  Asserts:
+
+- the surviving host's framed output is byte-identical and in-order
+  for every stream it owns (vs the single-process scalar reference);
+- the killed host walks ``active → suspect → draining (evicted) →
+  departed`` in the survivor's membership view;
+- the transition and the ``fleet_hosts_*`` gauges are observable from
+  outside through the survivor's HTTP health endpoint while it runs.
+
+Subprocess budgets dominate the runtime (the PR 8 lesson), so this is
+``slow``-marked and runs as its own capped ci.sh step, not in tier 1.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from flowgger_tpu.config import Config
+from flowgger_tpu.decoders.rfc5424 import RFC5424Decoder
+from flowgger_tpu.encoders.gelf import GelfEncoder
+from flowgger_tpu.mergers import LineMerger
+
+_WORKER = os.path.join(os.path.dirname(__file__), "fleet_worker.py")
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+N_LINES = 96
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _expected(pid: int) -> bytes:
+    decoder, encoder, merger = (RFC5424Decoder(),
+                                GelfEncoder(Config.from_string("")),
+                                LineMerger())
+    out = b""
+    for i in range(N_LINES):
+        line = (f'<{(3 * i + pid) % 192}>1 2023-09-20T12:35:45.{i % 1000:03d}Z '
+                f'host{pid} app {i} m [sd@1 k="{i}" x="y"] '
+                f'worker {pid} line {i}')
+        out += merger.frame(encoder.encode(decoder.decode(line)))
+    return out
+
+
+def _poll_health(port: int):
+    import http.client
+
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=1) as resp:
+            return json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        # 503 during drain still carries the document
+        try:
+            return json.loads(e.read())
+        except (ValueError, OSError, http.client.HTTPException):
+            return None
+    except (OSError, ValueError, http.client.HTTPException):
+        # endpoint not up yet, or torn down mid-read (worker exiting):
+        # both are normal poller life
+        return None
+
+
+@pytest.mark.slow
+def test_two_host_fleet_survives_host_kill_byte_identical(tmp_path):
+    jax_port, fp0, fp1 = _free_port(), _free_port(), _free_port()
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS", "FLOWGGER_FAULTS")}
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    outs = [tmp_path / f"out_{pid}.bin" for pid in (0, 1)]
+    procs = []
+    for pid in (0, 1):
+        wenv = dict(env)
+        if pid == 1:
+            # the victim: SIGKILL itself on the 8th fleet tick
+            # (~1.6s after fleet start = mid-stream, the corpus takes
+            # ~3s) — deterministic, no parent-timing race
+            wenv["FLOWGGER_FAULTS"] = "host_kill=once:8"
+        procs.append(subprocess.Popen(
+            [sys.executable, _WORKER, str(pid), str(jax_port),
+             str((fp0, fp1)[pid]), str(fp0), str(outs[pid]), str(N_LINES)],
+            env=wenv, cwd=_REPO,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE))
+
+    # watch the kill unfold from OUTSIDE, through the survivor's
+    # health endpoint: peer-1 states and the fleet_hosts_* gauges
+    observed_states = []
+    gauge_trail = []
+    deadline = time.monotonic() + 240
+    while time.monotonic() < deadline:
+        if procs[0].poll() is not None:
+            break
+        doc = _poll_health(fp0)
+        if doc is not None:
+            for peer in doc["fleet"]["peers"]:
+                if peer["rank"] == 1 and (not observed_states
+                                          or observed_states[-1]
+                                          != peer["state"]):
+                    observed_states.append(peer["state"])
+            counts = doc["fleet"]["counts"]
+            if not gauge_trail or gauge_trail[-1] != counts:
+                gauge_trail.append(dict(counts))
+            metrics = doc["metrics"]
+            assert metrics.get("fleet_hosts_active") == counts["active"]
+        time.sleep(0.05)
+
+    logs = []
+    try:
+        for p in procs:
+            stdout, stderr = p.communicate(timeout=240)
+            logs.append((p.returncode, stdout.decode(errors="replace"),
+                         stderr.decode(errors="replace")))
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.fail("fleet workers timed out")
+
+    rc0, out0, err0 = logs[0]
+    rc1, out1, err1 = logs[1]
+    assert rc0 == 0, f"survivor failed rc={rc0}\n{out0}\n{err0}"
+    # the victim died by SIGKILL (host_kill), not a clean exit
+    assert rc1 == -9, f"victim should die by SIGKILL, rc={rc1}\n{err1}"
+
+    # byte-identical, in-order output for every stream the survivor
+    # owns — the host kill perturbed nothing it shouldn't
+    assert outs[0].read_bytes() == _expected(0), \
+        "survivor output diverged from the scalar reference"
+    # the victim died mid-stream (that's the point): whatever it had
+    # already emitted and fsynced must be an uncorrupted, in-order
+    # PREFIX of its reference stream — and strictly short of the full
+    # stream, proving the kill really landed mid-decode
+    victim_bytes = outs[1].read_bytes() if outs[1].exists() else b""
+    want1 = _expected(1)
+    assert want1.startswith(victim_bytes), \
+        "victim's pre-kill output is not a clean prefix of its reference"
+    assert len(victim_bytes) < len(want1), \
+        "victim finished its whole stream — the kill was not mid-stream"
+
+    # the survivor's own report: the full eviction ladder ran
+    report = json.loads(out0.strip().splitlines()[-1])
+    assert report["peer_final_state"] == "departed", report
+    assert report["peer_evicted"] is True, report
+    ladder = [tuple(t) for t in report["peer_ladder"]]
+    assert ("active", "suspect") in ladder, ladder
+    assert ("suspect", "draining") in ladder, ladder
+    assert ("draining", "departed") in ladder, ladder
+    assert report["counts"]["active"] == 1, report
+    assert report["counts"]["departed"] == 1, report
+
+    # and the ladder was observable from outside while it happened:
+    # the health endpoint showed the peer active, then the
+    # missed-heartbeat progression
+    assert "active" in observed_states, observed_states
+    assert "suspect" in observed_states, observed_states
+    assert "departed" in observed_states, observed_states
+    idx = [observed_states.index(s)
+           for s in ("active", "suspect", "departed")]
+    assert idx == sorted(idx), f"ladder out of order: {observed_states}"
+    # gauges tracked it: 2 active at convergence, 1 active + 1
+    # departed at the end
+    assert any(g["active"] == 2 for g in gauge_trail), gauge_trail
+    assert gauge_trail[-1]["active"] == 1, gauge_trail
+    assert gauge_trail[-1]["departed"] == 1, gauge_trail

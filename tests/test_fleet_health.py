@@ -1,0 +1,293 @@
+"""Per-host health export: live endpoint vs the golden schema, LB
+routability semantics, drain verb, the peer_partition fault site, and
+the tools/fleetctl.py CLI smoke (status/drain against a real fleet)."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from flowgger_tpu.config import Config
+from flowgger_tpu.fleet import ACTIVE, DRAINING, SUSPECT, Fleet
+from flowgger_tpu.utils import faultinject
+from flowgger_tpu.utils.metrics import Registry
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_FLEETCTL = os.path.join(_REPO, "tools", "fleetctl.py")
+_SCHEMA = os.path.join(os.path.dirname(__file__), "resources",
+                       "healthz_schema.json")
+
+FAST = ("tpu_fleet_heartbeat_ms = 60\ntpu_fleet_suspect_ms = 250\n"
+        "tpu_fleet_evict_ms = 600\ntpu_fleet_depart_ms = 300\n")
+
+
+def _mk_fleet(rank=0, hosts=1, coordinator=None, timings=FAST):
+    coord = (f'tpu_fleet_coordinator = "{coordinator}"\n'
+             if coordinator else "")
+    cfg = Config.from_string(
+        f"[input]\ntpu_fleet = true\ntpu_fleet_rank = {rank}\n"
+        f"tpu_fleet_hosts = {hosts}\n{coord}{timings}")
+    fleet = Fleet.from_config(cfg, registry=Registry())
+    fleet.start()
+    return fleet
+
+
+def _get(addr, path="/healthz", method="GET"):
+    req = urllib.request.Request(
+        f"http://{addr}{path}", method=method,
+        data=b"" if method == "POST" else None)
+    try:
+        with urllib.request.urlopen(req, timeout=3) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+# -- golden schema -----------------------------------------------------------
+
+def _validate(doc, schema, path="$"):
+    """Walk the golden schema (tests/resources/healthz_schema.json):
+    leaves are type names, nested dicts recurse, ``__each__`` types
+    every element of a list."""
+    checks = {"int": lambda v: isinstance(v, int) and not isinstance(v, bool),
+              "number": lambda v: isinstance(v, (int, float))
+              and not isinstance(v, bool),
+              "str": lambda v: isinstance(v, str),
+              "bool": lambda v: isinstance(v, bool),
+              "dict": lambda v: isinstance(v, dict),
+              "list": lambda v: isinstance(v, list)}
+    problems = []
+    for key, want in schema.items():
+        if key == "__doc__":
+            continue
+        if key == "__each__":
+            assert isinstance(doc, list), f"{path}: expected a list"
+            for i, item in enumerate(doc):
+                problems += _validate(item, want, f"{path}[{i}]")
+            continue
+        if key not in doc:
+            problems.append(f"{path}.{key}: missing")
+            continue
+        value = doc[key]
+        if isinstance(want, dict):
+            if "__each__" in want:
+                if not isinstance(value, list):
+                    problems.append(f"{path}.{key}: expected list")
+                else:
+                    problems += _validate(value, want, f"{path}.{key}")
+            elif not isinstance(value, dict):
+                problems.append(f"{path}.{key}: expected object")
+            else:
+                problems += _validate(value, want, f"{path}.{key}")
+        elif not checks[want](value):
+            problems.append(
+                f"{path}.{key}: expected {want}, got {type(value).__name__}")
+    return problems
+
+
+def test_healthz_matches_golden_schema():
+    fleet = _mk_fleet()
+    try:
+        status, doc = _get(fleet.service.addr)
+        assert status == 200
+        with open(_SCHEMA) as fd:
+            schema = json.load(fd)
+        problems = _validate(doc, schema)
+        assert not problems, "health document drifted from the golden " \
+            f"schema: {problems}"
+        # the metrics snapshot is the real registry snapshot, not a stub
+        assert "input_lines" in doc["metrics"]
+        assert doc["fleet"]["counts"]["active"] == 1
+    finally:
+        fleet.shutdown()
+
+
+def test_healthz_routability_flips_on_drain():
+    fleet = _mk_fleet()
+    try:
+        addr = fleet.service.addr
+        assert _get(addr)[0] == 200
+        fleet.enter_draining()
+        # 503 the moment drain begins: LBs stop routing before flush
+        status, doc = _get(addr)
+        assert status == 503
+        assert doc["host"]["state"] == DRAINING
+        assert doc["host"]["draining"] is True
+    finally:
+        fleet.shutdown()
+
+
+def test_drain_endpoint_triggers_callback_and_drains():
+    hits = []
+    cfg = Config.from_string(
+        "[input]\ntpu_fleet = true\n" + FAST)
+    fleet = Fleet.from_config(cfg, registry=Registry(),
+                              on_drain=lambda: hits.append(1))
+    fleet.start()
+    try:
+        status, doc = _get(fleet.service.addr, "/drain", method="POST")
+        assert (status, doc["ok"]) == (200, True)
+        assert doc["state"] == DRAINING
+        deadline = time.monotonic() + 2
+        while not hits and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert hits, "drain callback never fired"
+        assert fleet.membership.local.state == DRAINING
+    finally:
+        fleet.shutdown()
+
+
+def test_unknown_paths_404():
+    fleet = _mk_fleet()
+    try:
+        assert _get(fleet.service.addr, "/nope")[0] == 404
+    finally:
+        fleet.shutdown()
+
+
+# -- peer_partition fault site ----------------------------------------------
+
+@pytest.mark.faults
+def test_peer_partition_drops_heartbeats_deterministically():
+    faultinject.reset()
+    f0 = _mk_fleet(rank=0, hosts=2)
+    f1 = None
+    try:
+        f1 = _mk_fleet(rank=1, hosts=2,
+                       coordinator=f"127.0.0.1:{f0.service.port}")
+        assert f0.wait_active(2, 10), "fleet never converged"
+        # partition: every inbound heartbeat at EITHER host drops from
+        # now on — rank 1 goes dark in rank 0's view without dying
+        faultinject.configure({"peer_partition": "every:1"})
+        deadline = time.monotonic() + 5
+        seen_suspect = False
+        while time.monotonic() < deadline:
+            view = f0.membership.view_of(1)
+            if view and view["state"] == SUSPECT:
+                seen_suspect = True
+                break
+            time.sleep(0.02)
+        assert seen_suspect, "partitioned peer never went suspect"
+        # heal the partition: suspicion must cure without an eviction
+        faultinject.reset()
+        deadline = time.monotonic() + 5
+        cured = False
+        while time.monotonic() < deadline:
+            view = f0.membership.view_of(1)
+            if view and view["state"] == ACTIVE:
+                cured = True
+                break
+            time.sleep(0.02)
+        assert cured, "healed peer never recovered to active"
+    finally:
+        faultinject.reset()
+        f0.shutdown()
+        if f1 is not None:
+            f1.shutdown()
+
+
+@pytest.mark.faults
+def test_peer_partition_names_a_single_peer():
+    faultinject.reset()
+    f0 = _mk_fleet(rank=0, hosts=3)
+    peers = []
+    try:
+        for rank in (1, 2):
+            peers.append(_mk_fleet(
+                rank=rank, hosts=3,
+                coordinator=f"127.0.0.1:{f0.service.port}"))
+        assert f0.wait_active(3, 10), "fleet never converged"
+        os.environ["FLOWGGER_PARTITION_PEER"] = "1"
+        faultinject.configure({"peer_partition": "every:1"})
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if f0.membership.view_of(1)["state"] == SUSPECT:
+                break
+            time.sleep(0.02)
+        assert f0.membership.view_of(1)["state"] == SUSPECT
+        # the unnamed peer keeps heartbeating through the same plan
+        assert f0.membership.view_of(2)["state"] == ACTIVE
+    finally:
+        os.environ.pop("FLOWGGER_PARTITION_PEER", None)
+        faultinject.reset()
+        f0.shutdown()
+        for p in peers:
+            p.shutdown()
+
+
+# -- pipeline wiring ---------------------------------------------------------
+
+def test_pipeline_builds_fleet_and_drain_departs(tmp_path):
+    """The pipeline lifecycle hooks: `input.tpu_fleet = true` builds a
+    Fleet at construction, and `_drain` walks it through
+    draining → departed and tears the endpoint down."""
+    from flowgger_tpu.pipeline import Pipeline
+
+    out = tmp_path / "out.gelf"
+    cfg = Config.from_string(
+        '[input]\ntype = "stdin"\nformat = "rfc5424"\n'
+        "tpu_fleet = true\n" + FAST +
+        f'[output]\ntype = "file"\nformat = "gelf"\n'
+        f'file_path = "{out}"\n')
+    pipeline = Pipeline(cfg)
+    assert pipeline.fleet is not None
+    pipeline.fleet.start()
+    try:
+        addr = pipeline.fleet.service.addr
+        assert _get(addr)[0] == 200
+        pipeline._drain([])
+        assert pipeline.fleet.membership.local.state == "departed"
+        # drain-on-departure finished: the endpoint went with the host
+        with pytest.raises(OSError):
+            urllib.request.urlopen(f"http://{addr}/healthz", timeout=1)
+    finally:
+        pipeline.fleet.shutdown()  # idempotent; belt for the failure path
+
+
+def test_pipeline_without_fleet_key_has_no_fleet():
+    from flowgger_tpu.pipeline import Pipeline
+
+    pipeline = Pipeline(Config.from_string(
+        '[input]\ntype = "stdin"\nformat = "rfc5424"\n'
+        '[output]\ntype = "debug"\nformat = "gelf"\n'))
+    assert pipeline.fleet is None
+
+
+# -- fleetctl CLI smoke ------------------------------------------------------
+
+def _fleetctl(*args):
+    return subprocess.run([sys.executable, _FLEETCTL, *args],
+                          capture_output=True, text=True, timeout=30)
+
+
+def test_fleetctl_status_and_drain_smoke():
+    fleet = _mk_fleet()
+    try:
+        addr = fleet.service.addr
+        r = _fleetctl("status", addr)
+        assert r.returncode == 0, r.stderr
+        assert "rank 0" in r.stdout and "active" in r.stdout
+        r = _fleetctl("status", addr, "--json")
+        assert r.returncode == 0
+        assert json.loads(r.stdout)["host"]["rank"] == 0
+
+        r = _fleetctl("drain", addr)
+        assert r.returncode == 0, r.stderr
+        assert "draining acknowledged" in r.stdout
+        # status against a draining host: exit 3 (answered, not routable)
+        r = _fleetctl("status", addr)
+        assert r.returncode == 3, (r.returncode, r.stdout, r.stderr)
+        assert "NOT routable" in r.stdout
+    finally:
+        fleet.shutdown()
+
+
+def test_fleetctl_unreachable_exits_2():
+    r = _fleetctl("status", "127.0.0.1:1")  # nothing listens on port 1
+    assert r.returncode == 2
+    assert "error" in r.stderr

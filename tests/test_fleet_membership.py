@@ -1,0 +1,318 @@
+"""Fleet membership state machine, fully in-process (no sockets, no
+subprocesses): join/heartbeat/suspect/evict/rejoin transitions under a
+fake clock, deterministic rank tie-breaks, and the terminal-state
+contract (draining unreachable from departed)."""
+
+import pytest
+
+from flowgger_tpu.config import Config, ConfigError
+from flowgger_tpu.fleet import (
+    ACTIVE,
+    DEPARTED,
+    DRAINING,
+    JOINING,
+    SUSPECT,
+    FleetStateError,
+    Membership,
+)
+from flowgger_tpu.fleet.federation import fleet_spec
+from flowgger_tpu.utils.metrics import Registry
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, seconds):
+        self.t += seconds
+
+
+def make(rank=0, **kw):
+    clock = FakeClock()
+    reg = Registry()
+    m = Membership(rank=rank, addr=f"127.0.0.1:900{rank}",
+                   suspect_ms=1_000, evict_ms=3_000, depart_ms=2_000,
+                   clock=clock, registry=reg, **kw)
+    return m, clock, reg
+
+
+# -- local lifecycle ---------------------------------------------------------
+
+def test_local_join_activate_drain_depart_ladder():
+    m, clock, reg = make()
+    assert m.local.state == JOINING
+    m.activate()
+    assert m.local.state == ACTIVE
+    m.mark_draining()
+    assert m.local.state == DRAINING
+    m.mark_departed()
+    assert m.local.state == DEPARTED
+    states = [(a, b) for _, r, a, b in m.transitions if r == 0]
+    assert states == [(JOINING, ACTIVE), (ACTIVE, DRAINING),
+                      (DRAINING, DEPARTED)]
+
+
+def test_draining_unreachable_from_departed():
+    m, _, _ = make()
+    m.activate()
+    m.mark_departed()  # passes through draining implicitly
+    assert m.local.state == DEPARTED
+    # the only legal exit from departed is a fresh-incarnation rejoin;
+    # an explicit drain request must refuse loudly, not resurrect
+    with pytest.raises(FleetStateError) as e:
+        m.mark_draining()
+    assert "departed" in str(e.value)
+    assert m.local.state == DEPARTED
+
+
+def test_departure_always_passes_through_draining():
+    m, _, _ = make()
+    m.activate()
+    m.mark_departed()
+    states = [(a, b) for _, r, a, b in m.transitions if r == 0]
+    assert (ACTIVE, DRAINING) in states and (DRAINING, DEPARTED) in states
+
+
+def test_local_rejoin_bumps_incarnation_and_restarts_ladder():
+    m, _, _ = make()
+    m.activate()
+    inc = m.local_rejoin()
+    assert inc == 1
+    assert m.local.state == ACTIVE
+    # the rejoin walked the full ladder: ... -> departed -> joining -> active
+    tail = [(a, b) for _, r, a, b in m.transitions if r == 0][-4:]
+    assert tail == [(ACTIVE, DRAINING), (DRAINING, DEPARTED),
+                    (DEPARTED, JOINING), (JOINING, ACTIVE)]
+
+
+# -- heartbeat-driven peer transitions ---------------------------------------
+
+def test_peer_join_heartbeat_suspect_evict_depart():
+    m, clock, reg = make()
+    m.activate()
+    assert m.note_heartbeat(1, "127.0.0.1:9001", ACTIVE, 0)
+    assert m.get(1).state == ACTIVE
+    assert reg.get_gauge("fleet_hosts_active") == 2
+
+    clock.advance(1.5)              # > suspect_ms (1s)
+    m.tick()
+    assert m.get(1).state == SUSPECT
+    assert reg.get_gauge("fleet_hosts_suspect") == 1
+
+    # heartbeat resumes inside the evict window: suspicion cured
+    assert m.note_heartbeat(1, "127.0.0.1:9001", ACTIVE, 0)
+    assert m.get(1).state == ACTIVE
+
+    clock.advance(3.5)              # > evict_ms (3s): evicted = draining
+    m.tick()
+    assert m.get(1).state == DRAINING
+    assert m.get(1).evicted is True
+    assert reg.get("fleet_evictions") == 1
+    assert reg.get_gauge("fleet_hosts_draining") == 1
+
+    clock.advance(2.5)              # > evict_ms + depart_ms
+    m.tick()
+    assert m.get(1).state == DEPARTED
+    assert reg.get_gauge("fleet_hosts_departed") == 1
+    ladder = [(a, b) for _, r, a, b in m.transitions if r == 1]
+    assert ladder == [("", JOINING), (JOINING, ACTIVE), (ACTIVE, SUSPECT),
+                      (SUSPECT, ACTIVE), (ACTIVE, SUSPECT),
+                      (SUSPECT, DRAINING), (DRAINING, DEPARTED)]
+
+
+def test_peer_announced_draining_is_one_way():
+    m, clock, _ = make()
+    m.activate()
+    m.note_heartbeat(1, "127.0.0.1:9001", ACTIVE, 0)
+    m.note_heartbeat(1, "127.0.0.1:9001", DRAINING, 0)
+    assert m.get(1).state == DRAINING
+    # still heartbeating while flushing: stays draining, never flaps back
+    m.note_heartbeat(1, "127.0.0.1:9001", ACTIVE, 0)
+    assert m.get(1).state == DRAINING
+    m.note_heartbeat(1, "127.0.0.1:9001", DEPARTED, 0)
+    assert m.get(1).state == DEPARTED
+
+
+def test_departed_peer_needs_fresh_incarnation_to_rejoin():
+    m, _, _ = make()
+    m.activate()
+    m.note_heartbeat(1, "127.0.0.1:9001", ACTIVE, 0)
+    m.note_heartbeat(1, "127.0.0.1:9001", DEPARTED, 0)
+    # same incarnation: a stale duplicate cannot resurrect the rank
+    assert not m.note_heartbeat(1, "127.0.0.1:9001", ACTIVE, 0)
+    assert m.get(1).state == DEPARTED
+    # strictly higher incarnation: legal rejoin, ladder restarts
+    assert m.note_heartbeat(1, "127.0.0.1:9001", ACTIVE, 1)
+    assert m.get(1).state == ACTIVE
+    assert m.get(1).incarnation == 1
+
+
+# -- rank tie-breaks ---------------------------------------------------------
+
+def test_rank_collision_equal_incarnation_incumbent_wins():
+    m, _, _ = make()
+    m.activate()
+    assert m.note_heartbeat(1, "10.0.0.1:9001", ACTIVE, 0)
+    # same rank, same incarnation, different address: deterministic —
+    # the first-observed holder keeps the rank on every host
+    assert not m.note_heartbeat(1, "10.0.0.2:9001", ACTIVE, 0)
+    assert m.get(1).addr == "10.0.0.1:9001"
+
+
+def test_rank_collision_higher_incarnation_wins():
+    m, _, _ = make()
+    m.activate()
+    m.note_heartbeat(1, "10.0.0.1:9001", ACTIVE, 0)
+    assert m.note_heartbeat(1, "10.0.0.2:9001", ACTIVE, 2)
+    peer = m.get(1)
+    assert peer.addr == "10.0.0.2:9001" and peer.incarnation == 2
+    # the old life was folded through the full ladder, not teleported
+    ladder = [(a, b) for _, r, a, b in m.transitions if r == 1]
+    assert (ACTIVE, DRAINING) in ladder and (DRAINING, DEPARTED) in ladder
+    assert ladder[-1] == (JOINING, ACTIVE)
+    # stale heartbeats from the losing life are ignored from now on
+    assert not m.note_heartbeat(1, "10.0.0.1:9001", ACTIVE, 0)
+
+
+def test_remote_claim_to_local_rank_is_ignored():
+    m, _, _ = make(rank=0)
+    m.activate()
+    assert not m.note_heartbeat(0, "10.9.9.9:1", ACTIVE, 99)
+    assert m.local.addr == "127.0.0.1:9000"
+    assert m.local.incarnation == 0
+
+
+# -- gossip (roster) ---------------------------------------------------------
+
+def test_roster_introduces_but_never_overrides():
+    m, _, _ = make()
+    m.activate()
+    m.note_roster(2, "127.0.0.1:9002", ACTIVE, 0)
+    # roster entries are hearsay: the peer shows up as joining (so we
+    # heartbeat it directly), not as active
+    assert m.get(2).state == JOINING
+    assert (2, "127.0.0.1:9002") in m.heartbeat_targets()
+    # direct proof arrived since; later gossip cannot rewrite it
+    m.note_heartbeat(2, "127.0.0.1:9002", ACTIVE, 0)
+    m.note_roster(2, "10.0.0.9:1", DEPARTED, 0)
+    peer = m.get(2)
+    assert peer.state == ACTIVE and peer.addr == "127.0.0.1:9002"
+
+
+def test_voluntary_drainer_that_dies_mid_flush_ages_to_departed():
+    """A host that announced draining and then crashed (OOM mid-flush)
+    must still reach departed by ageing — stuck-forever draining peers
+    would cost every survivor one timed-out connect per interval."""
+    m, clock, _ = make()
+    m.activate()
+    m.note_heartbeat(1, "127.0.0.1:9001", ACTIVE, 0)
+    m.note_heartbeat(1, "127.0.0.1:9001", DRAINING, 0)  # voluntary
+    clock.advance(5.5)  # > evict_ms + depart_ms, no heartbeat since
+    m.tick()
+    assert m.get(1).state == DEPARTED
+    assert (1, "127.0.0.1:9001") not in m.heartbeat_targets()
+
+
+def test_roster_preserves_announced_departed_and_draining():
+    """Gossip must not resurrect a cleanly-departed host as joining —
+    a fresh joiner would dial the corpse for evict_ms and then count a
+    spurious eviction."""
+    m, clock, reg = make()
+    m.activate()
+    m.note_roster(2, "127.0.0.1:9002", DEPARTED, 0)
+    assert m.get(2).state == DEPARTED
+    assert (2, "127.0.0.1:9002") not in m.heartbeat_targets()
+    m.note_roster(3, "127.0.0.1:9003", DRAINING, 0)
+    assert m.get(3).state == DRAINING
+    clock.advance(10)
+    m.tick()
+    assert m.get(3).state == DEPARTED
+    assert reg.get("fleet_evictions") == 0  # neither was an eviction
+
+
+def test_joining_peer_that_never_heartbeats_is_evicted():
+    m, clock, reg = make()
+    m.activate()
+    m.note_roster(3, "127.0.0.1:9003", ACTIVE, 0)
+    clock.advance(3.5)
+    m.tick()
+    assert m.get(3).state == DRAINING and m.get(3).evicted
+    clock.advance(2.5)
+    m.tick()
+    assert m.get(3).state == DEPARTED
+    # the departed are left in peace: no more heartbeat attempts
+    assert (3, "127.0.0.1:9003") not in m.heartbeat_targets()
+
+
+# -- config spec (fleet_spec) ------------------------------------------------
+
+def test_fleet_spec_defaults_rank_from_distributed_keys():
+    spec = fleet_spec(Config.from_string(
+        '[input]\ntpu_fleet = true\n'
+        'tpu_coordinator = "10.0.0.1:8476"\n'
+        'tpu_num_processes = 4\ntpu_process_id = 2\n'
+        'tpu_fleet_coordinator = "10.0.0.1:8600"\n'))
+    assert spec.rank == 2 and spec.hosts == 4
+
+
+def test_fleet_spec_absent_and_validation():
+    assert fleet_spec(Config.from_string("")) is None
+    assert fleet_spec(Config.from_string(
+        "[input]\ntpu_fleet = false\n")) is None
+    with pytest.raises(ConfigError):
+        fleet_spec(Config.from_string(
+            "[input]\ntpu_fleet = true\ntpu_fleet_hosts = 2\n"
+            "tpu_fleet_rank = 1\n"))  # rank > 0 without a coordinator
+    with pytest.raises(ConfigError):
+        fleet_spec(Config.from_string(
+            "[input]\ntpu_fleet = true\ntpu_fleet_rank = 5\n"
+            "tpu_fleet_hosts = 2\n"))
+    with pytest.raises(ConfigError):
+        fleet_spec(Config.from_string(
+            "[input]\ntpu_fleet = true\n"
+            "tpu_fleet_heartbeat_ms = 500\ntpu_fleet_suspect_ms = 400\n"))
+
+
+def test_fleet_spec_rejects_lanes_vs_mesh_conflict_at_config_time():
+    with pytest.raises(ConfigError) as e:
+        fleet_spec(Config.from_string(
+            '[input]\ntpu_fleet = true\n'
+            'tpu_lanes = 2\ntpu_mesh = "on"\n'))
+    assert "mutually" in str(e.value)
+
+
+def test_fleet_spec_rejects_wildcard_bind_without_advertise():
+    with pytest.raises(ConfigError) as e:
+        fleet_spec(Config.from_string(
+            '[input]\ntpu_fleet = true\ntpu_fleet_hosts = 2\n'
+            'tpu_fleet_rank = 0\ntpu_fleet_bind = "0.0.0.0"\n'))
+    assert "tpu_fleet_advertise" in str(e.value)
+    # explicit advertise makes the wildcard bind fine
+    spec = fleet_spec(Config.from_string(
+        '[input]\ntpu_fleet = true\ntpu_fleet_hosts = 2\n'
+        'tpu_fleet_rank = 0\ntpu_fleet_bind = "0.0.0.0"\n'
+        'tpu_fleet_advertise = "10.0.0.1:8476"\n'))
+    assert spec.advertise == "10.0.0.1:8476"
+
+
+def test_heartbeat_send_failures_are_counted_never_raised():
+    """Peer addrs are remote input (gossip relays anything): a
+    malformed or dead addr must cost one counted miss, not the ticker
+    thread."""
+    from flowgger_tpu.fleet.federation import _http_post_json
+    from flowgger_tpu.utils.metrics import Registry
+
+    reg = Registry()
+    # no port at all, unparseable port, nothing listening
+    for addr in ("badhost", "host:notaport", "127.0.0.1:1"):
+        assert _http_post_json(addr, "/hb", {}, 0.2, registry=reg) is None
+    assert reg.get("fleet_hb_send_errors") == 3
+
+
+def test_membership_rejects_inverted_deadlines():
+    with pytest.raises(ValueError):
+        Membership(rank=0, addr="x", suspect_ms=5_000, evict_ms=1_000,
+                   registry=Registry())

@@ -336,3 +336,23 @@ def test_multiprocess_mesh_uses_local_devices(monkeypatch):
     assert h._sharded_for("rfc5424") is not None
     assert h._mesh.shape == {"dp": 4, "sp": 1}
     assert set(h._mesh.devices.flat) == set(local)
+
+
+def test_make_global_decode_mesh_rejects_superseded_configs():
+    """PR 9 small fix: a config whose lane dispatch supersedes the mesh
+    must fail at config time with a clear ConfigError instead of
+    silently building a global mesh nothing will ever consult."""
+    from flowgger_tpu.parallel.distributed import make_global_decode_mesh
+
+    with pytest.raises(ConfigError) as e:
+        make_global_decode_mesh(Config.from_string(
+            "[input]\ntpu_lanes = 2\n"))
+    assert "dead weight" in str(e.value)
+    with pytest.raises(ConfigError) as e:
+        make_global_decode_mesh(Config.from_string(
+            '[input]\ntpu_mesh = "off"\n'))
+    assert "never consult" in str(e.value)
+    # a mesh-compatible config still builds (sp from the config)
+    m = make_global_decode_mesh(Config.from_string(
+        '[input]\ntpu_mesh = "on"\ntpu_sp = 2\n'))
+    assert m.shape["sp"] == 2

@@ -1,0 +1,117 @@
+#!/usr/bin/env python
+"""fleetctl — poke a flowgger-tpu fleet host's health endpoint.
+
+    fleetctl.py status <host:port> [--json]    fleet view + key metrics
+    fleetctl.py drain  <host:port>             ask the host to drain
+
+``status`` renders the health document (fleet/health.py ``GET
+/healthz``): the local host's lifecycle state, every peer's state and
+heartbeat age, and the load-bearing metrics a rollout watches.  Exit
+codes make it scriptable: 0 = host is routable (healthz 200), 3 = host
+answered but is draining/departed (healthz 503), 2 = unreachable /
+not a fleet health endpoint.
+
+``drain`` POSTs ``/drain`` — the remote equivalent of SIGTERM:
+drain-on-departure flushes in-flight batches byte-identically while
+fleet peers absorb new traffic.  Exit 0 once the host acknowledges.
+
+Stdlib-only on purpose: this is the tool an operator runs from a
+bastion box where the flowgger venv may not exist.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.error
+import urllib.request
+
+TIMEOUT_S = 5.0
+
+
+def _fetch(addr: str, path: str, method: str = "GET"):
+    """(HTTP status, parsed JSON document) — raises urllib errors for
+    transport failures, ValueError for non-JSON bodies."""
+    req = urllib.request.Request(f"http://{addr}{path}", method=method,
+                                 data=b"" if method == "POST" else None)
+    try:
+        with urllib.request.urlopen(req, timeout=TIMEOUT_S) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        # 503 draining still carries the full health document
+        return e.code, json.loads(e.read())
+
+
+def _fmt_age(ms: float) -> str:
+    return f"{ms / 1000.0:.1f}s" if ms >= 1000 else f"{ms:.0f}ms"
+
+
+def cmd_status(addr: str, as_json: bool) -> int:
+    try:
+        status, doc = _fetch(addr, "/healthz")
+    except (OSError, ValueError) as e:
+        print(f"error: {addr}: {e}", file=sys.stderr)
+        return 2
+    if "host" not in doc or "fleet" not in doc:
+        print(f"error: {addr}: not a fleet health endpoint", file=sys.stderr)
+        return 2
+    if as_json:
+        print(json.dumps(doc, indent=2, sort_keys=True))
+        return 0 if status == 200 else 3
+    host, fleet = doc["host"], doc["fleet"]
+    routable = "routable" if status == 200 else "NOT routable"
+    print(f"host rank {host['rank']} [{host['state']}] "
+          f"inc={host['incarnation']} @ {host['addr']} — {routable}")
+    counts = fleet.get("counts", {})
+    print("fleet: " + "  ".join(f"{s}={counts.get(s, 0)}"
+                                for s in ("joining", "active", "suspect",
+                                          "draining", "departed")))
+    for peer in fleet.get("peers", []):
+        marker = "*" if peer["rank"] == host["rank"] else " "
+        evicted = " (evicted)" if peer.get("evicted") else ""
+        print(f" {marker} rank {peer['rank']:>3} [{peer['state']:>8}]"
+              f" inc={peer['incarnation']}"
+              f" hb_age={_fmt_age(peer['hb_age_ms'])}"
+              f" {peer['addr']}{evicted}")
+    metrics = doc.get("metrics", {})
+    keys = ("input_lines", "output_written", "queue_dropped",
+            "device_breaker_state", "aot_hits", "fleet_evictions",
+            "fleet_rejoins", "fleet_hb_send_errors")
+    shown = {k: metrics[k] for k in keys if k in metrics}
+    if shown:
+        print("metrics: " + "  ".join(f"{k}={v}" for k, v in shown.items()))
+    return 0 if status == 200 else 3
+
+
+def cmd_drain(addr: str) -> int:
+    try:
+        status, doc = _fetch(addr, "/drain", method="POST")
+    except (OSError, ValueError) as e:
+        print(f"error: {addr}: {e}", file=sys.stderr)
+        return 2
+    if status != 200 or not doc.get("ok"):
+        print(f"error: {addr}: drain refused: {doc}", file=sys.stderr)
+        return 2
+    print(f"{addr}: draining acknowledged (state: {doc.get('state')})")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="fleetctl", description=__doc__,
+                                 formatter_class=argparse.RawDescriptionHelpFormatter)
+    sub = ap.add_subparsers(dest="verb", required=True)
+    st = sub.add_parser("status", help="fleet view + key metrics")
+    st.add_argument("addr", help="host:port of the health endpoint")
+    st.add_argument("--json", action="store_true",
+                    help="dump the raw health document")
+    dr = sub.add_parser("drain", help="ask the host to drain and depart")
+    dr.add_argument("addr", help="host:port of the health endpoint")
+    args = ap.parse_args(argv)
+    if args.verb == "status":
+        return cmd_status(args.addr, args.json)
+    return cmd_drain(args.addr)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
