@@ -13,16 +13,18 @@ echo "==            byte-identity contracts, exception hygiene, keys) =="
 # pure-ast, no JAX import: fails on any non-baselined FC01-FC05 finding
 python -m flowgger_tpu.analysis --format text .
 
-echo "== overlap-executor + fused-route + zero-JIT-boot smoke (<360s) =="
+echo "== overlap-executor + fused-route + zero-JIT-boot smoke (<480s) =="
 # asserts the in-flight submit/fetch window sustains >= the serial e2e,
 # 2-lane dispatch sustains >= 0.92x the 1-lane executor (jitter
 # tolerance for small hosts; the ratio itself is in the JSON line),
+# the jsonl/dns block routes are byte-identical to the scalar pipeline
+# at or above the backend-tiered throughput floor (new_formats line),
 # the fused decode→encode routes emit byte-identical output with
 # fetched bytes/row under emitted on every route (fused_routes line),
 # AND an artifact-booted cold subprocess performs zero fresh kernel
 # compiles with scalar-oracle-identical bytes per framing while the
 # TPU fused-route export round-trips build-only (aot_smoke line)
-JAX_PLATFORMS=cpu timeout 600 python bench.py --smoke
+JAX_PLATFORMS=cpu timeout 780 python bench.py --smoke
 
 echo "== python test suite (virtual 8-device CPU mesh) =="
 # slow-marked tests are excluded here (pytest.ini tier-1 contract);
@@ -64,6 +66,13 @@ JAX_PLATFORMS=cpu timeout 600 python -m pytest tests/test_fleet_acceptance.py -q
 echo "== multi-tenant serving suite (admission, fair queue, templates) =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_tenancy.py -q -m "not faults"
 
+echo "== new-format decode subsystems (jsonl_tpu / dns_tpu, slow half) =="
+# the non-slow differential/framing/auto-leg/AOT tests already ran in
+# the main suite step above — this step adds ONLY their slow-marked
+# half (1/2-lane identity, rescue tier, and the filtered deep fuzz
+# over both new routes: randomized lanes × framings vs the oracles)
+JAX_PLATFORMS=cpu timeout 1200 python -m pytest tests/test_tpu_jsonl.py tests/test_tpu_dns.py tests/test_cross_route_fuzz.py -q -m "slow and not faults"
+
 echo "== fault-injection suite (robustness degradation paths) =="
 JAX_PLATFORMS=cpu python -m pytest tests/ -q -m "faults and not slow"
 
@@ -85,6 +94,7 @@ echo "== config lint =="
 python -m flowgger_tpu --check flowgger.toml
 python -m flowgger_tpu --check examples/multihost-dp.toml
 python -m flowgger_tpu --check examples/tenants.toml
+python -m flowgger_tpu --check examples/jsonl.toml
 
 echo "== bench smoke (CPU backend, bounded) =="
 JAX_PLATFORMS=cpu FLOWGGER_BENCH_SMOKE=1 timeout 600 python bench.py

@@ -38,6 +38,8 @@ from .rfc5424 import RFC5424Decoder  # noqa: E402
 from .rfc3164 import RFC3164Decoder  # noqa: E402
 from .gelf import GelfDecoder  # noqa: E402
 from .ltsv import LTSVDecoder  # noqa: E402
+from .jsonl import JSONLDecoder  # noqa: E402
+from .dns import DNSDecoder  # noqa: E402
 
 __all__ = [
     "Decoder",
@@ -47,4 +49,6 @@ __all__ = [
     "RFC3164Decoder",
     "GelfDecoder",
     "LTSVDecoder",
+    "JSONLDecoder",
+    "DNSDecoder",
 ]

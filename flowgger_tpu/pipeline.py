@@ -103,6 +103,8 @@ _TPU_FORMATS = {
     "gelf_tpu": "gelf",
     "ltsv_tpu": "ltsv",
     "rfc3164_tpu": "rfc3164",
+    "jsonl_tpu": "jsonl",
+    "dns_tpu": "dns",
     "auto_tpu": "auto",
 }
 
@@ -116,6 +118,14 @@ def get_decoder(input_format: str, config: Config):
         return GelfDecoder(config)
     if base == "ltsv":
         return LTSVDecoder(config)
+    if base == "jsonl":
+        from .decoders import JSONLDecoder
+
+        return JSONLDecoder(config)
+    if base == "dns":
+        from .decoders import DNSDecoder
+
+        return DNSDecoder(config)
     if base in ("rfc5424", "auto"):
         return RFC5424Decoder(config)
     if base == "rfc3164":

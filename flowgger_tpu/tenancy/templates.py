@@ -153,7 +153,40 @@ _BLOCK_SPANS = {
     "rfc5424": ("msg_trim_start", "trim_end"),
     "rfc3164": ("msg_start", None),
     "ltsv": ("msg_start", "msg_end"),
+    "dns": ("qname_start", "qname_end"),
 }
+
+
+def _extract_jsonl(packed, host_out) -> list:
+    """JSON-lines block tap: the ``message`` key has no dedicated
+    kernel channel — scan each ok row's key spans for it (field counts
+    are small and mining already pins the host path)."""
+    chunk, starts, orig_lens = packed[2], packed[3], packed[4]
+    n_real = int(packed[5])
+    max_len = int(packed[0].shape[1])
+    ok = host_out["ok"]
+    n_fields = host_out["n_fields"]
+    key_s, key_e = host_out["key_start"], host_out["key_end"]
+    val_s, val_e = host_out["val_start"], host_out["val_end"]
+    val_t = host_out["val_type"]
+    msgs: list = []
+    for i in range(n_real):
+        if not bool(ok[i]):
+            msgs.append(None)
+            continue
+        s = int(starts[i])
+        ln = min(int(orig_lens[i]), max_len)
+        msg = b""
+        for f in range(int(n_fields[i])):
+            a, b = int(key_s[i][f]), int(key_e[i][f])
+            if chunk[s + a:s + b] == b"message" \
+                    and int(val_t[i][f]) == 0:  # VT_STRING
+                lo = min(int(val_s[i][f]), ln)
+                hi = min(int(val_e[i][f]), ln)
+                msg = chunk[s + lo:s + hi] if hi > lo else b""
+                break
+        msgs.append(msg)
+    return msgs
 
 
 class TemplateMinerSet:
@@ -270,6 +303,10 @@ class TemplateMinerSet:
         (pure extraction — safe on a concurrent lane fetcher thread;
         observation happens later, in sequenced batch order).  Returns
         None when the format has no mined span channels (gelf/auto)."""
+        if fmt == "jsonl":
+            if host_out.get("ok") is None:
+                return None
+            return _extract_jsonl(packed, host_out)
         spans = _BLOCK_SPANS.get(fmt)
         if spans is None:
             return None

@@ -67,7 +67,7 @@ MANIFEST_NAME = "manifest.json"
 AOT_FORMAT = 1
 XLA_CACHE_SUBDIR = "xla-cache"
 
-DECODE_FORMATS = ("rfc5424", "rfc3164", "ltsv", "gelf")
+DECODE_FORMATS = ("rfc5424", "rfc3164", "ltsv", "gelf", "jsonl", "dns")
 ENCODE_MODULES = ("device_gelf", "device_rfc3164", "device_ltsv",
                   "device_gelf_gelf")
 FUSED_ROUTES = ("rfc5424_gelf", "rfc3164_gelf", "ltsv_gelf", "gelf_gelf")
@@ -210,7 +210,13 @@ def decode_statics(fmt: str) -> Dict:
         from .gelf import DEFAULT_MAX_FIELDS
 
         return {"max_fields": DEFAULT_MAX_FIELDS}
-    return {}  # rfc3164: the year is a traced input, not a static
+    if fmt == "jsonl":
+        from .jsonl import DEFAULT_MAX_FIELDS
+
+        return {"max_fields": DEFAULT_MAX_FIELDS}
+    # rfc3164 (the year is a traced input, not a static) and dns (the
+    # fixed grammar has no static knobs)
+    return {}
 
 
 def fused_statics(route_name: str, suffix: bytes, impl: str,
@@ -734,7 +740,9 @@ def prewarm_covered(fmt: str, rows: int, max_len: int, encoder=None,
                 return False
         # prewarm warms the split pair too (the fused tier's decline
         # fallback), so coverage must include it — fall through
-    module = _ENCODE_MODULE_FOR_FMT[fmt]
+    module = _ENCODE_MODULE_FOR_FMT.get(fmt)
+    if module is None:
+        return True  # no split device-encode tier (jsonl/dns): decode was all
     if not _split_route_ok(module, encoder, merger, ltsv_decoder):
         return True  # split device tier never engages: decode was all
     statics = encode_statics(module, suffix, impl, extras)
@@ -801,6 +809,14 @@ def _decode_fn(fmt: str):
         from .ltsv import decode_ltsv_jit
 
         return lambda b, ln: decode_ltsv_jit(b, ln, **statics)
+    if fmt == "jsonl":
+        from .jsonl import decode_jsonl_jit
+
+        return lambda b, ln: decode_jsonl_jit(b, ln, **statics)
+    if fmt == "dns":
+        from .dns import decode_dns_jit
+
+        return lambda b, ln: decode_dns_jit(b, ln)
     from .gelf import decode_gelf_jit
 
     return lambda b, ln: decode_gelf_jit(b, ln, **statics)
@@ -972,7 +988,9 @@ def build_artifacts(out_dir: str, platforms=("cpu",),
                                       args, statics)
             if "encode" in families:
                 for fmt in formats:
-                    module = _ENCODE_MODULE_FOR_FMT[fmt]
+                    module = _ENCODE_MODULE_FOR_FMT.get(fmt)
+                    if module is None:
+                        continue  # jsonl/dns: no device-encode kernel
                     dec = None
                     for suffix in suffixes:
                         for assemble, ts in ((False, probe_ts),

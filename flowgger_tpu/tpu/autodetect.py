@@ -15,30 +15,80 @@ Signature rules (on the first bytes only):
 - TAB and ``:``  in the line → LTSV
 - anything else              → RFC3164 (the lenient legacy decoder —
   also the reference's catch-all behavior class)
+
+``input.auto_extra_formats`` (a list; default empty, so existing auto
+streams classify exactly as before) opts extra legs in:
+- ``"jsonl"`` re-routes the ``{`` signature to the generic JSON-lines
+  leg (tpu/jsonl.py) instead of GELF — the two dialects share the
+  byte signature, so the key picks which decoder owns it;
+- ``"dns"`` adds, ahead of the LTSV rule, exactly-five-tabs lines
+  whose first field is a unix timestamp (``digits[.digits]``) — the
+  dnstap-TSV signature (tpu/dns.py).
 """
 
 from __future__ import annotations
 
-from typing import List, Optional
+from typing import List, Optional, Tuple
 
-from ..config import Config
+from ..config import Config, ConfigError
 from ..decoders.ltsv import LTSVDecoder
 from .materialize import LineResult
 
-F_RFC5424, F_RFC3164, F_LTSV, F_GELF = 0, 1, 2, 3
+F_RFC5424, F_RFC3164, F_LTSV, F_GELF, F_JSONL, F_DNS = 0, 1, 2, 3, 4, 5
+
+_EXTRA_FORMATS = ("jsonl", "dns")
 
 
-def classify(raw: bytes) -> int:
+def auto_extra_formats(config: Config) -> Tuple[str, ...]:
+    """The validated ``input.auto_extra_formats`` list (empty tuple =
+    the classic four-class table)."""
+    v = config.lookup("input.auto_extra_formats")
+    if v is None:
+        return ()
+    if (not isinstance(v, list)
+            or any(not isinstance(x, str) for x in v)):
+        raise ConfigError(
+            "input.auto_extra_formats must be a list of strings")
+    bad = sorted(set(v) - set(_EXTRA_FORMATS))
+    if bad:
+        raise ConfigError(
+            f"input.auto_extra_formats: unknown format(s) {bad} "
+            f"(expected a subset of {list(_EXTRA_FORMATS)})")
+    return tuple(x for x in _EXTRA_FORMATS if x in v)
+
+
+def _dns_signature(b: bytes) -> bool:
+    """Exactly five tabs and a ``digits[.digits]`` first field — the
+    dnstap-TSV shape (decoders/dns.py grammar)."""
+    if b.count(b"\t") != 5:
+        return False
+    head = b.split(b"\t", 1)[0]
+    if not head:
+        return False
+    whole, dot, frac = head.partition(b".")
+    if not whole.isdigit():
+        return False
+    return not dot or frac.isdigit()
+
+
+def classify(raw: bytes, extras: Tuple[str, ...] = ()) -> int:
     b = raw
     if b.startswith(b"\xef\xbb\xbf"):
         b = b[3:]
     if b.startswith(b"{"):
-        return F_GELF
+        return F_JSONL if "jsonl" in extras else F_GELF
     if b.startswith(b"<"):
         gt = b.find(b">", 1, 6)
         if gt > 1 and b[gt + 1:gt + 3] == b"1 " and b[1:gt].isdigit():
             return F_RFC5424
         return F_RFC3164
+    # the dns signature checks the RAW bytes (no BOM strip): a BOM'd
+    # first field is not a clean unix timestamp — DNSDecoder would
+    # reject it anyway — and the vectorized overlay (_extras_adjust)
+    # reads the packed rows unstripped, so the two classifiers must
+    # agree byte-for-byte on such rows
+    if "dns" in extras and _dns_signature(raw):
+        return F_DNS
     if b"\t" in b and b":" in b:
         return F_LTSV
     return F_RFC3164
@@ -106,14 +156,51 @@ def _classify_device_jit(batch, lens):
     return _CLASSIFY_JIT(batch, lens)
 
 
-def classify_packed(packed, sharded=None) -> "np.ndarray":
+def _extras_adjust(cls, batch, lens, n, extras) -> None:
+    """Overlay the opt-in extra legs onto a base four-class vector, in
+    the same precedence order as ``classify``: the ``{`` signature
+    re-labels to jsonl, and the dns TSV signature (checked before the
+    LTSV rule, i.e. it may override an LTSV/RFC3164 base class but
+    never a ``{``/``<`` one) re-labels to dns.  Vectorized numpy over
+    the packed rows; clip-overflow rows are re-classified from their
+    raw bytes by the caller either way."""
+    import numpy as np
+
+    if "jsonl" in extras:
+        cls[cls == F_GELF] = F_JSONL
+    if "dns" in extras:
+        b = batch[:n]
+        L = b.shape[1]
+        valid = np.arange(L)[None, :] < np.asarray(lens)[:n, None]
+        is_tab = (b == 9) & valid
+        five = is_tab.sum(axis=1) == 5
+        ft = np.where(is_tab, np.arange(L)[None, :], L).min(axis=1)
+        in_head = (np.arange(L)[None, :] < ft[:, None]) & valid
+        is_digit = (b >= 48) & (b <= 57)
+        is_dot = b == ord(".")
+        junk = np.any(in_head & ~is_digit & ~is_dot, axis=1)
+        dots = (in_head & is_dot).sum(axis=1)
+        dot_edge = np.any(in_head & is_dot
+                          & ((np.arange(L)[None, :] == 0)
+                             | (np.arange(L)[None, :]
+                                == (ft - 1)[:, None])), axis=1)
+        dns = five & (ft >= 1) & ~junk & (dots <= 1) & ~dot_edge
+        # a '{'/'<' first byte took its own branch before the dns rule
+        dns &= (cls == F_LTSV) | (cls == F_RFC3164)
+        dns &= (b[:, 0] != ord("<")) & (b[:, 0] != ord("{"))
+        cls[dns] = F_DNS
+
+
+def classify_packed(packed, sharded=None, extras=()) -> "np.ndarray":
     """First-bytes classification of the packed batch — the same
     decision table as ``classify`` with no per-line Python: the device
     kernel above for real batches, numpy host fallback for tiny or
     pathological geometries.  Rows longer than max_len are
     re-classified from their raw bytes (their tab/colon signature may
     lie beyond the clip).  ``sharded`` (a ShardedDecode built for
-    "classify") spreads the kernel over the device mesh."""
+    "classify") spreads the kernel over the device mesh.  ``extras``
+    (input.auto_extra_formats) overlays the opt-in jsonl/dns legs on
+    the vectorized paths."""
     import numpy as np
 
     batch, lens, chunk, starts, orig_lens, n = packed
@@ -129,18 +216,20 @@ def classify_packed(packed, sharded=None) -> "np.ndarray":
         else:
             cls = np.asarray(_classify_device_jit(
                 jnp.asarray(batch[:n]), jnp.asarray(lens[:n]))).copy()
+        if extras:
+            _extras_adjust(cls, batch, lens, n, extras)
         over = np.flatnonzero(np.asarray(orig_lens)[:n] > L)
         for i in over.tolist():
             s = int(np.asarray(starts)[i])
             ln = int(np.asarray(orig_lens)[i])
-            cls[i] = classify(chunk[s:s + ln])
+            cls[i] = classify(chunk[s:s + ln], extras)
         return cls
     if L < 19:
         # pathological max_len: classify from the unclipped chunk bytes
         st = np.asarray(starts)
         ol = np.asarray(orig_lens)
         return np.fromiter(
-            (classify(chunk[int(st[i]):int(st[i]) + int(ol[i])])
+            (classify(chunk[int(st[i]):int(st[i]) + int(ol[i])], extras)
              for i in range(n)),
             dtype=np.int8, count=n)
 
@@ -173,17 +262,30 @@ def classify_packed(packed, sharded=None) -> "np.ndarray":
     cls[is_lt] = F_RFC3164
     cls[is5424] = F_RFC5424
     cls[is_gelf] = F_GELF
+    if extras:
+        _extras_adjust(cls, batch, lens, n, extras)
 
     over = np.flatnonzero(np.asarray(orig_lens)[:n] > L)
     for i in over.tolist():
         s = int(np.asarray(starts)[i])
         ln = int(np.asarray(orig_lens)[i])
-        cls[i] = classify(chunk[s:s + ln])
+        cls[i] = classify(chunk[s:s + ln], extras)
     return cls
 
 
+def _class_table(extras: Tuple[str, ...]):
+    table = [(F_RFC5424, "rfc5424"), (F_RFC3164, "rfc3164"),
+             (F_LTSV, "ltsv"), (F_GELF, "gelf")]
+    if "jsonl" in extras:
+        table.append((F_JSONL, "jsonl"))
+    if "dns" in extras:
+        table.append((F_DNS, "dns"))
+    return table
+
+
 def decode_auto_packed(packed, max_len: int,
-                       ltsv_decoder: Optional[LTSVDecoder] = None
+                       ltsv_decoder: Optional[LTSVDecoder] = None,
+                       extras: Tuple[str, ...] = ()
                        ) -> List[LineResult]:
     """Partition a packed batch by vectorized class signature, run each
     class's columnar kernel on a row subset, and reassemble results in
@@ -196,10 +298,9 @@ def decode_auto_packed(packed, max_len: int,
     if ltsv_decoder is None:
         ltsv_decoder = LTSVDecoder(Config.from_string(""))
     n = packed[5]
-    classes = classify_packed(packed)
+    classes = classify_packed(packed, extras=extras)
     results: List[LineResult] = [None] * n  # type: ignore
-    for cls, fmt in ((F_RFC5424, "rfc5424"), (F_RFC3164, "rfc3164"),
-                     (F_LTSV, "ltsv"), (F_GELF, "gelf")):
+    for cls, fmt in _class_table(extras):
         idx = np.flatnonzero(classes == cls)
         if not idx.size:
             continue
@@ -212,17 +313,19 @@ def decode_auto_packed(packed, max_len: int,
 
 
 def decode_auto_batch(lines: List[bytes], max_len: int,
-                      ltsv_decoder: Optional[LTSVDecoder] = None
+                      ltsv_decoder: Optional[LTSVDecoder] = None,
+                      extras: Tuple[str, ...] = ()
                       ) -> List[LineResult]:
     """List-of-lines entry: pack once, then the packed auto route."""
     from . import pack as packmod
 
     return decode_auto_packed(packmod.pack_lines_2d(lines, max_len),
-                              max_len, ltsv_decoder)
+                              max_len, ltsv_decoder, extras)
 
 
 def encode_auto_gelf_blocks(packed, encoder, merger, ltsv_decoder=None,
-                            route_state=None, sharded_for=None):
+                            route_state=None, sharded_for=None,
+                            extras=()):
     """Block-encode a mixed batch: classify, submit every class's kernel
     (device work for independent classes overlaps via JAX async
     dispatch), run each class's columnar encode route — GELF, capnp,
@@ -251,14 +354,21 @@ def encode_auto_gelf_blocks(packed, encoder, merger, ltsv_decoder=None,
         return None
     if ltsv_decoder.schema:
         return None
+    if extras:
+        # the jsonl/dns legs block-encode GELF and LTSV only; other
+        # encoders keep the Record path for the whole mixed batch
+        from ..encoders.ltsv import LTSVEncoder
+
+        if type(encoder) not in (GelfEncoder, LTSVEncoder):
+            return None
     suffix, syslen = spec
 
     n = packed[5]
     classes = classify_packed(
-        packed, sharded_for("classify") if sharded_for else None)
+        packed, sharded_for("classify") if sharded_for else None,
+        extras=extras)
     submitted = []
-    for cls, fmt in ((F_RFC5424, "rfc5424"), (F_RFC3164, "rfc3164"),
-                     (F_LTSV, "ltsv"), (F_GELF, "gelf")):
+    for cls, fmt in _class_table(extras):
         idx = np.flatnonzero(classes == cls)
         if not idx.size:
             continue

@@ -120,7 +120,8 @@ class BatchHandler(Handler):
         # block routes with mined span channels pin the host encode path
         # (the miner consumes the fetched decode columns)
         self._mine_block = (self._miners is not None
-                            and fmt in ("rfc5424", "rfc3164", "ltsv"))
+                            and fmt in ("rfc5424", "rfc3164", "ltsv",
+                                        "jsonl", "dns"))
         self._lock = threading.Lock()
         # serializes batch decodes so a timer flush racing a size flush
         # cannot reorder output
@@ -245,9 +246,17 @@ class BatchHandler(Handler):
             or (fmt in ("rfc3164", "ltsv", "gelf", "auto")
                 and type(encoder) in (GelfEncoder, CapnpEncoder,
                                       LTSVEncoder, RFC5424Encoder))
+            or (fmt in ("jsonl", "dns")
+                and type(encoder) in (GelfEncoder, LTSVEncoder))
             or (fmt == "rfc3164"
                 and (passthrough_ok
                      or type(encoder) is RFC3164Encoder)))
+        # opt-in extra auto legs (input.auto_extra_formats): jsonl/dns
+        # classes for the mixed-format dispatch; empty = classic table
+        from .autodetect import auto_extra_formats
+
+        self._auto_extras = (auto_extra_formats(cfg) if fmt == "auto"
+                             else ())
         # single source of truth for kernel dispatch: fmt -> batch decoder
         auto_ltsv = self._auto_ltsv_decoder(cfg) if fmt == "auto" else None
         self._auto_ltsv = auto_ltsv
@@ -257,8 +266,10 @@ class BatchHandler(Handler):
                 lines, self.max_len, self.scalar.decoder),
             "gelf": lambda lines: _decode_gelf_batch(lines, self.max_len),
             "rfc3164": lambda lines: _decode_rfc3164_batch(lines, self.max_len),
+            "jsonl": lambda lines: _decode_jsonl_batch(lines, self.max_len),
+            "dns": lambda lines: _decode_dns_batch(lines, self.max_len),
             "auto": lambda lines: _decode_auto_batch(
-                lines, self.max_len, auto_ltsv),
+                lines, self.max_len, auto_ltsv, self._auto_extras),
         }.get(fmt)
         # the block route is config-static: if it can never engage, say
         # so once at startup — a *_tpu format that silently drops to the
@@ -440,6 +451,10 @@ class BatchHandler(Handler):
         "auto" mode, or tpu_mesh="off")."""
         if self._mesh_mode == "off":
             return None
+        if fmt in ("jsonl", "dns"):
+            # no mesh kernels for the new formats yet: lane dispatch is
+            # their multi-chip story (each lane decodes its own batches)
+            return None
         if fmt in self._sharded:
             return self._sharded[fmt]
         sharded = None
@@ -533,7 +548,8 @@ class BatchHandler(Handler):
 
             self._window.fence()
             self._emit(decode_auto_packed(packed, self.max_len,
-                                          self._auto_ltsv), runs)
+                                          self._auto_ltsv,
+                                          self._auto_extras), runs)
             return
         self._window.fence()
         self._emit(_decode_packed(self.fmt, packed, self.scalar.decoder),
@@ -626,9 +642,10 @@ class BatchHandler(Handler):
         """auto format: classify the line host-side (same decision table
         as the device kernel) and use that class's scalar oracle, so the
         degraded path stays byte-identical to the columnar one."""
-        from .autodetect import F_GELF, F_LTSV, F_RFC3164, F_RFC5424, classify
+        from .autodetect import (F_DNS, F_GELF, F_JSONL, F_LTSV,
+                                 F_RFC3164, F_RFC5424, classify)
 
-        cls = classify(raw)
+        cls = classify(raw, self._auto_extras)
         handler = self._auto_scalars.get(cls)
         if handler is None:
             if cls == F_RFC5424:
@@ -639,6 +656,14 @@ class BatchHandler(Handler):
                 from ..decoders import GelfDecoder
 
                 decoder = GelfDecoder(self._cfg)
+            elif cls == F_JSONL:
+                from ..decoders import JSONLDecoder
+
+                decoder = JSONLDecoder(self._cfg)
+            elif cls == F_DNS:
+                from ..decoders import DNSDecoder
+
+                decoder = DNSDecoder(self._cfg)
             else:
                 from ..decoders import RFC3164Decoder
 
@@ -670,7 +695,9 @@ class BatchHandler(Handler):
         """Cheap applicability check, evaluated before any kernel work so
         an inapplicable route never pays a wasted device decode."""
         if not self._block_mode or self.fmt not in ("rfc5424", "rfc3164",
-                                                     "ltsv", "gelf", "auto"):
+                                                     "ltsv", "gelf",
+                                                     "jsonl", "dns",
+                                                     "auto"):
             return False
         if self._enrich_hook is not None:
             # per-row _template_id fields don't fit the constant-segment
@@ -729,13 +756,24 @@ class BatchHandler(Handler):
                 return True
             return (type(self.encoder) is GelfEncoder
                     and not self.encoder.extra)
+        if self.fmt in ("jsonl", "dns"):
+            # the new formats block-encode GELF and LTSV (the
+            # high-volume production outputs); everything else keeps
+            # the Record path
+            if type(self.encoder) is LTSVEncoder:
+                return True
+            return (type(self.encoder) is GelfEncoder
+                    and not self.encoder.extra)
         if self.fmt == "auto":
-            # every class leg supports all four columnar encoders
-            # (round 5); gelf_extra still needs static placement
+            # every classic class leg supports all four columnar
+            # encoders (round 5); the opt-in jsonl/dns legs support
+            # GELF/LTSV only; gelf_extra still needs static placement
             if type(self.encoder) is GelfEncoder and self.encoder.extra:
                 return False
-            return (type(self.encoder) in (GelfEncoder, CapnpEncoder,
-                                           LTSVEncoder, RFC5424Encoder)
+            enc_ok = (GelfEncoder, LTSVEncoder) if self._auto_extras \
+                else (GelfEncoder, CapnpEncoder, LTSVEncoder,
+                      RFC5424Encoder)
+            return (type(self.encoder) in enc_ok
                     and not (self._auto_ltsv and self._auto_ltsv.schema))
         if type(self.encoder) is GelfEncoder:
             # extras with static placement ride the columnar route as
@@ -775,6 +813,10 @@ class BatchHandler(Handler):
         from ..encoders.rfc5424 import RFC5424Encoder
 
         if t in (CapnpEncoder, LTSVEncoder, RFC5424Encoder):
+            if (self.fmt == "auto" and self._auto_extras
+                    and t in (CapnpEncoder, RFC5424Encoder)):
+                return ("input.auto_extra_formats is set (the jsonl/dns "
+                        "legs block-encode GELF/LTSV only)")
             if self.fmt in ("ltsv", "auto"):
                 # every class leg supports these encoders; the only
                 # blocker left is the typed schema on the ltsv leg
@@ -877,7 +919,8 @@ class BatchHandler(Handler):
             from .autodetect import decode_auto_packed
 
             self._emit(decode_auto_packed(packed, self.max_len,
-                                          self._auto_ltsv), runs)
+                                          self._auto_ltsv,
+                                          self._auto_extras), runs)
             return
         self._emit(_decode_packed(self.fmt, packed, self.scalar.decoder),
                    runs)
@@ -949,10 +992,12 @@ class BatchHandler(Handler):
             res = encode_auto_gelf_blocks(packed, self.encoder,
                                           self._merger, self._auto_ltsv,
                                           self._device_route_state,
-                                          self._sharded_for)
+                                          self._sharded_for,
+                                          self._auto_extras)
             if res is None:
                 results = decode_auto_packed(packed, self.max_len,
-                                             self._auto_ltsv)
+                                             self._auto_ltsv,
+                                             self._auto_extras)
                 return lambda: self._emit(results, runs)
             # per-leg fetch time is folded into encode_seconds here: the
             # merger interleaves four kernels' fetches with their encodes
@@ -1175,6 +1220,14 @@ def block_submit(fmt, packed, sharded=None, device=None):
         from . import gelf
 
         return gelf.decode_gelf_submit(batch, lens, sharded)
+    if fmt == "jsonl":
+        from . import jsonl
+
+        return jsonl.decode_jsonl_submit(batch, lens, sharded)
+    if fmt == "dns":
+        from . import dns
+
+        return dns.decode_dns_submit(batch, lens, sharded)
     from . import rfc5424
 
     return rfc5424.decode_rfc5424_submit(batch, lens, sharded=sharded)
@@ -1297,6 +1350,38 @@ def block_fetch_encode(fmt, handle, packed, encoder, merger,
             res = encode_ltsv_gelf_block.encode_ltsv_gelf_block(
                 packed[2], packed[3], packed[4], host_out, packed[5],
                 packed[0].shape[1], encoder, merger, ltsv_decoder)
+    elif fmt == "jsonl":
+        from ..encoders.ltsv import LTSVEncoder
+        from . import encode_jsonl_block, jsonl
+
+        # no device-encode tier for the new formats (yet): the host
+        # block path is the fast tier, so the fetch is unconditional
+        host_out = jsonl.decode_jsonl_fetch(handle)
+        t1 = _time.perf_counter()
+        _tap_columns(column_tap, host_out)
+        if type(encoder) is LTSVEncoder:
+            res = encode_jsonl_block.encode_jsonl_ltsv_block(
+                packed[2], packed[3], packed[4], host_out, packed[5],
+                packed[0].shape[1], encoder, merger)
+        else:
+            res = encode_jsonl_block.encode_jsonl_gelf_block(
+                packed[2], packed[3], packed[4], host_out, packed[5],
+                packed[0].shape[1], encoder, merger)
+    elif fmt == "dns":
+        from ..encoders.ltsv import LTSVEncoder
+        from . import dns, encode_dns_block
+
+        host_out = dns.decode_dns_fetch(handle)
+        t1 = _time.perf_counter()
+        _tap_columns(column_tap, host_out)
+        if type(encoder) is LTSVEncoder:
+            res = encode_dns_block.encode_dns_ltsv_block(
+                packed[2], packed[3], packed[4], host_out, packed[5],
+                packed[0].shape[1], encoder, merger)
+        else:
+            res = encode_dns_block.encode_dns_gelf_block(
+                packed[2], packed[3], packed[4], host_out, packed[5],
+                packed[0].shape[1], encoder, merger)
     elif fmt == "gelf":
         from ..encoders.ltsv import LTSVEncoder
         from ..encoders.rfc5424 import RFC5424Encoder
@@ -1448,6 +1533,19 @@ def _decode_packed(fmt, packed, decoder=None):
             gelf.decode_gelf_submit(batch, lens))
         return materialize_gelf.materialize_gelf(chunk, starts, orig_lens, host_out,
                                                  n_real, batch.shape[1])
+    if fmt == "jsonl":
+        from . import jsonl, materialize_jsonl
+
+        host_out = jsonl.decode_jsonl_fetch(
+            jsonl.decode_jsonl_submit(batch, lens))
+        return materialize_jsonl.materialize_jsonl(
+            chunk, starts, orig_lens, host_out, n_real, batch.shape[1])
+    if fmt == "dns":
+        from . import dns, materialize_dns
+
+        host_out = dns.decode_dns_fetch(dns.decode_dns_submit(batch, lens))
+        return materialize_dns.materialize_dns(
+            chunk, starts, orig_lens, host_out, n_real, batch.shape[1])
     if fmt == "rfc3164":
         from ..utils.timeparse import current_year_utc
         from . import materialize_rfc3164, rfc3164
@@ -1465,10 +1563,22 @@ def _decode_gelf_batch(lines, max_len):
     return _decode_packed("gelf", pack.pack_lines_2d(lines, max_len))
 
 
-def _decode_auto_batch(lines, max_len, ltsv_decoder=None):
+def _decode_jsonl_batch(lines, max_len):
+    from . import pack
+
+    return _decode_packed("jsonl", pack.pack_lines_2d(lines, max_len))
+
+
+def _decode_dns_batch(lines, max_len):
+    from . import pack
+
+    return _decode_packed("dns", pack.pack_lines_2d(lines, max_len))
+
+
+def _decode_auto_batch(lines, max_len, ltsv_decoder=None, extras=()):
     from .autodetect import decode_auto_batch
 
-    return decode_auto_batch(lines, max_len, ltsv_decoder)
+    return decode_auto_batch(lines, max_len, ltsv_decoder, extras)
 
 
 def _decode_ltsv_batch(lines, max_len, decoder):

@@ -1,0 +1,135 @@
+r"""Columnar DNS query-log decoder (dnstap-style TSV).
+
+Scalar spec: flowgger_tpu/decoders/dns.py.  The grammar is fixed —
+exactly six tab-separated fields, ``ts client qname qtype rcode
+latency_us`` — so the whole decode is the fixed-grammar columnar plan
+of arxiv 2411.12035 (and this repo's ltsv kernel): one tab-ordinal
+cumsum segments the line, five packed-sum extractions recover the tab
+positions, and every field becomes a span plus an elementwise
+validation mask.  No lookarounds, no parity — this is the cheapest
+kernel in the tree.
+
+- ``ts`` validates as ``digits[.digits]`` on-device; the exact f64
+  value materializes host-side (``float(span)``, dedup-cached);
+- ``latency_us`` validates as 1..19 plain digits (19 digits always fit
+  u64; longer-but-still-u64 values are oracle work);
+- ``client``/``qname`` must be non-empty; ``qtype``/``rcode`` are free
+  spans.
+
+Rows failing any check — wrong field count, junk timestamp, oversized
+latency — flag ``ok=False`` and re-run the scalar oracle, keeping
+observable output byte-identical in every case.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+from .rfc5424 import (
+    _scan_ordinals,
+    best_extract_impl,
+    best_scan_impl,
+    extract_by_ord,
+)
+
+N_FIELDS = 6
+MAX_LAT_DIGITS = 19  # 19 decimal digits always fit u64
+_I32 = jnp.int32
+
+
+def decode_dns(batch: jnp.ndarray, lens: jnp.ndarray,
+               scan_impl: str = None,
+               extract_impl: str = None) -> Dict[str, jnp.ndarray]:
+    if scan_impl is None:
+        scan_impl = best_scan_impl()
+    if extract_impl is None:
+        extract_impl = best_extract_impl()
+    N, L = batch.shape
+    lens = lens.astype(_I32)
+    iota = jax.lax.broadcasted_iota(_I32, (N, L), 1)
+    valid = iota < lens[:, None]
+    bb = jnp.where(valid, batch, jnp.uint8(0))
+    is_digit = (bb >= 48) & (bb <= 57)
+    is_dot = bb == ord(".")
+
+    is_tab = (bb == 9) & valid
+    (tab_ord,) = _scan_ordinals([is_tab], scan_impl)
+    n_tabs = jnp.max(jnp.where(is_tab, tab_ord, 0), axis=1).astype(_I32)
+    ok = n_tabs == N_FIELDS - 1
+
+    # the five separator positions; rows with a different tab count are
+    # already off the tier, so fill values never reach a consumer
+    tab_pos = extract_by_ord(is_tab, tab_ord, iota, N_FIELDS - 1, L,
+                             extract_impl)
+    tab_pos = jnp.minimum(tab_pos, lens[:, None])
+    t0, t1, t2, t3, t4 = (tab_pos[:, k] for k in range(N_FIELDS - 1))
+
+    # ---- ts: digits[.digits] in [0, t0) ---------------------------------
+    in_ts = (iota < t0[:, None]) & valid
+    dot_bad = is_dot & ((iota == 0) | (iota == (t0 - 1)[:, None]))
+    ts_viol = in_ts & ((~is_digit & ~is_dot) | dot_bad)
+    n_dots = jnp.sum((in_ts & is_dot).astype(_I32), axis=1)
+    ts_ok = ~jnp.any(ts_viol, axis=1) & (n_dots <= 1) & (t0 >= 1)
+
+    # ---- latency: 1..19 plain digits in [t4+1, len) ----------------------
+    lat_start = t4 + 1
+    in_lat = (iota >= lat_start[:, None]) & valid
+    lat_len = lens - lat_start
+    lat_ok = (~jnp.any(in_lat & ~is_digit, axis=1)
+              & (lat_len >= 1) & (lat_len <= MAX_LAT_DIGITS))
+
+    client_start, client_end = t0 + 1, t1
+    qname_start, qname_end = t1 + 1, t2
+    qtype_start, qtype_end = t2 + 1, t3
+    rcode_start, rcode_end = t3 + 1, t4
+    ok &= ts_ok & lat_ok
+    ok &= (client_end > client_start) & (qname_end > qname_start)
+
+    return {
+        "ok": ok,
+        "has_high": jnp.any((bb >= 128) & valid, axis=1),
+        "ts_start": jnp.zeros_like(lens), "ts_end": t0,
+        "client_start": client_start, "client_end": client_end,
+        "qname_start": qname_start, "qname_end": qname_end,
+        "qtype_start": qtype_start, "qtype_end": qtype_end,
+        "rcode_start": rcode_start, "rcode_end": rcode_end,
+        "lat_start": lat_start, "lat_end": lens,
+    }
+
+
+@functools.partial(jax.jit, static_argnames=("demand",))
+def decode_dns_jit(batch, lens, demand=None):
+    """``demand`` (static frozenset): keep only the channels the
+    consumer reads so XLA dead-code-eliminates the rest."""
+    out = decode_dns(batch, lens)
+    if demand is not None:
+        out = {k: v for k, v in out.items() if k in demand}
+    return out
+
+
+def decode_dns_submit(batch, lens, sharded=None):
+    """Asynchronous dispatch (pair with decode_dns_fetch) — the dns leg
+    of the block pipeline's double buffering."""
+    import jax.numpy as jnp
+
+    if sharded is not None:
+        b, ln = sharded.put(batch, lens)
+        return sharded.fn(b, ln), b, ln
+    from .aot import decode_call
+
+    b, ln = jnp.asarray(batch), jnp.asarray(lens)
+    # zero-JIT boot: a loaded AOT artifact replaces the trace+compile
+    out = decode_call("dns", (b, ln))
+    if out is None:
+        out = decode_dns_jit(b, ln)
+    return out, b, ln
+
+
+def decode_dns_fetch(handle):
+    import numpy as np
+
+    return {k: np.asarray(v) for k, v in handle[0].items()}

@@ -52,26 +52,23 @@ from typing import Dict
 import jax
 import jax.numpy as jnp
 
+from .jsonidx import (  # noqa: F401 - VT_* re-exported for consumers
+    VT_FALSE,
+    VT_NULL,
+    VT_NUMBER,
+    VT_STRING,
+    VT_TRUE,
+    WS_WINDOW,
+    structural_index,
+)
 from .rfc5424 import (
-    _bitpack32,
-    _esc_parity,
-    _scan_ordinals,
-    _slot_geometry,
-    _shift_left,
-    _shift_right,
     best_extract_impl,
     best_scan_impl,
-    extract_by_ord,
-    extract_counts_by_ord,
     rescue_refetch,
 )
 
 DEFAULT_MAX_FIELDS = 8
 RESCUE_MAX_FIELDS = 24
-WS_WINDOW = 8
-_I32 = jnp.int32
-
-VT_STRING, VT_NUMBER, VT_TRUE, VT_FALSE, VT_NULL = 0, 1, 2, 3, 4
 
 
 def decode_gelf(batch: jnp.ndarray, lens: jnp.ndarray,
@@ -82,237 +79,11 @@ def decode_gelf(batch: jnp.ndarray, lens: jnp.ndarray,
         scan_impl = best_scan_impl()
     if extract_impl is None:
         extract_impl = best_extract_impl()
-    N, L = batch.shape
-    lens = lens.astype(_I32)
-    iota = jax.lax.broadcasted_iota(_I32, (N, L), 1)
-    valid = iota < lens[:, None]
-    # uint8 byte plane (see rfc5424.py): widen inside consumer fusions
-    bb = jnp.where(valid, batch, jnp.uint8(0))
-
-    is_ws = ((bb == 32) | (bb == 9) | (bb == 10) | (bb == 13)) & valid
-    nonws = valid & ~is_ws
-
-    # ---- escaped quotes & parity ----------------------------------------
-    is_bs = (bb == 92) & valid
-    quote = (bb == ord('"')) & valid
-    escaped, cap_plane, cap_words = _esc_parity(is_bs, scan_impl)
-    real_q = quote & ~escaped
-    if cap_plane is not None:
-        cap_viol = jnp.any(cap_plane & quote, axis=1)
-    else:
-        cap_viol = jnp.any((cap_words & _bitpack32(quote)) != 0, axis=1)
-
-    (q_incl,) = _scan_ordinals([real_q], scan_impl)
-    q_excl = q_incl - real_q.astype(q_incl.dtype)
-    outside = (q_excl & 1) == 0
-    open_q = real_q & outside
-    close_q = real_q & ~outside
-    inside_str = (~outside) & valid
-    ok = ~cap_viol
-
-    # ---- bounded-window lookarounds -------------------------------------
-    # ptb/ntb: byte of the nearest non-ws position within WS_WINDOW
-    # before/after each position (0 when none in window).  Rows with a
-    # longer outside-string whitespace run fall back, so "not found in
-    # window" can never silently mean "found nothing relevant".
-    #
-    # Round-5 fold: the old per-shift select chain materialized ~2*W
-    # [N, L] pad fusions (the shifted planes each had many consumers, so
-    # XLA would not rematerialize them); a reduce-window over a packed
-    # (position << 8 | byte) word is ONE windowed pass each way — max
-    # over [p-W, p-1] picks the nearest previous non-ws (largest
-    # position) with its byte in the low bits, min over [p+1, p+W] the
-    # nearest next.
-    bi32 = bb.astype(_I32)
-    pv = jnp.where(nonws, (iota << 8) | bi32, -1)
-    rw_p = jax.lax.reduce_window(
-        pv, jnp.int32(-1), jax.lax.max, (1, WS_WINDOW), (1, 1),
-        ((0, 0), (WS_WINDOW - 1, 0)))
-    ptb_w = _shift_right(rw_p, 1, -1)
-    ptb = jnp.where(ptb_w >= 0, ptb_w & 255, 0)
-    _BIG = jnp.int32(1 << 30)
-    nv = jnp.where(nonws, (iota << 8) | bi32, _BIG)
-    rw_n = jax.lax.reduce_window(
-        nv, _BIG, jax.lax.min, (1, WS_WINDOW), (1, 1),
-        ((0, 0), (0, WS_WINDOW - 1)))
-    ntb_w = _shift_left(rw_n, 1, _BIG)
-    ntb = jnp.where(ntb_w < _BIG, ntb_w & 255, 0)
-
-    # ws run > WS_WINDOW outside strings: a windowed count hitting W+1
-    # (edge padding contributes 0, so short runs at the line start can
-    # never flag, matching the old shifted-AND ladder's False fill)
-    run = is_ws & outside
-    rw_run = jax.lax.reduce_window(
-        run.astype(_I32), jnp.int32(0), jax.lax.add,
-        (1, WS_WINDOW + 1), (1, 1), ((0, 0), (WS_WINDOW, 0)))
-    # every row-disqualifying plane ORs into one mask reduced by a single
-    # any at the end (round-5 fold: was 7 separate any-reductions)
-    viol = rw_run == WS_WINDOW + 1
-
-    # ---- structure: braces, arrays --------------------------------------
-    lb = (bb == ord("{")) & outside
-    rb = (bb == ord("}")) & outside
-    viol |= ((bb == ord("[")) | (bb == ord("]"))) & outside
-    # first/last non-ws position with an is-it-the-brace tag packed into
-    # the reduction word (fold: was 4 reductions — first_nonws/lb_pos
-    # mins, last_nonws/rb_pos maxes).  Combined with the exactly-one
-    # lb/rb count checks below this is equivalent to first_nonws==lb_pos
-    # & last_nonws==rb_pos.
-    wf = jnp.min(jnp.where(nonws, 2 * iota + (~lb).astype(_I32), 2 * L + 2),
-                 axis=1)
-    first_is_lb = (wf & 1) == 0
-    first_nonws = wf >> 1
-    wl = jnp.max(jnp.where(nonws, 2 * iota + rb.astype(_I32), -1), axis=1)
-    last_is_rb = (wl & 1) == 1
-    last_nonws = wl >> 1
-    ok &= first_is_lb & last_is_rb & (first_nonws < last_nonws)
-
-    # ---- token roles (elementwise) --------------------------------------
-    is_key_open = open_q & ((ptb == ord("{")) | (ptb == ord(",")))
-    is_val_open = open_q & (ptb == ord(":"))
-    viol |= open_q & ~is_key_open & ~is_val_open
-    is_key_close = close_q & (ntb == ord(":"))
-    is_val_close = close_q & ~is_key_close
-    # a value close must be followed by ',' or '}'
-    viol |= is_val_close & (ntb != ord(",")) & (ntb != ord("}"))
-
-    colon_out = (bb == ord(":")) & outside & valid
-    comma_out = (bb == ord(",")) & outside & valid
-    # every comma introduces another key (next non-ws is a quote)
-    viol |= comma_out & (ntb != ord('"'))
-
-    key_ord, kc_ord = _scan_ordinals(
-        [is_key_open, is_key_close], scan_impl)
-    # the seven row counts ride packed sums, as many per-count fields per
-    # i32 word as L allows (fold: was 3 maxes + 4 sums); the ordinal-plane
-    # maxes equal plain mask counts because the ordinals are inclusive
-    # cumsums
-    cbits, per, cmask = _slot_geometry(L)
-
-    def packed_counts(masks):
-        outs = []
-        for base in range(0, len(masks), per):
-            grp = masks[base:base + per]
-            acc = grp[0].astype(_I32)
-            for s, m in enumerate(grp[1:], 1):
-                acc = acc + (m.astype(_I32) << (cbits * s))
-            word = jnp.sum(acc, axis=1)
-            for s in range(len(grp)):
-                outs.append((word >> (cbits * s)) & cmask)
-        return outs
-
-    n_quotes, lbc, rbc, n_keys, n_kc, n_colons, n_commas = packed_counts(
-        [real_q, lb, rb, is_key_open, is_key_close, colon_out, comma_out])
-    ok &= (n_quotes & 1) == 0  # every string closed
-    ok &= (lbc == 1) & (rbc == 1)
-    ok &= n_kc == n_keys
-    ok &= n_keys <= max_fields
-    ok &= n_colons == n_keys
-    ok &= n_commas == jnp.maximum(n_keys - 1, 0)
-
-    # ---- literal/number runs --------------------------------------------
-    structural = (colon_out | comma_out | lb | rb | real_q)
-    is_lit = nonws & outside & ~structural
-    lit_start = is_lit & ~_shift_right(is_lit, 1, False)
-    lit_end_m = is_lit & ~_shift_left(is_lit, 1, False)
-    # nothing significant may precede the first key (between '{' and it)
-    viol |= is_lit & (key_ord == 0)
-    # backslashes are only legal inside strings in flat JSON; a bs
-    # "outside" (per possibly-garbled parity) sends the row to the
-    # oracle, which also shields the parity math itself from junk input
-    viol |= is_bs & outside
-    ok &= ~jnp.any(viol, axis=1)
-
-    # number/literal value start: a literal-run start whose previous
-    # non-ws byte is ':'
-    is_lit_val = lit_start & (ptb == ord(":"))
-    is_val_start = is_val_open | is_lit_val
-    # literal tokens match against a packed next-4-bytes word (2 shifted
-    # planes) instead of per-token shifted-plane chains (was ~11 planes);
-    # high input bytes overflow into the sign bit deterministically and
-    # can never collide with the ASCII token constants
-    w2 = (bi32 << 8) | _shift_left(bi32, 1, 0)
-    w4 = (w2 << 16) | _shift_left(w2, 2, 0)
-    true_at = w4 == int.from_bytes(b"true", "big")
-    null_at = w4 == int.from_bytes(b"null", "big")
-    false_at = (w4 == int.from_bytes(b"fals", "big")) & \
-        (_shift_left(bi32, 4, 0) == ord("e"))
-    is_num0 = ((bb >= 48) & (bb <= 57)) | (bb == ord("-"))
-    vclass = jnp.where(
-        is_val_open, 1 + VT_STRING,
-        jnp.where(true_at, 1 + VT_TRUE,
-                  jnp.where(false_at, 1 + VT_FALSE,
-                            jnp.where(null_at, 1 + VT_NULL,
-                                      jnp.where(is_num0, 1 + VT_NUMBER, 0)))))
-
-    # ---- per-key extraction (packed-sum words) --------------------------
-    F = max_fields
-    key_open_pos = extract_by_ord(is_key_open, key_ord, iota, F, L,
-                                  extract_impl)
-    key_close_pos = extract_by_ord(is_key_close, kc_ord, iota, F, L,
-                                   extract_impl)
-    # value position and class share one extraction word per slot: the
-    # class rides bits above the position field (fold: was 2 channels =
-    # 6 reduction words at F=8; fill L keeps the class field 0)
-    pbits = max(10, int(L + 1).bit_length())
-    vs_packed = extract_by_ord(is_val_start, key_ord,
-                               iota | (vclass << pbits), F, L,
-                               extract_impl, slot_bits=pbits + 3)
-    val_start_pos = vs_packed & ((1 << pbits) - 1)
-    val_class1 = vs_packed >> pbits
-    val_close_pos = extract_by_ord(is_val_close, key_ord, iota, F, L,
-                                   extract_impl)
-    lit_end_pos = extract_by_ord(lit_end_m, key_ord, iota, F, L,
-                                 extract_impl)
-    # exactly one value token per key: a string close or a literal run
-    val_tokens = extract_counts_by_ord(is_val_close | lit_start, key_ord,
-                                       F, extract_impl)
-    esc_count = extract_counts_by_ord(is_bs & inside_str, key_ord, F,
-                                      extract_impl)
-
-    field_valid = (jnp.arange(F, dtype=_I32)[None, :] < n_keys[:, None])
-    ok &= jnp.where(field_valid, val_tokens == 1, val_tokens == 0).all(axis=1)
-    ok &= jnp.where(field_valid, val_class1 >= 1, True).all(axis=1)
-    val_type = jnp.where(field_valid, val_class1 - 1, -1)
-
-    # per-key ordering sanity: open < close < value start
-    ok &= jnp.where(field_valid,
-                    (key_open_pos < key_close_pos)
-                    & (key_close_pos < val_start_pos), True).all(axis=1)
-    # extraction-collision guard: multiple val-starts per key would
-    # corrupt the packed sums — val_tokens==1 bounds val_close/lit runs,
-    # and >1 val_start implies >1 lit_start or val_open (the former is
-    # bounded above; a second val_open implies a second ':' which the
-    # colon count bounds)
-
-    # string values: close quote; literals: last run byte + 1
-    is_string = val_type == VT_STRING
-    val_end = jnp.where(is_string, val_close_pos, lit_end_pos + 1)
-    val_end = jnp.minimum(val_end, lens[:, None])
-    # literal token length must match exactly (rejects "truex")
-    lit_len = jnp.where(val_type == VT_TRUE, 4,
-                        jnp.where(val_type == VT_FALSE, 5,
-                                  jnp.where(val_type == VT_NULL, 4, -1)))
-    ok &= jnp.where(field_valid & (lit_len > 0),
-                    val_end - val_start_pos == lit_len, True).all(axis=1)
-    # string values must close after they open
-    ok &= jnp.where(field_valid & is_string,
-                    val_close_pos > val_start_pos, True).all(axis=1)
-
-    esc_flag = (esc_count > 0) & field_valid
-
-    return {
-        "ok": ok,
-        # n_fields stays un-zeroed on not-ok rows so the fetch-side
-        # rescue can screen precisely; every consumer gates on ok
-        # before reading it (materialize_gelf.py, encode_gelf_gelf_block)
-        "n_fields": n_keys,
-        "key_start": key_open_pos + 1, "key_end": key_close_pos,
-        "val_start": jnp.where(is_string, val_start_pos + 1, val_start_pos),
-        "val_end": val_end,
-        "val_type": val_type,
-        "key_esc": esc_flag, "val_esc": esc_flag & is_string,
-    }
+    # stage 1 lives in tpu/jsonidx.py, shared with the generic
+    # JSON-lines decoder (tpu/jsonl.py) — nested=0 is GELF's flat-only
+    # contract: any bracket outside a string flags the row
+    return structural_index(batch, lens, max_fields, scan_impl,
+                            extract_impl, nested=0)
 
 
 def decode_gelf_submit(batch, lens, sharded=None):

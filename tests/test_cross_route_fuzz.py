@@ -6,13 +6,38 @@ import subprocess
 import sys
 import os
 
+import pytest
+
 REPO = os.path.join(os.path.dirname(__file__), "..")
 
 
 def test_cross_route_fuzz_bounded():
+    # tier-1 keeps this pass at its classic four-format scope: the
+    # jsonl/dns routes have their own 22 direct tier-1 tests plus the
+    # filtered fuzz below (slow) and ci.sh's dedicated new-format
+    # step — re-fuzzing them here would push the tier-1 wall budget
     r = subprocess.run(
         [sys.executable, os.path.join(REPO, "tools", "deep_fuzz.py"),
-         "5", "1"],
+         "--routes", "rfc5424,rfc3164,ltsv,gelf", "5", "1"],
+        capture_output=True, timeout=900, cwd=REPO)
+    assert r.returncode == 0, (
+        r.stdout.decode("utf-8", "replace")[-1500:]
+        + r.stderr.decode("utf-8", "replace")[-800:])
+
+
+@pytest.mark.slow
+def test_cross_route_fuzz_new_formats_bounded():
+    """The jsonl/dns block routes (randomized 1/2-lane dispatch ×
+    line/nul/syslen framing) vs their scalar oracles — the filtered
+    run gives the new formats more trials than the full matrix pass
+    above affords.  Slow-marked: tier-1 does NOT fuzz the jsonl/dns
+    routes at all (the classic pass above is pinned to the four
+    classic formats for the wall budget; jsonl/dns tier-1 coverage is
+    the direct tests in test_tpu_jsonl/test_tpu_dns) — ci.sh's
+    new-format step runs THIS test as the filtered-fuzz gate."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "deep_fuzz.py"),
+         "--routes", "jsonl,dns", "7", "3"],
         capture_output=True, timeout=900, cwd=REPO)
     assert r.returncode == 0, (
         r.stdout.decode("utf-8", "replace")[-1500:]

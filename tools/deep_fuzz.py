@@ -3,8 +3,15 @@
 
 Usage: python tools/deep_fuzz.py [seed] [trials]
        python tools/deep_fuzz.py --routes fused [seed] [trials]
+       python tools/deep_fuzz.py --routes jsonl,dns [seed] [trials]
 Prints per-route mismatches (none expected) and a FAILURES count.
 A bounded version runs in CI as tests/test_cross_route_fuzz.py.
+
+``--routes`` either selects the fused decode→encode tier (``fused``)
+or filters the classic block-route matrix to a comma-separated list of
+input formats (e.g. ``jsonl,dns`` — the new-format CI step).  Classic
+new-format runs randomize the lane count (1/2) so the LaneSet
+sequencer's ordering contract is fuzzed too.
 
 ``--routes fused`` fuzzes the fused decode→encode tier
 (flowgger_tpu/tpu/fused_routes.py) instead: every registered fused
@@ -17,13 +24,19 @@ import os, queue, random, re, sys, time
 os.environ["JAX_PLATFORMS"] = "cpu"
 
 FUSED_MODE = False
+ROUTE_FILTER = None
 if "--routes" in sys.argv:
     i = sys.argv.index("--routes")
-    if i + 1 >= len(sys.argv) or sys.argv[i + 1] != "fused":
-        print("--routes takes exactly one value: fused", file=sys.stderr)
+    if i + 1 >= len(sys.argv):
+        print("--routes takes a value: fused, or a comma-separated "
+              "format list (e.g. jsonl,dns)", file=sys.stderr)
         sys.exit(2)
+    val = sys.argv[i + 1]
     del sys.argv[i:i + 2]
-    FUSED_MODE = True
+    if val == "fused":
+        FUSED_MODE = True
+    else:
+        ROUTE_FILTER = set(val.split(","))
 
 if FUSED_MODE:
     # fused mode runs the programs eagerly (disable_jit below): inline
@@ -134,6 +147,48 @@ def gen_gelf():
     if rng.random() < 0.3:
         obj["level"] = rng.randrange(0, 10)
     return _json.dumps(obj).encode()
+
+def gen_jsonl():
+    import json as _json
+    obj = {"timestamp": rng.choice([1438790025, 1438790025.42, -5, 0])}
+    # up to 12 DISTINCT extra keys: with the three specials below this
+    # crosses the DEFAULT_MAX_FIELDS=8 boundary, so the tier-2 rescue
+    # path (9..24 fields, decode_jsonl_fetch) gets fuzzed too
+    for kn in rng.sample(range(20), rng.randrange(0, 13)):
+        k = f"k{kn}"
+        r = rng.random()
+        if r < 0.15:
+            obj[k] = {"a": rng.randrange(9), "b": [1, rnd_val()]}
+        elif r < 0.25:
+            obj[k] = [rng.randrange(9), rnd_val(), None]
+        else:
+            obj[k] = rng.choice([rnd_val(), rng.randrange(-99, 99),
+                                 True, False, None, 3.25])
+    if rng.random() < 0.5:
+        obj["message"] = rnd_val()
+    if rng.random() < 0.5:
+        obj["host"] = f"h{rng.randrange(5)}"
+    if rng.random() < 0.3:
+        obj["level"] = rng.randrange(0, 10)
+    return _json.dumps(obj).encode()
+
+
+def gen_dns():
+    ts = rng.choice(["1438790025", "1438790025.5", "1438790025.123",
+                     "0", ".5", "5.", "x", "-1"])
+    client = rng.choice(["10.0.0.9", "2001:db8::1", f"h{rng.randrange(5)}",
+                         ""])
+    qname = rng.choice([f"q{rng.randrange(9)}.example.com.", "a.b.", ""])
+    qtype = rng.choice(["A", "AAAA", "TXT", "28", ""])
+    rcode = rng.choice(["NOERROR", "NXDOMAIN", "SERVFAIL", "3"])
+    lat = rng.choice(["0", "523", "007", str(rng.randrange(10 ** 7)),
+                      "18446744073709551615", "99999999999999999999999"])
+    parts = [ts, client, qname, qtype, rcode, lat]
+    # occasionally break the field count
+    if rng.random() < 0.1:
+        parts = parts[:5] if rng.random() < 0.5 else parts + ["extra"]
+    return "\t".join(parts).encode()
+
 
 GENS = [gen_rfc5424, gen_rfc3164, gen_ltsv, gen_gelf]
 
@@ -336,18 +391,44 @@ if FUSED_MODE:
     print("ENGAGED:", engaged, "FAILURES:", fails)
     sys.exit(1 if fails or not engaged else 0)
 
+from flowgger_tpu.decoders.jsonl import JSONLDecoder
+from flowgger_tpu.decoders.dns import DNSDecoder
+
 ROUTES = [
     ("rfc5424", RFC5424Decoder, [GelfEncoder, PassthroughEncoder, RFC5424Encoder, LTSVEncoder, CapnpEncoder], gen_rfc5424),
     ("rfc3164", RFC3164Decoder, [GelfEncoder, PassthroughEncoder, RFC3164Encoder, CapnpEncoder, LTSVEncoder, RFC5424Encoder], gen_rfc3164),
     ("ltsv", LTSVDecoder, [GelfEncoder, CapnpEncoder, LTSVEncoder, RFC5424Encoder], gen_ltsv),
     ("ltsv", TypedLTSVDecoder, [GelfEncoder, CapnpEncoder, LTSVEncoder, RFC5424Encoder], gen_ltsv_typed),
     ("gelf", GelfDecoder, [GelfEncoder, LTSVEncoder, CapnpEncoder, RFC5424Encoder], gen_gelf),
+    ("jsonl", JSONLDecoder, [GelfEncoder, LTSVEncoder], gen_jsonl),
+    ("dns", DNSDecoder, [GelfEncoder, LTSVEncoder], gen_dns),
 ]
+if ROUTE_FILTER is not None:
+    unknown = ROUTE_FILTER - {fmt for fmt, *_ in ROUTES}
+    if unknown:
+        print(f"--routes: unknown format(s) {sorted(unknown)}",
+              file=sys.stderr)
+        sys.exit(2)
+    ROUTES = [r for r in ROUTES if r[0] in ROUTE_FILTER]
+# new-format handler configs: eager kernel cost scales with max_len,
+# and the generators' longest lines stay well under 192 (over-long
+# rows would take the per-row oracle, which the fuzz compares against
+# anyway)
+CFG_NEWFMT = Config.from_string("[input]\ntpu_max_line_len = 192\n")
+CFG_LANES2 = Config.from_string(
+    "[input]\ntpu_lanes = 2\ntpu_max_line_len = 192\n")
 MERGERS = [None, LineMerger(), NulMerger(), SyslenMerger()]
 fails = 0
 for trial in range(int(sys.argv[2]) if len(sys.argv) > 2 else 6):
     for fmt, dec_cls, encs, gen in ROUTES:
-        lines = corpus(400, gen)
+        # the new-format legs fuzz the host-side screen/assembly/
+        # splicing logic eagerly on a smaller corpus: a fresh
+        # [512, 512] jsonl structural-index compile per CI pass buys
+        # nothing the eager run doesn't check (compiled-vs-eager
+        # channel equality has its own tests, and bench.py --smoke
+        # gates the compiled block route's bytes)
+        new_fmt = fmt in ("jsonl", "dns")
+        lines = corpus(256 if new_fmt else 400, gen)
         for enc_cls in encs:
             dec = dec_cls(CFG)
             enc = enc_cls(CFG)
@@ -360,10 +441,16 @@ for trial in range(int(sys.argv[2]) if len(sys.argv) > 2 else 6):
                     continue
                 want.append(merger.frame(payload) if merger else payload)
             tx = queue.Queue()
-            h = BatchHandler(tx, dec, enc, CFG, fmt=fmt, start_timer=False, merger=merger)
-            for ln in lines:
-                h.handle_bytes(ln)
-            h.flush()
+            # the new-format routes fuzz the 1/2-lane sequencer too
+            hcfg = CFG
+            if new_fmt:
+                hcfg = CFG_LANES2 if rng.random() < 0.5 else CFG_NEWFMT
+            h = BatchHandler(tx, dec, enc, hcfg, fmt=fmt, start_timer=False, merger=merger)
+            import contextlib
+            with jax.disable_jit() if new_fmt else contextlib.nullcontext():
+                for ln in lines:
+                    h.handle_bytes(ln)
+                h.flush()
             got = []
             while not tx.empty():
                 item = tx.get_nowait()
