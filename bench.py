@@ -1491,6 +1491,137 @@ def bench_new_formats(extra, smoke):
     return bool(ok)
 
 
+def bench_framing(extra, smoke):
+    """Device-resident framing gates (tpu/framing.py):
+
+    1. Byte identity: the device-framed pipeline (raw chunks → on-device
+       span kernel + gather) must emit exactly the host-splitter
+       pipeline's bytes on line, nul, AND syslen framing (hard gate).
+    2. Span-metadata economics: the framing path fetches only the span
+       vectors (8 B/row + scalars); fetched bytes/row must stay under
+       emitted bytes/row (hard gate — this is the D2H the tier saves).
+    3. Throughput: device-framed e2e >= host-pack e2e on >= 1 framing.
+       Tiered like the fleet/new-format gates: hard on an accelerator
+       backend; on cpu-fallback the jnp span kernels legitimately lose
+       to the native memcpy pack, so the gate drops to a structural
+       floor with the ratio always recorded (the economics arm routes
+       real traffic to the winner either way).
+    """
+    import queue as _q
+
+    import jax
+
+    from flowgger_tpu.block import EncodedBlock
+    from flowgger_tpu.config import Config
+    from flowgger_tpu.decoders.rfc5424 import RFC5424Decoder
+    from flowgger_tpu.encoders.ltsv import LTSVEncoder
+    from flowgger_tpu.mergers import LineMerger
+    from flowgger_tpu.splitters import (LineSplitter, NulSplitter,
+                                        SyslenSplitter)
+    from flowgger_tpu.tpu.batch import BatchHandler
+    from flowgger_tpu.utils.metrics import registry as _registry
+
+    cpu_fallback = jax.default_backend() == "cpu"
+    rate_floor = 0.1 if cpu_fallback else 1.0
+    n = 4_096 if smoke else 16_384
+    lines = [(f"<34>1 2023-10-11T22:14:15.00{i % 10}Z host{i % 7} app "
+              f"{i} ID47 - request served in {i % 900}us path=/v{i % 4}"
+              ).encode() for i in range(n)]
+    streams = {
+        "line": (LineSplitter, b"".join(ln + b"\n" for ln in lines)),
+        "nul": (NulSplitter, b"".join(ln + b"\0" for ln in lines)),
+        "syslen": (SyslenSplitter,
+                   b"".join(b"%d %s" % (len(ln), ln) for ln in lines)),
+    }
+    base = (f"[input]\ntpu_batch_size = {n}\ntpu_max_line_len = 192\n"
+            'tpu_fuse = "off"\n')
+
+    class _Chunked:
+        def __init__(self, data):
+            self.data, self.pos = data, 0
+
+        def read(self, nbytes):
+            out = self.data[self.pos:self.pos + (1 << 16)]
+            self.pos += len(out)
+            return out
+
+    def run(framing_cfg, splitter_cls, stream):
+        # the "on" runs pin the framing tier (economics off) so the
+        # measured rate is the pure device-framed path — in production
+        # the economics arm routes each flush to the winner, which on a
+        # cpu-fallback box is usually the host pack this gate records
+        cfg = Config.from_string(
+            base + f'tpu_framing = "{framing_cfg}"\n'
+            + ("tpu_encode_economics = false\n"
+               if framing_cfg == "on" else ""))
+        tx = _q.Queue()
+        h = BatchHandler(tx, RFC5424Decoder(), LTSVEncoder(cfg), cfg,
+                         fmt="rfc5424", start_timer=False,
+                         merger=LineMerger())
+        t0 = time.perf_counter()
+        splitter_cls().run(_Chunked(stream), h)
+        dt = time.perf_counter() - t0
+        h.close()
+        got = []
+        while not tx.empty():
+            item = tx.get_nowait()
+            got.extend(item.iter_framed()
+                       if isinstance(item, EncodedBlock) else [item])
+        return got, n / dt
+
+    sections = {}
+    ok = True
+    any_faster = False
+    for name, (splitter_cls, stream) in streams.items():
+        run("on", splitter_cls, stream)   # warmup: framing + decode
+        run("off", splitter_cls, stream)  # compiles out of the rates
+        want, host_rate = run("off", splitter_cls, stream)
+        _registry.reset()
+        got, dev_rate = run("on", splitter_cls, stream)
+        identical = got == want
+        rows = _registry.get("framing_rows")
+        emitted = sum(len(g) for g in got)
+        fetch_pr = (_registry.get("framing_span_fetch_bytes")
+                    / max(rows, 1))
+        emit_pr = emitted / max(len(got), 1)
+        engaged = rows >= n
+        fetch_ok = fetch_pr < emit_pr
+        ratio = dev_rate / max(host_rate, 1)
+        any_faster |= engaged and ratio >= 1.0
+        fr_ok = identical and engaged and fetch_ok \
+            and ratio >= rate_floor
+        ok &= fr_ok
+        sections[name] = {
+            "host_pack_lines_per_sec": round(host_rate),
+            "device_framed_lines_per_sec": round(dev_rate),
+            "device_vs_host": round(ratio, 2),
+            "framing_rows": rows,
+            "span_fetch_bytes_per_row": round(fetch_pr, 1),
+            "emit_bytes_per_row": round(emit_pr, 1),
+            "byte_identical": bool(identical),
+            "ok": bool(fr_ok),
+        }
+        print(f"framing {name}: host-pack {host_rate / 1e3:.0f}K "
+              f"lines/s, device-framed {dev_rate / 1e3:.0f}K lines/s "
+              f"({ratio:.2f}x), span fetch {fetch_pr:.0f} B/row vs "
+              f"emit {emit_pr:.0f} B/row, identical={identical}",
+              file=sys.stderr)
+    if not cpu_fallback and not any_faster:
+        ok = False
+    # the deleted host stage, by component (observability satellite):
+    # slice (separator scan) + copy (arena memcpy) walls from the host
+    # runs above — on an engaged device-framing run both stay ~0
+    payload = {"metric": "framing_smoke",
+               "gate_tier": ("cpu-fallback-correctness" if cpu_fallback
+                             else "accelerator"),
+               "lines": n,
+               "device_ge_host_on_some_framing": bool(any_faster),
+               **sections, "ok": bool(ok)}
+    print(json.dumps(payload))
+    extra["framing_smoke"] = payload
+    return bool(ok)
+
+
 def smoke_main():
     """``bench.py --smoke``: the CI gate for the overlap executor.
 
@@ -1559,6 +1690,10 @@ def smoke_main():
     # block throughput >= scalar (runs BEFORE the fused section, whose
     # declined background compiles would chew the cores under it)
     newfmt_ok = bench_new_formats(extra, smoke=True)
+    # device-resident framing: byte identity vs the host splitters on
+    # all three framings + span-metadata fetch under emit bytes/row
+    # (runs before the fused section for the same clean-machine reason)
+    framing_ok = bench_framing(extra, smoke=True)
     # fused route matrix: byte-identical to the split path + fetched
     # bytes/row at or under the split path's (and under emitted)
     fused_ok = bench_fused_routes(extra, smoke=True)
@@ -1574,9 +1709,10 @@ def smoke_main():
     # host can't compile them (~40s on a 2-core box), the AOT section
     # adds ~5 cold subprocess boots + the TPU export (~80s), the fleet
     # section 6 jax-free subprocess runs (~15s), and the new-format
-    # section two foreground kernel compiles (~60s), so the smoke
-    # budget is 480s — still bounded, still CI-friendly
-    budget = 480
+    # section two foreground kernel compiles (~60s), and the framing
+    # section ~9 short e2e passes + three span-kernel compiles (~40s),
+    # so the smoke budget is 540s — still bounded, still CI-friendly
+    budget = 540
     print(json.dumps({
         "metric": "e2e_overlap_smoke",
         "e2e_lines_per_sec": serial,
@@ -1587,9 +1723,16 @@ def smoke_main():
         "multilane_vs_single_lane": round(multilane / max(overlap, 1), 2),
         "wall_seconds": round(wall, 1),
         "ok": bool(ok and lanes_ok and tenancy_ok and newfmt_ok
-                   and fused_ok and aot_ok and fleet_ok
+                   and framing_ok and fused_ok and aot_ok and fleet_ok
                    and wall < budget),
     }))
+    if not framing_ok:
+        print("SMOKE FAIL: device-framing gates missed (byte identity "
+              "vs the host splitters on line/nul/syslen, span-metadata "
+              "fetch bytes/row above emitted, or throughput below the "
+              "backend-tiered floor — see the framing_smoke JSON line)",
+              file=sys.stderr)
+        sys.exit(1)
     if not newfmt_ok:
         print("SMOKE FAIL: jsonl/dns block-route gates missed (byte "
               "identity vs the scalar pipeline, or block throughput "
@@ -1763,6 +1906,9 @@ def main():
     bench_host_scaling(lines[:65_536], extra, smoke or cpu_fallback)
     # jsonl/dns block routes (PR 10): identity + throughput vs scalar
     bench_new_formats(extra, smoke or cpu_fallback)
+    # device-resident framing (PR 12): identity + span-fetch economics
+    # + device-framed vs host-pack e2e per framing
+    bench_framing(extra, smoke or cpu_fallback)
     # fused decode→encode route matrix (before the overlap sections:
     # its eager fallback leaves no background compiles behind, but the
     # overlap section's cold device-encode shapes must still run last)

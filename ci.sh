@@ -13,7 +13,12 @@ echo "==            byte-identity contracts, exception hygiene, keys) =="
 # pure-ast, no JAX import: fails on any non-baselined FC01-FC05 finding
 python -m flowgger_tpu.analysis --format text .
 
-echo "== overlap-executor + fused-route + zero-JIT-boot smoke (<480s) =="
+echo "== BENCH series trajectory check (tools/bench_trend.py) =="
+# every BENCH_r*.json must parse into the trajectory table (the r06
+# metadata stub is allowed); a malformed new BENCH entry fails fast
+python tools/bench_trend.py --check
+
+echo "== overlap-executor + fused-route + zero-JIT-boot smoke (<540s) =="
 # asserts the in-flight submit/fetch window sustains >= the serial e2e,
 # 2-lane dispatch sustains >= 0.92x the 1-lane executor (jitter
 # tolerance for small hosts; the ratio itself is in the JSON line),
@@ -23,8 +28,11 @@ echo "== overlap-executor + fused-route + zero-JIT-boot smoke (<480s) =="
 # fetched bytes/row under emitted on every route (fused_routes line),
 # AND an artifact-booted cold subprocess performs zero fresh kernel
 # compiles with scalar-oracle-identical bytes per framing while the
-# TPU fused-route export round-trips build-only (aot_smoke line)
-JAX_PLATFORMS=cpu timeout 780 python bench.py --smoke
+# TPU fused-route export round-trips build-only (aot_smoke line),
+# AND the device-resident framing tier emits byte-identical output on
+# line/nul/syslen with span-metadata fetch bytes/row under emitted
+# (framing_smoke line; throughput gate backend-tiered)
+JAX_PLATFORMS=cpu timeout 900 python bench.py --smoke
 
 echo "== python test suite (virtual 8-device CPU mesh) =="
 # slow-marked tests are excluded here (pytest.ini tier-1 contract);
@@ -72,6 +80,18 @@ echo "== new-format decode subsystems (jsonl_tpu / dns_tpu, slow half) =="
 # half (1/2-lane identity, rescue tier, and the filtered deep fuzz
 # over both new routes: randomized lanes × framings vs the oracles)
 JAX_PLATFORMS=cpu timeout 1200 python -m pytest tests/test_tpu_jsonl.py tests/test_tpu_dns.py tests/test_cross_route_fuzz.py -q -m "slow and not faults"
+
+echo "== device-resident framing (differential vs host splitters) =="
+# span kernels + raw-session ingest vs the host splitters across
+# line/nul/syslen x adversarial chunk boundaries x 1/2 lanes, the
+# decline/breaker ladder, and the AOT framing family round trip
+JAX_PLATFORMS=cpu timeout 600 python -m pytest tests/test_framing.py -q -m "not faults"
+
+echo "== framing deep fuzz (random chunk splits vs host splitters) =="
+# random chunk sizes that split records mid-byte (incl. mid-syslen-
+# prefix and delimiters exactly on chunk edges): device spans == host
+# splitter output, e2e bytes identical across 1/2 lanes
+timeout 900 python tools/deep_fuzz.py --routes framing 1 4
 
 echo "== fault-injection suite (robustness degradation paths) =="
 JAX_PLATFORMS=cpu python -m pytest tests/ -q -m "faults and not slow"

@@ -16,10 +16,12 @@ be declared where the kernel lives so the checker — and the next reader
 
 Applies to ``tpu/device_*.py``, ``tpu/encode_*_block.py``,
 ``tpu/fused_*.py`` (the fused decode→encode route tier carries the
-same byte-identity obligation as the split kernels it composes), and
+same byte-identity obligation as the split kernels it composes),
 ``tpu/aot.py`` (an AOT-loaded exported program replaces a jit compile
 at dispatch — the swap must be byte-invisible, so the loader carries
-the contract too).  ``device_common.py`` is shared kernel
+the contract too), and ``tpu/framing.py`` (device-resident framing
+replaces the host splitters — its oracle is the host split/scan
+itself).  ``device_common.py`` is shared kernel
 infrastructure (segment engine, compile watchdog) with no route of
 its own and is exempt.
 """
@@ -33,9 +35,9 @@ from typing import Iterable, List, Optional, Tuple
 from ..core import Finding, Module, Project, Rule, register
 
 _PATTERNS = ("*tpu/device_*.py", "*tpu/encode_*_block.py",
-             "*tpu/fused_*.py", "*tpu/aot.py",
+             "*tpu/fused_*.py", "*tpu/aot.py", "*tpu/framing.py",
              "tpu/device_*.py", "tpu/encode_*_block.py",
-             "tpu/fused_*.py", "tpu/aot.py")
+             "tpu/fused_*.py", "tpu/aot.py", "tpu/framing.py")
 _EXEMPT_BASENAMES = {"device_common.py"}
 
 

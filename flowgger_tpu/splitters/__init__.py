@@ -50,6 +50,13 @@ class Handler:
     def flush(self) -> None:
         """Called at end-of-stream (and by batching handlers on timers)."""
 
+    def wants_raw(self, framing: str) -> bool:
+        """Device-resident framing (input.tpu_framing): a handler that
+        returns True gets *raw* transport chunks via a per-connection
+        session (``open_raw``) and finds record boundaries itself — the
+        splitter does zero scanning.  Default: host framing as ever."""
+        return False
+
 
 class ScalarHandler(Handler):
     """Reference-exact per-line path: utf-8 validate → decode → encode →
@@ -175,6 +182,52 @@ def _read_stream(stream):
         yield chunk
 
 
+def _run_raw_sep(stream, handler: Handler, framing: str) -> None:
+    """Device-framing fast path for line/nul: hand every raw chunk to
+    the handler's per-connection session untouched (record boundaries —
+    including the carry for records split across chunk edges — resolve
+    on device, or on the handler's host fallback).  EOF semantics match
+    the host path: the session's ``finish`` emits a trailing partial
+    frame exactly like ``_run_chunked``."""
+    sess = handler.open_raw(framing)
+    for chunk in _read_stream(stream):
+        if not sess.push(chunk):
+            break
+    sess.finish()
+    handler.flush()
+
+
+def _run_raw_syslen(stream, handler: Handler) -> None:
+    """Device-framing fast path for syslen framing: raw chunks to the
+    session; the octet-count scan happens on device (host scan on
+    decline).  Stderr parity with ``SyslenSplitter._run_spans``: idle
+    and EOF leftovers print the same messages (ordering may differ by
+    one flush — the messages come from the session, which owns the
+    carry)."""
+    sess = handler.open_raw("syslen")
+    while True:
+        try:
+            chunk = stream.read(_CHUNK)
+        except TimeoutError:
+            sess.finish(idle=True)
+            return
+        except OSError:
+            chunk = b""
+        if not chunk:
+            break
+        if not sess.push(chunk):
+            # mid-stream framing error: the session printed the host
+            # scan's message and went dead — close like the host path.
+            # finish() still runs so the dead session unregisters from
+            # the handler (it prints nothing more); without it every
+            # errored connection would leak one session entry.
+            sess.finish()
+            handler.flush()
+            return
+    sess.finish()
+    handler.flush()
+
+
 def _read_chunks_split(stream, handler: Handler, sep: bytes, strip_cr: bool):
     """Shared chunked scan for line/nul framing: bulk ``bytes.split`` per
     chunk (C speed) instead of the reference's per-byte BufRead loop."""
@@ -195,7 +248,9 @@ class LineSplitter(Splitter):
     """
 
     def run(self, stream, handler: Handler) -> None:
-        if hasattr(handler, "ingest_chunk"):
+        if handler.wants_raw("line"):
+            _run_raw_sep(stream, handler, "line")
+        elif hasattr(handler, "ingest_chunk"):
             self._run_chunked(stream, handler)
         else:
             _read_chunks_split(stream, handler, b"\n", strip_cr=True)
@@ -229,7 +284,9 @@ class NulSplitter(Splitter):
 
     def run(self, stream, handler: Handler) -> None:
         handler.quiet_empty = True
-        if hasattr(handler, "ingest_chunk"):
+        if handler.wants_raw("nul"):
+            _run_raw_sep(stream, handler, "nul")
+        elif hasattr(handler, "ingest_chunk"):
             LineSplitter._run_chunked(stream, handler, b"\0", strip_cr=False)
         else:
             _read_chunks_split(stream, handler, b"\0", strip_cr=False)
@@ -283,6 +340,9 @@ class SyslenSplitter(Splitter):
     """
 
     def run(self, stream, handler: Handler) -> None:
+        if handler.wants_raw("syslen"):
+            _run_raw_syslen(stream, handler)
+            return
         if hasattr(handler, "ingest_spans"):
             self._run_spans(stream, handler)
             return

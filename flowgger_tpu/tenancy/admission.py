@@ -143,7 +143,14 @@ class AdmissionHandler(Handler):
     tenant, charges admission, forwards admitted input to the shared
     inner handler.  Exposes ``ingest_chunk``/``ingest_spans`` only when
     the inner handler does, so splitter fast-path dispatch (hasattr
-    checks) is unchanged."""
+    checks) is unchanged.
+
+    Device-resident framing (``wants_raw``) deliberately stays at the
+    base False here even when the inner handler engages it: admission
+    drops whole delivery units, and a dropped *raw* chunk (which can
+    end mid-record) would splice the surrounding records together —
+    host framing keeps the drop unit record-aligned, so tenancy-
+    admitted connections pin the host splitters."""
 
     def __init__(self, inner: Handler, tenant: TenantState):
         self._inner = inner

@@ -74,7 +74,13 @@ FUSED_ROUTES = ("rfc5424_gelf", "rfc3164_gelf", "ltsv_gelf", "gelf_gelf")
 # framing name -> block merger suffix; syslen shares "line"'s b"\n"
 # (block_common.merger_suffix: the syslen prefix is a host-side splice)
 FRAMINGS = {"line": b"\n", "nul": b"\x00"}
-FAMILIES = ("decode", "fused", "encode")
+FAMILIES = ("decode", "fused", "encode", "framing")
+# device-resident framing (tpu/framing.py): stage-A span kernels per
+# input framing plus the shared stage-B gather
+FRAMING_KINDS = ("line", "nul", "syslen")
+# the byte-bucket each row bucket's framing artifact assumes (~128 B
+# average records); other region sizes decline to the JIT ladder
+FRAMING_AVG_BYTES = 128
 
 # the active store is module state with the same contract as
 # pack._SHAPE_BUCKETS: only an explicit config key (input.tpu_aot_dir /
@@ -230,6 +236,24 @@ def fused_statics(route_name: str, suffix: bytes, impl: str,
 
         statics["max_sd"] = DEFAULT_MAX_SD
     return statics
+
+
+def framing_statics(kind: str, ncap: int, region_bytes: int) -> Dict:
+    """Static-arg recipe for one framing stage-A kernel (kind in
+    FRAMING_KINDS) or the stage-B gather (kind="gather", where ``ncap``
+    carries max_len).  ONE definition shared by the builder and
+    ``framing_call``'s call sites in tpu/framing.py."""
+    if kind == "line":
+        return {"sep": 10, "strip_cr": True, "ncap": ncap}
+    if kind == "nul":
+        return {"sep": 0, "strip_cr": False, "ncap": ncap}
+    if kind == "syslen":
+        from .framing import syslen_hops
+
+        return {"ncap": ncap, "max_hops": syslen_hops(region_bytes)}
+    if kind == "gather":
+        return {"max_len": ncap}
+    raise ValueError(f"unknown framing kind {kind!r}")
 
 
 def encode_statics(module: str, suffix: bytes, impl: str,
@@ -619,6 +643,30 @@ def decode_call(fmt: str, args, statics: Optional[Dict] = None
     return out
 
 
+def framing_call(kind: str, args, statics: Dict):
+    """AOT lookup for one framing kernel call (stage-A spans for a
+    framing in FRAMING_KINDS, or kind="gather" for stage B): the
+    exported program's output, or None → the caller runs its jit under
+    the framing watchdog slot as before.  Same decline contract as
+    decode_call: a call error rejects the entry and falls back, never
+    losing the region."""
+    store = active_store()
+    if store is None:
+        return None
+    call = store.find(f"framing_{kind}", dict(statics), args)
+    if call is None:
+        return None
+    try:
+        out = call(*args)
+    except Exception as e:  # noqa: BLE001 - decline to JIT, never lose the region
+        key = entry_key(f"framing_{kind}", store._platform(),
+                        dict(statics), args_spec(args))
+        store.reject_entry(key, "call_error", f"{type(e).__name__}: {e}")
+        return None
+    _metrics().inc("aot_hits")
+    return out
+
+
 def wrap_kernel(family: str, kernel, args, statics: Dict):
     """Wrap a device-encode/fused kernel closure (``kernel(ts_text,
     ts_len, assemble)``) so each call consults the store first and
@@ -857,6 +905,21 @@ def _encode_fn(module: str, statics: Dict):
         b, ln, dec, ts, tl, **kw)
 
 
+def _framing_fn(kind: str, statics: Dict):
+    """Builder-side callable for one framing kernel (the loader half is
+    ``framing_call``)."""
+    from . import framing as _framing
+
+    if kind == "gather":
+        return lambda region, starts, lens: _framing.frame_gather_jit(
+            region, starts, lens, **statics)
+    if kind == "syslen":
+        return lambda region, rlen: _framing.frame_syslen_spans_jit(
+            region, rlen, **statics)
+    return lambda region, rlen: _framing.frame_sep_spans_jit(
+        region, rlen, **statics)
+
+
 def _export_one(fn, example_args, platform: str):
     import jax
     from jax import export as jexport
@@ -986,6 +1049,27 @@ def build_artifacts(out_dir: str, platforms=("cpu",),
                                       rows, route_name,
                                       _fused_fn(route_name, statics),
                                       args, statics)
+            if "framing" in families:
+                # device-resident framing: one stage-A span kernel per
+                # framing kind + the shared stage-B gather, at this row
+                # bucket's assumed byte bucket (~FRAMING_AVG_BYTES per
+                # record; other region sizes hit the JIT ladder).  The
+                # kernels are small (cumsum/scatter/gather planes), so
+                # the full enumeration stays cheap to export.
+                from .framing import region_bucket
+
+                rb = region_bucket(rows * FRAMING_AVG_BYTES)
+                reg = jax.ShapeDtypeStruct((rb,), jnp.uint8)
+                rl = jax.ShapeDtypeStruct((), jnp.int32)
+                for kind in FRAMING_KINDS:
+                    fst = framing_statics(kind, rows, rb)
+                    add_entry(f"framing_{kind}", platform, rows, kind,
+                              _framing_fn(kind, fst), (reg, rl), fst)
+                gst = framing_statics("gather", max_len, rb)
+                sl = jax.ShapeDtypeStruct((rows,), jnp.int32)
+                add_entry("framing_gather", platform, rows, "gather",
+                          _framing_fn("gather", gst), (reg, sl, sl),
+                          gst)
             if "encode" in families:
                 for fmt in formats:
                     module = _ENCODE_MODULE_FOR_FMT.get(fmt)

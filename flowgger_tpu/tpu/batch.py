@@ -293,6 +293,50 @@ class BatchHandler(Handler):
                     "fused program for the route, template mining on, "
                     "or a sharded mesh owns the format); using the "
                     "split decode/encode path", file=sys.stderr)
+        # device-resident framing (tpu/framing.py): "auto" lifts the
+        # record-boundary scan + arena pack onto the accelerator
+        # whenever the columnar block route is engaged on a non-CPU
+        # backend (the mesh/lanes "auto" precedent; "on" also engages
+        # on the CPU backend — tests/benches; "off" pins the host
+        # splitters).  Raw transport chunks then reach this handler
+        # through per-connection _RawSession objects instead of
+        # pre-framed regions, and the splitter does zero scanning.
+        from .framing import FramingEconomics
+
+        self._framing_mode = cfg.lookup_str(
+            "input.tpu_framing", "input.tpu_framing must be a string",
+            "auto")
+        if self._framing_mode not in ("auto", "on", "off"):
+            from ..config import ConfigError
+
+            raise ConfigError("input.tpu_framing must be auto, on or off")
+        self._framing_econ = FramingEconomics.from_config(cfg)
+        self._raw_sessions: List = []
+        self._raw_est = 0
+        framing_engaged = False
+        if (self._framing_mode != "off" and self._block_mode
+                and self.fmt != "auto" and self._kernel_fn is not None
+                and self._block_route_ok()):
+            if self._framing_mode == "on":
+                framing_engaged = True
+            else:
+                import jax
+
+                framing_engaged = jax.default_backend() != "cpu"
+        if framing_engaged and self._sharded_for(self.fmt) is not None:
+            # the sharded mesh owns this format's batches (it re-shards
+            # host arrays across every chip); framing's lane-committed
+            # device arrays would fight that placement
+            framing_engaged = False
+        self._framing_engaged = framing_engaged
+        if (self._framing_mode == "on" and not framing_engaged
+                and self._block_mode):
+            print(
+                'flowgger-tpu: input.tpu_framing = "on" but this config '
+                f"cannot device-frame format '{fmt}' (the columnar "
+                "block route is disabled, auto format, or a sharded "
+                "mesh owns the format); using the host splitters",
+                file=sys.stderr)
         # background kernel prewarm: compile the configured format's
         # decode (+ engaged device-encode) kernels for the shape-bucket
         # grid now, so the first real batch of each steady-state shape
@@ -374,8 +418,24 @@ class BatchHandler(Handler):
         if full:
             self.flush(drain=False)
 
+    def wants_raw(self, framing: str) -> bool:
+        """Device framing engaged for this framing: the splitter hands
+        raw chunks via ``open_raw`` and does zero scanning."""
+        return (self._framing_engaged
+                and framing in ("line", "nul", "syslen"))
+
+    def open_raw(self, framing: str):
+        """One per-connection raw-framing session (the RegionBuffer):
+        accumulates raw transport chunks and the carry-over tail for
+        records split across chunk boundaries; framed at flush."""
+        sess = _RawSession(self, framing)
+        with self._lock:
+            self._raw_sessions.append(sess)
+        return sess
+
     def _pending_locked(self) -> int:
-        return self._chunk_lines + self._span_count + len(self._lines)
+        return (self._chunk_lines + self._span_count + len(self._lines)
+                + self._raw_est)
 
     def handle_bytes(self, raw: bytes) -> None:
         tag = _tenancy.current_name()
@@ -423,6 +483,25 @@ class BatchHandler(Handler):
 
             t0 = _time.perf_counter()
             n0 = _metrics.get("input_lines")
+            if self._raw_sessions:
+                # raw-framing sessions snapshot *inside* the decode
+                # lock: region assembly chains each session's carry
+                # across flushes, so snapshot order must equal
+                # processing order no matter which thread flushes
+                with self._lock:
+                    raw = [(s, s.chunks) for s in self._raw_sessions
+                           if s.chunks]
+                    for s, _ch in raw:
+                        s.chunks = []
+                        s.nbytes = 0
+                        self._raw_est -= s.est
+                        s.est = 0
+                for s, ch in raw:
+                    self._decode_raw(s, ch)
+                with self._lock:
+                    carry_total = sum(len(s.carry)
+                                      for s in self._raw_sessions)
+                _metrics.set_gauge("framing_carry_bytes", carry_total)
             if chunks:
                 self._decode_chunks(chunks, chunk_runs or None)
             if spans[0]:
@@ -535,13 +614,164 @@ class BatchHandler(Handler):
         self._guarded_dispatch(pack.pack_spans_2d(span_chunks, span_sets,
                                                   self.max_len), runs)
 
-    def _dispatch_packed(self, packed, deferred=None, runs=None) -> None:
+    # -- device-resident framing (raw sessions) ----------------------------
+    def _decode_raw(self, sess, chunks) -> None:
+        """Frame one session's pending raw bytes: device framing when
+        the tier is engaged/healthy/economical, else the host splitter
+        logic applied at flush — same records, same order, either way.
+        The carry-over tail (a record split across chunk or flush
+        boundaries) stays in the session."""
+        region = sess.carry + b"".join(chunks) if sess.carry \
+            else b"".join(chunks)
+        sess.carry = b""
+        if not region or sess.dead:
+            return
+        runs_tag = None
+        if self._miners is not None or sess.tag is not None:
+            runs_tag = sess.tag or _tenancy.DEFAULT_TENANT
+        from . import framing as _framing
+
+        state = _framing.cooldown_state(self._device_route_state,
+                                        sess.framing)
+        breaker_open = not self._device_allowed()
+        use_device = (not breaker_open
+                      and not _framing.in_cooldown(state)
+                      and self._framing_econ.allow_framing())
+        if sess.framing == "syslen":
+            self._decode_raw_syslen(sess, region, state, use_device,
+                                    breaker_open, runs_tag)
+        else:
+            self._decode_raw_sep(sess, region, state, use_device,
+                                 breaker_open, runs_tag)
+
+    def _decode_raw_sep(self, sess, region, state, use_device,
+                        breaker_open, runs_tag) -> None:
+        import time as _time
+
+        from . import framing as _framing
+
+        sep = sess.sep
+        cut = region.rfind(sep)
+        if cut < 0:
+            sess.carry = region
+            return
+        framed, sess.carry = region[:cut + 1], region[cut + 1:]
+        n = framed.count(sep)
+        runs = [(runs_tag, n)] if runs_tag is not None else None
+        if breaker_open:
+            # breaker-open scalar oracle, same bytes (fence first so
+            # older device batches keep their place)
+            self._window.fence()
+            self._scalar_raw_lines(framed, sep, sess.framing == "line")
+            return
+        if use_device:
+            lane = self._window.next_lane()
+            t0 = _time.perf_counter()
+            try:
+                _faults.maybe_raise("device_decode")
+                packed, _consumed, _err = _framing.device_frame_region(
+                    framed, sess.framing, self.max_len, n_records=n,
+                    device=self._lane_devices[lane])
+            except _framing.FramingDeclined:
+                _framing.note_decline(state)
+            except Exception as e:  # noqa: BLE001 - device degradation boundary
+                if self._breaker is None:
+                    raise
+                self._device_failed(e)
+            else:
+                _framing.note_success(state)
+                self._framing_econ.observe(
+                    "framing", n, _time.perf_counter() - t0)
+                self._guarded_dispatch(packed, runs, lane=lane)
+                return
+        from . import pack
+
+        t0 = _time.perf_counter()
+        packed = pack.pack_region_2d(framed, self.max_len, sep=sep[0],
+                                     strip_cr=sess.framing == "line")
+        self._framing_econ.observe("hostpack", n,
+                                   _time.perf_counter() - t0)
+        self._guarded_dispatch(packed, runs)
+
+    def _decode_raw_syslen(self, sess, region, state, use_device,
+                           breaker_open, runs_tag) -> None:
+        import time as _time
+
+        from ..splitters import _scan_syslen_region
+        from . import framing as _framing
+
+        if use_device and not breaker_open:
+            lane = self._window.next_lane()
+            t0 = _time.perf_counter()
+            try:
+                _faults.maybe_raise("device_decode")
+                packed, consumed, err = _framing.device_frame_region(
+                    region, "syslen", self.max_len,
+                    n_records=max(region.count(b" "), 1),
+                    device=self._lane_devices[lane])
+            except _framing.FramingDeclined:
+                _framing.note_decline(state)
+            except Exception as e:  # noqa: BLE001 - device degradation boundary
+                if self._breaker is None:
+                    raise
+                self._device_failed(e)
+            else:
+                _framing.note_success(state)
+                n = packed[5]
+                if n:
+                    self._framing_econ.observe(
+                        "framing", n, _time.perf_counter() - t0)
+                    runs = ([(runs_tag, n)] if runs_tag is not None
+                            else None)
+                    self._guarded_dispatch(packed, runs, lane=lane)
+                self._finish_raw_syslen(sess, region, consumed, err)
+                return
+        t0 = _time.perf_counter()
+        starts, lens, n, consumed, err = _scan_syslen_region(region)
+        if breaker_open:
+            self._window.fence()
+            for s, ln in zip(starts.tolist(), lens.tolist()):
+                self._scalar_handle(region[s:s + ln])
+            self._finish_raw_syslen(sess, region, consumed, err)
+            return
+        if n:
+            from . import pack
+
+            packed = pack.pack_spans_2d([region[:consumed]],
+                                        [(starts, lens)], self.max_len)
+            self._framing_econ.observe("hostpack", n,
+                                       _time.perf_counter() - t0)
+            runs = [(runs_tag, n)] if runs_tag is not None else None
+            self._guarded_dispatch(packed, runs)
+        self._finish_raw_syslen(sess, region, consumed, err)
+
+    def _finish_raw_syslen(self, sess, region, consumed, err) -> None:
+        sess.carry = region[consumed:]
+        if err:
+            # host-scan parity: a malformed length prefix ends the
+            # connection (the session goes dead; the splitter's next
+            # push sees it and closes the stream like the host path)
+            print("Can't read message's length", file=sys.stderr)
+            sess.dead = True
+            sess.carry = b""
+
+    def _scalar_raw_lines(self, framed: bytes, sep: bytes,
+                          strip_cr: bool) -> None:
+        lines = framed.split(sep)
+        lines.pop()  # framed regions end with the separator
+        for raw in lines:
+            if strip_cr and raw.endswith(b"\r"):
+                raw = raw[:-1]
+            self._scalar_handle(raw)
+
+    def _dispatch_packed(self, packed, deferred=None, runs=None,
+                         lane=None) -> None:
         """Route one packed tuple through the right decode/encode tier.
         ``deferred`` (single-element list) is set True when the batch
         was submitted to the in-flight window instead of emitted
         synchronously."""
         if self._fast_encode:
-            self._emit_fast(packed, deferred, runs)
+            self._emit_fast(packed, deferred, runs, lane)
             return
         if self.fmt == "auto":
             from .autodetect import decode_auto_packed
@@ -598,14 +828,15 @@ class BatchHandler(Handler):
         if self._breaker is not None and self._window.pending() == 0:
             self._breaker.record_success()
 
-    def _guarded_dispatch(self, packed, runs=None) -> None:
+    def _guarded_dispatch(self, packed, runs=None, lane=None) -> None:
         """Route one packed tuple to the device tier, degrading to the
         scalar oracle (same bytes, no lines lost) on any device/XLA
-        error when the breaker is armed."""
+        error when the breaker is armed.  ``lane`` pins the dispatch
+        lane (device framing already committed the batch there)."""
         deferred = [False]
         try:
             _faults.maybe_raise("device_decode")
-            self._dispatch_packed(packed, deferred, runs)
+            self._dispatch_packed(packed, deferred, runs, lane)
         except Exception as e:  # noqa: BLE001 - device degradation boundary
             if self._breaker is None:
                 raise
@@ -861,16 +1092,20 @@ class BatchHandler(Handler):
             self.fmt, self.encoder, self._merger,
             self.scalar.decoder if self.fmt == "ltsv" else None)
 
-    def _emit_fast(self, packed, deferred=None, runs=None) -> None:
+    def _emit_fast(self, packed, deferred=None, runs=None,
+                   lane=None) -> None:
         """Span→bytes encode for one packed tuple: the columnar block
         route when engaged (submitted onto the next dispatch lane; that
         lane's fetcher thread fetches and encodes behind us, and the
         LaneSet sequencer emits in strict batch order), else the per-row
-        fast path (gelf/passthrough only), else the Record path."""
+        fast path (gelf/passthrough only), else the Record path.
+        ``lane`` (device framing) reuses an already-reserved lane whose
+        device holds the batch."""
         if self._block_route_ok():
             if deferred is not None:
                 deferred[0] = True
-            lane = self._window.next_lane()
+            if lane is None:
+                lane = self._window.next_lane()
             if len(self._lane_devices) > 1:
                 _metrics.inc(f"lane{lane}_rows", int(packed[5]))
             if self.fmt == "auto":
@@ -1191,6 +1426,99 @@ class BatchHandler(Handler):
                 # the row's own tenant tag, not the flusher's
                 _tenancy.set_current(expanded[i])
             self.tx.put(encoded)
+
+
+# bound on a single session's buffered region (bytes) before a flush is
+# forced regardless of the record estimate — keeps a no-separator flood
+# (or a giant syslen body) from growing the RegionBuffer unboundedly
+_RAW_REGION_CAP = 4 << 20
+
+
+class _RawSession:
+    """Per-connection RegionBuffer for device-resident framing.
+
+    One splitter ``run`` (one connection/stream) owns one session: raw
+    chunks accumulate here untouched, the handler frames them at flush
+    (device kernel or host fallback), and the carry-over tail — a
+    record split across a chunk or flush boundary — stays in the
+    session between flushes.  ``tag`` pins the whole session to the
+    connection's tenant (one stream = one tenant), so per-row run
+    attribution is exact without per-chunk record counts.
+
+    ``est`` is the pending-record estimate driving the batch-size
+    flush trigger: exact for line/nul (one memchr-speed separator
+    count per chunk), an upper bound for syslen (each frame consumes
+    at least one space).
+    """
+
+    def __init__(self, handler, framing: str):
+        self.handler = handler
+        self.framing = framing
+        self.sep = b"\0" if framing == "nul" else b"\n"
+        self.carry = b""
+        self.chunks: List[bytes] = []
+        self.est = 0
+        self.nbytes = 0
+        self.dead = False
+        self.tag = _tenancy.current_name()
+
+    def push(self, chunk: bytes) -> bool:
+        """Buffer one raw chunk; returns False when the session died
+        (a mid-stream framing error — the splitter closes the stream
+        like the host scan does)."""
+        if self.dead:
+            return False
+        h = self.handler
+        est = chunk.count(b" " if self.framing == "syslen" else self.sep)
+        with h._lock:
+            self.chunks.append(chunk)
+            self.nbytes += len(chunk)
+            self.est += est
+            h._raw_est += est
+            full = (h._pending_locked() >= h.batch_size
+                    or self.nbytes + len(self.carry) >= _RAW_REGION_CAP)
+            if not full and h._timer is None and h._start_timer:
+                h._timer = threading.Timer(h.flush_ms / 1000.0, h.flush)
+                h._timer.daemon = True
+                h._timer.start()
+        if full:
+            h.flush(drain=False)
+        return not self.dead
+
+    def finish(self, idle: bool = False) -> None:
+        """End of stream: flush pending data, then resolve the carry
+        with the host splitters' exact EOF semantics — line/nul emit a
+        trailing partial frame (BufRead::lines parity), syslen prints
+        the host scan's short-read / bad-length message."""
+        h = self.handler
+        h.flush(drain=True)
+        with h._lock:
+            carry, self.carry = self.carry, b""
+            if self in h._raw_sessions:
+                h._raw_sessions.remove(self)
+        if self.dead:
+            return
+        if self.framing == "syslen":
+            from ..splitters import SyslenSplitter
+
+            # stderr parity with SyslenSplitter._run_spans: a carry
+            # mid-body is a short read; an idle timeout outside a body
+            # (even with a partial length prefix buffered) closes
+            # quietly; only a hard EOF on a non-body carry is a
+            # bad-length error
+            if carry and SyslenSplitter._mid_body(carry):
+                print("failed to fill whole buffer", file=sys.stderr)
+            elif idle:
+                print(
+                    "Client hasn't sent any data for a while - Closing "
+                    "idle connection", file=sys.stderr)
+            elif carry:
+                print("Can't read message's length", file=sys.stderr)
+            return
+        if carry:
+            if self.framing == "line" and carry.endswith(b"\r"):
+                carry = carry[:-1]
+            h.handle_bytes(carry)
 
 
 def block_submit(fmt, packed, sharded=None, device=None):

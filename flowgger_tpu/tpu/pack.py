@@ -180,11 +180,26 @@ def _pack_dense(chunk: bytes, starts: np.ndarray, lens: np.ndarray,
     return batch, lens_p
 
 
+def _note_stage(name: str, seconds: float) -> None:
+    """Host pack-stage walls, split so the device-framing tier's win
+    is visible per component: ``pack_slice_seconds`` (separator scan /
+    span assembly) + ``pack_copy_seconds`` (dense arena memcpy) sum to
+    ``pack_stage_seconds`` — the host stage device framing deletes."""
+    from ..utils.metrics import registry as _metrics
+
+    _metrics.add_seconds(name, seconds)
+    _metrics.add_seconds("pack_stage_seconds", seconds)
+
+
 def _finish(chunk: bytes, starts: np.ndarray, lens: np.ndarray, n: int,
             max_len: int):
+    import time as _time
+
     np_rows = bucket_rows(n)
     _note_shape(np_rows, max_len)
+    t0 = _time.perf_counter()
     batch, lens_p = _pack_dense(chunk, starts, lens, max_len, np_rows)
+    _note_stage("pack_copy_seconds", _time.perf_counter() - t0)
     starts_p = np.zeros(np_rows, dtype=np.int32)
     starts_p[:n] = starts
     return batch, lens_p, chunk, starts_p, np.asarray(lens, dtype=np.int32), n
@@ -194,12 +209,16 @@ def pack_lines_2d(lines: List[bytes], max_len: int):
     """Pack a list of framed lines.  Returns
     (batch, clipped_lens, chunk, starts, orig_lens, n_real) with row
     count bucketed to a power of two."""
+    import time as _time
+
+    t0 = _time.perf_counter()
     n = len(lines)
     chunk = b"".join(lines)
     orig_lens = np.fromiter((len(ln) for ln in lines), dtype=np.int32, count=n)
     starts = np.zeros(n, dtype=np.int32)
     if n > 1:
         np.cumsum(orig_lens[:-1], out=starts[1:])
+    _note_stage("pack_slice_seconds", _time.perf_counter() - t0)
     return _finish(chunk, starts, orig_lens, n, max_len)
 
 
@@ -208,7 +227,11 @@ def pack_region_2d(region: bytes, max_len: int, sep: int = 10,
     """Pack a region of complete separator-terminated messages straight
     into a dense batch — the zero-per-line-Python fast path.  Same
     return contract as pack_lines_2d."""
+    import time as _time
+
+    t0 = _time.perf_counter()
     starts, lens, n, _carry = _split(region, strip_cr, sep)
+    _note_stage("pack_slice_seconds", _time.perf_counter() - t0)
     return _finish(region, starts, lens, n, max_len)
 
 
@@ -217,6 +240,9 @@ def pack_spans_2d(chunks: List[bytes], span_sets: List[Tuple[np.ndarray, np.ndar
     """Pack pre-framed spans (syslen framing: the scanner already knows
     every message's offset/length) from one or more chunk fragments.
     Same return contract as pack_lines_2d."""
+    import time as _time
+
+    t0 = _time.perf_counter()
     if len(chunks) == 1:
         chunk = chunks[0]
         starts, lens = span_sets[0]
@@ -228,6 +254,7 @@ def pack_spans_2d(chunks: List[bytes], span_sets: List[Tuple[np.ndarray, np.ndar
             if span_sets else np.zeros(0, np.int32)
         lens = np.concatenate([l for _, l in span_sets]) \
             if span_sets else np.zeros(0, np.int32)
+    _note_stage("pack_slice_seconds", _time.perf_counter() - t0)
     return _finish(chunk, np.asarray(starts, dtype=np.int32),
                    np.asarray(lens, dtype=np.int32), len(starts), max_len)
 

@@ -1,0 +1,194 @@
+#!/usr/bin/env python3
+"""Aggregate the heterogeneous BENCH_r01..rNN series into one
+trajectory table.
+
+Every PR's bench snapshot has its own schema (r01 is a raw
+``{parsed: {metric, value}}`` capture, r04+ carry fetch/emit bytes per
+row, r07+ nest per-route sections, r06 is an explicitly backfilled
+metadata stub) — this tool walks whatever shape each file has and
+extracts the comparable axes:
+
+- headline throughput: every numeric ``*lines_per_sec*`` leaf (the max
+  is the headline; the count shows how broad the snapshot is);
+- memory-bandwidth economics: ``*fetch_bytes_per_row*`` vs
+  ``*emit/out_bytes_per_row*`` leaves;
+- gate posture: every boolean ``ok`` leaf plus any ``gate``/``tier``
+  strings (the fleet/new-format gates are backend-tiered; the tier is
+  part of the result).
+
+``--check`` is the CI mode: exit 2 when any BENCH file is unparseable,
+not a JSON object, or (unless it is a marked backfill stub) carries no
+recognizable metric at all — so a malformed new BENCH entry fails fast
+instead of silently breaking the series.  ``--json`` emits the rows as
+one machine-readable line.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import sys
+
+_NUM = (int, float)
+
+
+def _walk(obj, prefix=""):
+    if isinstance(obj, dict):
+        for k, v in obj.items():
+            yield from _walk(v, f"{prefix}{k}.")
+    elif isinstance(obj, list):
+        for i, v in enumerate(obj):
+            yield from _walk(v, f"{prefix}{i}.")
+    else:
+        yield prefix[:-1], obj
+
+
+def extract(doc: dict) -> dict:
+    """The comparable axes of one BENCH document (see module doc)."""
+    lps = {}
+    fetch = {}
+    emit = {}
+    gates = {}
+    tiers = {}
+    for path, val in _walk(doc):
+        leaf = path.rsplit(".", 1)[-1]
+        if isinstance(val, bool):
+            if leaf == "ok":
+                gates[path] = val
+            continue
+        if isinstance(val, _NUM):
+            if "lines_per_sec" in leaf:
+                lps[path] = float(val)
+            elif "fetch_bytes_per_row" in leaf:
+                fetch[path] = float(val)
+            elif re.search(r"(emit|out)_bytes_per_row", leaf):
+                emit[path] = float(val)
+        elif isinstance(val, str):
+            if leaf in ("gate", "tier", "gate_tier", "backend"):
+                tiers[path] = val
+    # r01-style raw capture: {parsed: {metric, value}}
+    parsed = doc.get("parsed")
+    if not lps and isinstance(parsed, dict):
+        val = parsed.get("value")
+        if isinstance(val, _NUM) and isinstance(parsed.get("metric"),
+                                                str):
+            lps[f"parsed.{parsed['metric']}"] = float(val)
+    return {
+        "pr": doc.get("pr"),
+        "stub": doc.get("backfilled_in_pr"),
+        "lines_per_sec": lps,
+        "fetch_bytes_per_row": fetch,
+        "emit_bytes_per_row": emit,
+        "gates": gates,
+        "tiers": tiers,
+    }
+
+
+def load_series(root: str):
+    """[(name, doc-or-None, error-or-None)] for every BENCH_r*.json in
+    numeric order."""
+    paths = glob.glob(os.path.join(root, "BENCH_r*.json"))
+
+    def rnum(p):
+        m = re.search(r"BENCH_r(\d+)\.json$", p)
+        return int(m.group(1)) if m else 1 << 30
+
+    out = []
+    for path in sorted(paths, key=rnum):
+        name = os.path.basename(path)
+        try:
+            with open(path, "rb") as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as e:
+            out.append((name, None, f"unreadable: {e}"))
+            continue
+        if not isinstance(doc, dict):
+            out.append((name, None, "not a JSON object"))
+            continue
+        out.append((name, doc, None))
+    return out
+
+
+def check(rows) -> list:
+    """Malformed-entry findings for --check (empty = series healthy)."""
+    bad = []
+    if not rows:
+        return ["no BENCH_r*.json files found"]
+    for name, doc, err in rows:
+        if err is not None:
+            bad.append(f"{name}: {err}")
+            continue
+        ex = extract(doc)
+        if ex["stub"] is not None:
+            continue  # marked backfill stub: metadata-only is fine
+        if not (ex["lines_per_sec"] or ex["gates"]
+                or ex["fetch_bytes_per_row"]):
+            bad.append(
+                f"{name}: no recognizable metric (no *lines_per_sec*, "
+                "ok gate, or *bytes_per_row leaf; stubs must carry "
+                "backfilled_in_pr)")
+    return bad
+
+
+def table(rows) -> str:
+    out = ["entry       pr  headline lines/s  (n)  fetch/emit B/row   "
+           "gates      tier"]
+    for name, doc, err in rows:
+        if err is not None:
+            out.append(f"{name:<11} --  MALFORMED: {err}")
+            continue
+        ex = extract(doc)
+        lps = ex["lines_per_sec"]
+        head = f"{max(lps.values()):>16,.0f}" if lps else " " * 16
+        fetch = ex["fetch_bytes_per_row"]
+        emit = ex["emit_bytes_per_row"]
+        fe = ""
+        if fetch and emit:
+            fe = f"{min(fetch.values()):.0f}/{max(emit.values()):.0f}"
+        gates = ex["gates"]
+        gstr = (f"{sum(gates.values())}/{len(gates)} ok" if gates
+                else "")
+        tier = next(iter(ex["tiers"].values()), "")
+        stub = f" [stub: backfilled in PR {ex['stub']}]" \
+            if ex["stub"] is not None else ""
+        pr = ex["pr"] if ex["pr"] is not None else "--"
+        out.append(f"{name:<11} {pr!s:>2} {head} ({len(lps):>2})  "
+                   f"{fe:<17}  {gstr:<9}  {tier}{stub}")
+    return "\n".join(out)
+
+
+def main(argv) -> int:
+    root = "."
+    args = [a for a in argv if not a.startswith("--")]
+    if args:
+        root = args[0]
+    rows = load_series(root)
+    bad = check(rows)
+    if "--check" in argv:
+        if bad:
+            for b in bad:
+                print(f"bench_trend: {b}", file=sys.stderr)
+            return 2
+        print(f"bench_trend: {len(rows)} BENCH entries parse clean")
+        return 0
+    if "--json" in argv:
+        payload = []
+        for name, doc, err in rows:
+            entry = {"entry": name, "error": err}
+            if doc is not None:
+                entry.update(extract(doc))
+            payload.append(entry)
+        print(json.dumps(payload))
+        return 0
+    print(table(rows))
+    if bad:
+        for b in bad:
+            print(f"bench_trend: {b}", file=sys.stderr)
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

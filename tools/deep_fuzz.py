@@ -3,6 +3,7 @@
 
 Usage: python tools/deep_fuzz.py [seed] [trials]
        python tools/deep_fuzz.py --routes fused [seed] [trials]
+       python tools/deep_fuzz.py --routes framing [seed] [trials]
        python tools/deep_fuzz.py --routes jsonl,dns [seed] [trials]
 Prints per-route mismatches (none expected) and a FAILURES count.
 A bounded version runs in CI as tests/test_cross_route_fuzz.py.
@@ -24,22 +25,26 @@ import os, queue, random, re, sys, time
 os.environ["JAX_PLATFORMS"] = "cpu"
 
 FUSED_MODE = False
+FRAMING_MODE = False
 ROUTE_FILTER = None
 if "--routes" in sys.argv:
     i = sys.argv.index("--routes")
     if i + 1 >= len(sys.argv):
-        print("--routes takes a value: fused, or a comma-separated "
-              "format list (e.g. jsonl,dns)", file=sys.stderr)
+        print("--routes takes a value: fused, framing, or a comma-"
+              "separated format list (e.g. jsonl,dns)", file=sys.stderr)
         sys.exit(2)
     val = sys.argv[i + 1]
     del sys.argv[i:i + 2]
     if val == "fused":
         FUSED_MODE = True
+    elif val == "framing":
+        FRAMING_MODE = True
     else:
         ROUTE_FILTER = set(val.split(","))
 
-if FUSED_MODE:
-    # fused mode runs the programs eagerly (disable_jit below): inline
+if FUSED_MODE or FRAMING_MODE:
+    # fused/framing modes never touch the device-encode compiles (the
+    # routes they exercise have no device-encode tier engaged): inline
     # guarded calls can never hang, so the watchdog comes off entirely
     os.environ["FLOWGGER_COMPILE_TIMEOUT_MS"] = "0"
     os.environ["FLOWGGER_FUSED_COMPILE_TIMEOUT_MS"] = "0"
@@ -392,6 +397,151 @@ if FUSED_MODE:
     sys.exit(1 if fails or not engaged else 0)
 
 from flowgger_tpu.decoders.jsonl import JSONLDecoder
+if FRAMING_MODE:
+    # ---- device-resident framing fuzz (tpu/framing.py) ----------------
+    # Random chunk sizes that split records mid-byte — including mid-
+    # syslen-length-prefix and a delimiter landing exactly on a chunk
+    # edge — asserting (a) device spans == host splitter output per
+    # region and (b) end-to-end handler bytes identical to the host-
+    # framed pipeline, for line/nul/syslen x 1/2 lanes.
+    import numpy as np
+
+    from flowgger_tpu.splitters import (LineSplitter, NulSplitter,
+                                        SyslenSplitter,
+                                        _scan_syslen_region)
+    from flowgger_tpu.tpu import framing as _framing
+    from flowgger_tpu.tpu import pack as _pack
+    from flowgger_tpu.utils.metrics import registry as _registry
+
+    # run the framing jits inline (no single-flight semaphore): the
+    # routes below engage no device-encode tier, so nothing can wedge
+    _framing._watchdogged = lambda slot, fn: fn()
+
+    def _cfg(framing_on, lanes):
+        return Config.from_string(
+            "[input]\n"
+            f'tpu_framing = "{"on" if framing_on else "off"}"\n'
+            'tpu_fuse = "off"\n'
+            "tpu_max_line_len = 192\n"
+            + (f"tpu_lanes = {lanes}\n" if lanes > 1 else ""))
+
+    class _ChunkedStream:
+        def __init__(self, data, sizes):
+            self.data, self.pos = data, 0
+            self.sizes, self.i = sizes or [len(data) or 1], 0
+
+        def read(self, n):
+            if self.pos >= len(self.data):
+                return b""
+            sz = max(1, self.sizes[self.i % len(self.sizes)])
+            self.i += 1
+            out = self.data[self.pos:self.pos + sz]
+            self.pos += len(out)
+            return out
+
+    def _sizes_from_cuts(stream, forced):
+        cuts = {c for c in forced if 0 < c < len(stream)}
+        for _ in range(rng.randrange(0, 14)):
+            if len(stream) > 1:
+                cuts.add(rng.randrange(1, len(stream)))
+        prev, sizes = 0, []
+        for c in sorted(cuts):
+            sizes.append(c - prev)
+            prev = c
+        sizes.append(max(1, len(stream) - prev))
+        return sizes
+
+    def _run(stream, splitter_cls, framing_on, lanes, sizes):
+        tx = queue.Queue()
+        h = BatchHandler(tx, RFC5424Decoder(), LTSVEncoder(CFG),
+                         _cfg(framing_on, lanes), fmt="rfc5424",
+                         start_timer=False, merger=None)
+        splitter_cls().run(_ChunkedStream(stream, sizes), h)
+        h.close()
+        out = []
+        while not tx.empty():
+            item = tx.get_nowait()
+            out.extend(item.iter_unframed()
+                       if isinstance(item, EncodedBlock) else [item])
+        return out
+
+    fails = 0
+    trials = int(sys.argv[2]) if len(sys.argv) > 2 else 8
+    for trial in range(trials):
+        lines = [ln.replace(b"\n", b"~").replace(b"\0", b"~")
+                 for ln in corpus(rng.randrange(1, 160), gen_rfc5424)]
+        # (framing, stream bytes, splitter, forced cut positions)
+        line_stream = b"".join(ln + b"\n" for ln in lines)
+        nul_stream = b"".join(ln + b"\0" for ln in lines)
+        sys_stream = b"".join(b"%d %s" % (len(ln), ln) for ln in lines)
+        # forced adversarial cuts: a delimiter exactly on a chunk edge,
+        # the byte after it, and (syslen) mid-length-prefix
+        pos = 0
+        line_cuts, sys_cuts = set(), set()
+        for ln in lines[: 1 + trial % 5]:
+            pos += len(ln) + 1
+            line_cuts |= {pos, pos - 1, pos + 1}
+        pos = 0
+        for ln in lines[: 1 + trial % 5]:
+            plen = len(b"%d" % len(ln))
+            sys_cuts |= {pos + 1, pos + plen, pos + plen + 1}
+            pos += plen + 1 + len(ln)
+        if trial % 3 == 0:
+            # tail variants: partial record / bad length / huge prefix
+            line_stream += rnd_bytes(rng.randrange(0, 30)) \
+                .replace(b"\n", b"~")
+            sys_stream += rng.choice(
+                [b"9999 short", b"xx junk", b"123456789012 x", b""])
+        cases = [
+            ("line", line_stream, LineSplitter, line_cuts),
+            ("nul", nul_stream, NulSplitter, set()),
+            ("syslen", sys_stream, SyslenSplitter, sys_cuts),
+        ]
+        for framing, stream, splitter_cls, forced in cases:
+            # (a) span identity on the whole region
+            if framing == "syslen":
+                hs, hl, hn, hcons, herr = _scan_syslen_region(stream)
+                try:
+                    p, c, e = _framing.device_frame_region(
+                        stream, "syslen", 192,
+                        n_records=max(stream.count(b" "), 1))
+                except _framing.FramingDeclined:
+                    p = None  # >9-digit prefix: host owns it, by design
+                if p is not None and not (
+                        p[5] == hn and c == hcons and e == herr
+                        and np.array_equal(p[3][:hn], hs)
+                        and np.array_equal(p[4], hl)):
+                    fails += 1
+                    print(f"SPAN MISMATCH syslen trial={trial}")
+            else:
+                sep = b"\0" if framing == "nul" else b"\n"
+                cut = stream.rfind(sep)
+                if cut >= 0:
+                    framed = stream[:cut + 1]
+                    hs, hl, hn, _c = _pack._split_np(
+                        framed, strip_cr=framing == "line",
+                        sep=sep[0])
+                    p, _, _ = _framing.device_frame_region(
+                        framed, framing, 192,
+                        n_records=framed.count(sep))
+                    if not (p[5] == hn
+                            and np.array_equal(p[3][:hn], hs)
+                            and np.array_equal(p[4], hl)):
+                        fails += 1
+                        print(f"SPAN MISMATCH {framing} trial={trial}")
+            # (b) e2e byte identity across chunk boundaries and lanes
+            sizes = _sizes_from_cuts(stream, forced)
+            lanes = 2 if trial % 2 else 1
+            want = _run(stream, splitter_cls, False, lanes, sizes)
+            got = _run(stream, splitter_cls, True, lanes, sizes)
+            if want != got:
+                fails += 1
+                print(f"E2E MISMATCH {framing} lanes={lanes} "
+                      f"trial={trial} want={len(want)} got={len(got)}")
+    engaged = _registry.get("framing_rows") > 0
+    print("ENGAGED:", engaged, "FAILURES:", fails)
+    sys.exit(1 if fails or not engaged else 0)
+
 from flowgger_tpu.decoders.dns import DNSDecoder
 
 ROUTES = [
