@@ -74,6 +74,13 @@ JAX_PLATFORMS=cpu timeout 600 python -m pytest tests/test_fleet_acceptance.py -q
 echo "== multi-tenant serving suite (admission, fair queue, templates) =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_tenancy.py -q -m "not faults"
 
+echo "== observability suite (spans, event journal, exposition) =="
+# flight recorder: strict Prometheus exposition-format parse of
+# GET /metrics, one typed journal event per degradation rung, trace
+# ring -> Chrome trace JSON (tools/trace_dump.py), the reporter/
+# final_flush write-race fix, and the SIGUSR2 / POST /profile toggle
+JAX_PLATFORMS=cpu timeout 600 python -m pytest tests/test_obs.py tests/test_metrics.py -q -m "not faults"
+
 echo "== new-format decode subsystems (jsonl_tpu / dns_tpu, slow half) =="
 # the non-slow differential/framing/auto-leg/AOT tests already ran in
 # the main suite step above — this step adds ONLY their slow-marked

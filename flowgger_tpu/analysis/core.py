@@ -167,6 +167,7 @@ def _load_rules() -> None:
         fc03_oracle,
         fc04_exceptions,
         fc05_configkeys,
+        fc06_metrics,
     )
 
 

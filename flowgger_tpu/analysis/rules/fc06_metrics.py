@@ -1,0 +1,171 @@
+"""FC06 — metric-name discipline.
+
+``Registry.inc``/``set_gauge``/``add_seconds``/``observe`` mint
+counters on first use: a typo'd name silently creates a dead series
+and the real one stays flat — the class of bug no test notices until a
+graph is empty mid-incident.  This rule resolves **every literal name
+passed to a registry call** against the namespace the metrics module
+declares:
+
+- the declared literal tuples in any scanned ``metrics.py`` defining
+  ``_COUNTERS``: ``_COUNTERS``, ``_SECONDS_NAMES``, ``_GAUGE_NAMES``,
+  ``_HISTOGRAM_NAMES``;
+- the registered family patterns (``_FAMILY_PATTERNS``), where each
+  ``{placeholder}`` matches one ``[A-Za-z0-9_]+`` segment — so the
+  literal ``"aot_rejects_missing_route"`` resolves via
+  ``"aot_rejects_{reason}"``;
+- dynamic families a module declares in its **docstring** as a
+  backticked ``name_{var}``-shaped token (the escape hatch for
+  families minted far from metrics.py).
+
+Call sites are recognized by method name (``inc``, ``set_gauge``,
+``init_gauge``, ``add_seconds``, ``observe``, ``get``, ``get_gauge``)
+AND receiver spelling (``_metrics``/``registry``/``reg``/… — the
+conventional registry aliases), so ``dict.get("key")`` or an
+economics tracker's ``observe("framing", …)`` never false-positive.
+Non-literal names (f-strings, variables) are out of scope here: they
+are the families the patterns declare.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable, List, Optional, Set, Tuple
+
+from ..core import Finding, Module, Project, Rule, register
+
+# methods of utils.metrics.Registry that take a metric name first
+_METHODS = frozenset({"inc", "set_gauge", "init_gauge", "add_seconds",
+                      "observe", "get", "get_gauge"})
+
+# receiver spellings that mean "the metrics registry" across the tree
+_RECEIVERS = frozenset({"_metrics", "metrics", "registry", "reg",
+                        "_reg", "_global_registry", "_registry"})
+
+_DECL_TUPLES = ("_COUNTERS", "_SECONDS_NAMES", "_GAUGE_NAMES",
+                "_HISTOGRAM_NAMES")
+
+_PLACEHOLDER = re.compile(r"\{[A-Za-z0-9_]+\}")
+_DOC_PATTERN = re.compile(r"``([a-z0-9_]*\{[a-z0-9_]+\}[a-z0-9_{}]*)``")
+
+
+def _literal_str_tuple(tree: ast.Module, name: str) -> Optional[Set[str]]:
+    """String elements of a module-level ``NAME = (...)`` tuple/list/
+    set assignment; None when absent."""
+    for node in tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        if not any(isinstance(t, ast.Name) and t.id == name
+                   for t in node.targets):
+            continue
+        if isinstance(node.value, (ast.Tuple, ast.List, ast.Set)):
+            return {el.value for el in node.value.elts
+                    if isinstance(el, ast.Constant)
+                    and isinstance(el.value, str)}
+        return None
+    return None
+
+
+def _pattern_regex(pattern: str):
+    """``lane{i}_route_{path}_spr`` → compiled fullmatch regex with one
+    ``[A-Za-z0-9_]+`` segment per placeholder."""
+    out, pos = [], 0
+    for m in _PLACEHOLDER.finditer(pattern):
+        out.append(re.escape(pattern[pos:m.start()]))
+        out.append(r"[A-Za-z0-9_]+")
+        pos = m.end()
+    out.append(re.escape(pattern[pos:]))
+    return re.compile("".join(out) + r"\Z")
+
+
+def _receiver_name(func: ast.Attribute) -> Optional[str]:
+    """Terminal name of the call receiver: ``_metrics.inc`` →
+    ``_metrics``; ``self._registry.inc`` → ``_registry``;
+    ``mod.registry.inc`` → ``registry``."""
+    value = func.value
+    if isinstance(value, ast.Name):
+        return value.id
+    if isinstance(value, ast.Attribute):
+        return value.attr
+    if isinstance(value, ast.Call):
+        # reg = _metrics() pattern inlined: _metrics().inc(...)
+        f = value.func
+        if isinstance(f, ast.Name):
+            return f.id
+        if isinstance(f, ast.Attribute):
+            return f.attr
+    return None
+
+
+def _docstring_patterns(tree: ast.Module) -> List[str]:
+    doc = ast.get_docstring(tree) or ""
+    return _DOC_PATTERN.findall(doc)
+
+
+@register
+class MetricNameDiscipline(Rule):
+    id = "FC06"
+    title = "metric-name discipline (literal registry names must be declared)"
+
+    def check_project(self, project: Project) -> Iterable[Finding]:
+        declared, patterns = self._namespace(project)
+        if declared is None:
+            # no metrics declaration module under this root: nothing
+            # to resolve against (fixture projects without metrics.py)
+            return []
+        for module in project.modules:
+            patterns = patterns + [
+                _pattern_regex(p) for p in _docstring_patterns(module.tree)]
+        findings: List[Finding] = []
+        for module in project.modules:
+            for name, line, col in self._literal_sites(module.tree):
+                if name in declared:
+                    continue
+                if any(rx.match(name) for rx in patterns):
+                    continue
+                findings.append(Finding(
+                    self.id, module.rel, line, col,
+                    f"metric name '{name}' resolves against neither the "
+                    f"declared tuples (_COUNTERS/_SECONDS_NAMES/"
+                    f"_GAUGE_NAMES/_HISTOGRAM_NAMES) nor a registered "
+                    f"family pattern — a typo here mints a silent dead "
+                    f"series; declare it in utils/metrics.py or fix the "
+                    f"spelling"))
+        return findings
+
+    def _namespace(self, project: Project
+                   ) -> Tuple[Optional[Set[str]], list]:
+        """(declared literal names, compiled family regexes) from the
+        scanned metrics declaration module (a ``metrics.py`` defining
+        ``_COUNTERS``)."""
+        for module in project.modules:
+            if module.rel.rsplit("/", 1)[-1] != "metrics.py":
+                continue
+            counters = _literal_str_tuple(module.tree, "_COUNTERS")
+            if counters is None:
+                continue
+            declared = set(counters)
+            for tup in _DECL_TUPLES[1:]:
+                declared |= _literal_str_tuple(module.tree, tup) or set()
+            fams = _literal_str_tuple(module.tree, "_FAMILY_PATTERNS") \
+                or set()
+            return declared, [_pattern_regex(p) for p in sorted(fams)]
+        return None, []
+
+    def _literal_sites(self, tree: ast.Module):
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if not isinstance(func, ast.Attribute) \
+                    or func.attr not in _METHODS:
+                continue
+            if _receiver_name(func) not in _RECEIVERS:
+                continue
+            if not node.args:
+                continue
+            first = node.args[0]
+            if isinstance(first, ast.Constant) \
+                    and isinstance(first.value, str):
+                yield first.value, node.lineno, node.col_offset

@@ -83,8 +83,11 @@ DEFAULT_REJOIN_BACKOFF_MS = 1_000
 PARTITION_PEER_ENV = "FLOWGGER_PARTITION_PEER"
 
 # health-document schema version; tests/resources/healthz_schema.json
-# is the golden copy a CI test validates real payloads against
-HEALTH_SCHEMA = 1
+# is the golden copy a CI test validates real payloads against.
+# v2: added the observability sections — ``events`` (degradation
+# journal ring + per-reason counts, obs/events.py) and ``trace``
+# (flight-recorder mode/ring stats, obs/trace.py)
+HEALTH_SCHEMA = 2
 
 
 @dataclass
@@ -510,6 +513,9 @@ class Fleet:
         """The ``GET /healthz`` document.  Schema is golden-file-tested
         (tests/resources/healthz_schema.json) — additive changes bump
         ``HEALTH_SCHEMA``."""
+        from ..obs.events import journal as _journal
+        from ..obs.trace import tracer as _tracer
+
         local = self.membership.local if self.membership else None
         counts = self.membership.counts() if self.membership else {}
         return {
@@ -529,4 +535,6 @@ class Fleet:
                 "peers": self.membership.roster() if self.membership else [],
             },
             "metrics": self._registry.snapshot(),
+            "events": _journal.health_section(),
+            "trace": _tracer.stats(),
         }

@@ -20,6 +20,14 @@ A load balancer (or ``tools/fleetctl.py``, or a peer) talks to it:
 - ``POST /drain`` — ask this host to drain: flips it to ``draining``
   and triggers the pipeline's SIGTERM drain path when one is attached
   (``fleetctl drain``).
+- ``GET /metrics`` — the registry in the Prometheus text exposition
+  format (obs/prom.py): counters as ``_total`` series, gauges,
+  histogram families as summaries — the scrape leg for fleet hosts.
+- ``GET /trace`` — the flight recorder's completed-batch ring as
+  Chrome trace-event JSON (Perfetto-loadable; empty when
+  ``[metrics] trace`` is off).
+- ``POST /profile`` — toggle the on-demand XLA profiler (the SIGUSR2
+  twin): a soak run captures an xprof trace without a restart.
 
 Transport choice: plain HTTP over TCP, one short-lived connection per
 exchange, every socket under a hard timeout.  No JAX collectives, no
@@ -80,16 +88,43 @@ class HealthService:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _reply_raw(self, code: int, body: bytes,
+                           ctype: str) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
             def do_GET(self):  # noqa: N802 - stdlib name
-                if self.path.split("?")[0] != "/healthz":
+                path = self.path.split("?")[0]
+                if path == "/metrics":
+                    from ..obs import prom as _prom
+
+                    self._reply_raw(200, _prom.render().encode(),
+                                    _prom.PROM_CONTENT_TYPE)
+                    return
+                if path == "/trace":
+                    from ..obs import prom as _prom
+
+                    self._reply_raw(200, _prom.trace_document(),
+                                    "application/json")
+                    return
+                if path != "/healthz":
                     self._reply(404, {"error": "unknown path",
-                                      "paths": ["/healthz"]})
+                                      "paths": ["/healthz", "/metrics",
+                                                "/trace"]})
                     return
                 code = 200 if service._healthy() else 503
                 self._reply(code, service._payload())
 
             def do_POST(self):  # noqa: N802 - stdlib name
                 path = self.path.split("?")[0]
+                if path == "/profile":
+                    from ..obs import prom as _prom
+
+                    self._reply(200, _prom.profile_toggle())
+                    return
                 if path == "/drain":
                     if service._on_drain is None:
                         self._reply(501, {"error": "no drain hook"})
@@ -98,7 +133,8 @@ class HealthService:
                     return
                 if path not in ("/hb", "/join"):
                     self._reply(404, {"error": "unknown path",
-                                      "paths": ["/hb", "/join", "/drain"]})
+                                      "paths": ["/hb", "/join", "/drain",
+                                                "/profile"]})
                     return
                 if service._on_heartbeat is None:
                     self._reply(501, {"error": "no heartbeat sink"})

@@ -1,0 +1,33 @@
+"""Flight recorder: the collector's observability layer.
+
+Three cooperating subsystems, each cheap enough to stay wired through
+the hot path permanently:
+
+- :mod:`~flowgger_tpu.obs.trace` — per-batch stage spans.  A monotonic
+  batch ID minted at flush follows each batch through
+  frame → pack → submit → decode → fetch → encode → sequence → emit;
+  completed batch traces park in a bounded ring and dump as Chrome
+  trace-event JSON (``tools/trace_dump.py``, ``GET /trace``).  Off by
+  default (``[metrics] trace``): when off, every instrumentation site
+  is one predicted-false branch.
+- :mod:`~flowgger_tpu.obs.events` — the structured degradation
+  journal.  Every decline/degradation rung (compile-watchdog decline,
+  busy decline, breaker trip/recover, economics re-route, AOT reject,
+  framing decline, tenant shed, queue drop) emits one typed event —
+  (ts, site, reason, route/lane/tenant, cost hint) — into a bounded
+  ring served under ``/healthz``'s ``events`` section, mirrored to
+  per-reason counters, optionally appended to a JSONL sink.
+- :mod:`~flowgger_tpu.obs.prom` — Prometheus text exposition of the
+  full metrics registry (counters, gauges, stage seconds, histogram
+  families with ``_count``/``_sum`` + quantiles) at ``GET /metrics``
+  on the fleet health server, or on a standalone ``[metrics]
+  prom_port`` listener when fleet federation is off.
+
+The pipeline layers import these lazily (inside functions) so the
+package stays import-cycle-free: obs depends only on
+``utils.metrics`` and the stdlib.
+"""
+
+from __future__ import annotations
+
+__all__ = ["events", "prom", "trace"]

@@ -1,0 +1,196 @@
+"""Structured degradation events: the flight recorder's journal half.
+
+The pipeline has ~10 distinct degradation rungs; before this module
+each surfaced as a scattered stderr print plus (sometimes) a bare
+counter, so an operator watching throughput fall could not reconstruct
+*which* rung fired, *when*, or *what it cost*.  Every decline site now
+calls :func:`emit` with a **typed reason code** — the single emitter:
+
+=========================  =================================================
+reason                     fired by
+=========================  =================================================
+``watchdog_decline``       device_common.guarded_compile_call deadline
+``busy_decline``           guarded call queued behind an in-flight compile
+``breaker_trip``           tpu/breaker.py CLOSED→OPEN (errors or ratio)
+``breaker_recover``        tpu/breaker.py →CLOSED after a cured probe
+``economics_switch``       overlap.RouteEconomics / framing.FramingEconomics
+                           steady-state winner flip (device↔host,
+                           fused↔split, framing↔hostpack)
+``aot_reject``             tpu/aot.py boot/entry artifact rejection
+``framing_decline``        tpu/framing.py device-framing decline
+``fused_fallback``         tpu/batch.py fused tier → split path
+``device_error``           tpu/batch.py device/XLA exception (breaker feed)
+``tenant_shed``            tenancy/admission.py token-bucket denial
+``queue_drop``             utils/bounded_queue.py + tenancy/fairqueue.py
+                           shed/drop (cause + tenant attributed)
+=========================  =================================================
+
+Each event carries ``(ts, site, reason)`` plus whatever context the
+site has — ``route``/``lane``/``tenant``/``detail`` — and a **cost
+hint** (``cost`` + ``cost_unit``: lines shed, seconds burned, rows
+re-decoded), lands in a bounded ring served under ``/healthz``'s
+``events`` section, mirrors to the per-reason ``events_{reason}``
+counter family (+ the ``degradation_events`` aggregate), and
+optionally appends to a JSONL sink.
+
+``emit(..., msg=...)`` also writes the site's legacy stderr line, so
+the one emitter owns both the structured journal and the operator
+console — decline sites no longer hand-roll prints.
+
+Config (``[metrics]``)::
+
+    events_ring = 256            # journal depth (default)
+    events_path = "ev.jsonl"     # optional JSONL sink
+
+Cost model: events fire only on degradation (the healthy hot path
+never calls in here), so one lock + deque append + counter bump per
+occurrence is noise even under a sustained flood — the ring bounds
+memory and the stderr half stays rate-limited where the legacy sites
+rate-limited it.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+from .sink import JsonlSink
+
+DEFAULT_RING = 256
+
+# typed reason codes — the closed vocabulary FC06-adjacent tooling and
+# the tests key on; emit() rejects anything else so a typo'd reason is
+# a crash in CI, not a silent new counter family
+REASONS = (
+    "watchdog_decline",
+    "busy_decline",
+    "breaker_trip",
+    "breaker_recover",
+    "economics_switch",
+    "aot_reject",
+    "framing_decline",
+    "fused_fallback",
+    "device_error",
+    "tenant_shed",
+    "queue_drop",
+)
+_REASON_SET = frozenset(REASONS)
+
+
+class Journal:
+    """Bounded degradation-event ring (module singleton ``journal``)."""
+
+    def __init__(self, ring: int = DEFAULT_RING):
+        self._lock = threading.Lock()
+        self._ring: "deque[dict]" = deque(maxlen=ring)
+        self._counts: Dict[str, int] = {}
+        self._total = 0
+        self._sink = JsonlSink("events")
+
+    def configure(self, ring: int = DEFAULT_RING,
+                  path: Optional[str] = None) -> None:
+        with self._lock:
+            self._ring = deque(self._ring, maxlen=max(1, int(ring)))
+        self._sink.open(path)
+
+    def emit(self, site: str, reason: str, *,
+             detail: Optional[str] = None, route: Optional[str] = None,
+             lane: Optional[int] = None, tenant: Optional[str] = None,
+             cost: Optional[float] = None, cost_unit: Optional[str] = None,
+             msg: Optional[str] = None) -> dict:
+        """Record one degradation event.  ``msg`` (when given) is the
+        operator's stderr line — the legacy print the structured event
+        replaces."""
+        if reason not in _REASON_SET:
+            raise ValueError(f"unknown degradation reason: {reason!r} "
+                             f"(known: {', '.join(REASONS)})")
+        event = {"ts": round(time.time(), 4), "site": site,
+                 "reason": reason}
+        if detail is not None:
+            event["detail"] = str(detail)
+        if route is not None:
+            event["route"] = route
+        if lane is not None:
+            event["lane"] = int(lane)
+        if tenant is not None:
+            event["tenant"] = tenant
+        if cost is not None:
+            event["cost"] = round(float(cost), 6)
+            event["cost_unit"] = cost_unit or "units"
+        with self._lock:
+            self._ring.append(event)
+            self._counts[reason] = self._counts.get(reason, 0) + 1
+            self._total += 1
+        # counter mirror: the registry has its own lock, taken OUTSIDE
+        # ours (no nesting, no ordering hazard)
+        from ..utils.metrics import registry as _metrics
+
+        _metrics.inc("degradation_events")
+        _metrics.inc(f"events_{reason}")
+        if msg:
+            print(msg, file=sys.stderr)
+        self._sink.write(event)
+        return event
+
+    # -- export ------------------------------------------------------------
+    def snapshot(self) -> List[dict]:
+        """The event ring, oldest first (JSON-safe dicts)."""
+        with self._lock:
+            return [dict(e) for e in self._ring]
+
+    def counts(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._counts)
+
+    def total(self) -> int:
+        with self._lock:
+            return self._total
+
+    def health_section(self) -> dict:
+        """The ``events`` section of the ``/healthz`` document."""
+        with self._lock:
+            return {"total": self._total,
+                    "counts": dict(self._counts),
+                    "ring": [dict(e) for e in self._ring]}
+
+    def reset(self) -> None:
+        """Tests only: empty the ring and counts (the registry's
+        mirrored counters reset separately via registry.reset())."""
+        with self._lock:
+            self._ring.clear()
+            self._counts.clear()
+            self._total = 0
+
+    def close(self) -> None:
+        self._sink.close()
+
+
+# the process-wide journal every degradation site imports
+journal = Journal()
+
+
+def emit(site: str, reason: str, **kw) -> dict:
+    """Module-level convenience over ``journal.emit`` (the form the
+    decline sites call)."""
+    return journal.emit(site, reason, **kw)
+
+
+def configure_from(config) -> None:
+    """Wire ``[metrics] events_ring``/``events_path`` (pipeline boot;
+    no keys = defaults, ring only)."""
+    ring = config.lookup_int(
+        "metrics.events_ring",
+        "metrics.events_ring must be an integer (events kept)",
+        DEFAULT_RING)
+    path = config.lookup_str(
+        "metrics.events_path",
+        "metrics.events_path must be a string (file)")
+    try:
+        journal.configure(ring=ring, path=path)
+    except OSError as e:
+        print(f"events: cannot open {path} ({e}); journal keeps the "
+              "in-memory ring only", file=sys.stderr)
+        journal.configure(ring=ring)

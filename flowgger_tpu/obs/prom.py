@@ -1,0 +1,278 @@
+"""Prometheus text exposition of the metrics registry.
+
+``render()`` turns the full registry snapshot into the text exposition
+format (version 0.0.4): every counter (and every cumulative stage-
+seconds accumulator) as a ``_total``-suffixed counter, every gauge as
+a gauge, every histogram family (``batch_seconds``,
+``queue_wait_seconds``, ``e2e_batch_seconds``, …) as a summary with
+``quantile`` labels plus ``_count``/``_sum``, and the degradation
+journal mirrored once more as a labeled family
+(``flowgger_degradation_events_by_reason_total{reason="…"}``) so a
+PromQL ``sum by (reason)`` needs no regex over flat names.
+
+Serving:
+
+- fleet on — the fleet health server (fleet/health.py) answers
+  ``GET /metrics`` with this text (same process, same registry);
+- fleet off — ``[metrics] prom_port`` starts the standalone
+  :class:`ObsServer` below, a minimal HTTP listener with the same
+  ``GET /metrics`` / ``GET /trace`` / ``GET /healthz`` / ``POST
+  /profile`` legs, so single-host deployments scrape without joining a
+  fleet.
+
+Names are sanitized to the ``[a-zA-Z_:][a-zA-Z0-9_:]*`` metric charset
+and label values escaped per the format spec (backslash, double-quote,
+newline); the strict pure-python parser in ``tests/test_obs.py`` is
+the contract.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import sys
+import threading
+from typing import Dict, Optional
+
+PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+NAMESPACE = "flowgger"
+
+_NAME_OK = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*\Z")
+_NAME_FIX = re.compile(r"[^a-zA-Z0-9_:]")
+
+# summary quantiles rendered from each histogram's sliding window —
+# the keys utils.metrics.Histogram.snapshot() exports
+_QUANTILES = (("0.5", "p50"), ("0.99", "p99"))
+
+
+def metric_name(raw: str, suffix: str = "") -> str:
+    """``flowgger_<sanitized raw><suffix>`` in the legal charset."""
+    name = f"{NAMESPACE}_{_NAME_FIX.sub('_', raw)}{suffix}"
+    if not _NAME_OK.match(name):  # leading digit after namespace: impossible
+        name = "_" + name
+    return name
+
+
+def escape_label_value(value: str) -> str:
+    """Label-value escaping per the exposition format: backslash,
+    double-quote, and newline."""
+    return (value.replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def render_labeled(name: str, labels: Dict[str, str], value) -> str:
+    pairs = ",".join(
+        f'{_NAME_FIX.sub("_", k)}="{escape_label_value(str(v))}"'
+        for k, v in labels.items())
+    return f"{name}{{{pairs}}} {_fmt(value)}"
+
+
+def _fmt(value) -> str:
+    if isinstance(value, bool):
+        return "1" if value else "0"
+    if isinstance(value, int):
+        return str(value)
+    v = float(value)
+    if v != v or v in (float("inf"), float("-inf")):
+        return {float("inf"): "+Inf", float("-inf"): "-Inf"}.get(v, "NaN")
+    return repr(v)
+
+
+def render(registry=None, journal=None) -> str:
+    """The full exposition document (trailing newline included)."""
+    if registry is None:
+        from ..utils.metrics import registry as _reg
+
+        registry = _reg
+    if journal is None:
+        from .events import journal as _journal
+
+        journal = _journal
+    export = registry.export()
+    lines = []
+
+    for raw, value in sorted(export["counters"].items()):
+        name = metric_name(raw, "_total")
+        lines.append(f"# TYPE {name} counter")
+        lines.append(f"{name} {_fmt(int(value))}")
+    for raw, value in sorted(export["seconds"].items()):
+        # cumulative stage wall-clock: monotonic, so a counter
+        name = metric_name(raw, "_total")
+        lines.append(f"# TYPE {name} counter")
+        lines.append(f"{name} {_fmt(float(value))}")
+    for raw, value in sorted(export["gauges"].items()):
+        name = metric_name(raw)
+        lines.append(f"# TYPE {name} gauge")
+        lines.append(f"{name} {_fmt(value)}")
+    for raw, snap in sorted(export["histograms"].items()):
+        name = metric_name(raw)
+        lines.append(f"# TYPE {name} summary")
+        for q, key in _QUANTILES:
+            if key in snap:
+                lines.append(render_labeled(name, {"quantile": q},
+                                            snap[key]))
+        lines.append(f"{name}_sum {_fmt(float(snap.get('sum', 0.0)))}")
+        lines.append(f"{name}_count {_fmt(int(snap.get('count', 0)))}")
+
+    counts = journal.counts()
+    if counts:
+        name = f"{NAMESPACE}_degradation_events_by_reason_total"
+        lines.append(f"# TYPE {name} counter")
+        for reason, n in sorted(counts.items()):
+            lines.append(render_labeled(name, {"reason": reason}, n))
+    return "\n".join(lines) + "\n"
+
+
+class ObsServer:
+    """Standalone observability listener for fleet-off deployments
+    (``[metrics] prom_port``).  Same legs the fleet health server
+    grew, minus the fleet document:
+
+    - ``GET /metrics`` — the text exposition above;
+    - ``GET /trace``   — the completed-batch ring as Chrome trace JSON;
+    - ``GET /healthz`` — registry snapshot + events ring + trace stats
+      (always 200: a solo host has no drain ladder to signal);
+    - ``POST /profile`` — toggle the XLA profiler (the SIGUSR2 twin).
+    """
+
+    def __init__(self, bind: str, port: int):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        service = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.0"
+
+            def log_message(self, fmt, *args):  # noqa: N802 - stdlib name
+                pass  # scrapers at 1Hz+ would flood stderr
+
+            def _send(self, code: int, body: bytes, ctype: str) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802 - stdlib name
+                path = self.path.split("?")[0]
+                code, body, ctype = service.handle_get(path)
+                self._send(code, body, ctype)
+
+            def do_POST(self):  # noqa: N802 - stdlib name
+                path = self.path.split("?")[0]
+                code, body, ctype = service.handle_post(path)
+                self._send(code, body, ctype)
+
+        self._server = ThreadingHTTPServer((bind, port), Handler)
+        self._server.daemon_threads = True
+        self._thread: Optional[threading.Thread] = None
+
+    # request handling is on the service (shared with tests; the fleet
+    # server wires the same render/trace/profile helpers directly)
+    def handle_get(self, path: str):
+        if path == "/metrics":
+            return 200, render().encode(), PROM_CONTENT_TYPE
+        if path == "/trace":
+            return 200, trace_document(), "application/json"
+        if path == "/healthz":
+            from ..utils.metrics import registry as _reg
+
+            from .events import journal as _journal
+            from .trace import tracer as _tracer
+
+            doc = {"metrics": _reg.snapshot(),
+                   "events": _journal.health_section(),
+                   "trace": _tracer.stats()}
+            return 200, json.dumps(doc).encode(), "application/json"
+        doc = {"error": "unknown path",
+               "paths": ["/metrics", "/trace", "/healthz", "/profile"]}
+        return 404, json.dumps(doc).encode(), "application/json"
+
+    def handle_post(self, path: str):
+        if path == "/profile":
+            return 200, json.dumps(profile_toggle()).encode(), \
+                "application/json"
+        doc = {"error": "unknown path", "paths": ["/profile"]}
+        return 404, json.dumps(doc).encode(), "application/json"
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    @property
+    def addr(self) -> str:
+        return f"{self._server.server_address[0]}:{self.port}"
+
+    def start(self, supervisor=None) -> None:
+        if self._thread is not None:
+            return
+        if supervisor is not None:
+            self._thread = supervisor.spawn(
+                self._server.serve_forever, "obs-http", exhausted="return")
+        else:
+            self._thread = threading.Thread(
+                target=self._server.serve_forever, daemon=True,
+                name="obs-http")
+            self._thread.start()
+        print(f"obs: exposition endpoint http://{self.addr}/metrics",
+              file=sys.stderr)
+
+    def stop(self) -> None:
+        if self._thread is None:
+            return
+        try:
+            self._server.shutdown()
+            self._server.server_close()
+        except OSError as e:
+            print(f"obs-http: shutdown error: {e}", file=sys.stderr)
+        self._thread = None
+
+
+def trace_document() -> bytes:
+    """The ``GET /trace`` body: Chrome trace JSON of the completed
+    ring (``{"traceEvents": [...]}`` — loadable by Perfetto and
+    chrome://tracing directly)."""
+    from .trace import tracer as _tracer
+
+    doc = {"traceEvents": _tracer.chrome_events(),
+           "displayTimeUnit": "ms"}
+    return json.dumps(doc).encode()
+
+
+def profile_toggle() -> dict:
+    """The ``POST /profile`` body: flip the XLA profiler and report the
+    new state (shared by the fleet server and the SIGUSR2 handler)."""
+    from ..utils import metrics as _metrics_mod
+
+    profiling, log_dir = _metrics_mod.toggle_jax_profiler()
+    return {"ok": True, "profiling": profiling, "log_dir": log_dir}
+
+
+def maybe_start_from(config, supervisor=None) -> Optional[ObsServer]:
+    """Start the standalone listener when ``[metrics] prom_port`` is
+    configured (the caller only asks with fleet off — the fleet health
+    server carries these legs itself)."""
+    port = config.lookup_int(
+        "metrics.prom_port",
+        "metrics.prom_port must be an integer port (standalone "
+        "exposition listener)")
+    if port is None:
+        return None
+    from ..config import ConfigError
+
+    if not 0 <= port < 65536:
+        raise ConfigError("metrics.prom_port must be in [0, 65536)")
+    bind = config.lookup_str(
+        "metrics.prom_bind", "metrics.prom_bind must be a string",
+        "127.0.0.1")
+    try:
+        server = ObsServer(bind, port)
+    except OSError as e:
+        # a taken port must not kill ingest; the scrape target is gone
+        # and the operator is told why
+        print(f"obs: cannot bind exposition listener on {bind}:{port} "
+              f"({e}); metrics stay reachable via the JSONL reporter",
+              file=sys.stderr)
+        return None
+    server.start(supervisor)
+    return server

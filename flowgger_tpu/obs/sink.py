@@ -1,0 +1,75 @@
+"""Shared append-only JSONL sink for the flight recorder.
+
+The journal (obs/events.py) and the tracer (obs/trace.py) both stream
+completed records to an optional file; this is the ONE implementation
+of that lifecycle — open/close under a lock, one JSON object per line,
+and the error contract both callers rely on:
+
+- ``open()`` raises ``OSError`` (the caller decides its fallback — a
+  bad path at configure time is an operator-visible choice);
+- ``write()`` is **best-effort**: a runtime failure (disk full, volume
+  gone) disables the sink with one stderr notice and never raises —
+  the callers sit inside degradation paths (queue shed, breaker trip,
+  sequencer emit), and a full disk must never turn recording a
+  degradation into a new one.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import threading
+from typing import Optional
+
+
+class JsonlSink:
+    def __init__(self, label: str):
+        self._label = label
+        self._lock = threading.Lock()
+        self._fd = None
+        self._path: Optional[str] = None
+
+    def open(self, path: Optional[str]) -> None:
+        """Point the sink at ``path`` (None = close).  Raises OSError —
+        configure-time callers fall back explicitly."""
+        with self._lock:
+            if self._fd is not None:
+                self._fd.close()
+                self._fd = None
+            self._path = path
+            if path:
+                self._fd = open(path, "a")
+
+    @property
+    def active(self) -> bool:
+        return self._fd is not None
+
+    def write(self, doc: dict) -> None:
+        """Append one record; a write failure disables the sink (one
+        notice) instead of propagating into the recording site."""
+        if self._fd is None:
+            return
+        line = json.dumps(doc, sort_keys=True)
+        with self._lock:
+            if self._fd is None:
+                return
+            try:
+                self._fd.write(line + "\n")
+                self._fd.flush()
+            except (OSError, ValueError) as e:
+                # ValueError: write on a handle something else closed
+                path, self._path = self._path, None
+                try:
+                    self._fd.close()
+                except OSError:  # flowcheck: disable=FC04 -- already failing; close is best-effort
+                    pass
+                self._fd = None
+                print(f"{self._label}: sink write to {path} failed "
+                      f"({e}); sink disabled, in-memory ring keeps "
+                      "recording", file=sys.stderr)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fd is not None:
+                self._fd.close()
+                self._fd = None

@@ -1,0 +1,259 @@
+"""Per-batch span tracing: the flight recorder's timeline half.
+
+A monotonic batch ID is minted when a flush dispatches a packed batch;
+every pipeline stage that touches the batch afterwards records a span
+(a ``perf_counter`` pair plus row/byte annotations) against that ID —
+frame → pack → submit → decode → fetch → encode → sequence → emit —
+no matter which thread runs the stage (ingest thread, lane fetcher,
+sequencer turnstile).  ``end()`` moves the completed trace into a
+bounded ring of finished batches (and, in ``jsonl`` mode, appends it
+to a sink), where ``tools/trace_dump.py`` and the health server's
+``GET /trace`` leg render it as Chrome trace-event JSON
+(Perfetto/chrome://tracing loadable).
+
+Config (``[metrics]``)::
+
+    trace = "off"          # "off" | "ring" | "jsonl"
+    trace_ring = 256       # completed batch traces kept (ring/jsonl)
+    trace_path = "t.jsonl" # jsonl mode: one JSON object per batch
+
+Cost model: ``tracer.active`` is a plain attribute — when tracing is
+off every instrumentation site is one attribute read and a
+predicted-false branch (the ``bench.py --smoke`` obs section gates
+this at < 1% of per-chunk e2e cost).  When on, a span append is one
+lock + one list append; the ring is a ``deque(maxlen=...)`` so memory
+is bounded regardless of uptime.
+
+The stage timeline is wall-clock-anchored once per process
+(``perf_counter`` ↔ ``time.time`` epoch pair) so Chrome trace ``ts``
+microseconds are absolute and two hosts' dumps can be laid side by
+side.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+from .sink import JsonlSink
+
+OFF, RING, JSONL = "off", "ring", "jsonl"
+MODES = (OFF, RING, JSONL)
+
+DEFAULT_RING = 256
+
+# canonical stage order (used by trace_dump sorting and the tests; a
+# span may carry any stage name — these are the ones the pipeline
+# records)
+STAGES = ("frame", "pack", "submit", "decode", "fetch", "encode",
+          "sequence", "emit")
+
+
+class Tracer:
+    """Process-wide batch-span recorder (module singleton ``tracer``)."""
+
+    def __init__(self, ring: int = DEFAULT_RING):
+        # plain attribute, read unlocked on the hot path: instrumenting
+        # sites check ``tracer.active`` before touching anything else
+        self.active = False
+        self.mode = OFF
+        self._lock = threading.Lock()
+        self._next = 0
+        self._open: Dict[int, dict] = {}
+        self._ring: "deque[dict]" = deque(maxlen=ring)
+        self._completed = 0
+        self._dropped_open = 0
+        self._sink = JsonlSink("trace")
+        # perf_counter -> wall anchor, fixed at construction: chrome ts
+        # microseconds are absolute wall time
+        self._epoch_wall = time.time()
+        self._epoch_perf = time.perf_counter()
+
+    # -- configuration -----------------------------------------------------
+    def configure(self, mode: str, ring: int = DEFAULT_RING,
+                  path: Optional[str] = None) -> None:
+        if mode not in MODES:
+            raise ValueError(f"trace mode must be one of {MODES}")
+        with self._lock:
+            self.mode = mode
+            # a reconfigured tracer starts fresh: configure is a boot-
+            # time (or test-fixture) action, and stale batches from a
+            # previous configuration would skew the new ring's stats
+            self._ring = deque(maxlen=max(1, int(ring)))
+            self._open.clear()
+            self._completed = 0
+            self._dropped_open = 0
+        self._sink.open(path if mode == JSONL else None)
+        # flipped last: a site observing active=True sees a configured
+        # tracer
+        self.active = mode != OFF
+
+    def close(self) -> None:
+        self.active = False
+        self._sink.close()
+
+    # -- recording ---------------------------------------------------------
+    def begin(self, route: Optional[str] = None) -> Optional[int]:
+        """Mint one batch ID (monotonic) and open its trace; returns
+        None when tracing is off so call sites can skip annotation
+        work entirely."""
+        if not self.active:
+            return None
+        t0 = time.perf_counter()
+        with self._lock:
+            self._next += 1
+            bid = self._next
+            if len(self._open) >= 4096:
+                # a caller that began but never ended (a batch lost to
+                # a crash path) must not leak the open table forever
+                self._open.pop(next(iter(self._open)))
+                self._dropped_open += 1
+            self._open[bid] = {"bid": bid, "route": route, "t0": t0,
+                               "rows": 0, "spans": []}
+        return bid
+
+    def span(self, bid: Optional[int], stage: str, t0: float, t1: float,
+             rows: Optional[int] = None, nbytes: Optional[int] = None,
+             note: Optional[str] = None) -> None:
+        """Record one completed stage span for batch ``bid``.  The
+        caller passes the perf_counter pair it already measured for its
+        stage metrics, so tracing never adds clock reads of its own."""
+        if bid is None or not self.active:
+            return
+        tname = threading.current_thread().name
+        with self._lock:
+            rec = self._open.get(bid)
+            if rec is None:
+                return
+            rec["spans"].append({
+                "stage": stage, "t0": t0, "t1": t1, "thread": tname,
+                **({"rows": int(rows)} if rows is not None else {}),
+                **({"bytes": int(nbytes)} if nbytes is not None else {}),
+                **({"note": note} if note else {}),
+            })
+            if rows:
+                rec["rows"] = max(rec["rows"], int(rows))
+
+    def end(self, bid: Optional[int],
+            e2e_s: Optional[float] = None) -> None:
+        """Finish one batch trace: move it to the completed ring (and
+        the JSONL sink when configured)."""
+        if bid is None:
+            return
+        with self._lock:
+            rec = self._open.pop(bid, None)
+            if rec is None:
+                return
+            rec["t1"] = time.perf_counter()
+            if e2e_s is not None:
+                rec["e2e_s"] = round(e2e_s, 6)
+            self._ring.append(rec)
+            self._completed += 1
+        if self.mode == JSONL:
+            # best-effort: a failed write disables the sink (one
+            # notice) — it must never propagate into the sequencer's
+            # emit path that is closing this batch
+            self._sink.write(rec)
+
+    # -- export ------------------------------------------------------------
+    def snapshot(self) -> List[dict]:
+        """The completed ring, oldest first (JSON-safe dicts)."""
+        with self._lock:
+            return [dict(rec) for rec in self._ring]
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"mode": self.mode, "completed": self._completed,
+                    "ring": len(self._ring), "open": len(self._open),
+                    "dropped_open": self._dropped_open}
+
+    def chrome_events(self, traces: Optional[List[dict]] = None
+                      ) -> List[dict]:
+        """Render batch traces as Chrome trace-event ``"X"`` (complete)
+        events: ``ts``/``dur`` in wall-anchored microseconds, ``pid``
+        the process, ``tid`` a stable small integer per recorded
+        thread name (thread names land in trace metadata events)."""
+        if traces is None:
+            traces = self.snapshot()
+        return chrome_events(traces, self._epoch_wall, self._epoch_perf)
+
+
+def chrome_events(traces: List[dict], epoch_wall: Optional[float] = None,
+                  epoch_perf: Optional[float] = None) -> List[dict]:
+    """Pure converter: batch-trace dicts → Chrome trace-event list.
+    Used by the live tracer and by ``tools/trace_dump.py`` over a JSONL
+    capture (where no live epoch exists — spans then anchor at 0)."""
+    if epoch_wall is None or epoch_perf is None:
+        epoch_wall, epoch_perf = 0.0, 0.0
+    pid = os.getpid()
+    tids: Dict[str, int] = {}
+    events: List[dict] = []
+
+    def tid_for(name: str) -> int:
+        tid = tids.get(name)
+        if tid is None:
+            tid = len(tids) + 1
+            tids[name] = tid
+            events.append({"name": "thread_name", "ph": "M", "pid": pid,
+                           "tid": tid, "args": {"name": name}})
+        return tid
+
+    def us(t: float) -> float:
+        return round((epoch_wall + (t - epoch_perf)) * 1e6, 3)
+
+    for rec in traces:
+        bid = rec.get("bid")
+        for sp in rec.get("spans", ()):
+            args = {"batch": bid}
+            for key in ("rows", "bytes", "note"):
+                if key in sp:
+                    args[key] = sp[key]
+            if rec.get("route"):
+                args["route"] = rec["route"]
+            events.append({
+                "name": sp["stage"], "ph": "X", "cat": "batch",
+                "ts": us(sp["t0"]),
+                "dur": round(max(0.0, sp["t1"] - sp["t0"]) * 1e6, 3),
+                "pid": pid, "tid": tid_for(sp.get("thread", "?")),
+                "args": args,
+            })
+    return events
+
+
+# the process-wide tracer every pipeline layer imports
+tracer = Tracer()
+
+
+def configure_from(config) -> None:
+    """Wire ``[metrics] trace``/``trace_ring``/``trace_path`` (pipeline
+    boot; no keys = tracing off, the production default)."""
+    mode = config.lookup_str(
+        "metrics.trace",
+        'metrics.trace must be "off", "ring" or "jsonl"', OFF)
+    if mode not in MODES:
+        from ..config import ConfigError
+
+        raise ConfigError('metrics.trace must be "off", "ring" or "jsonl"')
+    ring = config.lookup_int(
+        "metrics.trace_ring",
+        "metrics.trace_ring must be an integer (batch traces kept)",
+        DEFAULT_RING)
+    path = config.lookup_str(
+        "metrics.trace_path", "metrics.trace_path must be a string (file)")
+    if mode == JSONL and not path:
+        from ..config import ConfigError
+
+        raise ConfigError(
+            'metrics.trace = "jsonl" requires metrics.trace_path')
+    try:
+        tracer.configure(mode, ring=ring, path=path)
+    except OSError as e:
+        # an unwritable trace sink must never kill ingest: fall back to
+        # the in-memory ring and say so
+        print(f"trace: cannot open {path} ({e}); falling back to ring "
+              "mode", file=sys.stderr)
+        tracer.configure(RING, ring=ring)

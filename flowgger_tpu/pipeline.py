@@ -264,6 +264,12 @@ class Pipeline:
         self.fleet = Fleet.from_config(
             config, supervisor=self.supervisor,
             on_drain=self._fleet_drain_signal)
+        # standalone observability listener ([metrics] prom_port):
+        # fleet-off deployments scrape GET /metrics (and /trace, POST
+        # /profile) without joining a fleet — with fleet on, the fleet
+        # health server carries the same legs and this stays None.
+        # Started in run() beside the fleet agent, stopped at drain.
+        self._obs_server = None
         if input_format in _TPU_FORMATS:
             # multi-host: join the JAX process group before any device
             # op so the decode mesh's dp axis can span every host's
@@ -423,6 +429,9 @@ class Pipeline:
         # sinks — announce `departed` and stop the fleet threads
         if self.fleet is not None:
             self.fleet.shutdown()
+        if self._obs_server is not None:
+            self._obs_server.stop()
+            self._obs_server = None
 
     def _install_signal_handlers(self, threads):
         import os
@@ -440,6 +449,18 @@ class Pipeline:
 
         signal.signal(signal.SIGTERM, handle)
         signal.signal(signal.SIGINT, handle)
+
+        def profile_toggle(signum, frame):
+            # on-demand xprof capture for soak runs: SIGUSR2 starts a
+            # trace into metrics.jax_profile_dir (or a per-pid default)
+            # and a second SIGUSR2 stops it — no restart, no config
+            # edit (the health server's POST /profile is the same flip)
+            from .utils import metrics as _m
+
+            _m.toggle_jax_profiler()
+
+        if hasattr(signal, "SIGUSR2"):
+            signal.signal(signal.SIGUSR2, profile_toggle)
 
     def _fleet_drain_signal(self):
         """`POST /drain` on the health endpoint (fleetctl drain): route
@@ -460,6 +481,11 @@ class Pipeline:
         # are installed
         if self.fleet is not None:
             self.fleet.start()
+        else:
+            from .obs import prom as _prom
+
+            self._obs_server = _prom.maybe_start_from(
+                self.config, supervisor=self.supervisor)
         # the accept loop runs supervised: a crash in the transport
         # restarts it (bounded by [supervisor] config) instead of
         # killing the daemon while consumers still hold the queue

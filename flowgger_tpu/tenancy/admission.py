@@ -29,7 +29,6 @@ gauge (0 admitting, 1 throttled, 2 queue-shed) — plus the aggregate
 
 from __future__ import annotations
 
-import sys
 import threading
 import time
 from typing import Optional
@@ -114,13 +113,18 @@ class TenantState:
         _metrics.inc("tenant_drops", lines)
         self._set_state(STATE_THROTTLED)
         now = time.monotonic()
+        msg = None
         if now - self._last_notice >= 5.0:
             # rate-limited notice: a sustained flood must not turn
-            # stderr into a second flood
+            # stderr into a second flood (the journal event still fires
+            # per denied delivery unit — the ring is bounded)
             self._last_notice = now
-            print(f"tenant [{self.name}] over admission rate; shedding "
-                  f"(tenant_{self.name}_drops counts lines)",
-                  file=sys.stderr)
+            msg = (f"tenant [{self.name}] over admission rate; shedding "
+                   f"(tenant_{self.name}_drops counts lines)")
+        from ..obs import events as _events
+
+        _events.emit("admission", "tenant_shed", tenant=self.name,
+                     cost=lines, cost_unit="lines", msg=msg)
         return False
 
     def _set_state(self, state: int) -> None:

@@ -47,6 +47,7 @@ from collections import deque
 from typing import Dict, Optional
 
 from ..utils import faultinject
+from ..utils.bounded_queue import QUEUE_WAIT_SAMPLE
 from ..utils.metrics import registry as _metrics
 from . import DEFAULT_TENANT, current_name
 from .registry import TenantRegistry
@@ -62,7 +63,7 @@ class _Lane:
 
     def __init__(self, name: str, weight: int, policy: str, state):
         self.name = name
-        self.q: deque = deque()  # (item, cost, lines)
+        self.q: deque = deque()  # (item, cost, lines, enqueue perf_counter)
         self.cost = 0            # queued bytes (DRR + noisiest metric)
         self.deficit = 0.0
         self.weight = max(1, weight)
@@ -96,6 +97,20 @@ class WeightedFairQueue:
         self._control: deque = deque()
         self._total = 0            # queued data items (maxsize domain)
         self.draining = False
+        self._wait_n = 0           # queue_wait_seconds sample counter
+        # shed events staged under the mutex, emitted after release:
+        # the journal's optional JSONL sink is disk I/O, and per-drop
+        # I/O inside the queue lock would serialize every producer
+        # behind the disk exactly when overload sheds fire
+        self._event_buf: list = []
+
+    def _sample_wait_locked(self, ts: float) -> None:
+        """Sampled sojourn time of dequeued items (PolicyQueue parity:
+        one queue_wait_seconds sample per QUEUE_WAIT_SAMPLE gets)."""
+        self._wait_n += 1
+        if self._wait_n % QUEUE_WAIT_SAMPLE == 0:
+            _metrics.observe("queue_wait_seconds",
+                             time.perf_counter() - ts)
 
     # -- introspection (PolicyQueue/queue.Queue parity) --------------------
     def qsize(self) -> int:
@@ -131,7 +146,7 @@ class WeightedFairQueue:
         return lane
 
     def _shed_head_locked(self, lane: _Lane, cause: str) -> None:
-        _item, cost, lines = lane.q.popleft()
+        _item, cost, lines, _ts = lane.q.popleft()
         lane.cost -= cost
         self._total -= 1
         self._count_shed_locked(lane, cause, lines)
@@ -150,6 +165,9 @@ class WeightedFairQueue:
             _metrics.inc("queue_shed_during_drain")
         if lane is not None and lane.state is not None:
             lane.state.count_shed(lines)
+        # staged, not emitted: put() drains the buffer after the mutex
+        self._event_buf.append(
+            (cause, lane.name if lane is not None else None, lines))
 
     def _noisiest_sheddable_locked(self) -> Optional[_Lane]:
         best, best_score = None, -1.0
@@ -161,7 +179,26 @@ class WeightedFairQueue:
                 best, best_score = lane, score
         return best
 
+    def _drain_events(self) -> None:
+        """Emit staged shed events outside the mutex (journal I/O must
+        never run under the queue lock)."""
+        with self.mutex:
+            if not self._event_buf:
+                return
+            buf, self._event_buf = self._event_buf, []
+        from ..obs import events as _events
+
+        for cause, tenant, lines in buf:
+            _events.emit("queue", "queue_drop", detail=cause,
+                         tenant=tenant, cost=lines, cost_unit="lines")
+
     def put(self, item, block: bool = True, timeout=None) -> None:
+        try:
+            self._put_inner(item, block, timeout)
+        finally:
+            self._drain_events()
+
+    def _put_inner(self, item, block: bool = True, timeout=None) -> None:
         if item is None:
             # SHUTDOWN sentinel: unsheddable, capacity-exempt, delivered
             # by get() only after the data lanes drain
@@ -219,7 +256,7 @@ class WeightedFairQueue:
                 # with the lane's configured policy, not a fixed cause
                 self._count_shed_locked(lane, lane.policy, lines)
                 return
-            lane.q.append((item, cost, lines))
+            lane.q.append((item, cost, lines, time.perf_counter()))
             lane.cost += cost
             self._total += 1
             self.unfinished_tasks += 1
@@ -237,11 +274,12 @@ class WeightedFairQueue:
             return item
         if len(active) == 1:
             lane = self._lanes[active[0]]
-            item, cost, _lines = lane.q.popleft()
+            item, cost, _lines, ts = lane.q.popleft()
             lane.cost -= cost
             if not lane.q:
                 lane.deficit = 0.0
             self._total -= 1
+            self._sample_wait_locked(ts)
             return item
         # DRR: resume the rotation after the last-served lane; refill
         # every active lane's deficit until one can afford its head
@@ -252,13 +290,14 @@ class WeightedFairQueue:
                 lane = self._lanes[active[idx]]
                 head_cost = lane.q[0][1]
                 if lane.deficit >= head_cost:
-                    item, cost, _lines = lane.q.popleft()
+                    item, cost, _lines, ts = lane.q.popleft()
                     lane.cost -= cost
                     lane.deficit -= cost
                     if not lane.q:
                         lane.deficit = 0.0
                     self._total -= 1
                     self._cursor = idx
+                    self._sample_wait_locked(ts)
                     return item
             for n in active:
                 lane = self._lanes[n]

@@ -317,11 +317,15 @@ class AotStore:
         reg = _metrics()
 
         def reject(reason: str, msg: str) -> None:
+            from ..obs import events as _events
+
             reg.inc("aot_rejects")
             reg.inc(f"aot_rejects_{reason}")
-            print(f"aot: rejecting artifact dir {root} ({msg}); "
-                  "kernels use the JIT + persistent-cache ladder",
-                  file=sys.stderr)
+            _events.emit(
+                "aot", "aot_reject", detail=f"{reason}: {msg}",
+                route=root,
+                msg=f"aot: rejecting artifact dir {root} ({msg}); "
+                    "kernels use the JIT + persistent-cache ladder")
 
         try:
             with open(os.path.join(root, MANIFEST_NAME), "rb") as f:
@@ -399,11 +403,15 @@ class AotStore:
         if msg is None:
             return True
         reg = _metrics()
+        from ..obs import events as _events
+
         reg.inc("aot_rejects")
         reg.inc("aot_rejects_bucket_grid")
-        print(f"aot: rejecting artifact dir {self.root} ({msg}); "
-              "kernels use the JIT + persistent-cache ladder",
-              file=sys.stderr)
+        _events.emit(
+            "aot", "aot_reject", detail=f"bucket_grid: {msg}",
+            route=self.root,
+            msg=f"aot: rejecting artifact dir {self.root} ({msg}); "
+                "kernels use the JIT + persistent-cache ladder")
         return False
 
     # -- lookup ------------------------------------------------------------
@@ -466,11 +474,14 @@ class AotStore:
             self._bad.add(key)
             first = key not in self._warned
             self._warned.add(key)
+        from ..obs import events as _events
+
         reg.inc("aot_rejects")
         reg.inc(f"aot_rejects_{reason}")
-        if first:
-            print(f"aot: artifact [{key}] rejected ({reason}: {detail}); "
-                  "that kernel uses the JIT ladder", file=sys.stderr)
+        _events.emit(
+            "aot", "aot_reject", detail=f"{reason}: {detail}", route=key,
+            msg=(f"aot: artifact [{key}] rejected ({reason}: {detail}); "
+                 "that kernel uses the JIT ladder") if first else None)
 
 
 def active_store() -> Optional[AotStore]:

@@ -25,6 +25,8 @@ from ..encoders import EncodeError
 from ..splitters import Handler, ScalarHandler
 from ..record import Record
 from .. import tenancy as _tenancy
+from ..obs import events as _events
+from ..obs.trace import tracer as _tracer
 from ..utils import faultinject as _faults
 from ..utils.metrics import registry as _metrics
 
@@ -126,6 +128,7 @@ class BatchHandler(Handler):
         # serializes batch decodes so a timer flush racing a size flush
         # cannot reorder output
         self._decode_lock = threading.Lock()
+        self._flush_t0 = 0.0
         self._timer: Optional[threading.Timer] = None
         self._start_timer = start_timer
         # per-handler hysteresis for the device-encode route (declines /
@@ -482,6 +485,10 @@ class BatchHandler(Handler):
             import time as _time
 
             t0 = _time.perf_counter()
+            # the e2e_batch_seconds anchor every batch dispatched from
+            # this flush measures against (decode lock serializes
+            # flushes, so an instance attribute is race-free)
+            self._flush_t0 = t0
             n0 = _metrics.get("input_lines")
             if self._raw_sessions:
                 # raw-framing sessions snapshot *inside* the decode
@@ -598,9 +605,17 @@ class BatchHandler(Handler):
             self._window.fence()
             self._scalar_region(region, sep)
             return
-        self._guarded_dispatch(pack.pack_region_2d(
+        import time as _time
+
+        bid = _tracer.begin(self.fmt)
+        tp0 = _time.perf_counter()
+        packed = pack.pack_region_2d(
             region, self.max_len, sep=sep[0],
-            strip_cr=self.ingest_strip_cr), runs)
+            strip_cr=self.ingest_strip_cr)
+        if bid is not None:
+            _tracer.span(bid, "pack", tp0, _time.perf_counter(),
+                         rows=int(packed[5]), nbytes=len(region))
+        self._guarded_dispatch(packed, runs, trace=bid)
 
     def _decode_spans(self, span_chunks, span_sets, runs=None) -> None:
         from . import pack
@@ -611,8 +626,15 @@ class BatchHandler(Handler):
                 for s, ln in zip(starts.tolist(), lens.tolist()):
                     self._scalar_handle(chunk[s:s + ln])
             return
-        self._guarded_dispatch(pack.pack_spans_2d(span_chunks, span_sets,
-                                                  self.max_len), runs)
+        import time as _time
+
+        bid = _tracer.begin(self.fmt)
+        tp0 = _time.perf_counter()
+        packed = pack.pack_spans_2d(span_chunks, span_sets, self.max_len)
+        if bid is not None:
+            _tracer.span(bid, "pack", tp0, _time.perf_counter(),
+                         rows=int(packed[5]))
+        self._guarded_dispatch(packed, runs, trace=bid)
 
     # -- device-resident framing (raw sessions) ----------------------------
     def _decode_raw(self, sess, chunks) -> None:
@@ -666,6 +688,7 @@ class BatchHandler(Handler):
             return
         if use_device:
             lane = self._window.next_lane()
+            bid = _tracer.begin(self.fmt)
             t0 = _time.perf_counter()
             try:
                 _faults.maybe_raise("device_decode")
@@ -674,24 +697,34 @@ class BatchHandler(Handler):
                     device=self._lane_devices[lane])
             except _framing.FramingDeclined:
                 _framing.note_decline(state)
+                _tracer.end(bid)
             except Exception as e:  # noqa: BLE001 - device degradation boundary
+                _tracer.end(bid)
                 if self._breaker is None:
                     raise
                 self._device_failed(e)
             else:
                 _framing.note_success(state)
-                self._framing_econ.observe(
-                    "framing", n, _time.perf_counter() - t0)
-                self._guarded_dispatch(packed, runs, lane=lane)
+                t1 = _time.perf_counter()
+                if bid is not None:
+                    _tracer.span(bid, "frame", t0, t1, rows=n,
+                                 nbytes=len(framed), note="device")
+                self._framing_econ.observe("framing", n, t1 - t0)
+                self._guarded_dispatch(packed, runs, lane=lane,
+                                       trace=bid)
                 return
         from . import pack
 
+        bid = _tracer.begin(self.fmt)
         t0 = _time.perf_counter()
         packed = pack.pack_region_2d(framed, self.max_len, sep=sep[0],
                                      strip_cr=sess.framing == "line")
-        self._framing_econ.observe("hostpack", n,
-                                   _time.perf_counter() - t0)
-        self._guarded_dispatch(packed, runs)
+        t1 = _time.perf_counter()
+        if bid is not None:
+            _tracer.span(bid, "pack", t0, t1, rows=n,
+                         nbytes=len(framed), note="host-frame")
+        self._framing_econ.observe("hostpack", n, t1 - t0)
+        self._guarded_dispatch(packed, runs, trace=bid)
 
     def _decode_raw_syslen(self, sess, region, state, use_device,
                            breaker_open, runs_tag) -> None:
@@ -702,6 +735,7 @@ class BatchHandler(Handler):
 
         if use_device and not breaker_open:
             lane = self._window.next_lane()
+            bid = _tracer.begin(self.fmt)
             t0 = _time.perf_counter()
             try:
                 _faults.maybe_raise("device_decode")
@@ -711,7 +745,9 @@ class BatchHandler(Handler):
                     device=self._lane_devices[lane])
             except _framing.FramingDeclined:
                 _framing.note_decline(state)
+                _tracer.end(bid)
             except Exception as e:  # noqa: BLE001 - device degradation boundary
+                _tracer.end(bid)
                 if self._breaker is None:
                     raise
                 self._device_failed(e)
@@ -719,11 +755,17 @@ class BatchHandler(Handler):
                 _framing.note_success(state)
                 n = packed[5]
                 if n:
-                    self._framing_econ.observe(
-                        "framing", n, _time.perf_counter() - t0)
+                    t1 = _time.perf_counter()
+                    if bid is not None:
+                        _tracer.span(bid, "frame", t0, t1, rows=int(n),
+                                     nbytes=len(region), note="device")
+                    self._framing_econ.observe("framing", n, t1 - t0)
                     runs = ([(runs_tag, n)] if runs_tag is not None
                             else None)
-                    self._guarded_dispatch(packed, runs, lane=lane)
+                    self._guarded_dispatch(packed, runs, lane=lane,
+                                           trace=bid)
+                else:
+                    _tracer.end(bid)
                 self._finish_raw_syslen(sess, region, consumed, err)
                 return
         t0 = _time.perf_counter()
@@ -737,12 +779,16 @@ class BatchHandler(Handler):
         if n:
             from . import pack
 
+            bid = _tracer.begin(self.fmt)
             packed = pack.pack_spans_2d([region[:consumed]],
                                         [(starts, lens)], self.max_len)
-            self._framing_econ.observe("hostpack", n,
-                                       _time.perf_counter() - t0)
+            t1 = _time.perf_counter()
+            if bid is not None:
+                _tracer.span(bid, "pack", t0, t1, rows=int(n),
+                             nbytes=consumed, note="host-frame")
+            self._framing_econ.observe("hostpack", n, t1 - t0)
             runs = [(runs_tag, n)] if runs_tag is not None else None
-            self._guarded_dispatch(packed, runs)
+            self._guarded_dispatch(packed, runs, trace=bid)
         self._finish_raw_syslen(sess, region, consumed, err)
 
     def _finish_raw_syslen(self, sess, region, consumed, err) -> None:
@@ -765,13 +811,14 @@ class BatchHandler(Handler):
             self._scalar_handle(raw)
 
     def _dispatch_packed(self, packed, deferred=None, runs=None,
-                         lane=None) -> None:
+                         lane=None, trace=None) -> None:
         """Route one packed tuple through the right decode/encode tier.
         ``deferred`` (single-element list) is set True when the batch
         was submitted to the in-flight window instead of emitted
-        synchronously."""
+        synchronously.  ``trace`` is the flight-recorder batch ID
+        (None when tracing is off)."""
         if self._fast_encode:
-            self._emit_fast(packed, deferred, runs, lane)
+            self._emit_fast(packed, deferred, runs, lane, trace)
             return
         if self.fmt == "auto":
             from .autodetect import decode_auto_packed
@@ -792,18 +839,32 @@ class BatchHandler(Handler):
             for raw in lines:
                 self._scalar_handle(raw)
             return
+        bid = None
         try:
             _faults.maybe_raise("device_decode")
             if self._fast_encode:
+                import time as _time
+
                 from . import pack
 
+                bid = _tracer.begin(self.fmt)
+                tp0 = _time.perf_counter()
                 packed = pack.pack_lines_2d(lines, self.max_len)
-                self._emit_fast(packed, runs=runs)
+                if bid is not None:
+                    _tracer.span(bid, "pack", tp0, _time.perf_counter(),
+                                 rows=int(packed[5]))
+                deferred = [False]
+                self._emit_fast(packed, deferred, runs, trace=bid)
+                if not deferred[0]:
+                    # emitted synchronously: close the trace here (a
+                    # deferred batch closes it at its sequenced emit)
+                    self._finish_batch(bid, self._flush_t0)
             else:
                 results = self._kernel_fn(lines)
                 self._window.fence()
                 self._emit(results, runs)
         except Exception as e:  # noqa: BLE001 - device degradation boundary
+            _tracer.end(bid)
             if self._breaker is None:
                 raise
             self._device_failed(e)
@@ -818,9 +879,11 @@ class BatchHandler(Handler):
         return self._breaker is None or self._breaker.allow()
 
     def _device_failed(self, e: BaseException) -> None:
-        print(f"device decode failed ({type(e).__name__}: {e}); "
-              f"re-decoding the batch through the scalar oracle",
-              file=sys.stderr)
+        _events.emit(
+            "batch", "device_error", route=self.fmt,
+            detail=f"{type(e).__name__}: {e}",
+            msg=f"device decode failed ({type(e).__name__}: {e}); "
+                f"re-decoding the batch through the scalar oracle")
         self._breaker.record_failure(e)
 
     def _record_sync_success(self) -> None:
@@ -828,16 +891,19 @@ class BatchHandler(Handler):
         if self._breaker is not None and self._window.pending() == 0:
             self._breaker.record_success()
 
-    def _guarded_dispatch(self, packed, runs=None, lane=None) -> None:
+    def _guarded_dispatch(self, packed, runs=None, lane=None,
+                          trace=None) -> None:
         """Route one packed tuple to the device tier, degrading to the
         scalar oracle (same bytes, no lines lost) on any device/XLA
         error when the breaker is armed.  ``lane`` pins the dispatch
-        lane (device framing already committed the batch there)."""
+        lane (device framing already committed the batch there);
+        ``trace`` is the flight-recorder batch ID."""
         deferred = [False]
         try:
             _faults.maybe_raise("device_decode")
-            self._dispatch_packed(packed, deferred, runs, lane)
+            self._dispatch_packed(packed, deferred, runs, lane, trace)
         except Exception as e:  # noqa: BLE001 - device degradation boundary
+            _tracer.end(trace)
             if self._breaker is None:
                 raise
             self._device_failed(e)
@@ -855,8 +921,10 @@ class BatchHandler(Handler):
             return
         if not deferred[0]:
             # completed synchronously; deferred batches are judged at
-            # fetch time in _pop_emit instead
+            # fetch time in _pop_emit instead — and this batch's
+            # flush→emit wall is complete right here
             self._record_sync_success()
+            self._finish_batch(trace, self._flush_t0)
 
     def _scalar_handle(self, raw: bytes) -> None:
         """One line through the right scalar oracle, honoring the
@@ -1093,26 +1161,35 @@ class BatchHandler(Handler):
             self.scalar.decoder if self.fmt == "ltsv" else None)
 
     def _emit_fast(self, packed, deferred=None, runs=None,
-                   lane=None) -> None:
+                   lane=None, trace=None) -> None:
         """Span→bytes encode for one packed tuple: the columnar block
         route when engaged (submitted onto the next dispatch lane; that
         lane's fetcher thread fetches and encodes behind us, and the
         LaneSet sequencer emits in strict batch order), else the per-row
         fast path (gelf/passthrough only), else the Record path.
         ``lane`` (device framing) reuses an already-reserved lane whose
-        device holds the batch."""
+        device holds the batch; ``trace`` rides the window payload so
+        the lane fetcher / sequencer stages land on the same batch
+        trace."""
         if self._block_route_ok():
+            import time as _time
+
             if deferred is not None:
                 deferred[0] = True
             if lane is None:
                 lane = self._window.next_lane()
             if len(self._lane_devices) > 1:
                 _metrics.inc(f"lane{lane}_rows", int(packed[5]))
+            ctx = (trace, self._flush_t0)
             if self.fmt == "auto":
                 # the auto merger submits its per-class kernels at fetch
                 # time, on the lane's fetcher thread (default device:
                 # the per-class legs share one jit cache)
-                self._window.submit(lane, (None, packed, runs))
+                ts0 = _time.perf_counter()
+                self._window.submit(lane, (None, packed, runs, ctx))
+                if trace is not None:
+                    _tracer.span(trace, "submit", ts0,
+                                 _time.perf_counter())
                 return
             route = self._fused_route()
             if route is not None:
@@ -1129,13 +1206,31 @@ class BatchHandler(Handler):
                     # program itself dispatches on the lane fetcher
                     # thread, where a compile-watchdog wait can never
                     # stall ingest
-                    self._window.submit(lane, (fused_routes.submit(
-                        route, packed, self._lane_devices[lane]),
-                        packed, runs))
+                    td0 = _time.perf_counter()
+                    handle = fused_routes.submit(
+                        route, packed, self._lane_devices[lane])
+                    ts0 = _time.perf_counter()
+                    if trace is not None:
+                        _tracer.span(trace, "decode", td0, ts0,
+                                     rows=int(packed[5]),
+                                     note=f"fused:{route.name} commit")
+                    self._window.submit(lane, (handle, packed, runs,
+                                               ctx))
+                    if trace is not None:
+                        _tracer.span(trace, "submit", ts0,
+                                     _time.perf_counter())
                     return
-            self._window.submit(lane, (block_submit(
+            td0 = _time.perf_counter()
+            handle = block_submit(
                 self.fmt, packed, self._sharded_for(self.fmt),
-                self._lane_devices[lane]), packed, runs))
+                self._lane_devices[lane])
+            ts0 = _time.perf_counter()
+            if trace is not None:
+                _tracer.span(trace, "decode", td0, ts0,
+                             rows=int(packed[5]), note="split dispatch")
+            self._window.submit(lane, (handle, packed, runs, ctx))
+            if trace is not None:
+                _tracer.span(trace, "submit", ts0, _time.perf_counter())
             return
         from ..encoders.gelf import GelfEncoder
         from ..encoders.passthrough import PassthroughEncoder
@@ -1164,7 +1259,8 @@ class BatchHandler(Handler):
         """Fetch + encode one in-flight entry on a lane fetcher thread
         (concurrent across lanes); returns the emit closure the LaneSet
         sequencer runs in global submit order."""
-        handle, packed, runs = payload
+        handle, packed, runs, ctx = payload
+        bid, t_flush = ctx
         import time as _time
 
         t0 = _time.perf_counter()
@@ -1172,20 +1268,33 @@ class BatchHandler(Handler):
         econ = self._econs[lane % len(self._econs)]
         try:
             _faults.maybe_raise("device_decode")
-            emit = self._pop_emit_inner(handle, packed, stats, econ, runs)
+            emit = self._pop_emit_inner(handle, packed, stats, econ,
+                                        runs, bid)
         except Exception as e:  # noqa: BLE001 - device degradation boundary
             if self._breaker is None:
+                _tracer.end(bid)
                 raise
             self._device_failed(e)
+
             # emitted under the sequencer turnstile: the scalar re-
             # decode still lands at the batch's position in the stream
-            return lambda: self._scalar_fallback_packed(packed)
+            def fallback():
+                self._scalar_fallback_packed(packed)
+                self._finish_batch(bid, t_flush)
+
+            return fallback
         # measure the route's compute wall now — the sequencer wait
         # ahead of emission is cross-lane scheduling, not route cost
         compute_s = _time.perf_counter() - t0 - stats.get("declined_s", 0.0)
         path = stats.get("path")
+        t_done = _time.perf_counter()
 
         def finish():
+            t_emit0 = _time.perf_counter()
+            if bid is not None:
+                # the gap between compute finishing and the turnstile
+                # opening is cross-lane scheduling: its own span
+                _tracer.span(bid, "sequence", t_done, t_emit0)
             try:
                 emit()
             except Exception as e:  # noqa: BLE001 - device degradation boundary
@@ -1195,10 +1304,15 @@ class BatchHandler(Handler):
                 # oracle at its sequenced position instead of ferrying
                 # and losing the lines
                 if self._breaker is None:
+                    _tracer.end(bid)
                     raise
                 self._device_failed(e)
                 self._scalar_fallback_packed(packed)
+                self._finish_batch(bid, t_flush)
                 return
+            if bid is not None:
+                _tracer.span(bid, "emit", t_emit0, _time.perf_counter(),
+                             rows=int(packed[5]))
             if self._breaker is not None:
                 self._breaker.record_success()
             if path is not None:
@@ -1208,14 +1322,27 @@ class BatchHandler(Handler):
                 # waits) is the device tier's fault, not the host
                 # path's — already subtracted
                 econ.observe(path, int(packed[5]), compute_s)
+            self._finish_batch(bid, t_flush)
 
         return finish
 
+    def _finish_batch(self, bid, t_flush: float) -> None:
+        """One batch fully emitted: observe the flush→emit wall
+        (e2e_batch_seconds) and close its flight-recorder trace."""
+        import time as _time
+
+        e2e = (_time.perf_counter() - t_flush) if t_flush else None
+        if e2e is not None:
+            _metrics.observe("e2e_batch_seconds", e2e)
+        _tracer.end(bid, e2e)
+
     def _pop_emit_inner(self, handle, packed, stats=None, econ=None,
-                        runs=None):
+                        runs=None, bid=None):
         """Fetch + encode one entry; returns a zero-arg emit closure
         (runs later, under the sequencer) so lanes can compute
-        concurrently without reordering the merger stream."""
+        concurrently without reordering the merger stream.  ``bid``
+        is the flight-recorder batch ID the lane-side spans (fetch/
+        encode) land on."""
         import time as _time
 
         if econ is None:
@@ -1233,11 +1360,17 @@ class BatchHandler(Handler):
                 results = decode_auto_packed(packed, self.max_len,
                                              self._auto_ltsv,
                                              self._auto_extras)
+                if bid is not None:
+                    _tracer.span(bid, "encode", t0,
+                                 _time.perf_counter(), note="auto-record")
                 return lambda: self._emit(results, runs)
             # per-leg fetch time is folded into encode_seconds here: the
             # merger interleaves four kernels' fetches with their encodes
-            _metrics.add_seconds("encode_seconds",
-                                 _time.perf_counter() - t0)
+            t1 = _time.perf_counter()
+            _metrics.add_seconds("encode_seconds", t1 - t0)
+            if bid is not None:
+                _tracer.span(bid, "encode", t0, t1, rows=int(packed[5]),
+                             note="auto merged fetch+encode")
             return lambda: self._emit_block(res, packed[5])
         ltsv_dec = self.scalar.decoder if self.fmt == "ltsv" else None
         from . import fused_routes as _fr
@@ -1249,13 +1382,18 @@ class BatchHandler(Handler):
                 handle, packed, self.encoder, self._merger, ltsv_dec,
                 self._device_route_state)
             if fres is not None:
+                tf1 = _time.perf_counter()
                 if stats is not None:
                     stats["path"] = "fused"
                     stats["declined_s"] = 0.0
                 _metrics.add_seconds("device_fetch_seconds", ffetch_s)
-                _metrics.add_seconds(
-                    "encode_seconds",
-                    _time.perf_counter() - tf0 - ffetch_s)
+                _metrics.add_seconds("encode_seconds",
+                                     tf1 - tf0 - ffetch_s)
+                if bid is not None:
+                    _tracer.span(bid, "fetch", tf0, tf0 + ffetch_s,
+                                 note="fused")
+                    _tracer.span(bid, "encode", tf0 + ffetch_s, tf1,
+                                 rows=int(packed[5]), note="fused")
                 return lambda: self._emit_block(fres, packed[5])
             # fused tier declined (compile pending, cooldown, or tier
             # fraction): fall back to the split path right here on the
@@ -1269,6 +1407,9 @@ class BatchHandler(Handler):
                                  fused_declined_s)
             _metrics.inc("fused_fallbacks")
             _metrics.inc(f"fused_fallbacks_{handle.route.name}")
+            _events.emit("batch", "fused_fallback",
+                         route=handle.route.name,
+                         cost=fused_declined_s, cost_unit="declined_s")
             handle = block_submit(self.fmt, packed, None, handle.device)
         mined: list = []
         column_tap = None
@@ -1293,11 +1434,22 @@ class BatchHandler(Handler):
             # the route declined after the fact (e.g. an oversized
             # ltsv_schema or a configured suffix): Record path
             results = _decode_packed(self.fmt, packed, self.scalar.decoder)
+            if bid is not None:
+                _tracer.span(bid, "encode", t0, _time.perf_counter(),
+                             note="record-path")
             return lambda: self._emit(results, runs)
         t2 = _time.perf_counter()
         _metrics.add_seconds("device_fetch_seconds", fetch_s)
         _metrics.add_seconds("encode_seconds",
                              t2 - t0 - fetch_s - declined_s)
+        if bid is not None:
+            # fetch interleaves with encode inside the driver, so the
+            # two spans split the measured wall at the fetch share
+            _tracer.span(bid, "fetch", t0, t0 + fetch_s,
+                         note=stats.get("path") if stats else None)
+            _tracer.span(bid, "encode", t0 + fetch_s, t2,
+                         rows=int(packed[5]),
+                         note=stats.get("path") if stats else None)
         if mined and mined[0] is not None:
             def emit_mined():
                 self._miners.observe_rows(mined[0], runs)

@@ -102,18 +102,28 @@ class DecodeBreaker:
 
     # -- state machine -----------------------------------------------------
     def _transition(self, new: str, count_trip: bool = True) -> None:
+        from ..obs import events as _events
+
         old, self._state = self._state, new
         self.transitions.append((self._clock(), old, new))
         _metrics.set_gauge("device_breaker_state", _STATE_GAUGE[new])
-        if new == OPEN:
+        msg = f"device-decode breaker: {old} -> {new}"
+        if new == OPEN and count_trip:
             # re-opens after an uncured probe are the SAME logical trip:
-            # breaker_trips counts trip events, not cooldown cycles
-            if count_trip:
-                _metrics.inc("breaker_trips")
-            self._opened_at = self._clock()
+            # breaker_trips counts trip events, not cooldown cycles —
+            # and exactly one journal event per trip, same contract
+            _metrics.inc("breaker_trips")
+            _events.emit("breaker", "breaker_trip",
+                         detail=self._trip_reason or "errors",
+                         cost=self.cooldown_ms / 1000.0,
+                         cost_unit="cooldown_s", msg=msg)
         elif new == CLOSED and old != CLOSED:
             _metrics.inc("breaker_recoveries")
-        print(f"device-decode breaker: {old} -> {new}", file=sys.stderr)
+            _events.emit("breaker", "breaker_recover", msg=msg)
+        else:
+            print(msg, file=sys.stderr)
+        if new == OPEN:
+            self._opened_at = self._clock()
 
     @property
     def state(self) -> str:

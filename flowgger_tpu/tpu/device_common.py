@@ -133,6 +133,9 @@ def guarded_compile_call(name: str, fn, *args, timeout_s=None):
             pending = _compile_slots.get(name)
             if pending is not None and not pending.is_set():
                 _count_decline()
+                from ..obs import events as _events
+
+                _events.emit("compile", "watchdog_decline", detail=name)
                 raise CompileTimeout(name)
             # claim the slot inside this same critical section so two
             # threads can never spawn duplicate compiles of one kernel
@@ -172,21 +175,28 @@ def guarded_compile_call(name: str, fn, *args, timeout_s=None):
         # On healthy hosts the semaphore is almost always free, so this
         # path only engages while a compile is genuinely in flight.
         _count_decline()
+        from ..obs import events as _events
+
+        msg = None
         if name not in _compile_warned:
             _compile_warned.add(name)
-            print(
-                f"device-encode kernel [{name}] queued behind the "
-                f"in-flight [{busy}] compile; using the host encode "
-                "path until it lands", file=sys.stderr)
+            msg = (f"device-encode kernel [{name}] queued behind the "
+                   f"in-flight [{busy}] compile; using the host encode "
+                   "path until it lands")
+        _events.emit("compile", "busy_decline", detail=name, msg=msg)
         raise CompileTimeout(name)
     if not done.wait(timeout):
         _count_decline()
+        from ..obs import events as _events
+
+        msg = None
         if name not in _compile_warned:
             _compile_warned.add(name)
-            print(
-                f"device-encode kernel [{name}] still compiling after "
-                f"{timeout:.0f}s; using the host encode path until it "
-                "lands", file=sys.stderr)
+            msg = (f"device-encode kernel [{name}] still compiling "
+                   f"after {timeout:.0f}s; using the host encode path "
+                   "until it lands")
+        _events.emit("compile", "watchdog_decline", detail=name,
+                     cost=timeout, cost_unit="deadline_s", msg=msg)
         raise CompileTimeout(name)
     with _compile_lock:
         _compile_slots.pop(name, None)

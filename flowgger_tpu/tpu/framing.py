@@ -55,6 +55,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..obs import events as _events
 from ..utils.metrics import registry as _metrics
 
 SCALAR_ORACLE = "flowgger_tpu.tpu.pack:split_chunk"
@@ -65,7 +66,12 @@ DIFF_TEST = (
 )
 
 _I32 = jnp.int32
-_BIG = jnp.int32(1 << 30)
+# numpy scalar, NOT jnp.int32(...): materializing a device scalar at
+# import time costs a jit(convert_element_type) compile in every fresh
+# process — the one fresh compile that broke the zero-JIT artifact
+# boot's compile_cache_misses == 0 gate (inside traced code a numpy
+# int32 scalar folds in identically)
+_BIG = np.int32(1 << 30)
 
 # region byte floor (mirrors pack._MIN_BYTES) and the syslen digit-run
 # cap the exact-int32 value parse supports; longer prefixes decline the
@@ -330,6 +336,8 @@ def device_frame_region(region: bytes, framing: str, max_len: int,
         out = _watchdogged(slot, stage_a)
     except CompileTimeout:
         _metrics.inc("framing_declines")
+        _events.emit("framing", "framing_decline", route=framing,
+                     detail="compile watchdog")
         raise FramingDeclined("compile watchdog") from None
     spans = jax.device_get(out)
     n = int(spans["n"])
@@ -338,6 +346,9 @@ def device_frame_region(region: bytes, framing: str, max_len: int,
     if bool(spans.get("overflow", False)) or bool(spans.get("decline",
                                                             False)):
         _metrics.inc("framing_declines")
+        _events.emit("framing", "framing_decline", route=framing,
+                     detail="span overflow or oversized prefix",
+                     cost=nbytes, cost_unit="region_bytes")
         raise FramingDeclined("span overflow or oversized prefix")
     # span metadata is the only D2H on this path: 2 x i32 per slot
     _metrics.inc("framing_span_fetch_bytes", 8 * ncap + 16)
@@ -370,6 +381,8 @@ def device_frame_region(region: bytes, framing: str, max_len: int,
         batch_dev, lens_c_dev = _watchdogged(gslot, stage_b)
     except CompileTimeout:
         _metrics.inc("framing_declines")
+        _events.emit("framing", "framing_decline", route=framing,
+                     detail="compile watchdog (gather)")
         raise FramingDeclined("compile watchdog (gather)") from None
     _metrics.inc("framing_rows", n)
     packed = (batch_dev, lens_c_dev, region, starts_np, orig_lens, n)
@@ -398,6 +411,10 @@ class FramingEconomics:
         self._lock = threading.Lock()
         self._spr = {"framing": None, "hostpack": None}
         self._batches = 0
+        # journal bookkeeping: device framing is the probe-first
+        # default, so the first measured re-route to the host pack (and
+        # every flip back) is one economics_switch event
+        self._winner = "framing"
 
     def allow_framing(self) -> bool:
         if not self.enabled:
@@ -422,15 +439,37 @@ class FramingEconomics:
         if not self.enabled or rows <= 0 or path not in self._spr:
             return
         spr = seconds / rows
+        flip = None
         with self._lock:
             prev = self._spr[path]
             self._spr[path] = spr if prev is None \
                 else prev + self.ALPHA * (spr - prev)
             ewma = self._spr[path]
+            dev, host = self._spr["framing"], self._spr["hostpack"]
+            if dev is not None and host is not None:
+                new = self._winner
+                if dev > host * self.MARGIN:
+                    new = "hostpack"
+                elif host > dev * self.MARGIN:
+                    new = "framing"
+                if new != self._winner:
+                    flip = (self._winner, new,
+                            dev if new == "framing" else host,
+                            host if new == "framing" else dev)
+                    self._winner = new
         # exported unconditionally: when the tier self-disables on a
         # slow backend, these two gauges in /healthz are the operator's
         # signal for WHY device framing stopped engaging
         _metrics.set_gauge(f"framing_{path}_spr", ewma)
+        if flip is not None:
+            old, new, new_spr, old_spr = flip
+            _events.emit(
+                "economics", "economics_switch", route="framing",
+                detail=f"{old} -> {new} "
+                       f"({old}={old_spr:.3g} s/row, {new}={new_spr:.3g})",
+                cost=new_spr, cost_unit="s_per_row",
+                msg=f"framing economics: {old} -> {new} (measured "
+                    f"{new_spr:.3g} s/row vs {old_spr:.3g})")
 
     def snapshot(self) -> dict:
         with self._lock:

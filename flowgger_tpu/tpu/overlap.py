@@ -452,6 +452,11 @@ class RouteEconomics:
         self._spr = {"fused": None, "device": None, "host": None}
         self._batches = 0
         self._fused_batches = 0
+        # steady-state winner per comparison arm, for the degradation
+        # journal: the device/fused tiers are the probe-first defaults,
+        # so the first measured re-route away from them (and every flip
+        # back) is one economics_switch event
+        self._winner = {"split": "device", "fused": "fused"}
 
     def allow_fused(self) -> bool:
         """Fused-vs-split arm of the economics, decided at submit time
@@ -506,13 +511,63 @@ class RouteEconomics:
         if not self.enabled or rows <= 0 or path not in self._spr:
             return
         spr = seconds / rows
+        switches = []
         with self._lock:
             prev = self._spr[path]
             ewma = spr if prev is None else prev + ECON_ALPHA * (spr - prev)
             self._spr[path] = ewma
+            switches = self._winner_flips_locked()
         _metrics.inc(f"encode_route_{path}")
         if self.label is not None:
             _metrics.set_gauge(f"{self.label}_route_{path}_spr", ewma)
+        for arm, old, new, new_spr, old_spr in switches:
+            from ..obs import events as _events
+
+            _events.emit(
+                "economics", "economics_switch", route=arm,
+                detail=f"{old} -> {new} "
+                       f"({old}={old_spr:.3g} s/row, {new}={new_spr:.3g})",
+                lane=(int(self.label[4:]) if self.label
+                      and self.label.startswith("lane") else None),
+                cost=new_spr, cost_unit="s_per_row",
+                msg=f"route economics [{self.label or 'lane0'}/{arm}]: "
+                    f"{old} -> {new} (measured {new_spr:.3g} s/row vs "
+                    f"{old_spr:.3g})")
+
+    def _winner_flips_locked(self):
+        """Steady-state winner changes (margin-hysteretic, mirroring
+        allow_device/allow_fused routing) for the journal; returns
+        [(arm, old, new, new_spr, old_spr), ...]."""
+        flips = []
+        dev, host = self._spr["device"], self._spr["host"]
+        if dev is not None and host is not None:
+            old = self._winner["split"]
+            new = old
+            if dev > host * self.margin:
+                new = "host"
+            elif host > dev * self.margin:
+                new = "device"
+            if new != old:
+                self._winner["split"] = new
+                flips.append(("split", old, new,
+                              dev if new == "device" else host,
+                              host if new == "device" else dev))
+        fused = self._spr["fused"]
+        split = [v for v in (dev, host) if v is not None]
+        best_split = min(split) if split else None
+        if fused is not None and best_split is not None:
+            old = self._winner["fused"]
+            new = old
+            if fused > best_split * self.margin:
+                new = "split"
+            elif best_split > fused * self.margin:
+                new = "fused"
+            if new != old:
+                self._winner["fused"] = new
+                flips.append(("fused", old, new,
+                              fused if new == "fused" else best_split,
+                              best_split if new == "fused" else fused))
+        return flips
 
     def snapshot(self) -> dict:
         with self._lock:

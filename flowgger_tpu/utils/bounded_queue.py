@@ -35,11 +35,19 @@ same queue surface and the same sentinel/drain exemptions.
 from __future__ import annotations
 
 import queue
+import time
+from collections import deque
 
 from . import faultinject
 from .metrics import registry as _metrics
 
 POLICIES = ("block", "drop_newest", "drop_oldest")
+
+# one queue_wait_seconds histogram sample per this many dequeued items:
+# the sojourn clock pairs ride the queue's own mutex (``_put``/``_get``
+# hooks), but the histogram has its own lock — sampling keeps the
+# per-record fast path at a deque append instead of a second lock
+QUEUE_WAIT_SAMPLE = 16
 
 
 class PolicyQueue(queue.Queue):
@@ -49,6 +57,27 @@ class PolicyQueue(queue.Queue):
         super().__init__(maxsize)
         self.policy = policy
         self.draining = False
+        # enqueue-time stamps parallel to the FIFO (SHUTDOWN exempt on
+        # both sides, so alignment survives the sentinel): the
+        # queue_wait_seconds histogram is sampled at dequeue
+        self._wait_ts: deque = deque()
+        self._wait_n = 0
+
+    # queue.Queue calls these under its own mutex
+    def _put(self, item) -> None:
+        super()._put(item)
+        if item is not None:
+            self._wait_ts.append(time.perf_counter())
+
+    def _get(self):
+        item = super()._get()
+        if item is not None and self._wait_ts:
+            ts = self._wait_ts.popleft()
+            self._wait_n += 1
+            if self._wait_n % QUEUE_WAIT_SAMPLE == 0:
+                _metrics.observe("queue_wait_seconds",
+                                 time.perf_counter() - ts)
+        return item
 
     def mark_draining(self) -> None:
         """Pipeline drain entered: subsequent sheds also count
@@ -56,10 +85,14 @@ class PolicyQueue(queue.Queue):
         self.draining = True
 
     def _count_drop(self) -> None:
+        from ..obs import events as _events
+
         _metrics.inc("queue_dropped")
         _metrics.inc(f"queue_dropped_{self.policy}")
         if self.draining:
             _metrics.inc("queue_shed_during_drain")
+        _events.emit("queue", "queue_drop", detail=self.policy,
+                     cost=1, cost_unit="items")
 
     def put(self, item, block: bool = True, timeout=None):
         if item is None or self.policy == "block":

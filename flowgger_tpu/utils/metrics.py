@@ -3,7 +3,7 @@
 The reference has no observability at all — diagnostics are bare stderr
 writes and its declared ``log`` dependency is never used (SURVEY.md §5).
 This registry gives every pipeline stage cheap thread-safe counters and
-the batched decode path a latency histogram, reported as one JSON line
+the batched decode path latency histograms, reported as one JSON line
 on a configurable interval:
 
     [metrics]
@@ -13,7 +13,11 @@ on a configurable interval:
 Counter names: input_lines, decoded_records, decode_errors,
 encode_errors, invalid_utf8, enqueued, output_written, output_errors,
 batches, batch_lines, fallback_rows.  ``batch_seconds`` is a histogram
-(count/sum/min/max/p50/p99 over a sliding window).
+(count/sum/min/max/p50/p99 over a sliding window); the named histogram
+family (``observe(name, value)``) adds ``queue_wait_seconds`` (sampled
+sojourn time of queued items, bounded_queue/fairqueue) and
+``e2e_batch_seconds`` (flush→emit wall per dispatched batch,
+tpu/batch.py) so latency, not just throughput, is measurable.
 
 Overlap executor stages report as cumulative seconds
 (``dispatch_seconds`` submit-side pack+dispatch, ``fetch_seconds``
@@ -23,7 +27,7 @@ the ``inflight_depth`` gauge — see tpu/overlap.py.
 Lane dispatch / compile stability (tpu/overlap.py LaneSet,
 tpu/device_common.py cache+prewarm, tpu/pack.py bucketing):
 ``lane_depth`` (deepest lane) and per-lane ``lane{i}_depth`` gauges,
-``lane{i}_rows`` counters, per-lane ``lane{i}_route_{device,host}_spr``
+``lane{i}_rows`` counters, per-lane ``lane{i}_route_{path}_spr``
 EWMA gauges, ``distinct_compiled_shapes`` gauge (every (rows, max_len)
 shape packed so far), and the ``compile_cache_hits`` /
 ``compile_cache_misses`` / ``prewarmed_shapes`` counters — a second
@@ -43,27 +47,36 @@ economics export as ``lane{i}_route_fused_spr`` alongside the
 device/host gauges.
 
 Multi-tenant serving (tenancy/): per-tenant ``tenant_{name}_lines`` /
-``_bytes`` (admitted), ``_drops`` (admission denials), ``_shed``
-(queue-pressure sheds) counters and the ``tenant_{name}_state`` gauge
-(0 admitting / 1 throttled / 2 shed), plus the aggregate
-``tenant_lines/bytes/drops/shed``.  Queue sheds carry per-cause labels:
-``queue_dropped_{drop_newest,drop_oldest,shed_noisiest}`` alongside the
+``tenant_{name}_bytes`` (admitted), ``tenant_{name}_drops`` (admission
+denials), ``tenant_{name}_shed`` (queue-pressure sheds) counters and
+the ``tenant_{name}_state`` gauge (0 admitting / 1 throttled /
+2 shed), plus the aggregate ``tenant_lines/bytes/drops/shed``.  Queue
+sheds carry per-cause labels: ``queue_dropped_{policy}`` alongside the
 aggregate ``queue_dropped``, and ``queue_shed_during_drain`` after the
 pipeline enters its drain phase.  Template mining reports
 ``template_hits``, the ``tenant_templates_distinct`` gauge (and its
-per-tenant form), and the per-template ``tenant_{name}_template_{id}``
-counter family (capped; overflow ids fold into
-``tenant_{name}_template_overflow``).
+per-tenant ``tenant_{name}_templates_distinct`` form), and the
+per-template ``tenant_{name}_template_{id}`` counter family (capped;
+overflow ids fold into ``tenant_{name}_template_overflow``).
 
-Fleet federation (fleet/): ``fleet_hosts_{joining,active,suspect,
-draining,departed}`` gauges (the local host counts toward its own
-state), per-peer ``fleet_peer{rank}_state`` (0..4 in ladder order) and
-``fleet_peer{rank}_hb_age_ms`` gauges, plus the ``fleet_evictions`` /
-``fleet_rejoins`` / ``fleet_hb_send_errors`` counters.  The whole
-``snapshot()`` is what each host's HTTP health endpoint serves under
-``metrics`` (fleet/health.py) — it is JSON-safe by construction
-(counters and gauges are numbers, ``batch_seconds`` a flat dict), so
-the health document needs no second serialization layer.
+Fleet federation (fleet/): ``fleet_hosts_{state}`` gauges (the local
+host counts toward its own state), per-peer ``fleet_peer{rank}_state``
+(0..4 in ladder order) and ``fleet_peer{rank}_hb_age_ms`` gauges, plus
+the ``fleet_evictions`` / ``fleet_rejoins`` / ``fleet_hb_send_errors``
+counters.  The whole ``snapshot()`` is what each host's HTTP health
+endpoint serves under ``metrics`` (fleet/health.py) — it is JSON-safe
+by construction (counters and gauges are numbers, histograms flat
+dicts), so the health document needs no second serialization layer.
+
+Observability layer (obs/): degradation rungs journal through
+``obs.events`` and mirror here as the ``degradation_events`` aggregate
+plus the per-reason ``events_{reason}`` counter family; the whole
+registry renders in the Prometheus text exposition format via
+``obs.prom.render`` (``GET /metrics``).  The declaration tuples below
+(``_COUNTERS``/``_SECONDS_NAMES``/``_GAUGE_NAMES``/
+``_HISTOGRAM_NAMES``/``_FAMILY_PATTERNS``) are the metric-name
+namespace flowcheck rule FC06 resolves every literal call-site name
+against — a typo'd counter is a CI finding, not a silent new series.
 """
 
 from __future__ import annotations
@@ -73,7 +86,7 @@ import json
 import sys
 import threading
 import time
-from typing import Dict, Optional
+from typing import Dict, Optional, Tuple
 
 _COUNTERS = (
     "input_lines", "decoded_records", "decode_errors", "encode_errors",
@@ -87,9 +100,22 @@ _COUNTERS = (
     # overlap executor (tpu/overlap.py): D2H bytes the compaction +
     # constant-elision path avoided, and encode-route economics picks
     "fetch_bytes_saved", "encode_route_device", "encode_route_host",
+    "encode_route_fused",
     # compile stability (tpu/device_common.py): persistent-cache
-    # traffic and startup kernel prewarm progress
+    # traffic, startup kernel prewarm progress, and the compile
+    # watchdog's decline/health accounting
     "compile_cache_hits", "compile_cache_misses", "prewarmed_shapes",
+    "prewarm_aot_skips", "device_encode_compile_declines",
+    # device-encode tier accounting (tpu/device_common.py driver)
+    "device_encode_declined", "device_encode_rows",
+    "device_encode_scalar_rows", "device_encode_fetch_bytes",
+    "device_encode_out_bytes", "device_encode_wide_batches",
+    # multi-chip mesh + fused routes + device framing
+    "sharded_kernels", "fused_rows", "fused_fallbacks",
+    "framing_rows", "framing_declines", "framing_span_fetch_bytes",
+    # zero-JIT boot (tpu/aot.py): artifact-store traffic; per-reason
+    # rejects ride the aot_rejects_{reason} family
+    "aot_hits", "aot_misses", "aot_rejects",
     # multi-tenant serving (tenancy/): aggregate admission and shed
     # counters — the per-tenant family (tenant_{name}_lines/_bytes/
     # _drops/_shed, tenant_{name}_state gauge) materializes on first
@@ -101,15 +127,58 @@ _COUNTERS = (
     "queue_shed_during_drain",
     # online template mining (tenancy/templates.py): rows mined; the
     # per-template family is tenant_{name}_template_{id} (+ _overflow)
-    "template_hits",
+    "template_hits", "template_tap_errors",
     # fleet federation (fleet/): peers evicted by the missed-heartbeat
     # ladder, local rejoins after a discovered self-eviction, and
     # heartbeat deliveries that failed in transit (partition/churn —
     # normal life at fleet scale, counted not logged).  The state
-    # gauges (fleet_hosts_{joining,active,suspect,draining,departed},
-    # fleet_peer{rank}_state, fleet_peer{rank}_hb_age_ms) materialize
-    # when membership starts
+    # gauges (fleet_hosts_{state}, fleet_peer{rank}_state,
+    # fleet_peer{rank}_hb_age_ms) materialize when membership starts
     "fleet_evictions", "fleet_rejoins", "fleet_hb_send_errors",
+    # degradation journal (obs/events.py): aggregate event count; the
+    # per-reason family is events_{reason}
+    "degradation_events",
+)
+
+# cumulative per-stage wall-clock accumulators (add_seconds)
+_SECONDS_NAMES = (
+    "dispatch_seconds", "fetch_seconds", "overlap_stall_seconds",
+    "device_fetch_seconds", "encode_seconds",
+    "device_encode_declined_seconds",
+    "pack_stage_seconds", "pack_slice_seconds", "pack_copy_seconds",
+)
+
+# point-in-time gauges with literal names (set_gauge/init_gauge)
+_GAUGE_NAMES = (
+    "device_breaker_state", "inflight_depth", "lane_depth",
+    "distinct_compiled_shapes", "framing_carry_bytes",
+    "tenant_templates_distinct",
+)
+
+# sliding-window histogram family (observe)
+_HISTOGRAM_NAMES = (
+    "batch_seconds", "queue_wait_seconds", "e2e_batch_seconds",
+)
+
+# dynamic name families: ``{placeholder}`` stands for one
+# ``[A-Za-z0-9_]+`` segment.  FC06 resolves literal call-site names
+# against these too (e.g. the literal "aot_rejects_missing_route"
+# resolves via "aot_rejects_{reason}"); f-string call sites are by
+# construction members of exactly one family here
+_FAMILY_PATTERNS = (
+    "lane{i}_depth", "lane{i}_rows", "lane{i}_route_{path}_spr",
+    "queue_dropped_{policy}",
+    "tenant_{name}_lines", "tenant_{name}_bytes", "tenant_{name}_drops",
+    "tenant_{name}_shed", "tenant_{name}_state",
+    "tenant_{name}_templates_distinct",
+    "tenant_{name}_template_{id}", "tenant_{name}_template_overflow",
+    "fleet_hosts_{state}", "fleet_peer{rank}_state",
+    "fleet_peer{rank}_hb_age_ms",
+    "aot_rejects_{reason}",
+    "fused_rows_{route}", "fused_fallbacks_{route}",
+    "fetch_bytes_per_row_{route}", "emit_bytes_per_row_{route}",
+    "framing_{path}_spr",
+    "events_{reason}",
 )
 
 
@@ -155,9 +224,21 @@ class Registry:
         self._counters: Dict[str, int] = {name: 0 for name in _COUNTERS}
         self._seconds: Dict[str, float] = {}
         self._gauges: Dict[str, float] = {}
+        # named histogram family; batch_seconds keeps its attribute
+        # alias (it predates the family and call sites/tests use it)
         self.batch_seconds = Histogram()
+        self._hists: Dict[str, Histogram] = {
+            "batch_seconds": self.batch_seconds}
         self._reporter: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        # reporter sink shared between the interval thread and
+        # final_flush: both write through ONE handle under ONE lock, so
+        # a drain-time flush can never interleave bytes mid-line with a
+        # reporter tick (the two used to open the append path
+        # independently)
+        self._out_lock = threading.Lock()
+        self._out = None
+        self._path: Optional[str] = None
 
     def inc(self, name: str, value: int = 1):
         with self._lock:
@@ -186,6 +267,22 @@ class Registry:
         with self._lock:
             self._seconds[name] = self._seconds.get(name, 0.0) + value
 
+    def observe(self, name: str, value: float):
+        """One sample into the named histogram family (created on
+        first use): queue_wait_seconds, e2e_batch_seconds, ..."""
+        h = self._hists.get(name)
+        if h is None:
+            with self._lock:
+                h = self._hists.setdefault(name, Histogram())
+        h.observe(value)
+
+    def histogram(self, name: str) -> Histogram:
+        h = self._hists.get(name)
+        if h is None:
+            with self._lock:
+                h = self._hists.setdefault(name, Histogram())
+        return h
+
     def get(self, name: str) -> int:
         with self._lock:
             return self._counters.get(name, 0)
@@ -195,12 +292,27 @@ class Registry:
             counters = dict(self._counters)
             seconds = {k: round(v, 6) for k, v in self._seconds.items()}
             gauges = dict(self._gauges)
+            hists = dict(self._hists)
         snap: Dict[str, object] = {"ts": round(time.time(), 3)}
         snap.update(counters)
         snap.update(seconds)
         snap.update(gauges)
-        snap["batch_seconds"] = self.batch_seconds.snapshot()
+        for name, h in hists.items():
+            snap[name] = h.snapshot()
         return snap
+
+    def export(self) -> Dict[str, dict]:
+        """Typed snapshot for renderers that need counter/gauge/
+        histogram kinds kept apart (obs/prom.py — Prometheus TYPE
+        lines)."""
+        with self._lock:
+            return {
+                "counters": dict(self._counters),
+                "seconds": dict(self._seconds),
+                "gauges": dict(self._gauges),
+                "histograms": {k: h.snapshot()
+                               for k, h in self._hists.items()},
+            }
 
     def reset(self):
         with self._lock:
@@ -208,26 +320,36 @@ class Registry:
                 self._counters[k] = 0
             self._seconds.clear()
             self._gauges.clear()
-        self.batch_seconds = Histogram()
+            self.batch_seconds = Histogram()
+            self._hists = {"batch_seconds": self.batch_seconds}
 
     # -- periodic reporter -------------------------------------------------
     def start_reporter(self, interval: float, path: Optional[str] = None):
         if interval <= 0 or self._reporter is not None:
             return
         self._path = path
+        if path:
+            try:
+                self._out = open(path, "a")
+            except OSError as e:
+                print(f"metrics: cannot open {path} ({e}); reporting "
+                      "to stderr", file=sys.stderr)
+                self._path = None
+                self._out = None
 
         def run():
-            out = open(path, "a") if path else sys.stderr
-            try:
-                while not self._stop.wait(interval):
-                    print(json.dumps(self.snapshot()), file=out, flush=True)
-            finally:
-                if path:
-                    out.close()
+            while not self._stop.wait(interval):
+                self._write_snapshot()
 
         self._reporter = threading.Thread(target=run, daemon=True,
                                           name="metrics-reporter")
         self._reporter.start()
+
+    def _write_snapshot(self) -> None:
+        line = json.dumps(self.snapshot())
+        with self._out_lock:
+            out = self._out if self._out is not None else sys.stderr
+            print(line, file=out, flush=True)
 
     def stop_reporter(self):
         self._stop.set()
@@ -235,18 +357,24 @@ class Registry:
             self._reporter.join(timeout=2)
             self._reporter = None
         self._stop = threading.Event()
+        # release the sink and clear the stale path: a final_flush
+        # after stop must not re-open a file the reporter no longer
+        # owns (the old code left _path behind forever)
+        with self._out_lock:
+            if self._out is not None:
+                self._out.close()
+                self._out = None
+            self._path = None
 
     def final_flush(self):
         """One last snapshot at shutdown — short-lived runs would
-        otherwise exit between reporter ticks."""
+        otherwise exit between reporter ticks.  Writes through the
+        reporter's own handle under its lock (never a second
+        independent open of the same append path — the interleaved-
+        bytes race the old implementation had)."""
         if self._reporter is None:
             return
-        path = getattr(self, "_path", None)
-        if path:
-            with open(path, "a") as out:
-                print(json.dumps(self.snapshot()), file=out, flush=True)
-        else:
-            print(json.dumps(self.snapshot()), file=sys.stderr, flush=True)
+        self._write_snapshot()
 
 
 # process-wide registry; pipeline stages import and increment this
@@ -255,7 +383,9 @@ registry = Registry()
 
 def configure_from(config) -> None:
     """Start the reporter (and optional XLA profiler trace) if [metrics]
-    is configured (pipeline boot)."""
+    is configured (pipeline boot).  Also wires the observability layer:
+    span tracing (obs/trace.py) and the degradation-event journal
+    (obs/events.py) read their ``[metrics]`` keys here."""
     interval = config.lookup_int(
         "metrics.interval", "metrics.interval must be an integer", 0)
     path = config.lookup_str("metrics.path", "metrics.path must be a string")
@@ -264,10 +394,27 @@ def configure_from(config) -> None:
     profile_dir = config.lookup_str(
         "metrics.jax_profile_dir", "metrics.jax_profile_dir must be a string")
     if profile_dir:
+        global _profile_dir
+        _profile_dir = profile_dir
         start_jax_profiler(profile_dir)
+    from ..obs import events as _events
+    from ..obs import trace as _trace
+
+    _trace.configure_from(config)
+    _events.configure_from(config)
 
 
 _profiling = False
+# the directory on-demand profiling (SIGUSR2 / POST /profile) captures
+# into: metrics.jax_profile_dir when configured, else a per-pid default
+_profile_dir: Optional[str] = None
+
+
+def _default_profile_dir() -> str:
+    import os
+    import tempfile
+
+    return f"{tempfile.gettempdir()}/flowgger-xprof-{os.getpid()}"
 
 
 def start_jax_profiler(log_dir: str) -> None:
@@ -297,3 +444,19 @@ def stop_jax_profiler() -> None:
     except Exception:  # noqa: BLE001  # flowcheck: disable=FC04 -- shutdown best-effort; profiling must never block drain
         pass
     _profiling = False
+
+
+def toggle_jax_profiler() -> Tuple[bool, str]:
+    """On-demand profiling flip (SIGUSR2 handler and the health
+    server's ``POST /profile`` both land here): start a trace into the
+    configured — or default per-pid — directory when idle, stop the
+    running one otherwise.  Returns (now profiling?, log dir) so a
+    soak-run operator can capture an xprof trace without a restart."""
+    log_dir = _profile_dir or _default_profile_dir()
+    if _profiling:
+        stop_jax_profiler()
+        print(f"jax profiler stopped (trace in {log_dir})",
+              file=sys.stderr)
+    else:
+        start_jax_profiler(log_dir)
+    return _profiling, log_dir

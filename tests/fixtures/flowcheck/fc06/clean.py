@@ -1,0 +1,19 @@
+"""FC06 fixture: every literal resolves.
+
+Dynamic metric families: ``custom_{kind}_total``.
+"""
+
+from metrics import registry as _metrics
+
+
+def ok(econ, route_state):
+    _metrics.inc("input_lines")              # declared counter
+    _metrics.inc("tenant_acme_lines")        # family pattern
+    _metrics.inc("aot_rejects_missing_route")  # family pattern, literal
+    _metrics.add_seconds("fetch_seconds", 0.1)
+    _metrics.set_gauge("lane_depth", 2)
+    _metrics.observe("batch_seconds", 0.5)
+    _metrics.inc("custom_abc_total")         # docstring-declared family
+    _metrics.inc(f"lane{0}_rows")            # non-literal: out of scope
+    econ.observe("framing", 1, 0.2)          # not a registry receiver
+    route_state.get("cooldown")              # not a registry receiver

@@ -1,0 +1,7 @@
+"""FC06 fixture: the declared metric namespace."""
+
+_COUNTERS = ("input_lines", "queue_dropped")
+_SECONDS_NAMES = ("fetch_seconds",)
+_GAUGE_NAMES = ("lane_depth",)
+_HISTOGRAM_NAMES = ("batch_seconds",)
+_FAMILY_PATTERNS = ("tenant_{name}_lines", "aot_rejects_{reason}")

@@ -1,0 +1,7 @@
+"""FC06 fixture: a reasoned suppression stays quiet."""
+
+from metrics import registry as _metrics
+
+
+def tolerated():
+    _metrics.inc("legacy_series_kept_for_dashboards")  # flowcheck: disable=FC06 -- grandfathered pre-discipline name

@@ -1,0 +1,8 @@
+"""FC06 fixture: typo'd names that would mint dead series."""
+
+from metrics import registry as _metrics
+
+
+def bad():
+    _metrics.inc("input_linez")        # line 7: typo'd counter
+    _metrics.set_gauge("lane_depht", 1)  # line 8: typo'd gauge

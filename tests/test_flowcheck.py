@@ -99,6 +99,27 @@ def test_fc05_drift_both_ways_plus_dynamic_and_redundant():
     assert read[0].path == "app.py" and read[0].line == 6
 
 
+def test_fc06_metric_name_discipline():
+    result = _run(_fixture("fc06"), rule_ids=["FC06"])
+    got = {(f.path, f.line) for f in result.findings}
+    assert got == {("violating.py", 7), ("violating.py", 8)}
+    msgs = " | ".join(f.message for f in result.findings)
+    assert "input_linez" in msgs and "lane_depht" in msgs
+    assert "silent dead series" in msgs
+    # clean.py resolved everything: declared tuples, family patterns
+    # (incl. a literal member of aot_rejects_{reason}), the docstring-
+    # declared custom_{kind}_total family, and non-registry receivers
+    # (dict.get / economics observe) were skipped; suppressed.py quiet
+    assert result.suppressed_count == 1
+
+
+def test_fc06_no_declaration_module_is_silent():
+    # a project without a _COUNTERS-defining metrics.py has no
+    # namespace to resolve against: FC06 must not fire on it
+    result = _run(_fixture("fc01"), rule_ids=["FC06"])
+    assert result.findings == []
+
+
 # -- suppression mechanics ---------------------------------------------------
 
 def test_suppression_same_line_and_line_above():
@@ -261,5 +282,6 @@ def test_repo_has_zero_non_baselined_findings():
 
 def test_rule_catalog_is_complete():
     rules = all_rules()
-    assert list(rules) == ["FC01", "FC02", "FC03", "FC04", "FC05"]
+    assert list(rules) == ["FC01", "FC02", "FC03", "FC04", "FC05",
+                           "FC06"]
     assert all(rule.title for rule in rules.values())

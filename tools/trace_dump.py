@@ -1,0 +1,80 @@
+#!/usr/bin/env python
+"""Dump flight-recorder batch traces as Chrome trace-event JSON.
+
+Two sources, one output (Perfetto / chrome://tracing loadable):
+
+    # a live collector's completed-batch ring (GET /trace on the fleet
+    # health server or the standalone [metrics] prom_port listener)
+    python tools/trace_dump.py --url http://127.0.0.1:8476/trace -o t.json
+
+    # a [metrics] trace = "jsonl" capture (one batch-trace object per
+    # line, written by obs/trace.py as batches complete)
+    python tools/trace_dump.py --jsonl trace.jsonl -o t.json
+
+Without ``-o`` the document prints to stdout.  Exit codes: 0 dumped,
+2 unreadable source / bad arguments (lint-style, so a soak-run script
+can gate on it).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _from_url(url: str) -> dict:
+    with urllib.request.urlopen(url, timeout=5) as resp:
+        doc = json.loads(resp.read())
+    if not isinstance(doc, dict) or "traceEvents" not in doc:
+        raise ValueError("endpoint did not return a trace document "
+                         "(expected a traceEvents object)")
+    return doc
+
+
+def _from_jsonl(path: str) -> dict:
+    from flowgger_tpu.obs.trace import chrome_events
+
+    traces = []
+    with open(path, "r") as fd:
+        for i, line in enumerate(fd, 1):
+            line = line.strip()
+            if not line:
+                continue
+            rec = json.loads(line)
+            if not isinstance(rec, dict) or "spans" not in rec:
+                raise ValueError(f"line {i}: not a batch-trace object")
+            traces.append(rec)
+    return {"traceEvents": chrome_events(traces), "displayTimeUnit": "ms"}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    src = ap.add_mutually_exclusive_group(required=True)
+    src.add_argument("--url", help="live /trace endpoint to fetch")
+    src.add_argument("--jsonl", help="[metrics] trace_path capture file")
+    ap.add_argument("-o", "--out", help="write here instead of stdout")
+    args = ap.parse_args(argv)
+    try:
+        doc = _from_url(args.url) if args.url else _from_jsonl(args.jsonl)
+    except (OSError, ValueError, urllib.error.URLError) as e:
+        print(f"trace_dump: {e}", file=sys.stderr)
+        return 2
+    rendered = json.dumps(doc)
+    if args.out:
+        with open(args.out, "w") as fd:
+            fd.write(rendered)
+        print(f"trace_dump: {len(doc['traceEvents'])} events -> "
+              f"{args.out}", file=sys.stderr)
+    else:
+        print(rendered)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
