@@ -1240,7 +1240,14 @@ def bench_fleet(extra, smoke):
        solo-run file — federation must not perturb a single byte;
     4. both workers saw 2 active members at the barrier (the
        membership layer actually converged, the rate is not two
-       unfederated processes).
+       unfederated processes);
+    5. self-healing (PR 14): one bounded ``tools/chaos.py`` drill —
+       SIGKILL the coordinator of a 2-process fleet under sustained
+       ingest via the self-selecting ``coordinator_kill`` site — must
+       leave survivors byte-clean and reach an agreed fallback
+       rendezvous; the reconvergence time gates against the
+       heartbeat-ladder bound, tiered by the same headroom probe
+       (correctness-only when the container is cpu-throttled).
     """
     import subprocess
     import tempfile
@@ -1323,6 +1330,73 @@ def bench_fleet(extra, smoke):
             break
         print("fleet smoke: a gate missed, retrying once for jitter",
               file=sys.stderr)
+
+    # self-healing drill: coordinator_kill on a 2-process fleet under
+    # sustained ingest (tools/chaos.py asserts survivor byte-cleanness,
+    # one agreed fallback rendezvous, and the journaled transitions
+    # itself — here we gate its reconvergence time).  The ladder bound
+    # is evict + depart + slack at the chaos workers' own timings; the
+    # tiering mirrors the scale-out gate: hard bound with real
+    # headroom, 2x on a 2-core box, correctness-only (drill must still
+    # SUCCEED inside its window) when cpu-throttled.
+    failover = {"ok": False}
+    for attempt in range(2):
+        proc = subprocess.Popen(
+            [sys.executable,
+             os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "tools", "chaos.py"),
+             "--hosts", "2", "--events", "1",
+             "--sites", "coordinator_kill", "--window", "60",
+             "--json"],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+        try:
+            stdout, stderr = proc.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            # SIGTERM first: the harness's handler tears its worker
+            # fleet down (a bare kill would orphan 2 fsync-looping
+            # workers under every later gate on this box)
+            proc.terminate()
+            try:
+                proc.communicate(timeout=15)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.communicate()
+            print("fleet smoke: chaos drill timed out", file=sys.stderr)
+            break
+        try:
+            report = json.loads(stdout.strip().splitlines()[-1])
+        except (ValueError, IndexError):
+            print(f"fleet smoke: chaos drill printed no report "
+                  f"(rc={proc.returncode}):\n{stderr[-2000:]}",
+                  file=sys.stderr)
+            break
+        bound = report.get("ladder_bound_s") or 10.0
+        if headroom >= 2.5:
+            reconverge_gate, fo_tier = bound, "standard"
+        elif headroom >= 1.45:
+            reconverge_gate, fo_tier = bound * 2, "2-core tolerance"
+        else:
+            reconverge_gate, fo_tier = None, \
+                "cpu-throttled: correctness-only"
+        reconverge = report.get("max_reconverge_s")
+        fo_ok = bool(report.get("ok")) and proc.returncode == 0 and (
+            reconverge_gate is None
+            or (reconverge is not None and reconverge <= reconverge_gate))
+        failover = {
+            "drill": "coordinator_kill",
+            "reconverge_s": reconverge,
+            "ladder_bound_s": bound,
+            "reconverge_gate_s": reconverge_gate,
+            "gate_note": fo_tier,
+            "drill_report_ok": bool(report.get("ok")),
+            "ok": fo_ok,
+        }
+        if fo_ok:
+            break
+        print("fleet smoke: failover drill missed its gate, retrying "
+              "once for jitter", file=sys.stderr)
+    ok = ok and failover["ok"]
+
     payload = {
         "metric": "fleet_smoke",
         "hosts": 2,
@@ -1336,6 +1410,7 @@ def bench_fleet(extra, smoke):
         "gate_note": tier,
         "byte_identical_vs_solo": ident,
         "membership_converged": converged,
+        "failover": failover,
         "ok": bool(ok),
     }
     print(json.dumps(payload))
@@ -1848,8 +1923,10 @@ def smoke_main():
     if not fleet_ok:
         print("SMOKE FAIL: fleet federation gates missed (aggregate "
               "2-host rate vs single host, byte identity vs the solo "
-              "runs, or membership never converged — see the "
-              "fleet_smoke JSON line)", file=sys.stderr)
+              "runs, membership never converged, or the "
+              "coordinator-kill failover drill missed its tiered "
+              "reconvergence bound — see the fleet_smoke JSON line)",
+              file=sys.stderr)
         sys.exit(1)
     if not aot_ok:
         print("SMOKE FAIL: zero-JIT boot gates missed (fresh compiles "

@@ -71,6 +71,19 @@ echo "== fleet federation: multi-process acceptance (slow) =="
 # container
 JAX_PLATFORMS=cpu timeout 600 python -m pytest tests/test_fleet_acceptance.py -q -m "slow"
 
+echo "== self-healing fleet: chaos drills + failover acceptance (slow) =="
+# (1) the slow-marked pytest half: the 3-process chaos acceptance
+# (coordinator SIGKILL mid-stream; survivors byte-identical, fallback
+# rendezvous agreed within the ladder bound, new joiner admitted) —
+# the non-slow failover/roster/rebalance tests already ran in the main
+# suite step.  (2) a bounded tools/chaos.py loop on a 2-process
+# localhost fleet cycling every fault site (coordinator_kill,
+# host_kill, peer_partition, roster_corrupt); the harness asserts
+# reconvergence + clean-prefix outputs after every drill.  measured
+# ~20s total on the 2-core container
+JAX_PLATFORMS=cpu timeout 600 python -m pytest tests/test_fleet_failover.py -q -m "slow"
+timeout 600 python tools/chaos.py --hosts 2 --events 4 --window 60
+
 echo "== multi-tenant serving suite (admission, fair queue, templates) =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_tenancy.py -q -m "not faults"
 

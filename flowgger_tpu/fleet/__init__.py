@@ -11,16 +11,23 @@ collective: logs are embarrassingly data-parallel, so host failure
 degrades that host alone.
 
     membership.py — the joining/active/suspect/draining/departed state
-                    machine, deterministic rank tie-breaks, gauges
+                    machine, deterministic rank tie-breaks, the
+                    rendezvous election + capacity-share derivations,
+                    gauges
     health.py     — per-host HTTP health + heartbeat endpoint
     federation.py — the Fleet agent: config spec, heartbeat ticker,
-                    eviction ladder, rejoin-after-backoff
+                    eviction ladder, rejoin-after-backoff, rendezvous
+                    failover + live-rebalance watch
+    roster.py     — the durable roster journal (crash-safe bootstrap
+                    candidates for joiners whose coordinator is dead)
 
 See README "Multi-host fleet" for topology, key surface, the health
-document schema, and the failure ladder.
+document schema, the failure ladder, and the self-healing
+(failover/rebalance/chaos) story.
 """
 
 from .federation import Fleet, FleetSpec, fleet_spec  # noqa: F401
+from .roster import RosterStore  # noqa: F401
 from .membership import (  # noqa: F401
     ACTIVE,
     DEPARTED,

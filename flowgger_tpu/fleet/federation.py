@@ -31,6 +31,16 @@ Config — all under ``[input]`` beside the ``tpu_*`` family (one
     tpu_fleet_evict_ms = 5000             # -> draining (evicted)
     tpu_fleet_depart_ms = 2000            # evicted -> departed grace
     tpu_fleet_rejoin_backoff_ms = 1000    # self-eviction rejoin backoff
+    tpu_fleet_roster_path = "/var/lib/flowgger/roster.json"
+                                          # durable roster journal:
+                                          # bootstrap candidates when
+                                          # the coordinator is dead
+    tpu_fleet_capacity = 1.0              # advertised traffic weight
+                                          # (default: resolved lane
+                                          # count on *_tpu pipelines)
+    tpu_fleet_chaos = false               # enable POST /fault (chaos
+                                          # harness only — never in
+                                          # production)
 
 Rank and fleet size default from the ``jax.distributed`` spec
 (``input.tpu_process_id`` / ``tpu_num_processes``) so a multi-host JAX
@@ -39,17 +49,47 @@ deployments (scalar pipelines, heterogeneous hosts) set
 ``tpu_fleet_rank`` / ``tpu_fleet_hosts`` instead.
 
 Failure semantics: heartbeats ride the ticker thread (supervised),
-every send is a short-lived HTTP POST under a hard socket timeout, and
-a dead peer costs one timed-out connect per interval — the decode hot
-path never waits on the fleet.  A host that discovers its own eviction
-(a reply's view of it says draining/departed at its incarnation) backs
-off through ``Supervisor.fleet_policy`` and rejoins with a fresh
-incarnation (counted as ``fleet_rejoins``).
+every send is a short-lived HTTP POST under a hard socket timeout with
+a bounded full-jitter retry (``utils/retry.py``; retries counted as
+``fleet_hb_retries``) so one dropped packet cannot start the suspect
+clock, and a dead peer costs a few timed-out connects per interval —
+the decode hot path never waits on the fleet.  A host that discovers
+its own eviction (a reply's view of it says draining/departed at its
+incarnation) backs off through ``Supervisor.fleet_policy`` and rejoins
+with a fresh incarnation (counted as ``fleet_rejoins``).
+
+Self-healing (the PR 14 tentpole — every single-host failure repairs
+without an operator):
+
+- **Durable roster** (``tpu_fleet_roster_path``, ``roster.py``): the
+  gossiped roster journals to disk on change (crash-safe atomic
+  rewrite) and loads at boot as bootstrap candidates — a joiner whose
+  configured coordinator is dead walks the persisted peers instead
+  (``roster_restore`` journal event); a corrupt/partial journal is
+  counted and ignored (clean re-rendezvous).
+- **Rendezvous failover**: every host deterministically elects the
+  lowest active rank as the agreed rendezvous
+  (``membership.rendezvous()``; tie-breaks are the incarnation rules).
+  The election is announced in ``/healthz``'s ``fleet.rendezvous``
+  field so ``fleetctl`` and LB stanzas can follow it; a change lands
+  as a ``rendezvous_failover`` journal event.
+- **Live rebalancing**: hosts advertise capacity weights on their
+  heartbeats; ``membership.shares()`` turns membership into per-host
+  traffic shares (joining/active hosts only — the healthz-200 set), so
+  a joiner starts absorbing its share and a draining/evicted host's
+  share redistributes across survivors through the existing LB 200/503
+  contract.  Share changes land as ``fleet_rebalance`` journal events.
 
 Fault sites (``utils/faultinject.py``): ``peer_partition`` drops
-inbound heartbeats (optionally only from ``FLOWGGER_PARTITION_PEER``),
-``host_kill`` SIGKILLs this process from the ticker — both
-deterministic, for the multi-process acceptance tests.
+heartbeat exchanges in BOTH directions at the armed host — outbound
+sends are suppressed, inbound POSTs 503, and any stray replies are
+discarded — so a single-host arming is a true network cut
+(``FLOWGGER_PARTITION_PEER`` narrows it to one peer);  ``host_kill``
+SIGKILLs this process from the ticker,
+``coordinator_kill`` does the same but only while this host *is* the
+agreed rendezvous, and ``roster_corrupt`` truncates the next roster
+journal write — all deterministic, for the acceptance tests and
+``tools/chaos.py``.
 """
 
 from __future__ import annotations
@@ -87,7 +127,22 @@ PARTITION_PEER_ENV = "FLOWGGER_PARTITION_PEER"
 # v2: added the observability sections — ``events`` (degradation
 # journal ring + per-reason counts, obs/events.py) and ``trace``
 # (flight-recorder mode/ring stats, obs/trace.py)
-HEALTH_SCHEMA = 2
+# v3: self-healing fleet — ``fleet.rendezvous`` (the elected rendezvous
+# every consumer should follow), ``fleet.shares`` (per-rank traffic
+# shares), ``host.capacity``, and per-peer ``capacity``/``share``
+HEALTH_SCHEMA = 3
+
+# bounded heartbeat-POST retry (utils/retry.py, full jitter): one
+# dropped packet must not start a peer's suspect clock — but the whole
+# attempt train must fit the ORIGINAL single-attempt budget (the send
+# timeout is divided across attempts), because the ticker sends
+# serially: a black-holed peer whose train ran 3x the old cost would
+# delay this host's heartbeats to its HEALTHY peers past their suspect
+# window, manufacturing exactly the false suspicion retries exist to
+# prevent
+HB_SEND_ATTEMPTS = 3
+HB_RETRY_INIT_MS = 20
+HB_RETRY_MAX_MS = 60
 
 
 @dataclass
@@ -103,6 +158,9 @@ class FleetSpec:
     evict_ms: int
     depart_ms: int
     rejoin_backoff_ms: int
+    roster_path: Optional[str] = None
+    capacity: Optional[float] = None  # None = caller default (lanes)
+    chaos: bool = False
 
 
 def _check_mesh_conflict(config: Config) -> None:
@@ -172,10 +230,16 @@ def fleet_spec(config: Config) -> Optional[FleetSpec]:
     coordinator = config.lookup_str(
         "input.tpu_fleet_coordinator",
         "input.tpu_fleet_coordinator must be a host:port string")
-    if coordinator is None and rank != 0 and hosts > 1:
+    roster_path = config.lookup_str(
+        "input.tpu_fleet_roster_path",
+        "input.tpu_fleet_roster_path must be a string (journal file)")
+    if coordinator is None and rank != 0 and hosts > 1 \
+            and roster_path is None:
         raise ConfigError(
             "input.tpu_fleet_coordinator is required on ranks > 0 "
-            "(rank 0's health endpoint is the rendezvous address)")
+            "(rank 0's health endpoint is the rendezvous address) — "
+            "unless input.tpu_fleet_roster_path names a durable roster "
+            "journal to bootstrap from instead")
     heartbeat_ms = config.lookup_int(
         "input.tpu_fleet_heartbeat_ms",
         "input.tpu_fleet_heartbeat_ms must be an integer (ms)",
@@ -202,28 +266,43 @@ def fleet_spec(config: Config) -> Optional[FleetSpec]:
         raise ConfigError(
             "fleet deadlines must satisfy tpu_fleet_heartbeat_ms < "
             "tpu_fleet_suspect_ms < tpu_fleet_evict_ms")
+    capacity = config.lookup_float(
+        "input.tpu_fleet_capacity",
+        "input.tpu_fleet_capacity must be a number (traffic weight)")
+    if capacity is not None and capacity <= 0:
+        raise ConfigError("input.tpu_fleet_capacity must be > 0")
+    chaos = config.lookup_bool(
+        "input.tpu_fleet_chaos",
+        "input.tpu_fleet_chaos must be a boolean", False)
     return FleetSpec(rank=rank, hosts=hosts, bind=bind, port=port,
                      advertise=advertise, coordinator=coordinator,
                      heartbeat_ms=heartbeat_ms, suspect_ms=suspect_ms,
                      evict_ms=evict_ms, depart_ms=depart_ms,
-                     rejoin_backoff_ms=rejoin_ms)
+                     rejoin_backoff_ms=rejoin_ms, roster_path=roster_path,
+                     capacity=capacity, chaos=chaos)
 
 
-def _http_post_json(addr: str, path: str, doc: dict, timeout: float,
-                    registry=_global_registry) -> Optional[dict]:
-    """One short-lived POST; None on any failed delivery — a fleet
-    send failing is normal life under partition/churn, so it is counted
-    (``fleet_hb_send_errors``), not logged.  ``addr`` is remote input
-    (gossip can relay anything), so even parsing it stays inside the
-    failure path: a malformed peer entry costs one counted miss, never
-    the ticker thread."""
+class _Undeliverable(Exception):
+    """One POST attempt failed at the transport/parse layer (connect
+    refused, timeout, garbage body) — the retryable class.  A non-200
+    reply is NOT this: the listener is alive and said no (draining /
+    injected partition); retrying a refusal cannot change it and would
+    perturb the deterministic fault-site counting."""
+
+
+def _http_post_once(addr: str, path: str, body: bytes,
+                    timeout: float) -> Optional[dict]:
+    """One short-lived POST.  Raises ``_Undeliverable`` on transport
+    failure; returns None on a delivered-but-refused (non-200) reply.
+    ``addr`` is remote input (gossip can relay anything), so even
+    parsing it stays inside the failure path: a malformed peer entry
+    costs one counted miss, never the ticker thread."""
     import http.client
 
     conn = None
     try:
         host, _, port = addr.rpartition(":")
         conn = http.client.HTTPConnection(host, int(port), timeout=timeout)
-        body = json.dumps(doc).encode()
         conn.request("POST", path, body=body,
                      headers={"Content-Type": "application/json"})
         resp = conn.getresponse()
@@ -232,16 +311,55 @@ def _http_post_json(addr: str, path: str, doc: dict, timeout: float,
             # a 503 (partitioned / draining listener) is a failed
             # delivery too — uncounted it would make a partition with
             # live listeners look like a clean network
-            registry.inc("fleet_hb_send_errors")
             return None
         out = json.loads(data)
         return out if isinstance(out, dict) else None
-    except (OSError, ValueError):
-        registry.inc("fleet_hb_send_errors")
-        return None
+    except (OSError, ValueError) as e:
+        raise _Undeliverable(str(e)) from e
     finally:
         if conn is not None:
             conn.close()
+
+
+def _http_post_json(addr: str, path: str, doc: dict, timeout: float,
+                    registry=_global_registry) -> Optional[dict]:
+    """POST with a bounded full-jitter retry (``utils/retry.py``) over
+    transport failures only: one dropped packet must not start the
+    suspect clock.  ``timeout`` is the whole train's transport budget —
+    it is divided across ``HB_SEND_ATTEMPTS`` so a black-holed peer
+    costs roughly what the pre-retry single attempt cost (the ticker
+    sends serially; a 3x train would stall heartbeats to healthy peers
+    into THEIR suspect windows).  Retries count as ``fleet_hb_retries``;
+    only the exhausted train counts as one ``fleet_hb_send_errors`` — a
+    fleet send failing is normal life under partition/churn, counted
+    not logged."""
+    from ..utils.retry import RetryPolicy
+
+    body = json.dumps(doc).encode()
+    # 150ms floor per attempt: below it a loaded host's loopback HTTP
+    # round trip starts missing the deadline outright and the retry
+    # train fails forever (observed at 50ms on a busy 2-core box).
+    # The floor only loosens the train-fits-old-budget bound for
+    # sub-500ms heartbeat configs, which are loopback test fleets —
+    # where a dead peer answers with an instant RST, never a timeout
+    per_try = max(0.15, timeout / HB_SEND_ATTEMPTS)
+    policy = RetryPolicy(init_ms=HB_RETRY_INIT_MS,
+                         max_ms=HB_RETRY_MAX_MS,
+                         mode="exponential",
+                         max_attempts=HB_SEND_ATTEMPTS - 1)
+    while True:
+        try:
+            out = _http_post_once(addr, path, body, per_try)
+            if out is None:
+                # delivered but refused (503 partition / drain): one
+                # counted failure, no retry — the listener said no
+                registry.inc("fleet_hb_send_errors")
+            return out
+        except _Undeliverable:
+            if policy.backoff() is None:
+                registry.inc("fleet_hb_send_errors")
+                return None
+            registry.inc("fleet_hb_retries")
 
 
 class Fleet:
@@ -262,6 +380,23 @@ class Fleet:
         self.service: Optional[HealthService] = None
         self._rejoin_policy = None  # lazily built; persists across rejoins
         self._started = time.monotonic()
+        self._default_capacity = 1.0  # pipeline override (lane count)
+        self._roster_store = None
+        if spec.roster_path:
+            from .roster import RosterStore
+
+            self._roster_store = RosterStore(spec.roster_path,
+                                             registry=self._registry)
+        # fleet-watch state: last announced rendezvous / shares, so the
+        # ticker emits one typed journal event per actual change.  The
+        # dedicated lock totally orders derive->emit->journal across
+        # the ticker and heartbeat threads: without it a ticker that
+        # derived BEFORE an inbound join could journal its stale
+        # roster AFTER the join's save (last-writer-wins rollback) and
+        # the seen-state swap could emit phantom A->B/B->A event pairs
+        self._watch_lock = threading.Lock()
+        self._rendezvous_seen: Optional[tuple] = None
+        self._shares_seen: Optional[Dict[int, float]] = None
 
     @classmethod
     def from_config(cls, config: Config, supervisor=None, registry=None,
@@ -273,21 +408,88 @@ class Fleet:
                    on_drain=on_drain)
 
     # -- lifecycle ---------------------------------------------------------
+    def set_default_capacity(self, capacity: float) -> None:
+        """Pipeline hook, before ``start()``: the advertised capacity
+        weight when ``input.tpu_fleet_capacity`` is unset (a *_tpu
+        pipeline passes its resolved lane count, so a 4-chip host
+        advertises 4x a 1-chip host's share by default)."""
+        if capacity > 0:
+            self._default_capacity = float(capacity)
+
+    @property
+    def capacity(self) -> float:
+        cap = self.spec.capacity
+        return float(cap) if cap is not None else self._default_capacity
+
     def start(self) -> None:
         spec = self.spec
         self.service = HealthService(
             spec.bind, spec.port, payload=self.health_payload,
             healthy=self._lb_healthy, on_heartbeat=self.on_heartbeat,
-            on_drain=self._drain_requested)
+            on_drain=self._drain_requested,
+            on_fault=self._fault_requested if spec.chaos else None)
         advertise = spec.advertise or \
             f"{spec.bind}:{self.service.port}"
+        # durable-roster bootstrap: load the journal BEFORE membership
+        # exists — a journaled entry for our own rank means this is a
+        # restart within the same lineage, so start one incarnation
+        # past the journaled life and peers accept the comeback without
+        # an eviction-discovery round trip
+        journaled = self._roster_store.load() if self._roster_store \
+            else None
+        incarnation = 0
+        if journaled:
+            for entry in journaled:
+                if entry["rank"] == spec.rank:
+                    incarnation = entry["incarnation"] + 1
         self.membership = Membership(
-            rank=spec.rank, addr=advertise, suspect_ms=spec.suspect_ms,
+            rank=spec.rank, addr=advertise, incarnation=incarnation,
+            suspect_ms=spec.suspect_ms,
             evict_ms=spec.evict_ms, depart_ms=spec.depart_ms,
-            registry=self._registry)
+            capacity=self.capacity, registry=self._registry)
+        if journaled:
+            restored = 0
+            for entry in journaled:
+                if entry["rank"] == spec.rank:
+                    continue
+                # journaled states are stale opinion, and bootstrap is
+                # the one consumer that must DIAL, not trust: enter
+                # every restored peer as joining (dialable) even when
+                # the journal says draining/departed — the last host
+                # to drain journals everyone departed, and honoring
+                # that would boot a coordinator-less restart into a
+                # silent singleton fleet.  A truly dead candidate
+                # costs refused connects until the evict window ages
+                # it out (one spurious fleet_eviction — the price of
+                # checking)
+                self.membership.note_roster(
+                    entry["rank"], entry["addr"], JOINING,
+                    entry["incarnation"], capacity=entry["capacity"])
+                restored += 1
+            if restored:
+                from ..obs import events as _events
+
+                _events.emit(
+                    "fleet/roster", "roster_restore",
+                    detail=f"{restored} bootstrap candidates from "
+                           f"{spec.roster_path}",
+                    cost=float(restored), cost_unit="peers",
+                    msg=f"fleet-roster: restored {restored} bootstrap "
+                        f"candidates from {spec.roster_path} (walked "
+                        "alongside the configured coordinator)")
+        if spec.coordinator is None and spec.hosts > 1 and not journaled:
+            # roster_path waived the coordinator requirement but there
+            # is no usable journal either: this host can only wait to
+            # be dialed.  Say so loudly — a silent singleton answering
+            # healthz 200 looks exactly like a healthy fleet of one
+            print("fleet: WARNING — no coordinator configured and no "
+                  f"usable roster journal at {spec.roster_path}; this "
+                  "host has no peer to dial and will idle until a peer "
+                  "dials it", file=sys.stderr)
         self.service.start(self.supervisor)
         self.membership.activate()
-        print(f"fleet: rank {spec.rank}/{spec.hosts} active, "
+        print(f"fleet: rank {spec.rank}/{spec.hosts} active "
+              f"(capacity {self.capacity:g}), "
               f"health endpoint http://{self.service.addr}/healthz",
               file=sys.stderr)
         if self.supervisor is not None:
@@ -315,6 +517,10 @@ class Fleet:
                 return
             self._draining = True
         self.membership.mark_draining()
+        # derive + journal NOW, not a tick later: the local share just
+        # redistributed (fleet_rebalance) and a restarting host should
+        # find its drain on disk
+        self._fleet_watch()
         if sync_wave:
             self._send_heartbeats()  # don't wait a tick: announce now
         else:
@@ -329,6 +535,7 @@ class Fleet:
                 self._draining = True
             if self.membership.local.state != DEPARTED:
                 self.membership.mark_departed()
+                self._fleet_watch()  # journal the departure durably
                 self._send_heartbeats()
         self._stop.set()
         if self.service is not None:
@@ -349,23 +556,89 @@ class Fleet:
     def _tick_loop(self) -> None:
         interval = self.spec.heartbeat_ms / 1000.0
         while not self._stop.wait(interval):
-            if faultinject.enabled() and faultinject.fire("host_kill"):
-                # deterministic hard host loss for the acceptance
-                # tests: SIGKILL, no drain, no goodbye — peers must
-                # discover it through the missed-heartbeat ladder
-                import signal
-
-                print("faultinject: host_kill firing — SIGKILL",
-                      file=sys.stderr, flush=True)
-                os.kill(os.getpid(), signal.SIGKILL)
+            if faultinject.enabled():
+                if faultinject.fire("host_kill"):
+                    # deterministic hard host loss for the acceptance
+                    # tests: SIGKILL, no drain, no goodbye — peers must
+                    # discover it through the missed-heartbeat ladder
+                    self._sigkill_self("host_kill")
+                rdv = self.rendezvous()
+                if rdv is not None and rdv.get("rank") == self.spec.rank \
+                        and faultinject.fire("coordinator_kill"):
+                    # like host_kill, but self-selecting: only the host
+                    # that currently IS the agreed rendezvous checks the
+                    # site, so `once:N` kills the coordinator on its Nth
+                    # tick as rendezvous — the failover drill's trigger
+                    self._sigkill_self("coordinator_kill")
             self._send_heartbeats()
             if self.membership is not None:
                 self.membership.tick()
+            self._fleet_watch()
+
+    def _sigkill_self(self, site: str) -> None:
+        import signal
+
+        print(f"faultinject: {site} firing — SIGKILL",
+              file=sys.stderr, flush=True)
+        os.kill(os.getpid(), signal.SIGKILL)
+
+    def _fleet_watch(self) -> None:
+        """Post-tick fleet derivations: journal the roster when its
+        durable part changed, and emit one typed event per rendezvous
+        change (``rendezvous_failover``) / share redistribution
+        (``fleet_rebalance``) — the why-did-traffic-move signal at
+        fleet granularity."""
+        m = self.membership
+        if m is None:
+            return
+        from ..obs import events as _events
+
+        with self._watch_lock:
+            # derive INSIDE the lock: a snapshot taken outside could be
+            # older than the save a concurrent watcher already wrote
+            rdv = m.rendezvous()
+            shares = m.shares()
+            prev_rdv, self._rendezvous_seen = self._rendezvous_seen, rdv
+            prev_shares, self._shares_seen = self._shares_seen, shares
+            if self._roster_store is not None:
+                rdv_doc = None if rdv is None else \
+                    {"rank": rdv[0], "addr": rdv[1]}
+                self._roster_store.maybe_save(m.roster(), self.spec.rank,
+                                              rdv_doc)
+        # emit AFTER release (the WFQ shed-event precedent): the event
+        # sink write is disk I/O that must not serialize the heartbeat
+        # handlers behind the watch lock.  The lock still totally
+        # orders the derivations and journal saves; events from two
+        # watchers may interleave in the ring, each built from its own
+        # consistent (prev, new) snapshot
+        if rdv != prev_rdv and prev_rdv is not None and rdv is not None:
+            _events.emit(
+                "fleet/federation", "rendezvous_failover",
+                detail=f"rank{prev_rdv[0]}@{prev_rdv[1]} -> "
+                       f"rank{rdv[0]}@{rdv[1]}",
+                msg=f"fleet: rendezvous moved to rank {rdv[0]} "
+                    f"({rdv[1]}) — was rank {prev_rdv[0]}")
+        if prev_shares is not None and shares and shares != prev_shares:
+            # (an EMPTY share map means no routable host remains in
+            # this view — there is nobody to rebalance TO, and the
+            # state gauges already tell that story)
+            moved = sum(abs(shares.get(r, 0.0) - prev_shares.get(r, 0.0))
+                        for r in set(shares) | set(prev_shares)) / 2.0
+            _events.emit(
+                "fleet/federation", "fleet_rebalance",
+                detail="shares " + json.dumps(
+                    {str(r): shares[r] for r in sorted(shares)}),
+                cost=round(moved, 4), cost_unit="share_moved",
+                msg=f"fleet: traffic shares rebalanced "
+                    f"({moved:.0%} of traffic moved): "
+                    + ", ".join(f"rank{r}={shares[r]:.0%}"
+                                for r in sorted(shares)))
 
     def _heartbeat_doc(self) -> dict:
         local = self.membership.local
         return {"op": "hb", "rank": local.rank, "addr": local.addr,
-                "state": local.state, "incarnation": local.incarnation}
+                "state": local.state, "incarnation": local.incarnation,
+                "capacity": local.capacity}
 
     def _send_heartbeats(self) -> None:
         if self.membership is None:
@@ -379,7 +652,19 @@ class Fleet:
                 targets[addr] = rank
         timeout = max(0.05, min(1.0, self.spec.heartbeat_ms / 1000.0))
         doc = self._heartbeat_doc()
+        named = self._partition_peer() if faultinject.enabled() else None
         for addr, rank in targets.items():
+            if faultinject.enabled() \
+                    and (named is None or (rank is not None
+                                           and named == rank)) \
+                    and faultinject.fire("peer_partition"):
+                # a partitioned host must stop DELIVERING liveness too:
+                # without this send-side drop the armed host keeps
+                # proving itself alive to unarmed peers (multi-process
+                # chaos) and their suspect clock never starts.  Counted
+                # like the real thing — a black-holed send times out
+                self._registry.inc("fleet_hb_send_errors")
+                continue
             reply = _http_post_json(addr, "/hb", doc, timeout,
                                     registry=self._registry)
             if reply is None:
@@ -395,19 +680,19 @@ class Fleet:
             try:
                 s_rank = int(sender["rank"])
                 if faultinject.enabled():
-                    # a partition blocks BOTH directions: when the named
-                    # peer answers our heartbeat, the reply is liveness
-                    # proof too, and it must drop with the site.  (The
-                    # unnamed everything-partition is handled inbound —
-                    # the receiver 503s, so no reply reaches here.)
+                    # belt for the send-side drop in _send_heartbeats:
+                    # a reply that still arrives while the site is
+                    # armed (race with arming) is liveness proof and
+                    # must drop with the partition too
                     named = self._partition_peer()
-                    if named == s_rank and faultinject.fire(
-                            "peer_partition"):
+                    if (named is None or named == s_rank) \
+                            and faultinject.fire("peer_partition"):
                         return
                 self.membership.note_heartbeat(
                     s_rank, str(sender["addr"]),
                     str(sender.get("state", ACTIVE)),
-                    int(sender.get("incarnation", 0)))
+                    int(sender.get("incarnation", 0)),
+                    capacity=sender.get("capacity"))
             except (KeyError, TypeError, ValueError):
                 self._registry.inc("fleet_hb_send_errors")
         for entry in reply.get("roster", []):
@@ -416,7 +701,8 @@ class Fleet:
             try:
                 self.membership.note_roster(
                     int(entry["rank"]), str(entry["addr"]),
-                    str(entry["state"]), int(entry.get("incarnation", 0)))
+                    str(entry["state"]), int(entry.get("incarnation", 0)),
+                    capacity=entry.get("capacity"))
             except (KeyError, TypeError, ValueError):
                 self._registry.inc("fleet_hb_send_errors")
         view = reply.get("view")
@@ -479,16 +765,43 @@ class Fleet:
             if (named is None or named == rank) \
                     and faultinject.fire("peer_partition"):
                 raise PartitionDrop()
-        accepted = self.membership.note_heartbeat(rank, addr, state, inc)
+        accepted = self.membership.note_heartbeat(
+            rank, addr, state, inc, capacity=msg.get("capacity"))
+        if accepted:
+            # derive + journal NOW, on the thread that learned it — not
+            # a tick later.  A host SIGKILLed between accepting a
+            # joiner and its next ticker pass otherwise dies with a
+            # journal that never heard of the joiner (observed in the
+            # chaos drills: the stale journal's only candidate was a
+            # dead address and the NEXT replacement had nobody to
+            # dial).  maybe_save dedups by signature, so steady-state
+            # heartbeats cost two dict compares, no disk I/O
+            self._fleet_watch()
         local = self.membership.local
         return {
             "ok": bool(accepted),
             "from": {"rank": local.rank, "addr": local.addr,
                      "state": local.state,
-                     "incarnation": local.incarnation},
+                     "incarnation": local.incarnation,
+                     "capacity": local.capacity},
             "roster": self.membership.roster(),
             "view": self.membership.view_of(rank),
         }
+
+    def _fault_requested(self, msg: dict) -> dict:
+        """Inbound ``POST /fault`` (chaos harness; only wired when
+        ``input.tpu_fleet_chaos = true``): arm or disarm one fault site
+        at runtime — ``{"site": "host_kill", "spec": "once:1"}`` — so
+        ``tools/chaos.py`` can drive deterministic fault drills against
+        long-running hosts without restarting them."""
+        site = msg.get("site")
+        spec = msg.get("spec", "off")
+        if not isinstance(site, str) or not isinstance(spec, str):
+            raise ValueError("fault body must carry string site/spec")
+        faultinject.set_site(site, spec)  # FaultInjectError -> 400
+        print(f"fleet-chaos: fault site [{site}] set to [{spec}]",
+              file=sys.stderr)
+        return {"ok": True, "site": site, "spec": spec}
 
     def _drain_requested(self) -> dict:
         """Inbound ``POST /drain`` (fleetctl): flip to draining and
@@ -509,6 +822,20 @@ class Fleet:
             return False
         return self.membership.local.state in (JOINING, ACTIVE)
 
+    def rendezvous(self) -> Optional[Dict[str, object]]:
+        """The agreed rendezvous as announced in ``/healthz``:
+        ``{"rank", "addr", "fallback"}`` (None before membership
+        starts).  ``fallback`` means the elected host is not rank 0 —
+        the configured coordinator is rank 0's endpoint by convention,
+        so a non-zero election is the failover consumers (fleetctl, LB
+        templating, joining hosts) should follow."""
+        if self.membership is None:
+            return None
+        rdv = self.membership.rendezvous()
+        if rdv is None:
+            return {"rank": -1, "addr": "", "fallback": False}
+        return {"rank": rdv[0], "addr": rdv[1], "fallback": rdv[0] != 0}
+
     def health_payload(self) -> Dict[str, object]:
         """The ``GET /healthz`` document.  Schema is golden-file-tested
         (tests/resources/healthz_schema.json) — additive changes bump
@@ -518,6 +845,9 @@ class Fleet:
 
         local = self.membership.local if self.membership else None
         counts = self.membership.counts() if self.membership else {}
+        rdv = self.rendezvous() or \
+            {"rank": -1, "addr": "", "fallback": False}
+        shares = self.membership.shares() if self.membership else {}
         return {
             "schema": HEALTH_SCHEMA,
             "ts": round(time.time(), 3),
@@ -528,11 +858,14 @@ class Fleet:
                 "state": local.state if local else "down",
                 "incarnation": local.incarnation if local else 0,
                 "draining": bool(self._draining),
+                "capacity": local.capacity if local else 0.0,
             },
             "fleet": {
                 "hosts": self.spec.hosts,
                 "counts": counts,
                 "peers": self.membership.roster() if self.membership else [],
+                "rendezvous": rdv,
+                "shares": {str(r): s for r, s in sorted(shares.items())},
             },
             "metrics": self._registry.snapshot(),
             "events": _journal.health_section(),

@@ -20,6 +20,11 @@ A load balancer (or ``tools/fleetctl.py``, or a peer) talks to it:
 - ``POST /drain`` — ask this host to drain: flips it to ``draining``
   and triggers the pipeline's SIGTERM drain path when one is attached
   (``fleetctl drain``).
+- ``POST /fault`` — arm/disarm one ``utils/faultinject.py`` site at
+  runtime (``{"site": ..., "spec": "once:1"}``).  Only served when the
+  host opted in (``input.tpu_fleet_chaos = true``, the chaos-harness
+  switch); otherwise 403 — production hosts must not expose a
+  kill-me-on-request verb.
 - ``GET /metrics`` — the registry in the Prometheus text exposition
   format (obs/prom.py): counters as ``_total`` series, gauges,
   histogram families as summaries — the scrape leg for fleet hosts.
@@ -65,11 +70,13 @@ class HealthService:
                  payload: Callable[[], Dict[str, object]],
                  healthy: Callable[[], bool],
                  on_heartbeat: Optional[Callable[[dict], dict]] = None,
-                 on_drain: Optional[Callable[[], dict]] = None):
+                 on_drain: Optional[Callable[[], dict]] = None,
+                 on_fault: Optional[Callable[[dict], dict]] = None):
         self._payload = payload
         self._healthy = healthy
         self._on_heartbeat = on_heartbeat
         self._on_drain = on_drain
+        self._on_fault = on_fault
         service = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -131,10 +138,32 @@ class HealthService:
                         return
                     self._reply(200, service._on_drain())
                     return
+                if path == "/fault":
+                    if service._on_fault is None:
+                        # chaos control is opt-in (tpu_fleet_chaos):
+                        # a production host refuses, loudly
+                        self._reply(403, {"error": "fault control "
+                                          "disabled (input."
+                                          "tpu_fleet_chaos = false)"})
+                        return
+                    from ..utils.faultinject import FaultInjectError
+
+                    try:
+                        length = min(int(self.headers.get(
+                            "Content-Length", 0)), MAX_BODY)
+                        msg = json.loads(self.rfile.read(length) or b"{}")
+                        if not isinstance(msg, dict):
+                            raise ValueError("fault body must be an "
+                                             "object")
+                        self._reply(200, service._on_fault(msg))
+                    except (ValueError, OSError,
+                            FaultInjectError) as e:
+                        self._reply(400, {"error": f"bad fault: {e}"})
+                    return
                 if path not in ("/hb", "/join"):
                     self._reply(404, {"error": "unknown path",
                                       "paths": ["/hb", "/join", "/drain",
-                                                "/profile"]})
+                                                "/profile", "/fault"]})
                     return
                 if service._on_heartbeat is None:
                     self._reply(501, {"error": "no heartbeat sink"})

@@ -40,11 +40,29 @@ incumbent (first observed) keeps the rank and the newcomer is rejected.
 No clock comparison, no address ordering — the same inputs produce the
 same winner on every host.
 
+The same determinism carries two fleet-wide derivations every host
+computes locally from its own view (no consensus round):
+
+- :meth:`Membership.rendezvous` — the agreed rendezvous is simply the
+  **lowest active rank**.  Which host holds a rank is already settled
+  by the incarnation tie-breaks above, so converged views elect the
+  same host everywhere; when the configured coordinator (rank 0 by
+  convention) dies, the election degrades to the next-lowest active
+  rank with no extra protocol — that *is* the failover.
+- :meth:`Membership.shares` — each host advertises a capacity weight
+  on its heartbeats; a host's traffic share is its weight over the sum
+  across routable (joining/active) hosts.  A joiner's weight enters
+  the denominator the moment it is routable and an evicted/draining
+  host's weight leaves it — live rebalancing falls out of membership
+  plus the LB's 200/503 contract, again with no added protocol.
+
 Exported metrics (consumed by the health endpoint and any scraper):
 ``fleet_hosts_{joining,active,suspect,draining,departed}`` gauges (the
 local host counts toward its own state), per-peer
-``fleet_peer{rank}_state`` / ``fleet_peer{rank}_hb_age_ms`` gauges, and
-the ``fleet_evictions`` counter.
+``fleet_peer{rank}_state`` / ``fleet_peer{rank}_hb_age_ms`` /
+``fleet_peer{rank}_share`` gauges, the ``fleet_rendezvous_rank`` gauge
+(-1 while no active host is known), and the ``fleet_evictions``
+counter.
 """
 
 from __future__ import annotations
@@ -93,6 +111,7 @@ class PeerView:
     incarnation: int = 0
     last_hb: float = 0.0
     evicted: bool = False
+    capacity: float = 1.0  # advertised traffic weight (heartbeat-borne)
 
 
 class Membership:
@@ -105,10 +124,15 @@ class Membership:
                  suspect_ms: int = DEFAULT_SUSPECT_MS,
                  evict_ms: int = DEFAULT_EVICT_MS,
                  depart_ms: int = DEFAULT_DEPART_MS,
+                 capacity: float = 1.0,
                  clock=time.monotonic, registry=None):
         if suspect_ms >= evict_ms:
             raise ValueError("suspect_ms must be < evict_ms "
                              "(suspect is the rung before eviction)")
+        if capacity <= 0:
+            raise ValueError("capacity must be > 0 (a zero-weight host "
+                             "would advertise itself unroutable while "
+                             "answering healthz 200)")
         self.rank = rank
         self.suspect_ms = suspect_ms
         self.evict_ms = evict_ms
@@ -123,7 +147,8 @@ class Membership:
         self._peers: Dict[int, PeerView] = {}
         self._peers[rank] = PeerView(rank=rank, addr=addr, state=JOINING,
                                      incarnation=incarnation,
-                                     last_hb=self._clock())
+                                     last_hb=self._clock(),
+                                     capacity=float(capacity))
         self.transitions: List[Tuple[float, int, str, str]] = []
         with self._lock:
             self._publish_gauges()
@@ -147,6 +172,7 @@ class Membership:
     def _publish_gauges(self) -> None:
         counts = {s: 0 for s in STATES}
         now = self._clock()
+        shares = self._shares_locked()
         for peer in self._peers.values():
             counts[peer.state] += 1
             self._metrics.set_gauge(f"fleet_peer{peer.rank}_state",
@@ -155,8 +181,13 @@ class Membership:
                 (now - peer.last_hb) * 1000.0
             self._metrics.set_gauge(f"fleet_peer{peer.rank}_hb_age_ms",
                                     round(age_ms, 1))
+            self._metrics.set_gauge(f"fleet_peer{peer.rank}_share",
+                                    shares.get(peer.rank, 0.0))
         for state, n in counts.items():
             self._metrics.set_gauge(f"fleet_hosts_{state}", n)
+        rdv = self._rendezvous_locked()
+        self._metrics.set_gauge("fleet_rendezvous_rank",
+                                rdv[0] if rdv is not None else -1)
 
     # -- local lifecycle ---------------------------------------------------
     @property
@@ -165,7 +196,8 @@ class Membership:
             peer = self._peers[self.rank]
             return PeerView(rank=peer.rank, addr=peer.addr, state=peer.state,
                             incarnation=peer.incarnation,
-                            last_hb=peer.last_hb, evicted=peer.evicted)
+                            last_hb=peer.last_hb, evicted=peer.evicted,
+                            capacity=peer.capacity)
 
     def activate(self) -> None:
         """Local host is up (service listening): joining → active."""
@@ -198,7 +230,8 @@ class Membership:
 
     # -- peer observations -------------------------------------------------
     def note_heartbeat(self, rank: int, addr: str, state: str = ACTIVE,
-                       incarnation: int = 0) -> bool:
+                       incarnation: int = 0,
+                       capacity: Optional[float] = None) -> bool:
         """One direct liveness proof (inbound heartbeat, or a reply to
         ours).  Returns False when the claim loses its tie-break and was
         ignored (stale incarnation, or a rank collision the incumbent
@@ -208,12 +241,15 @@ class Membership:
             # our rank never rewrites it (see view_of/local_rejoin for
             # how an evicted host learns its fate)
             return False
+        capacity = self._clean_capacity(capacity)
         with self._lock:
             peer = self._peers.get(rank)
             if peer is None:
                 peer = PeerView(rank=rank, addr=addr,
                                 incarnation=incarnation,
-                                last_hb=self._clock())
+                                last_hb=self._clock(),
+                                capacity=capacity if capacity is not None
+                                else 1.0)
                 self._peers[rank] = peer
                 self.transitions.append((self._clock(), rank, "", JOINING))
             else:
@@ -239,6 +275,8 @@ class Membership:
                     peer.incarnation = incarnation
                     peer.evicted = False
                 peer.addr = addr
+            if capacity is not None:
+                peer.capacity = capacity
             peer.last_hb = self._clock()
             if state == DRAINING:
                 if peer.state in (JOINING, ACTIVE, SUSPECT):
@@ -257,8 +295,22 @@ class Membership:
             self._publish_gauges()
             return True
 
+    @staticmethod
+    def _clean_capacity(capacity) -> Optional[float]:
+        """Capacity claims are remote input: non-numeric or non-positive
+        values are ignored (None = keep what we have), never propagated
+        into the share denominator."""
+        if capacity is None:
+            return None
+        try:
+            capacity = float(capacity)
+        except (TypeError, ValueError):
+            return None
+        return capacity if capacity > 0 else None
+
     def note_roster(self, rank: int, addr: str, state: str,
-                    incarnation: int = 0) -> None:
+                    incarnation: int = 0,
+                    capacity: Optional[float] = None) -> None:
         """Gossip (a roster entry relayed by another host): introduces
         *new* peers, but never overrides a state we learned first-hand —
         only direct heartbeats move an already-known peer.  Live gossip
@@ -270,6 +322,7 @@ class Membership:
         eviction by every fresh joiner."""
         if rank == self.rank or state not in STATES:
             return
+        capacity = self._clean_capacity(capacity)
         entry_state = state if state in (DRAINING, DEPARTED) else JOINING
         with self._lock:
             if rank in self._peers:
@@ -277,7 +330,9 @@ class Membership:
             self._peers[rank] = PeerView(rank=rank, addr=addr,
                                          state=entry_state,
                                          incarnation=incarnation,
-                                         last_hb=self._clock())
+                                         last_hb=self._clock(),
+                                         capacity=capacity
+                                         if capacity is not None else 1.0)
             self.transitions.append((self._clock(), rank, "", entry_state))
             self._publish_gauges()
 
@@ -351,7 +406,45 @@ class Membership:
                 return None
             return PeerView(rank=peer.rank, addr=peer.addr, state=peer.state,
                             incarnation=peer.incarnation,
-                            last_hb=peer.last_hb, evicted=peer.evicted)
+                            last_hb=peer.last_hb, evicted=peer.evicted,
+                            capacity=peer.capacity)
+
+    # -- fleet-wide derivations (deterministic, no consensus round) --------
+    def _rendezvous_locked(self) -> Optional[Tuple[int, str]]:
+        best = None
+        for peer in self._peers.values():
+            if peer.state == ACTIVE and (best is None
+                                         or peer.rank < best.rank):
+                best = peer
+        return None if best is None else (best.rank, best.addr)
+
+    def rendezvous(self) -> Optional[Tuple[int, str]]:
+        """The agreed rendezvous: ``(rank, addr)`` of the lowest
+        *active* rank in this host's view (None while nobody is
+        active).  Deterministic on converged views — which host holds a
+        rank is settled by the incarnation tie-breaks, so every host
+        elects the same winner from the same facts; the configured
+        coordinator dying simply shifts the election to the next-lowest
+        active rank (the failover)."""
+        with self._lock:
+            return self._rendezvous_locked()
+
+    def _shares_locked(self) -> Dict[int, float]:
+        routable = [p for p in self._peers.values()
+                    if p.state in (JOINING, ACTIVE)]
+        total = sum(p.capacity for p in routable)
+        if total <= 0:
+            return {}
+        return {p.rank: round(p.capacity / total, 4) for p in routable}
+
+    def shares(self) -> Dict[int, float]:
+        """Per-host traffic share: advertised capacity weight over the
+        sum across *routable* (joining/active — the healthz-200 set)
+        hosts.  A joiner absorbs its share the moment it is routable; a
+        draining/evicted host's weight redistributes across survivors —
+        live rebalancing as a pure function of membership."""
+        with self._lock:
+            return self._shares_locked()
 
     def heartbeat_targets(self) -> List[Tuple[int, str]]:
         """(rank, addr) of every remote peer worth heartbeating — the
@@ -365,6 +458,7 @@ class Membership:
         gossip payload carried on heartbeat replies."""
         now = self._clock()
         with self._lock:
+            shares = self._shares_locked()
             out = []
             for peer in sorted(self._peers.values(), key=lambda p: p.rank):
                 age_ms = 0.0 if peer.rank == self.rank else \
@@ -376,6 +470,8 @@ class Membership:
                     "incarnation": peer.incarnation,
                     "hb_age_ms": round(age_ms, 1),
                     "evicted": peer.evicted,
+                    "capacity": peer.capacity,
+                    "share": shares.get(peer.rank, 0.0),
                 })
             return out
 

@@ -1,0 +1,184 @@
+"""Durable fleet roster: the crash-safe journal half of rendezvous
+failover.
+
+The coordinator address (``input.tpu_fleet_coordinator``) is only the
+*bootstrap* rendezvous — PR 9 deliberately made its death harmless to
+the running fleet, but a brand-new (or rebooted) host still had nobody
+else to dial.  This module closes that hole: each host journals the
+gossiped roster to ``input.tpu_fleet_roster_path`` whenever it changes,
+and a booting host loads the journal as bootstrap candidates — when the
+configured coordinator is unreachable it simply walks the persisted
+peers, whose replies carry the live roster and the currently elected
+rendezvous.
+
+Write discipline is crash-safe atomic rewrite (the metrics reporter /
+AOT manifest idiom): serialize to a sibling temp file, ``fsync``, then
+``os.replace`` — a SIGKILL mid-save leaves the *previous* journal
+intact, never a half-written one.  Loads are strict: a corrupt,
+truncated, or wrong-format file is counted
+(``fleet_roster_load_errors``), reported once, and ignored — the host
+falls back to the plain coordinator walk, exactly as if the journal
+never existed (clean re-rendezvous, no crash).
+
+Volatile fields (heartbeat ages, computed shares) are stripped before
+the journal is written: the journal records *who exists where at which
+incarnation*, not a point-in-time liveness opinion — liveness is
+re-proven by dialing.
+
+The ``roster_corrupt`` fault site (``utils/faultinject.py``) makes a
+firing save write a deliberately truncated journal instead — the chaos
+harness uses it to prove the corrupt-file path above end to end.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..utils import faultinject
+from ..utils.metrics import registry as _global_registry
+
+ROSTER_FORMAT = 1
+
+# entry fields persisted per peer (everything else the roster() payload
+# carries — hb_age_ms, share — is volatile and re-derived after boot)
+_ENTRY_FIELDS = ("rank", "addr", "state", "incarnation", "capacity",
+                 "evicted")
+
+_VALID_STATES = frozenset(
+    ("joining", "active", "suspect", "draining", "departed"))
+
+
+def _clean_entry(entry: dict) -> Optional[dict]:
+    """One validated, volatile-field-free journal entry; None when the
+    entry is not a plausible peer (a corrupt journal must degrade to
+    'no journal', never to a crash or a poisoned membership)."""
+    try:
+        out = {
+            "rank": int(entry["rank"]),
+            "addr": str(entry["addr"]),
+            "state": str(entry["state"]),
+            "incarnation": int(entry.get("incarnation", 0)),
+            "capacity": float(entry.get("capacity", 1.0)),
+            "evicted": bool(entry.get("evicted", False)),
+        }
+    except (KeyError, TypeError, ValueError):
+        return None
+    if out["rank"] < 0 or out["state"] not in _VALID_STATES \
+            or not out["addr"]:
+        return None
+    return out
+
+
+class RosterStore:
+    """One host's roster journal (``input.tpu_fleet_roster_path``)."""
+
+    def __init__(self, path: str, registry=None):
+        self.path = path
+        self._registry = registry if registry is not None \
+            else _global_registry
+        self._last_signature: Optional[Tuple] = None
+        # the ticker (_fleet_watch per tick) and the drain path
+        # (enter_draining/shutdown, signal or HTTP thread) both save;
+        # unserialized they would share ONE tmp file and os.replace a
+        # mixed-content journal — corrupting it exactly at drain, the
+        # moment the next boot needs it most
+        self._lock = threading.Lock()
+
+    # -- save --------------------------------------------------------------
+    def _signature(self, entries: List[dict]) -> Tuple:
+        return tuple(tuple(e[f] for f in _ENTRY_FIELDS) for e in entries)
+
+    def maybe_save(self, roster: List[dict], rank: int,
+                   rendezvous: Optional[Dict[str, object]]) -> bool:
+        """Persist when the durable part of the roster changed since the
+        last save (heartbeat ages churn every tick; identity does not).
+        Returns True when a write happened."""
+        entries = [e for e in (_clean_entry(r) for r in roster)
+                   if e is not None]
+        sig = self._signature(entries)
+        with self._lock:
+            return self._save_locked(entries, sig, rank, rendezvous)
+
+    def _save_locked(self, entries: List[dict], sig: Tuple, rank: int,
+                     rendezvous: Optional[Dict[str, object]]) -> bool:
+        if sig == self._last_signature:
+            return False
+        doc = {
+            "format": ROSTER_FORMAT,
+            "saved_ts": round(time.time(), 3),
+            "saved_by_rank": rank,
+            "rendezvous": rendezvous,
+            "roster": entries,
+        }
+        body = json.dumps(doc, indent=1).encode()
+        if faultinject.enabled() and faultinject.fire("roster_corrupt"):
+            # deterministic journal corruption: write a truncated
+            # prefix (still atomically — the corruption under test is
+            # the CONTENT, not a torn write, which os.replace already
+            # rules out)
+            body = body[:max(8, len(body) // 3)]
+            print("faultinject: roster_corrupt firing — truncated "
+                  f"journal written to {self.path}", file=sys.stderr)
+        tmp = f"{self.path}.tmp.{os.getpid()}"
+        try:
+            with open(tmp, "wb") as fd:
+                fd.write(body)
+                fd.flush()
+                os.fsync(fd.fileno())
+            os.replace(tmp, self.path)
+        except OSError as e:
+            # a full/readonly volume must not take the ticker down: the
+            # fleet keeps running on gossip alone, the journal is a
+            # bootstrap optimization
+            print(f"fleet-roster: cannot journal to {self.path} ({e})",
+                  file=sys.stderr)
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass  # flowcheck: disable=FC04 -- best-effort temp cleanup
+            return False
+        self._last_signature = sig
+        self._registry.inc("fleet_roster_saves")
+        return True
+
+    # -- load --------------------------------------------------------------
+    def load(self) -> Optional[List[dict]]:
+        """The journaled entries, or None when there is no usable
+        journal (missing file, corrupt/partial JSON, wrong format, no
+        valid entries).  Corruption is counted and reported once; the
+        caller falls back to the coordinator walk."""
+        try:
+            with open(self.path, "rb") as fd:
+                raw = fd.read()
+        except FileNotFoundError:
+            return None
+        except OSError as e:
+            self._registry.inc("fleet_roster_load_errors")
+            print(f"fleet-roster: cannot read {self.path} ({e}); "
+                  "booting without bootstrap candidates", file=sys.stderr)
+            return None
+        try:
+            doc = json.loads(raw)
+            if not isinstance(doc, dict) \
+                    or doc.get("format") != ROSTER_FORMAT \
+                    or not isinstance(doc.get("roster"), list):
+                raise ValueError("not a roster journal")
+        except ValueError as e:
+            self._registry.inc("fleet_roster_load_errors")
+            print(f"fleet-roster: {self.path} is corrupt ({e}); "
+                  "ignoring it and re-rendezvousing cleanly",
+                  file=sys.stderr)
+            return None
+        entries = [e for e in (_clean_entry(r) for r in doc["roster"])
+                   if e is not None]
+        if not entries:
+            self._registry.inc("fleet_roster_load_errors")
+            print(f"fleet-roster: {self.path} carries no usable peers; "
+                  "ignoring it", file=sys.stderr)
+            return None
+        return entries

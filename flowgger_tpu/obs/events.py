@@ -23,6 +23,12 @@ reason                     fired by
 ``tenant_shed``            tenancy/admission.py token-bucket denial
 ``queue_drop``             utils/bounded_queue.py + tenancy/fairqueue.py
                            shed/drop (cause + tenant attributed)
+``rendezvous_failover``    fleet/federation.py — the agreed rendezvous
+                           (lowest active rank) moved to another host
+``fleet_rebalance``        fleet/federation.py — per-host traffic shares
+                           redistributed (join/drain/eviction/capacity)
+``roster_restore``         fleet/federation.py — boot used the durable
+                           roster journal as bootstrap candidates
 =========================  =================================================
 
 Each event carries ``(ts, site, reason)`` plus whatever context the
@@ -76,6 +82,9 @@ REASONS = (
     "device_error",
     "tenant_shed",
     "queue_drop",
+    "rendezvous_failover",
+    "fleet_rebalance",
+    "roster_restore",
 )
 _REASON_SET = frozenset(REASONS)
 

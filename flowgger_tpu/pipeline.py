@@ -291,6 +291,15 @@ class Pipeline:
             from .tpu.device_common import setup_compile_cache
 
             setup_compile_cache(config)
+            if self.fleet is not None:
+                # advertised fleet capacity defaults to the resolved
+                # lane count: a 4-chip host should absorb 4x a 1-chip
+                # host's traffic share unless input.tpu_fleet_capacity
+                # pins something else (fleet/membership.py shares())
+                from .tpu.overlap import resolve_lanes
+
+                lanes, _ = resolve_lanes(config)
+                self.fleet.set_default_capacity(float(lanes))
 
     def handler_factory(self, peer=None):
         """Per-connection handler.  ``peer`` is the transport's source

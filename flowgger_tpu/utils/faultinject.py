@@ -27,15 +27,30 @@ Sites wired in (each names the exception type it surfaces):
   deny as if their token bucket were empty (unlimited tenants never
   check the site, so a plan targets exactly the tenants a test marks
   with a finite rate — see tenancy/admission.py);
-- ``peer_partition`` — the fleet heartbeat receiver drops the inbound
-  exchange as if the network ate it (the sender sees a failed
-  delivery).  Checked once per inbound heartbeat; set
+- ``peer_partition`` — the fleet heartbeat layer drops exchanges in
+  BOTH directions at the armed host: outbound sends are suppressed,
+  inbound exchanges are refused as if the network ate them (the
+  sender sees a failed delivery), and stray replies are discarded.
+  Checked per send target and per inbound heartbeat; set
   ``FLOWGGER_PARTITION_PEER=<rank>`` to partition only the named peer
   (absent = every peer) — see fleet/federation.py;
 - ``host_kill``      — the fleet ticker SIGKILLs its own process on the
   firing tick: a deterministic hard host loss (no drain, no goodbye)
   for the multi-process acceptance tests.  ``once:N`` kills on the Nth
-  tick, i.e. ~N x tpu_fleet_heartbeat_ms after fleet start.
+  tick, i.e. ~N x tpu_fleet_heartbeat_ms after fleet start;
+- ``coordinator_kill`` — like ``host_kill`` but self-selecting: only
+  checked while this host *is* the fleet's agreed rendezvous (lowest
+  active rank), so arming it fleet-wide kills exactly the coordinator —
+  the rendezvous-failover drill (see fleet/federation.py);
+- ``roster_corrupt`` — the next durable-roster journal write
+  (fleet/roster.py) writes a deliberately truncated file instead: the
+  corrupt-journal → clean-re-rendezvous path, end to end.
+
+Runtime arming: beyond the boot-time plan below, ``set_site`` merges
+one site into the active plan while the process runs — the fleet
+health endpoint's ``POST /fault`` leg (``input.tpu_fleet_chaos = true``
+only) exposes it so ``tools/chaos.py`` can drive fault drills against
+long-running hosts.
 
 Counters are per-site, process-wide, and thread-safe; numbering is
 1-based (``once:1`` fires on the first check).  The module is inert —
@@ -53,7 +68,7 @@ ENV_VAR = "FLOWGGER_FAULTS"
 
 KNOWN_SITES = ("device_decode", "input_socket", "sink_write",
                "queue_pressure", "tenant_flood", "peer_partition",
-               "host_kill")
+               "host_kill", "coordinator_kill", "roster_corrupt")
 
 
 class InjectedFault(Exception):
@@ -83,6 +98,7 @@ class FaultPlan:
     def __init__(self, specs: Dict[str, str]):
         self._rules: Dict[str, Tuple[str, int]] = {}
         self._counts: Dict[str, int] = {}
+        self._specs = dict(specs)  # raw specs, so set_site can merge
         self._lock = threading.Lock()
         for site, spec in specs.items():
             parsed = _parse_spec(site, spec)
@@ -139,6 +155,25 @@ def configure(specs: Dict[str, str]) -> None:
     """Install a plan directly (tests / programmatic chaos runs)."""
     global _plan
     _plan = FaultPlan(specs) if specs else None
+
+
+def set_site(site: str, spec: str) -> None:
+    """Runtime (chaos) arming: merge ONE site into the active plan —
+    other sites keep their specs but every counter restarts, so each
+    arm is a fresh deterministic drill (``once:1`` = the next check).
+    ``spec = "off"`` disarms the site.  Raises ``FaultInjectError`` on
+    an unknown site or malformed spec, exactly like configure_from."""
+    if site not in KNOWN_SITES:
+        raise FaultInjectError(
+            f"unknown fault site [{site}] (known: "
+            f"{', '.join(KNOWN_SITES)})")
+    _parse_spec(site, spec)  # validate before touching the plan
+    specs = dict(_plan._specs) if _plan is not None else {}
+    if spec.strip().lower() in ("off", "none", ""):
+        specs.pop(site, None)
+    else:
+        specs[site] = spec
+    configure(specs)
 
 
 def configure_from(config) -> None:

@@ -61,9 +61,12 @@ overflow ids fold into ``tenant_{name}_template_overflow``).
 
 Fleet federation (fleet/): ``fleet_hosts_{state}`` gauges (the local
 host counts toward its own state), per-peer ``fleet_peer{rank}_state``
-(0..4 in ladder order) and ``fleet_peer{rank}_hb_age_ms`` gauges, plus
-the ``fleet_evictions`` / ``fleet_rejoins`` / ``fleet_hb_send_errors``
-counters.  The whole ``snapshot()`` is what each host's HTTP health
+(0..4 in ladder order), ``fleet_peer{rank}_hb_age_ms`` and
+``fleet_peer{rank}_share`` (capacity-weighted traffic share) gauges,
+the ``fleet_rendezvous_rank`` gauge (the elected rendezvous; -1 while
+none), plus the ``fleet_evictions`` / ``fleet_rejoins`` /
+``fleet_hb_send_errors`` / ``fleet_hb_retries`` /
+``fleet_roster_saves`` / ``fleet_roster_load_errors`` counters.  The whole ``snapshot()`` is what each host's HTTP health
 endpoint serves under ``metrics`` (fleet/health.py) — it is JSON-safe
 by construction (counters and gauges are numbers, histograms flat
 dicts), so the health document needs no second serialization layer.
@@ -135,6 +138,11 @@ _COUNTERS = (
     # gauges (fleet_hosts_{state}, fleet_peer{rank}_state,
     # fleet_peer{rank}_hb_age_ms) materialize when membership starts
     "fleet_evictions", "fleet_rejoins", "fleet_hb_send_errors",
+    # self-healing fleet (PR 14): heartbeat-POST retries before a send
+    # is declared failed (utils/retry.py full jitter), durable-roster
+    # journal writes, and corrupt/unreadable journal loads (each load
+    # error is a clean re-rendezvous, not a crash — fleet/roster.py)
+    "fleet_hb_retries", "fleet_roster_saves", "fleet_roster_load_errors",
     # degradation journal (obs/events.py): aggregate event count; the
     # per-reason family is events_{reason}
     "degradation_events",
@@ -152,7 +160,7 @@ _SECONDS_NAMES = (
 _GAUGE_NAMES = (
     "device_breaker_state", "inflight_depth", "lane_depth",
     "distinct_compiled_shapes", "framing_carry_bytes",
-    "tenant_templates_distinct",
+    "tenant_templates_distinct", "fleet_rendezvous_rank",
 )
 
 # sliding-window histogram family (observe)
@@ -173,7 +181,7 @@ _FAMILY_PATTERNS = (
     "tenant_{name}_templates_distinct",
     "tenant_{name}_template_{id}", "tenant_{name}_template_overflow",
     "fleet_hosts_{state}", "fleet_peer{rank}_state",
-    "fleet_peer{rank}_hb_age_ms",
+    "fleet_peer{rank}_hb_age_ms", "fleet_peer{rank}_share",
     "aot_rejects_{reason}",
     "fused_rows_{route}", "fused_fallbacks_{route}",
     "fetch_bytes_per_row_{route}", "emit_bytes_per_row_{route}",

@@ -53,6 +53,29 @@ def test_check_flags_malformed_entries(tmp_path):
     assert any("BENCH_r03" in b for b in bad)
 
 
+def test_check_flags_series_gaps(tmp_path):
+    """A missing BENCH_rNN between the lowest and highest committed
+    entry is a finding (the r06/r11 lesson): a new PR cannot skip its
+    snapshot silently, but a marked backfill stub closes a hole."""
+    ok_doc = '{"pr": 1, "x_lines_per_sec": 1.0}'
+    (tmp_path / "BENCH_r01.json").write_text(ok_doc)
+    (tmp_path / "BENCH_r03.json").write_text(ok_doc)
+    bad = bench_trend.check(bench_trend.load_series(str(tmp_path)))
+    assert len(bad) == 1
+    assert "BENCH_r02.json is missing" in bad[0]
+    assert "backfilled_in_pr" in bad[0]
+    # a marked stub closes the gap
+    (tmp_path / "BENCH_r02.json").write_text('{"backfilled_in_pr": 99}')
+    assert bench_trend.check(
+        bench_trend.load_series(str(tmp_path))) == []
+    # leading entries below the series start are NOT gaps (the series
+    # starts wherever it starts)
+    os.unlink(tmp_path / "BENCH_r01.json")
+    os.unlink(tmp_path / "BENCH_r02.json")
+    assert bench_trend.check(
+        bench_trend.load_series(str(tmp_path))) == []
+
+
 def test_cli_check_exit_codes(tmp_path):
     ok = subprocess.run(
         [sys.executable, os.path.join(REPO, "tools", "bench_trend.py"),

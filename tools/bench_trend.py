@@ -17,10 +17,14 @@ extracts the comparable axes:
   part of the result).
 
 ``--check`` is the CI mode: exit 2 when any BENCH file is unparseable,
-not a JSON object, or (unless it is a marked backfill stub) carries no
-recognizable metric at all — so a malformed new BENCH entry fails fast
-instead of silently breaking the series.  ``--json`` emits the rows as
-one machine-readable line.
+not a JSON object, (unless it is a marked backfill stub) carries no
+recognizable metric at all, or when the series has a **gap** — a
+missing ``BENCH_rNN.json`` between the lowest and highest committed
+entry.  Two holes (r06, r11) slipped through historically and each
+cost a later PR an archaeology satellite; a gap now fails fast in the
+PR that creates it, while an honest hole can still be closed with an
+explicitly-marked metadata stub (``backfilled_in_pr``, the r06/r11
+precedent).  ``--json`` emits the rows as one machine-readable line.
 """
 
 from __future__ import annotations
@@ -116,6 +120,15 @@ def check(rows) -> list:
     bad = []
     if not rows:
         return ["no BENCH_r*.json files found"]
+    nums = sorted(int(m.group(1)) for m in
+                  (re.search(r"BENCH_r(\d+)\.json$", name)
+                   for name, _, _ in rows) if m)
+    for missing in sorted(set(range(nums[0], nums[-1] + 1)) - set(nums)):
+        bad.append(
+            f"series gap: BENCH_r{missing:02d}.json is missing between "
+            f"r{nums[0]:02d} and r{nums[-1]:02d} — commit the PR's bench "
+            "snapshot, or close an honest hole with an explicitly-marked "
+            "metadata stub (backfilled_in_pr, the r06/r11 precedent)")
     for name, doc, err in rows:
         if err is not None:
             bad.append(f"{name}: {err}")

@@ -1,0 +1,672 @@
+#!/usr/bin/env python3
+"""chaos — the self-healing-fleet drill harness (ISSUE 14 tentpole).
+
+Runs a real N-process localhost fleet under sustained ingest and
+injects one fault after another through the deterministic
+``utils/faultinject.py`` sites, asserting after EVERY event that the
+fleet reconverges — with zero operator action — within a bounded
+window:
+
+- every survivor answers ``GET /healthz`` 200 with all live hosts
+  active in its view;
+- all survivors agree on ONE rendezvous, and it is the lowest live
+  active rank (``fleet.rendezvous`` in the health document);
+- traffic shares over the routable set sum to ~1 on every survivor
+  (the live-rebalance contract);
+- no lost lines: every host's fsynced output is a clean prefix of its
+  deterministic reference stream, and survivors' outputs keep growing
+  (ingest never stopped);
+- the transitions are journaled: ``rendezvous_failover`` /
+  ``fleet_rebalance`` / ``roster_restore`` events (obs/events.py) are
+  observable through the survivors' health documents.
+
+Fault sites exercised (armed at runtime over the chaos-only
+``POST /fault`` leg — workers run with ``tpu_fleet_chaos = true``):
+
+``host_kill``         SIGKILL a non-rendezvous host mid-stream; the
+                      survivors evict it, shares redistribute, and a
+                      replacement (same rank, same roster journal)
+                      boots one incarnation later and is re-admitted.
+``coordinator_kill``  SIGKILL the host currently holding the
+                      rendezvous (the site self-selects); survivors
+                      elect the next-lowest active rank, and a
+                      BRAND-NEW host (fresh journal) must join through
+                      the fallback rendezvous.
+``peer_partition``    cut one host off (inbound 503 + outbound replies
+                      dropped) long enough to be seen suspect, then
+                      heal; suspicion must cure without data loss.
+``roster_corrupt``    truncate a host's next roster-journal write,
+                      then drain it (SIGTERM); its replacement must
+                      boot CLEANLY off the corrupt journal
+                      (``fleet_roster_load_errors`` counted, plain
+                      coordinator walk, reconverges).
+
+Usage::
+
+    python tools/chaos.py [--hosts 3] [--events 4] [--window 60]
+                          [--sites coordinator_kill,host_kill,...]
+                          [--json] [--keep-dir]
+
+``--events K`` cycles K events through ``--sites`` and exits 0 only if
+every drill reconverged and every integrity check held.  ``--json``
+prints one machine-readable report line (bench.py consumes
+``max_reconverge_s`` for the BENCH_r14 gate).
+
+Internal: ``--worker ...`` is one fleet host (scalar rfc5424→GELF over
+a deterministic per-(rank, generation) stream, fsynced per chunk,
+fleet heartbeats alongside) — spawned by the harness, never by hand.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# worker fleet timings: fast enough that the full missed-heartbeat
+# ladder (evict + depart ~= 2.5s) fits many drills into one CI step,
+# slow enough that a loaded 2-core container's scheduling jitter
+# cannot fake a missed heartbeat (suspect >> heartbeat)
+HB_MS, SUSPECT_MS, EVICT_MS, DEPART_MS, REJOIN_MS = 150, 900, 2200, 900, 200
+CHUNK_LINES = 16
+CHUNK_SLEEP_S = 0.06  # ~270 lines/s/host of sustained ingest
+
+DEFAULT_SITES = ("coordinator_kill", "host_kill", "peer_partition",
+                 "roster_corrupt")
+
+
+def _line(rank: int, gen: int, i: int) -> str:
+    """Deterministic line ``i`` of host ``rank``'s generation ``gen``
+    stream — the harness regenerates the same stream to verify clean
+    prefixes, so nothing here may depend on time or randomness."""
+    return (f"<{(5 * i + rank) % 192}>1 2023-09-20T12:35:45.{i % 1000:03d}Z "
+            f"chaos{rank} app{i % 7} {i % 1000} MSGID "
+            f'[ex@32473 k="{i}" gen="{gen}"] host {rank} gen {gen} '
+            f"line {i}")
+
+
+# --------------------------------------------------------------- worker
+
+def worker_main(args) -> int:
+    """One chaos fleet host (see module doc).  Streams its generation's
+    lines forever; SIGTERM = drain-on-departure and clean exit."""
+    sys.path.insert(0, _REPO)
+    from flowgger_tpu.config import Config
+    from flowgger_tpu.decoders.rfc5424 import RFC5424Decoder
+    from flowgger_tpu.encoders.gelf import GelfEncoder
+    from flowgger_tpu.fleet import Fleet
+    from flowgger_tpu.mergers import LineMerger
+
+    coord = ("" if args.coordinator == "none" else
+             f'tpu_fleet_coordinator = "{args.coordinator}"\n')
+    roster = ("" if args.roster == "none" else
+              f'tpu_fleet_roster_path = "{args.roster}"\n')
+    cfg = Config.from_string(
+        f"[input]\ntpu_fleet = true\ntpu_fleet_rank = {args.rank}\n"
+        f"tpu_fleet_hosts = {args.hosts}\n"
+        f"tpu_fleet_port = {args.port}\n{coord}{roster}"
+        "tpu_fleet_chaos = true\n"
+        f"tpu_fleet_heartbeat_ms = {HB_MS}\n"
+        f"tpu_fleet_suspect_ms = {SUSPECT_MS}\n"
+        f"tpu_fleet_evict_ms = {EVICT_MS}\n"
+        f"tpu_fleet_depart_ms = {DEPART_MS}\n"
+        f"tpu_fleet_rejoin_backoff_ms = {REJOIN_MS}\n")
+    fleet = Fleet.from_config(cfg)
+    fleet.start()
+
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    parent = os.getppid()
+
+    decoder, encoder, merger = (RFC5424Decoder(),
+                                GelfEncoder(Config.from_string("")),
+                                LineMerger())
+    i = 0
+    with open(args.out, "wb") as fd:
+        while not stop.is_set():
+            if os.getppid() != parent:
+                # the harness died without tearing us down (external
+                # timeout SIGKILL): a chaos worker must never outlive
+                # its run — orphans would fsync forever and tax every
+                # later gate on a shared box
+                print("chaos-worker: harness gone, draining out",
+                      file=sys.stderr)
+                stop.set()
+                break
+            for _ in range(CHUNK_LINES):
+                fd.write(merger.frame(encoder.encode(
+                    decoder.decode(_line(args.rank, args.gen, i)))))
+                i += 1
+            # fsync per chunk: whatever a SIGKILL leaves on disk must
+            # be an uncorrupted prefix of the reference stream
+            fd.flush()
+            os.fsync(fd.fileno())
+            stop.wait(CHUNK_SLEEP_S)
+        fd.flush()
+        os.fsync(fd.fileno())
+    fleet.enter_draining()
+    fleet.shutdown()
+    print(json.dumps({"rank": args.rank, "gen": args.gen, "lines": i}),
+          flush=True)
+    return 0
+
+
+# -------------------------------------------------------------- harness
+
+class Host:
+    """One live worker process the harness tracks."""
+
+    def __init__(self, rank: int, gen: int, port: int, proc, out_path,
+                 log_path, roster_path):
+        self.rank = rank
+        self.gen = gen
+        self.port = port
+        self.proc = proc
+        self.out_path = out_path
+        self.log_path = log_path
+        self.roster_path = roster_path
+        self.last_size = 0
+
+
+class ChaosError(AssertionError):
+    pass
+
+
+class Harness:
+    def __init__(self, hosts: int, window: float, workdir: str,
+                 verbose: bool = True):
+        self.n = hosts
+        self.window = window
+        self.dir = workdir
+        self.verbose = verbose
+        self.hosts: dict = {}  # rank -> Host
+        self._ref_cache: dict = {}  # (rank, gen) -> bytes built so far
+        self._ref_idx: dict = {}
+        self._encode = None
+
+    def log(self, msg: str) -> None:
+        if self.verbose:
+            print(f"chaos: {msg}", file=sys.stderr, flush=True)
+
+    # -- worker lifecycle --------------------------------------------------
+    def _free_port(self) -> int:
+        import socket
+
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    def spawn(self, rank: int, gen: int, coordinator: str,
+              fresh_roster: bool = False) -> Host:
+        port = self._free_port()
+        out = os.path.join(self.dir, f"out_r{rank}_g{gen}.bin")
+        log = os.path.join(self.dir, f"log_r{rank}_g{gen}.txt")
+        roster = os.path.join(
+            self.dir,
+            f"roster_r{rank}{f'_g{gen}' if fresh_roster else ''}.json")
+        env = {k: v for k, v in os.environ.items()
+               if k not in ("FLOWGGER_FAULTS", "FLOWGGER_PARTITION_PEER")}
+        env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+        with open(log, "ab") as logfd:
+            proc = subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__), "--worker",
+                 "--rank", str(rank), "--hosts", str(self.n),
+                 "--port", str(port), "--coordinator", coordinator,
+                 "--roster", roster, "--out", out, "--gen", str(gen)],
+                env=env, cwd=_REPO, stdout=logfd,
+                stderr=subprocess.STDOUT)
+        host = Host(rank, gen, port, proc, out, log, roster)
+        self.hosts[rank] = host
+        self.log(f"spawned rank {rank} gen {gen} (port {port}, "
+                 f"coordinator {coordinator})")
+        return host
+
+    def sigterm(self, host: Host, wait_s: float = 20.0) -> None:
+        host.proc.send_signal(signal.SIGTERM)
+        try:
+            rc = host.proc.wait(timeout=wait_s)
+        except subprocess.TimeoutExpired:
+            host.proc.kill()
+            raise ChaosError(
+                f"rank {host.rank}: SIGTERM drain never finished "
+                f"({self._tail(host)})")
+        if rc != 0:
+            raise ChaosError(f"rank {host.rank}: drain exit {rc} "
+                             f"({self._tail(host)})")
+
+    def _tail(self, host: Host, n: int = 12) -> str:
+        try:
+            with open(host.log_path, "rb") as fd:
+                return b"\n".join(
+                    fd.read().splitlines()[-n:]).decode(errors="replace")
+        except OSError:
+            return "<no log>"
+
+    # -- health polling ----------------------------------------------------
+    def health(self, host: Host):
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{host.port}/healthz",
+                    timeout=2) as resp:
+                return resp.status, json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            try:
+                return e.code, json.loads(e.read())
+            except (ValueError, OSError):
+                return e.code, None
+        except (OSError, ValueError):
+            return None, None
+
+    def post_fault(self, host: Host, site: str, spec: str) -> None:
+        body = json.dumps({"site": site, "spec": spec}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{host.port}/fault", data=body,
+            method="POST", headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            doc = json.loads(resp.read())
+            if not doc.get("ok"):
+                raise ChaosError(f"fault arm refused: {doc}")
+        self.log(f"armed [{site}={spec}] on rank {host.rank}")
+
+    # -- convergence predicate --------------------------------------------
+    def _converged_view(self, doc, live_ranks) -> bool:
+        if doc is None:
+            return False
+        fleet = doc.get("fleet", {})
+        peers = {p["rank"]: p for p in fleet.get("peers", [])}
+        if not all(r in peers and peers[r]["state"] == "active"
+                   for r in live_ranks):
+            return False
+        # no ghost actives: everything not live must be non-routable
+        for r, p in peers.items():
+            if r not in live_ranks and p["state"] in ("joining", "active"):
+                return False
+        rdv = fleet.get("rendezvous", {})
+        if rdv.get("rank") != min(live_ranks):
+            return False
+        shares = fleet.get("shares", {})
+        if set(shares) != {str(r) for r in live_ranks}:
+            return False
+        if abs(sum(shares.values()) - 1.0) > 0.01:
+            return False
+        return True
+
+    def wait_converged(self, note: str, deadline_s: float = None) -> float:
+        """Block until EVERY live host's health document shows all live
+        hosts active, one agreed rendezvous (the lowest live rank), and
+        shares summing to 1 over exactly the live set.  Returns the
+        seconds it took."""
+        deadline_s = self.window if deadline_s is None else deadline_s
+        live = sorted(self.hosts)
+        t0 = time.monotonic()
+        last_bad = "no poll yet"
+        while time.monotonic() - t0 < deadline_s:
+            oks = 0
+            for rank in live:
+                status, doc = self.health(self.hosts[rank])
+                if status == 200 and self._converged_view(doc, live):
+                    oks += 1
+                else:
+                    last_bad = (f"rank {rank}: status={status} "
+                                f"doc={'yes' if doc else 'no'}")
+            if oks == len(live):
+                dt = time.monotonic() - t0
+                self.log(f"reconverged after {note} in {dt:.1f}s "
+                         f"({len(live)} hosts, rendezvous rank "
+                         f"{min(live)})")
+                return dt
+            time.sleep(0.1)
+        tails = "\n".join(f"-- rank {r}:\n{self._tail(self.hosts[r])}"
+                          for r in live)
+        raise ChaosError(
+            f"fleet failed to reconverge within {deadline_s:.0f}s after "
+            f"{note} (last: {last_bad})\n{tails}")
+
+    def wait_dead(self, host: Host, expect_sig: bool) -> None:
+        try:
+            rc = host.proc.wait(timeout=self.window)
+        except subprocess.TimeoutExpired:
+            host.proc.kill()
+            raise ChaosError(f"rank {host.rank} never died "
+                             f"({self._tail(host)})")
+        if expect_sig and rc != -9:
+            raise ChaosError(
+                f"rank {host.rank}: expected SIGKILL death, rc={rc} "
+                f"({self._tail(host)})")
+
+    # -- integrity ---------------------------------------------------------
+    def _reference_prefix(self, rank: int, gen: int, length: int) -> bytes:
+        """The first ``length`` bytes of (rank, gen)'s reference
+        stream, built incrementally and cached across checks."""
+        if self._encode is None:
+            sys.path.insert(0, _REPO)
+            from flowgger_tpu.config import Config
+            from flowgger_tpu.decoders.rfc5424 import RFC5424Decoder
+            from flowgger_tpu.encoders.gelf import GelfEncoder
+            from flowgger_tpu.mergers import LineMerger
+
+            decoder, encoder, merger = (RFC5424Decoder(),
+                                        GelfEncoder(Config.from_string("")),
+                                        LineMerger())
+            self._encode = lambda r, g, i: merger.frame(
+                encoder.encode(decoder.decode(_line(r, g, i))))
+        key = (rank, gen)
+        buf = self._ref_cache.get(key, b"")
+        i = self._ref_idx.get(key, 0)
+        while len(buf) < length:
+            buf += self._encode(rank, gen, i)
+            i += 1
+        self._ref_cache[key], self._ref_idx[key] = buf, i
+        return buf[:length]
+
+    def check_outputs(self, require_growth: bool = True) -> None:
+        """No lost lines: every live host's fsynced output is a clean
+        prefix of its reference stream — and still growing (ingest
+        survived the event)."""
+        for host in self.hosts.values():
+            data = open(host.out_path, "rb").read() \
+                if os.path.exists(host.out_path) else b""
+            want = self._reference_prefix(host.rank, host.gen, len(data))
+            if data != want:
+                raise ChaosError(
+                    f"rank {host.rank} gen {host.gen}: output is NOT a "
+                    f"clean prefix of its reference stream "
+                    f"({len(data)} bytes)")
+            if require_growth and len(data) <= host.last_size:
+                raise ChaosError(
+                    f"rank {host.rank}: ingest stalled at "
+                    f"{len(data)} bytes")
+            host.last_size = len(data)
+        self.log("output integrity: every stream is a clean, growing "
+                 "prefix")
+
+    def check_file_prefix(self, host: Host) -> None:
+        """A dead host's fsynced bytes must still be an uncorrupted
+        prefix (possibly cut mid-record by the kill)."""
+        data = open(host.out_path, "rb").read() \
+            if os.path.exists(host.out_path) else b""
+        want = self._reference_prefix(host.rank, host.gen, len(data))
+        if data != want:
+            raise ChaosError(
+                f"dead rank {host.rank} gen {host.gen}: pre-kill output "
+                "is not a clean prefix of its reference stream")
+
+    def journal_counts(self, host: Host) -> dict:
+        _, doc = self.health(host)
+        if doc is None:
+            return {}
+        return doc.get("events", {}).get("counts", {})
+
+    def metrics(self, host: Host) -> dict:
+        _, doc = self.health(host)
+        return (doc or {}).get("metrics", {})
+
+    def rendezvous_addr(self) -> str:
+        for host in self.hosts.values():
+            _, doc = self.health(host)
+            if doc is not None:
+                rdv = doc.get("fleet", {}).get("rendezvous", {})
+                if rdv.get("rank", -1) >= 0:
+                    return rdv["addr"]
+        raise ChaosError("no live host could name a rendezvous")
+
+    def require_journaled(self, reason: str) -> None:
+        """Some live host must have journaled the typed event."""
+        seen = {r: self.journal_counts(h).get(reason, 0)
+                for r, h in self.hosts.items()}
+        if not any(seen.values()):
+            raise ChaosError(
+                f"no live host journaled a {reason} event ({seen})")
+        self.log(f"journal: {reason} observed ({seen})")
+
+
+# -- the drills --------------------------------------------------------
+
+def drill_host_kill(h: Harness) -> float:
+    """SIGKILL a non-rendezvous host mid-stream; survivors reconverge
+    and rebalance; the SAME host (next generation, same roster
+    journal) boots one incarnation later and is re-admitted —
+    bootstrapping from its durable roster, not the (possibly dead)
+    configured coordinator."""
+    victim_rank = max(r for r in h.hosts
+                      if r != min(h.hosts))  # keep the rendezvous
+    victim = h.hosts[victim_rank]
+    h.post_fault(victim, "host_kill", "once:1")
+    h.wait_dead(victim, expect_sig=True)
+    t0 = time.monotonic()
+    del h.hosts[victim_rank]
+    h.check_file_prefix(victim)
+    dt = h.wait_converged(f"host_kill of rank {victim_rank}")
+    h.require_journaled("fleet_rebalance")
+    # replacement: same rank, same roster journal, dead-end
+    # coordinator ("none") — it MUST bootstrap via the persisted roster
+    h.spawn(victim_rank, victim.gen + 1, "none")
+    h.wait_converged(f"rank {victim_rank} replacement join")
+    replacement = h.hosts[victim_rank]
+    if not h.journal_counts(replacement).get("roster_restore"):
+        raise ChaosError("replacement joined without a roster_restore "
+                         "event — did it really use the journal?")
+    return dt if dt > 0 else time.monotonic() - t0
+
+
+def drill_coordinator_kill(h: Harness) -> float:
+    """SIGKILL the host holding the rendezvous (the self-selecting
+    ``coordinator_kill`` site); survivors elect the next-lowest active
+    rank as fallback, and a BRAND-NEW host (fresh journal) joins
+    through the fallback rendezvous — the ISSUE 14 acceptance drill."""
+    coord_rank = min(h.hosts)
+    coord = h.hosts[coord_rank]
+    # armed only on the host that IS the rendezvous: arming fleet-wide
+    # would cascade — each successor rendezvous would fire the site on
+    # its own first tick as coordinator
+    h.post_fault(coord, "coordinator_kill", "once:1")
+    h.wait_dead(coord, expect_sig=True)
+    t0 = time.monotonic()
+    del h.hosts[coord_rank]
+    h.check_file_prefix(coord)
+    dt = h.wait_converged(f"coordinator_kill of rank {coord_rank}")
+    h.require_journaled("rendezvous_failover")
+    h.require_journaled("fleet_rebalance")
+    # a brand-new joiner (fresh roster journal) admitted by the
+    # FALLBACK rendezvous — the coordinator everybody was configured
+    # with is dead
+    fallback = h.rendezvous_addr()
+    h.spawn(coord_rank, coord.gen + 1, fallback, fresh_roster=True)
+    h.wait_converged(
+        f"new joiner rank {coord_rank} via fallback {fallback}")
+    return dt if dt > 0 else time.monotonic() - t0
+
+
+def drill_peer_partition(h: Harness) -> float:
+    """Cut one non-rendezvous host off (both directions) long enough
+    to be seen suspect, then heal; suspicion must cure with no
+    eviction needed and no lost lines."""
+    target_rank = max(r for r in h.hosts if r != min(h.hosts))
+    target = h.hosts[target_rank]
+    h.post_fault(target, "peer_partition", "every:1")
+    deadline = time.monotonic() + h.window
+    seen = False
+    while time.monotonic() < deadline:
+        for rank, host in h.hosts.items():
+            if rank == target_rank:
+                continue
+            _, doc = h.health(host)
+            if doc is None:
+                continue
+            peers = {p["rank"]: p["state"]
+                     for p in doc["fleet"].get("peers", [])}
+            if peers.get(target_rank) == "suspect":
+                seen = True
+        if seen:
+            break
+        time.sleep(0.05)
+    if not seen:
+        raise ChaosError(
+            f"partitioned rank {target_rank} was never seen suspect")
+    h.log(f"rank {target_rank} seen suspect under partition; healing")
+    h.post_fault(target, "peer_partition", "off")
+    return h.wait_converged(f"partition heal of rank {target_rank}")
+
+
+def drill_roster_corrupt(h: Harness) -> float:
+    """Corrupt a host's roster journal via the ``roster_corrupt`` site
+    (its drain-time saves write a truncated file), drain it out, and
+    prove its replacement boots CLEANLY off the corrupt journal: the
+    load error is counted, the plain coordinator walk takes over, the
+    fleet reconverges."""
+    target_rank = max(r for r in h.hosts if r != min(h.hosts))
+    target = h.hosts[target_rank]
+    h.post_fault(target, "roster_corrupt", "every:1")
+    # voluntary drain: mark_draining/mark_departed both re-derive and
+    # journal the roster, so the armed site corrupts the file on disk
+    h.sigterm(target)
+    t0 = time.monotonic()
+    del h.hosts[target_rank]
+    dt = h.wait_converged(f"drain of rank {target_rank}")
+    # journal really is corrupt?
+    try:
+        json.loads(open(target.roster_path, "rb").read())
+        raise ChaosError("roster_corrupt armed but the journal still "
+                         "parses — the site never fired")
+    except ValueError:
+        pass
+    rdv = h.rendezvous_addr()
+    h.spawn(target_rank, target.gen + 1, rdv)
+    h.wait_converged(f"rank {target_rank} rejoin off a corrupt journal")
+    replacement = h.hosts[target_rank]
+    if not h.metrics(replacement).get("fleet_roster_load_errors"):
+        raise ChaosError("corrupt journal was not counted as a "
+                         "fleet_roster_load_errors load")
+    if h.journal_counts(replacement).get("roster_restore"):
+        raise ChaosError("corrupt journal must NOT produce a "
+                         "roster_restore event")
+    return dt if dt > 0 else time.monotonic() - t0
+
+
+DRILLS = {
+    "host_kill": drill_host_kill,
+    "coordinator_kill": drill_coordinator_kill,
+    "peer_partition": drill_peer_partition,
+    "roster_corrupt": drill_roster_corrupt,
+}
+
+
+def harness_main(args) -> int:
+    sites = [s.strip() for s in args.sites.split(",") if s.strip()]
+    unknown = [s for s in sites if s not in DRILLS]
+    if unknown:
+        print(f"chaos: unknown sites {unknown} "
+              f"(known: {', '.join(DRILLS)})", file=sys.stderr)
+        return 2
+    workdir = args.dir or tempfile.mkdtemp(prefix="flowgger_chaos_")
+    os.makedirs(workdir, exist_ok=True)
+    h = Harness(args.hosts, args.window, workdir,
+                verbose=not args.json or args.verbose)
+    report = {"metric": "chaos", "hosts": args.hosts,
+              "events": [], "ok": False}
+    t_run = time.monotonic()
+
+    def _terminated(signum, _frame):
+        # ci.sh's `timeout` sends SIGTERM: raise through the drill so
+        # the finally: below kills the worker fleet instead of
+        # orphaning it (SIGKILL can't be caught — the workers' own
+        # parent-gone check covers that path)
+        raise ChaosError(f"harness terminated by signal {signum}")
+
+    signal.signal(signal.SIGTERM, _terminated)
+    signal.signal(signal.SIGINT, _terminated)
+    try:
+        # boot the initial fleet: rank 0 is the configured coordinator
+        first = h.spawn(0, 0, "none")
+        coord_addr = f"127.0.0.1:{first.port}"
+        for rank in range(1, args.hosts):
+            h.spawn(rank, 0, coord_addr)
+        h.wait_converged("initial boot")
+        h.check_outputs(require_growth=False)
+        time.sleep(0.5)  # one ingest beat so growth checks mean something
+        for k in range(args.events):
+            site = sites[k % len(sites)]
+            h.log(f"=== event {k + 1}/{args.events}: {site} ===")
+            dt = DRILLS[site](h)
+            h.check_outputs()
+            report["events"].append(
+                {"site": site, "reconverge_s": round(dt, 2), "ok": True})
+        # clean teardown: every survivor drains byte-cleanly
+        for rank in sorted(h.hosts):
+            h.sigterm(h.hosts[rank])
+        for host in h.hosts.values():
+            data = open(host.out_path, "rb").read()
+            want = h._reference_prefix(host.rank, host.gen, len(data))
+            if data != want:
+                raise ChaosError(
+                    f"rank {host.rank}: post-drain output diverged")
+        report["ok"] = True
+    except ChaosError as e:
+        report["error"] = str(e)
+        print(f"chaos: FAILED: {e}", file=sys.stderr)
+    except Exception as e:  # harness bug: report it, don't hang CI
+        import traceback
+
+        traceback.print_exc()
+        report["error"] = f"harness error: {e!r}"
+    finally:
+        for host in h.hosts.values():
+            if host.proc.poll() is None:
+                host.proc.kill()
+    recs = [e["reconverge_s"] for e in report["events"]]
+    report["max_reconverge_s"] = max(recs) if recs else None
+    report["wall_s"] = round(time.monotonic() - t_run, 1)
+    # the heartbeat-ladder bound every reconvergence must respect:
+    # eviction + departure grace + one poll slack
+    report["ladder_bound_s"] = round((EVICT_MS + DEPART_MS) / 1000 + 1, 1)
+    if not args.keep_dir and report["ok"]:
+        import shutil
+
+        shutil.rmtree(workdir, ignore_errors=True)
+    else:
+        report["dir"] = workdir
+    print(json.dumps(report))
+    return 0 if report["ok"] else 1
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="chaos", description=__doc__,
+                                 formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--worker", action="store_true",
+                    help="internal: run one fleet host")
+    ap.add_argument("--rank", type=int, default=0)
+    ap.add_argument("--hosts", type=int, default=3)
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--coordinator", default="none")
+    ap.add_argument("--roster", default="none")
+    ap.add_argument("--out", default="chaos_out.bin")
+    ap.add_argument("--gen", type=int, default=0)
+    ap.add_argument("--events", type=int, default=4,
+                    help="fault drills to run (cycled through --sites)")
+    ap.add_argument("--window", type=float, default=60.0,
+                    help="per-step reconvergence deadline, seconds")
+    ap.add_argument("--sites", default=",".join(DEFAULT_SITES))
+    ap.add_argument("--json", action="store_true",
+                    help="quiet; one machine-readable report line")
+    ap.add_argument("--verbose", action="store_true")
+    ap.add_argument("--dir", default=None,
+                    help="work dir (default: fresh temp dir)")
+    ap.add_argument("--keep-dir", action="store_true")
+    args = ap.parse_args(argv)
+    if args.worker:
+        return worker_main(args)
+    return harness_main(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -5,8 +5,11 @@
     fleetctl.py drain  <host:port>             ask the host to drain
 
 ``status`` renders the health document (fleet/health.py ``GET
-/healthz``): the local host's lifecycle state, every peer's state and
-heartbeat age, and the load-bearing metrics a rollout watches.  Exit
+/healthz``): the local host's lifecycle state, the fleet's agreed
+rendezvous (the address a NEW host should join through — it follows
+the lowest active rank, so it survives the configured coordinator's
+death), every peer's state, heartbeat age and capacity-weighted
+traffic share, and the load-bearing metrics a rollout watches.  Exit
 codes make it scriptable: 0 = host is routable (healthz 200), 3 = host
 answered but is draining/departed (healthz 503), 2 = unreachable /
 not a fleet health endpoint.
@@ -67,17 +70,29 @@ def cmd_status(addr: str, as_json: bool) -> int:
     print("fleet: " + "  ".join(f"{s}={counts.get(s, 0)}"
                                 for s in ("joining", "active", "suspect",
                                           "draining", "departed")))
+    rdv = fleet.get("rendezvous")
+    if isinstance(rdv, dict) and rdv.get("rank", -1) >= 0:
+        # pre-schema-3 hosts carry no rendezvous field; stay quiet
+        # rather than inventing one
+        tag = " (FALLBACK — configured coordinator is not the " \
+            "rendezvous)" if rdv.get("fallback") else ""
+        print(f"rendezvous: rank {rdv['rank']} @ {rdv['addr']}{tag}")
     for peer in fleet.get("peers", []):
         marker = "*" if peer["rank"] == host["rank"] else " "
         evicted = " (evicted)" if peer.get("evicted") else ""
+        share = ""
+        if "share" in peer:
+            share = f" share={peer['share']:>5.1%}" \
+                f" cap={peer.get('capacity', 1.0):g}"
         print(f" {marker} rank {peer['rank']:>3} [{peer['state']:>8}]"
               f" inc={peer['incarnation']}"
               f" hb_age={_fmt_age(peer['hb_age_ms'])}"
-              f" {peer['addr']}{evicted}")
+              f"{share} {peer['addr']}{evicted}")
     metrics = doc.get("metrics", {})
     keys = ("input_lines", "output_written", "queue_dropped",
             "device_breaker_state", "aot_hits", "fleet_evictions",
-            "fleet_rejoins", "fleet_hb_send_errors")
+            "fleet_rejoins", "fleet_hb_send_errors", "fleet_hb_retries",
+            "fleet_roster_saves", "fleet_roster_load_errors")
     shown = {k: metrics[k] for k in keys if k in metrics}
     if shown:
         print("metrics: " + "  ".join(f"{k}={v}" for k, v in shown.items()))
