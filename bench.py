@@ -844,10 +844,23 @@ def bench_obs(extra, lines):
     3. Journal + exposition sanity: a degradation event lands in the
        ring and the registry renders non-empty exposition text (the
        strict format parser lives in tests/test_obs.py).
+    4. SLO-plane guard cost: the per-batch hot-path additions the SLO
+       engine feeds on (_finish_batch's route_rows_{route} inc + the
+       e2e_batch_seconds_{route} family observe) must stay under 1%
+       of per-chunk e2e cost, like the trace guard.
+    5. Regression sentinel: seeded from the COMMITTED BENCH series,
+       a playback of this run's measured live rate must report ZERO
+       perf_regression events (an unmodified run is not a regression —
+       and a future PR that tanks the hot path fails right here), while
+       a synthetic 10x throttle must raise one with measured-vs-
+       baseline cost (the detector actually detects).
     """
     from flowgger_tpu.obs import events as obs_events
     from flowgger_tpu.obs import prom as obs_prom
+    from flowgger_tpu.obs.sentinel import Sentinel
     from flowgger_tpu.obs.trace import tracer
+    from flowgger_tpu.utils.metrics import Registry as _Registry
+    from flowgger_tpu.utils.metrics import registry as _reg
 
     # the guard sequence one block batch pays: mint + the instrumented
     # stages' span guards + the finish guard (tpu/batch.py)
@@ -898,13 +911,64 @@ def bench_obs(extra, lines):
     journal_ok = bool(ring) and ring[-1]["reason"] == "queue_drop"
     text = obs_prom.render()
     prom_ok = ("# TYPE flowgger_input_lines_total counter" in text
-               and "flowgger_degradation_events_by_reason_total" in text)
+               and "flowgger_degradation_events_by_reason_total" in text
+               and "_sample_count" in text)
 
-    ok = off_ok and journal_ok and prom_ok
+    # SLO-plane per-batch guard cost (one family counter inc + one
+    # family histogram observe per finished batch)
+    slo_loops = 50_000
+    slo_best = None
+    for _ in range(5):
+        t0 = time.perf_counter()
+        for _ in range(slo_loops):
+            _reg.inc("route_rows_bench", 1024)
+            _reg.observe("e2e_batch_seconds_bench", 0.001)
+        wall = time.perf_counter() - t0
+        slo_best = wall if slo_best is None else min(slo_best, wall)
+    slo_s_per_batch = slo_best / slo_loops
+    slo_ratio = slo_s_per_batch / e2e_s_per_chunk
+    slo_ok = slo_ratio < 0.01
+
+    # regression sentinel: committed-series seed, live-rate playback
+    import os as _os
+
+    repo = _os.path.dirname(_os.path.abspath(__file__))
+    sreg = _Registry()
+    clock = [0.0]
+    sent = Sentinel(registry=sreg, clock=lambda: clock[0])
+    sent.configure(enabled=True, interval_s=1, drop=0.5, sustain=2,
+                   min_rows=64)
+    seeded = sent.seed_from_bench(repo)
+
+    def regressions():
+        return len([ev for ev in obs_events.journal.snapshot()
+                    if ev["reason"] == "perf_regression"])
+
+    before = regressions()
+    live_rate = max(1, int(e2e_rate))
+    for _ in range(10):
+        clock[0] += 1.0
+        sreg.inc("route_rows_rfc5424", live_rate)
+        sent.tick()
+    sentinel_clean = regressions() == before
+    # synthetic 10x throttle: 10s ticks give the 30s-tau EWMA time to
+    # converge onto the throttled rate within the playback
+    for _ in range(30):
+        clock[0] += 10.0
+        sreg.inc("route_rows_rfc5424", live_rate)  # live/10 per second
+        sent.tick()
+    sentinel_detects = regressions() > before
+    sentinel_ok = bool(seeded.get("rfc5424")) and sentinel_clean \
+        and sentinel_detects
+
+    ok = off_ok and journal_ok and prom_ok and slo_ok and sentinel_ok
     extra.update({
         "obs_trace_off_ns_per_batch": round(off_s_per_batch * 1e9),
         "obs_trace_ring_ns_per_batch": round(ring_s_per_batch * 1e9),
         "obs_trace_off_overhead_ratio": round(overhead_ratio, 6),
+        "obs_slo_guard_ns_per_batch": round(slo_s_per_batch * 1e9),
+        "obs_sentinel_baseline_lps": seeded.get(
+            "rfc5424", {}).get("lines_per_sec"),
         "obs_ok": ok,
     })
     print(json.dumps({
@@ -914,6 +978,15 @@ def bench_obs(extra, lines):
         "trace_off_overhead_ratio": round(overhead_ratio, 6),
         "trace_off_gate": "< 0.01 of per-chunk e2e cost",
         "trace_off_ok": off_ok,
+        "slo_guard_ns_per_batch": round(slo_s_per_batch * 1e9),
+        "slo_guard_overhead_ratio": round(slo_ratio, 6),
+        "slo_guard_ok": slo_ok,
+        "sentinel_seeded_baseline_lps": seeded.get(
+            "rfc5424", {}).get("lines_per_sec"),
+        "sentinel_live_lps": live_rate,
+        "sentinel_clean_on_unmodified_run": sentinel_clean,
+        "sentinel_detects_throttle": sentinel_detects,
+        "sentinel_ok": sentinel_ok,
         "journal_ok": journal_ok,
         "exposition_ok": prom_ok,
         "ok": ok,
@@ -1946,10 +2019,12 @@ def smoke_main():
               "tenancy_smoke JSON line)", file=sys.stderr)
         sys.exit(1)
     if not obs_ok:
-        print("SMOKE FAIL: observability gates missed (tracing-off "
-              "guard cost above 1% of per-chunk e2e, journal, or "
-              "exposition sanity — see the obs_smoke JSON line)",
-              file=sys.stderr)
+        print("SMOKE FAIL: observability gates missed (tracing-off or "
+              "SLO-plane guard cost above 1% of per-chunk e2e, the "
+              "BENCH-seeded sentinel flagged this run as a perf "
+              "regression — or failed to flag a synthetic throttle — "
+              "or journal/exposition sanity — see the obs_smoke JSON "
+              "line)", file=sys.stderr)
         sys.exit(1)
     if not ok:
         print("SMOKE FAIL: overlap executor slower than the serial path",

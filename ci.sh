@@ -94,6 +94,16 @@ echo "== observability suite (spans, event journal, exposition) =="
 # final_flush write-race fix, and the SIGUSR2 / POST /profile toggle
 JAX_PLATFORMS=cpu timeout 600 python -m pytest tests/test_obs.py tests/test_metrics.py -q -m "not faults"
 
+echo "== observability plane: SLO engine + fleet aggregation (obs-fleet) =="
+# SLO unit suite (multi-window burn rates, burn/recover events, sink
+# rotation, BENCH-seeded regression sentinel) + the multi-host /fleetz
+# tests: merged counters/histograms (pooled-sample quantiles), the
+# rank-tagged event union, dead-host staleness marking, fleetctl top
+# exit codes, and trace_dump --fleet process lanes.  The host_kill
+# staleness drill (faults-marked, subprocess) runs in the
+# fault-injection step below
+JAX_PLATFORMS=cpu timeout 600 python -m pytest tests/test_slo.py tests/test_fleetz.py -q -m "not faults"
+
 echo "== new-format decode subsystems (jsonl_tpu / dns_tpu, slow half) =="
 # the non-slow differential/framing/auto-leg/AOT tests already ran in
 # the main suite step above — this step adds ONLY their slow-marked

@@ -1,14 +1,18 @@
 """Fleet federation: multi-host lane scale-out.
 
 One host = one pipeline = one LaneSet over local chips (PR 5).  This
-package federates N of them into a fleet with exactly three powers —
+package federates N of them into a fleet with four powers —
 **membership** (who is in, coordinator-rendezvous then full-mesh
 heartbeats), **health export** (per-host HTTP endpoint a load balancer
-consumes), and **drain-on-departure** (SIGTERM or missed-heartbeat
+consumes), **drain-on-departure** (SIGTERM or missed-heartbeat
 eviction reuses the pipeline's fence-all drain so in-flight batches
-emit byte-identically while peers absorb new traffic).  It never adds a
-collective: logs are embarrassingly data-parallel, so host failure
-degrades that host alone.
+emit byte-identically while peers absorb new traffic), and **fleet
+observability** (``GET /fleetz``: merged metrics with pooled-sample
+histogram quantiles, the rank-tagged degradation-event union,
+per-host staleness marking, and fleet-level SLO status — see README
+"Fleet aggregation").  It never adds a collective: logs are
+embarrassingly data-parallel, so host failure degrades that host
+alone.
 
     membership.py — the joining/active/suspect/draining/departed state
                     machine, deterministic rank tie-breaks, the

@@ -80,6 +80,30 @@ without an operator):
   share redistributes across survivors through the existing LB 200/503
   contract.  Share changes land as ``fleet_rebalance`` journal events.
 
+Fleet observability plane (PR 15 tentpole): every host serves ``GET
+/fleetz`` — the fleet-level document answering "is the *fleet* meeting
+its targets, and which tenant/route/host is burning the budget":
+
+- the serving host scrapes every non-departed peer's ``/healthz`` over
+  the same short-lived HTTP transport the heartbeats use (bounded
+  timeout, parallel, never on the decode path) and caches the last
+  good snapshot per rank — a host that stops answering is served from
+  cache and **flagged stale with its age, never silently dropped**;
+- metrics merge across hosts: counters and cumulative stage-seconds
+  sum; histograms merge honestly (counts/sums summed, quantiles
+  recomputed from the pooled per-host sample rings that HEALTH_SCHEMA
+  4 snapshots carry) — never an average of per-host p99s;
+- the degradation-event union is tagged by rank (obs/events.py
+  ``set_rank``) and re-sorted by timestamp;
+- fleet-level SLO status folds each host's ``slo`` section per
+  objective name: burning anywhere = burning fleet-wide, burn rates
+  are the worst observed, stale contributors marked.
+
+Every host can serve ``/fleetz`` from its own view; consumers
+(``fleetctl top``) follow ``fleet.rendezvous`` so the fleet has ONE
+agreed answer that survives coordinator death via the existing
+failover election.
+
 Fault sites (``utils/faultinject.py``): ``peer_partition`` drops
 heartbeat exchanges in BOTH directions at the armed host — outbound
 sends are suppressed, inbound POSTs 503, and any stray replies are
@@ -130,7 +154,15 @@ PARTITION_PEER_ENV = "FLOWGGER_PARTITION_PEER"
 # v3: self-healing fleet — ``fleet.rendezvous`` (the elected rendezvous
 # every consumer should follow), ``fleet.shares`` (per-rank traffic
 # shares), ``host.capacity``, and per-peer ``capacity``/``share``
-HEALTH_SCHEMA = 3
+# v4: observability plane — the ``slo`` section (objective burn state +
+# sentinel status, obs/slo.py), histogram snapshots carry
+# ``sample_count`` + bounded ``samples`` (the /fleetz quantile-merge
+# raw material), and journal/trace records carry the fleet ``rank``
+HEALTH_SCHEMA = 4
+
+# /fleetz fleet-observability document schema (tests/resources/
+# fleetz_schema.json is the golden copy)
+FLEETZ_SCHEMA = 1
 
 # bounded heartbeat-POST retry (utils/retry.py, full jitter): one
 # dropped packet must not start a peer's suspect clock — but the whole
@@ -362,6 +394,160 @@ def _http_post_json(addr: str, path: str, doc: dict, timeout: float,
             registry.inc("fleet_hb_retries")
 
 
+def _http_get_json(addr: str, path: str, timeout: float) -> Optional[dict]:
+    """One short-lived GET; None on transport/parse failure.  A non-200
+    status with a JSON body still counts (a draining host's /healthz is
+    a 503 carrying the full document — exactly what the fleet merge
+    wants to keep aggregating)."""
+    import http.client
+
+    conn = None
+    try:
+        host, _, port = addr.rpartition(":")
+        conn = http.client.HTTPConnection(host, int(port), timeout=timeout)
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        out = json.loads(resp.read())
+        return out if isinstance(out, dict) else None
+    except (OSError, ValueError):
+        return None
+    finally:
+        if conn is not None:
+            conn.close()
+
+
+# -- /fleetz merge (pure functions; unit-tested directly) ------------------
+
+# per-histogram cap on POOLED merge samples: local senders bound their
+# rings to 128 (Histogram.samples), but peer snapshots are remote input
+# and the merge must enforce its own bound, not trust theirs
+_MERGE_SAMPLES_MAX = 2048
+
+
+def merge_metric_snapshots(snaps) -> Dict[str, object]:
+    """Merge per-host registry snapshots into one fleet view: counters
+    and cumulative stage-seconds sum; histograms sum counts/sums and
+    recompute quantiles from the POOLED per-host sample rings (an
+    average of per-host p99s is not a p99); gauges are point-in-time
+    per-host truth and stay out of the merged dict (read them under
+    ``hosts[].metrics``)."""
+    from ..utils.metrics import classify_metric, window_quantiles
+
+    merged: Dict[str, object] = {}
+    pools: Dict[str, dict] = {}
+    for snap in snaps:
+        if not isinstance(snap, dict):
+            continue
+        for key, val in snap.items():
+            if key == "ts":
+                continue
+            if isinstance(val, dict) and "count" in val:
+                pool = pools.setdefault(key, {
+                    "count": 0, "sum": 0.0, "samples": [],
+                    "min": None, "max": None})
+                pool["count"] += int(val.get("count", 0))
+                pool["sum"] += float(val.get("sum", 0.0))
+                room = _MERGE_SAMPLES_MAX - len(pool["samples"])
+                if room > 0:
+                    pool["samples"].extend(
+                        (val.get("samples") or ())[:room])
+                for bound, pick in (("min", min), ("max", max)):
+                    v = val.get(bound)
+                    if isinstance(v, (int, float)):
+                        pool[bound] = v if pool[bound] is None \
+                            else pick(pool[bound], v)
+                continue
+            if not isinstance(val, (int, float)) or isinstance(val, bool):
+                continue
+            if classify_metric(key) in ("counter", "seconds"):
+                merged[key] = merged.get(key, 0) + val
+    for key, pool in pools.items():
+        samples = sorted(s for s in pool["samples"]
+                         if isinstance(s, (int, float)))
+        entry: Dict[str, object] = {
+            "count": pool["count"], "sum": round(pool["sum"], 6),
+            "sample_count": len(samples)}
+        if samples:
+            entry.update(window_quantiles(samples))
+            # per-host min/max cover samples the bounded rings dropped
+            entry["min"] = pool["min"] if pool["min"] is not None \
+                else samples[0]
+            entry["max"] = pool["max"] if pool["max"] is not None \
+                else samples[-1]
+        merged[key] = entry
+    return merged
+
+
+def merge_event_sections(tagged, ring: int = 256) -> Dict[str, object]:
+    """``[(rank, events_section), ...]`` → one union section: totals
+    and per-reason counts summed, rings merged by timestamp (each
+    entry tagged with its source rank — schema-4 hosts stamp it at
+    emit; older snapshots are tagged here)."""
+    total = 0
+    counts: Dict[str, int] = {}
+    union = []
+    for rank, section in tagged:
+        if not isinstance(section, dict):
+            continue
+        total += int(section.get("total", 0))
+        for reason, n in (section.get("counts") or {}).items():
+            counts[reason] = counts.get(reason, 0) + int(n)
+        for event in section.get("ring") or ():
+            if isinstance(event, dict):
+                event = dict(event)
+                event.setdefault("rank", rank)
+                union.append(event)
+    union.sort(key=lambda e: e.get("ts", 0))
+    return {"total": total, "counts": counts, "ring": union[-ring:]}
+
+
+def merge_slo_sections(tagged) -> Dict[str, object]:
+    """``[(rank, stale, slo_section), ...]`` → fleet-level SLO status:
+    per objective name, burning anywhere is burning fleet-wide, the
+    reported burn rates are the worst observed, and every contributing
+    host is listed (stale contributors marked — a dead host's last
+    judgement stays on the board rather than reading as green)."""
+    objectives: Dict[str, dict] = {}
+    sentinel_regressions = 0
+    sentinel_routes: Dict[str, dict] = {}
+    for rank, stale, section in tagged:
+        if not isinstance(section, dict):
+            continue
+        for obj in section.get("objectives") or ():
+            if not isinstance(obj, dict) or "name" not in obj:
+                continue
+            entry = objectives.setdefault(obj["name"], {
+                "name": obj["name"], "kind": obj.get("kind", ""),
+                "burning": False, "fast_burn": 0.0, "slow_burn": 0.0,
+                "budget_remaining": 1.0, "hosts": []})
+            entry["burning"] = entry["burning"] or bool(obj.get("burning"))
+            for key, pick in (("fast_burn", max), ("slow_burn", max),
+                              ("budget_remaining", min)):
+                v = obj.get(key)
+                if isinstance(v, (int, float)):
+                    entry[key] = pick(entry[key], v)
+            entry["hosts"].append({
+                "rank": rank, "burning": bool(obj.get("burning")),
+                "fast_burn": obj.get("fast_burn", 0.0), "stale": stale})
+        sent = section.get("sentinel")
+        if isinstance(sent, dict):
+            sentinel_regressions += int(sent.get("regressions", 0))
+            for route, st in (sent.get("routes") or {}).items():
+                if isinstance(st, dict):
+                    prev = sentinel_routes.get(route)
+                    if prev is None or (st.get("alerted")
+                                        and not prev.get("alerted")):
+                        sentinel_routes[route] = dict(st, rank=rank)
+    objs = sorted(objectives.values(), key=lambda o: o["name"])
+    return {
+        "configured": len(objs),
+        "burning": sum(1 for o in objs if o["burning"]),
+        "objectives": objs,
+        "sentinel": {"regressions": sentinel_regressions,
+                     "routes": sentinel_routes},
+    }
+
+
 class Fleet:
     """One host's fleet agent: health service + heartbeat ticker +
     membership, wired into the pipeline's drain lifecycle."""
@@ -397,6 +583,11 @@ class Fleet:
         self._watch_lock = threading.Lock()
         self._rendezvous_seen: Optional[tuple] = None
         self._shares_seen: Optional[Dict[int, float]] = None
+        # /fleetz peer-snapshot cache: rank -> (healthz doc, monotonic
+        # fetch time).  A peer that stops answering is served from here
+        # with a stale flag — its last snapshot is evidence, not noise
+        self._fleetz_lock = threading.Lock()
+        self._fleetz_cache: Dict[int, tuple] = {}
 
     @classmethod
     def from_config(cls, config: Config, supervisor=None, registry=None,
@@ -427,7 +618,16 @@ class Fleet:
             spec.bind, spec.port, payload=self.health_payload,
             healthy=self._lb_healthy, on_heartbeat=self.on_heartbeat,
             on_drain=self._drain_requested,
-            on_fault=self._fault_requested if spec.chaos else None)
+            on_fault=self._fault_requested if spec.chaos else None,
+            on_fleetz=self.fleetz_payload)
+        # cross-host correlation: stamp every journal event and batch
+        # trace with this host's rank, so the /fleetz event union and
+        # `trace_dump --fleet` process lanes stay attributable
+        from ..obs.events import journal as _journal
+        from ..obs.trace import tracer as _tracer
+
+        _journal.set_rank(spec.rank)
+        _tracer.set_rank(spec.rank)
         advertise = spec.advertise or \
             f"{spec.bind}:{self.service.port}"
         # durable-roster bootstrap: load the journal BEFORE membership
@@ -477,11 +677,15 @@ class Fleet:
                     msg=f"fleet-roster: restored {restored} bootstrap "
                         f"candidates from {spec.roster_path} (walked "
                         "alongside the configured coordinator)")
-        if spec.coordinator is None and spec.hosts > 1 and not journaled:
+        if spec.coordinator is None and spec.hosts > 1 and not journaled \
+                and spec.rank != 0:
             # roster_path waived the coordinator requirement but there
             # is no usable journal either: this host can only wait to
             # be dialed.  Say so loudly — a silent singleton answering
-            # healthz 200 looks exactly like a healthy fleet of one
+            # healthz 200 looks exactly like a healthy fleet of one.
+            # (Rank 0 is exempt: it IS the conventional rendezvous, and
+            # being dialed by joiners is its normal life, not a
+            # misconfiguration.)
             print("fleet: WARNING — no coordinator configured and no "
                   f"usable roster journal at {spec.roster_path}; this "
                   "host has no peer to dial and will idle until a peer "
@@ -841,6 +1045,7 @@ class Fleet:
         (tests/resources/healthz_schema.json) — additive changes bump
         ``HEALTH_SCHEMA``."""
         from ..obs.events import journal as _journal
+        from ..obs.slo import engine as _slo_engine
         from ..obs.trace import tracer as _tracer
 
         local = self.membership.local if self.membership else None
@@ -867,7 +1072,104 @@ class Fleet:
                 "rendezvous": rdv,
                 "shares": {str(r): s for r, s in sorted(shares.items())},
             },
-            "metrics": self._registry.snapshot(),
+            # samples included: the /fleetz scrape on the rendezvous
+            # host pools them for honest merged quantiles
+            "metrics": self._registry.snapshot(include_hist_samples=True),
             "events": _journal.health_section(),
             "trace": _tracer.stats(),
+            "slo": _slo_engine.health_section(),
+        }
+
+    # -- fleet observability (/fleetz) -------------------------------------
+    def _scrape_peers(self, timeout: float) -> None:
+        """Refresh the /fleetz snapshot cache from every non-departed
+        remote peer, in parallel (one short-lived GET each, the
+        heartbeat transport's rules: hard timeout, failure is data)."""
+        if self.membership is None:
+            return
+        targets = self.membership.heartbeat_targets()
+
+        def scrape(rank: int, addr: str) -> None:
+            doc = _http_get_json(addr, "/healthz", timeout)
+            if doc is not None:
+                with self._fleetz_lock:
+                    self._fleetz_cache[rank] = (doc, time.monotonic())
+
+        threads = [threading.Thread(target=scrape, args=t, daemon=True,
+                                    name=f"fleetz-scrape-{t[0]}")
+                   for t in targets]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + timeout + 0.25
+        for t in threads:
+            t.join(max(0.0, deadline - time.monotonic()))
+
+    def fleetz_payload(self) -> Dict[str, object]:
+        """The ``GET /fleetz`` document (schema ``FLEETZ_SCHEMA``,
+        golden-file-tested): merged fleet metrics, rank-tagged event
+        union, per-host staleness, and fleet-level SLO status.  Served
+        by every host from its own view; the agreed rendezvous host is
+        the canonical answer consumers follow."""
+        timeout = max(0.2, min(1.0,
+                               self.spec.heartbeat_ms * 2 / 1000.0))
+        self._scrape_peers(timeout)
+        now_mono = time.monotonic()
+        local = self.membership.local if self.membership else None
+        local_doc = self.health_payload()
+        # freshness threshold: a snapshot older than one scrape round
+        # was NOT refreshed this request — its host failed to answer
+        stale_after = timeout + 0.5
+        shares = self.membership.shares() if self.membership else {}
+        hosts = []
+        metric_snaps = []
+        event_sections = []
+        slo_sections = []
+
+        def add(rank, addr, state, doc, stale, age_s):
+            hosts.append({
+                "rank": rank, "addr": addr, "state": state,
+                "stale": bool(stale), "age_s": round(age_s, 3),
+                "share": shares.get(rank, 0.0),
+                "snapshot": doc is not None,
+                "metrics": (doc or {}).get("metrics", {}),
+            })
+            if doc is None:
+                return
+            metric_snaps.append(doc.get("metrics", {}))
+            event_sections.append((rank, doc.get("events", {})))
+            slo_sections.append((rank, bool(stale), doc.get("slo", {})))
+
+        if local is not None:
+            add(local.rank, local.addr, local.state, local_doc,
+                False, 0.0)
+        with self._fleetz_lock:
+            cached = dict(self._fleetz_cache)
+        known = {p["rank"]: p
+                 for p in (self.membership.roster()
+                           if self.membership else [])}
+        for rank in sorted(set(cached) | set(known)):
+            if local is not None and rank == local.rank:
+                continue
+            peer = known.get(rank)
+            doc, fetched = cached.get(rank, (None, None))
+            age = (now_mono - fetched) if fetched is not None else 0.0
+            stale = fetched is None or age > stale_after
+            add(rank,
+                peer["addr"] if peer else
+                (doc or {}).get("host", {}).get("addr", ""),
+                peer["state"] if peer else "unknown",
+                doc, stale, age)
+        rdv = self.rendezvous() or \
+            {"rank": -1, "addr": "", "fallback": False}
+        return {
+            "schema": FLEETZ_SCHEMA,
+            "ts": round(time.time(), 3),
+            "served_by": local.rank if local else -1,
+            "is_rendezvous": bool(local is not None
+                                  and rdv.get("rank") == local.rank),
+            "rendezvous": rdv,
+            "hosts": hosts,
+            "metrics": merge_metric_snapshots(metric_snaps),
+            "events": merge_event_sections(event_sections),
+            "slo": merge_slo_sections(slo_sections),
         }

@@ -28,6 +28,13 @@ A load balancer (or ``tools/fleetctl.py``, or a peer) talks to it:
 - ``GET /metrics`` — the registry in the Prometheus text exposition
   format (obs/prom.py): counters as ``_total`` series, gauges,
   histogram families as summaries — the scrape leg for fleet hosts.
+- ``GET /fleetz`` — the fleet-level observability document
+  (federation.Fleet.fleetz_payload): merged metrics snapshots across
+  every routable host (counters summed, histogram quantiles from
+  pooled samples), the union of recent degradation events tagged by
+  rank, per-host staleness marking, and fleet-level SLO status.  Every
+  host serves it from its own view; the agreed rendezvous host is the
+  one ``fleetctl top`` (and operators) should ask.
 - ``GET /trace`` — the flight recorder's completed-batch ring as
   Chrome trace-event JSON (Perfetto-loadable; empty when
   ``[metrics] trace`` is off).
@@ -71,12 +78,14 @@ class HealthService:
                  healthy: Callable[[], bool],
                  on_heartbeat: Optional[Callable[[dict], dict]] = None,
                  on_drain: Optional[Callable[[], dict]] = None,
-                 on_fault: Optional[Callable[[dict], dict]] = None):
+                 on_fault: Optional[Callable[[dict], dict]] = None,
+                 on_fleetz: Optional[Callable[[], Dict[str, object]]] = None):
         self._payload = payload
         self._healthy = healthy
         self._on_heartbeat = on_heartbeat
         self._on_drain = on_drain
         self._on_fault = on_fault
+        self._on_fleetz = on_fleetz
         service = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -117,10 +126,19 @@ class HealthService:
                     self._reply_raw(200, _prom.trace_document(),
                                     "application/json")
                     return
+                if path == "/fleetz":
+                    if service._on_fleetz is None:
+                        self._reply(501, {"error": "no fleet aggregator"})
+                        return
+                    # always 200: /fleetz reports on the FLEET, and a
+                    # draining host's view of its peers is still a
+                    # valid (rank-attributed) answer
+                    self._reply(200, service._on_fleetz())
+                    return
                 if path != "/healthz":
                     self._reply(404, {"error": "unknown path",
-                                      "paths": ["/healthz", "/metrics",
-                                                "/trace"]})
+                                      "paths": ["/healthz", "/fleetz",
+                                                "/metrics", "/trace"]})
                     return
                 code = 200 if service._healthy() else 503
                 self._reply(code, service._payload())

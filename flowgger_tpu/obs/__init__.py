@@ -19,9 +19,21 @@ the hot path permanently:
   per-reason counters, optionally appended to a JSONL sink.
 - :mod:`~flowgger_tpu.obs.prom` — Prometheus text exposition of the
   full metrics registry (counters, gauges, stage seconds, histogram
-  families with ``_count``/``_sum`` + quantiles) at ``GET /metrics``
+  families with ``_count``/``_sum`` + quantiles and the
+  bounded-window ``_sample_count`` disclosure) at ``GET /metrics``
   on the fleet health server, or on a standalone ``[metrics]
   prom_port`` listener when fleet federation is off.
+- :mod:`~flowgger_tpu.obs.slo` — the SLO engine: ``[slo.*]``-declared
+  objectives (latency percentile targets per tenant/route, throughput
+  floors, degradation-event rate caps) evaluated continuously with
+  Google-SRE multi-window burn rates; ``slo_burn``/``slo_recover``
+  typed events, per-objective burn-rate/budget gauges, the ``slo``
+  health-document section every /healthz and /fleetz consumer reads.
+- :mod:`~flowgger_tpu.obs.sentinel` — the live perf-regression
+  sentinel: per-route lines/s (and fetch-B/row) EWMAs compared
+  against baselines seeded from the committed BENCH series
+  (``tools/bench_trend.py``); a sustained drop journals
+  ``perf_regression`` with measured-vs-baseline cost.
 
 The pipeline layers import these lazily (inside functions) so the
 package stays import-cycle-free: obs depends only on
@@ -30,4 +42,4 @@ package stays import-cycle-free: obs depends only on
 
 from __future__ import annotations
 
-__all__ = ["events", "prom", "trace"]
+__all__ = ["events", "prom", "sentinel", "slo", "trace"]

@@ -29,6 +29,14 @@ reason                     fired by
                            redistributed (join/drain/eviction/capacity)
 ``roster_restore``         fleet/federation.py — boot used the durable
                            roster journal as bootstrap candidates
+``slo_burn``               obs/slo.py — an objective's error budget is
+                           burning faster than its threshold on BOTH
+                           evaluation windows (fast + slow)
+``slo_recover``            obs/slo.py — a burning objective fell back
+                           under its burn threshold
+``perf_regression``        obs/sentinel.py — a route's live throughput
+                           (or fetch cost) sustained a drop against
+                           its BENCH-seeded baseline
 =========================  =================================================
 
 Each event carries ``(ts, site, reason)`` plus whatever context the
@@ -47,6 +55,12 @@ Config (``[metrics]``)::
 
     events_ring = 256            # journal depth (default)
     events_path = "ev.jsonl"     # optional JSONL sink
+    events_max_mb = 64           # rotate the sink past this size
+    events_keep = 3              # rotated files kept (ev.jsonl.1 ...)
+
+Fleet correlation: once ``fleet/federation.py`` calls
+:meth:`Journal.set_rank`, every event carries a ``rank`` field so the
+``/fleetz`` union of rings stays attributable per host.
 
 Cost model: events fire only on degradation (the healthy hot path
 never calls in here), so one lock + deque append + counter bump per
@@ -85,6 +99,9 @@ REASONS = (
     "rendezvous_failover",
     "fleet_rebalance",
     "roster_restore",
+    "slo_burn",
+    "slo_recover",
+    "perf_regression",
 )
 _REASON_SET = frozenset(REASONS)
 
@@ -98,12 +115,19 @@ class Journal:
         self._counts: Dict[str, int] = {}
         self._total = 0
         self._sink = JsonlSink("events")
+        self._rank: Optional[int] = None
 
     def configure(self, ring: int = DEFAULT_RING,
-                  path: Optional[str] = None) -> None:
+                  path: Optional[str] = None,
+                  max_mb: Optional[float] = None, keep: int = 3) -> None:
         with self._lock:
             self._ring = deque(self._ring, maxlen=max(1, int(ring)))
-        self._sink.open(path)
+        self._sink.open(path, max_mb=max_mb, keep=keep)
+
+    def set_rank(self, rank: Optional[int]) -> None:
+        """Fleet correlation: stamp every subsequent event with this
+        host's fleet rank (federation.Fleet.start)."""
+        self._rank = rank
 
     def emit(self, site: str, reason: str, *,
              detail: Optional[str] = None, route: Optional[str] = None,
@@ -118,6 +142,8 @@ class Journal:
                              f"(known: {', '.join(REASONS)})")
         event = {"ts": round(time.time(), 4), "site": site,
                  "reason": reason}
+        if self._rank is not None:
+            event["rank"] = self._rank
         if detail is not None:
             event["detail"] = str(detail)
         if route is not None:
@@ -172,6 +198,7 @@ class Journal:
             self._ring.clear()
             self._counts.clear()
             self._total = 0
+        self._rank = None
 
     def close(self) -> None:
         self._sink.close()
@@ -188,8 +215,9 @@ def emit(site: str, reason: str, **kw) -> dict:
 
 
 def configure_from(config) -> None:
-    """Wire ``[metrics] events_ring``/``events_path`` (pipeline boot;
-    no keys = defaults, ring only)."""
+    """Wire ``[metrics] events_ring``/``events_path`` (+ the
+    ``events_max_mb``/``events_keep`` rotation pair) — pipeline boot;
+    no keys = defaults, ring only."""
     ring = config.lookup_int(
         "metrics.events_ring",
         "metrics.events_ring must be an integer (events kept)",
@@ -197,8 +225,15 @@ def configure_from(config) -> None:
     path = config.lookup_str(
         "metrics.events_path",
         "metrics.events_path must be a string (file)")
+    max_mb = config.lookup_float(
+        "metrics.events_max_mb",
+        "metrics.events_max_mb must be a number (MB before the JSONL "
+        "sink rotates)")
+    keep = config.lookup_int(
+        "metrics.events_keep",
+        "metrics.events_keep must be an integer (rotated files kept)", 3)
     try:
-        journal.configure(ring=ring, path=path)
+        journal.configure(ring=ring, path=path, max_mb=max_mb, keep=keep)
     except OSError as e:
         print(f"events: cannot open {path} ({e}); journal keeps the "
               "in-memory ring only", file=sys.stderr)

@@ -106,6 +106,14 @@ def render(registry=None, journal=None) -> str:
         lines.append(f"{name} {_fmt(value)}")
     for raw, snap in sorted(export["histograms"].items()):
         name = metric_name(raw)
+        # the quantiles come from a BOUNDED sliding sample window, not
+        # the full population — say so in the exposition itself, and
+        # export the backing sample count so a scraper (and the fleet
+        # merge) can judge quantile confidence
+        lines.append(f"# HELP {name} summary over a bounded sliding "
+                     f"sample window; quantiles are computed from the "
+                     f"last {name}_sample_count samples, not the full "
+                     f"{name}_count population")
         lines.append(f"# TYPE {name} summary")
         for q, key in _QUANTILES:
             if key in snap:
@@ -113,6 +121,9 @@ def render(registry=None, journal=None) -> str:
                                             snap[key]))
         lines.append(f"{name}_sum {_fmt(float(snap.get('sum', 0.0)))}")
         lines.append(f"{name}_count {_fmt(int(snap.get('count', 0)))}")
+        sc_name = metric_name(raw, "_sample_count")
+        lines.append(f"# TYPE {sc_name} gauge")
+        lines.append(f"{sc_name} {_fmt(int(snap.get('sample_count', 0)))}")
 
     counts = journal.counts()
     if counts:
@@ -178,11 +189,13 @@ class ObsServer:
             from ..utils.metrics import registry as _reg
 
             from .events import journal as _journal
+            from .slo import engine as _slo
             from .trace import tracer as _tracer
 
-            doc = {"metrics": _reg.snapshot(),
+            doc = {"metrics": _reg.snapshot(include_hist_samples=True),
                    "events": _journal.health_section(),
-                   "trace": _tracer.stats()}
+                   "trace": _tracer.stats(),
+                   "slo": _slo.health_section()}
             return 200, json.dumps(doc).encode(), "application/json"
         doc = {"error": "unknown path",
                "paths": ["/metrics", "/trace", "/healthz", "/profile"]}

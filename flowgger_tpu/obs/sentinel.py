@@ -1,0 +1,340 @@
+"""Live perf-regression sentinel: per-route throughput (and fetch
+cost) vs BENCH-seeded baselines.
+
+The BENCH_r01..rNN series is the repo's committed performance memory,
+but until now only a human rereading those files could notice that a
+kernel/AOT/economics change quietly lost a hot path's throughput.  The
+sentinel closes that loop inside the process: rolling EWMA estimates
+of each route's live lines/s (from the ``route_rows_{route}`` counter
+family tpu/batch.py feeds per batch) are compared against baselines
+seeded from the committed BENCH trajectory (``tools/bench_trend.py``'s
+extraction, minimum across the series — the conservative floor the
+repo has actually sustained), and a route that holds below
+``(1 - drop) x baseline`` for ``sustain`` consecutive ticks raises a
+``perf_regression`` typed journal event carrying measured-vs-baseline
+cost.  Fetch-B/row regressions mirror the same machinery against the
+``fetch_bytes_per_row_{route}`` gauges (a *rise* past
+``(1 + rise) x baseline`` is the regression there).
+
+Config — scalar keys on the ``[slo]`` table (the engine's ticker
+drives the sentinel)::
+
+    [slo]
+    sentinel = true
+    sentinel_interval_s = 10     # evaluation cadence
+    sentinel_drop = 0.5          # alert below (1-drop) x baseline
+    sentinel_rise = 0.5          # fetch-B/row: alert above (1+rise) x
+    sentinel_sustain = 3         # consecutive breaching ticks required
+    sentinel_bench_root = "."    # BENCH_r*.json dir; absent = no
+                                 # seeding, baselines self-learn
+    sentinel_min_rows = 256      # ignore ticks with fewer new rows
+                                 # (idle != slow)
+
+Routes with no BENCH-mapped baseline self-learn one: the first
+sustained traffic establishes a slow EWMA (the "what this box
+normally does" estimate) and the fast EWMA is compared against it, so
+the sentinel still catches a mid-run cliff on a never-benched route —
+it just cannot catch "slow since boot" there.
+
+Gauges: ``sentinel_{route}_ratio`` (live/baseline; the watchable
+number) and ``sentinel_{route}_baseline`` (lines/s).  An alerted route
+re-arms once it recovers above the threshold, so a flapping route
+journals each episode, not each tick.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+import sys
+import threading
+import time
+from typing import Dict, Optional
+
+DEFAULT_INTERVAL_S = 10.0
+DEFAULT_DROP = 0.5
+DEFAULT_RISE = 0.5
+DEFAULT_SUSTAIN = 3
+DEFAULT_MIN_ROWS = 256
+FAST_TAU_S = 30.0      # live-rate EWMA time constant
+SLOW_TAU_S = 600.0     # self-learned baseline time constant
+
+_ROUTE_RX = re.compile(r"route_rows_([A-Za-z0-9_]+)\Z")
+
+
+class _RouteState:
+    __slots__ = ("ewma", "self_base", "last_rows", "last_t",
+                 "breach", "alerted", "fetch_breach", "fetch_alerted",
+                 "ratio")
+
+    def __init__(self):
+        self.ewma: Optional[float] = None
+        self.self_base: Optional[float] = None
+        self.last_rows: Optional[int] = None
+        self.last_t: Optional[float] = None
+        self.breach = 0
+        self.alerted = False
+        self.fetch_breach = 0
+        self.fetch_alerted = False
+        self.ratio: Optional[float] = None
+
+
+class Sentinel:
+    """Module singleton ``sentinel``; ticked by the SLO engine's
+    thread (or directly by tests/bench with a controlled clock)."""
+
+    def __init__(self, registry=None, clock=time.monotonic):
+        self._registry = registry
+        self._clock = clock
+        self._lock = threading.Lock()
+        self.enabled = False
+        self._interval = DEFAULT_INTERVAL_S
+        self._drop = DEFAULT_DROP
+        self._rise = DEFAULT_RISE
+        self._sustain = DEFAULT_SUSTAIN
+        self._min_rows = DEFAULT_MIN_ROWS
+        self._fast_tau = FAST_TAU_S
+        self._slow_tau = SLOW_TAU_S
+        self._baselines: Dict[str, Dict[str, float]] = {}
+        self._routes: Dict[str, _RouteState] = {}
+        self._last_tick: Optional[float] = None
+        self._events = 0
+
+    def _reg(self):
+        if self._registry is not None:
+            return self._registry
+        from ..utils.metrics import registry as _global
+
+        return _global
+
+    # -- configuration -----------------------------------------------------
+    def configure(self, enabled: bool = False,
+                  interval_s: float = DEFAULT_INTERVAL_S,
+                  drop: float = DEFAULT_DROP, rise: float = DEFAULT_RISE,
+                  sustain: int = DEFAULT_SUSTAIN,
+                  min_rows: int = DEFAULT_MIN_ROWS,
+                  bench_root: Optional[str] = None,
+                  fast_tau_s: float = FAST_TAU_S,
+                  slow_tau_s: float = SLOW_TAU_S,
+                  registry=None) -> None:
+        with self._lock:
+            self.enabled = bool(enabled)
+            self._interval = max(0.0, float(interval_s))
+            self._drop = float(drop)
+            self._rise = float(rise)
+            self._sustain = max(1, int(sustain))
+            self._min_rows = max(0, int(min_rows))
+            self._fast_tau = max(1e-3, float(fast_tau_s))
+            self._slow_tau = max(1e-3, float(slow_tau_s))
+            self._routes = {}
+            self._last_tick = None
+            self._events = 0
+            self._baselines = {}
+            if registry is not None:
+                self._registry = registry
+        if enabled and bench_root:
+            self.seed_from_bench(bench_root)
+
+    def seed_from_bench(self, root: str) -> Dict[str, Dict[str, float]]:
+        """Seed per-route baselines from the committed BENCH series via
+        ``tools/bench_trend.py`` (loaded from ``<root>/tools``; an
+        unreadable tool or series degrades to self-learned baselines
+        with one notice, never a boot failure)."""
+        try:
+            bt = _load_bench_trend(root)
+            baselines = bt.route_baselines(root)
+        except (OSError, ImportError, AttributeError, ValueError) as e:
+            print(f"sentinel: cannot seed baselines from {root} ({e}); "
+                  "baselines will self-learn from live traffic",
+                  file=sys.stderr)
+            return {}
+        with self._lock:
+            self._baselines = baselines
+        if baselines:
+            print("sentinel: seeded baselines for "
+                  + ", ".join(f"{r}={b['lines_per_sec']:,.0f}/s"
+                              for r, b in sorted(baselines.items())
+                              if "lines_per_sec" in b),
+                  file=sys.stderr)
+        return baselines
+
+    def set_baseline(self, route: str, lines_per_sec: float,
+                     fetch_bytes_per_row: Optional[float] = None) -> None:
+        """Explicit baseline injection (tests, bench harness)."""
+        with self._lock:
+            entry = self._baselines.setdefault(route, {})
+            entry["lines_per_sec"] = float(lines_per_sec)
+            if fetch_bytes_per_row is not None:
+                entry["fetch_bytes_per_row"] = float(fetch_bytes_per_row)
+
+    # -- evaluation --------------------------------------------------------
+    def maybe_tick(self, now: Optional[float] = None) -> None:
+        """Pace off ``sentinel_interval_s`` (the SLO engine ticks more
+        often than the sentinel needs)."""
+        if not self.enabled:
+            return
+        now = self._clock() if now is None else now
+        if self._last_tick is not None \
+                and now - self._last_tick < self._interval:
+            return
+        self.tick(now)
+
+    def tick(self, now: Optional[float] = None) -> None:
+        now = self._clock() if now is None else now
+        self._last_tick = now
+        reg = self._reg()
+        export = reg.export()
+        alerts = []
+        for key, rows in export["counters"].items():
+            m = _ROUTE_RX.match(key)
+            if m is None or not rows:
+                continue
+            route = m.group(1)
+            st = self._routes.get(route)
+            if st is None:
+                # insert under the lock: health_section() iterates
+                # this dict from HTTP handler threads, and a first
+                # sighting mid-iteration would raise out of a
+                # /healthz render (per-state field reads stay
+                # unlocked — benign float races)
+                with self._lock:
+                    st = self._routes.setdefault(route, _RouteState())
+            if st.last_rows is None:
+                st.last_rows, st.last_t = rows, now
+                continue
+            delta, dt = rows - st.last_rows, now - st.last_t
+            if delta < self._min_rows or dt <= 0:
+                # idle (or sub-threshold trickle) is not evidence of a
+                # regression — a drained route must not page anyone.
+                # After a LONG idle span, re-anchor the delta window:
+                # otherwise the first post-resume tick would average
+                # the burst over the whole gap, collapse the EWMA, and
+                # fire a false perf_regression on a healthy route
+                if dt > 10.0 * max(self._interval, 1.0):
+                    st.last_rows, st.last_t = rows, now
+                continue
+            st.last_rows, st.last_t = rows, now
+            inst = delta / dt
+            alpha = 1.0 - math.exp(-dt / self._fast_tau)
+            st.ewma = inst if st.ewma is None \
+                else st.ewma + alpha * (inst - st.ewma)
+            slow_alpha = 1.0 - math.exp(-dt / self._slow_tau)
+            st.self_base = inst if st.self_base is None \
+                else st.self_base + slow_alpha * (inst - st.self_base)
+            seeded = self._baselines.get(route, {})
+            baseline = seeded.get("lines_per_sec") or st.self_base
+            if not baseline or baseline <= 0:
+                continue
+            ratio = st.ewma / baseline
+            st.ratio = ratio
+            reg.set_gauge(f"sentinel_{route}_ratio", round(ratio, 4))
+            reg.set_gauge(f"sentinel_{route}_baseline", round(baseline, 1))
+            if ratio < 1.0 - self._drop:
+                st.breach += 1
+                if st.breach >= self._sustain and not st.alerted:
+                    st.alerted = True
+                    alerts.append((route, "lines/s", st.ewma, baseline,
+                                   ratio))
+            else:
+                st.breach = 0
+                st.alerted = False  # recovered: re-arm for a new episode
+            # fetch-B/row axis: cost going UP is the regression
+            fetch_base = seeded.get("fetch_bytes_per_row")
+            if fetch_base:
+                live_fetch = export["gauges"].get(
+                    f"fetch_bytes_per_row_{route}")
+                if live_fetch:
+                    fr = live_fetch / fetch_base
+                    if fr > 1.0 + self._rise:
+                        st.fetch_breach += 1
+                        if st.fetch_breach >= self._sustain \
+                                and not st.fetch_alerted:
+                            st.fetch_alerted = True
+                            alerts.append((route, "fetch B/row",
+                                           live_fetch, fetch_base, fr))
+                    else:
+                        st.fetch_breach = 0
+                        st.fetch_alerted = False
+        from . import events as _events
+
+        for route, axis, measured, baseline, ratio in alerts:
+            self._events += 1
+            _events.emit(
+                "obs/sentinel", "perf_regression", route=route,
+                detail=f"{axis} {measured:,.1f} vs baseline "
+                       f"{baseline:,.1f} ({ratio:.2f}x) sustained "
+                       f"{self._sustain} ticks",
+                cost=round(abs(1.0 - ratio), 4), cost_unit="ratio",
+                msg=f"sentinel: route [{route}] {axis} regression — "
+                    f"measured {measured:,.1f} vs baseline "
+                    f"{baseline:,.1f} ({ratio:.2f}x)")
+
+    # -- export ------------------------------------------------------------
+    def health_section(self) -> dict:
+        with self._lock:
+            routes = {
+                r: {
+                    "live": round(st.ewma, 1) if st.ewma else 0.0,
+                    "ratio": round(st.ratio, 4)
+                    if st.ratio is not None else None,
+                    "alerted": st.alerted or st.fetch_alerted,
+                }
+                for r, st in self._routes.items()
+            }
+            return {"enabled": self.enabled,
+                    "seeded_routes": sorted(self._baselines),
+                    "routes": routes,
+                    "regressions": self._events}
+
+
+sentinel = Sentinel()
+
+
+def configure_from_table(table: dict) -> None:
+    """The ``sentinel_*`` scalar keys of the ``[slo]`` table
+    (obs/slo.configure_from hands the parsed table over)."""
+    from ..config import ConfigError
+
+    enabled = table.get("sentinel", False)
+    if not isinstance(enabled, bool):
+        raise ConfigError("slo.sentinel must be a boolean")
+
+    def num(key, default):
+        v = table.get(key, default)
+        if isinstance(v, bool) or not isinstance(v, (int, float)):
+            raise ConfigError(f"slo.{key} must be a number")
+        return float(v)
+
+    root = table.get("sentinel_bench_root")
+    if root is not None and not isinstance(root, str):
+        raise ConfigError("slo.sentinel_bench_root must be a string "
+                          "(directory holding BENCH_r*.json)")
+    sentinel.configure(
+        enabled=enabled,
+        interval_s=num("sentinel_interval_s", DEFAULT_INTERVAL_S),
+        drop=num("sentinel_drop", DEFAULT_DROP),
+        rise=num("sentinel_rise", DEFAULT_RISE),
+        sustain=int(num("sentinel_sustain", DEFAULT_SUSTAIN)),
+        min_rows=int(num("sentinel_min_rows", DEFAULT_MIN_ROWS)),
+        bench_root=root)
+
+
+def _load_bench_trend(root: str):
+    """Import ``tools/bench_trend.py`` from ``root`` (the BENCH series
+    lives beside it in a checkout) or, failing that, from this repo's
+    own tree — the tool is the single owner of BENCH-schema walking."""
+    import importlib.util
+    import os
+
+    candidates = [os.path.join(root, "tools", "bench_trend.py")]
+    here = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    candidates.append(os.path.join(here, "tools", "bench_trend.py"))
+    for path in candidates:
+        if os.path.exists(path):
+            spec = importlib.util.spec_from_file_location(
+                "flowgger_bench_trend", path)
+            mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(mod)
+            return mod
+    raise ImportError(f"tools/bench_trend.py not found under {root}")

@@ -3,7 +3,7 @@
 The journal (obs/events.py) and the tracer (obs/trace.py) both stream
 completed records to an optional file; this is the ONE implementation
 of that lifecycle — open/close under a lock, one JSON object per line,
-and the error contract both callers rely on:
+size-capped rotation, and the error contract both callers rely on:
 
 - ``open()`` raises ``OSError`` (the caller decides its fallback — a
   bad path at configure time is an operator-visible choice);
@@ -12,14 +12,24 @@ and the error contract both callers rely on:
   the callers sit inside degradation paths (queue shed, breaker trip,
   sequencer emit), and a full disk must never turn recording a
   degradation into a new one.
+
+Rotation (``max_mb``/``keep``): a noisy decline loop used to grow the
+journal file without limit — with ``max_mb`` set, a write that pushes
+the file past the cap rotates it (``path`` → ``path.1`` → … →
+``path.keep``, oldest dropped) and reopens fresh.  Rotation failures
+fold into the best-effort write contract above (sink disabled, one
+notice).  ``max_mb = None`` keeps the historical unbounded behavior.
 """
 
 from __future__ import annotations
 
 import json
+import os
 import sys
 import threading
 from typing import Optional
+
+DEFAULT_KEEP = 3
 
 
 class JsonlSink:
@@ -28,21 +38,48 @@ class JsonlSink:
         self._lock = threading.Lock()
         self._fd = None
         self._path: Optional[str] = None
+        self._max_bytes: Optional[int] = None
+        self._keep = DEFAULT_KEEP
+        self._size = 0
 
-    def open(self, path: Optional[str]) -> None:
+    def open(self, path: Optional[str], max_mb: Optional[float] = None,
+             keep: int = DEFAULT_KEEP) -> None:
         """Point the sink at ``path`` (None = close).  Raises OSError —
-        configure-time callers fall back explicitly."""
+        configure-time callers fall back explicitly.  ``max_mb`` caps
+        the live file; ``keep`` rotated files are retained."""
         with self._lock:
             if self._fd is not None:
                 self._fd.close()
                 self._fd = None
             self._path = path
+            self._max_bytes = None if not max_mb or max_mb <= 0 \
+                else int(max_mb * 1024 * 1024)
+            self._keep = max(1, int(keep))
+            self._size = 0
             if path:
                 self._fd = open(path, "a")
+                try:
+                    self._size = os.path.getsize(path)
+                except OSError:
+                    self._size = 0
 
     @property
     def active(self) -> bool:
         return self._fd is not None
+
+    def _rotate_locked(self) -> None:
+        """``path`` → ``path.1`` → … → ``path.keep`` (oldest dropped),
+        then reopen fresh.  Caller holds the lock; OSError propagates
+        to the write handler, which disables the sink."""
+        self._fd.close()
+        self._fd = None
+        for i in range(self._keep - 1, 0, -1):
+            src = f"{self._path}.{i}"
+            if os.path.exists(src):
+                os.replace(src, f"{self._path}.{i + 1}")
+        os.replace(self._path, f"{self._path}.1")
+        self._fd = open(self._path, "a")
+        self._size = 0
 
     def write(self, doc: dict) -> None:
         """Append one record; a write failure disables the sink (one
@@ -54,15 +91,21 @@ class JsonlSink:
             if self._fd is None:
                 return
             try:
+                if self._max_bytes is not None \
+                        and self._size + len(line) + 1 > self._max_bytes \
+                        and self._size > 0:
+                    self._rotate_locked()
                 self._fd.write(line + "\n")
                 self._fd.flush()
+                self._size += len(line) + 1
             except (OSError, ValueError) as e:
                 # ValueError: write on a handle something else closed
                 path, self._path = self._path, None
-                try:
-                    self._fd.close()
-                except OSError:  # flowcheck: disable=FC04 -- already failing; close is best-effort
-                    pass
+                if self._fd is not None:
+                    try:
+                        self._fd.close()
+                    except OSError:  # flowcheck: disable=FC04 -- already failing; close is best-effort
+                        pass
                 self._fd = None
                 print(f"{self._label}: sink write to {path} failed "
                       f"({e}); sink disabled, in-memory ring keeps "

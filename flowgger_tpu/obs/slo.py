@@ -1,0 +1,518 @@
+"""SLO engine: config-declared objectives evaluated continuously with
+Google-SRE-style multi-window burn rates.
+
+PR 13's flight recorder answers *what degraded*; this module answers
+the operator's actual question — **is the service meeting its
+latency/throughput targets, and how fast is each tenant/route burning
+its error budget?**  Objectives are declared as ``[slo.*]`` tables and
+evaluated on a background ticker against the metrics the pipeline
+already records (no new hot-path instrumentation beyond the per-route
+``e2e_batch_seconds_{route}`` / per-tenant ``queue_wait_seconds_
+{tenant}`` families and one counter per batch)::
+
+    [slo]
+    eval_interval_s = 5            # ticker; 0 = manual tick() (tests)
+
+    [slo.ingest_p99]               # "99% of batches emit under 250ms"
+    kind = "latency"
+    histogram = "e2e_batch_seconds"  # default; or queue_wait_seconds
+    threshold_ms = 250             # a sample this fast is "good"
+    objective = 0.99               # good-fraction target (p99 target)
+    #route = "rfc5424"             # narrow to one route's family
+    #tenant = "acme"               # narrow to one tenant's family
+
+    [slo.acme_floor]               # "acme's admitted rate >= 5k/s"
+    kind = "throughput"
+    tenant = "acme"                # -> tenant_acme_lines counter
+    min_lines_per_sec = 5000
+    objective = 0.99               # fraction of ticks at/above floor
+
+    [slo.quiet_journal]            # "degradations stay rare"
+    kind = "events"
+    #reason = "queue_drop"         # one reason; default: all events
+    max_per_sec = 0.5
+
+Burn-rate model (the Google SRE multi-window form): each objective has
+an **error budget** — ``1 - objective`` for latency/throughput (the
+allowed bad fraction), ``max_per_sec`` for event rates.  The burn rate
+over a window is the observed bad share divided by the budget (1.0 =
+burning exactly the sustainable rate; 10 = the monthly budget gone in
+3 days).  An objective starts **burning** when BOTH the fast window
+(default 5m) and the slow window (default 1h) exceed
+``burn_threshold`` — the fast window confirms the problem is *current*,
+the slow window that it is *significant* — and recovers when the fast
+window clears.  Transitions land as typed journal events
+(``slo_burn`` / ``slo_recover``, obs/events.py) and every tick
+refreshes the ``slo_{name}_burn_rate`` (fast-window burn) and
+``slo_{name}_budget_remaining`` (1 − slow-window burn, floored at 0)
+gauges.
+
+Latency accounting rides the registry's **observe taps**
+(utils/metrics.py): the histogram's own ``observe()`` call increments
+a per-objective good/bad pair, so the hot path pays one dict lookup
+when no SLO targets that histogram and two guarded increments when one
+does — never a second clock read or a sample scan.
+
+The engine is also the home ticker for the regression sentinel
+(obs/sentinel.py): one background thread drives both.
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+DEFAULT_EVAL_INTERVAL_S = 5.0
+DEFAULT_FAST_WINDOW_S = 300.0
+DEFAULT_SLOW_WINDOW_S = 3600.0
+DEFAULT_OBJECTIVE = 0.99
+DEFAULT_BURN_THRESHOLD = 1.0
+
+KINDS = ("latency", "throughput", "events")
+
+# scalar keys accepted at the [slo] table top level (everything else
+# at that level must be an objective sub-table); the sentinel_* family
+# is parsed by obs/sentinel.configure_from over the same table
+ENGINE_KEYS = frozenset({
+    "eval_interval_s",
+    "sentinel", "sentinel_interval_s", "sentinel_drop", "sentinel_rise",
+    "sentinel_sustain", "sentinel_bench_root", "sentinel_min_rows",
+})
+
+_NAME_OK = re.compile(r"[A-Za-z0-9_]+\Z")
+
+
+@dataclass
+class Objective:
+    """One parsed ``[slo.<name>]`` table (validation in
+    :func:`parse_objectives`)."""
+
+    name: str
+    kind: str
+    metric: str                    # resolved histogram / counter name
+    threshold_s: float = 0.0       # latency: good at/under this
+    objective: float = DEFAULT_OBJECTIVE
+    floor_per_sec: float = 0.0     # throughput: minimum rate
+    max_per_sec: float = 0.0       # events: allowed rate (the budget)
+    tenant: Optional[str] = None
+    route: Optional[str] = None
+    fast_window_s: float = DEFAULT_FAST_WINDOW_S
+    slow_window_s: float = DEFAULT_SLOW_WINDOW_S
+    burn_threshold: float = DEFAULT_BURN_THRESHOLD
+
+    @property
+    def budget(self) -> float:
+        """Allowed bad fraction (latency/throughput kinds)."""
+        return max(1e-9, 1.0 - self.objective)
+
+
+class _ObjState:
+    """Runtime half of one objective: cumulative good/bad accounting
+    plus the timestamped point ring the windows diff against."""
+
+    def __init__(self, obj: Objective):
+        self.obj = obj
+        self.lock = threading.Lock()
+        self.total = 0              # latency: samples; throughput: ticks
+        self.bad = 0                # over-threshold / below-floor / events
+        self.last_counter: Optional[int] = None  # throughput/events
+        # (t, total, bad) per tick, pruned past the slow window
+        self.points: "deque[tuple]" = deque()
+        self.burning = False
+        self.fast_burn = 0.0
+        self.slow_burn = 0.0
+        self.budget_remaining = 1.0
+
+    # the latency observe tap — runs inside Registry.observe, so it
+    # must stay two increments under a private lock and never raise
+    def tap(self, value: float) -> None:
+        with self.lock:
+            self.total += 1
+            if value > self.obj.threshold_s:
+                self.bad += 1
+
+
+def _num(table: dict, name: str, key: str, default=None,
+         required: bool = False):
+    from ..config import ConfigError
+
+    v = table.get(key, default)
+    if v is None:
+        if required:
+            raise ConfigError(f"slo.{name}.{key} is required for "
+                              f"kind = \"{table.get('kind')}\"")
+        return None
+    if isinstance(v, bool) or not isinstance(v, (int, float)):
+        raise ConfigError(f"slo.{name}.{key} must be a number")
+    return float(v)
+
+
+def parse_objectives(table: dict) -> List[Objective]:
+    """``[slo.*]`` sub-tables → validated :class:`Objective` list.
+    Raises ``ConfigError`` with the offending key, matching the
+    repo-wide config error style."""
+    from ..config import ConfigError
+
+    out: List[Objective] = []
+    for name, sub in table.items():
+        if not isinstance(sub, dict):
+            if name not in ENGINE_KEYS:
+                raise ConfigError(
+                    f"unknown [slo] key {name!r} (engine keys: "
+                    f"{', '.join(sorted(ENGINE_KEYS))}; objectives are "
+                    "[slo.<name>] tables)")
+            continue
+        if not _NAME_OK.match(name):
+            raise ConfigError(
+                f"slo objective name {name!r} must match [A-Za-z0-9_]+ "
+                "(it becomes the slo_{name}_* gauge family)")
+        kind = sub.get("kind")
+        if kind not in KINDS:
+            raise ConfigError(
+                f"slo.{name}.kind must be one of {KINDS}")
+        tenant = sub.get("tenant")
+        route = sub.get("route")
+        for dim, val in (("tenant", tenant), ("route", route)):
+            if val is not None and (not isinstance(val, str)
+                                    or not _NAME_OK.match(val)):
+                raise ConfigError(
+                    f"slo.{name}.{dim} must be a [A-Za-z0-9_]+ string")
+        if tenant is not None and route is not None:
+            raise ConfigError(
+                f"slo.{name}: tenant and route are mutually exclusive "
+                "dimensions (one objective targets one family instance)")
+        obj = Objective(name=name, kind=kind, metric="",
+                        tenant=tenant, route=route)
+        objective = _num(sub, name, "objective")
+        if objective is not None:
+            if not 0.0 < objective < 1.0:
+                raise ConfigError(
+                    f"slo.{name}.objective must be in (0, 1)")
+            obj.objective = objective
+        for key, attr, default in (
+                ("fast_window_s", "fast_window_s", DEFAULT_FAST_WINDOW_S),
+                ("slow_window_s", "slow_window_s", DEFAULT_SLOW_WINDOW_S),
+                ("burn_threshold", "burn_threshold",
+                 DEFAULT_BURN_THRESHOLD)):
+            v = _num(sub, name, key)
+            if v is not None:
+                if v <= 0:
+                    raise ConfigError(f"slo.{name}.{key} must be > 0")
+                setattr(obj, attr, v)
+        if obj.fast_window_s >= obj.slow_window_s:
+            raise ConfigError(
+                f"slo.{name}: fast_window_s must be < slow_window_s "
+                "(the fast window confirms currency, the slow one "
+                "significance)")
+        if kind == "latency":
+            hist = sub.get("histogram", "e2e_batch_seconds")
+            if not isinstance(hist, str) or not _NAME_OK.match(hist):
+                raise ConfigError(
+                    f"slo.{name}.histogram must be a histogram name")
+            dim = route or tenant
+            obj.metric = f"{hist}_{dim}" if dim else hist
+            obj.threshold_s = _num(sub, name, "threshold_ms",
+                                   required=True) / 1000.0
+            if obj.threshold_s <= 0:
+                raise ConfigError(
+                    f"slo.{name}.threshold_ms must be > 0")
+        elif kind == "throughput":
+            counter = sub.get("counter")
+            if counter is None:
+                if tenant:
+                    counter = f"tenant_{tenant}_lines"
+                elif route:
+                    counter = f"route_rows_{route}"
+                else:
+                    counter = "input_lines"
+            if not isinstance(counter, str) or not _NAME_OK.match(counter):
+                raise ConfigError(
+                    f"slo.{name}.counter must be a counter name")
+            obj.metric = counter
+            obj.floor_per_sec = _num(sub, name, "min_lines_per_sec",
+                                     required=True)
+            if obj.floor_per_sec <= 0:
+                raise ConfigError(
+                    f"slo.{name}.min_lines_per_sec must be > 0")
+        else:  # events
+            reason = sub.get("reason")
+            if reason is not None:
+                from .events import REASONS
+
+                if reason not in REASONS:
+                    raise ConfigError(
+                        f"slo.{name}.reason must be a known degradation "
+                        f"reason (one of: {', '.join(REASONS)})")
+                obj.metric = f"events_{reason}"
+            else:
+                obj.metric = "degradation_events"
+            obj.max_per_sec = _num(sub, name, "max_per_sec",
+                                   required=True)
+            if obj.max_per_sec <= 0:
+                raise ConfigError(f"slo.{name}.max_per_sec must be > 0")
+        known = {"kind", "histogram", "threshold_ms", "objective",
+                 "counter", "min_lines_per_sec", "reason", "max_per_sec",
+                 "tenant", "route", "fast_window_s", "slow_window_s",
+                 "burn_threshold"}
+        for key in sub:
+            if key not in known:
+                raise ConfigError(
+                    f"unknown slo.{name}.{key} (known objective keys: "
+                    f"{', '.join(sorted(known))})")
+        out.append(obj)
+    return out
+
+
+class SloEngine:
+    """Evaluates configured objectives on a ticker; module singleton
+    ``engine``.  ``clock`` is injectable so tests drive windows
+    deterministically."""
+
+    def __init__(self, registry=None, clock=time.monotonic):
+        self._registry = registry
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._states: List[_ObjState] = []
+        self._interval = 0.0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._ticks = 0
+
+    def _reg(self):
+        if self._registry is not None:
+            return self._registry
+        from ..utils.metrics import registry as _global
+
+        return _global
+
+    # -- configuration -----------------------------------------------------
+    def configure(self, objectives: List[Objective],
+                  interval_s: float = DEFAULT_EVAL_INTERVAL_S,
+                  registry=None) -> None:
+        """Install objectives (replacing any prior set), register the
+        latency observe taps, and (re)start the ticker when
+        ``interval_s > 0`` and there is anything to evaluate."""
+        self.stop()
+        # drop the PREVIOUS configuration's latency taps before
+        # re-registering: add_observe_tap only appends, and a pipeline
+        # that reconfigures without a registry reset must not leave
+        # dead _ObjState closures on the observe hot path forever.
+        # (The SLO engine is the registry's only tap consumer.)
+        self._reg().clear_observe_taps()
+        if registry is not None:
+            self._registry = registry
+        reg = self._reg()
+        with self._lock:
+            self._states = [_ObjState(o) for o in objectives]
+            self._interval = float(interval_s)
+            self._ticks = 0
+            for st in self._states:
+                if st.obj.kind == "latency":
+                    reg.add_observe_tap(st.obj.metric, st.tap)
+                # gauges visible from tick zero: a dashboard shows a
+                # healthy 0-burn objective, not a missing series
+                reg.set_gauge(f"slo_{st.obj.name}_burn_rate", 0.0)
+                reg.set_gauge(f"slo_{st.obj.name}_budget_remaining", 1.0)
+        from . import sentinel as _sentinel
+
+        if self._interval > 0 and (self._states
+                                   or _sentinel.sentinel.enabled):
+            # one ticker drives both the objectives and the regression
+            # sentinel (it paces itself off its own interval)
+            self._stop = threading.Event()
+            self._thread = threading.Thread(
+                target=self._run, daemon=True, name="slo-engine")
+            self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None
+
+    def reset(self) -> None:
+        """Tests: drop objectives, their taps, and the ticker."""
+        self.stop()
+        self._reg().clear_observe_taps()
+        with self._lock:
+            self._states = []
+            self._ticks = 0
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._interval):
+            try:
+                self.tick()
+            except Exception as e:  # noqa: BLE001 - the evaluator must never die silently mid-soak
+                print(f"slo: tick failed: {e}", file=sys.stderr)
+            from . import sentinel as _sentinel
+
+            _sentinel.sentinel.maybe_tick()
+
+    # -- evaluation --------------------------------------------------------
+    @staticmethod
+    def _window_delta(points, now: float, window: float):
+        """(dt, total_delta, bad_delta) between now's point and the
+        oldest point still inside ``window`` (the point *at or before*
+        the window edge, so a sparse ring still covers the full span)."""
+        if len(points) < 2:
+            return 0.0, 0, 0
+        newest = points[-1]
+        anchor = points[0]
+        edge = now - window
+        for p in points:
+            if p[0] > edge:
+                break
+            anchor = p
+        dt = newest[0] - anchor[0]
+        return dt, newest[1] - anchor[1], newest[2] - anchor[2]
+
+    def _burns(self, st: _ObjState, now: float):
+        obj = st.obj
+        out = []
+        for window in (obj.fast_window_s, obj.slow_window_s):
+            dt, total_d, bad_d = self._window_delta(st.points, now, window)
+            if obj.kind == "events":
+                rate = (bad_d / dt) if dt > 0 else 0.0
+                out.append(rate / obj.max_per_sec)
+            else:
+                frac = (bad_d / total_d) if total_d > 0 else 0.0
+                out.append(frac / obj.budget)
+        return out  # [fast, slow]
+
+    def tick(self, now: Optional[float] = None) -> None:
+        """One evaluation pass (the ticker calls this; tests call it
+        directly with a controlled ``now``)."""
+        now = self._clock() if now is None else now
+        reg = self._reg()
+        with self._lock:
+            states = list(self._states)
+            self._ticks += 1
+        transitions = []
+        for st in states:
+            obj = st.obj
+            if obj.kind == "latency":
+                with st.lock:
+                    total, bad = st.total, st.bad
+            else:
+                value = reg.get(obj.metric)
+                if obj.kind == "throughput":
+                    if st.last_counter is None or not st.points:
+                        # first sighting: no rate yet, no verdict
+                        st.last_counter = value
+                        st.points.append((now, 0, 0))
+                        continue
+                    prev_t = st.points[-1][0]
+                    dt = now - prev_t
+                    inst = ((value - st.last_counter) / dt) if dt > 0 \
+                        else obj.floor_per_sec
+                    st.last_counter = value
+                    total = st.points[-1][1] + 1
+                    bad = st.points[-1][2] + \
+                        (1 if inst < obj.floor_per_sec else 0)
+                else:  # events: cumulative event count IS the bad series
+                    total, bad = 0, value
+            st.points.append((now, total, bad))
+            # prune past the slow window, keeping one anchor before it
+            edge = now - obj.slow_window_s
+            while len(st.points) > 2 and st.points[1][0] <= edge:
+                st.points.popleft()
+            fast, slow = self._burns(st, now)
+            st.fast_burn, st.slow_burn = fast, slow
+            st.budget_remaining = max(0.0, 1.0 - slow)
+            reg.set_gauge(f"slo_{obj.name}_burn_rate", round(fast, 4))
+            reg.set_gauge(f"slo_{obj.name}_budget_remaining",
+                          round(st.budget_remaining, 4))
+            th = obj.burn_threshold
+            if not st.burning and fast >= th and slow >= th:
+                st.burning = True
+                transitions.append((st, "slo_burn"))
+            elif st.burning and fast < th:
+                st.burning = False
+                transitions.append((st, "slo_recover"))
+        # journal AFTER the evaluation loop: emit() may write the JSONL
+        # sink (disk I/O) and must not sit between gauge updates
+        from . import events as _events
+
+        for st, reason in transitions:
+            obj = st.obj
+            verb = "burning" if reason == "slo_burn" else "recovered"
+            _events.emit(
+                "obs/slo", reason,
+                detail=f"{obj.name} ({obj.kind}/{obj.metric}): "
+                       f"fast {st.fast_burn:.2f}x, slow "
+                       f"{st.slow_burn:.2f}x of budget "
+                       f"(threshold {obj.burn_threshold:g}x)",
+                route=obj.route, tenant=obj.tenant,
+                cost=round(st.fast_burn, 4), cost_unit="burn_rate",
+                msg=f"slo: objective [{obj.name}] {verb} — fast-window "
+                    f"burn {st.fast_burn:.2f}x, budget remaining "
+                    f"{st.budget_remaining:.0%}")
+
+    # -- export ------------------------------------------------------------
+    def health_section(self) -> dict:
+        """The ``slo`` section of the health document (and the per-host
+        half ``/fleetz`` aggregates)."""
+        with self._lock:
+            states = list(self._states)
+            ticks = self._ticks
+        from . import sentinel as _sentinel
+
+        objectives = []
+        for st in states:
+            obj = st.obj
+            entry = {
+                "name": obj.name, "kind": obj.kind, "metric": obj.metric,
+                "burning": st.burning,
+                "fast_burn": round(st.fast_burn, 4),
+                "slow_burn": round(st.slow_burn, 4),
+                "budget_remaining": round(st.budget_remaining, 4),
+                "burn_threshold": obj.burn_threshold,
+            }
+            if obj.tenant:
+                entry["tenant"] = obj.tenant
+            if obj.route:
+                entry["route"] = obj.route
+            objectives.append(entry)
+        return {
+            "configured": len(objectives),
+            "burning": sum(1 for o in objectives if o["burning"]),
+            "evaluations": ticks,
+            "objectives": objectives,
+            "sentinel": _sentinel.sentinel.health_section(),
+        }
+
+
+# the process-wide engine the pipeline, health servers and tests share
+engine = SloEngine()
+
+
+def configure_from(config) -> None:
+    """Wire the ``[slo]`` table (pipeline boot, via
+    utils.metrics.configure_from).  No table = engine idle, zero
+    threads, zero taps.  Also hands the table to the regression
+    sentinel (obs/sentinel.py), which shares the engine's ticker."""
+    from ..config import ConfigError
+
+    table = config.lookup_table(
+        "slo", "slo must be a table of [slo.*] objective tables")
+    from . import sentinel as _sentinel
+
+    if table is None:
+        engine.reset()
+        _sentinel.sentinel.configure(enabled=False)
+        return
+    interval = table.get("eval_interval_s", DEFAULT_EVAL_INTERVAL_S)
+    if isinstance(interval, bool) or not isinstance(interval, (int, float)):
+        raise ConfigError("slo.eval_interval_s must be a number "
+                          "(seconds; 0 disables the ticker)")
+    objectives = parse_objectives(table)
+    _sentinel.configure_from_table(table)
+    engine.configure(objectives, interval_s=float(interval))
+    if objectives:
+        print(f"slo: {len(objectives)} objective(s) under evaluation "
+              f"every {interval:g}s", file=sys.stderr)

@@ -16,6 +16,8 @@ Config (``[metrics]``)::
     trace = "off"          # "off" | "ring" | "jsonl"
     trace_ring = 256       # completed batch traces kept (ring/jsonl)
     trace_path = "t.jsonl" # jsonl mode: one JSON object per batch
+    trace_max_mb = 64      # rotate the jsonl sink past this size
+    trace_keep = 3         # rotated files kept (t.jsonl.1 ...)
 
 Cost model: ``tracer.active`` is a plain attribute — when tracing is
 off every instrumentation site is one attribute read and a
@@ -27,7 +29,10 @@ is bounded regardless of uptime.
 The stage timeline is wall-clock-anchored once per process
 (``perf_counter`` ↔ ``time.time`` epoch pair) so Chrome trace ``ts``
 microseconds are absolute and two hosts' dumps can be laid side by
-side.
+side.  Fleet correlation: once ``fleet/federation.py`` calls
+:meth:`Tracer.set_rank`, every completed batch trace carries a
+``rank`` field, and ``tools/trace_dump.py --fleet`` merges every
+routable host's ring into one document with per-host process lanes.
 """
 
 from __future__ import annotations
@@ -68,6 +73,7 @@ class Tracer:
         self._completed = 0
         self._dropped_open = 0
         self._sink = JsonlSink("trace")
+        self._rank: Optional[int] = None
         # perf_counter -> wall anchor, fixed at construction: chrome ts
         # microseconds are absolute wall time
         self._epoch_wall = time.time()
@@ -75,7 +81,8 @@ class Tracer:
 
     # -- configuration -----------------------------------------------------
     def configure(self, mode: str, ring: int = DEFAULT_RING,
-                  path: Optional[str] = None) -> None:
+                  path: Optional[str] = None,
+                  max_mb: Optional[float] = None, keep: int = 3) -> None:
         if mode not in MODES:
             raise ValueError(f"trace mode must be one of {MODES}")
         with self._lock:
@@ -87,10 +94,16 @@ class Tracer:
             self._open.clear()
             self._completed = 0
             self._dropped_open = 0
-        self._sink.open(path if mode == JSONL else None)
+        self._sink.open(path if mode == JSONL else None,
+                        max_mb=max_mb, keep=keep)
         # flipped last: a site observing active=True sees a configured
         # tracer
         self.active = mode != OFF
+
+    def set_rank(self, rank: Optional[int]) -> None:
+        """Fleet correlation: stamp every subsequent batch trace with
+        this host's fleet rank (federation.Fleet.start)."""
+        self._rank = rank
 
     def close(self) -> None:
         self.active = False
@@ -112,8 +125,11 @@ class Tracer:
                 # a crash path) must not leak the open table forever
                 self._open.pop(next(iter(self._open)))
                 self._dropped_open += 1
-            self._open[bid] = {"bid": bid, "route": route, "t0": t0,
-                               "rows": 0, "spans": []}
+            rec = {"bid": bid, "route": route, "t0": t0,
+                   "rows": 0, "spans": []}
+            if self._rank is not None:
+                rec["rank"] = self._rank
+            self._open[bid] = rec
         return bid
 
     def span(self, bid: Optional[int], stage: str, t0: float, t1: float,
@@ -244,13 +260,21 @@ def configure_from(config) -> None:
         DEFAULT_RING)
     path = config.lookup_str(
         "metrics.trace_path", "metrics.trace_path must be a string (file)")
+    max_mb = config.lookup_float(
+        "metrics.trace_max_mb",
+        "metrics.trace_max_mb must be a number (MB before the JSONL "
+        "sink rotates)")
+    keep = config.lookup_int(
+        "metrics.trace_keep",
+        "metrics.trace_keep must be an integer (rotated files kept)", 3)
     if mode == JSONL and not path:
         from ..config import ConfigError
 
         raise ConfigError(
             'metrics.trace = "jsonl" requires metrics.trace_path')
     try:
-        tracer.configure(mode, ring=ring, path=path)
+        tracer.configure(mode, ring=ring, path=path, max_mb=max_mb,
+                         keep=keep)
     except OSError as e:
         # an unwritable trace sink must never kill ingest: fall back to
         # the in-memory ring and say so

@@ -434,6 +434,12 @@ class Pipeline:
                   f"after 30s, abandoning: [{names}]", file=sys.stderr)
         _metrics_mod.registry.final_flush()
         _metrics_mod.stop_jax_profiler()
+        # the SLO engine's evaluator (and the sentinel riding its
+        # ticker) stops with the pipeline — a drained process must not
+        # keep journaling slo_burn events off a frozen traffic rate
+        from .obs import slo as _slo
+
+        _slo.engine.stop()
         # drain-on-departure, phase 2: every queued batch reached the
         # sinks — announce `departed` and stop the fleet threads
         if self.fleet is not None:

@@ -104,13 +104,17 @@ class WeightedFairQueue:
         # behind the disk exactly when overload sheds fire
         self._event_buf: list = []
 
-    def _sample_wait_locked(self, ts: float) -> None:
+    def _sample_wait_locked(self, ts: float, lane: str) -> None:
         """Sampled sojourn time of dequeued items (PolicyQueue parity:
-        one queue_wait_seconds sample per QUEUE_WAIT_SAMPLE gets)."""
+        one queue_wait_seconds sample per QUEUE_WAIT_SAMPLE gets).
+        Samples also land the per-tenant ``queue_wait_seconds_{tenant}``
+        family so a tenant-scoped latency SLO (obs/slo.py) can tell a
+        starved lane from global pressure."""
         self._wait_n += 1
         if self._wait_n % QUEUE_WAIT_SAMPLE == 0:
-            _metrics.observe("queue_wait_seconds",
-                             time.perf_counter() - ts)
+            wait = time.perf_counter() - ts
+            _metrics.observe("queue_wait_seconds", wait)
+            _metrics.observe(f"queue_wait_seconds_{lane}", wait)
 
     # -- introspection (PolicyQueue/queue.Queue parity) --------------------
     def qsize(self) -> int:
@@ -279,7 +283,7 @@ class WeightedFairQueue:
             if not lane.q:
                 lane.deficit = 0.0
             self._total -= 1
-            self._sample_wait_locked(ts)
+            self._sample_wait_locked(ts, lane.name)
             return item
         # DRR: resume the rotation after the last-served lane; refill
         # every active lane's deficit until one can afford its head
@@ -297,7 +301,7 @@ class WeightedFairQueue:
                         lane.deficit = 0.0
                     self._total -= 1
                     self._cursor = idx
-                    self._sample_wait_locked(ts)
+                    self._sample_wait_locked(ts, lane.name)
                     return item
             for n in active:
                 lane = self._lanes[n]

@@ -858,7 +858,8 @@ class BatchHandler(Handler):
                 if not deferred[0]:
                     # emitted synchronously: close the trace here (a
                     # deferred batch closes it at its sequenced emit)
-                    self._finish_batch(bid, self._flush_t0)
+                    self._finish_batch(bid, self._flush_t0,
+                                       rows=int(packed[5]))
             else:
                 results = self._kernel_fn(lines)
                 self._window.fence()
@@ -924,7 +925,7 @@ class BatchHandler(Handler):
             # fetch time in _pop_emit instead — and this batch's
             # flush→emit wall is complete right here
             self._record_sync_success()
-            self._finish_batch(trace, self._flush_t0)
+            self._finish_batch(trace, self._flush_t0, rows=int(packed[5]))
 
     def _scalar_handle(self, raw: bytes) -> None:
         """One line through the right scalar oracle, honoring the
@@ -1280,7 +1281,7 @@ class BatchHandler(Handler):
             # decode still lands at the batch's position in the stream
             def fallback():
                 self._scalar_fallback_packed(packed)
-                self._finish_batch(bid, t_flush)
+                self._finish_batch(bid, t_flush, rows=int(packed[5]))
 
             return fallback
         # measure the route's compute wall now — the sequencer wait
@@ -1308,7 +1309,7 @@ class BatchHandler(Handler):
                     raise
                 self._device_failed(e)
                 self._scalar_fallback_packed(packed)
-                self._finish_batch(bid, t_flush)
+                self._finish_batch(bid, t_flush, rows=int(packed[5]))
                 return
             if bid is not None:
                 _tracer.span(bid, "emit", t_emit0, _time.perf_counter(),
@@ -1322,18 +1323,28 @@ class BatchHandler(Handler):
                 # waits) is the device tier's fault, not the host
                 # path's — already subtracted
                 econ.observe(path, int(packed[5]), compute_s)
-            self._finish_batch(bid, t_flush)
+            self._finish_batch(bid, t_flush, rows=int(packed[5]))
 
         return finish
 
-    def _finish_batch(self, bid, t_flush: float) -> None:
+    def _finish_batch(self, bid, t_flush: float, rows: int = 0) -> None:
         """One batch fully emitted: observe the flush→emit wall
-        (e2e_batch_seconds) and close its flight-recorder trace."""
+        (e2e_batch_seconds, plus the per-route family the SLO engine
+        and regression sentinel key on), count the route's rows, and
+        close its flight-recorder trace."""
         import time as _time
 
+        if _faults.enabled() and _faults.fire("route_throttle"):
+            # the sentinel drill: an injected per-batch delay collapses
+            # this route's lines/s with no byte-level change —
+            # obs/sentinel.py must surface it as perf_regression
+            _time.sleep(0.05)
         e2e = (_time.perf_counter() - t_flush) if t_flush else None
         if e2e is not None:
             _metrics.observe("e2e_batch_seconds", e2e)
+            _metrics.observe(f"e2e_batch_seconds_{self.fmt}", e2e)
+        if rows:
+            _metrics.inc(f"route_rows_{self.fmt}", int(rows))
         _tracer.end(bid, e2e)
 
     def _pop_emit_inner(self, handle, packed, stats=None, econ=None,

@@ -45,6 +45,11 @@ Sites wired in (each names the exception type it surfaces):
 - ``roster_corrupt`` — the next durable-roster journal write
   (fleet/roster.py) writes a deliberately truncated file instead: the
   corrupt-journal → clean-re-rendezvous path, end to end.
+- ``route_throttle`` — injects a 50 ms delay into each firing batch's
+  finish path (tpu/batch.py ``_finish_batch``): an artificial
+  route-throughput collapse with no byte-level change, the drill the
+  regression sentinel (obs/sentinel.py) must flag as
+  ``perf_regression`` within its window.
 
 Runtime arming: beyond the boot-time plan below, ``set_site`` merges
 one site into the active plan while the process runs — the fleet
@@ -68,7 +73,8 @@ ENV_VAR = "FLOWGGER_FAULTS"
 
 KNOWN_SITES = ("device_decode", "input_socket", "sink_write",
                "queue_pressure", "tenant_flood", "peer_partition",
-               "host_kill", "coordinator_kill", "roster_corrupt")
+               "host_kill", "coordinator_kill", "roster_corrupt",
+               "route_throttle")
 
 
 class InjectedFault(Exception):

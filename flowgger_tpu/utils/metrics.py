@@ -75,7 +75,22 @@ Observability layer (obs/): degradation rungs journal through
 ``obs.events`` and mirror here as the ``degradation_events`` aggregate
 plus the per-reason ``events_{reason}`` counter family; the whole
 registry renders in the Prometheus text exposition format via
-``obs.prom.render`` (``GET /metrics``).  The declaration tuples below
+``obs.prom.render`` (``GET /metrics``).
+
+SLO plane (obs/slo.py + obs/sentinel.py): per-batch emits land the
+``route_rows_{route}`` counter family and the per-route
+``e2e_batch_seconds_{route}`` histogram family (tpu/batch.py
+``_finish_batch``); the weighted-fair queue lands per-tenant sojourn
+samples as ``queue_wait_seconds_{tenant}``.  The SLO engine exports
+``slo_{name}_burn_rate`` / ``slo_{name}_budget_remaining`` gauges per
+configured objective, and the regression sentinel exports
+``sentinel_{route}_ratio`` / ``sentinel_{route}_baseline`` gauges.
+Histograms additionally support *observe taps*
+(:meth:`Registry.add_observe_tap`) — the SLO engine's per-sample
+threshold accounting rides the existing ``observe()`` call with one
+dict lookup when no tap is registered.
+
+The declaration tuples below
 (``_COUNTERS``/``_SECONDS_NAMES``/``_GAUGE_NAMES``/
 ``_HISTOGRAM_NAMES``/``_FAMILY_PATTERNS``) are the metric-name
 namespace flowcheck rule FC06 resolves every literal call-site name
@@ -187,7 +202,110 @@ _FAMILY_PATTERNS = (
     "fetch_bytes_per_row_{route}", "emit_bytes_per_row_{route}",
     "framing_{path}_spr",
     "events_{reason}",
+    # SLO / observability plane (obs/slo.py, obs/sentinel.py,
+    # tpu/batch.py _finish_batch, tenancy/fairqueue.py)
+    "route_rows_{route}",
+    "e2e_batch_seconds_{route}", "queue_wait_seconds_{tenant}",
+    "slo_{name}_burn_rate", "slo_{name}_budget_remaining",
+    "sentinel_{route}_ratio", "sentinel_{route}_baseline",
 )
+
+
+# kind of each dynamic family in _FAMILY_PATTERNS — the fleet-level
+# merge (fleet/federation.merge_metric_snapshots) must sum counters
+# and pool histograms while leaving point-in-time gauges per-host, and
+# a flat snapshot alone cannot tell them apart
+_FAMILY_KINDS = (
+    ("lane{i}_depth", "gauge"),
+    ("lane{i}_rows", "counter"),
+    ("lane{i}_route_{path}_spr", "gauge"),
+    ("queue_dropped_{policy}", "counter"),
+    ("tenant_{name}_state", "gauge"),
+    ("tenant_{name}_templates_distinct", "gauge"),
+    ("tenant_{name}_template_overflow", "counter"),
+    ("tenant_{name}_template_{id}", "counter"),
+    ("tenant_{name}_lines", "counter"),
+    ("tenant_{name}_bytes", "counter"),
+    ("tenant_{name}_drops", "counter"),
+    ("tenant_{name}_shed", "counter"),
+    ("fleet_hosts_{state}", "gauge"),
+    ("fleet_peer{rank}_state", "gauge"),
+    ("fleet_peer{rank}_hb_age_ms", "gauge"),
+    ("fleet_peer{rank}_share", "gauge"),
+    ("aot_rejects_{reason}", "counter"),
+    ("fused_rows_{route}", "counter"),
+    ("fused_fallbacks_{route}", "counter"),
+    ("fetch_bytes_per_row_{route}", "gauge"),
+    ("emit_bytes_per_row_{route}", "gauge"),
+    ("framing_{path}_spr", "gauge"),
+    ("events_{reason}", "counter"),
+    ("route_rows_{route}", "counter"),
+    ("e2e_batch_seconds_{route}", "histogram"),
+    ("queue_wait_seconds_{tenant}", "histogram"),
+    ("slo_{name}_burn_rate", "gauge"),
+    ("slo_{name}_budget_remaining", "gauge"),
+    ("sentinel_{route}_ratio", "gauge"),
+    ("sentinel_{route}_baseline", "gauge"),
+)
+
+_classify_cache: Dict[str, Optional[str]] = {}
+_CLASSIFY_CACHE_MAX = 4096  # /fleetz feeds REMOTE snapshot keys here:
+#                             a skewed peer's churning names must not
+#                             grow a process-global cache forever
+_family_kind_rx = None
+
+
+def classify_metric(name: str) -> Optional[str]:
+    """``"counter" | "seconds" | "gauge" | "histogram" | None`` for a
+    metric name, resolving the declared tuples first and then the
+    family patterns above (first match wins — patterns are ordered
+    most-specific-first where prefixes overlap)."""
+    global _family_kind_rx
+    cached = _classify_cache.get(name)
+    if cached is not None or name in _classify_cache:
+        return cached
+    if _family_kind_rx is None:
+        import re as _re
+
+        def rx(pattern):
+            out, pos = [], 0
+            for m in _re.finditer(r"\{[A-Za-z0-9_]+\}", pattern):
+                out.append(_re.escape(pattern[pos:m.start()]))
+                out.append(r"[A-Za-z0-9_]+")
+                pos = m.end()
+            out.append(_re.escape(pattern[pos:]))
+            return _re.compile("".join(out) + r"\Z")
+
+        _family_kind_rx = [(rx(p), kind) for p, kind in _FAMILY_KINDS]
+    kind: Optional[str] = None
+    if name in _COUNTERS:
+        kind = "counter"
+    elif name in _SECONDS_NAMES:
+        kind = "seconds"
+    elif name in _GAUGE_NAMES:
+        kind = "gauge"
+    elif name in _HISTOGRAM_NAMES:
+        kind = "histogram"
+    else:
+        for pattern, fam_kind in _family_kind_rx:
+            if pattern.match(name):
+                kind = fam_kind
+                break
+    if len(_classify_cache) < _CLASSIFY_CACHE_MAX:
+        _classify_cache[name] = kind
+    return kind
+
+
+def window_quantiles(sorted_samples) -> Dict[str, float]:
+    """p50/p99 over an already-sorted sample list — the ONE definition
+    of this registry's summary quantiles.  Histogram.snapshot() and the
+    fleet merge (fleet/federation.merge_metric_snapshots) both call it,
+    so a per-host quantile change cannot drift from the fleet view."""
+    if not sorted_samples:
+        return {}
+    n = len(sorted_samples)
+    return {"p50": sorted_samples[n // 2],
+            "p99": sorted_samples[min(n - 1, int(n * 0.99))]}
 
 
 class Histogram:
@@ -215,15 +333,30 @@ class Histogram:
             samples = sorted(self._samples)
             count, total = self.count, self.sum
         if not samples:
-            return {"count": 0}
+            return {"count": 0, "sample_count": 0}
         return {
             "count": count,
             "sum": round(total, 6),
             "min": samples[0],
-            "p50": samples[len(samples) // 2],
-            "p99": samples[min(len(samples) - 1, int(len(samples) * 0.99))],
+            **window_quantiles(samples),
             "max": samples[-1],
+            # how many window samples back the quantiles above: the
+            # window is bounded, so a scraper (and the fleet merge)
+            # can judge quantile confidence instead of trusting a p99
+            # computed from 3 samples
+            "sample_count": len(samples),
         }
+
+    def samples(self, cap: int = 128) -> list:
+        """Up to ``cap`` evenly-strided window samples (sorted) — the
+        raw material the fleet-level histogram merge pools so merged
+        quantiles come from data, not from averaging per-host p99s."""
+        with self._lock:
+            samples = sorted(self._samples)
+        if len(samples) <= cap:
+            return [round(s, 6) for s in samples]
+        stride = len(samples) / cap
+        return [round(samples[int(i * stride)], 6) for i in range(cap)]
 
 
 class Registry:
@@ -247,6 +380,11 @@ class Registry:
         self._out_lock = threading.Lock()
         self._out = None
         self._path: Optional[str] = None
+        # observe taps: name -> (fn, ...) called after the histogram
+        # records a sample (obs/slo.py threshold accounting).  Replaced
+        # wholesale under _lock, read without it on the observe path —
+        # an observe racing a reconfigure sees either tuple, both valid
+        self._observe_taps: Dict[str, tuple] = {}
 
     def inc(self, name: str, value: int = 1):
         with self._lock:
@@ -283,6 +421,22 @@ class Registry:
             with self._lock:
                 h = self._hists.setdefault(name, Histogram())
         h.observe(value)
+        taps = self._observe_taps.get(name)
+        if taps:
+            for tap in taps:
+                tap(value)
+
+    def add_observe_tap(self, name: str, fn) -> None:
+        """Register ``fn(value)`` to run after every ``observe(name,
+        ...)`` sample — the SLO engine's per-objective good/bad
+        accounting.  Taps must be cheap and never raise."""
+        with self._lock:
+            self._observe_taps[name] = self._observe_taps.get(name, ()) \
+                + (fn,)
+
+    def clear_observe_taps(self) -> None:
+        with self._lock:
+            self._observe_taps = {}
 
     def histogram(self, name: str) -> Histogram:
         h = self._hists.get(name)
@@ -295,7 +449,12 @@ class Registry:
         with self._lock:
             return self._counters.get(name, 0)
 
-    def snapshot(self) -> Dict[str, object]:
+    def snapshot(self, include_hist_samples: bool = False
+                 ) -> Dict[str, object]:
+        """Flat JSON-safe snapshot.  ``include_hist_samples`` adds each
+        histogram's bounded sample ring (the fleet /fleetz merge pools
+        them for honest merged quantiles); the periodic JSONL reporter
+        leaves it off so report lines stay one-screen."""
         with self._lock:
             counters = dict(self._counters)
             seconds = {k: round(v, 6) for k, v in self._seconds.items()}
@@ -306,7 +465,10 @@ class Registry:
         snap.update(seconds)
         snap.update(gauges)
         for name, h in hists.items():
-            snap[name] = h.snapshot()
+            hsnap = h.snapshot()
+            if include_hist_samples:
+                hsnap["samples"] = h.samples()
+            snap[name] = hsnap
         return snap
 
     def export(self) -> Dict[str, dict]:
@@ -330,6 +492,7 @@ class Registry:
             self._gauges.clear()
             self.batch_seconds = Histogram()
             self._hists = {"batch_seconds": self.batch_seconds}
+            self._observe_taps = {}
 
     # -- periodic reporter -------------------------------------------------
     def start_reporter(self, interval: float, path: Optional[str] = None):
@@ -406,10 +569,12 @@ def configure_from(config) -> None:
         _profile_dir = profile_dir
         start_jax_profiler(profile_dir)
     from ..obs import events as _events
+    from ..obs import slo as _slo
     from ..obs import trace as _trace
 
     _trace.configure_from(config)
     _events.configure_from(config)
+    _slo.configure_from(config)
 
 
 _profiling = False

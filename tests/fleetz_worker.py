@@ -1,0 +1,54 @@
+"""Worker process for the /fleetz staleness acceptance test
+(tests/test_fleetz.py — NOT a test module itself).
+
+Joins a localhost fleet via the production ``Fleet`` path (no
+``jax.distributed`` — the observability plane is jax-free), feeds its
+registry a steady per-route traffic trickle plus latency samples so
+its ``/healthz`` snapshot carries real merged material, prints
+``ready`` once active, and idles.  When the harness arms
+``FLOWGGER_FAULTS=host_kill=once:N`` this process SIGKILLs itself from
+the fleet ticker — no drain, no goodbye — so the scraping host must
+serve this worker's **last cached snapshot flagged stale** on
+``/fleetz`` instead of dropping it.
+"""
+
+import os
+import sys
+import time
+
+
+def main():
+    rank = int(sys.argv[1])
+    port = sys.argv[2]
+    coordinator = sys.argv[3]  # "" on rank 0
+
+    from flowgger_tpu.config import Config
+    from flowgger_tpu.fleet import Fleet
+    from flowgger_tpu.utils import faultinject
+    from flowgger_tpu.utils.metrics import registry
+
+    coord = (f'tpu_fleet_coordinator = "127.0.0.1:{coordinator}"\n'
+             if coordinator else "")
+    cfg = Config.from_string(
+        f"[input]\ntpu_fleet = true\ntpu_fleet_rank = {rank}\n"
+        f"tpu_fleet_hosts = 2\ntpu_fleet_port = {port}\n{coord}"
+        "tpu_fleet_heartbeat_ms = 100\ntpu_fleet_suspect_ms = 400\n"
+        "tpu_fleet_evict_ms = 1000\ntpu_fleet_depart_ms = 500\n")
+    faultinject.configure_from(cfg)  # FLOWGGER_FAULTS (host_kill) applies
+    fleet = Fleet.from_config(cfg)
+    fleet.start()
+    if not fleet.wait_active(2, 30):
+        print("fleet never converged", file=sys.stderr)
+        os._exit(4)
+    print(f"ready rank={rank} addr={fleet.service.addr}", flush=True)
+    # steady traffic: the scraper's merged /fleetz view needs counters
+    # and histogram samples from this rank
+    while True:
+        registry.inc("input_lines", 100)
+        registry.inc("route_rows_rfc5424", 100)
+        registry.observe("e2e_batch_seconds", 0.01 + rank / 100.0)
+        time.sleep(0.05)
+
+
+if __name__ == "__main__":
+    main()

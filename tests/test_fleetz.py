@@ -1,0 +1,465 @@
+"""Fleet observability plane: the /fleetz document (golden schema,
+cross-host metric/histogram/event merge, per-host staleness marking,
+fleet-level SLO status), rank correlation, the merge pure functions,
+``fleetctl top``, and ``trace_dump --fleet``."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from flowgger_tpu.config import Config
+from flowgger_tpu.fleet import Fleet
+from flowgger_tpu.fleet.federation import (
+    FLEETZ_SCHEMA,
+    merge_event_sections,
+    merge_metric_snapshots,
+    merge_slo_sections,
+)
+from flowgger_tpu.obs import events as obs_events
+from flowgger_tpu.obs import slo as obs_slo
+from flowgger_tpu.obs import trace as obs_trace
+from flowgger_tpu.utils import faultinject
+from flowgger_tpu.utils.metrics import Registry, registry
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_FLEETCTL = os.path.join(_REPO, "tools", "fleetctl.py")
+_TRACE_DUMP = os.path.join(_REPO, "tools", "trace_dump.py")
+_WORKER = os.path.join(os.path.dirname(__file__), "fleetz_worker.py")
+_SCHEMA = os.path.join(os.path.dirname(__file__), "resources",
+                       "fleetz_schema.json")
+
+FAST = ("tpu_fleet_heartbeat_ms = 60\ntpu_fleet_suspect_ms = 250\n"
+        "tpu_fleet_evict_ms = 600\ntpu_fleet_depart_ms = 300\n")
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    registry.reset()
+    obs_events.journal.reset()
+    obs_events.journal.configure()
+    obs_slo.engine.reset()
+    obs_trace.tracer.configure("off")
+    obs_trace.tracer.set_rank(None)
+    faultinject.reset()
+    yield
+    obs_slo.engine.reset()
+    obs_trace.tracer.configure("off")
+    obs_trace.tracer.set_rank(None)
+    obs_events.journal.reset()
+    obs_events.journal.configure()
+    faultinject.reset()
+    registry.reset()
+
+
+def _mk_fleet(rank=0, hosts=1, coordinator=None, reg=None):
+    coord = (f'tpu_fleet_coordinator = "{coordinator}"\n'
+             if coordinator else "")
+    cfg = Config.from_string(
+        f"[input]\ntpu_fleet = true\ntpu_fleet_rank = {rank}\n"
+        f"tpu_fleet_hosts = {hosts}\n{coord}{FAST}")
+    fleet = Fleet.from_config(cfg, registry=reg or Registry())
+    fleet.start()
+    return fleet
+
+
+def _get(addr, path="/fleetz"):
+    try:
+        with urllib.request.urlopen(f"http://{addr}{path}",
+                                    timeout=5) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+# -- golden schema -----------------------------------------------------------
+
+def _validate(doc, schema, path="$"):
+    """Same walk as tests/test_fleet_health.py: leaves are type names,
+    nested dicts recurse, ``__each__`` types every list element."""
+    checks = {"int": lambda v: isinstance(v, int) and not isinstance(v, bool),
+              "number": lambda v: isinstance(v, (int, float))
+              and not isinstance(v, bool),
+              "str": lambda v: isinstance(v, str),
+              "bool": lambda v: isinstance(v, bool),
+              "dict": lambda v: isinstance(v, dict),
+              "list": lambda v: isinstance(v, list)}
+    problems = []
+    for key, want in schema.items():
+        if key == "__doc__":
+            continue
+        if key == "__each__":
+            assert isinstance(doc, list), f"{path}: expected a list"
+            for i, item in enumerate(doc):
+                problems += _validate(item, want, f"{path}[{i}]")
+            continue
+        if key not in doc:
+            problems.append(f"{path}.{key}: missing")
+            continue
+        value = doc[key]
+        if isinstance(want, dict):
+            if "__each__" in want:
+                if not isinstance(value, list):
+                    problems.append(f"{path}.{key}: expected list")
+                else:
+                    problems += _validate(value, want, f"{path}.{key}")
+            elif not isinstance(value, dict):
+                problems.append(f"{path}.{key}: expected object")
+            else:
+                problems += _validate(value, want, f"{path}.{key}")
+        elif not checks[want](value):
+            problems.append(
+                f"{path}.{key}: expected {want}, got {type(value).__name__}")
+    return problems
+
+
+def test_fleetz_matches_golden_schema():
+    fleet = _mk_fleet()
+    try:
+        status, doc = _get(fleet.service.addr)
+        assert status == 200
+        assert doc["schema"] == FLEETZ_SCHEMA
+        with open(_SCHEMA) as fd:
+            schema = json.load(fd)
+        problems = _validate(doc, schema)
+        assert not problems, "fleetz document drifted from the golden " \
+            f"schema: {problems}"
+        assert doc["is_rendezvous"] is True
+        assert doc["served_by"] == 0
+    finally:
+        fleet.shutdown()
+
+
+def test_healthz_slo_section_schema4():
+    fleet = _mk_fleet()
+    try:
+        status, doc = _get(fleet.service.addr, "/healthz")
+        assert status == 200
+        assert doc["schema"] == 4
+        assert doc["slo"]["configured"] == 0
+        assert doc["slo"]["sentinel"]["enabled"] is False
+        # schema-4 histogram snapshots carry the merge raw material
+        registry_snapshot = doc["metrics"]
+        assert "sample_count" in registry_snapshot["batch_seconds"]
+    finally:
+        fleet.shutdown()
+
+
+# -- cross-host merge --------------------------------------------------------
+
+def test_fleetz_merges_two_hosts():
+    r0, r1 = Registry(), Registry()
+    f0 = _mk_fleet(rank=0, hosts=2, reg=r0)
+    f1 = None
+    try:
+        f1 = _mk_fleet(rank=1, hosts=2,
+                       coordinator=f"127.0.0.1:{f0.service.port}", reg=r1)
+        assert f0.wait_active(2, 10), "fleet never converged"
+        r0.inc("input_lines", 100)
+        r1.inc("input_lines", 50)
+        for v in (0.1, 0.2, 0.3):
+            r0.observe("e2e_batch_seconds", v)
+        for v in (0.4, 0.5):
+            r1.observe("e2e_batch_seconds", v)
+        status, doc = _get(f0.service.addr)
+        assert status == 200
+        assert doc["metrics"]["input_lines"] == 150
+        merged = doc["metrics"]["e2e_batch_seconds"]
+        assert merged["count"] == 5
+        assert merged["sample_count"] == 5
+        # pooled-sample quantiles, not averaged per-host quantiles
+        assert merged["p50"] == 0.3
+        assert merged["min"] == 0.1 and merged["max"] == 0.5
+        ranks = {h["rank"]: h for h in doc["hosts"]}
+        assert set(ranks) == {0, 1}
+        assert not ranks[0]["stale"] and not ranks[1]["stale"]
+        assert ranks[0]["share"] == pytest.approx(0.5)
+    finally:
+        f0.shutdown()
+        if f1 is not None:
+            f1.shutdown()
+
+
+def test_fleetz_marks_dead_host_stale_keeps_snapshot():
+    r0, r1 = Registry(), Registry()
+    f0 = _mk_fleet(rank=0, hosts=2, reg=r0)
+    f1 = None
+    try:
+        f1 = _mk_fleet(rank=1, hosts=2,
+                       coordinator=f"127.0.0.1:{f0.service.port}", reg=r1)
+        assert f0.wait_active(2, 10)
+        r1.inc("input_lines", 42)
+        # one fresh scrape primes the cache with rank 1's snapshot
+        _, doc = _get(f0.service.addr)
+        assert doc["metrics"]["input_lines"] == 42
+        # rank 1's endpoint dies without a drain announcement
+        f1.service.stop()
+        time.sleep(1.0)
+        _, doc = _get(f0.service.addr)
+        ranks = {h["rank"]: h for h in doc["hosts"]}
+        assert ranks[1]["stale"] is True
+        assert ranks[1]["age_s"] > 0
+        assert ranks[1]["snapshot"] is True  # last snapshot kept
+        # fleet-level evaluation continues over the stale snapshot:
+        # the dead host's counters stay in the merged view
+        assert doc["metrics"]["input_lines"] == 42
+    finally:
+        f0.shutdown()
+        if f1 is not None:
+            f1.shutdown()
+
+
+def test_fleet_rank_tags_journal_events():
+    fleet = _mk_fleet()
+    try:
+        obs_events.emit("test", "queue_drop", detail="tagged")
+        ring = obs_events.journal.snapshot()
+        assert ring[-1]["rank"] == 0
+        _, doc = _get(fleet.service.addr)
+        tagged = [e for e in doc["events"]["ring"]
+                  if e.get("detail") == "tagged"]
+        assert tagged and tagged[0]["rank"] == 0
+    finally:
+        fleet.shutdown()
+
+
+# -- merge pure functions ----------------------------------------------------
+
+def test_merge_quantiles_match_pooled_raw_samples():
+    """Satellite acceptance: merged fleet quantiles stay within
+    tolerance of quantiles over the pooled raw samples, including when
+    each host's ring downsamples."""
+    import random
+
+    rng = random.Random(7)
+    r0, r1 = Registry(), Registry()
+    raw = []
+    for reg, mean in ((r0, 0.1), (r1, 0.5)):
+        for _ in range(1000):
+            v = rng.gauss(mean, 0.02)
+            raw.append(v)
+            reg.observe("e2e_batch_seconds", v)
+    merged = merge_metric_snapshots([
+        r0.snapshot(include_hist_samples=True),
+        r1.snapshot(include_hist_samples=True)])["e2e_batch_seconds"]
+    pooled = sorted(raw)
+    true_p50 = pooled[len(pooled) // 2]
+    true_p99 = pooled[int(len(pooled) * 0.99)]
+    assert merged["count"] == 2000
+    assert merged["p50"] == pytest.approx(true_p50, rel=0.15)
+    assert merged["p99"] == pytest.approx(true_p99, rel=0.15)
+    # and the confidence is disclosed: 2 bounded rings backed this
+    assert 0 < merged["sample_count"] <= 256
+
+
+def test_merge_skips_gauges_sums_counters():
+    merged = merge_metric_snapshots([
+        {"input_lines": 10, "device_breaker_state": 1,
+         "fleet_peer0_state": 1, "dispatch_seconds": 1.5},
+        {"input_lines": 5, "device_breaker_state": 0,
+         "fleet_peer0_state": 4, "dispatch_seconds": 0.5},
+    ])
+    assert merged["input_lines"] == 15
+    assert merged["dispatch_seconds"] == 2.0
+    # point-in-time per-host gauges must NOT be summed into nonsense
+    assert "device_breaker_state" not in merged
+    assert "fleet_peer0_state" not in merged
+
+
+def test_merge_event_sections_tags_and_sorts():
+    merged = merge_event_sections([
+        (0, {"total": 2, "counts": {"queue_drop": 2},
+             "ring": [{"ts": 2.0, "reason": "queue_drop"},
+                      {"ts": 4.0, "reason": "queue_drop", "rank": 0}]}),
+        (1, {"total": 1, "counts": {"breaker_trip": 1},
+             "ring": [{"ts": 3.0, "reason": "breaker_trip"}]}),
+    ])
+    assert merged["total"] == 3
+    assert merged["counts"] == {"queue_drop": 2, "breaker_trip": 1}
+    assert [e["ts"] for e in merged["ring"]] == [2.0, 3.0, 4.0]
+    assert [e["rank"] for e in merged["ring"]] == [0, 1, 0]
+
+
+def test_merge_slo_sections_worst_of_and_stale_marking():
+    merged = merge_slo_sections([
+        (0, False, {"objectives": [
+            {"name": "lat", "kind": "latency", "burning": False,
+             "fast_burn": 0.2, "slow_burn": 0.1,
+             "budget_remaining": 0.9}],
+            "sentinel": {"regressions": 0, "routes": {}}}),
+        (1, True, {"objectives": [
+            {"name": "lat", "kind": "latency", "burning": True,
+             "fast_burn": 6.0, "slow_burn": 3.0,
+             "budget_remaining": 0.0}],
+            "sentinel": {"regressions": 2,
+                         "routes": {"rfc5424": {"alerted": True}}}}),
+    ])
+    assert merged["burning"] == 1
+    lat = merged["objectives"][0]
+    assert lat["burning"] is True
+    assert lat["fast_burn"] == 6.0
+    assert lat["budget_remaining"] == 0.0
+    hosts = {h["rank"]: h for h in lat["hosts"]}
+    assert hosts[1]["stale"] is True and hosts[1]["burning"] is True
+    assert hosts[0]["stale"] is False
+    assert merged["sentinel"]["regressions"] == 2
+    assert merged["sentinel"]["routes"]["rfc5424"]["rank"] == 1
+
+
+# -- host_kill staleness (reuses the chaos fault site) -----------------------
+
+@pytest.mark.faults
+def test_fleetz_staleness_after_host_kill(tmp_path):
+    """A worker process SIGKILLed by the ``host_kill`` fault site must
+    stay on /fleetz as a stale snapshot — the acceptance's 'killing one
+    host marks its snapshot stale without dropping fleet evaluation'."""
+    port0 = _free_port()
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("FLOWGGER_FAULTS",)}
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    # kill on the ~30th ticker pass (100ms interval): up long enough
+    # for a fresh scrape to cache its snapshot first
+    env["FLOWGGER_FAULTS"] = "host_kill=once:30"
+    reg = Registry()
+    cfg = Config.from_string(
+        f"[input]\ntpu_fleet = true\ntpu_fleet_rank = 0\n"
+        f"tpu_fleet_hosts = 2\ntpu_fleet_port = {port0}\n"
+        "tpu_fleet_heartbeat_ms = 100\ntpu_fleet_suspect_ms = 400\n"
+        "tpu_fleet_evict_ms = 1000\ntpu_fleet_depart_ms = 500\n")
+    fleet = Fleet.from_config(cfg, registry=reg)
+    fleet.start()
+    proc = subprocess.Popen(
+        [sys.executable, _WORKER, "1", "0", str(port0)],
+        env=env, cwd=_REPO, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True)
+    try:
+        assert fleet.wait_active(2, 60), "worker never joined"
+        # fresh scrape while the worker lives: snapshot cached, live
+        deadline = time.monotonic() + 10
+        live = None
+        while time.monotonic() < deadline:
+            _, doc = _get(fleet.service.addr)
+            live = {h["rank"]: h for h in doc["hosts"]}.get(1)
+            if live and live["snapshot"] and not live["stale"] \
+                    and live["metrics"].get("route_rows_rfc5424", 0) > 0:
+                break
+            time.sleep(0.1)
+        assert live and live["snapshot"] and not live["stale"] \
+            and live["metrics"].get("route_rows_rfc5424", 0) > 0, live
+        # the fault site SIGKILLs the worker from its own ticker
+        assert proc.wait(timeout=60) == -9, "worker was not SIGKILLed"
+        time.sleep(1.0)
+        _, doc = _get(fleet.service.addr)
+        dead = {h["rank"]: h for h in doc["hosts"]}.get(1)
+        assert dead is not None, "dead host dropped from /fleetz"
+        assert dead["stale"] is True and dead["snapshot"] is True
+        # its traffic stays in the merged fleet view
+        assert doc["metrics"].get("route_rows_rfc5424", 0) > 0
+    finally:
+        proc.kill()
+        fleet.shutdown()
+
+
+# -- fleetctl top ------------------------------------------------------------
+
+def _fleetctl(*args):
+    return subprocess.run([sys.executable, _FLEETCTL, *args],
+                          capture_output=True, text=True, timeout=30)
+
+
+def test_fleetctl_top_green_fleet_exits_0():
+    fleet = _mk_fleet()
+    try:
+        registry.inc("input_lines", 10)
+        r = _fleetctl("top", fleet.service.addr, "--once",
+                      "--interval", "0.5")
+        assert r.returncode == 0, (r.returncode, r.stdout, r.stderr)
+        assert "rendezvous rank 0" in r.stdout
+        assert "0 burning" in r.stdout
+        assert "live" in r.stdout
+    finally:
+        fleet.shutdown()
+
+
+def test_fleetctl_top_burning_slo_exits_3():
+    fleet = _mk_fleet()
+    try:
+        # drive the process-wide engine (the one /fleetz serves) into a
+        # burning state with manual ticks
+        objs = obs_slo.parse_objectives(
+            Config.from_string(
+                '[slo.lat]\nkind = "latency"\nthreshold_ms = 10\n'
+                "objective = 0.9\nfast_window_s = 10\n"
+                "slow_window_s = 60\n").lookup_table("slo", "x"))
+        obs_slo.engine.configure(objs, interval_s=0, registry=registry)
+        now = 0.0
+        for _ in range(20):
+            now += 2.0
+            for _ in range(5):
+                registry.observe("e2e_batch_seconds", 0.5)
+            obs_slo.engine.tick(now=now)
+        assert obs_slo.engine.health_section()["burning"] == 1
+        r = _fleetctl("top", fleet.service.addr, "--once",
+                      "--interval", "0.5")
+        assert r.returncode == 3, (r.returncode, r.stdout, r.stderr)
+        assert "BURN" in r.stdout
+        r = _fleetctl("top", fleet.service.addr, "--json")
+        assert r.returncode == 3
+        assert json.loads(r.stdout)["slo"]["burning"] == 1
+    finally:
+        obs_slo.engine.reset()
+        fleet.shutdown()
+
+
+def test_fleetctl_top_unreachable_exits_2():
+    r = _fleetctl("top", "127.0.0.1:1", "--once")
+    assert r.returncode == 2
+    assert "error" in r.stderr
+
+
+# -- trace_dump --fleet ------------------------------------------------------
+
+def test_trace_dump_fleet_merges_process_lanes(tmp_path):
+    obs_trace.tracer.configure("ring")
+    fleet = _mk_fleet()
+    try:
+        # fleet.start() stamped the tracer's rank: record one batch
+        bid = obs_trace.tracer.begin("rfc5424")
+        obs_trace.tracer.span(bid, "decode", 0.0, 1.0, rows=8)
+        obs_trace.tracer.end(bid)
+        assert obs_trace.tracer.snapshot()[-1]["rank"] == 0
+        out = tmp_path / "fleet.json"
+        r = subprocess.run(
+            [sys.executable, _TRACE_DUMP, "--fleet", fleet.service.addr,
+             "-o", str(out)],
+            capture_output=True, text=True, timeout=30)
+        assert r.returncode == 0, r.stderr
+        doc = json.loads(out.read_text())
+        events = doc["traceEvents"]
+        lanes = [e for e in events if e.get("name") == "process_name"]
+        assert lanes and lanes[0]["pid"] == 0
+        assert "rank 0 @" in lanes[0]["args"]["name"]
+        spans = [e for e in events if e.get("ph") == "X"]
+        assert spans and all(e["pid"] == 0 for e in spans)
+    finally:
+        fleet.shutdown()
+
+
+def test_trace_dump_fleet_unreachable_exits_2(tmp_path):
+    r = subprocess.run(
+        [sys.executable, _TRACE_DUMP, "--fleet", "127.0.0.1:1"],
+        capture_output=True, text=True, timeout=30)
+    assert r.returncode == 2

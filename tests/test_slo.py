@@ -1,0 +1,705 @@
+"""Observability plane: SLO engine (multi-window burn rates, typed
+burn/recover events, gauges), regression sentinel (BENCH-seeded
+baselines, perf_regression episodes), JSONL sink rotation, histogram
+sample export, and the per-route/per-tenant metric families the plane
+evaluates."""
+
+import json
+import queue
+
+import pytest
+
+from flowgger_tpu.config import Config, ConfigError
+from flowgger_tpu.obs import events as obs_events
+from flowgger_tpu.obs import slo as obs_slo
+from flowgger_tpu.obs import sentinel as obs_sentinel
+from flowgger_tpu.obs.sink import JsonlSink
+from flowgger_tpu.obs.slo import SloEngine, parse_objectives
+from flowgger_tpu.utils.metrics import (
+    Histogram,
+    Registry,
+    classify_metric,
+    registry,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    registry.reset()
+    obs_events.journal.reset()
+    obs_events.journal.configure()
+    obs_slo.engine.reset()
+    obs_sentinel.sentinel.configure(enabled=False)
+    yield
+    obs_slo.engine.reset()
+    obs_sentinel.sentinel.configure(enabled=False)
+    obs_events.journal.reset()
+    obs_events.journal.configure()
+    registry.reset()
+
+
+def _events_of(reason):
+    return [e for e in obs_events.journal.snapshot()
+            if e["reason"] == reason]
+
+
+# ---------------------------------------------------------------------------
+# [slo.*] parsing
+# ---------------------------------------------------------------------------
+
+def _table(toml):
+    return Config.from_string(toml).lookup_table("slo", "slo table")
+
+
+def test_parse_objectives_all_kinds():
+    objs = parse_objectives(_table("""
+[slo.lat]
+kind = "latency"
+threshold_ms = 250
+[slo.lat_route]
+kind = "latency"
+threshold_ms = 100
+route = "rfc5424"
+[slo.lat_tenant]
+kind = "latency"
+histogram = "queue_wait_seconds"
+threshold_ms = 50
+tenant = "acme"
+[slo.floor]
+kind = "throughput"
+tenant = "acme"
+min_lines_per_sec = 1000
+[slo.ev]
+kind = "events"
+reason = "queue_drop"
+max_per_sec = 0.5
+"""))
+    by_name = {o.name: o for o in objs}
+    assert by_name["lat"].metric == "e2e_batch_seconds"
+    assert by_name["lat_route"].metric == "e2e_batch_seconds_rfc5424"
+    assert by_name["lat_tenant"].metric == "queue_wait_seconds_acme"
+    assert by_name["floor"].metric == "tenant_acme_lines"
+    assert by_name["ev"].metric == "events_queue_drop"
+    assert by_name["lat"].threshold_s == pytest.approx(0.25)
+
+
+@pytest.mark.parametrize("toml,frag", [
+    ('[slo.x]\nkind = "nope"\n', "kind"),
+    ('[slo.x]\nkind = "latency"\n', "threshold_ms"),
+    ('[slo.x]\nkind = "throughput"\n', "min_lines_per_sec"),
+    ('[slo.x]\nkind = "events"\n', "max_per_sec"),
+    ('[slo.x]\nkind = "events"\nmax_per_sec = 1\nreason = "typo"\n',
+     "reason"),
+    ('[slo.x]\nkind = "latency"\nthreshold_ms = 9\n'
+     'tenant = "a"\nroute = "b"\n', "mutually exclusive"),
+    ('[slo.x]\nkind = "latency"\nthreshold_ms = 9\nmystery = 1\n',
+     "mystery"),
+    ('[slo]\nmystery_key = 1\n[slo.x]\nkind = "events"\n'
+     'max_per_sec = 1\n', "mystery_key"),
+    ('[slo.x]\nkind = "latency"\nthreshold_ms = 9\n'
+     'fast_window_s = 600\nslow_window_s = 300\n', "fast_window_s"),
+    ('[slo.x]\nkind = "latency"\nthreshold_ms = 9\nobjective = 1.5\n',
+     "objective"),
+])
+def test_parse_objectives_rejects(toml, frag):
+    with pytest.raises(ConfigError) as err:
+        parse_objectives(_table(toml))
+    assert frag in str(err.value)
+
+
+# ---------------------------------------------------------------------------
+# burn-rate evaluation
+# ---------------------------------------------------------------------------
+
+def _engine(toml, reg, clock):
+    objs = parse_objectives(_table(toml))
+    eng = SloEngine(registry=reg, clock=lambda: clock[0])
+    eng.configure(objs, interval_s=0)  # manual ticks
+    return eng
+
+
+def test_latency_burn_and_recover_cycle():
+    reg = Registry()
+    clock = [1000.0]
+    eng = _engine("""
+[slo.lat]
+kind = "latency"
+threshold_ms = 100
+objective = 0.9
+fast_window_s = 10
+slow_window_s = 60
+""", reg, clock)
+    for _ in range(20):
+        clock[0] += 2.0
+        for _ in range(10):
+            reg.observe("e2e_batch_seconds", 0.5)  # every sample bad
+        eng.tick()
+    section = eng.health_section()
+    obj = section["objectives"][0]
+    assert obj["burning"] is True
+    assert obj["fast_burn"] >= 1.0
+    assert section["burning"] == 1
+    burns = _events_of("slo_burn")
+    assert len(burns) == 1 and burns[0]["cost_unit"] == "burn_rate"
+    assert reg.get_gauge("slo_lat_burn_rate") >= 1.0
+    assert reg.get_gauge("slo_lat_budget_remaining") == 0.0
+    # recovery: good samples drain the fast window
+    for _ in range(20):
+        clock[0] += 2.0
+        for _ in range(10):
+            reg.observe("e2e_batch_seconds", 0.01)
+        eng.tick()
+    obj = eng.health_section()["objectives"][0]
+    assert obj["burning"] is False
+    assert len(_events_of("slo_recover")) == 1
+    # one episode = one burn + one recover, not one per tick
+    assert len(_events_of("slo_burn")) == 1
+
+
+def test_burn_requires_both_windows():
+    """A short bad burst breaches the fast window but must not alert
+    until the SLOW window agrees it is significant (the multi-window
+    point: no paging on a blip)."""
+    reg = Registry()
+    clock = [0.0]
+    eng = _engine("""
+[slo.lat]
+kind = "latency"
+threshold_ms = 100
+objective = 0.9
+burn_threshold = 2.0
+fast_window_s = 4
+slow_window_s = 40
+""", reg, clock)
+    # 20 healthy ticks fill the slow window with good samples
+    for _ in range(20):
+        clock[0] += 2.0
+        for _ in range(10):
+            reg.observe("e2e_batch_seconds", 0.01)
+        eng.tick()
+    # one all-bad tick: fast burn goes vertical, slow burn barely moves
+    clock[0] += 2.0
+    for _ in range(10):
+        reg.observe("e2e_batch_seconds", 0.5)
+    eng.tick()
+    obj = eng.health_section()["objectives"][0]
+    assert obj["fast_burn"] >= 2.0
+    assert obj["slow_burn"] < 2.0
+    assert obj["burning"] is False
+    assert not _events_of("slo_burn")
+    # sustained badness drags the slow window over the threshold too
+    for _ in range(10):
+        clock[0] += 2.0
+        for _ in range(10):
+            reg.observe("e2e_batch_seconds", 0.5)
+        eng.tick()
+    assert eng.health_section()["objectives"][0]["burning"] is True
+    assert len(_events_of("slo_burn")) == 1
+
+
+def test_throughput_floor_burn():
+    reg = Registry()
+    clock = [0.0]
+    eng = _engine("""
+[slo.floor]
+kind = "throughput"
+min_lines_per_sec = 100
+objective = 0.5
+fast_window_s = 10
+slow_window_s = 60
+""", reg, clock)
+    for _ in range(10):
+        clock[0] += 2.0
+        reg.inc("input_lines", 400)  # 200/s, above floor
+        eng.tick()
+    assert eng.health_section()["objectives"][0]["burning"] is False
+    for _ in range(30):
+        clock[0] += 2.0
+        reg.inc("input_lines", 50)  # 25/s, below floor
+        eng.tick()
+    obj = eng.health_section()["objectives"][0]
+    assert obj["burning"] is True
+    assert _events_of("slo_burn")
+
+
+def test_events_rate_burn():
+    reg = Registry()
+    clock = [0.0]
+    eng = _engine("""
+[slo.ev]
+kind = "events"
+max_per_sec = 1.0
+fast_window_s = 10
+slow_window_s = 60
+""", reg, clock)
+    for _ in range(30):
+        clock[0] += 2.0
+        reg.inc("degradation_events", 10)  # 5/s, 5x budget
+        eng.tick()
+    obj = eng.health_section()["objectives"][0]
+    assert obj["burning"] is True
+    assert obj["fast_burn"] == pytest.approx(5.0, rel=0.2)
+
+
+def test_tenant_latency_slo_isolated_from_other_tenant():
+    """The acceptance shape: the flooded tenant's latency SLO burns,
+    the well-behaved tenant's stays green."""
+    reg = Registry()
+    clock = [0.0]
+    eng = _engine("""
+[slo.acme]
+kind = "latency"
+histogram = "queue_wait_seconds"
+threshold_ms = 100
+objective = 0.9
+tenant = "acme"
+fast_window_s = 10
+slow_window_s = 60
+[slo.calm]
+kind = "latency"
+histogram = "queue_wait_seconds"
+threshold_ms = 100
+objective = 0.9
+tenant = "calm"
+fast_window_s = 10
+slow_window_s = 60
+""", reg, clock)
+    for _ in range(20):
+        clock[0] += 2.0
+        for _ in range(5):
+            reg.observe("queue_wait_seconds_acme", 0.9)   # flooded
+            reg.observe("queue_wait_seconds_calm", 0.005)  # healthy
+        eng.tick()
+    by_name = {o["name"]: o for o in eng.health_section()["objectives"]}
+    assert by_name["acme"]["burning"] is True
+    assert by_name["calm"]["burning"] is False
+    burns = _events_of("slo_burn")
+    assert len(burns) == 1 and burns[0]["tenant"] == "acme"
+
+
+def test_configure_from_wires_and_clears():
+    cfg = Config.from_string("""
+[slo]
+eval_interval_s = 0
+[slo.lat]
+kind = "latency"
+threshold_ms = 100
+""")
+    obs_slo.configure_from(cfg)
+    assert obs_slo.engine.health_section()["configured"] == 1
+    # gauges pre-initialized so dashboards see a healthy 0, not a gap
+    assert registry.get_gauge("slo_lat_budget_remaining") == 1.0
+    obs_slo.configure_from(Config.from_string(""))
+    assert obs_slo.engine.health_section()["configured"] == 0
+
+
+def test_configure_from_bad_interval():
+    with pytest.raises(ConfigError):
+        obs_slo.configure_from(Config.from_string(
+            '[slo]\neval_interval_s = "fast"\n'))
+
+
+# ---------------------------------------------------------------------------
+# regression sentinel
+# ---------------------------------------------------------------------------
+
+def _sentinel(reg, clock, **kw):
+    s = obs_sentinel.Sentinel(registry=reg, clock=lambda: clock[0])
+    kw.setdefault("enabled", True)
+    kw.setdefault("interval_s", 1)
+    kw.setdefault("sustain", 2)
+    kw.setdefault("min_rows", 10)
+    s.configure(**kw)
+    return s
+
+
+def test_sentinel_regression_episode_and_rearm():
+    reg = Registry()
+    clock = [0.0]
+    s = _sentinel(reg, clock, drop=0.5)
+    s.set_baseline("rfc5424", 1000.0)
+    for _ in range(5):
+        clock[0] += 1.0
+        reg.inc("route_rows_rfc5424", 1000)
+        s.tick()
+    assert not _events_of("perf_regression")
+    # sustained 10x drop
+    for _ in range(60):
+        clock[0] += 1.0
+        reg.inc("route_rows_rfc5424", 100)
+        s.tick()
+    evs = _events_of("perf_regression")
+    assert len(evs) == 1, "one event per episode, not per tick"
+    assert evs[0]["route"] == "rfc5424"
+    assert "baseline" in evs[0]["detail"]
+    assert reg.get_gauge("sentinel_rfc5424_ratio") < 0.5
+    assert reg.get_gauge("sentinel_rfc5424_baseline") == 1000.0
+    # recover, then regress again: a NEW episode journals again
+    for _ in range(60):
+        clock[0] += 1.0
+        reg.inc("route_rows_rfc5424", 1000)
+        s.tick()
+    assert s.health_section()["routes"]["rfc5424"]["alerted"] is False
+    for _ in range(60):
+        clock[0] += 1.0
+        reg.inc("route_rows_rfc5424", 100)
+        s.tick()
+    assert len(_events_of("perf_regression")) == 2
+
+
+def test_sentinel_idle_route_is_not_a_regression():
+    reg = Registry()
+    clock = [0.0]
+    s = _sentinel(reg, clock, drop=0.5)
+    s.set_baseline("rfc5424", 1000.0)
+    clock[0] += 1.0
+    reg.inc("route_rows_rfc5424", 1000)
+    s.tick()
+    # traffic stops entirely: below min_rows there is no evidence
+    for _ in range(60):
+        clock[0] += 1.0
+        s.tick()
+    assert not _events_of("perf_regression")
+
+
+def test_sentinel_idle_gap_then_resume_is_not_a_regression():
+    """Resuming at the baseline rate after a long idle span must NOT
+    page: the delta window re-anchors during idleness, so the first
+    post-resume rate is not averaged across the gap."""
+    reg = Registry()
+    clock = [0.0]
+    s = _sentinel(reg, clock, drop=0.5, interval_s=1)
+    s.set_baseline("rfc5424", 1000.0)
+    for _ in range(10):
+        clock[0] += 1.0
+        reg.inc("route_rows_rfc5424", 1000)
+        s.tick()
+    # one hour of silence, ticked throughout
+    for _ in range(360):
+        clock[0] += 10.0
+        s.tick()
+    # traffic resumes at the healthy baseline rate
+    for _ in range(30):
+        clock[0] += 1.0
+        reg.inc("route_rows_rfc5424", 1000)
+        s.tick()
+    assert not _events_of("perf_regression")
+    assert s.health_section()["routes"]["rfc5424"]["alerted"] is False
+
+
+def test_sentinel_fetch_bytes_axis():
+    reg = Registry()
+    clock = [0.0]
+    s = _sentinel(reg, clock, drop=0.5, rise=0.5)
+    s.set_baseline("gelf", 1000.0, fetch_bytes_per_row=10.0)
+    for _ in range(10):
+        clock[0] += 1.0
+        reg.inc("route_rows_gelf", 1000)
+        reg.set_gauge("fetch_bytes_per_row_gelf", 30.0)  # 3x the baseline
+        s.tick()
+    evs = _events_of("perf_regression")
+    assert len(evs) == 1 and "fetch B/row" in evs[0]["detail"]
+
+
+def test_sentinel_seeds_from_bench_series(tmp_path):
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps(
+        {"pr": 1, "e2e_overlap_smoke": {"e2e_lines_per_sec": 50000,
+                                        "ok": True}}))
+    (tmp_path / "BENCH_r02.json").write_text(json.dumps(
+        {"pr": 2, "e2e_overlap_smoke": {"e2e_lines_per_sec": 40000,
+                                        "ok": True},
+         "new_formats": {"jsonl": {"block_lines_per_sec": 20000,
+                                   "ok": True}}}))
+    reg = Registry()
+    clock = [0.0]
+    s = obs_sentinel.Sentinel(registry=reg, clock=lambda: clock[0])
+    s.configure(enabled=True, bench_root=str(tmp_path))
+    section = s.health_section()
+    # minimum across the series is the floor; the e2e smoke series IS
+    # the rfc5424 route (tools/bench_trend.ROUTE_PATH_ALIASES)
+    assert section["seeded_routes"] == ["jsonl", "rfc5424"]
+    assert s._baselines["rfc5424"]["lines_per_sec"] == 40000
+    assert s._baselines["jsonl"]["lines_per_sec"] == 20000
+
+
+def test_sentinel_config_keys_ride_the_slo_table():
+    obs_slo.configure_from(Config.from_string("""
+[slo]
+eval_interval_s = 0
+sentinel = true
+sentinel_drop = 0.4
+sentinel_sustain = 5
+"""))
+    assert obs_sentinel.sentinel.enabled is True
+    assert obs_sentinel.sentinel._drop == 0.4
+    assert obs_sentinel.sentinel._sustain == 5
+    with pytest.raises(ConfigError):
+        obs_slo.configure_from(Config.from_string(
+            '[slo]\nsentinel = "yes"\n'))
+
+
+@pytest.mark.faults
+def test_sentinel_flags_faultinject_throttled_route():
+    """The acceptance drill: an artificially throttled route — the
+    ``route_throttle`` fault site injecting a 50 ms delay into every
+    batch finish — must raise a ``perf_regression`` event with
+    measured-vs-baseline cost within the sentinel's window, driven by
+    REAL BatchHandler traffic on the real wall clock."""
+    import time
+
+    from flowgger_tpu.decoders import RFC5424Decoder
+    from flowgger_tpu.encoders import GelfEncoder
+    from flowgger_tpu.tpu.batch import BatchHandler
+    from flowgger_tpu.utils import faultinject
+
+    s = obs_sentinel.Sentinel(registry=registry)
+    s.configure(enabled=True, interval_s=0, drop=0.5, sustain=2,
+                min_rows=1, fast_tau_s=0.2, slow_tau_s=5.0)
+    tx = queue.Queue()
+    handler = BatchHandler(tx, RFC5424Decoder(),
+                           GelfEncoder(Config.from_string("")),
+                           start_timer=False)
+
+    def pump(rounds):
+        for r in range(rounds):
+            for i in range(8):
+                handler.handle_bytes(
+                    b"<13>1 2015-08-05T15:53:45Z h a p m - l%d" % i)
+            handler.flush()
+            s.tick()
+            time.sleep(0.005)
+
+    pump(3)    # first flushes pay the kernel compile: not the rate
+    pump(20)   # unthrottled warmup establishes the live rate
+    live = s.health_section()["routes"]["rfc5424"]["live"]
+    assert live > 0
+    s.set_baseline("rfc5424", live)
+    faultinject.configure({"route_throttle": "every:1"})
+    try:
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline \
+                and not _events_of("perf_regression"):
+            pump(3)
+    finally:
+        faultinject.reset()
+    evs = _events_of("perf_regression")
+    assert evs, "throttled route never raised perf_regression"
+    assert evs[0]["route"] == "rfc5424"
+    assert "baseline" in evs[0]["detail"] and evs[0]["cost"] > 0
+
+
+def test_route_baselines_extraction(tmp_path):
+    import importlib.util
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "bt", os.path.join(repo, "tools", "bench_trend.py"))
+    bt = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bt)
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps({
+        "pr": 1,
+        "new_formats": {"dns": {"block_lines_per_sec": 100000,
+                                "ok": True}},
+        "fused": {"rfc5424": {"fetch_bytes_per_row": 8.0,
+                              "lines_per_sec": 30000, "ok": True}}}))
+    (tmp_path / "BENCH_r02.json").write_text(json.dumps({
+        "pr": 2, "backfilled_in_pr": 3}))  # stub contributes nothing
+    base = bt.route_baselines(str(tmp_path))
+    assert base["dns"]["lines_per_sec"] == 100000
+    assert base["rfc5424"] == {"lines_per_sec": 30000,
+                               "fetch_bytes_per_row": 8.0}
+
+
+# ---------------------------------------------------------------------------
+# JSONL sink rotation (satellite: bounded journal/trace files)
+# ---------------------------------------------------------------------------
+
+def test_sink_rotation_caps_size(tmp_path):
+    path = tmp_path / "ev.jsonl"
+    sink = JsonlSink("test")
+    # ~100B records against a 1KB cap: rotation must kick in
+    sink.open(str(path), max_mb=0.001, keep=2)
+    for i in range(100):
+        sink.write({"i": i, "pad": "x" * 80})
+    sink.close()
+    assert path.exists()
+    assert path.stat().st_size <= 1100  # cap + one record of slack
+    assert (tmp_path / "ev.jsonl.1").exists()
+    assert (tmp_path / "ev.jsonl.2").exists()
+    assert not (tmp_path / "ev.jsonl.3").exists()  # keep=2 bounds it
+    # every surviving line is intact JSON (rotation never tears a line)
+    for p in (path, tmp_path / "ev.jsonl.1", tmp_path / "ev.jsonl.2"):
+        for line in p.read_text().splitlines():
+            json.loads(line)
+
+
+def test_sink_unbounded_without_cap(tmp_path):
+    path = tmp_path / "ev.jsonl"
+    sink = JsonlSink("test")
+    sink.open(str(path))
+    for i in range(50):
+        sink.write({"i": i, "pad": "x" * 80})
+    sink.close()
+    assert not (tmp_path / "ev.jsonl.1").exists()
+    assert len(path.read_text().splitlines()) == 50
+
+
+def test_events_rotation_config_wiring(tmp_path):
+    path = tmp_path / "ev.jsonl"
+    cfg = Config.from_string(
+        f'[metrics]\nevents_path = "{path}"\n'
+        "events_max_mb = 0.001\nevents_keep = 2\n")
+    obs_events.configure_from(cfg)
+    for i in range(100):
+        obs_events.emit("test", "queue_drop", detail="x" * 80)
+    obs_events.journal.close()
+    assert (tmp_path / "ev.jsonl.1").exists()
+    assert path.stat().st_size <= 1200
+
+
+# ---------------------------------------------------------------------------
+# histogram sample export + classification (the /fleetz raw material)
+# ---------------------------------------------------------------------------
+
+def test_histogram_sample_count_and_downsample():
+    h = Histogram(window=16)
+    for i in range(100):
+        h.observe(i / 100.0)
+    snap = h.snapshot()
+    assert snap["count"] == 100
+    assert snap["sample_count"] == 16  # bounded window, said out loud
+    assert len(h.samples(cap=8)) == 8
+    assert h.samples(cap=8) == sorted(h.samples(cap=8))
+
+
+def test_snapshot_histogram_samples_opt_in():
+    reg = Registry()
+    reg.observe("e2e_batch_seconds", 0.1)
+    assert "samples" not in reg.snapshot()["e2e_batch_seconds"]
+    withs = reg.snapshot(include_hist_samples=True)
+    assert withs["e2e_batch_seconds"]["samples"] == [0.1]
+
+
+def test_exposition_exports_sample_count_and_help():
+    from flowgger_tpu.obs import prom
+
+    reg = Registry()
+    reg.observe("e2e_batch_seconds", 0.25)
+    text = prom.render(registry=reg, journal=obs_events.journal)
+    assert "# TYPE flowgger_e2e_batch_seconds_sample_count gauge" in text
+    assert "flowgger_e2e_batch_seconds_sample_count 1" in text
+    assert "bounded sliding" in text  # the HELP sampling disclosure
+
+
+def test_family_kinds_cover_every_family_pattern():
+    """_FAMILY_PATTERNS must stay a literal tuple (FC06's AST reader
+    depends on it), so _FAMILY_KINDS cannot be derived from it — this
+    is the drift tripwire instead: a family added to one table but not
+    the other would silently vanish from the /fleetz merged view."""
+    from flowgger_tpu.utils.metrics import _FAMILY_KINDS, _FAMILY_PATTERNS
+
+    assert set(_FAMILY_PATTERNS) == {p for p, _ in _FAMILY_KINDS}
+
+
+def test_reconfigure_replaces_observe_taps():
+    """configure() must drop the previous objectives' latency taps —
+    add_observe_tap only appends, and leaked dead closures would run
+    on every hot-path observe forever."""
+    reg = Registry()
+    eng = SloEngine(registry=reg, clock=lambda: 0.0)
+    objs = parse_objectives(_table(
+        '[slo.a]\nkind = "latency"\nthreshold_ms = 100\n'))
+    eng.configure(objs, interval_s=0)
+    eng.configure(objs, interval_s=0)
+    eng.configure(objs, interval_s=0)
+    assert len(reg._observe_taps["e2e_batch_seconds"]) == 1
+    eng.reset()
+    assert not reg._observe_taps
+
+
+def test_classify_metric_kinds():
+    assert classify_metric("input_lines") == "counter"
+    assert classify_metric("dispatch_seconds") == "seconds"
+    assert classify_metric("inflight_depth") == "gauge"
+    assert classify_metric("batch_seconds") == "histogram"
+    assert classify_metric("route_rows_rfc5424") == "counter"
+    assert classify_metric("e2e_batch_seconds_jsonl") == "histogram"
+    assert classify_metric("queue_wait_seconds_acme") == "histogram"
+    assert classify_metric("tenant_acme_lines") == "counter"
+    assert classify_metric("tenant_acme_state") == "gauge"
+    assert classify_metric("fleet_peer3_share") == "gauge"
+    assert classify_metric("slo_lat_burn_rate") == "gauge"
+    assert classify_metric("sentinel_dns_ratio") == "gauge"
+    assert classify_metric("totally_unknown_series") is None
+
+
+# ---------------------------------------------------------------------------
+# hot-path families (the data the objectives evaluate)
+# ---------------------------------------------------------------------------
+
+def test_batch_handler_lands_per_route_family():
+    from flowgger_tpu.decoders import RFC5424Decoder
+    from flowgger_tpu.encoders import GelfEncoder
+    from flowgger_tpu.tpu.batch import BatchHandler
+
+    tx = queue.Queue()
+    handler = BatchHandler(tx, RFC5424Decoder(),
+                           GelfEncoder(Config.from_string("")),
+                           start_timer=False)
+    for i in range(4):
+        handler.handle_bytes(
+            b"<13>1 2015-08-05T15:53:45Z h a p m - line %d" % i)
+    handler.flush()
+    assert registry.get("route_rows_rfc5424") == 4
+    snap = registry.snapshot()
+    assert snap["e2e_batch_seconds_rfc5424"]["count"] >= 1
+    # the aggregate histogram still fills (scrapers keep their series)
+    assert snap["e2e_batch_seconds"]["count"] >= 1
+
+
+def test_fair_queue_lands_per_tenant_wait_family():
+    from flowgger_tpu.tenancy.fairqueue import WeightedFairQueue
+
+    q = WeightedFairQueue(maxsize=0)
+    for i in range(64):
+        q.put(b"x%d" % i)
+    for _ in range(64):
+        q.get()
+    snap = registry.snapshot()
+    assert snap["queue_wait_seconds_default"]["count"] >= 1
+
+
+def test_slo_end_to_end_on_batch_traffic():
+    """Config → engine → real BatchHandler traffic → burn event: the
+    whole plane wired the way the pipeline wires it."""
+    from flowgger_tpu.decoders import RFC5424Decoder
+    from flowgger_tpu.encoders import GelfEncoder
+    from flowgger_tpu.tpu.batch import BatchHandler
+
+    clock = [0.0]
+    objs = parse_objectives(_table("""
+[slo.route_floor]
+kind = "throughput"
+route = "rfc5424"
+min_lines_per_sec = 1000000000
+objective = 0.5
+fast_window_s = 10
+slow_window_s = 60
+"""))
+    eng = SloEngine(registry=registry, clock=lambda: clock[0])
+    eng.configure(objs, interval_s=0)
+    tx = queue.Queue()
+    handler = BatchHandler(tx, RFC5424Decoder(),
+                           GelfEncoder(Config.from_string("")),
+                           start_timer=False)
+    for _ in range(30):
+        clock[0] += 2.0
+        handler.handle_bytes(b"<13>1 2015-08-05T15:53:45Z h a p m - x")
+        handler.flush()
+        eng.tick()
+    # an absurd floor over real (slow) traffic must burn
+    obj = eng.health_section()["objectives"][0]
+    assert obj["burning"] is True
+    assert _events_of("slo_burn")
+    eng.reset()

@@ -145,6 +145,68 @@ def check(rows) -> list:
     return bad
 
 
+# BENCH leaf paths rarely spell the live route name (the e2e smoke
+# series IS the rfc5424 route; "new_formats.jsonl." carries its token
+# directly) — this maps a path to the route whose live counters
+# (route_rows_{route}, obs/sentinel.py) it baselines
+ROUTE_TOKENS = ("rfc5424", "rfc3164", "gelf", "ltsv", "jsonl", "dns",
+                "auto")
+ROUTE_PATH_ALIASES = {
+    "e2e_overlap_smoke": "rfc5424",   # the smoke corpus format
+    "framing_smoke": "rfc5424",
+}
+
+
+def _route_of(path: str):
+    parts = path.lower().split(".")
+    for token in ROUTE_TOKENS:
+        if token in parts:
+            return token
+    for alias, route in ROUTE_PATH_ALIASES.items():
+        if alias in parts:
+            return route
+    return None
+
+
+def route_baselines(root: str = ".") -> dict:
+    """Per-route sentinel baselines from the committed series:
+    ``{route: {"lines_per_sec": floor, "fetch_bytes_per_row": cap}}``.
+
+    lines/s is the **minimum across entries of each entry's best
+    route-mapped rate** — the conservative floor the series has
+    actually sustained (shared-box jitter already priced in); fetch
+    B/row is the maximum across entries of each entry's best (lowest)
+    route-mapped cost.  Backfill stubs and entries with no mapped leaf
+    contribute nothing.  obs/sentinel.py seeds from this."""
+    per_route: dict = {}
+    for _name, doc, err in load_series(root):
+        if err is not None or doc is None:
+            continue
+        ex = extract(doc)
+        if ex["stub"] is not None:
+            continue
+        best_lps: dict = {}
+        best_fetch: dict = {}
+        for path, val in ex["lines_per_sec"].items():
+            route = _route_of(path)
+            if route is not None and val > best_lps.get(route, 0.0):
+                best_lps[route] = val
+        for path, val in ex["fetch_bytes_per_row"].items():
+            route = _route_of(path)
+            if route is not None and (route not in best_fetch
+                                      or val < best_fetch[route]):
+                best_fetch[route] = val
+        for route, val in best_lps.items():
+            entry = per_route.setdefault(route, {})
+            entry["lines_per_sec"] = min(
+                entry.get("lines_per_sec", float("inf")), val)
+        for route, val in best_fetch.items():
+            entry = per_route.setdefault(route, {})
+            entry["fetch_bytes_per_row"] = max(
+                entry.get("fetch_bytes_per_row", 0.0), val)
+    return per_route
+
+
 def table(rows) -> str:
     out = ["entry       pr  headline lines/s  (n)  fetch/emit B/row   "
            "gates      tier"]

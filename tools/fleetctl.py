@@ -3,6 +3,18 @@
 
     fleetctl.py status <host:port> [--json]    fleet view + key metrics
     fleetctl.py drain  <host:port>             ask the host to drain
+    fleetctl.py top    <host:port> [--interval N | --once] [--json]
+                                               live per-host fleet table
+
+``top`` is the operator's one-glance fleet view: it follows the
+``fleet.rendezvous`` announced by whatever host you point it at, pulls
+that host's ``GET /fleetz`` (merged metrics, per-host staleness,
+fleet-level SLO status), and renders one row per host — lines/s
+(computed between refreshes), traffic share, SLO status, recent event
+counts, staleness age — refreshed every ``--interval`` seconds
+(default 2; ``--once`` prints a single table, sampling twice for the
+rates).  Exit codes: 0 = fleet green, **3 = at least one SLO is
+burning**, 2 = unreachable — so a rollout script can gate on it.
 
 ``status`` renders the health document (fleet/health.py ``GET
 /healthz``): the local host's lifecycle state, the fleet's agreed
@@ -99,6 +111,123 @@ def cmd_status(addr: str, as_json: bool) -> int:
     return 0 if status == 200 else 3
 
 
+# -- top ---------------------------------------------------------------------
+
+def _follow_rendezvous(addr: str):
+    """(fleetz document, serving address): ask ``addr`` for its
+    rendezvous and pull /fleetz from the elected host (falling back to
+    ``addr`` itself when the rendezvous is unreachable — a degraded
+    view beats no view)."""
+    _, health = _fetch(addr, "/healthz")
+    rdv = (health.get("fleet") or {}).get("rendezvous") or {}
+    serving = addr
+    if rdv.get("rank", -1) >= 0 and rdv.get("addr"):
+        serving = rdv["addr"]
+    try:
+        _, doc = _fetch(serving, "/fleetz")
+    except (OSError, ValueError):
+        if serving == addr:
+            raise
+        serving = addr
+        _, doc = _fetch(serving, "/fleetz")
+    if "hosts" not in doc:
+        raise ValueError(f"{serving}: /fleetz did not return a fleet "
+                         "document")
+    return doc, serving
+
+
+def _rates(prev, doc, now):
+    """Per-rank lines/s between two /fleetz samples (None on the first
+    sighting of a rank)."""
+    out = {}
+    for host in doc.get("hosts", []):
+        rank = host["rank"]
+        lines = (host.get("metrics") or {}).get("input_lines")
+        if lines is None:
+            continue
+        if rank in prev:
+            p_lines, p_t = prev[rank]
+            dt = now - p_t
+            if dt > 0 and lines >= p_lines:
+                out[rank] = (lines - p_lines) / dt
+        prev[rank] = (lines, now)
+    return out
+
+
+def _render_top(doc, serving, rates) -> str:
+    slo = doc.get("slo") or {}
+    burning = {o["name"] for o in slo.get("objectives", [])
+               if o.get("burning")}
+    per_host_burn = {}
+    for obj in slo.get("objectives", []):
+        for h in obj.get("hosts", []):
+            if h.get("burning"):
+                per_host_burn.setdefault(h["rank"], set()).add(obj["name"])
+    rdv = doc.get("rendezvous") or {}
+    lines = [f"fleet of {len(doc.get('hosts', []))} — rendezvous "
+             f"rank {rdv.get('rank', '?')} @ {rdv.get('addr', '?')}"
+             f" — served by rank {doc.get('served_by', '?')} ({serving})"]
+    sent = (slo.get("sentinel") or {})
+    lines.append(
+        f"slo: {slo.get('configured', 0)} objective(s), "
+        f"{slo.get('burning', 0)} burning"
+        + (f" [{', '.join(sorted(burning))}]" if burning else "")
+        + f" — sentinel regressions: {sent.get('regressions', 0)}")
+    lines.append(f"{'RANK':>4} {'STATE':<9} {'SHARE':>6} {'LINES/S':>10} "
+                 f"{'EVENTS':>7} {'SLO':<12} FRESHNESS")
+    for host in sorted(doc.get("hosts", []), key=lambda h: h["rank"]):
+        rank = host["rank"]
+        rate = rates.get(rank)
+        rate_s = f"{rate:>10,.0f}" if rate is not None else f"{'--':>10}"
+        events = (host.get("metrics") or {}).get("degradation_events", 0)
+        burn = per_host_burn.get(rank)
+        slo_s = f"BURN({len(burn)})" if burn else "ok"
+        fresh = f"STALE {host.get('age_s', 0):.1f}s" \
+            if host.get("stale") else "live"
+        lines.append(
+            f"{rank:>4} {host.get('state', '?'):<9} "
+            f"{host.get('share', 0.0):>6.1%} {rate_s} "
+            f"{events:>7} {slo_s:<12} {fresh}")
+    return "\n".join(lines)
+
+
+def cmd_top(addr: str, interval: float, once: bool, as_json: bool) -> int:
+    prev = {}
+    burning = False
+    primed = False
+    try:
+        import time as _time
+
+        while True:
+            try:
+                doc, serving = _follow_rendezvous(addr)
+            except (OSError, ValueError) as e:
+                print(f"error: {addr}: {e}", file=sys.stderr)
+                return 2
+            now = _time.monotonic()
+            rates = _rates(prev, doc, now)
+            burning = (doc.get("slo") or {}).get("burning", 0) > 0
+            if as_json:
+                print(json.dumps(doc))
+            elif once and not rates and not primed:
+                # one priming sample so --once can show real rates —
+                # exactly one: an idle fleet (no input_lines counter
+                # yet) must still print its table and exit, not poll
+                # forever waiting for traffic
+                primed = True
+                _time.sleep(max(0.5, min(interval, 2.0)))
+                continue
+            else:
+                if not once:
+                    print("\x1b[2J\x1b[H", end="")
+                print(_render_top(doc, serving, rates))
+            if once or as_json:
+                return 3 if burning else 0
+            _time.sleep(max(0.2, interval))
+    except KeyboardInterrupt:
+        return 3 if burning else 0
+
+
 def cmd_drain(addr: str) -> int:
     try:
         status, doc = _fetch(addr, "/drain", method="POST")
@@ -122,9 +251,21 @@ def main(argv=None) -> int:
                     help="dump the raw health document")
     dr = sub.add_parser("drain", help="ask the host to drain and depart")
     dr.add_argument("addr", help="host:port of the health endpoint")
+    tp = sub.add_parser("top", help="live per-host fleet table "
+                        "(follows the rendezvous, exit 3 on a burning "
+                        "SLO)")
+    tp.add_argument("addr", help="any fleet host's health endpoint")
+    tp.add_argument("--interval", type=float, default=2.0,
+                    help="refresh seconds (default 2)")
+    tp.add_argument("--once", action="store_true",
+                    help="print one table and exit (scriptable)")
+    tp.add_argument("--json", action="store_true",
+                    help="dump the raw /fleetz document and exit")
     args = ap.parse_args(argv)
     if args.verb == "status":
         return cmd_status(args.addr, args.json)
+    if args.verb == "top":
+        return cmd_top(args.addr, args.interval, args.once, args.json)
     return cmd_drain(args.addr)
 
 
