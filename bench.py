@@ -994,6 +994,150 @@ def bench_obs(extra, lines):
     return ok
 
 
+def bench_durability(extra, lines):
+    """Zero-loss ingestion (WAL spill tier) smoke gates:
+
+    1. Disarmed-watermark overhead: the per-dispatch cost of the
+       ``should_spill()`` guard a durability-armed handler pays while
+       the queue sits BELOW the watermark (the steady state — one
+       fill-fraction read and a compare) must stay under 1% of the
+       measured per-chunk e2e cost.  Same micro-differential isolation
+       as the admission/trace gates: two full e2e runs jitter ±10% on
+       2-core CI boxes while the guard costs nanoseconds.
+    2. Spill + replay byte identity: a corpus forced through the spill
+       tier (saturated queue, every batch appended to WAL segments)
+       and then replayed through a fresh handler must emit exactly the
+       bytes of a straight no-spill run, the replay cursor must drain
+       to zero unacked records on sink acks, and the fully-acked
+       segments must be unlinked from disk.
+    """
+    import queue as _q
+    import shutil
+    import tempfile
+
+    from flowgger_tpu.block import EncodedBlock
+    from flowgger_tpu.config import Config
+    from flowgger_tpu.decoders.rfc5424 import RFC5424Decoder
+    from flowgger_tpu.durability import DurabilityManager
+    from flowgger_tpu.encoders.gelf import GelfEncoder
+    from flowgger_tpu.mergers import LineMerger
+    from flowgger_tpu.outputs import ack_item
+    from flowgger_tpu.tpu.batch import BatchHandler
+    from flowgger_tpu.utils.bounded_queue import PolicyQueue
+
+    # gate 1: guard cost below the watermark (the always-on price)
+    idle_q = PolicyQueue(10_000)
+    tmp = tempfile.mkdtemp(prefix="flowgger_dur_bench_")
+    mgr = DurabilityManager("spill", tmp, start_watchdog=False)
+    mgr.attach_queue(idle_q)
+    loops = 100_000
+    best = None
+    for _ in range(5):
+        t0 = time.perf_counter()
+        for _ in range(loops):
+            mgr.should_spill()
+        wall = time.perf_counter() - t0
+        best = wall if best is None else min(best, wall)
+    guard_s = best / loops
+    region_len = sum(len(ln) + 1 for ln in lines)
+    lines_per_chunk = max(1.0, len(lines) / max(1, region_len / 8192))
+    e2e_rate = extra.get("e2e_overlap_lines_per_sec", 0) or 1
+    e2e_s_per_chunk = lines_per_chunk / e2e_rate
+    overhead_ratio = guard_s / e2e_s_per_chunk
+    guard_ok = overhead_ratio < 0.01
+
+    # gate 2: spill → replay byte identity vs a straight run
+    corpus = lines[:2_048]
+    region = b"".join(ln + b"\n" for ln in corpus)
+    cfg = Config.from_string(
+        "[input]\ntpu_batch_size = 256\ntpu_max_line_len = 256\n")
+
+    def collect(tx):
+        got = []
+        while not tx.empty():
+            item = tx.get_nowait()
+            if isinstance(item, EncodedBlock):
+                got.extend(item.iter_framed())
+                ack_item(item)
+            else:
+                got.append(LineMerger().frame(item))
+        return b"".join(got)
+
+    def fresh_handler(tx):
+        return BatchHandler(tx, RFC5424Decoder(), GelfEncoder(
+            Config.from_string("")), cfg, fmt="rfc5424",
+            start_timer=False, merger=LineMerger())
+
+    tx0 = _q.Queue()
+    h0 = fresh_handler(tx0)
+    h0.ingest_chunk(region)
+    h0.flush()
+    h0.close()
+    want = collect(tx0)
+
+    class _Saturated:
+        """A queue past its watermark whose put must never fire: with
+        the spill tier armed, every dispatch lands in the WAL."""
+
+        @staticmethod
+        def fill_fraction():
+            return 1.0
+
+        def put(self, item):
+            raise AssertionError("dispatch leaked past the spill tier")
+
+    sat = _Saturated()
+    mgr.attach_queue(sat)  # past the watermark: should_spill() arms
+    h1 = fresh_handler(sat)
+    h1.durability = mgr
+    h1.ingest_chunk(region)
+    h1.flush()
+    h1.close()
+    stats = mgr.backlog_stats()
+    spilled_segments = stats["segments"]
+    spilled_bytes = stats["bytes"]
+
+    tx2 = _q.Queue()
+    h2 = fresh_handler(tx2)
+    h2.durability = mgr
+    replayed = h2.replay_spilled()
+    h2.close()
+    got = collect(tx2)
+    mgr.stop()
+    drained = mgr.unacked() == 0 and not mgr.backlog()
+    wal_empty = not any(f.endswith(".seg") for f in os.listdir(tmp))
+    identical = got == want and len(want) > 0
+    shutil.rmtree(tmp, ignore_errors=True)
+    replay_ok = identical and drained and wal_empty \
+        and replayed == len(corpus)
+
+    ok = guard_ok and replay_ok
+    extra.update({
+        "durability_guard_ns_per_dispatch": round(guard_s * 1e9),
+        "durability_guard_overhead_ratio": round(overhead_ratio, 6),
+        "durability_spilled_segments": spilled_segments,
+        "durability_spilled_bytes": spilled_bytes,
+        "durability_replayed_lines": replayed,
+        "durability_replay_byte_identical": bool(identical),
+        "durability_ok": ok,
+    })
+    print(json.dumps({
+        "metric": "durability_smoke",
+        "guard_ns_per_dispatch": round(guard_s * 1e9),
+        "guard_overhead_ratio": round(overhead_ratio, 6),
+        "guard_gate": "< 0.01 of per-chunk e2e cost",
+        "guard_ok": guard_ok,
+        "spilled_segments": spilled_segments,
+        "spilled_bytes": spilled_bytes,
+        "replayed_lines": replayed,
+        "replay_byte_identical": bool(identical),
+        "cursor_drained": bool(drained),
+        "wal_empty_after_ack": bool(wal_empty),
+        "ok": ok,
+    }))
+    return ok
+
+
 def bench_fused_routes(extra, smoke):
     """Fused decode→encode route matrix (tpu/fused_routes.py): per
     route, emit the fused tier's fetched-vs-emitted bytes/row, the
@@ -1940,6 +2084,10 @@ def smoke_main():
     # observability section: tracing-off guard cost < 1% of per-chunk
     # e2e cost, ring-mode cost recorded, journal + exposition sanity
     obs_ok = bench_obs(extra, lines)
+    # durability section: disarmed-watermark guard cost < 1% of
+    # per-chunk e2e cost + spill→replay byte identity with a drained
+    # cursor and an empty WAL after sink acks
+    durability_ok = bench_durability(extra, lines)
     # jsonl/dns block routes: byte identity vs the scalar pipeline +
     # block throughput >= scalar (runs BEFORE the fused section, whose
     # declined background compiles would chew the cores under it)
@@ -1977,8 +2125,9 @@ def smoke_main():
         "multilane_vs_single_lane": round(multilane / max(overlap, 1), 2),
         "wall_seconds": round(wall, 1),
         "ok": bool(ok and lanes_ok and tenancy_ok and obs_ok
-                   and newfmt_ok and framing_ok and fused_ok and aot_ok
-                   and fleet_ok and wall < budget),
+                   and durability_ok and newfmt_ok and framing_ok
+                   and fused_ok and aot_ok and fleet_ok
+                   and wall < budget),
     }))
     if not framing_ok:
         print("SMOKE FAIL: device-framing gates missed (byte identity "
@@ -2024,6 +2173,13 @@ def smoke_main():
               "BENCH-seeded sentinel flagged this run as a perf "
               "regression — or failed to flag a synthetic throttle — "
               "or journal/exposition sanity — see the obs_smoke JSON "
+              "line)", file=sys.stderr)
+        sys.exit(1)
+    if not durability_ok:
+        print("SMOKE FAIL: durability gates missed (disarmed-watermark "
+              "guard cost above 1% of per-chunk e2e, spill→replay "
+              "bytes diverged from the straight run, or the WAL did "
+              "not drain on sink acks — see the durability_smoke JSON "
               "line)", file=sys.stderr)
         sys.exit(1)
     if not ok:
@@ -2189,6 +2345,9 @@ def main():
         # across per-chip lanes (input.tpu_lanes)
         bench_e2e_overlap(lines[:E2E_BATCH], extra, smoke,
                           lanes=min(4, ndev))
+    # durability (WAL spill tier): guard cost + spill→replay identity —
+    # the smoke gates these; the full run records the numbers
+    bench_durability(extra, lines[:E2E_BATCH])
 
     # scalar CPU baseline (the reference's per-line architecture)
     from flowgger_tpu.decoders.rfc5424 import RFC5424Decoder

@@ -84,6 +84,16 @@ echo "== self-healing fleet: chaos drills + failover acceptance (slow) =="
 JAX_PLATFORMS=cpu timeout 600 python -m pytest tests/test_fleet_failover.py -q -m "slow"
 timeout 600 python tools/chaos.py --hosts 2 --events 4 --window 60
 
+echo "== zero-loss ingestion: WAL spill chaos drill (kill mid-spill) =="
+# (1) the slow-marked pytest half: kill-mid-spill acceptance through
+# the drill harness; (2) the drill itself — SIGKILL a spilling worker
+# mid-record, SIGKILL a replaying worker mid-replay, then replay to
+# completion: every WAL-owed line delivered (clean-prefix accounting),
+# nothing foreign, no line more than twice (at-least-once across
+# process restarts).  measured ~10s per run on the 2-core container
+JAX_PLATFORMS=cpu timeout 600 python -m pytest tests/test_durability.py -q -m "slow"
+timeout 300 python tools/chaos.py --durability --json
+
 echo "== multi-tenant serving suite (admission, fair queue, templates) =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_tenancy.py -q -m "not faults"
 

@@ -27,17 +27,23 @@ class EncodedBlock:
     ``prefix_lens`` — per-message framing-prefix length (int64 array) or
                     None when the framing has no prefix.
     ``suffix_len`` — framing suffix length (0, or 1 for line/nul).
+    ``ack_cb``    — durability ack hook (or None, the usual case): a
+                    replayed spill record's block carries the callback
+                    the sink fires once the bytes are flushed/sent
+                    (``outputs.ack_item``) — only then does the WAL's
+                    replay cursor advance (durability/manager.py).
     """
 
-    __slots__ = ("data", "bounds", "prefix_lens", "suffix_len")
+    __slots__ = ("data", "bounds", "prefix_lens", "suffix_len", "ack_cb")
 
     def __init__(self, data: bytes, bounds: np.ndarray,
                  prefix_lens: Optional[np.ndarray] = None,
-                 suffix_len: int = 0):
+                 suffix_len: int = 0, ack_cb=None):
         self.data = data
         self.bounds = bounds
         self.prefix_lens = prefix_lens
         self.suffix_len = suffix_len
+        self.ack_cb = ack_cb
 
     def __len__(self) -> int:
         return len(self.bounds) - 1

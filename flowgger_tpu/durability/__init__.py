@@ -1,0 +1,33 @@
+"""Disk-backed WAL spill tier with acked replay (``[durability]``).
+
+See ``durability.manager`` for the spill → ack → replay lifecycle and
+``durability.segments`` for the crash-safe on-disk format.
+"""
+
+from .manager import (
+    MODES,
+    DurabilityError,
+    DurabilityManager,
+    SpillRecord,
+)
+from .segments import (
+    SegmentWriter,
+    list_segments,
+    load_cursor,
+    read_segment,
+    save_cursor,
+    segment_path,
+)
+
+__all__ = [
+    "MODES",
+    "DurabilityError",
+    "DurabilityManager",
+    "SpillRecord",
+    "SegmentWriter",
+    "list_segments",
+    "load_cursor",
+    "read_segment",
+    "save_cursor",
+    "segment_path",
+]

@@ -1,0 +1,200 @@
+"""Crash-safe spill segments: the on-disk WAL half of the durability
+tier (see ``durability.manager`` for the lifecycle).
+
+One segment file (``spill-<seq:08d>.seg``) is a sequence of framed
+records, each one packed ingest region plus its framing metadata::
+
+    MAGIC "FWSP" | hdr_len u32le | body_len u32le | crc32 u32le
+    | hdr JSON | body
+
+``hdr`` carries ``{"fmt", "n", "starts", "lens", "runs"}`` — exactly
+what ``tpu/pack.pack_spans_2d`` needs to rebuild the device-ready
+packed tuple at replay, so replay re-enters at ``block_submit`` with
+zero re-framing cost; ``body`` is the raw region bytes.  The CRC
+covers header and body together, so a torn append (power loss, or the
+``spill_io`` fault site's deliberately-torn write) is detected as a
+corrupt tail: :func:`read_segment` recovers the valid prefix and stops
+there, never crashing on garbage.
+
+Write discipline mirrors the roster journal (fleet/roster.py):
+segments are appended unbuffered (``"ab", buffering=0``) and fsynced
+per record, so a record the writer returned from is durable; the
+replay cursor is a separate tiny JSON document persisted with the
+tmp → flush → fsync → ``os.replace`` idiom, so it is atomically either
+the old or the new position — never half-written.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+import zlib
+from typing import List, Optional, Tuple
+
+from ..utils import faultinject as _faults
+
+MAGIC = b"FWSP"
+_FIXED = struct.Struct("<4sIII")  # magic, hdr_len, body_len, crc32
+
+
+def segment_path(dirpath: str, seq: int) -> str:
+    return os.path.join(dirpath, f"spill-{seq:08d}.seg")
+
+
+def list_segments(dirpath: str) -> List[Tuple[int, str]]:
+    """Sorted ``[(seq, path)]`` of every segment file in the spill
+    directory (missing/unreadable directory -> empty, never raises)."""
+    try:
+        names = os.listdir(dirpath)
+    except OSError:
+        return []
+    out = []
+    for name in names:
+        if name.startswith("spill-") and name.endswith(".seg"):
+            try:
+                seq = int(name[len("spill-"):-len(".seg")])
+            except ValueError:
+                continue
+            out.append((seq, os.path.join(dirpath, name)))
+    out.sort()
+    return out
+
+
+def encode_record(hdr: dict, body: bytes) -> bytes:
+    hdr_b = json.dumps(hdr, separators=(",", ":")).encode()
+    crc = zlib.crc32(hdr_b + body) & 0xFFFFFFFF
+    return _FIXED.pack(MAGIC, len(hdr_b), len(body), crc) + hdr_b + body
+
+
+def read_segment(path: str) -> Tuple[List[Tuple[dict, bytes]], bool]:
+    """``(records, clean)``: every validly framed ``(hdr, body)`` in
+    on-disk order.  ``clean`` is False when the file ends in a torn or
+    corrupt tail (a crash mid-append): reading stops at the first bad
+    frame and the valid prefix survives — degradation, not a crash."""
+    records: List[Tuple[dict, bytes]] = []
+    try:
+        with open(path, "rb") as f:
+            data = f.read()
+    except OSError:
+        return records, False
+    off, n = 0, len(data)
+    while off < n:
+        if off + _FIXED.size > n:
+            return records, False
+        magic, hdr_len, body_len, crc = _FIXED.unpack_from(data, off)
+        if magic != MAGIC:
+            return records, False
+        start = off + _FIXED.size
+        end = start + hdr_len + body_len
+        if end > n:
+            return records, False
+        blob = data[start:end]
+        if (zlib.crc32(blob) & 0xFFFFFFFF) != crc:
+            return records, False
+        try:
+            hdr = json.loads(blob[:hdr_len])
+        except ValueError:
+            return records, False
+        if not isinstance(hdr, dict):
+            return records, False
+        records.append((hdr, bytes(blob[hdr_len:])))
+        off = end
+    return records, True
+
+
+def load_cursor(path: str):
+    """``((segment, record), error)`` — ``((0, 0), None)`` when the
+    cursor file is simply absent (fresh spill dir); a present-but-
+    unreadable cursor returns ``(0, 0)`` with the error string, which
+    restarts replay from the oldest segment (duplicates stay inside the
+    at-least-once window — never a loss)."""
+    if not os.path.exists(path):
+        return (0, 0), None
+    try:
+        with open(path, "rb") as f:
+            doc = json.load(f)
+        return (int(doc["segment"]), int(doc["record"])), None
+    except (OSError, ValueError, KeyError, TypeError) as e:
+        return (0, 0), f"{type(e).__name__}: {e}"
+
+
+def save_cursor(path: str, segment: int, record: int) -> None:
+    """Atomically persist the replay cursor (tmp + flush + fsync +
+    ``os.replace`` — the roster-journal idiom)."""
+    tmp = f"{path}.tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump({"segment": int(segment), "record": int(record)}, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+class SegmentWriter:
+    """Fsynced record appender with size-based rotation.
+
+    A failed append (real I/O error, or the ``spill_io`` fault site's
+    injected torn write) *abandons* the open segment — subsequent
+    appends go to a fresh file — so one bad tail never grows; the
+    reader recovers the abandoned segment's valid prefix at the next
+    boot."""
+
+    def __init__(self, dirpath: str, max_bytes: int, fsync: bool = True,
+                 start_seq: int = 0):
+        self.dir = dirpath
+        self.max_bytes = max(1, int(max_bytes))
+        self.fsync = fsync
+        self.seq = int(start_seq)
+        self.count = 0       # records appended to the current segment
+        self._f = None
+        self._size = 0
+
+    def append(self, hdr: dict, body: bytes):
+        """Durably append one record; returns ``(seq, idx, nbytes)``.
+        Raises OSError on failure — the current segment is abandoned
+        first, so the caller may retry into a fresh file."""
+        if self._f is not None and self._size >= self.max_bytes:
+            self.close()
+            self.seq += 1
+            self.count = 0
+        rec = encode_record(hdr, body)
+        if self._f is None:
+            self._f = open(segment_path(self.dir, self.seq), "ab",
+                           buffering=0)
+            self._size = self._f.tell()
+        if _faults.enabled() and _faults.fire("spill_io"):
+            # a realistic failure leaves a TORN record on disk, not a
+            # clean boundary: write a fragment, then fail the append
+            try:
+                self._f.write(rec[:8])
+            except OSError:  # flowcheck: disable=FC04 -- the injected OSError below is the failure under test
+                pass
+            self.abandon()
+            raise OSError("injected spill_io failure (torn segment append)")
+        try:
+            self._f.write(rec)
+            if self.fsync:
+                os.fsync(self._f.fileno())
+        except OSError:
+            self.abandon()
+            raise
+        idx = self.count
+        self.count += 1
+        self._size += len(rec)
+        return self.seq, idx, len(rec)
+
+    def abandon(self) -> None:
+        """The open segment may end in a torn tail: close it and point
+        subsequent appends at a fresh segment file."""
+        self.close()
+        self.seq += 1
+        self.count = 0
+
+    def close(self) -> None:
+        if self._f is not None:
+            try:
+                self._f.close()
+            except OSError:  # flowcheck: disable=FC04 -- close on an already-failed fd is best-effort
+                pass
+            self._f = None
+        self._size = 0

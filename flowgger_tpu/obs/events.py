@@ -37,6 +37,19 @@ reason                     fired by
 ``perf_regression``        obs/sentinel.py — a route's live throughput
                            (or fetch cost) sustained a drop against
                            its BENCH-seeded baseline
+``spill_begin``            durability/manager.py — the queue crossed
+                           the spill watermark and the first overflow
+                           batch landed in the on-disk WAL
+``spill_replay``           tpu/batch.py replay_spilled — one replay
+                           round re-dispatched spilled records through
+                           block_submit
+``replay_complete``        durability/manager.py — every spilled
+                           record has been sink-acknowledged; the
+                           backlog is empty
+``replay_stall``           durability/manager.py watchdog — nonzero
+                           unacked backlog with a pinned replay cursor
+                           (SLO-declarable: a stuck replay burns an
+                           objective instead of rotting silently)
 =========================  =================================================
 
 Each event carries ``(ts, site, reason)`` plus whatever context the
@@ -102,6 +115,10 @@ REASONS = (
     "slo_burn",
     "slo_recover",
     "perf_regression",
+    "spill_begin",
+    "spill_replay",
+    "replay_complete",
+    "replay_stall",
 )
 _REASON_SET = frozenset(REASONS)
 

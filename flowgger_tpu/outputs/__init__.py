@@ -50,6 +50,39 @@ def spawn_worker(target, name: str, supervisor=None) -> threading.Thread:
     return t
 
 
+def ack_item(item) -> None:
+    """Fire a delivered item's durability ack, if it carries one.
+
+    Sinks call this at their own delivery point — FileOutput after a
+    flushed write, TLS after sendall, Kafka after an acknowledged
+    send_all — so the WAL replay cursor (durability/manager.py)
+    advances only on real sink acknowledgment.  The ``sink_ack_loss``
+    fault site suppresses the callback (the ack "never arrives"),
+    which is exactly a stuck-replay drill: the record stays unacked,
+    ``replay_cursor_lag`` pins, and the stall watchdog journals it.
+    A failing callback is contained and counted — an ack bug must
+    never take down a sink worker."""
+    cb = getattr(item, "ack_cb", None)
+    if cb is None:
+        return
+    from ..utils import faultinject as _faults
+
+    if _faults.enabled() and _faults.fire("sink_ack_loss"):
+        return
+    from ..utils.metrics import registry as _metrics
+
+    try:
+        cb()
+    except Exception as e:  # noqa: BLE001 - ack is advisory for the sink
+        _metrics.inc("sink_ack_errors")
+        import sys
+
+        print(f"sink ack callback failed ({type(e).__name__}: {e})",
+              file=sys.stderr)
+    else:
+        _metrics.inc("sink_acks")
+
+
 from .debug_output import DebugOutput  # noqa: E402
 from .file_output import FileOutput  # noqa: E402
 from .tls_output import TlsOutput  # noqa: E402

@@ -9,7 +9,7 @@ from __future__ import annotations
 
 import sys
 
-from . import Output, SHUTDOWN, stream_bytes
+from . import Output, SHUTDOWN, ack_item, stream_bytes
 
 
 class DebugOutput(Output):
@@ -26,6 +26,7 @@ class DebugOutput(Output):
                 data, _ = stream_bytes(item, merger)
                 sys.stdout.write(data.decode("utf-8", errors="replace"))
                 sys.stdout.flush()
+                ack_item(item)
                 arx.task_done()
 
         return self.spawn(run, "debug-output")

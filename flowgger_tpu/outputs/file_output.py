@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import sys
 
-from . import Output, SHUTDOWN, stream_bytes
+from . import Output, SHUTDOWN, ack_item, stream_bytes
 from ..block import EncodedBlock
 from ..utils import faultinject as _faults
 from ..utils.metrics import registry as _metrics
@@ -130,6 +130,15 @@ class FileOutput(Output):
                         data, count = stream_bytes(item, merger)
                         wbox[0].write(data)
                         _metrics.inc("output_written", count)
+                    # durability ack: fires only once the bytes cleared
+                    # any BufferedWriter layer — an ack on merely-
+                    # buffered data would advance the replay cursor
+                    # past bytes a crash can still lose
+                    if (getattr(item, "ack_cb", None) is not None
+                            and self.buffer_size > 0
+                            and hasattr(wbox[0], "flush")):
+                        wbox[0].flush()
+                    ack_item(item)
                 except OSError:
                     _metrics.inc("output_errors")
                     if from_queue:
@@ -144,11 +153,13 @@ class FileOutput(Output):
                         # failure would lose trimmed frames), so the
                         # whole block is retained instead: at-least-once.
                         _metrics.inc("output_written", written)
+                        # the durability ack (if any) rides the trimmed
+                        # block: it fires only once the TAIL lands too
                         item = EncodedBlock(
                             item.data, item.bounds[written:],
                             None if item.prefix_lens is None
                             else item.prefix_lens[written:],
-                            item.suffix_len)
+                            item.suffix_len, ack_cb=item.ack_cb)
                     carry[0] = item
                     # the fd may be what broke: reopen on restart
                     try:

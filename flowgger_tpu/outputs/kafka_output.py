@@ -16,7 +16,7 @@ from __future__ import annotations
 
 import sys
 
-from . import Output, SHUTDOWN
+from . import Output, SHUTDOWN, ack_item
 from ..block import EncodedBlock
 from ..config import Config, ConfigError
 from ..utils.kafka_wire import KafkaError, KafkaProducer
@@ -110,6 +110,11 @@ class KafkaOutput(Output):
             return self._die()
         policy.note_success()
         queue_buf = []
+        # durability acks ride the coalescing buffer in parallel: they
+        # fire only after the send_all carrying their messages came
+        # back clean through the whole retry ladder (RetryPolicy) —
+        # Kafka-level acks= semantics are the producer's as configured
+        ack_buf = []
         while True:
             item = arx.get()
             if item is SHUTDOWN:
@@ -119,12 +124,16 @@ class KafkaOutput(Output):
                     print(f"Kafka not responsive: [{e}]")
                     arx.task_done()
                     return self._die()
+                for acked in ack_buf:
+                    ack_item(acked)
                 arx.task_done()
                 return None
             if isinstance(item, EncodedBlock):
                 queue_buf.extend(item.iter_unframed())
             else:
                 queue_buf.append(item)
+            if getattr(item, "ack_cb", None) is not None:
+                ack_buf.append(item)
             if len(queue_buf) >= max(1, self.coalesce):
                 try:
                     self._send_retrying(policy, producer, queue_buf)
@@ -133,6 +142,9 @@ class KafkaOutput(Output):
                     arx.task_done()
                     return self._die()
                 queue_buf = []
+                for acked in ack_buf:
+                    ack_item(acked)
+                ack_buf = []
             arx.task_done()
 
     def _die(self):

@@ -23,7 +23,7 @@ import ssl
 import sys
 import threading
 
-from . import Output, SHUTDOWN, stream_bytes
+from . import Output, SHUTDOWN, ack_item, stream_bytes
 from ..config import Config, ConfigError
 from ..utils import faultinject as _faults
 from ..utils.metrics import registry as _metrics
@@ -179,7 +179,14 @@ class TlsOutput(Output):
                         _faults.maybe_raise("sink_write", BrokenPipeError)
                     if self.async_:
                         buf.extend(data)
-                        if len(buf) >= 8192:
+                        if getattr(item, "ack_cb", None) is not None:
+                            # a durability-acked item forces the async
+                            # buffer out now: acking bytes that are
+                            # still host-buffered would advance the
+                            # replay cursor past a loss window
+                            tls.sendall(bytes(buf))
+                            buf.clear()
+                        elif len(buf) >= 8192:
                             tls.sendall(bytes(buf))
                             buf.clear()
                     else:
@@ -191,6 +198,7 @@ class TlsOutput(Output):
                     if from_queue:
                         arx.task_done()
                     raise
+                ack_item(item)
                 carry[0] = None
                 if from_queue:
                     arx.task_done()

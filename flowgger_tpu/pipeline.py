@@ -234,6 +234,34 @@ class Pipeline:
                 maxsize=queue_size, registry=self.tenants)
         else:
             self.tx = PolicyQueue(maxsize=queue_size, policy=queue_policy)
+        # zero-loss ingestion ([durability]): the WAL spill tier arms
+        # only on the *_tpu formats — the spill record is the packed-
+        # region shape (chunk + span vectors) only the batch handler
+        # produces.  A scalar pipeline asking for it gets a warning,
+        # not silent false durability.
+        from .durability.manager import DurabilityManager
+
+        self.durability = None
+        if input_format in _TPU_FORMATS:
+            self.durability = DurabilityManager.from_config(config)
+            if self.durability is not None:
+                self.durability.attach_queue(self.tx)
+        else:
+            _dmode = config.lookup_str(
+                "durability.mode",
+                'durability.mode must be "off", "spill" or "require"',
+                "off")
+            if _dmode != "off":
+                import sys
+
+                _dmsg = (f'durability.mode = "{_dmode}" requires a '
+                         f"*_tpu input format (got '{input_format}')")
+                if _dmode == "require":
+                    # "require" promised no silent loss: refusing to
+                    # start beats booting a lossy pipeline quietly
+                    raise ConfigError(_dmsg)
+                print(f"{_dmsg}; the spill tier is disabled",
+                      file=sys.stderr)
         self.input_format = input_format
         self.config = config
         # template mining for scalar pipelines (the batch handler owns
@@ -337,6 +365,7 @@ class Pipeline:
                     fmt=_TPU_FORMATS[self.input_format], merger=self.merger,
                     supervisor=self.supervisor,
                 )
+                handler.durability = self.durability
                 self._handlers.append(handler)
                 return handler
         # ScalarHandlers are stateless (no buffered batch, flush is a
@@ -414,6 +443,39 @@ class Pipeline:
                 print("drain: final flush failed, batch lost:",
                       file=sys.stderr)
                 traceback.print_exc()
+        if self.durability is not None:
+            # replay-on-drain: spilled batches re-enter through the
+            # (already flushed and fenced) handlers so nothing rides
+            # out the process on disk unnecessarily.  The replay
+            # happens BEFORE the queue drain barrier below, so
+            # replayed blocks and the live tail both clear the sinks
+            # before any SHUTDOWN is enqueued — replay can never
+            # interleave with sink teardown.
+            for handler in self._handlers:
+                replay = getattr(handler, "replay_spilled", None)
+                if replay is None:
+                    continue
+                try:
+                    replay()
+                except Exception:  # noqa: BLE001 - best-effort during shutdown
+                    import sys
+                    import traceback
+
+                    from .utils.metrics import registry as _metrics
+
+                    _metrics.inc("drain_flush_errors")
+                    print("drain: spill replay failed; the WAL keeps "
+                          "the records for the next boot:",
+                          file=sys.stderr)
+                    traceback.print_exc()
+        # drain barrier: every enqueued item must be consumed AND
+        # task_done'd by a sink before SHUTDOWN goes in.  The WFQ
+        # already delivers its control lane last, but the barrier makes
+        # the ordering explicit for every queue type — and sink acks
+        # fire before task_done, so replay cursors are settled here too
+        self._await_queue_drain()
+        if self.durability is not None:
+            self.durability.stop()
         from .outputs import SHUTDOWN
 
         for _ in threads:
@@ -447,6 +509,29 @@ class Pipeline:
         if self._obs_server is not None:
             self._obs_server.stop()
             self._obs_server = None
+
+    def _await_queue_drain(self, deadline_s: float = 30.0) -> None:
+        """Block until the sinks have consumed and ``task_done``'d every
+        enqueued item (outputs ack before task_done, so durability
+        replay cursors are settled when this returns).  A sink that
+        cannot drain within ``deadline_s`` is surfaced, not waited on
+        forever — counted in ``drain_barrier_timeouts``."""
+        import sys
+        import time
+
+        if getattr(self.tx, "unfinished_tasks", None) is None:
+            return
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < deadline_s:
+            if self.tx.unfinished_tasks == 0:
+                return
+            time.sleep(0.01)
+        from .utils.metrics import registry as _metrics
+
+        _metrics.inc("drain_barrier_timeouts")
+        print(f"drain: queue barrier timed out after {deadline_s:.0f}s "
+              f"({self.tx.unfinished_tasks} item(s) still in flight)",
+              file=sys.stderr)
 
     def _install_signal_handlers(self, threads):
         import os
@@ -501,6 +586,18 @@ class Pipeline:
 
             self._obs_server = _prom.maybe_start_from(
                 self.config, supervisor=self.supervisor)
+        if self.durability is not None and self.durability.backlog():
+            # crash recovery: a previous life left unacked records in
+            # the WAL — replay them through the sinks BEFORE fresh
+            # ingest is admitted, so restart ordering is replay-then-
+            # live and the at-least-once window closes at boot
+            import sys
+
+            handler = self._base_handler()
+            replayed = handler.replay_spilled()
+            if replayed:
+                print(f"durability: replayed {replayed} spilled line(s) "
+                      f"from {self.durability.dir}", file=sys.stderr)
         # the accept loop runs supervised: a crash in the transport
         # restarts it (bounded by [supervisor] config) instead of
         # killing the daemon while consumers still hold the queue

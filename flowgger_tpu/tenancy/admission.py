@@ -142,6 +142,26 @@ class TenantState:
         self._set_state(STATE_SHED)
 
 
+class RawCharge:
+    """Record-aligned admission hook a raw (device-framed) session
+    carries: the batch handler calls ``admit_region`` once per *framed*
+    region — after the boundary scan, before dispatch — with the exact
+    (records, bytes) the host splitter would have charged for the same
+    stream.  All-or-nothing per region, so a denial sheds whole records
+    (never a mid-record splice) and the tenant counters stay identical
+    to the host-framing baseline.  The carry tail (a record split
+    across chunks) is charged when it finally frames, or as one record
+    at EOF — again mirroring the host splitters' delivery units."""
+
+    __slots__ = ("state",)
+
+    def __init__(self, state: TenantState):
+        self.state = state
+
+    def admit_region(self, lines: int, nbytes: int) -> bool:
+        return self.state.admit(lines, nbytes)
+
+
 class AdmissionHandler(Handler):
     """Per-connection wrapper: tags the connection thread with its
     tenant, charges admission, forwards admitted input to the shared
@@ -149,12 +169,12 @@ class AdmissionHandler(Handler):
     the inner handler does, so splitter fast-path dispatch (hasattr
     checks) is unchanged.
 
-    Device-resident framing (``wants_raw``) deliberately stays at the
-    base False here even when the inner handler engages it: admission
-    drops whole delivery units, and a dropped *raw* chunk (which can
-    end mid-record) would splice the surrounding records together —
-    host framing keeps the drop unit record-aligned, so tenancy-
-    admitted connections pin the host splitters."""
+    Device-resident framing forwards too (``wants_raw``/``open_raw``):
+    the raw session carries a :class:`RawCharge` that the batch handler
+    invokes on each *framed* region, so admission stays record-aligned
+    (a raw chunk can end mid-record; charging at frame time means a
+    denial can never splice the surrounding records together) while
+    tenancy-admitted connections keep the device framing tier."""
 
     def __init__(self, inner: Handler, tenant: TenantState):
         self._inner = inner
@@ -197,6 +217,18 @@ class AdmissionHandler(Handler):
     @ingest_strip_cr.setter
     def ingest_strip_cr(self, v):
         self._inner.ingest_strip_cr = v
+
+    def wants_raw(self, framing: str) -> bool:
+        return self._inner.wants_raw(framing)
+
+    def open_raw(self, framing: str):
+        # the session is charged at frame time (RawCharge), not here:
+        # raw chunks are admitted unconditionally into the session
+        # buffer and pay admission once record boundaries are known
+        set_current(self._tenant.name)
+        sess = self._inner.open_raw(framing)
+        sess.charge = RawCharge(self._tenant)
+        return sess
 
     def handle_bytes(self, raw: bytes) -> None:
         if self._tenant.admit(1, len(raw)):

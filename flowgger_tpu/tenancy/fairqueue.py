@@ -135,6 +135,13 @@ class WeightedFairQueue:
         with self.mutex:
             self.draining = True
 
+    def fill_fraction(self) -> float:
+        """Data-item occupancy in [0, 1] (PolicyQueue parity — the
+        durability watermark signal; the control lane is capacity-
+        exempt and does not count)."""
+        with self.mutex:
+            return self._total / self.maxsize if self.maxsize > 0 else 0.0
+
     # -- producers ---------------------------------------------------------
     def _lane_for(self, name: str) -> _Lane:
         lane = self._lanes.get(name)

@@ -57,6 +57,11 @@ class BatchHandler(Handler):
         self.scalar = ScalarHandler(tx, decoder, encoder)
         cfg = config or Config.from_string("")
         self._cfg = cfg
+        # WAL spill tier (durability/manager.py): set by the pipeline
+        # when [durability] is armed.  _guarded_dispatch diverts fresh
+        # packed batches to disk instead of blocking on a full queue;
+        # replay_spilled() re-enters them with sink-ack cursors.
+        self.durability = None
         # device-decode circuit breaker: trips the whole handler onto the
         # scalar-oracle path on sustained device failure (None = disabled
         # via input.tpu_breaker = false, legacy fail-fast behavior)
@@ -530,6 +535,55 @@ class BatchHandler(Handler):
         fetcher threads across handler generations."""
         self._window.close()
 
+    # -- WAL replay (durability/manager.py) --------------------------------
+    def replay_spilled(self, limit: Optional[int] = None) -> int:
+        """Re-enter spilled WAL records through the normal dispatch
+        path: each record re-packs from its raw chunk + span vectors
+        (byte-identical to the original pack) and rides an ack that
+        advances the persisted replay cursor only once the sink flushed
+        the bytes.  ``limit`` caps replayed records (None = drain the
+        whole backlog).  Returns the number of lines replayed."""
+        mgr = self.durability
+        if mgr is None or not mgr.backlog():
+            return 0
+        from . import pack
+
+        total_lines = 0
+        replayed = 0
+        while limit is None or replayed < limit:
+            want = mgr.replay_batch if limit is None \
+                else min(mgr.replay_batch, limit - replayed)
+            recs = mgr.next_records(want)
+            if not recs:
+                break
+            for rec in recs:
+                if rec.fmt != self.fmt:
+                    # config changed across the restart: the record
+                    # still replays (bytes are bytes), but decode runs
+                    # under this handler's format
+                    print(f"durability: replaying a '{rec.fmt}' record "
+                          f"through the '{self.fmt}' handler",
+                          file=sys.stderr)
+                with self._decode_lock:
+                    packed = pack.pack_spans_2d(
+                        [rec.body], [(rec.starts, rec.lens)],
+                        self.max_len)
+                    self._guarded_dispatch(
+                        packed, runs=rec.runs,
+                        ack=mgr.make_ack(rec.seq, rec.idx))
+                _metrics.inc("replayed_lines", rec.n)
+                total_lines += rec.n
+                replayed += 1
+            _events.emit(
+                "durability", "spill_replay", route=self.fmt,
+                cost=len(recs), cost_unit="records",
+                msg=f"replayed {len(recs)} spilled record(s) "
+                    f"({mgr.backlog()} pending)")
+        # every replayed batch reaches the queue before we return, so
+        # callers (boot replay, drain) can sequence against the sink
+        self._window.fence()
+        return total_lines
+
     # -- multi-chip mesh ---------------------------------------------------
     def _sharded_for(self, fmt: str):
         """Lazily build (and cache) the ShardedDecode for one format;
@@ -680,6 +734,15 @@ class BatchHandler(Handler):
         framed, sess.carry = region[:cut + 1], region[cut + 1:]
         n = framed.count(sep)
         runs = [(runs_tag, n)] if runs_tag is not None else None
+        charge = getattr(sess, "charge", None)
+        if charge is not None and n:
+            # record-aligned admission for raw (device-framed) sessions:
+            # charge the tenant exactly what the host splitter would
+            # have — one all-or-nothing admit per framed region, counted
+            # in records and bytes.  A denial sheds the framed region
+            # (the carry tail stays; its bytes are charged when framed)
+            if not charge.admit_region(n, len(framed)):
+                return
         if breaker_open:
             # breaker-open scalar oracle, same bytes (fence first so
             # older device batches keep their place)
@@ -754,6 +817,16 @@ class BatchHandler(Handler):
             else:
                 _framing.note_success(state)
                 n = packed[5]
+                charge = getattr(sess, "charge", None)
+                if (n and charge is not None
+                        and not charge.admit_region(
+                            int(n), int(packed[4][:n].sum()))):
+                    # record-aligned shed: the framed records drop as a
+                    # unit (host-splitter admission parity); the carry
+                    # tail stays with the session
+                    _tracer.end(bid)
+                    self._finish_raw_syslen(sess, region, consumed, err)
+                    return
                 if n:
                     t1 = _time.perf_counter()
                     if bid is not None:
@@ -770,6 +843,12 @@ class BatchHandler(Handler):
                 return
         t0 = _time.perf_counter()
         starts, lens, n, consumed, err = _scan_syslen_region(region)
+        charge = getattr(sess, "charge", None)
+        if charge is not None and n and not charge.admit_region(
+                int(n), int(lens.sum())):
+            # same record-aligned shed on the host-framed tier
+            self._finish_raw_syslen(sess, region, consumed, err)
+            return
         if breaker_open:
             self._window.fence()
             for s, ln in zip(starts.tolist(), lens.tolist()):
@@ -811,14 +890,15 @@ class BatchHandler(Handler):
             self._scalar_handle(raw)
 
     def _dispatch_packed(self, packed, deferred=None, runs=None,
-                         lane=None, trace=None) -> None:
+                         lane=None, trace=None, ack=None) -> None:
         """Route one packed tuple through the right decode/encode tier.
         ``deferred`` (single-element list) is set True when the batch
         was submitted to the in-flight window instead of emitted
         synchronously.  ``trace`` is the flight-recorder batch ID
-        (None when tracing is off)."""
+        (None when tracing is off).  ``ack`` is a durability replay
+        acknowledgment (see _guarded_dispatch)."""
         if self._fast_encode:
-            self._emit_fast(packed, deferred, runs, lane, trace)
+            self._emit_fast(packed, deferred, runs, lane, trace, ack)
             return
         if self.fmt == "auto":
             from .autodetect import decode_auto_packed
@@ -827,10 +907,14 @@ class BatchHandler(Handler):
             self._emit(decode_auto_packed(packed, self.max_len,
                                           self._auto_ltsv,
                                           self._auto_extras), runs)
+            if ack is not None:
+                ack()
             return
         self._window.fence()
         self._emit(_decode_packed(self.fmt, packed, self.scalar.decoder),
                    runs)
+        if ack is not None:
+            ack()
 
     def _decode_batch(self, lines: List[bytes], runs=None) -> None:
         if self._kernel_fn is None or not self._device_allowed():
@@ -893,16 +977,34 @@ class BatchHandler(Handler):
             self._breaker.record_success()
 
     def _guarded_dispatch(self, packed, runs=None, lane=None,
-                          trace=None) -> None:
+                          trace=None, ack=None) -> None:
         """Route one packed tuple to the device tier, degrading to the
         scalar oracle (same bytes, no lines lost) on any device/XLA
         error when the breaker is armed.  ``lane`` pins the dispatch
         lane (device framing already committed the batch there);
-        ``trace`` is the flight-recorder batch ID."""
+        ``trace`` is the flight-recorder batch ID.  ``ack`` is the
+        durability replay acknowledgment riding a replayed batch (never
+        set on fresh ingest); it travels with the batch to the sink and
+        fires once the bytes are flushed downstream."""
+        if (ack is None and self.durability is not None
+                and self.durability.should_spill()):
+            # queue past the watermark: divert this fresh batch to the
+            # on-disk WAL instead of blocking ingest on a full queue.
+            # The pack keeps the raw chunk plus per-row start/length
+            # vectors, so the spilled record reconstructs byte-exactly
+            # at replay.  mode=require raises (DurabilityError) when
+            # the spill tier itself cannot take the batch.
+            _batch, _lens, chunk, starts, orig_lens, n_real = packed
+            if n_real and self.durability.spill(
+                    self.fmt, chunk, starts, orig_lens, int(n_real),
+                    runs=runs):
+                _tracer.end(trace)
+                return
         deferred = [False]
         try:
             _faults.maybe_raise("device_decode")
-            self._dispatch_packed(packed, deferred, runs, lane, trace)
+            self._dispatch_packed(packed, deferred, runs, lane, trace,
+                                  ack)
         except Exception as e:  # noqa: BLE001 - device degradation boundary
             _tracer.end(trace)
             if self._breaker is None:
@@ -1162,7 +1264,7 @@ class BatchHandler(Handler):
             self.scalar.decoder if self.fmt == "ltsv" else None)
 
     def _emit_fast(self, packed, deferred=None, runs=None,
-                   lane=None, trace=None) -> None:
+                   lane=None, trace=None, ack=None) -> None:
         """Span→bytes encode for one packed tuple: the columnar block
         route when engaged (submitted onto the next dispatch lane; that
         lane's fetcher thread fetches and encodes behind us, and the
@@ -1181,7 +1283,7 @@ class BatchHandler(Handler):
                 lane = self._window.next_lane()
             if len(self._lane_devices) > 1:
                 _metrics.inc(f"lane{lane}_rows", int(packed[5]))
-            ctx = (trace, self._flush_t0)
+            ctx = (trace, self._flush_t0, ack)
             if self.fmt == "auto":
                 # the auto merger submits its per-class kernels at fetch
                 # time, on the lane's fetcher thread (default device:
@@ -1245,6 +1347,11 @@ class BatchHandler(Handler):
             # its _template_id stamped before encode
             self._emit_encoded(
                 _encode_packed_rfc5424_gelf(packed, self.encoder), runs)
+            if ack is not None:
+                # per-message route: rows were enqueued individually,
+                # so the replay ack fires on enqueue (weaker than the
+                # block route's sink-flush ack, still at-least-once)
+                ack()
             return
         if self.fmt == "auto":
             from .autodetect import decode_auto_packed
@@ -1252,16 +1359,20 @@ class BatchHandler(Handler):
             self._emit(decode_auto_packed(packed, self.max_len,
                                           self._auto_ltsv,
                                           self._auto_extras), runs)
+            if ack is not None:
+                ack()
             return
         self._emit(_decode_packed(self.fmt, packed, self.scalar.decoder),
                    runs)
+        if ack is not None:
+            ack()
 
     def _pop_emit(self, payload, lane: int = 0):
         """Fetch + encode one in-flight entry on a lane fetcher thread
         (concurrent across lanes); returns the emit closure the LaneSet
         sequencer runs in global submit order."""
         handle, packed, runs, ctx = payload
-        bid, t_flush = ctx
+        bid, t_flush, ack = ctx
         import time as _time
 
         t0 = _time.perf_counter()
@@ -1270,7 +1381,7 @@ class BatchHandler(Handler):
         try:
             _faults.maybe_raise("device_decode")
             emit = self._pop_emit_inner(handle, packed, stats, econ,
-                                        runs, bid)
+                                        runs, bid, ack)
         except Exception as e:  # noqa: BLE001 - device degradation boundary
             if self._breaker is None:
                 _tracer.end(bid)
@@ -1348,12 +1459,13 @@ class BatchHandler(Handler):
         _tracer.end(bid, e2e)
 
     def _pop_emit_inner(self, handle, packed, stats=None, econ=None,
-                        runs=None, bid=None):
+                        runs=None, bid=None, ack=None):
         """Fetch + encode one entry; returns a zero-arg emit closure
         (runs later, under the sequencer) so lanes can compute
         concurrently without reordering the merger stream.  ``bid``
         is the flight-recorder batch ID the lane-side spans (fetch/
-        encode) land on."""
+        encode) land on.  ``ack`` (durability replay) rides the emitted
+        block to the sink, or fires on enqueue for per-record emits."""
         import time as _time
 
         if econ is None:
@@ -1374,7 +1486,8 @@ class BatchHandler(Handler):
                 if bid is not None:
                     _tracer.span(bid, "encode", t0,
                                  _time.perf_counter(), note="auto-record")
-                return lambda: self._emit(results, runs)
+                return lambda: (self._emit(results, runs),
+                                ack() if ack is not None else None)
             # per-leg fetch time is folded into encode_seconds here: the
             # merger interleaves four kernels' fetches with their encodes
             t1 = _time.perf_counter()
@@ -1382,7 +1495,7 @@ class BatchHandler(Handler):
             if bid is not None:
                 _tracer.span(bid, "encode", t0, t1, rows=int(packed[5]),
                              note="auto merged fetch+encode")
-            return lambda: self._emit_block(res, packed[5])
+            return lambda: self._emit_block(res, packed[5], ack)
         ltsv_dec = self.scalar.decoder if self.fmt == "ltsv" else None
         from . import fused_routes as _fr
 
@@ -1405,7 +1518,7 @@ class BatchHandler(Handler):
                                  note="fused")
                     _tracer.span(bid, "encode", tf0 + ffetch_s, tf1,
                                  rows=int(packed[5]), note="fused")
-                return lambda: self._emit_block(fres, packed[5])
+                return lambda: self._emit_block(fres, packed[5], ack)
             # fused tier declined (compile pending, cooldown, or tier
             # fraction): fall back to the split path right here on the
             # lane fetcher thread — re-dispatch the split decode on the
@@ -1448,7 +1561,8 @@ class BatchHandler(Handler):
             if bid is not None:
                 _tracer.span(bid, "encode", t0, _time.perf_counter(),
                              note="record-path")
-            return lambda: self._emit(results, runs)
+            return lambda: (self._emit(results, runs),
+                            ack() if ack is not None else None)
         t2 = _time.perf_counter()
         _metrics.add_seconds("device_fetch_seconds", fetch_s)
         _metrics.add_seconds("encode_seconds",
@@ -1464,12 +1578,12 @@ class BatchHandler(Handler):
         if mined and mined[0] is not None:
             def emit_mined():
                 self._miners.observe_rows(mined[0], runs)
-                self._emit_block(res, packed[5])
+                self._emit_block(res, packed[5], ack)
 
             return emit_mined
-        return lambda: self._emit_block(res, packed[5])
+        return lambda: self._emit_block(res, packed[5], ack)
 
-    def _emit_block(self, res, n_real: int) -> None:
+    def _emit_block(self, res, n_real: int, ack=None) -> None:
         _metrics.inc("input_lines", n_real)
         if self._breaker is not None:
             self._breaker.observe_batch(n_real, res.fallback_rows)
@@ -1491,7 +1605,16 @@ class BatchHandler(Handler):
         if count:
             _metrics.inc("decoded_records", count)
             _metrics.inc("enqueued", count)
+            if ack is not None:
+                # the replay ack rides the block to the sink: it fires
+                # in outputs.ack_item once the bytes are flushed
+                # downstream, and only then does the WAL cursor advance
+                res.block.ack_cb = ack
             self.tx.put(res.block)
+        elif ack is not None:
+            # every row decoded to an error (nothing reaches the sink):
+            # the record is fully consumed, so acknowledge it now
+            ack()
 
     def _emit_encoded(self, results, runs=None) -> None:
         """Emit pre-encoded bytes from the span->bytes fast path."""
@@ -1681,6 +1804,12 @@ class _RawSession:
         if carry:
             if self.framing == "line" and carry.endswith(b"\r"):
                 carry = carry[:-1]
+            charge = getattr(self, "charge", None)
+            if charge is not None and not charge.admit_region(
+                    1, len(carry)):
+                # EOF partial frame charges like the host splitter's
+                # handle_bytes(raw): one record, its bytes
+                return
             h.handle_bytes(carry)
 
 

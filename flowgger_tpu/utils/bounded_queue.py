@@ -84,6 +84,12 @@ class PolicyQueue(queue.Queue):
         ``queue_shed_during_drain`` (see module docstring)."""
         self.draining = True
 
+    def fill_fraction(self) -> float:
+        """Queue occupancy in [0, 1] — the durability tier's watermark
+        signal (durability/manager.py should_spill).  Unbounded queues
+        report 0.0: no backpressure means nothing to spill for."""
+        return self.qsize() / self.maxsize if self.maxsize > 0 else 0.0
+
     def _count_drop(self) -> None:
         from ..obs import events as _events
 

@@ -50,6 +50,17 @@ Sites wired in (each names the exception type it surfaces):
   route-throughput collapse with no byte-level change, the drill the
   regression sentinel (obs/sentinel.py) must flag as
   ``perf_regression`` within its window.
+- ``spill_io``       — the durability tier's segment append
+  (durability/segments.py) writes a deliberately TORN record fragment
+  and then raises ``OSError``: with ``durability.mode = "spill"`` the
+  batch declines to shed (it continues down the normal lossy dispatch
+  path), with ``mode = "require"`` the append failure is a hard
+  ``DurabilityError`` — and the next boot's segment scan must recover
+  the valid prefix ahead of the torn tail;
+- ``sink_ack_loss``  — a sink's durability acknowledgment never
+  arrives (``outputs.ack_item`` suppresses the callback): the WAL
+  replay cursor pins, ``replay_cursor_lag`` stays nonzero, and the
+  stall watchdog journals ``replay_stall`` — the stuck-replay drill.
 
 Runtime arming: beyond the boot-time plan below, ``set_site`` merges
 one site into the active plan while the process runs — the fleet
@@ -74,7 +85,7 @@ ENV_VAR = "FLOWGGER_FAULTS"
 KNOWN_SITES = ("device_decode", "input_socket", "sink_write",
                "queue_pressure", "tenant_flood", "peer_partition",
                "host_kill", "coordinator_kill", "roster_corrupt",
-               "route_throttle")
+               "route_throttle", "spill_io", "sink_ack_loss")
 
 
 class InjectedFault(Exception):

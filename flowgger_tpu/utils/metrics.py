@@ -161,6 +161,14 @@ _COUNTERS = (
     # degradation journal (obs/events.py): aggregate event count; the
     # per-reason family is events_{reason}
     "degradation_events",
+    # zero-loss ingestion (durability/): WAL spill/replay traffic,
+    # unreadable segment/cursor loads (each one degrades — recovered
+    # prefix, widened at-least-once window — never a crash), failed
+    # fsynced appends, sink acks fired/contained, and output drain
+    # barriers that expired before the queue fully drained
+    "spill_records", "replayed_lines", "spill_load_errors",
+    "spill_io_errors", "sink_acks", "sink_ack_errors",
+    "drain_barrier_timeouts",
 )
 
 # cumulative per-stage wall-clock accumulators (add_seconds)
@@ -176,6 +184,10 @@ _GAUGE_NAMES = (
     "device_breaker_state", "inflight_depth", "lane_depth",
     "distinct_compiled_shapes", "framing_carry_bytes",
     "tenant_templates_distinct", "fleet_rendezvous_rank",
+    # durability tier backlog (durability/manager.py): on-disk WAL
+    # bytes/segments and the spilled-but-unacked record count the
+    # replay-stall watchdog and fleetctl's spill line key on
+    "spill_bytes", "spill_segments", "replay_cursor_lag",
 )
 
 # sliding-window histogram family (observe)

@@ -561,6 +561,280 @@ DRILLS = {
 }
 
 
+# ----------------------------------------------- durability (WAL) drills
+#
+# Socket-free, single-host, three-phase crash drill for the zero-loss
+# ingestion tier (ISSUE 16): SIGKILL a worker mid-spill, SIGKILL its
+# successor mid-replay, then let a third worker finish — and assert
+# byte-exact no-loss: every line the WAL durably owned at the first
+# kill appears in the final sink output at least once, nothing foreign
+# appears, and the at-least-once window duplicates each line at most
+# once (one crash mid-flight = one possible redelivery).
+#
+#   python tools/chaos.py --durability [--kill-records 25] [--json]
+
+DUR_CHUNK_LINES = 8          # lines per spilled record
+DUR_REPLAY_PAUSE_MS = 120    # phase-B pacing so the kill lands mid-replay
+
+
+def _dur_line(i: int) -> bytes:
+    """Deterministic rfc5424 line ``i`` — PassthroughEncoder + LineMerger
+    make the sink output byte-identical to this input."""
+    return (f"<{(3 * i) % 192}>1 2023-09-20T12:35:45.{i % 1000:03d}Z "
+            f"durhost app{i % 5} {i % 1000} MSGID "
+            f'[ex@32473 k="{i}"] durability line {i}').encode()
+
+
+def _wal_lines(spill_dir: str) -> list:
+    """Every line the WAL durably owns right now (clean-prefix scan:
+    a torn tail record was never durable, so it is not owed)."""
+    if not os.path.isdir(spill_dir):
+        return []
+    sys.path.insert(0, _REPO)
+    from flowgger_tpu.durability import list_segments, read_segment
+
+    lines = []
+    for _seq, path in list_segments(spill_dir):
+        records, _clean = read_segment(path)
+        for hdr, body in records:
+            for s, ln in zip(hdr["starts"], hdr["lens"]):
+                lines.append(bytes(body[s:s + ln]))
+    return lines
+
+
+def durability_worker_main(args) -> int:
+    """One durability drill worker: ``--phase spill`` streams lines
+    into the WAL forever (the harness SIGKILLs it); ``--phase replay``
+    replays the WAL through a real FileOutput sink, optionally paced
+    (``--replay-pause-ms``) so the harness can SIGKILL it mid-replay."""
+    sys.path.insert(0, _REPO)
+    from flowgger_tpu.config import Config
+    from flowgger_tpu.decoders.rfc5424 import RFC5424Decoder
+    from flowgger_tpu.durability.manager import DurabilityManager
+    from flowgger_tpu.encoders.passthrough import PassthroughEncoder
+    from flowgger_tpu.mergers import LineMerger
+    from flowgger_tpu.tpu.batch import BatchHandler
+
+    cfg = Config.from_string("")
+
+    def make_handler(tx, mgr, merger):
+        h = BatchHandler(tx, RFC5424Decoder(cfg), PassthroughEncoder(cfg),
+                         cfg, fmt="rfc5424", start_timer=False,
+                         merger=merger)
+        h.ingest_sep = b"\n"
+        h.ingest_strip_cr = True
+        h.durability = mgr
+        return h
+
+    if args.phase == "spill":
+        mgr = DurabilityManager("spill", args.spill_dir,
+                                start_watchdog=False)
+
+        class FullQueue:
+            """Pinned past the watermark: every batch must spill."""
+
+            @staticmethod
+            def put(item):
+                raise AssertionError("a batch leaked past the spill tier")
+
+            @staticmethod
+            def fill_fraction():
+                return 1.0
+
+        tx = FullQueue()
+        mgr.attach_queue(tx)
+        h = make_handler(tx, mgr, LineMerger(cfg))
+        i = 0
+        while True:  # the harness SIGKILLs us mid-spill
+            region = b"".join(_dur_line(i + j) + b"\n"
+                              for j in range(DUR_CHUNK_LINES))
+            h.ingest_chunk(region)
+            h.flush()
+            i += DUR_CHUNK_LINES
+
+    # -- phase == "replay" -------------------------------------------------
+    from flowgger_tpu.obs.events import journal
+    from flowgger_tpu.outputs import SHUTDOWN
+    from flowgger_tpu.outputs.file_output import FileOutput
+    from flowgger_tpu.utils.bounded_queue import PolicyQueue
+
+    out_cfg = Config.from_string(
+        f'[output]\nfile_path = "{args.out}"\n')
+    merger = LineMerger(cfg)
+    tx = PolicyQueue(maxsize=10_000)
+    output = FileOutput(out_cfg)
+    thread = output.start(tx, merger)
+    mgr = DurabilityManager("spill", args.spill_dir, start_watchdog=False)
+    mgr.attach_queue(tx)
+    h = make_handler(tx, mgr, merger)
+    total = 0
+    while mgr.backlog():
+        total += h.replay_spilled(limit=1)
+        if args.replay_pause_ms:
+            time.sleep(args.replay_pause_ms / 1000.0)
+    # replay enqueued everything; now wait for the sink acks to settle
+    # the persisted cursor (outputs ack after the flushed write)
+    deadline = time.monotonic() + 30
+    while mgr.unacked() and time.monotonic() < deadline:
+        time.sleep(0.02)
+    tx.put(SHUTDOWN)
+    thread.join(timeout=20)
+    mgr.stop()
+    print(json.dumps({
+        "phase": "replay", "replayed_lines": total,
+        "unacked": mgr.unacked(),
+        "replay_complete": journal.counts().get("replay_complete", 0),
+        "stats": mgr.backlog_stats()}), flush=True)
+    return 0 if mgr.unacked() == 0 else 1
+
+
+def durability_main(args) -> int:
+    """Three-phase kill-mid-spill / kill-mid-replay acceptance drill."""
+    workdir = args.dir or tempfile.mkdtemp(prefix="flowgger_dur_")
+    os.makedirs(workdir, exist_ok=True)
+    spill_dir = os.path.join(workdir, "wal")
+    out_path = os.path.join(workdir, "sink.out")
+    report = {"metric": "durability_chaos", "ok": False, "phases": []}
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    t_run = time.monotonic()
+
+    def log(msg):
+        if not args.json or args.verbose:
+            print(f"chaos-durability: {msg}", file=sys.stderr, flush=True)
+
+    def spawn(phase, pause_ms=0, tag=""):
+        logf = open(os.path.join(workdir, f"log_{phase}{tag}.txt"), "ab")
+        return subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__),
+             "--durability-worker", "--phase", phase,
+             "--spill-dir", spill_dir, "--out", out_path,
+             "--replay-pause-ms", str(pause_ms)],
+            env=env, cwd=_REPO, stdout=subprocess.PIPE, stderr=logf)
+
+    def out_lines():
+        if not os.path.exists(out_path):
+            return []
+        with open(out_path, "rb") as fd:
+            return [ln for ln in fd.read().split(b"\n") if ln]
+
+    proc = None
+    try:
+        # phase A: spill under a pinned-full queue, SIGKILL mid-spill
+        proc = spawn("spill")
+        deadline = time.monotonic() + args.window
+        while time.monotonic() < deadline:
+            if proc.poll() is not None:
+                raise ChaosError(
+                    f"spill worker exited early (rc={proc.returncode})")
+            if len(_wal_lines(spill_dir)) >= args.kill_records \
+                    * DUR_CHUNK_LINES:
+                break
+            time.sleep(0.02)
+        else:
+            raise ChaosError("spill worker never reached the kill point")
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.wait()
+        expected = _wal_lines(spill_dir)
+        if len(expected) < DUR_CHUNK_LINES:
+            raise ChaosError("WAL owned almost nothing at the kill")
+        log(f"phase A: SIGKILL mid-spill; WAL owns {len(expected)} "
+            f"line(s) across {len(os.listdir(spill_dir))} file(s)")
+        report["phases"].append({"phase": "kill_mid_spill",
+                                 "wal_lines": len(expected)})
+
+        # phase B: paced replay through a real FileOutput, SIGKILL
+        # once output proves the replay is mid-flight
+        proc = spawn("replay", pause_ms=DUR_REPLAY_PAUSE_MS, tag="_b")
+        deadline = time.monotonic() + args.window
+        while time.monotonic() < deadline:
+            if proc.poll() is not None:
+                raise ChaosError(
+                    "replay worker finished before the mid-replay kill "
+                    f"(rc={proc.returncode}) — pacing too fast")
+            n = len(out_lines())
+            if 0 < n < len(expected):
+                break
+            time.sleep(0.01)
+        else:
+            raise ChaosError("replay worker never emitted mid-replay")
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.wait()
+        mid = len(out_lines())
+        log(f"phase B: SIGKILL mid-replay after {mid} line(s) reached "
+            "the sink")
+        report["phases"].append({"phase": "kill_mid_replay",
+                                 "lines_at_kill": mid})
+
+        # phase C: a fresh worker finishes the replay and drains clean
+        proc = spawn("replay", pause_ms=0, tag="_c")
+        try:
+            stdout, _ = proc.communicate(timeout=args.window)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            raise ChaosError("phase C replay never finished")
+        if proc.returncode != 0:
+            raise ChaosError(
+                f"phase C exited {proc.returncode} (cursor not settled)")
+        doc = json.loads(stdout.splitlines()[-1])
+        if not doc.get("replay_complete"):
+            raise ChaosError("phase C never journaled replay_complete")
+        if _wal_lines(spill_dir):
+            raise ChaosError("fully-acked WAL still holds records")
+        report["phases"].append({"phase": "replay_to_completion",
+                                 **{k: doc[k] for k in
+                                    ("replayed_lines", "replay_complete")}})
+
+        # byte-exact no-loss: every owed line >= 1x, nothing foreign,
+        # each line duplicated at most once (one crash window)
+        final = out_lines()
+        counts: dict = {}
+        for ln in final:
+            counts[ln] = counts.get(ln, 0) + 1
+        owed = set(expected)
+        missing = [ln for ln in owed if ln not in counts]
+        foreign = [ln for ln in counts if ln not in owed]
+        over = {ln: c for ln, c in counts.items() if c > 2}
+        if missing:
+            raise ChaosError(
+                f"LOST {len(missing)} line(s), e.g. {missing[0]!r}")
+        if foreign:
+            raise ChaosError(
+                f"{len(foreign)} foreign line(s) in the sink, "
+                f"e.g. {foreign[0]!r}")
+        if over:
+            ln, c = next(iter(over.items()))
+            raise ChaosError(
+                f"{len(over)} line(s) delivered >2x (e.g. {c}x {ln!r}) "
+                "— dispatch-once-per-process is broken")
+        dups = sum(c - 1 for c in counts.values())
+        log(f"no-loss held: {len(owed)} owed, {len(final)} delivered, "
+            f"{dups} duplicate(s) inside the at-least-once window")
+        report.update(ok=True, owed_lines=len(owed),
+                      delivered_lines=len(final), duplicates=dups)
+    except ChaosError as e:
+        report["error"] = str(e)
+        print(f"chaos-durability: FAILED: {e}", file=sys.stderr)
+    except Exception as e:  # harness bug: report it, don't hang CI
+        import traceback
+
+        traceback.print_exc()
+        report["error"] = f"harness error: {e!r}"
+    finally:
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+    report["wall_s"] = round(time.monotonic() - t_run, 1)
+    if not args.keep_dir and report["ok"]:
+        import shutil
+
+        shutil.rmtree(workdir, ignore_errors=True)
+    else:
+        report["dir"] = workdir
+    print(json.dumps(report))
+    return 0 if report["ok"] else 1
+
+
 def harness_main(args) -> int:
     sites = [s.strip() for s in args.sites.split(",") if s.strip()]
     unknown = [s for s in sites if s not in DRILLS]
@@ -644,6 +918,18 @@ def main(argv=None) -> int:
                                  formatter_class=argparse.RawDescriptionHelpFormatter)
     ap.add_argument("--worker", action="store_true",
                     help="internal: run one fleet host")
+    ap.add_argument("--durability", action="store_true",
+                    help="run the kill-mid-spill / kill-mid-replay WAL "
+                         "drill instead of the fleet drills")
+    ap.add_argument("--durability-worker", action="store_true",
+                    help="internal: run one durability drill worker")
+    ap.add_argument("--phase", default="spill",
+                    choices=("spill", "replay"))
+    ap.add_argument("--spill-dir", default="wal")
+    ap.add_argument("--replay-pause-ms", type=int, default=0)
+    ap.add_argument("--kill-records", type=int, default=25,
+                    help="spilled records on disk before the phase-A "
+                         "SIGKILL")
     ap.add_argument("--rank", type=int, default=0)
     ap.add_argument("--hosts", type=int, default=3)
     ap.add_argument("--port", type=int, default=0)
@@ -665,6 +951,10 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
     if args.worker:
         return worker_main(args)
+    if args.durability_worker:
+        return durability_worker_main(args)
+    if args.durability:
+        return durability_main(args)
     return harness_main(args)
 
 

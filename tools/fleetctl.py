@@ -108,6 +108,17 @@ def cmd_status(addr: str, as_json: bool) -> int:
     shown = {k: metrics[k] for k in keys if k in metrics}
     if shown:
         print("metrics: " + "  ".join(f"{k}={v}" for k, v in shown.items()))
+    spill_keys = ("spill_segments", "spill_bytes", "replay_cursor_lag",
+                  "replayed_lines", "spill_records")
+    spill = {k: metrics.get(k, 0) for k in spill_keys}
+    if any(spill.values()):
+        # WAL spill backlog: nonzero segments/lag means this host is
+        # running behind its sink and owes a replay before it is "done"
+        print(f"spill: {spill['spill_segments']:.0f} segment(s) "
+              f"{spill['spill_bytes'] / 1e6:.1f} MB on disk, "
+              f"cursor lag {spill['replay_cursor_lag']:.0f} record(s) "
+              f"(spilled={spill['spill_records']:.0f} "
+              f"replayed={spill['replayed_lines']:.0f} lines)")
     return 0 if status == 200 else 3
 
 
