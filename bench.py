@@ -1138,6 +1138,169 @@ def bench_durability(extra, lines):
     return ok
 
 
+def bench_control(extra, lines):
+    """Control-plane smoke gates (closing-the-loop PR):
+
+    1. Disarmed guard cost: the per-chunk admission delta between a
+       controller-touched tenant state (armed-idle: a ControlPlane
+       exists, ``set_rate_factor`` was exercised, factor back at 1.0)
+       and a never-governed state must stay under 1% of the measured
+       per-chunk e2e cost.  The admit hot path reads nothing from the
+       controller — the factor lands by re-rating the buckets in
+       place — so this delta is the entire hot-path price of the
+       feedback layer.
+    2. Disarmed structure: a default (no ``[control]``) pipeline builds
+       no plane, no ticker thread, no proxy thread.
+    3. Reaction time: with real short SLO windows (fast 0.4s / slow
+       1.2s), a sustained tenant flood must drive the AIMD loop to a
+       tightened rate factor within 5 s of the first shed — the
+       closed-loop latency an operator would actually see, measured
+       through the real SloEngine -> burn_states -> tick path.
+    """
+    import threading as _threading
+
+    from flowgger_tpu.config import Config
+    from flowgger_tpu.control import ControlPlane, ControlSpec
+    from flowgger_tpu.obs import events as obs_events
+    from flowgger_tpu.obs.slo import Objective, SloEngine
+    from flowgger_tpu.tenancy.admission import AdmissionHandler
+    from flowgger_tpu.tenancy.registry import TenantRegistry
+
+    region = b"".join(ln + b"\n" for ln in lines)
+    chunk_size = 8192
+    chunks = [region[i:i + chunk_size]
+              for i in range(0, len(region), chunk_size)]
+    lines_per_chunk = max(1, len(lines) / len(chunks))
+
+    class _NoopIngest:
+        quiet_empty = False
+        bare_errors = False
+        ingest_sep = b"\n"
+        ingest_strip_cr = True
+
+        def ingest_chunk(self, chunk):
+            pass
+
+        def flush(self):
+            pass
+
+    # rate high enough that the flood never trips the buckets: both
+    # runs stay on the admit-success path, so the delta isolates the
+    # control layer's attribute cost, not denial-path work
+    reg = TenantRegistry.from_config(Config.from_string(
+        "[tenants.plain]\nrate = 1000000000\n"
+        "[tenants.armed]\nrate = 1000000000\n"))
+    plain = AdmissionHandler(_NoopIngest(), reg.state("plain"))
+    plane = ControlPlane(ControlSpec(admission=True, interval_s=0),
+                         tenants=reg, burn_source=lambda: [])
+    armed_state = reg.state("armed")
+    armed_state.set_rate_factor(0.5)   # exercise the re-rate path...
+    armed_state.set_rate_factor(1.0)   # ...then idle at the ceiling
+    armed = AdmissionHandler(_NoopIngest(), armed_state)
+    repeats = 20
+    best_plain = best_armed = None
+    for _ in range(5):
+        t0 = time.perf_counter()
+        for _ in range(repeats):
+            for c in chunks:
+                plain.ingest_chunk(c)
+        t_plain = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for _ in range(repeats):
+            for c in chunks:
+                armed.ingest_chunk(c)
+        t_armed = time.perf_counter() - t0
+        best_plain = t_plain if best_plain is None else min(best_plain,
+                                                            t_plain)
+        best_armed = t_armed if best_armed is None else min(best_armed,
+                                                            t_armed)
+    n_calls = repeats * len(chunks)
+    guard_s = max(0.0, (best_armed - best_plain) / n_calls)
+    e2e_rate = extra.get("e2e_overlap_lines_per_sec", 0) or 1
+    e2e_s_per_chunk = lines_per_chunk / e2e_rate
+    overhead_ratio = guard_s / e2e_s_per_chunk
+    guard_ok = overhead_ratio < 0.01
+
+    # disarmed structure: default config builds no control plane and
+    # starts no control/proxy threads
+    from flowgger_tpu.pipeline import Pipeline
+
+    before = {t.name for t in _threading.enumerate()}
+    p = Pipeline(Config.from_string(
+        '[input]\ntype = "stdin"\n[output]\ntype = "debug"\n'))
+    new_threads = {t.name for t in _threading.enumerate()} - before
+    disarmed_clean = (p.control is None and not any(
+        n.startswith(("control-plane", "steer-")) for n in new_threads))
+
+    # flood-to-tighten reaction time on real short windows
+    obs_events.journal.reset()
+    obs_events.journal.configure()
+    reg2 = TenantRegistry.from_config(Config.from_string(
+        "[tenants.noisy]\nrate = 2000\n"))
+    eng = SloEngine()
+    eng.configure([Objective(
+        name="noisy_sheds", kind="events", metric="events_tenant_shed",
+        max_per_sec=10.0, tenant="noisy",
+        fast_window_s=0.4, slow_window_s=1.2)], interval_s=0)
+    plane2 = ControlPlane(ControlSpec(admission=True, interval_s=0),
+                          tenants=reg2, burn_source=eng.burn_states)
+    noisy = reg2.state("noisy")
+    stop_flood = _threading.Event()
+
+    def flood():
+        while not stop_flood.is_set():
+            noisy.admit(64, 4096)   # far over rate: sustained sheds
+            time.sleep(0.002)
+
+    flooder = _threading.Thread(target=flood, daemon=True)
+    t_flood = time.perf_counter()
+    flooder.start()
+    reaction_s = None
+    deadline = t_flood + 10.0
+    while time.perf_counter() < deadline:
+        eng.tick()
+        plane2.tick()
+        if noisy.rate_factor < 1.0:
+            reaction_s = time.perf_counter() - t_flood
+            break
+        time.sleep(0.1)
+    stop_flood.set()
+    flooder.join(timeout=2)
+    eng.stop()
+    tightened = reaction_s is not None
+    reaction_ok = tightened and reaction_s < 5.0
+    tighten_events = sum(
+        1 for e in obs_events.journal.snapshot()
+        if e["reason"] == "admission_tighten")
+    obs_events.journal.reset()
+    obs_events.journal.configure()
+
+    ok = guard_ok and disarmed_clean and reaction_ok
+    extra.update({
+        "control_guard_ns_per_chunk": round(guard_s * 1e9),
+        "control_guard_overhead_ratio": round(overhead_ratio, 6),
+        "control_disarmed_clean": disarmed_clean,
+        "control_reaction_s": (round(reaction_s, 3)
+                               if tightened else None),
+        "control_tighten_events": tighten_events,
+        "control_ok": ok,
+    })
+    print(json.dumps({
+        "metric": "control_smoke",
+        "guard_ns_per_chunk": round(guard_s * 1e9),
+        "guard_overhead_ratio": round(overhead_ratio, 6),
+        "guard_gate": "< 0.01 of per-chunk e2e cost",
+        "guard_ok": guard_ok,
+        "disarmed_clean": disarmed_clean,
+        "reaction_s": round(reaction_s, 3) if tightened else None,
+        "reaction_gate": "flood tightens the tenant factor in < 5 s",
+        "reaction_ok": reaction_ok,
+        "tighten_events": tighten_events,
+        "ok": ok,
+    }))
+    return ok
+
+
 def bench_fused_routes(extra, smoke):
     """Fused decode→encode route matrix (tpu/fused_routes.py): per
     route, emit the fused tier's fetched-vs-emitted bytes/row, the
@@ -2088,6 +2251,10 @@ def smoke_main():
     # per-chunk e2e cost + spill→replay byte identity with a drained
     # cursor and an empty WAL after sink acks
     durability_ok = bench_durability(extra, lines)
+    # control plane: disarmed guard cost < 1% of per-chunk e2e,
+    # disarmed structure (no plane, no ticker/proxy threads), and the
+    # flood-to-tighten closed-loop reaction time on real short windows
+    control_ok = bench_control(extra, lines)
     # jsonl/dns block routes: byte identity vs the scalar pipeline +
     # block throughput >= scalar (runs BEFORE the fused section, whose
     # declined background compiles would chew the cores under it)
@@ -2125,8 +2292,8 @@ def smoke_main():
         "multilane_vs_single_lane": round(multilane / max(overlap, 1), 2),
         "wall_seconds": round(wall, 1),
         "ok": bool(ok and lanes_ok and tenancy_ok and obs_ok
-                   and durability_ok and newfmt_ok and framing_ok
-                   and fused_ok and aot_ok and fleet_ok
+                   and durability_ok and control_ok and newfmt_ok
+                   and framing_ok and fused_ok and aot_ok and fleet_ok
                    and wall < budget),
     }))
     if not framing_ok:
@@ -2181,6 +2348,13 @@ def smoke_main():
               "bytes diverged from the straight run, or the WAL did "
               "not drain on sink acks — see the durability_smoke JSON "
               "line)", file=sys.stderr)
+        sys.exit(1)
+    if not control_ok:
+        print("SMOKE FAIL: control gates missed (disarmed guard cost "
+              "above 1% of per-chunk e2e, control-plane residue on a "
+              "default pipeline, or the flood-to-tighten reaction "
+              "exceeded its bound — see the control_smoke JSON line)",
+              file=sys.stderr)
         sys.exit(1)
     if not ok:
         print("SMOKE FAIL: overlap executor slower than the serial path",

@@ -94,6 +94,19 @@ echo "== zero-loss ingestion: WAL spill chaos drill (kill mid-spill) =="
 JAX_PLATFORMS=cpu timeout 600 python -m pytest tests/test_durability.py -q -m "slow"
 timeout 300 python tools/chaos.py --durability --json
 
+echo "== control loop: burn-driven admission, share feedback, autoscale =="
+# (1) the unit suite: AIMD hysteresis/clamps (fake clock), in-place
+# bucket re-rating, frozen-at-last-applied (stop + control_freeze),
+# weight emitter renders/runtime pushes, steering-proxy byte identity
+# per framing, /fleetz control section, and the disarmed-inertness
+# contract (no [control] table -> no threads, no hot-path cost);
+# (2) the closed-loop drills: a flooding tenant burn-tightened within
+# the reaction bound while a calm tenant stays byte-identical with a
+# green SLO, and a degrading host's advertised share decaying at its
+# peers BEFORE its decode breaker trips.  measured ~8s total
+JAX_PLATFORMS=cpu timeout 600 python -m pytest tests/test_control.py -q -m "not faults"
+timeout 300 python tools/chaos.py --control --json
+
 echo "== multi-tenant serving suite (admission, fair queue, templates) =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_tenancy.py -q -m "not faults"
 

@@ -1,0 +1,46 @@
+"""Feedback control: the loop-closing layer over the observability
+plane.
+
+PR 14-16 made the fleet *observable* — capacity-weighted shares,
+per-tenant/per-route SLO burn rates, spill backlog — but nothing
+*acted* on those signals: shares were advisory LB hints and a burning
+SLO only journaled while the flooder kept flooding.  This package
+turns the signals into enforcement, three loops, each individually
+gated under the ``[control]`` config table and **off by default**:
+
+1. **Burn-driven admission** (:mod:`.aimd`, :mod:`.plane`): sustained
+   per-tenant ``slo_burn`` multiplicatively tightens that tenant's
+   token-bucket rates at the existing admission layer; recovery is
+   additive once the burn clears (AIMD, the TCP congestion-control
+   shape).  A misbehaving tenant is throttled at its own bucket before
+   the weighted-fair queue has to shed fleet-wide.
+2. **Share feedback**: sustained host-level burn (or breaker-open /
+   spill-backlog pressure) decays the host's advertised
+   ``tpu_fleet_capacity`` weight, so a degrading host gives up traffic
+   *before* it trips breakers.  The decayed weight rides the existing
+   heartbeat doc, so every peer's ``fleet.shares`` reflects it with no
+   added protocol — and the shares become *enforced* through the
+   weight emitter (:mod:`.emitter`: haproxy runtime-API / nginx
+   upstream renders) or the built-in steering proxy
+   (``fleet/proxy.py``) for deployments with no external LB.
+3. **Autoscale signal**: a desired-routable-host count derived from
+   fleet burn + queue headroom + spill backlog, exported as the
+   ``fleet_desired_hosts`` gauge and the ``/fleetz`` ``control``
+   section for compose/k8s layers to consume.
+
+Failure philosophy: **frozen-at-last-applied**.  A dead controller
+(crash, ``control_freeze`` drill, plain ``stop()``) leaves tightened
+rates and a decayed capacity weight exactly where the last live tick
+put them — never reset-to-open, because a controller that fails open
+un-throttles a flood at the worst possible moment.  Recovery resumes
+when ticks resume.
+
+With no ``[control]`` table the package is inert by construction:
+``ControlPlane.from_config`` returns ``None``, the pipeline keeps its
+pre-control objects, zero threads start, and the admission hot path is
+byte-for-byte the PR 13 code path.
+"""
+
+from .aimd import AimdLimiter                      # noqa: F401
+from .plane import ControlPlane, desired_hosts     # noqa: F401
+from .spec import ControlSpec, control_spec        # noqa: F401

@@ -1,0 +1,87 @@
+"""AIMD rate governor: the pure, clockless unit under the admission
+and share-feedback loops.
+
+The limiter tracks one scalar ``factor`` in ``[floor, 1.0]`` — the
+fraction of the *configured* rate (or capacity weight) currently
+applied.  Each controller tick feeds it the same two-window burn
+signals the SLO engine computes, and the decision rule deliberately
+mirrors the engine's hysteresis (obs/slo.py):
+
+- **tighten** (multiplicative, ``factor *= backoff``) only when BOTH
+  the fast and the slow window are at/over the burn threshold — the
+  fast window confirms the problem is current, the slow window that it
+  is significant, so a single-window blip can never oscillate the
+  factor;
+- **relax** (additive, ``factor += recover_step``) only when the fast
+  window is clear AND the factor is below 1.0 — the same fast-window
+  condition that flips the engine's ``burning`` flag off;
+- anything else **holds** (notably fast-hot/slow-cold: neither rule
+  fires, the factor sits still).
+
+Tightening clamps at ``floor`` (a governed tenant keeps a trickle —
+admission must stay distinguishable from a blackhole) and relaxing
+clamps at 1.0 (the configured rate is the ceiling; the controller only
+ever *removes* headroom, never grants more than the operator did).
+
+The unit is step-based and owns no clock or thread: determinism under
+a fake clock is the caller's trivially-held property, and the tests
+drive it as a value → value function.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+DEFAULT_BACKOFF = 0.5       # multiplicative tighten per burning tick
+DEFAULT_RECOVER_STEP = 0.1  # additive recovery per clear tick
+DEFAULT_FLOOR = 0.1         # tighten clamp (fraction of configured)
+
+TIGHTEN = "tighten"
+RELAX = "relax"
+
+
+class AimdLimiter:
+    """One governed scalar: multiplicative decrease, additive
+    increase, both-windows hysteresis."""
+
+    def __init__(self, backoff: float = DEFAULT_BACKOFF,
+                 recover_step: float = DEFAULT_RECOVER_STEP,
+                 floor: float = DEFAULT_FLOOR):
+        if not 0.0 < backoff < 1.0:
+            raise ValueError("backoff must be in (0, 1) "
+                             "(multiplicative decrease)")
+        if recover_step <= 0.0:
+            raise ValueError("recover_step must be > 0 "
+                             "(additive increase)")
+        if not 0.0 < floor <= 1.0:
+            raise ValueError("floor must be in (0, 1]")
+        self.backoff = float(backoff)
+        self.recover_step = float(recover_step)
+        self.floor = float(floor)
+        self.factor = 1.0
+
+    def update(self, fast_burn: float, slow_burn: float,
+               threshold: float = 1.0) -> Optional[str]:
+        """One tick from raw window burns: applies the both-windows
+        rule above and returns ``"tighten"``/``"relax"`` when the
+        factor moved, None on hold (including hold-at-floor and
+        hold-at-ceiling — a clamped no-move emits no action, so a
+        pinned limiter does not journal every tick)."""
+        tighten = fast_burn >= threshold and slow_burn >= threshold
+        relax = fast_burn < threshold
+        return self.step(tighten, relax)
+
+    def step(self, tighten: bool, relax: bool) -> Optional[str]:
+        """The decision half, pre-digested signals (the control plane
+        combines several objectives into one tighten/relax pair before
+        stepping).  ``tighten`` wins when both are set."""
+        if tighten:
+            new = max(self.floor, self.factor * self.backoff)
+            if new < self.factor:
+                self.factor = new
+                return TIGHTEN
+            return None
+        if relax and self.factor < 1.0:
+            self.factor = min(1.0, self.factor + self.recover_step)
+            return RELAX
+        return None

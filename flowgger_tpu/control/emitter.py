@@ -1,0 +1,192 @@
+"""Weight emitter: renders ``fleet.shares`` into load-balancer
+configuration, turning advisory shares into enforced routing.
+
+Until this module the shares were hand-templated into
+``examples/lb-healthz.conf`` and went stale the moment a host joined,
+drained, or decayed its capacity.  The emitter closes that gap two
+ways, both driven from the control plane's tick off the live roster:
+
+- **file render** (``control.weights_path``): the current weights are
+  rendered (haproxy ``server`` stanzas or an nginx ``upstream`` block)
+  and atomically rewritten (tmp + rename, the roster-journal idiom)
+  whenever they change.  Pair with the LB's config-reload hook, or
+  pull one-shot renders from a bastion with
+  ``tools/fleetctl.py weights <host> --render haproxy|nginx``.
+- **haproxy runtime API** (``control.haproxy_socket``): ``set weight
+  <backend>/r<rank> <w>`` commands are pushed over the stats socket on
+  every change — live rebalancing with no reload at all.
+
+Weight mapping: a routable (joining/active — the healthz-200 set)
+host's share is scaled to an integer weight in [1, 256] (haproxy's
+native range; nginx treats it as a plain ratio).  Non-routable hosts
+render at weight 0 (haproxy: the slot stays addressable for runtime
+updates) or ``down`` (nginx) so the 200/503 routability contract and
+the rendered config never disagree.
+
+Failures are contained: an unwritable path or a dead socket counts
+``control_emit_errors``-adjacent stderr noise but never raises into
+the control tick — the LB keeps its last applied weights, the same
+frozen-at-last-applied philosophy the controller itself follows.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import sys
+import tempfile
+from typing import Dict, List, Optional
+
+ROUTABLE_STATES = ("joining", "active")
+MAX_WEIGHT = 256
+
+
+def scaled_weights(roster: List[dict]) -> Dict[int, int]:
+    """rank -> integer LB weight.  Routable hosts get their share
+    scaled into [1, 256]; everyone else gets 0."""
+    routable = [p for p in roster if p.get("state") in ROUTABLE_STATES]
+    top = max((float(p.get("share", 0.0)) for p in routable),
+              default=0.0)
+    out: Dict[int, int] = {}
+    for p in roster:
+        rank = int(p["rank"])
+        if p.get("state") not in ROUTABLE_STATES or top <= 0:
+            out[rank] = 0
+            continue
+        share = float(p.get("share", 0.0))
+        out[rank] = max(1, min(MAX_WEIGHT,
+                               round(share / top * MAX_WEIGHT)))
+    return out
+
+
+def ingest_addr(fleet_addr: str, ingest_port: int) -> str:
+    """Map a peer's fleet (health) address to its ingest listener —
+    same host, the configured ingest port.  With ``ingest_port = 0``
+    the fleet address is used as-is (tests that point the roster
+    straight at listeners)."""
+    host = fleet_addr.rsplit(":", 1)[0] if ":" in fleet_addr else fleet_addr
+    return f"{host}:{ingest_port}" if ingest_port > 0 else fleet_addr
+
+
+def render_haproxy(roster: List[dict], backend: str = "flowgger",
+                   ingest_port: int = 0) -> str:
+    """haproxy ``server`` stanzas (drop into the backend, or reload a
+    mapped file).  Weight 0 keeps a non-routable host's slot present
+    so runtime-API pushes address a stable name set."""
+    weights = scaled_weights(roster)
+    lines = [f"# backend {backend} — rendered from fleet.shares; do "
+             "not hand-edit"]
+    for p in sorted(roster, key=lambda p: int(p["rank"])):
+        rank = int(p["rank"])
+        addr = ingest_addr(str(p["addr"]), ingest_port)
+        lines.append(f"server r{rank} {addr} weight {weights[rank]} "
+                     f"check  # state={p.get('state')}")
+    return "\n".join(lines) + "\n"
+
+
+def render_nginx(roster: List[dict], backend: str = "flowgger",
+                 ingest_port: int = 0) -> str:
+    """An nginx ``upstream`` block (stream or http context)."""
+    weights = scaled_weights(roster)
+    lines = [f"upstream {backend} {{",
+             "    # rendered from fleet.shares; do not hand-edit"]
+    for p in sorted(roster, key=lambda p: int(p["rank"])):
+        rank = int(p["rank"])
+        addr = ingest_addr(str(p["addr"]), ingest_port)
+        if weights[rank] > 0:
+            lines.append(f"    server {addr} "
+                         f"weight={weights[rank]};  # r{rank} "
+                         f"{p.get('state')}")
+        else:
+            lines.append(f"    server {addr} down;  # r{rank} "
+                         f"{p.get('state')}")
+    lines.append("}")
+    return "\n".join(lines) + "\n"
+
+
+def render(roster: List[dict], fmt: str, backend: str = "flowgger",
+           ingest_port: int = 0) -> str:
+    if fmt == "nginx":
+        return render_nginx(roster, backend, ingest_port)
+    return render_haproxy(roster, backend, ingest_port)
+
+
+def runtime_commands(roster: List[dict], backend: str = "flowgger"
+                     ) -> List[str]:
+    """haproxy runtime-API command per host (stats socket)."""
+    weights = scaled_weights(roster)
+    return [f"set weight {backend}/r{rank} {weights[rank]}"
+            for rank in sorted(weights)]
+
+
+class WeightEmitter:
+    """Change-driven emitter the control plane ticks: renders to the
+    weights file and/or pushes runtime commands when (and only when)
+    the rendered weights differ from the last applied set."""
+
+    def __init__(self, path: Optional[str] = None,
+                 fmt: str = "haproxy", backend: str = "flowgger",
+                 ingest_port: int = 0,
+                 haproxy_socket: Optional[str] = None):
+        self.path = path
+        self.fmt = fmt
+        self.backend = backend
+        self.ingest_port = ingest_port
+        self.haproxy_socket = haproxy_socket
+        self._last: Optional[Dict[int, int]] = None
+        self.renders = 0
+        self.pushes = 0
+
+    def update(self, roster: List[dict]) -> bool:
+        """Apply the roster's weights if they changed.  Returns True
+        when something was rendered/pushed."""
+        weights = scaled_weights(roster)
+        if weights == self._last:
+            return False
+        if self.path is not None:
+            try:
+                self._write_atomic(
+                    render(roster, self.fmt, self.backend,
+                           self.ingest_port))
+                self.renders += 1
+            except OSError as e:
+                print(f"control: weights render to {self.path} failed "
+                      f"({e}); LB keeps its last applied weights",
+                      file=sys.stderr)
+                return False
+        if self.haproxy_socket is not None:
+            if not self._push_runtime(runtime_commands(roster,
+                                                       self.backend)):
+                return False
+            self.pushes += 1
+        self._last = weights
+        return True
+
+    def _write_atomic(self, text: str) -> None:
+        dirname = os.path.dirname(os.path.abspath(self.path))
+        fd, tmp = tempfile.mkstemp(prefix=".weights-", dir=dirname)
+        try:
+            with os.fdopen(fd, "w") as f:
+                f.write(text)
+            os.replace(tmp, self.path)
+        except OSError:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+
+    def _push_runtime(self, commands: List[str]) -> bool:
+        try:
+            with socket.socket(socket.AF_UNIX,
+                               socket.SOCK_STREAM) as sock:
+                sock.settimeout(2.0)
+                sock.connect(self.haproxy_socket)
+                sock.sendall(("; ".join(commands) + "\n").encode())
+                sock.recv(4096)  # drain the reply, errors included
+            return True
+        except OSError as e:
+            print(f"control: haproxy runtime push to "
+                  f"{self.haproxy_socket} failed ({e}); LB keeps its "
+                  "last applied weights", file=sys.stderr)
+            return False

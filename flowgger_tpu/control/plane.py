@@ -1,0 +1,350 @@
+"""The control plane: one ticker, three feedback loops.
+
+``ControlPlane.from_config`` is the enablement switch (the
+TenantRegistry/Fleet/DurabilityManager idiom): no ``[control]`` table
+→ ``None`` → the pipeline builds nothing and the hot path is
+untouched.  Armed, a single daemon ticker evaluates the loops every
+``control.interval_s`` seconds against signals other subsystems
+already compute — the SLO engine's per-objective burn state, the
+breaker gauge, the durability backlog, the fleet roster — so the
+controller itself adds no hot-path instrumentation at all.
+
+Loop 1 — burn-driven admission.  Every *tenant-dimensioned* objective
+feeds that tenant's :class:`~.aimd.AimdLimiter`; the limiter's factor
+is applied through ``TenantState.set_rate_factor`` (the token buckets
+re-rate in place, bursts untouched).  Tighten/relax transitions
+journal ``admission_tighten``/``admission_relax`` with the applied
+lines/sec rate as cost.  Only rate-limited tenants are governed — an
+unlimited tenant has no rate to multiply (the ``tenant_flood``
+convention).
+
+Loop 2 — share feedback.  Host-level pressure is any of: a burning
+*non-tenant* objective (tenant objectives are loop 1's job — one
+noisy tenant must not cost the whole host its share), the decode
+breaker away from CLOSED, or a nonzero spill backlog / pinned replay
+cursor.  Pressure decays the advertised ``tpu_fleet_capacity`` weight
+through ``Membership.set_local_capacity``; the decayed weight rides
+the next heartbeat doc, so every peer's ``fleet.shares`` — and
+through the weight emitter / steering proxy, actual traffic — shifts
+away from the degrading host *before* its breaker trips.
+
+Loop 3 — autoscale signal.  :func:`desired_hosts` derives a desired
+routable-host count from fleet burn, queue occupancy against the
+per-host target, and the replay backlog; the result is the
+``fleet_desired_hosts`` gauge and the ``/fleetz`` ``control`` section.
+The signal is advisory by design — *this* process can tighten tenants
+and shed share, but only an external compose/k8s layer can buy
+hardware.
+
+Failure philosophy: frozen-at-last-applied.  ``stop()`` (and the
+``control_freeze`` drill site, which makes a tick deterministically
+skip) leaves every applied factor exactly where the last live tick
+put it — a dead controller must not un-throttle a flood.  Nothing
+here ever *widens* an operator limit: factors are clamped to
+``[floor, 1.0]`` of configured values.
+"""
+
+from __future__ import annotations
+
+import math
+import sys
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from ..utils import faultinject as _faults
+from ..utils.metrics import registry as _metrics
+from .aimd import AimdLimiter
+from .emitter import WeightEmitter
+from .spec import ControlSpec, control_spec
+
+ROUTABLE_STATES = ("joining", "active")
+
+
+def desired_hosts(routable: int, burning: bool, max_fast_burn: float,
+                  fill_fraction: float, target_fill: float,
+                  replay_lag: int, lag_per_host: int,
+                  min_hosts: int, max_hosts: int) -> int:
+    """The autoscale signal, as a pure function.
+
+    Scale-up pressure is the max of two ratios — queue occupancy over
+    the per-host target, and the fast-window burn rate (capped at 8x
+    so one pathological window cannot demand an absurd fleet) — scaled
+    onto the current routable count, plus one extra host per
+    ``lag_per_host`` records of replay backlog.  Scale-down is
+    deliberately conservative: only when nothing burns, the backlog is
+    clear, and occupancy sits under half the target does the signal
+    step down, and then by exactly one host — the same
+    remove-slowly/add-quickly asymmetry as the AIMD loops.
+    """
+    routable = max(1, routable)
+    need = float(routable)
+    if target_fill > 0 and fill_fraction > target_fill:
+        need = max(need, routable * fill_fraction / target_fill)
+    if burning:
+        need = max(need, routable * max(1.0, min(max_fast_burn, 8.0)))
+    desired = math.ceil(need - 1e-9)
+    if lag_per_host > 0 and replay_lag > 0:
+        desired += math.ceil(replay_lag / lag_per_host)
+    if (desired <= routable and not burning and replay_lag <= 0
+            and fill_fraction < target_fill / 2):
+        desired = routable - 1
+    return max(min_hosts, min(max_hosts, desired))
+
+
+class ControlPlane:
+    """Owns the limiters, the ticker, the emitter, and (when
+    configured) the steering proxy's lifecycle."""
+
+    def __init__(self, spec: ControlSpec, tenants=None, fleet=None,
+                 tx=None, durability=None,
+                 burn_source: Optional[Callable[[], List[dict]]] = None,
+                 registry=None, clock=time.monotonic):
+        self.spec = spec
+        self.tenants = tenants
+        self.fleet = fleet
+        self.tx = tx
+        self.durability = durability
+        self._clock = clock
+        self._metrics = registry if registry is not None else _metrics
+        if burn_source is None:
+            from ..obs import slo as _slo
+
+            burn_source = _slo.engine.burn_states
+        self._burn_source = burn_source
+        self._limiters: Dict[str, AimdLimiter] = {}
+        self._share = AimdLimiter(
+            backoff=spec.share_backoff,
+            recover_step=spec.share_recover_pct / 100.0,
+            floor=spec.share_floor_pct / 100.0)
+        self._emitter: Optional[WeightEmitter] = None
+        if spec.emits_weights:
+            self._emitter = WeightEmitter(
+                path=spec.weights_path, fmt=spec.weights_format,
+                backend=spec.backend, ingest_port=spec.ingest_port,
+                haproxy_socket=spec.haproxy_socket)
+        self.proxy = None            # fleet/proxy.SteeringProxy (start())
+        self.desired = 0             # last autoscale signal
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- construction ------------------------------------------------------
+    @classmethod
+    def from_config(cls, config, tenants=None, fleet=None, tx=None,
+                    durability=None) -> Optional["ControlPlane"]:
+        """The enablement switch: None when ``[control]`` is absent."""
+        spec = control_spec(config)
+        if spec is None:
+            return None
+        return cls(spec, tenants=tenants, fleet=fleet, tx=tx,
+                   durability=durability)
+
+    def _tenant_limiter(self, name: str) -> AimdLimiter:
+        lim = self._limiters.get(name)
+        if lim is None:
+            lim = AimdLimiter(
+                backoff=self.spec.admission_backoff,
+                recover_step=self.spec.admission_recover_pct / 100.0,
+                floor=self.spec.admission_floor_pct / 100.0)
+            self._limiters[name] = lim
+        return lim
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        """Arm the loops: ticker (``interval_s > 0`` and at least one
+        loop on) and the steering proxy.  Call after ``fleet.start()``
+        — the proxy routes off the live roster."""
+        if self.spec.proxy and self.proxy is None:
+            from ..fleet.proxy import SteeringProxy
+
+            self.proxy = SteeringProxy(
+                bind=self.spec.proxy_bind, port=self.spec.proxy_port,
+                roster_fn=self._roster, ingest_port=self.spec.ingest_port)
+            self.proxy.start()
+            print(f"control: steering proxy on {self.proxy.addr} -> "
+                  f"ingest port {self.spec.ingest_port}",
+                  file=sys.stderr)
+        if self.spec.interval_s > 0 and self.spec.any_loop \
+                and self._thread is None:
+            self._stop = threading.Event()
+            self._thread = threading.Thread(
+                target=self._run, daemon=True, name="control-plane")
+            self._thread.start()
+            armed = [n for n, on in (
+                ("admission", self.spec.admission),
+                ("share", self.spec.share),
+                ("autoscale", self.spec.autoscale),
+                ("weights", self.spec.emits_weights)) if on]
+            print(f"control: loop(s) armed every "
+                  f"{self.spec.interval_s:g}s: {', '.join(armed)}",
+                  file=sys.stderr)
+
+    def stop(self) -> None:
+        """Frozen-at-last-applied: stops the ticker and the proxy but
+        deliberately leaves every applied factor in place — a dying
+        controller must never reset a throttled flood to open."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None
+        if self.proxy is not None:
+            self.proxy.stop()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.spec.interval_s):
+            try:
+                self.tick()
+            except Exception as e:  # noqa: BLE001 - the controller must never die silently mid-soak
+                print(f"control: tick failed: {e}", file=sys.stderr)
+
+    def _roster(self) -> List[dict]:
+        fleet = self.fleet
+        membership = getattr(fleet, "membership", None) if fleet else None
+        return membership.roster() if membership is not None else []
+
+    # -- the tick ----------------------------------------------------------
+    def tick(self, now: Optional[float] = None) -> bool:
+        """One controller pass (the ticker calls this; tests and the
+        chaos drill call it directly).  Returns True when any loop
+        applied a change."""
+        if _faults.enabled() and _faults.fire("control_freeze"):
+            # the controller-death drill: this tick never happened —
+            # whatever the last live tick applied stays applied
+            self._metrics.inc("control_freezes")
+            return False
+        self._metrics.inc("control_ticks")
+        burns = self._burn_source()
+        applied = False
+        if self.spec.admission and self.tenants is not None:
+            applied |= self._tick_admission(burns)
+        if self.spec.share and self.fleet is not None:
+            applied |= self._tick_share(burns)
+        if self.spec.autoscale:
+            self._tick_autoscale(burns)
+        if self._emitter is not None:
+            roster = self._roster()
+            if roster:
+                applied |= self._emitter.update(roster)
+        if applied:
+            self._metrics.inc("control_applies")
+        return applied
+
+    def _tick_admission(self, burns: List[dict]) -> bool:
+        from ..obs import events as _events
+
+        # combine a tenant's objectives: tighten if ANY is burning
+        # (the engine's burning flag IS the both-windows hysteresis),
+        # relax only when ALL are clear
+        per_tenant: Dict[str, bool] = {}
+        for b in burns:
+            tenant = b.get("tenant")
+            if not tenant:
+                continue
+            per_tenant[tenant] = per_tenant.get(tenant, False) \
+                or bool(b.get("burning"))
+        changed = False
+        for tenant, burning in per_tenant.items():
+            state = self.tenants.state(tenant)
+            if not state.spec.limited or state.name != tenant:
+                # unlimited (ungovernable) or an unknown name that
+                # resolved to the default state — never punish the
+                # default lane for a typo'd objective dimension
+                continue
+            lim = self._tenant_limiter(tenant)
+            action = lim.step(burning, not burning)
+            if action is None:
+                continue
+            changed = True
+            rate = state.set_rate_factor(lim.factor)
+            reason = ("admission_tighten" if action == "tighten"
+                      else "admission_relax")
+            _events.emit(
+                "control", reason, tenant=tenant,
+                detail=state.admission_detail(),
+                cost=rate, cost_unit="lines_per_sec",
+                msg=(f"control: tenant [{tenant}] {action}ed to "
+                     f"{lim.factor:.0%} of configured rate "
+                     f"({rate:g} lines/s)"))
+        return changed
+
+    def _host_pressure(self, burns: List[dict]) -> Optional[str]:
+        """The share loop's input: a human-readable pressure cause, or
+        None when the host is healthy."""
+        for b in burns:
+            if b.get("burning") and not b.get("tenant"):
+                return f"slo burn ({b.get('name')})"
+        if self._metrics.get_gauge("device_breaker_state", 0) >= 1:
+            return "decode breaker away from CLOSED"
+        if self.durability is not None:
+            if self.durability.backlog() > 0:
+                return "spill backlog"
+        elif (self._metrics.get_gauge("spill_segments", 0) > 0
+                or self._metrics.get_gauge("replay_cursor_lag", 0) > 0):
+            return "spill backlog"
+        return None
+
+    def _tick_share(self, burns: List[dict]) -> bool:
+        from ..obs import events as _events
+
+        membership = getattr(self.fleet, "membership", None)
+        if membership is None:
+            return False
+        cause = self._host_pressure(burns)
+        action = self._share.step(cause is not None, cause is None)
+        if action is None:
+            return False
+        base = self.fleet.capacity or 1.0
+        capacity = base * self._share.factor
+        if not membership.set_local_capacity(capacity):
+            return False
+        self._metrics.set_gauge("control_capacity_factor",
+                                round(self._share.factor, 4))
+        reason = "share_decay" if action == "tighten" else "share_restore"
+        verb = "decayed" if action == "tighten" else "restored"
+        _events.emit(
+            "control", reason,
+            detail=(f"advertised capacity {capacity:g} of configured "
+                    f"{base:g}"
+                    + (f"; pressure: {cause}" if cause else "")),
+            cost=capacity, cost_unit="capacity",
+            msg=(f"control: {verb} advertised capacity to "
+                 f"{self._share.factor:.0%} of configured"
+                 + (f" ({cause})" if cause else "")))
+        return True
+
+    def _tick_autoscale(self, burns: List[dict]) -> None:
+        routable = 1
+        membership = getattr(self.fleet, "membership", None) \
+            if self.fleet else None
+        if membership is not None:
+            counts = membership.counts()
+            routable = sum(counts.get(s, 0) for s in ROUTABLE_STATES)
+        burning = any(b.get("burning") for b in burns)
+        max_fast = max((float(b.get("fast_burn", 0.0)) for b in burns),
+                       default=0.0)
+        fill = self.tx.fill_fraction() if self.tx is not None else 0.0
+        lag = (self.durability.backlog() if self.durability is not None
+               else int(self._metrics.get_gauge("replay_cursor_lag", 0)))
+        self.desired = desired_hosts(
+            routable, burning, max_fast, fill,
+            self.spec.autoscale_target_fill, lag,
+            self.spec.autoscale_lag_per_host,
+            self.spec.autoscale_min_hosts, self.spec.autoscale_max_hosts)
+        self._metrics.set_gauge("fleet_desired_hosts", self.desired)
+
+    # -- export ------------------------------------------------------------
+    @property
+    def ticks(self) -> int:
+        """Live ticks completed (the control_ticks counter — frozen
+        ticks count control_freezes instead)."""
+        return self._metrics.get("control_ticks")
+
+    def fleetz_section(self) -> dict:
+        """The ``control`` section of the ``/fleetz`` document."""
+        return {
+            "enabled": True,
+            "desired_hosts": int(self.desired),
+            "capacity_factor": round(self._share.factor, 4),
+            "tenants": {name: round(lim.factor, 4)
+                        for name, lim in self._limiters.items()},
+        }

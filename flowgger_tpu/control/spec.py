@@ -1,0 +1,245 @@
+"""``[control]`` table parsing — the enablement switch for the whole
+feedback layer.
+
+``control_spec(config)`` returns ``None`` when no ``[control]`` table
+exists (the pipeline then builds nothing: zero threads, zero hot-path
+cost), and a validated :class:`ControlSpec` otherwise.  Every loop is
+additionally gated by its own boolean, all defaulting off, so an
+operator arms exactly the loops they trust::
+
+    [control]
+    interval_s = 1.0              # controller tick; 0 = manual (tests)
+
+    admission = true              # loop 1: burn-driven tenant AIMD
+    admission_backoff = 0.5       # multiplicative tighten per tick
+    admission_recover_pct = 10    # additive recovery, % of configured
+    admission_floor_pct = 10      # tighten clamp, % of configured
+
+    share = true                  # loop 2: capacity-weight feedback
+    share_backoff = 0.7
+    share_recover_pct = 10
+    share_floor_pct = 20
+
+    autoscale = true              # loop 3: desired-host-count signal
+    autoscale_min_hosts = 1
+    autoscale_max_hosts = 16
+    autoscale_target_fill = 0.5   # queue occupancy a host should hold
+    autoscale_lag_per_host = 100000  # replay backlog one host absorbs
+
+    # share *enforcement* (either/both; shares stay advisory without)
+    proxy = true                  # built-in TCP steering proxy
+    proxy_bind = "0.0.0.0"
+    proxy_port = 5514
+    ingest_port = 514             # maps a peer's fleet addr -> ingest
+    weights_path = "/run/flowgger/weights.map"   # rendered on change
+    weights_format = "haproxy"    # or "nginx"
+    haproxy_socket = "/var/run/haproxy.sock"     # live runtime pushes
+    backend = "flowgger"          # LB backend/upstream name
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from ..config import Config, ConfigError
+
+DEFAULT_INTERVAL_S = 1.0
+WEIGHT_FORMATS = ("haproxy", "nginx")
+
+_KNOWN_KEYS = frozenset((
+    "interval_s",
+    "admission", "admission_backoff", "admission_recover_pct",
+    "admission_floor_pct",
+    "share", "share_backoff", "share_recover_pct", "share_floor_pct",
+    "autoscale", "autoscale_min_hosts", "autoscale_max_hosts",
+    "autoscale_target_fill", "autoscale_lag_per_host",
+    "proxy", "proxy_bind", "proxy_port", "ingest_port",
+    "weights_path", "weights_format", "haproxy_socket", "backend",
+))
+
+
+@dataclass
+class ControlSpec:
+    """One validated ``[control]`` table."""
+
+    interval_s: float = DEFAULT_INTERVAL_S
+    admission: bool = False
+    admission_backoff: float = 0.5
+    admission_recover_pct: float = 10.0
+    admission_floor_pct: float = 10.0
+    share: bool = False
+    share_backoff: float = 0.7
+    share_recover_pct: float = 10.0
+    share_floor_pct: float = 20.0
+    autoscale: bool = False
+    autoscale_min_hosts: int = 1
+    autoscale_max_hosts: int = 16
+    autoscale_target_fill: float = 0.5
+    autoscale_lag_per_host: int = 100_000
+    proxy: bool = False
+    proxy_bind: str = "0.0.0.0"
+    proxy_port: int = 0
+    ingest_port: int = 0
+    weights_path: Optional[str] = None
+    weights_format: str = "haproxy"
+    haproxy_socket: Optional[str] = None
+    backend: str = "flowgger"
+
+    @property
+    def any_loop(self) -> bool:
+        """Anything for the ticker to do?"""
+        return (self.admission or self.share or self.autoscale
+                or self.emits_weights)
+
+    @property
+    def emits_weights(self) -> bool:
+        return self.weights_path is not None or self.haproxy_socket is not None
+
+
+def _pct(value: float, key: str) -> float:
+    if not (0.0 < value <= 100.0):
+        raise ConfigError(f"control.{key} must be in (0, 100]")
+    return value
+
+
+def control_spec(config: Config) -> Optional[ControlSpec]:
+    """Parse ``[control]``; None = the feedback layer stays unbuilt."""
+    table = config.lookup_table(
+        "control", "[control] must be a table (the feedback-loop "
+        "configuration)")
+    if table is None:
+        return None
+    unknown = set(table) - _KNOWN_KEYS
+    if unknown:
+        raise ConfigError(
+            f"unknown [control] key(s): {', '.join(sorted(unknown))} "
+            f"(known: {', '.join(sorted(_KNOWN_KEYS))})")
+    spec = ControlSpec()
+    interval = config.lookup_float(
+        "control.interval_s",
+        "control.interval_s must be a number (seconds between "
+        "controller ticks; 0 = manual tick, tests only)",
+        DEFAULT_INTERVAL_S)
+    if interval < 0:
+        raise ConfigError("control.interval_s must be >= 0")
+    spec.interval_s = interval
+
+    spec.admission = config.lookup_bool(
+        "control.admission",
+        "control.admission must be a boolean (arm the burn-driven "
+        "tenant AIMD loop)", False)
+    spec.admission_backoff = config.lookup_float(
+        "control.admission_backoff",
+        "control.admission_backoff must be a number in (0, 1) "
+        "(multiplicative tighten per burning tick)", 0.5)
+    if not 0.0 < spec.admission_backoff < 1.0:
+        raise ConfigError("control.admission_backoff must be in (0, 1)")
+    spec.admission_recover_pct = _pct(config.lookup_float(
+        "control.admission_recover_pct",
+        "control.admission_recover_pct must be a number in (0, 100] "
+        "(additive recovery per clear tick, % of the configured rate)",
+        10.0), "admission_recover_pct")
+    spec.admission_floor_pct = _pct(config.lookup_float(
+        "control.admission_floor_pct",
+        "control.admission_floor_pct must be a number in (0, 100] "
+        "(tighten clamp, % of the configured rate — a governed tenant "
+        "keeps a trickle, never a blackhole)", 10.0),
+        "admission_floor_pct")
+
+    spec.share = config.lookup_bool(
+        "control.share",
+        "control.share must be a boolean (arm the capacity-weight "
+        "feedback loop)", False)
+    spec.share_backoff = config.lookup_float(
+        "control.share_backoff",
+        "control.share_backoff must be a number in (0, 1) "
+        "(multiplicative capacity decay per pressured tick)", 0.7)
+    if not 0.0 < spec.share_backoff < 1.0:
+        raise ConfigError("control.share_backoff must be in (0, 1)")
+    spec.share_recover_pct = _pct(config.lookup_float(
+        "control.share_recover_pct",
+        "control.share_recover_pct must be a number in (0, 100] "
+        "(additive capacity recovery per clear tick)", 10.0),
+        "share_recover_pct")
+    spec.share_floor_pct = _pct(config.lookup_float(
+        "control.share_floor_pct",
+        "control.share_floor_pct must be a number in (0, 100] "
+        "(capacity decay clamp — a pressured host keeps a floor share "
+        "so it stays routable while it recovers)", 20.0),
+        "share_floor_pct")
+
+    spec.autoscale = config.lookup_bool(
+        "control.autoscale",
+        "control.autoscale must be a boolean (export the "
+        "fleet_desired_hosts signal)", False)
+    spec.autoscale_min_hosts = config.lookup_int(
+        "control.autoscale_min_hosts",
+        "control.autoscale_min_hosts must be an integer >= 1", 1)
+    spec.autoscale_max_hosts = config.lookup_int(
+        "control.autoscale_max_hosts",
+        "control.autoscale_max_hosts must be an integer >= min_hosts",
+        16)
+    if spec.autoscale_min_hosts < 1:
+        raise ConfigError("control.autoscale_min_hosts must be >= 1")
+    if spec.autoscale_max_hosts < spec.autoscale_min_hosts:
+        raise ConfigError("control.autoscale_max_hosts must be >= "
+                          "control.autoscale_min_hosts")
+    spec.autoscale_target_fill = config.lookup_float(
+        "control.autoscale_target_fill",
+        "control.autoscale_target_fill must be a number in (0, 1] "
+        "(queue occupancy one host should run at)", 0.5)
+    if not 0.0 < spec.autoscale_target_fill <= 1.0:
+        raise ConfigError(
+            "control.autoscale_target_fill must be in (0, 1]")
+    spec.autoscale_lag_per_host = config.lookup_int(
+        "control.autoscale_lag_per_host",
+        "control.autoscale_lag_per_host must be an integer >= 1 "
+        "(spilled-but-unacked records one extra host absorbs)",
+        100_000)
+    if spec.autoscale_lag_per_host < 1:
+        raise ConfigError(
+            "control.autoscale_lag_per_host must be >= 1")
+
+    spec.proxy = config.lookup_bool(
+        "control.proxy",
+        "control.proxy must be a boolean (start the built-in TCP "
+        "steering proxy)", False)
+    spec.proxy_bind = config.lookup_str(
+        "control.proxy_bind",
+        "control.proxy_bind must be a string (proxy listen address)",
+        "0.0.0.0")
+    spec.proxy_port = config.lookup_int(
+        "control.proxy_port",
+        "control.proxy_port must be an integer (proxy listen port; "
+        "0 = ephemeral, tests only)", 0)
+    spec.ingest_port = config.lookup_int(
+        "control.ingest_port",
+        "control.ingest_port must be an integer (the port senders "
+        "reach each host's ingest listener on — maps a peer's fleet "
+        "address to its ingest address)", 0)
+    if spec.proxy and spec.ingest_port <= 0:
+        raise ConfigError(
+            "control.proxy requires control.ingest_port (the proxy "
+            "routes connections to each routable host's ingest port)")
+
+    spec.weights_path = config.lookup_str(
+        "control.weights_path",
+        "control.weights_path must be a string (file the weight "
+        "emitter atomically rewrites on share change)")
+    spec.weights_format = config.lookup_str(
+        "control.weights_format",
+        'control.weights_format must be "haproxy" or "nginx"',
+        "haproxy")
+    if spec.weights_format not in WEIGHT_FORMATS:
+        raise ConfigError(
+            'control.weights_format must be "haproxy" or "nginx"')
+    spec.haproxy_socket = config.lookup_str(
+        "control.haproxy_socket",
+        "control.haproxy_socket must be a string (haproxy runtime-API "
+        "stats socket for live set-weight pushes)")
+    spec.backend = config.lookup_str(
+        "control.backend",
+        "control.backend must be a string (LB backend/upstream name "
+        "the rendered weights address)", "flowgger")
+    return spec

@@ -162,7 +162,9 @@ HEALTH_SCHEMA = 4
 
 # /fleetz fleet-observability document schema (tests/resources/
 # fleetz_schema.json is the golden copy)
-FLEETZ_SCHEMA = 1
+# v2: added the "control" section (control plane: autoscale signal +
+# applied factors; {"enabled": false, ...} when no [control] table)
+FLEETZ_SCHEMA = 2
 
 # bounded heartbeat-POST retry (utils/retry.py, full jitter): one
 # dropped packet must not start a peer's suspect clock — but the whole
@@ -588,6 +590,9 @@ class Fleet:
         # with a stale flag — its last snapshot is evidence, not noise
         self._fleetz_lock = threading.Lock()
         self._fleetz_cache: Dict[int, tuple] = {}
+        # control-plane hook (pipeline wires ControlPlane.fleetz_section
+        # here); None = the schema-stable disabled section below
+        self._control_source = None
 
     @classmethod
     def from_config(cls, config: Config, supervisor=None, registry=None,
@@ -599,6 +604,20 @@ class Fleet:
                    on_drain=on_drain)
 
     # -- lifecycle ---------------------------------------------------------
+    def set_control_source(self, fn) -> None:
+        """Pipeline hook: a zero-arg callable returning the control
+        plane's ``/fleetz`` section (ControlPlane.fleetz_section)."""
+        self._control_source = fn
+
+    def _control_section(self) -> Dict[str, object]:
+        if self._control_source is not None:
+            try:
+                return self._control_source()
+            except Exception:  # noqa: BLE001 - a dying controller must not take /fleetz down with it
+                pass
+        return {"enabled": False, "desired_hosts": 0,
+                "capacity_factor": 1.0, "tenants": {}}
+
     def set_default_capacity(self, capacity: float) -> None:
         """Pipeline hook, before ``start()``: the advertised capacity
         weight when ``input.tpu_fleet_capacity`` is unset (a *_tpu
@@ -1172,4 +1191,5 @@ class Fleet:
             "metrics": merge_metric_snapshots(metric_snaps),
             "events": merge_event_sections(event_sections),
             "slo": merge_slo_sections(slo_sections),
+            "control": self._control_section(),
         }

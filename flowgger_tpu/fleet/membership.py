@@ -205,6 +205,25 @@ class Membership:
             self._transition(self._peers[self.rank], ACTIVE)
             self._publish_gauges()
 
+    def set_local_capacity(self, capacity: float) -> bool:
+        """Retune the local host's advertised capacity weight in place
+        (the control plane's share-feedback loop).  The next heartbeat
+        doc carries the new weight, so the decay propagates to every
+        peer's share denominator with no added protocol.  Returns True
+        when the weight actually changed; non-positive values are
+        rejected (a zero-weight host would advertise itself unroutable
+        while answering healthz 200)."""
+        capacity = self._clean_capacity(capacity)
+        if capacity is None:
+            return False
+        with self._lock:
+            peer = self._peers[self.rank]
+            if peer.capacity == capacity:
+                return False
+            peer.capacity = capacity
+            self._publish_gauges()
+            return True
+
     def local_rejoin(self) -> int:
         """The fleet evicted *us* (a peer's view answered that our rank
         is draining/departed at our incarnation).  Bump the incarnation

@@ -1,0 +1,188 @@
+"""Built-in TCP steering proxy: enforced shares with no external LB.
+
+For deployments without haproxy/nginx in front, ``control.proxy``
+starts this minimal layer-4 proxy on any (usually every) host: each
+inbound connection is routed to one fleet host picked
+weighted-randomly by the live ``fleet.shares``, and the bytes are
+pumped verbatim both ways until either side closes.  Routing honors
+the 200/503 contract exactly — only routable (joining/active) hosts
+are candidates, so a draining host stops receiving *new* connections
+while its in-flight streams finish, which is precisely the behavior
+the healthz contract promises an external LB.
+
+Per-connection routing (not per-byte, not per-record): a syslog
+sender's stream stays on one backend for the connection's life, so
+framing, ordering, and tenant attribution are untouched — the proxy
+is invisible at the byte level (the ``test_control`` byte-identity
+tests pin this per framing mode).
+
+The roster is re-read from the injected ``roster_fn`` on every
+accept, so capacity decay (share feedback) shifts *new* connections
+within one heartbeat of the weight change — no reload, no restart.
+
+Scope: this is deliberately a minimal steering tier, not an LB
+product — no health probing beyond membership state, no retry once
+bytes have flowed (a mid-stream backend death drops the connection;
+the sender's reconnect lands on a live host), no TLS termination
+(point senders' TLS at the hosts directly, or keep a real LB for
+that).  Counters: ``proxy_connections``, ``proxy_bytes``,
+``proxy_route_errors``.
+"""
+
+from __future__ import annotations
+
+import random
+import socket
+import sys
+import threading
+from typing import Callable, List, Optional
+
+from ..utils.metrics import registry as _metrics
+
+ROUTABLE_STATES = ("joining", "active")
+_PUMP_CHUNK = 65536
+_ACCEPT_POLL_S = 0.5
+
+
+def _ingest_addr(fleet_addr: str, ingest_port: int) -> str:
+    host = fleet_addr.rsplit(":", 1)[0] if ":" in fleet_addr else fleet_addr
+    return f"{host}:{ingest_port}" if ingest_port > 0 else fleet_addr
+
+
+def pick_backend(roster: List[dict], ingest_port: int,
+                 rng: random.Random) -> Optional[str]:
+    """Weighted-random routable host -> its ingest ``host:port`` (None
+    when nothing is routable — the caller refuses the connection, the
+    proxy's 503)."""
+    routable = [p for p in roster if p.get("state") in ROUTABLE_STATES]
+    if not routable:
+        return None
+    weights = [max(0.0, float(p.get("share", 0.0))) for p in routable]
+    total = sum(weights)
+    if total <= 0:
+        chosen = routable[rng.randrange(len(routable))]
+    else:
+        roll = rng.random() * total
+        chosen = routable[-1]
+        for peer, w in zip(routable, weights):
+            roll -= w
+            if roll < 0:
+                chosen = peer
+                break
+    return _ingest_addr(str(chosen["addr"]), ingest_port)
+
+
+class SteeringProxy:
+    """Accept loop + two pump threads per connection."""
+
+    def __init__(self, bind: str, port: int,
+                 roster_fn: Callable[[], List[dict]],
+                 ingest_port: int = 0, rng: Optional[random.Random] = None,
+                 dial_timeout: float = 5.0):
+        self._bind = bind
+        self._port = port
+        self._roster_fn = roster_fn
+        self._ingest_port = ingest_port
+        self._rng = rng if rng is not None else random.Random()
+        self._dial_timeout = dial_timeout
+        self._listener: Optional[socket.socket] = None
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    @property
+    def addr(self) -> str:
+        assert self._listener is not None, "proxy not started"
+        host, port = self._listener.getsockname()[:2]
+        return f"{host}:{port}"
+
+    def start(self) -> None:
+        listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        listener.bind((self._bind, self._port))
+        listener.listen(128)
+        listener.settimeout(_ACCEPT_POLL_S)
+        self._listener = listener
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._accept_loop,
+                                        daemon=True, name="steer-proxy")
+        self._thread.start()
+
+    def stop(self) -> None:
+        """Stop accepting.  In-flight connections finish on their own
+        pump threads — a proxy restart must not cut live streams."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+            self._listener = None
+
+    # -- internals ---------------------------------------------------------
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _peer = self._listener.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            self._route(conn)
+
+    def _route(self, conn: socket.socket) -> None:
+        target = pick_backend(self._roster_fn(), self._ingest_port,
+                              self._rng)
+        if target is None:
+            _metrics.inc("proxy_route_errors")
+            conn.close()  # nothing routable: the proxy's 503
+            return
+        host, _, port = target.rpartition(":")
+        try:
+            upstream = socket.create_connection(
+                (host, int(port)), timeout=self._dial_timeout)
+        except OSError as e:
+            _metrics.inc("proxy_route_errors")
+            print(f"proxy: dial {target} failed ({e})", file=sys.stderr)
+            conn.close()
+            return
+        upstream.settimeout(None)
+        conn.settimeout(None)
+        _metrics.inc("proxy_connections")
+        # one pump per direction; each propagates EOF as a half-close
+        # so framed protocols see the exact shutdown sequence a direct
+        # connection would
+        refs = [2]
+        lock = threading.Lock()
+        for src, dst in ((conn, upstream), (upstream, conn)):
+            threading.Thread(
+                target=self._pump, args=(src, dst, refs, lock),
+                daemon=True, name="steer-pump").start()
+
+    @staticmethod
+    def _pump(src: socket.socket, dst: socket.socket,
+              refs: list, lock: threading.Lock) -> None:
+        try:
+            while True:
+                data = src.recv(_PUMP_CHUNK)
+                if not data:
+                    break
+                dst.sendall(data)
+                _metrics.inc("proxy_bytes", len(data))
+        except OSError:
+            pass
+        try:
+            dst.shutdown(socket.SHUT_WR)  # forward the EOF
+        except OSError:
+            pass
+        with lock:
+            refs[0] -= 1
+            done = refs[0] == 0
+        if done:
+            for sock in (src, dst):
+                try:
+                    sock.close()
+                except OSError:
+                    pass

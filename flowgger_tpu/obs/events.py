@@ -50,6 +50,18 @@ reason                     fired by
                            unacked backlog with a pinned replay cursor
                            (SLO-declarable: a stuck replay burns an
                            objective instead of rotting silently)
+``admission_tighten``      control/plane.py — the burn-driven AIMD
+                           loop multiplicatively tightened a tenant's
+                           admitted token-bucket rate (cost = the
+                           applied lines/sec rate)
+``admission_relax``        control/plane.py — additive recovery raised
+                           a controller-tightened tenant rate back
+                           toward its configured ceiling
+``share_decay``            control/plane.py — sustained local burn /
+                           breaker / spill pressure decayed this
+                           host's advertised fleet capacity weight
+``share_restore``          control/plane.py — pressure cleared; the
+                           advertised capacity weight recovered a step
 =========================  =================================================
 
 Each event carries ``(ts, site, reason)`` plus whatever context the
@@ -119,6 +131,10 @@ REASONS = (
     "spill_replay",
     "replay_complete",
     "replay_stall",
+    "admission_tighten",
+    "admission_relax",
+    "share_decay",
+    "share_restore",
 )
 _REASON_SET = frozenset(REASONS)
 

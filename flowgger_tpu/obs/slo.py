@@ -454,6 +454,21 @@ class SloEngine:
                     f"{st.budget_remaining:.0%}")
 
     # -- export ------------------------------------------------------------
+    def burn_states(self) -> List[dict]:
+        """Per-objective burn snapshot for consumers that *act* on burn
+        (control/plane.py): name/kind/tenant/route plus the burning
+        flag and both window burns.  Values are whatever the last tick
+        computed — the control plane deliberately reuses the engine's
+        evaluation instead of re-deriving windows."""
+        with self._lock:
+            states = list(self._states)
+        return [{"name": st.obj.name, "kind": st.obj.kind,
+                 "tenant": st.obj.tenant, "route": st.obj.route,
+                 "burning": st.burning,
+                 "fast_burn": st.fast_burn, "slow_burn": st.slow_burn,
+                 "burn_threshold": st.obj.burn_threshold}
+                for st in states]
+
     def health_section(self) -> dict:
         """The ``slo`` section of the health document (and the per-host
         half ``/fleetz`` aggregates)."""

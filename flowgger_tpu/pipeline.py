@@ -298,6 +298,18 @@ class Pipeline:
         # health server carries the same legs and this stays None.
         # Started in run() beside the fleet agent, stopped at drain.
         self._obs_server = None
+        # feedback control ([control]): burn-driven admission, share
+        # feedback, autoscale signal.  Unconfigured -> None — zero
+        # threads, zero hot-path cost (control/plane.py).  Started in
+        # run() after the fleet (the proxy routes off the live
+        # roster); stopped at drain frozen-at-last-applied.
+        from .control import ControlPlane
+
+        self.control = ControlPlane.from_config(
+            config, tenants=self.tenants, fleet=self.fleet,
+            tx=self.tx, durability=self.durability)
+        if self.control is not None and self.fleet is not None:
+            self.fleet.set_control_source(self.control.fleetz_section)
         if input_format in _TPU_FORMATS:
             # multi-host: join the JAX process group before any device
             # op so the decode mesh's dp axis can span every host's
@@ -496,6 +508,12 @@ class Pipeline:
                   f"after 30s, abandoning: [{names}]", file=sys.stderr)
         _metrics_mod.registry.final_flush()
         _metrics_mod.stop_jax_profiler()
+        # the control plane stops frozen-at-last-applied: tightened
+        # tenant rates and a decayed capacity weight stay exactly
+        # where the last tick put them (never reset-to-open), the
+        # ticker and steering proxy just stop
+        if self.control is not None:
+            self.control.stop()
         # the SLO engine's evaluator (and the sentinel riding its
         # ticker) stops with the pipeline — a drained process must not
         # keep journaling slo_burn events off a frozen traffic rate
@@ -586,6 +604,10 @@ class Pipeline:
 
             self._obs_server = _prom.maybe_start_from(
                 self.config, supervisor=self.supervisor)
+        if self.control is not None:
+            # after fleet.start(): the controller's steering proxy and
+            # share loop read the live membership roster
+            self.control.start()
         if self.durability is not None and self.durability.backlog():
             # crash recovery: a previous life left unacked records in
             # the WAL — replay them through the sinks BEFORE fresh

@@ -70,6 +70,20 @@ class TokenBucket:
                 return True
             return False
 
+    def set_rate(self, rate: float) -> None:
+        """Retune the refill rate in place (control plane).  Tokens
+        accrued so far refill at the *old* rate up to now, then the new
+        rate applies — no retroactive grant or confiscation.  Burst
+        capacity is unchanged: tightening bounds the sustained rate,
+        not the configured headroom for a one-off spike."""
+        with self._lock:
+            now = self._clock()
+            if now > self._last and self.rate > 0:
+                self._tokens = min(self.burst,
+                                   self._tokens + (now - self._last) * self.rate)
+            self._last = now
+            self.rate = float(rate)
+
 
 class TenantState:
     """One tenant's shared admission/QoS state: every connection of the
@@ -88,7 +102,45 @@ class TenantState:
         self._m_state = f"tenant_{spec.name}_state"
         self._last_notice = 0.0
         self._gauge_state = STATE_OK
+        # controller-applied rate factor (control/plane.py AIMD loop):
+        # 1.0 = configured rates; < 1.0 = tightened.  Written only from
+        # the controller tick, read on the denial path — never on the
+        # admit hot path.
+        self.rate_factor = 1.0
         _metrics.init_gauge(self._m_state, STATE_OK)
+
+    def set_rate_factor(self, factor: float) -> float:
+        """Scale the tenant's admitted rates to ``factor`` of the
+        configured spec (burn-driven admission).  Only rate-limited
+        tenants are governable — an unlimited tenant has no rate to
+        multiply (the same convention the ``tenant_flood`` fault site
+        uses).  Returns the effective lines/sec rate now applied."""
+        factor = min(1.0, max(0.0, float(factor)))
+        if not self.spec.limited or factor == self.rate_factor:
+            return self.effective_rate()
+        self.rate_factor = factor
+        if self.spec.rate > 0:
+            self.lines_bucket.set_rate(self.spec.rate * factor)
+        if self.spec.byte_rate > 0:
+            self.bytes_bucket.set_rate(self.spec.byte_rate * factor)
+        _metrics.set_gauge(f"tenant_{self.name}_rate_factor", factor)
+        return self.effective_rate()
+
+    def effective_rate(self) -> float:
+        """The lines/sec rate currently enforced (configured rate x
+        controller factor); 0 = unlimited."""
+        return self.lines_bucket.rate
+
+    def admission_detail(self) -> str:
+        """Denial-path annotation: the effective bucket rate, flagged
+        when the controller (not the operator's config) set it — lets
+        ``fleetctl top`` distinguish "over configured rate" from
+        "throttled by controller".  Built only when an event fires."""
+        if self.rate_factor < 1.0:
+            return (f"effective_rate={self.lines_bucket.rate:g}/s "
+                    f"(configured {self.spec.rate:g}/s, controller "
+                    f"factor {self.rate_factor:.2f})")
+        return f"effective_rate={self.lines_bucket.rate:g}/s"
 
     def admit(self, lines: int, nbytes: int) -> bool:
         """Charge one delivery unit; False = shed it (already counted)."""
@@ -124,6 +176,7 @@ class TenantState:
         from ..obs import events as _events
 
         _events.emit("admission", "tenant_shed", tenant=self.name,
+                     detail=self.admission_detail(),
                      cost=lines, cost_unit="lines", msg=msg)
         return False
 

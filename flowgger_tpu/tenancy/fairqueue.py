@@ -178,7 +178,8 @@ class WeightedFairQueue:
             lane.state.count_shed(lines)
         # staged, not emitted: put() drains the buffer after the mutex
         self._event_buf.append(
-            (cause, lane.name if lane is not None else None, lines))
+            (cause, lane.name if lane is not None else None, lines,
+             lane.state if lane is not None else None))
 
     def _noisiest_sheddable_locked(self) -> Optional[_Lane]:
         best, best_score = None, -1.0
@@ -199,8 +200,14 @@ class WeightedFairQueue:
             buf, self._event_buf = self._event_buf, []
         from ..obs import events as _events
 
-        for cause, tenant, lines in buf:
-            _events.emit("queue", "queue_drop", detail=cause,
+        for cause, tenant, lines, state in buf:
+            # annotate with the tenant's *effective* admitted rate so
+            # fleetctl top can tell "over configured rate" from
+            # "tightened by the controller" (string built out here —
+            # never under the queue mutex)
+            detail = (f"{cause} {state.admission_detail()}"
+                      if state is not None else cause)
+            _events.emit("queue", "queue_drop", detail=detail,
                          tenant=tenant, cost=lines, cost_unit="lines")
 
     def put(self, item, block: bool = True, timeout=None) -> None:

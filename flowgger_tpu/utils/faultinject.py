@@ -61,6 +61,12 @@ Sites wired in (each names the exception type it surfaces):
   arrives (``outputs.ack_item`` suppresses the callback): the WAL
   replay cursor pins, ``replay_cursor_lag`` stays nonzero, and the
   stall watchdog journals ``replay_stall`` — the stuck-replay drill.
+- ``control_freeze`` — the control plane's ticker (control/plane.py)
+  skips the firing tick entirely: the controller-death drill.  The
+  failure philosophy is frozen-at-last-applied — tightened tenant
+  rates and a decayed capacity weight stay exactly where the last
+  live tick left them, never reset to open — and this site proves it
+  deterministically.
 
 Runtime arming: beyond the boot-time plan below, ``set_site`` merges
 one site into the active plan while the process runs — the fleet
@@ -85,7 +91,8 @@ ENV_VAR = "FLOWGGER_FAULTS"
 KNOWN_SITES = ("device_decode", "input_socket", "sink_write",
                "queue_pressure", "tenant_flood", "peer_partition",
                "host_kill", "coordinator_kill", "roster_corrupt",
-               "route_throttle", "spill_io", "sink_ack_loss")
+               "route_throttle", "spill_io", "sink_ack_loss",
+               "control_freeze")
 
 
 class InjectedFault(Exception):

@@ -169,6 +169,12 @@ _COUNTERS = (
     "spill_records", "replayed_lines", "spill_load_errors",
     "spill_io_errors", "sink_acks", "sink_ack_errors",
     "drain_barrier_timeouts",
+    # control plane (control/plane.py + fleet/proxy.py): controller
+    # ticks that applied a change, ticks skipped by the control_freeze
+    # drill site, steering-proxy connections routed / bytes pumped /
+    # routing failures (no routable host, dial error)
+    "control_applies", "control_freezes", "control_ticks",
+    "proxy_connections", "proxy_bytes", "proxy_route_errors",
 )
 
 # cumulative per-stage wall-clock accumulators (add_seconds)
@@ -188,6 +194,10 @@ _GAUGE_NAMES = (
     # bytes/segments and the spilled-but-unacked record count the
     # replay-stall watchdog and fleetctl's spill line key on
     "spill_bytes", "spill_segments", "replay_cursor_lag",
+    # control plane (control/plane.py): the autoscale signal (desired
+    # routable host count) and this host's applied capacity factor
+    # (1.0 = configured weight, < 1.0 = share-feedback decay)
+    "fleet_desired_hosts", "control_capacity_factor",
 )
 
 # sliding-window histogram family (observe)
@@ -205,6 +215,7 @@ _FAMILY_PATTERNS = (
     "queue_dropped_{policy}",
     "tenant_{name}_lines", "tenant_{name}_bytes", "tenant_{name}_drops",
     "tenant_{name}_shed", "tenant_{name}_state",
+    "tenant_{name}_rate_factor",
     "tenant_{name}_templates_distinct",
     "tenant_{name}_template_{id}", "tenant_{name}_template_overflow",
     "fleet_hosts_{state}", "fleet_peer{rank}_state",
@@ -233,6 +244,7 @@ _FAMILY_KINDS = (
     ("lane{i}_route_{path}_spr", "gauge"),
     ("queue_dropped_{policy}", "counter"),
     ("tenant_{name}_state", "gauge"),
+    ("tenant_{name}_rate_factor", "gauge"),
     ("tenant_{name}_templates_distinct", "gauge"),
     ("tenant_{name}_template_overflow", "counter"),
     ("tenant_{name}_template_{id}", "counter"),

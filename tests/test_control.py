@@ -1,0 +1,816 @@
+"""The control plane (flowgger_tpu/control/): AIMD governor, burn-driven
+admission, share feedback, autoscale signal, weight emitter, steering
+proxy — and the inertness contract when ``[control]`` is absent."""
+
+import os
+import socket
+import threading
+import types
+
+import pytest
+
+from flowgger_tpu import tenancy
+from flowgger_tpu.config import Config, ConfigError
+from flowgger_tpu.control import (AimdLimiter, ControlPlane, ControlSpec,
+                                  control_spec, desired_hosts)
+from flowgger_tpu.control import emitter as emitter_mod
+from flowgger_tpu.control.emitter import (WeightEmitter, render_haproxy,
+                                          render_nginx, runtime_commands,
+                                          scaled_weights)
+from flowgger_tpu.fleet.membership import Membership
+from flowgger_tpu.fleet.proxy import SteeringProxy, pick_backend
+from flowgger_tpu.obs import events as obs_events
+from flowgger_tpu.tenancy.admission import TokenBucket
+from flowgger_tpu.tenancy.fairqueue import WeightedFairQueue
+from flowgger_tpu.tenancy.registry import TenantRegistry
+from flowgger_tpu.utils import faultinject
+from flowgger_tpu.utils.metrics import registry
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    registry.reset()
+    faultinject.reset()
+    obs_events.journal.reset()
+    obs_events.journal.configure()
+    tenancy.set_current(None)
+    yield
+    faultinject.reset()
+    obs_events.journal.reset()
+    obs_events.journal.configure()
+    tenancy.set_current(None)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def _tenants(toml: str, clock=None) -> TenantRegistry:
+    return TenantRegistry.from_config(Config.from_string(toml),
+                                      clock=clock)
+
+
+def _burn(name="lat", tenant=None, burning=True, fast=2.0, slow=2.0):
+    return {"name": name, "kind": "latency", "tenant": tenant,
+            "route": None, "burning": burning, "fast_burn": fast,
+            "slow_burn": slow, "burn_threshold": 1.0}
+
+
+def _events_of(reason):
+    return [e for e in obs_events.journal.snapshot()
+            if e["reason"] == reason]
+
+
+# ---------------------------------------------------------------------------
+# AIMD limiter: the pure unit
+# ---------------------------------------------------------------------------
+
+def test_aimd_constructor_validation():
+    with pytest.raises(ValueError, match="backoff"):
+        AimdLimiter(backoff=1.0)
+    with pytest.raises(ValueError, match="backoff"):
+        AimdLimiter(backoff=0.0)
+    with pytest.raises(ValueError, match="recover_step"):
+        AimdLimiter(recover_step=0.0)
+    with pytest.raises(ValueError, match="floor"):
+        AimdLimiter(floor=0.0)
+    with pytest.raises(ValueError, match="floor"):
+        AimdLimiter(floor=1.5)
+
+
+def test_aimd_tighten_requires_both_windows():
+    """The both-windows hysteresis mirrors the SLO engine: fast-only or
+    slow-only burn holds the factor — a single-window blip can never
+    move it."""
+    lim = AimdLimiter()
+    assert lim.update(2.0, 0.5) is None          # fast hot, slow cold
+    assert lim.factor == 1.0
+    # slow-only: the fast window is CLEAR, which is the relax condition,
+    # but at factor 1.0 there is nothing to relax -> hold
+    lim2 = AimdLimiter()
+    assert lim2.update(0.5, 2.0) is None
+    assert lim2.factor == 1.0
+    # both hot -> multiplicative tighten
+    assert lim2.update(2.0, 2.0) == "tighten"
+    assert lim2.factor == pytest.approx(0.5)
+
+
+def test_aimd_no_oscillation_on_single_window_blip():
+    """After a tighten, a fast-hot/slow-cold tick must hold (not
+    re-tighten) and a fast-cold tick relaxes additively — the factor
+    never ping-pongs on one window's noise."""
+    lim = AimdLimiter(backoff=0.5, recover_step=0.1)
+    assert lim.update(2.0, 2.0) == "tighten"
+    assert lim.factor == pytest.approx(0.5)
+    trace = [lim.update(2.0, 0.5) for _ in range(5)]  # blips: hold
+    assert trace == [None] * 5
+    assert lim.factor == pytest.approx(0.5)
+    assert lim.update(0.2, 1.5) == "relax"  # fast clear drives recovery
+    assert lim.factor == pytest.approx(0.6)
+
+
+def test_aimd_floor_and_ceiling_clamp_silently():
+    lim = AimdLimiter(backoff=0.5, recover_step=0.5, floor=0.2)
+    assert lim.update(2.0, 2.0) == "tighten"   # 0.5
+    assert lim.update(2.0, 2.0) == "tighten"   # 0.25
+    assert lim.update(2.0, 2.0) == "tighten"   # clamps at floor 0.2
+    assert lim.factor == pytest.approx(0.2)
+    # pinned at the floor: further burning ticks emit NO action (a
+    # clamped no-move must not journal every tick)
+    assert lim.update(2.0, 2.0) is None
+    assert lim.factor == pytest.approx(0.2)
+    assert lim.update(0.0, 0.0) == "relax"     # 0.7
+    assert lim.update(0.0, 0.0) == "relax"     # clamps at 1.0
+    assert lim.factor == 1.0
+    assert lim.update(0.0, 0.0) is None        # hold at ceiling, silent
+
+
+def test_aimd_step_tighten_wins_over_relax():
+    lim = AimdLimiter()
+    assert lim.step(True, True) == "tighten"
+    assert lim.factor == pytest.approx(0.5)
+
+
+def test_aimd_deterministic_sequence():
+    """Clockless by construction: the same signal sequence produces the
+    same factor trajectory, run to run."""
+    seq = [(2.0, 2.0), (2.0, 2.0), (2.0, 0.5), (0.1, 0.1),
+           (3.0, 3.0), (0.0, 0.0), (0.0, 0.0)]
+
+    def run():
+        lim = AimdLimiter(backoff=0.5, recover_step=0.1, floor=0.1)
+        return [(lim.update(f, s), round(lim.factor, 6))
+                for f, s in seq]
+
+    assert run() == run()
+
+
+# ---------------------------------------------------------------------------
+# token-bucket re-rating + effective-rate annotations
+# ---------------------------------------------------------------------------
+
+def test_token_bucket_set_rate_refills_old_rate_first():
+    """Re-rating refills at the OLD rate up to the switch instant —
+    no retroactive grant or confiscation — and leaves burst alone."""
+    clock = FakeClock()
+    b = TokenBucket(rate=10, burst=10, clock=clock)
+    assert b.try_take(10)            # drain the initial burst
+    clock.t = 0.5                    # 5 tokens accrue at rate 10
+    b.set_rate(2)
+    clock.t = 1.5                    # +2 at the new rate -> 7 total
+    assert b.try_take(7)
+    assert not b.try_take(0.5)
+    assert b.burst == 10             # burst headroom untouched
+
+
+def test_set_rate_factor_scales_buckets_and_detail():
+    clock = FakeClock()
+    reg = _tenants("[tenants.noisy]\nrate = 100\n", clock=clock)
+    state = reg.state("noisy")
+    assert state.effective_rate() == 100
+    assert state.admission_detail() == "effective_rate=100/s"
+    rate = state.set_rate_factor(0.5)
+    assert rate == 50 and state.effective_rate() == 50
+    assert state.lines_bucket.rate == 50
+    assert registry.get_gauge("tenant_noisy_rate_factor") == 0.5
+    assert "controller factor 0.50" in state.admission_detail()
+    assert "configured 100/s" in state.admission_detail()
+    # clamped to [0, 1] of configured: the controller can never widen
+    assert state.set_rate_factor(2.0) == 100
+    assert state.rate_factor == 1.0
+
+
+def test_set_rate_factor_ignores_unlimited_tenants():
+    reg = _tenants("[tenants.free]\n")
+    state = reg.state("free")
+    assert not state.spec.limited
+    state.set_rate_factor(0.5)
+    assert state.rate_factor == 1.0
+    assert state.lines_bucket.rate == 0  # still unlimited
+
+
+def test_tenant_shed_event_carries_effective_rate():
+    """Satellite: the denial-path event tells the operator whether the
+    bucket rate is the operator's or the controller's."""
+    clock = FakeClock()
+    reg = _tenants("[tenants.noisy]\nrate = 10\nburst = 1\n",
+                   clock=clock)
+    state = reg.state("noisy")
+    state.set_rate_factor(0.5)
+    assert state.admit(1, 10)        # burst token
+    assert not state.admit(1, 10)    # denied -> tenant_shed event
+    shed = _events_of("tenant_shed")
+    assert len(shed) == 1
+    assert shed[0]["tenant"] == "noisy"
+    assert "effective_rate=5/s" in shed[0]["detail"]
+    assert "controller factor 0.50" in shed[0]["detail"]
+
+
+def test_queue_drop_event_carries_effective_rate():
+    reg = _tenants('[tenants.noisy]\nrate = 100\n'
+                   'queue_policy = "drop_newest"\n')
+    reg.state("noisy").set_rate_factor(0.25)
+    q = WeightedFairQueue(maxsize=1, registry=reg)
+    tenancy.set_current("noisy")
+    q.put(b"a")
+    q.put(b"b")  # full, own lane noisiest -> drop_newest shed
+    tenancy.set_current(None)
+    drops = _events_of("queue_drop")
+    assert len(drops) == 1
+    assert drops[0]["tenant"] == "noisy"
+    assert drops[0]["detail"].startswith("drop_newest ")
+    assert "effective_rate=25/s" in drops[0]["detail"]
+    assert "controller factor 0.25" in drops[0]["detail"]
+
+
+# ---------------------------------------------------------------------------
+# [control] spec parsing: the enablement switch
+# ---------------------------------------------------------------------------
+
+def test_control_absent_means_none():
+    assert control_spec(Config.from_string("")) is None
+    assert control_spec(Config.from_string(
+        '[input]\ntype = "stdin"\n')) is None
+    assert ControlPlane.from_config(Config.from_string("")) is None
+
+
+def test_control_empty_table_arms_nothing():
+    spec = control_spec(Config.from_string("[control]\n"))
+    assert spec is not None
+    assert not spec.admission and not spec.share and not spec.autoscale
+    assert not spec.any_loop and not spec.emits_weights
+
+
+def test_control_spec_validation():
+    with pytest.raises(ConfigError, match="unknown \\[control\\] key"):
+        control_spec(Config.from_string("[control]\nadmision = true\n"))
+    with pytest.raises(ConfigError, match="admission_backoff"):
+        control_spec(Config.from_string(
+            "[control]\nadmission_backoff = 1.5\n"))
+    with pytest.raises(ConfigError, match="admission_floor_pct"):
+        control_spec(Config.from_string(
+            "[control]\nadmission_floor_pct = 0\n"))
+    with pytest.raises(ConfigError, match="ingest_port"):
+        control_spec(Config.from_string("[control]\nproxy = true\n"))
+    with pytest.raises(ConfigError, match="weights_format"):
+        control_spec(Config.from_string(
+            '[control]\nweights_format = "f5"\n'))
+    with pytest.raises(ConfigError, match="max_hosts"):
+        control_spec(Config.from_string(
+            "[control]\nautoscale_min_hosts = 4\n"
+            "autoscale_max_hosts = 2\n"))
+    with pytest.raises(ConfigError, match="interval_s"):
+        control_spec(Config.from_string("[control]\ninterval_s = -1\n"))
+
+
+def test_control_spec_full_table_parses():
+    spec = control_spec(Config.from_string("""
+[control]
+interval_s = 0.25
+admission = true
+admission_backoff = 0.6
+admission_recover_pct = 5
+admission_floor_pct = 20
+share = true
+autoscale = true
+autoscale_max_hosts = 8
+proxy = true
+proxy_port = 0
+ingest_port = 6514
+weights_path = "/tmp/w.map"
+weights_format = "nginx"
+"""))
+    assert spec.interval_s == 0.25
+    assert spec.admission and spec.share and spec.autoscale
+    assert spec.admission_backoff == 0.6
+    assert spec.autoscale_max_hosts == 8
+    assert spec.proxy and spec.ingest_port == 6514
+    assert spec.emits_weights and spec.weights_format == "nginx"
+    assert spec.any_loop
+
+
+# ---------------------------------------------------------------------------
+# inertness: no [control] -> nothing built, zero threads
+# ---------------------------------------------------------------------------
+
+def test_pipeline_without_control_builds_nothing(tmp_path):
+    from flowgger_tpu.outputs import SHUTDOWN
+    from flowgger_tpu.pipeline import Pipeline
+
+    config = Config.from_string(f"""
+[input]
+type = "stdin"
+format = "rfc5424"
+[output]
+type = "file"
+format = "passthrough"
+framing = "line"
+file_path = "{tmp_path / 'out.log'}"
+""")
+    before = {t.name for t in threading.enumerate()}
+    p = Pipeline(config)
+    assert p.control is None
+    after = {t.name for t in threading.enumerate()} - before
+    assert not any(n.startswith(("control-plane", "steer-"))
+                   for n in after)
+    thread = p.start_output()
+    p.tx.put(SHUTDOWN)
+    thread.join(timeout=10)
+
+
+def test_interval_zero_means_manual_tick_no_thread():
+    spec = control_spec(Config.from_string(
+        "[control]\ninterval_s = 0\nadmission = true\n"))
+    plane = ControlPlane(spec, burn_source=lambda: [])
+    before = {t.name for t in threading.enumerate()}
+    plane.start()
+    after = {t.name for t in threading.enumerate()} - before
+    assert not after
+    plane.stop()
+
+
+# ---------------------------------------------------------------------------
+# loop 1: burn-driven admission through the plane's tick
+# ---------------------------------------------------------------------------
+
+def _admission_plane(reg, burns):
+    spec = ControlSpec(admission=True, interval_s=0)
+    return ControlPlane(spec, tenants=reg, burn_source=lambda: burns)
+
+
+def test_tick_admission_tightens_and_relaxes():
+    clock = FakeClock()
+    reg = _tenants("[tenants.noisy]\nrate = 100\n", clock=clock)
+    burns = [_burn(tenant="noisy")]
+    plane = _admission_plane(reg, burns)
+    assert plane.tick() is True
+    state = reg.state("noisy")
+    assert state.rate_factor == pytest.approx(0.5)
+    assert state.effective_rate() == 50
+    tightens = _events_of("admission_tighten")
+    assert len(tightens) == 1
+    assert tightens[0]["tenant"] == "noisy"
+    assert tightens[0]["cost"] == 50.0
+    assert tightens[0]["cost_unit"] == "lines_per_sec"
+    assert registry.get("control_applies") == 1
+    # burn clears -> additive recovery, one step per tick
+    burns[0] = _burn(tenant="noisy", burning=False, fast=0.1, slow=0.1)
+    assert plane.tick() is True
+    assert state.rate_factor == pytest.approx(0.6)
+    relaxes = _events_of("admission_relax")
+    assert len(relaxes) == 1 and relaxes[0]["cost"] == 60.0
+    for _ in range(10):
+        plane.tick()
+    assert state.rate_factor == 1.0
+    # at the ceiling further clear ticks are silent
+    n = len(_events_of("admission_relax"))
+    assert plane.tick() is False
+    assert len(_events_of("admission_relax")) == n
+
+
+def test_tick_admission_skips_unlimited_and_unknown_tenants():
+    reg = _tenants("[tenants.free]\n[tenants.noisy]\nrate = 100\n")
+    plane = _admission_plane(
+        reg, [_burn(tenant="free"), _burn(tenant="ghost")])
+    assert plane.tick() is False
+    assert reg.state("free").rate_factor == 1.0
+    # a typo'd objective dimension resolves to the default lane — the
+    # default tenant must never be punished for it
+    assert reg.state("default").rate_factor == 1.0
+    assert not _events_of("admission_tighten")
+
+
+def test_tick_admission_combines_objectives_any_burning():
+    reg = _tenants("[tenants.noisy]\nrate = 100\n")
+    burns = [_burn(name="lat", tenant="noisy", burning=False,
+                   fast=0.1, slow=0.1),
+             _burn(name="events", tenant="noisy", burning=True)]
+    plane = _admission_plane(reg, burns)
+    plane.tick()
+    assert reg.state("noisy").rate_factor == pytest.approx(0.5)
+    # relax requires ALL of the tenant's objectives clear
+    burns[1] = _burn(name="events", tenant="noisy", burning=True)
+    plane.tick()
+    assert reg.state("noisy").rate_factor == pytest.approx(0.25)
+
+
+# ---------------------------------------------------------------------------
+# loop 2: share feedback through membership capacity
+# ---------------------------------------------------------------------------
+
+def _share_plane(burns, capacity=2.0, durability=None):
+    clock = FakeClock()
+    membership = Membership(rank=0, addr="h0:1", capacity=capacity,
+                            clock=clock)
+    membership.activate()
+    membership.note_heartbeat(1, "h1:1", capacity=capacity)
+    fleet = types.SimpleNamespace(capacity=capacity,
+                                  membership=membership)
+    spec = ControlSpec(share=True, interval_s=0)
+    plane = ControlPlane(spec, fleet=fleet, durability=durability,
+                         burn_source=lambda: burns)
+    return plane, membership
+
+
+def test_tick_share_decays_capacity_on_host_burn():
+    burns = [_burn(name="host_lat", tenant=None)]
+    plane, membership = _share_plane(burns)
+    assert membership.shares()[0] == pytest.approx(0.5)
+    assert plane.tick() is True
+    # capacity 2.0 * 0.7 = 1.4 against the peer's 2.0
+    assert membership.local.capacity == pytest.approx(1.4)
+    assert membership.shares()[0] == pytest.approx(1.4 / 3.4, abs=1e-3)
+    decays = _events_of("share_decay")
+    assert len(decays) == 1
+    assert decays[0]["cost_unit"] == "capacity"
+    assert "slo burn (host_lat)" in decays[0]["detail"]
+    assert registry.get_gauge("control_capacity_factor") == \
+        pytest.approx(0.7)
+    # pressure clears -> additive restore
+    burns[0] = _burn(name="host_lat", burning=False, fast=0.1, slow=0.1)
+    assert plane.tick() is True
+    assert membership.local.capacity == pytest.approx(1.6)
+    assert _events_of("share_restore")
+
+
+def test_tick_share_ignores_tenant_burn():
+    """Loop separation: a noisy tenant is loop 1's job — it must not
+    cost the whole host its fleet share."""
+    plane, membership = _share_plane([_burn(tenant="noisy")])
+    assert plane.tick() is False
+    assert membership.local.capacity == pytest.approx(2.0)
+    assert not _events_of("share_decay")
+
+
+def test_tick_share_pressure_from_breaker_and_backlog():
+    plane, membership = _share_plane([])
+    registry.set_gauge("device_breaker_state", 1)
+    plane.tick()
+    assert membership.local.capacity == pytest.approx(1.4)
+    registry.set_gauge("device_breaker_state", 0)
+
+    backlog = types.SimpleNamespace(backlog=lambda: 5)
+    plane2, membership2 = _share_plane([], durability=backlog)
+    plane2.tick()
+    assert membership2.local.capacity == pytest.approx(1.4)
+
+
+def test_share_decay_propagates_via_heartbeat_doc():
+    """The decayed weight rides the existing heartbeat: a peer noting
+    the new capacity recomputes its shares with no protocol change."""
+    plane, membership = _share_plane([_burn(name="host_lat")])
+    plane.tick()
+    peer = Membership(rank=1, addr="h1:1", capacity=2.0)
+    peer.activate()
+    local = membership.roster()[0]
+    peer.note_heartbeat(0, local["addr"], state=local["state"],
+                        capacity=local["capacity"])
+    assert peer.shares()[0] == pytest.approx(1.4 / 3.4, abs=1e-3)
+    assert peer.shares()[1] > peer.shares()[0]
+
+
+# ---------------------------------------------------------------------------
+# frozen-at-last-applied: stop/freeze never resets
+# ---------------------------------------------------------------------------
+
+def test_control_freeze_fault_skips_tick_frozen():
+    reg = _tenants("[tenants.noisy]\nrate = 100\n")
+    burns = [_burn(tenant="noisy")]
+    plane = _admission_plane(reg, burns)
+    plane.tick()
+    assert reg.state("noisy").rate_factor == pytest.approx(0.5)
+    ticks = plane.ticks
+    faultinject.configure({"control_freeze": "first:1"})
+    # burn clears, but the controller is dead: the tightened factor
+    # must stay applied — never reset-to-open
+    burns[0] = _burn(tenant="noisy", burning=False, fast=0.0, slow=0.0)
+    assert plane.tick() is False
+    assert plane.ticks == ticks
+    assert reg.state("noisy").rate_factor == pytest.approx(0.5)
+    assert registry.get("control_freezes") == 1
+    faultinject.reset()
+    assert plane.tick() is True  # thawed: recovery resumes
+    assert reg.state("noisy").rate_factor == pytest.approx(0.6)
+
+
+def test_stop_leaves_factors_applied():
+    reg = _tenants("[tenants.noisy]\nrate = 100\n")
+    plane = _admission_plane(reg, [_burn(tenant="noisy")])
+    plane.tick()
+    plane.stop()
+    assert reg.state("noisy").rate_factor == pytest.approx(0.5)
+    assert reg.state("noisy").effective_rate() == 50
+
+
+# ---------------------------------------------------------------------------
+# loop 3: the autoscale signal
+# ---------------------------------------------------------------------------
+
+def test_desired_hosts_math():
+    kw = dict(target_fill=0.5, lag_per_host=100_000,
+              min_hosts=1, max_hosts=16)
+    # healthy fleet at target: hold
+    assert desired_hosts(3, False, 0.0, 0.4, replay_lag=0, **kw) == 3
+    # well under half target, nothing burning: step down by ONE
+    assert desired_hosts(3, False, 0.0, 0.1, replay_lag=0, **kw) == 2
+    assert desired_hosts(1, False, 0.0, 0.0, replay_lag=0, **kw) == 1
+    # occupancy pressure scales on the ratio to target
+    assert desired_hosts(2, False, 0.0, 1.0, replay_lag=0, **kw) == 4
+    # burn pressure scales on the fast burn, capped at 8x
+    assert desired_hosts(2, True, 3.0, 0.0, replay_lag=0, **kw) == 6
+    assert desired_hosts(1, True, 50.0, 0.0, replay_lag=0, **kw) == 8
+    # replay backlog adds hosts on top
+    assert desired_hosts(1, False, 0.0, 0.3, replay_lag=250_000,
+                         **kw) == 4
+    # clamps
+    assert desired_hosts(
+        4, True, 8.0, 0.0, replay_lag=0, target_fill=0.5,
+        lag_per_host=100_000, min_hosts=1, max_hosts=6) == 6
+
+
+def test_tick_autoscale_sets_gauge_and_fleetz_section():
+    clock = FakeClock()
+    membership = Membership(rank=0, addr="h0:1", clock=clock)
+    membership.activate()
+    membership.note_heartbeat(1, "h1:1")
+    fleet = types.SimpleNamespace(capacity=1.0, membership=membership)
+    tx = types.SimpleNamespace(fill_fraction=lambda: 0.9)
+    spec = ControlSpec(autoscale=True, interval_s=0,
+                       autoscale_target_fill=0.5, autoscale_max_hosts=16)
+    plane = ControlPlane(spec, fleet=fleet, tx=tx,
+                         burn_source=lambda: [])
+    plane.tick()
+    assert plane.desired == 4  # 2 routable * 0.9/0.5 -> ceil(3.6)
+    assert registry.get_gauge("fleet_desired_hosts") == 4
+    section = plane.fleetz_section()
+    assert section == {"enabled": True, "desired_hosts": 4,
+                       "capacity_factor": 1.0, "tenants": {}}
+
+
+def test_fleetz_section_matches_golden_schema_leaves():
+    import json
+
+    schema = json.load(open(os.path.join(
+        os.path.dirname(__file__), "resources", "fleetz_schema.json")))
+    spec = ControlSpec(autoscale=True, interval_s=0)
+    plane = ControlPlane(spec, burn_source=lambda: [])
+    plane.tick()
+    assert set(plane.fleetz_section()) == set(schema["control"])
+
+
+# ---------------------------------------------------------------------------
+# weight emitter
+# ---------------------------------------------------------------------------
+
+ROSTER = [
+    {"rank": 0, "addr": "10.0.0.1:8404", "state": "active", "share": 0.5},
+    {"rank": 1, "addr": "10.0.0.2:8404", "state": "active", "share": 0.35},
+    {"rank": 2, "addr": "10.0.0.3:8404", "state": "draining",
+     "share": 0.0},
+]
+
+
+def test_scaled_weights_mapping():
+    w = scaled_weights(ROSTER)
+    assert w[0] == 256                       # top share -> max weight
+    assert w[1] == round(0.35 / 0.5 * 256)   # proportional
+    assert w[2] == 0                         # non-routable
+    # a tiny-but-routable share still gets weight >= 1
+    tiny = [{"rank": 0, "addr": "a:1", "state": "active", "share": 1.0},
+            {"rank": 1, "addr": "b:1", "state": "active",
+             "share": 0.0001}]
+    assert scaled_weights(tiny)[1] == 1
+
+
+def test_render_haproxy_and_runtime_commands():
+    text = render_haproxy(ROSTER, backend="fl", ingest_port=6514)
+    assert "server r0 10.0.0.1:6514 weight 256 check" in text
+    assert "server r2 10.0.0.3:6514 weight 0 check" in text
+    cmds = runtime_commands(ROSTER, backend="fl")
+    assert cmds[0] == "set weight fl/r0 256"
+    assert cmds[2] == "set weight fl/r2 0"
+
+
+def test_render_nginx_marks_unroutable_down():
+    text = render_nginx(ROSTER, ingest_port=6514)
+    assert "upstream flowgger {" in text
+    assert "server 10.0.0.1:6514 weight=256;" in text
+    assert "server 10.0.0.3:6514 down;" in text
+
+
+def test_weight_emitter_change_driven_atomic_write(tmp_path):
+    path = tmp_path / "weights.map"
+    em = WeightEmitter(path=str(path), fmt="haproxy", ingest_port=6514)
+    assert em.update(ROSTER) is True
+    first = path.read_text()
+    assert "server r0" in first
+    assert em.update(ROSTER) is False        # unchanged -> no rewrite
+    assert em.renders == 1
+    moved = [dict(p) for p in ROSTER]
+    moved[2]["state"] = "active"
+    moved[2]["share"] = 0.2
+    assert em.update(moved) is True
+    assert "weight 0" not in path.read_text()
+    assert em.renders == 2
+
+
+def test_weight_emitter_failure_contained(tmp_path, capsys):
+    em = WeightEmitter(path=str(tmp_path / "no" / "such" / "dir" / "w"))
+    assert em.update(ROSTER) is False        # never raises into the tick
+    assert "keeps its last applied weights" in capsys.readouterr().err
+    em2 = WeightEmitter(haproxy_socket=str(tmp_path / "no.sock"))
+    assert em2.update(ROSTER) is False
+
+
+def test_weight_emitter_haproxy_socket_push(tmp_path):
+    sock_path = str(tmp_path / "haproxy.sock")
+    server = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    server.bind(sock_path)
+    server.listen(1)
+    got = []
+
+    def serve():
+        conn, _ = server.accept()
+        got.append(conn.recv(4096))
+        conn.sendall(b"\n")
+        conn.close()
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+    em = WeightEmitter(haproxy_socket=sock_path, backend="fl")
+    assert em.update(ROSTER) is True
+    t.join(timeout=2)
+    server.close()
+    assert b"set weight fl/r0 256" in got[0]
+    assert em.pushes == 1
+
+
+# ---------------------------------------------------------------------------
+# steering proxy
+# ---------------------------------------------------------------------------
+
+def test_pick_backend_contract():
+    import random
+
+    rng = random.Random(7)
+    assert pick_backend([], 0, rng) is None
+    drained = [dict(p, state="draining") for p in ROSTER]
+    assert pick_backend(drained, 0, rng) is None
+    # routable only, ingest-port mapping, share-weighted distribution
+    counts = {"10.0.0.1:6514": 0, "10.0.0.2:6514": 0}
+    for _ in range(2000):
+        counts[pick_backend(ROSTER, 6514, rng)] += 1
+    assert counts["10.0.0.1:6514"] > counts["10.0.0.2:6514"]
+    ratio = counts["10.0.0.1:6514"] / counts["10.0.0.2:6514"]
+    assert 1.1 < ratio < 1.9  # ~0.5/0.35
+
+
+def _capture_backend():
+    """A TCP server that reads a connection to EOF, echoes the bytes
+    back, then closes — exercising both pump directions and the EOF
+    half-close forwarding."""
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(8)
+    port = srv.getsockname()[1]
+
+    def serve():
+        while True:
+            try:
+                conn, _ = srv.accept()
+            except OSError:
+                return
+            chunks = []
+            while True:
+                data = conn.recv(65536)
+                if not data:
+                    break
+                chunks.append(data)
+            conn.sendall(b"".join(chunks))
+            conn.close()
+
+    threading.Thread(target=serve, daemon=True).start()
+    return srv, port
+
+
+FRAMED_PAYLOADS = {
+    "line": b"<13>web alpha\n<13>web beta\n<13>web gamma\n",
+    "nul": b"<13>one\x00<13>two\x00\x00<13>three\x00",
+    "syslen": b"17 <13>sixteen chars!9 <13>tiny!",
+}
+
+
+@pytest.mark.parametrize("framing", sorted(FRAMED_PAYLOADS))
+def test_proxy_byte_identity_per_framing(framing):
+    """The proxy is invisible at the byte level: what the sender wrote
+    is exactly what the backend read, for every framing's byte shape
+    (separators, embedded NULs, length prefixes)."""
+    srv, port = _capture_backend()
+    roster = [{"rank": 0, "addr": f"127.0.0.1:{port}",
+               "state": "active", "share": 1.0}]
+    proxy = SteeringProxy("127.0.0.1", 0, roster_fn=lambda: roster)
+    proxy.start()
+    try:
+        host, _, pport = proxy.addr.rpartition(":")
+        payload = FRAMED_PAYLOADS[framing]
+        with socket.create_connection((host, int(pport)),
+                                      timeout=5) as c:
+            c.sendall(payload)
+            c.shutdown(socket.SHUT_WR)  # EOF must forward upstream
+            echoed = b""
+            c.settimeout(5)
+            while True:
+                data = c.recv(65536)
+                if not data:
+                    break
+                echoed += data
+        assert echoed == payload
+        assert registry.get("proxy_connections") == 1
+        assert registry.get("proxy_bytes") == 2 * len(payload)
+    finally:
+        proxy.stop()
+        srv.close()
+
+
+def test_proxy_refuses_when_nothing_routable():
+    roster = []
+    proxy = SteeringProxy("127.0.0.1", 0, roster_fn=lambda: roster)
+    proxy.start()
+    try:
+        host, _, pport = proxy.addr.rpartition(":")
+        with socket.create_connection((host, int(pport)), timeout=5) as c:
+            c.settimeout(5)
+            assert c.recv(1) == b""  # closed straight away: the 503
+        assert registry.get("proxy_route_errors") >= 1
+    finally:
+        proxy.stop()
+
+
+def test_proxy_follows_roster_changes_per_connection():
+    """Routing is re-read from the roster every accept: a share change
+    steers the NEXT connection with no restart."""
+    srv_a, port_a = _capture_backend()
+    srv_b, port_b = _capture_backend()
+    roster = [{"rank": 0, "addr": f"127.0.0.1:{port_a}",
+               "state": "active", "share": 1.0}]
+    proxy = SteeringProxy("127.0.0.1", 0, roster_fn=lambda: list(roster))
+    proxy.start()
+    try:
+        host, _, pport = proxy.addr.rpartition(":")
+
+        def round_trip(msg):
+            with socket.create_connection((host, int(pport)),
+                                          timeout=5) as c:
+                c.sendall(msg)
+                c.shutdown(socket.SHUT_WR)
+                c.settimeout(5)
+                out = b""
+                while True:
+                    data = c.recv(65536)
+                    if not data:
+                        break
+                    out += data
+            return out
+
+        assert round_trip(b"first") == b"first"
+        roster[0] = {"rank": 1, "addr": f"127.0.0.1:{port_b}",
+                     "state": "active", "share": 1.0}
+        assert round_trip(b"second") == b"second"
+    finally:
+        proxy.stop()
+        srv_a.close()
+        srv_b.close()
+
+
+# ---------------------------------------------------------------------------
+# plane end-to-end: ticker thread + emitter wiring
+# ---------------------------------------------------------------------------
+
+def test_armed_plane_runs_ticker_and_emits_weights(tmp_path):
+    clock = FakeClock()
+    membership = Membership(rank=0, addr="10.0.0.1:8404", clock=clock)
+    membership.activate()
+    fleet = types.SimpleNamespace(capacity=1.0, membership=membership)
+    path = tmp_path / "weights.map"
+    spec = ControlSpec(interval_s=0.02, weights_path=str(path),
+                       weights_format="nginx", ingest_port=6514)
+    assert spec.any_loop and spec.emits_weights
+    plane = ControlPlane(spec, fleet=fleet, burn_source=lambda: [])
+    plane.start()
+    try:
+        deadline = 50
+        while not path.exists() and deadline:
+            threading.Event().wait(0.02)
+            deadline -= 1
+        assert path.exists()
+        assert "server 10.0.0.1:6514" in path.read_text()
+        assert any(t.name == "control-plane"
+                   for t in threading.enumerate())
+    finally:
+        plane.stop()
+    assert not any(t.name == "control-plane"
+                   for t in threading.enumerate())

@@ -47,6 +47,13 @@ Usage::
                           [--sites coordinator_kill,host_kill,...]
                           [--json] [--keep-dir]
 
+``--durability`` runs the kill-mid-spill / kill-mid-replay WAL drill
+instead; ``--control`` runs the closed-control-loop drills (a flooding
+tenant must be burn-tightened within the reaction bound while a calm
+tenant's bytes stay identical and its SLO green; a degrading host's
+advertised share must decay at its peers BEFORE its decode breaker
+trips) — see ``control_main``.
+
 ``--events K`` cycles K events through ``--sites`` and exits 0 only if
 every drill reconverged and every integrity check held.  ``--json``
 prints one machine-readable report line (bench.py consumes
@@ -835,6 +842,285 @@ def durability_main(args) -> int:
     return 0 if report["ok"] else 1
 
 
+# -- the control-loop drill (--control) --------------------------------------
+
+def control_main(args) -> int:
+    """In-process closed-loop drills (``--control``):
+
+    Drill A — flood-to-tighten with a calm bystander.  A rate-limited
+    noisy tenant floods 10x over its rate while a calm tenant streams
+    steadily; a real SloEngine (short windows) feeds the control
+    plane's admission loop.  Asserts the flooder's bucket rate is
+    controller-tightened within the reaction bound, the
+    ``admission_tighten`` event journals, the calm tenant's delivered
+    bytes are identical to a no-flood reference run, and the calm
+    tenant's own SLO never burns.
+
+    Drill B — share decay beats the breaker.  A degrading device feed
+    (journaled ``device_error`` events + slow ``DecodeBreaker``
+    failures) pressures the share loop; the decayed capacity weight is
+    gossiped to a peer Membership via the ordinary heartbeat fields.
+    Asserts the peer's view of this host's traffic share drops BEFORE
+    the breaker reaches OPEN — the fleet sheds load off a degrading
+    host while it can still serve.
+    """
+    sys.path.insert(0, _REPO)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    from flowgger_tpu import tenancy
+    from flowgger_tpu.config import Config
+    from flowgger_tpu.control import ControlPlane, ControlSpec
+    from flowgger_tpu.fleet.membership import Membership
+    from flowgger_tpu.obs import events as obs_events
+    from flowgger_tpu.obs.slo import Objective, SloEngine
+    from flowgger_tpu.tenancy.admission import AdmissionHandler
+    from flowgger_tpu.tenancy.registry import TenantRegistry
+    from flowgger_tpu.tpu.breaker import OPEN, DecodeBreaker
+    from flowgger_tpu.utils.metrics import registry as metrics
+
+    report = {"metric": "control_chaos", "ok": False, "drills": []}
+    t_run = time.monotonic()
+    reaction_bound_s = 5.0
+
+    def log(msg):
+        if not args.json or args.verbose:
+            print(f"chaos-control: {msg}", file=sys.stderr, flush=True)
+
+    def fresh():
+        metrics.reset()
+        obs_events.journal.reset()
+        obs_events.journal.configure()
+        tenancy.set_current(None)
+
+    class _Capture:
+        quiet_empty = False
+        bare_errors = False
+        ingest_sep = b"\n"
+        ingest_strip_cr = True
+
+        def __init__(self):
+            self.chunks = []
+
+        def ingest_chunk(self, chunk):
+            self.chunks.append(chunk)
+
+        def flush(self):
+            pass
+
+    def calm_chunk(i):
+        return b"".join(b"<13>calm steady line %d.%d\n" % (i, j)
+                        for j in range(4))
+
+    CALM_CHUNKS = 200
+
+    try:
+        # ---------------- drill A: flood tighten, calm untouched -----
+        fresh()
+        reg = TenantRegistry.from_config(Config.from_string(
+            "[tenants.noisy]\nrate = 2000\n[tenants.calm]\n"))
+        reference = [calm_chunk(i) for i in range(CALM_CHUNKS)]
+
+        eng = SloEngine()
+        eng.configure([
+            Objective(name="noisy_sheds", kind="events",
+                      metric="events_tenant_shed", max_per_sec=10.0,
+                      tenant="noisy", fast_window_s=0.4,
+                      slow_window_s=1.2),
+            Objective(name="calm_floor", kind="throughput",
+                      metric="tenant_calm_lines", floor_per_sec=50.0,
+                      objective=0.9, tenant="calm", fast_window_s=0.4,
+                      slow_window_s=1.2),
+        ], interval_s=0)
+        plane = ControlPlane(ControlSpec(admission=True, interval_s=0),
+                             tenants=reg, burn_source=eng.burn_states)
+        noisy = reg.state("noisy")
+        calm_sink = _Capture()
+        calm = AdmissionHandler(calm_sink, reg.state("calm"))
+
+        stop = threading.Event()
+
+        def flood():
+            # ~10x the admitted rate, sustained for the whole drill
+            while not stop.is_set():
+                noisy.admit(64, 4096)
+                time.sleep(0.002)
+
+        calm_fed = threading.Event()
+
+        def feed_calm():
+            for i in range(CALM_CHUNKS):
+                if stop.is_set():
+                    return
+                calm.ingest_chunk(calm_chunk(i))
+                time.sleep(0.01)
+            calm_fed.set()
+
+        flooder = threading.Thread(target=flood, daemon=True)
+        feeder = threading.Thread(target=feed_calm, daemon=True)
+        t0 = time.monotonic()
+        flooder.start()
+        feeder.start()
+        reaction_s = None
+        calm_burned = False
+        deadline = t0 + args.window
+        while time.monotonic() < deadline:
+            eng.tick()
+            plane.tick()
+            for b in eng.burn_states():
+                # judge the calm SLO only while the feed is live — the
+                # instant after the last chunk its throughput is 0 by
+                # construction, which is not the flood's fault
+                if b["tenant"] == "calm" and b["burning"] \
+                        and not calm_fed.is_set():
+                    calm_burned = True
+            if reaction_s is None and noisy.rate_factor < 1.0:
+                reaction_s = time.monotonic() - t0
+                log(f"drill A: noisy tightened to "
+                    f"{noisy.rate_factor:.0%} after {reaction_s:.2f}s")
+            if reaction_s is not None and calm_fed.is_set():
+                break
+            time.sleep(0.1)
+        stop.set()
+        flooder.join(timeout=2)
+        feeder.join(timeout=5)
+        eng.stop()
+        # the counter mirror, not the ring: the sustained shed flood
+        # evicts older events from the bounded journal, but every emit
+        # also bumps events_<reason> in the registry
+        tighten_events = int(metrics.get("events_admission_tighten"))
+        if reaction_s is None:
+            raise ChaosError(
+                "drill A: the flooding tenant was never tightened")
+        if reaction_s >= reaction_bound_s:
+            raise ChaosError(
+                f"drill A: tighten took {reaction_s:.2f}s "
+                f"(bound {reaction_bound_s}s)")
+        if tighten_events < 1:
+            raise ChaosError(
+                "drill A: no admission_tighten event journaled")
+        if not calm_fed.is_set():
+            raise ChaosError("drill A: calm feed never completed")
+        if calm_sink.chunks != reference:
+            raise ChaosError(
+                "drill A: the calm tenant's bytes diverged under the "
+                "flood — isolation broken")
+        if calm_burned:
+            raise ChaosError(
+                "drill A: the calm tenant's SLO burned under the flood")
+        if reg.state("calm").rate_factor != 1.0:
+            raise ChaosError(
+                "drill A: the controller touched the calm tenant")
+        log(f"drill A held: tightened {noisy.rate_factor:.0%} in "
+            f"{reaction_s:.2f}s; calm byte-identical "
+            f"({len(reference)} chunks), calm SLO green")
+        report["drills"].append({
+            "drill": "flood_tighten", "reaction_s": round(reaction_s, 2),
+            "noisy_factor": round(noisy.rate_factor, 3),
+            "tighten_events": tighten_events,
+            "calm_chunks": len(reference),
+            "calm_byte_identical": True, "calm_slo_green": True,
+            "ok": True})
+
+        # ---------------- drill B: share decay beats the breaker -----
+        fresh()
+        local = Membership(rank=0, addr="127.0.0.1:9001", capacity=2.0)
+        local.activate()
+        local.note_heartbeat(1, "127.0.0.1:9002", capacity=2.0)
+        peer = Membership(rank=1, addr="127.0.0.1:9002", capacity=2.0)
+        peer.activate()
+        peer.note_heartbeat(0, "127.0.0.1:9001", capacity=2.0)
+        base_share = peer.shares()[0]
+
+        eng2 = SloEngine()
+        eng2.configure([Objective(
+            name="host_device", kind="events",
+            metric="events_device_error", max_per_sec=2.0,
+            fast_window_s=0.4, slow_window_s=1.2)], interval_s=0)
+        fleet = type("F", (), {"capacity": 2.0, "membership": local})()
+        plane2 = ControlPlane(ControlSpec(share=True, interval_s=0),
+                              fleet=fleet, burn_source=eng2.burn_states)
+        # 60 consecutive failures at 20/s = the breaker trips ~3s in;
+        # the SLO windows (0.4s/1.2s) see the same feed burning within
+        # ~1.3s — the share loop must win that race
+        breaker = DecodeBreaker(failures=60, cooldown_ms=60_000)
+
+        stop2 = threading.Event()
+
+        def degrade():
+            # a slowly failing device: each failure journals (the burn
+            # signal) and feeds the breaker ladder (the trip signal)
+            while not stop2.is_set():
+                obs_events.emit("chaos", "device_error",
+                                detail="injected device failure")
+                breaker.record_failure(RuntimeError("injected"))
+                time.sleep(0.05)
+
+        degrader = threading.Thread(target=degrade, daemon=True)
+        t0 = time.monotonic()
+        degrader.start()
+        t_decay = t_open = None
+        deadline = t0 + args.window
+        while time.monotonic() < deadline:
+            eng2.tick()
+            plane2.tick()
+            # the decayed weight rides the ordinary heartbeat fields
+            me = local.roster()[0]
+            peer.note_heartbeat(0, me["addr"], state=me["state"],
+                                capacity=me["capacity"])
+            if t_decay is None and \
+                    peer.shares().get(0, 0.0) < base_share - 0.01:
+                t_decay = time.monotonic() - t0
+                if breaker.state == OPEN:
+                    raise ChaosError(
+                        "drill B: the breaker tripped before the share "
+                        "decayed — feedback too slow")
+                log(f"drill B: peer sees share "
+                    f"{peer.shares()[0]:.1%} (was {base_share:.1%}) "
+                    f"after {t_decay:.2f}s; breaker still "
+                    f"{breaker.state}")
+            if breaker.state == OPEN:
+                t_open = time.monotonic() - t0
+                break
+            time.sleep(0.1)
+        stop2.set()
+        degrader.join(timeout=2)
+        eng2.stop()
+        if t_decay is None:
+            raise ChaosError(
+                "drill B: the peer never saw the share decay")
+        if t_open is None:
+            raise ChaosError(
+                "drill B: the breaker never tripped — the failure feed "
+                "was not degrading for real")
+        if not (t_decay < t_open):
+            raise ChaosError(
+                f"drill B: decay at {t_decay:.2f}s did not precede the "
+                f"breaker trip at {t_open:.2f}s")
+        decay_events = int(metrics.get("events_share_decay"))
+        if decay_events < 1:
+            raise ChaosError("drill B: no share_decay event journaled")
+        log(f"drill B held: share decayed at {t_decay:.2f}s, breaker "
+            f"opened at {t_open:.2f}s")
+        report["drills"].append({
+            "drill": "share_decay_before_breaker",
+            "decay_s": round(t_decay, 2), "breaker_open_s": round(t_open, 2),
+            "peer_share": round(peer.shares().get(0, 0.0), 4),
+            "base_share": round(base_share, 4),
+            "share_decay_events": decay_events, "ok": True})
+        report["ok"] = True
+    except ChaosError as e:
+        report["error"] = str(e)
+        print(f"chaos-control: FAILED: {e}", file=sys.stderr)
+    except Exception as e:  # harness bug: report it, don't hang CI
+        import traceback
+
+        traceback.print_exc()
+        report["error"] = f"harness error: {e!r}"
+    report["wall_s"] = round(time.monotonic() - t_run, 1)
+    print(json.dumps(report))
+    return 0 if report["ok"] else 1
+
+
 def harness_main(args) -> int:
     sites = [s.strip() for s in args.sites.split(",") if s.strip()]
     unknown = [s for s in sites if s not in DRILLS]
@@ -923,6 +1209,10 @@ def main(argv=None) -> int:
                          "drill instead of the fleet drills")
     ap.add_argument("--durability-worker", action="store_true",
                     help="internal: run one durability drill worker")
+    ap.add_argument("--control", action="store_true",
+                    help="run the closed-loop control drills (flood "
+                         "tighten + share decay) instead of the fleet "
+                         "drills")
     ap.add_argument("--phase", default="spill",
                     choices=("spill", "replay"))
     ap.add_argument("--spill-dir", default="wal")
@@ -955,6 +1245,8 @@ def main(argv=None) -> int:
         return durability_worker_main(args)
     if args.durability:
         return durability_main(args)
+    if args.control:
+        return control_main(args)
     return harness_main(args)
 
 

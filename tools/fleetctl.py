@@ -5,6 +5,8 @@
     fleetctl.py drain  <host:port>             ask the host to drain
     fleetctl.py top    <host:port> [--interval N | --once] [--json]
                                                live per-host fleet table
+    fleetctl.py weights <host:port> --render haproxy|nginx
+                                               one-shot LB weight render
 
 ``top`` is the operator's one-glance fleet view: it follows the
 ``fleet.rendezvous`` announced by whatever host you point it at, pulls
@@ -29,6 +31,17 @@ not a fleet health endpoint.
 ``drain`` POSTs ``/drain`` — the remote equivalent of SIGTERM:
 drain-on-departure flushes in-flight batches byte-identically while
 fleet peers absorb new traffic.  Exit 0 once the host acknowledges.
+
+``weights`` renders the live ``fleet.shares`` as LB configuration —
+haproxy ``server`` stanzas or an nginx ``upstream`` block — for LBs
+that only take config files (the continuous twin is the in-process
+weight emitter, ``control.weights_path`` / ``control.haproxy_socket``;
+see flowgger_tpu/control/emitter.py).  Pipe into the LB's config and
+reload:
+
+    fleetctl.py weights 10.0.0.1:8600 --render nginx \\
+        --ingest-port 514 > /etc/nginx/conf.d/flowgger-upstream.conf
+
 
 Stdlib-only on purpose: this is the tool an operator runs from a
 bastion box where the flowgger venv may not exist.
@@ -165,6 +178,43 @@ def _rates(prev, doc, now):
     return out
 
 
+_TENANT_STATE = {0: "ok", 1: "throttled", 2: "shed"}
+
+
+def _tenant_admission(doc, ctrl):
+    """Per-tenant admission cells for the top header: worst
+    ``tenant_{name}_state`` gauge across hosts plus the controller's
+    AIMD rate factor (tightest host wins) when it is below 1.0."""
+    states = {}
+    factors = {str(k): float(v)
+               for k, v in (ctrl.get("tenants") or {}).items()}
+    for host in doc.get("hosts", []):
+        for key, val in (host.get("metrics") or {}).items():
+            if not key.startswith("tenant_"):
+                continue
+            if key.endswith("_state"):
+                name = key[len("tenant_"):-len("_state")]
+                try:
+                    states[name] = max(states.get(name, 0), int(val))
+                except (TypeError, ValueError):
+                    pass
+            elif key.endswith("_rate_factor"):
+                name = key[len("tenant_"):-len("_rate_factor")]
+                try:
+                    factors[name] = min(factors.get(name, 1.0),
+                                        float(val))
+                except (TypeError, ValueError):
+                    pass
+    cells = []
+    for name in sorted(set(states) | set(factors)):
+        cell = f"{name}={_TENANT_STATE.get(states.get(name, 0), '?')}"
+        factor = factors.get(name)
+        if factor is not None and factor < 1.0:
+            cell += f" (ctl {factor:.0%})"
+        cells.append(cell)
+    return cells
+
+
 def _render_top(doc, serving, rates) -> str:
     slo = doc.get("slo") or {}
     burning = {o["name"] for o in slo.get("objectives", [])
@@ -184,6 +234,17 @@ def _render_top(doc, serving, rates) -> str:
         f"{slo.get('burning', 0)} burning"
         + (f" [{', '.join(sorted(burning))}]" if burning else "")
         + f" — sentinel regressions: {sent.get('regressions', 0)}")
+    ctrl = doc.get("control") or {}
+    if ctrl.get("enabled"):
+        # the control plane's autoscale verdict: what the fleet SIZE
+        # should be, for an external autoscaler to act on
+        lines.append(
+            f"control: desired hosts {ctrl.get('desired_hosts', 0)}"
+            f" — host capacity factor "
+            f"{float(ctrl.get('capacity_factor', 1.0)):.0%}")
+    tenants = _tenant_admission(doc, ctrl)
+    if tenants:
+        lines.append("tenants: " + "  ".join(tenants))
     lines.append(f"{'RANK':>4} {'STATE':<9} {'SHARE':>6} {'LINES/S':>10} "
                  f"{'EVENTS':>7} {'SLO':<12} FRESHNESS")
     for host in sorted(doc.get("hosts", []), key=lambda h: h["rank"]):
@@ -252,6 +313,81 @@ def cmd_drain(addr: str) -> int:
     return 0
 
 
+# -- weights -----------------------------------------------------------------
+# Stdlib duplicate of flowgger_tpu/control/emitter.py's rendering (this
+# tool must run where the flowgger venv may not exist).  Keep the weight
+# mapping in lockstep: routable share scaled into [1, 256], weight 0 /
+# ``down`` for non-routable hosts.
+
+_ROUTABLE_STATES = ("joining", "active")
+_MAX_WEIGHT = 256
+
+
+def _scaled_weights(peers):
+    routable = [p for p in peers if p.get("state") in _ROUTABLE_STATES]
+    top = max((float(p.get("share", 0.0)) for p in routable), default=0.0)
+    out = {}
+    for p in peers:
+        rank = int(p["rank"])
+        if p.get("state") not in _ROUTABLE_STATES or top <= 0:
+            out[rank] = 0
+            continue
+        share = float(p.get("share", 0.0))
+        out[rank] = max(1, min(_MAX_WEIGHT,
+                               round(share / top * _MAX_WEIGHT)))
+    return out
+
+
+def _ingest_addr(fleet_addr: str, ingest_port: int) -> str:
+    host = fleet_addr.rsplit(":", 1)[0] if ":" in fleet_addr else fleet_addr
+    return f"{host}:{ingest_port}" if ingest_port > 0 else fleet_addr
+
+
+def _render_weights(peers, fmt: str, backend: str,
+                    ingest_port: int) -> str:
+    weights = _scaled_weights(peers)
+    ordered = sorted(peers, key=lambda p: int(p["rank"]))
+    if fmt == "nginx":
+        lines = [f"upstream {backend} {{",
+                 "    # rendered from fleet.shares; do not hand-edit"]
+        for p in ordered:
+            rank = int(p["rank"])
+            addr = _ingest_addr(str(p["addr"]), ingest_port)
+            if weights[rank] > 0:
+                lines.append(f"    server {addr} "
+                             f"weight={weights[rank]};  # r{rank} "
+                             f"{p.get('state')}")
+            else:
+                lines.append(f"    server {addr} down;  # r{rank} "
+                             f"{p.get('state')}")
+        lines.append("}")
+        return "\n".join(lines) + "\n"
+    lines = [f"# backend {backend} — rendered from fleet.shares; do "
+             "not hand-edit"]
+    for p in ordered:
+        rank = int(p["rank"])
+        addr = _ingest_addr(str(p["addr"]), ingest_port)
+        lines.append(f"server r{rank} {addr} weight {weights[rank]} "
+                     f"check  # state={p.get('state')}")
+    return "\n".join(lines) + "\n"
+
+
+def cmd_weights(addr: str, fmt: str, backend: str,
+                ingest_port: int) -> int:
+    try:
+        _, doc = _fetch(addr, "/healthz")
+    except (OSError, ValueError) as e:
+        print(f"error: {addr}: {e}", file=sys.stderr)
+        return 2
+    peers = (doc.get("fleet") or {}).get("peers") or []
+    if not peers:
+        print(f"error: {addr}: health document carries no fleet peers",
+              file=sys.stderr)
+        return 2
+    sys.stdout.write(_render_weights(peers, fmt, backend, ingest_port))
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="fleetctl", description=__doc__,
                                  formatter_class=argparse.RawDescriptionHelpFormatter)
@@ -272,11 +408,25 @@ def main(argv=None) -> int:
                     help="print one table and exit (scriptable)")
     tp.add_argument("--json", action="store_true",
                     help="dump the raw /fleetz document and exit")
+    wt = sub.add_parser("weights", help="render live fleet shares as "
+                        "LB config (one-shot; stdout)")
+    wt.add_argument("addr", help="any fleet host's health endpoint")
+    wt.add_argument("--render", choices=("haproxy", "nginx"),
+                    default="haproxy",
+                    help="output format (default haproxy)")
+    wt.add_argument("--backend", default="flowgger",
+                    help="LB backend/upstream name (default flowgger)")
+    wt.add_argument("--ingest-port", type=int, default=0,
+                    help="ingest listener port to substitute into peer "
+                    "addresses (0 = use the fleet address as-is)")
     args = ap.parse_args(argv)
     if args.verb == "status":
         return cmd_status(args.addr, args.json)
     if args.verb == "top":
         return cmd_top(args.addr, args.interval, args.once, args.json)
+    if args.verb == "weights":
+        return cmd_weights(args.addr, args.render, args.backend,
+                           args.ingest_port)
     return cmd_drain(args.addr)
 
 
