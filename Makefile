@@ -1,9 +1,16 @@
 # Developer shortcuts; ci.sh remains the canonical CI entry point.
-.PHONY: flowcheck flowcheck-baseline test native lint ci
+.PHONY: flowcheck flowcheck-fast flowcheck-baseline test native lint ci
 
-# static analysis gate (FC01-FC05); pure ast, runs in seconds
+# static analysis gate (FC01-FC10); pure ast, runs in seconds.
+# --check also fails on stale baseline tombstones; --expect-rules
+# asserts the registry actually loaded all ten rules.
 flowcheck:
-	python -m flowgger_tpu.analysis --format text .
+	python -m flowgger_tpu.analysis --format text --check --expect-rules 10 .
+
+# pre-commit path: only files changed vs HEAD (plus untracked).
+# Full-tree flowcheck stays the ci.sh gate; this is the fast loop.
+flowcheck-fast:
+	python -m flowgger_tpu.analysis --format text --changed HEAD .
 
 # freeze current findings (then edit the "reason" fields in
 # .flowcheck-baseline.json — see README "Static analysis")

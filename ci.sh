@@ -9,9 +9,27 @@ set -euo pipefail
 cd "$(dirname "$0")"
 
 echo "== flowcheck (static analysis: trace-safety, thread discipline, =="
-echo "==            byte-identity contracts, exception hygiene, keys) =="
-# pure-ast, no JAX import: fails on any non-baselined FC01-FC05 finding
-python -m flowgger_tpu.analysis --format text .
+echo "==   byte-identity, exceptions, keys, metrics, locks, events,   =="
+echo "==            fault-site coverage, thread/fd lifecycle)         =="
+# pure-ast, no JAX import: fails on any non-baselined FC01-FC10
+# finding.  --expect-rules pins the registry size (a rule that fails
+# to register would otherwise pass as "no findings"); --check fails on
+# stale baseline tombstones.  Wall time is printed on stderr; the
+# full-tree scan is bounded at 15s (it measures ~5s here) so the gate
+# can never quietly eat the CI budget.
+timeout 15 python -m flowgger_tpu.analysis --format text --check --expect-rules 10 .
+
+# SARIF surface: emit the same run as SARIF and shape-check it, then
+# prove --validate-sarif fast-fails (exit 2) on a malformed document.
+python -m flowgger_tpu.analysis --format text --sarif-out /tmp/flowcheck.sarif . >/dev/null
+python -m flowgger_tpu.analysis --validate-sarif /tmp/flowcheck.sarif
+echo '{"version": "9.9.9", "runs": []}' > /tmp/flowcheck-bad.sarif
+if python -m flowgger_tpu.analysis --validate-sarif /tmp/flowcheck-bad.sarif 2>/dev/null; then
+  echo "flowcheck: --validate-sarif accepted a malformed SARIF doc" >&2; exit 1
+else
+  rc=$?; [ "$rc" -eq 2 ] || { echo "flowcheck: expected exit 2 on malformed SARIF, got $rc" >&2; exit 1; }
+fi
+rm -f /tmp/flowcheck.sarif /tmp/flowcheck-bad.sarif
 
 echo "== BENCH series trajectory check (tools/bench_trend.py) =="
 # every BENCH_r*.json must parse into the trajectory table (the r06

@@ -25,7 +25,22 @@ Rules (see ``flowcheck --list-rules`` / README "Static analysis"):
 - **FC04 exception hygiene** — no bare/swallowing ``except`` in
   supervised threads, sinks, transports, or the breaker;
 - **FC05 config-key drift** — the ``lint.py`` known-key namespace must
-  match the ``config.lookup*`` call sites the code actually reads.
+  match the ``config.lookup*`` call sites the code actually reads;
+- **FC06 metric-name discipline** — every counter/gauge/histogram name
+  resolves against the ``utils/metrics.py`` declarations (no typo'd
+  silently-dead series);
+- **FC07 lock discipline** — no journal emit / sink write / file I/O
+  while holding a lock (stage-under-lock, emit-after-release), and the
+  per-module lock-acquisition graph stays acyclic;
+- **FC08 degradation-event completeness** — every decline/trip/shed
+  site reaches a typed ``obs/events.py`` emit with a reason registered
+  in the ``REASONS`` vocabulary (and no dead vocabulary);
+- **FC09 fault-site coverage** — every ``utils/faultinject.py`` site is
+  registered in ``KNOWN_SITES``, documented in the ``flowgger.toml``
+  fault catalog, and drilled by a test or ``tools/chaos.py``;
+- **FC10 thread/resource lifecycle** — every thread start leaves a
+  reachable join path for drain, every instance-state fd/socket has a
+  close path.
 
 The package is deliberately dependency-free (``ast`` + stdlib only; no
 JAX, no numpy) so ``python -m flowgger_tpu.analysis`` runs in seconds on

@@ -1,20 +1,60 @@
 """CLI: ``python -m flowgger_tpu.analysis [root] [options]``.
 
-Exit codes: 0 = clean (no non-baselined findings), 1 = findings,
-2 = usage/internal error (unknown rule, malformed baseline, bad root).
-Pure ``ast`` + stdlib — no JAX import, so this runs in seconds and
-gates CI before the test suite starts.
+Exit codes: 0 = clean (no non-baselined findings), 1 = findings (or a
+stale baseline under ``--check``), 2 = usage/internal error (unknown
+rule, malformed baseline, bad root, rule-count mismatch, malformed
+SARIF).  Pure ``ast`` + stdlib — no JAX import, so this runs in seconds
+and gates CI before the test suite starts.
+
+Modes:
+
+- full run (default) — every rule over the whole tree; the ci.sh gate.
+  ``--check`` additionally fails on stale baseline entries: a baseline
+  row no current finding consumes is a fixed finding whose tombstone
+  must be deleted (zero unexplained baseline growth AND shrinkage).
+- ``--changed REF`` — the pre-commit path: per-module rules run only on
+  files changed vs ``REF`` (plus untracked files); cross-module rules
+  still see the whole tree but report only into the changed set.
+  Stale-baseline enforcement is skipped — a partial run cannot tell
+  "fixed" from "not checked".
+- ``--validate-sarif FILE`` — standalone shape-check of a SARIF
+  document (exit 0 valid / 2 malformed), the ci.sh fast-fail before an
+  upload step.
 """
 
 from __future__ import annotations
 
 import argparse
 import os
+import subprocess
 import sys
+import time
 
 from . import baseline as baseline_mod
 from .core import all_rules, run_check
-from .reporters import RENDERERS
+from .reporters import RENDERERS, render_sarif, validate_sarif
+
+
+def _changed_paths(root: str, ref: str):
+    """Rel posix paths of ``*.py`` files changed vs ``ref`` (committed,
+    staged, or working-tree changes) plus untracked files.  Returns None
+    when git cannot answer (not a repo, bad ref) — the caller exits 2."""
+    out = []
+    for cmd in (["git", "diff", "--name-only", ref, "--"],
+                ["git", "ls-files", "--others", "--exclude-standard"]):
+        try:
+            proc = subprocess.run(  # noqa: S603 - fixed argv, no shell
+                cmd, cwd=root, capture_output=True, text=True, timeout=30)
+        except (OSError, subprocess.TimeoutExpired) as e:
+            print(f"flowcheck: cannot run {' '.join(cmd)}: {e}",
+                  file=sys.stderr)
+            return None
+        if proc.returncode != 0:
+            print(f"flowcheck: {' '.join(cmd)} failed: "
+                  f"{proc.stderr.strip()}", file=sys.stderr)
+            return None
+        out.extend(line.strip() for line in proc.stdout.splitlines())
+    return {p.replace(os.sep, "/") for p in out if p.endswith(".py")}
 
 
 def main(argv=None) -> int:
@@ -22,7 +62,9 @@ def main(argv=None) -> int:
         prog="flowcheck",
         description="AST-based invariant checker for flowgger-tpu "
                     "(trace-safety, thread discipline, byte-identity "
-                    "contracts, exception hygiene, config-key drift)")
+                    "contracts, exception hygiene, config-key drift, "
+                    "lock discipline, degradation-event completeness, "
+                    "fault-site coverage, thread/resource lifecycle)")
     parser.add_argument("root", nargs="?", default=".",
                         help="scan root (default: current directory)")
     parser.add_argument("--format", choices=sorted(RENDERERS),
@@ -38,14 +80,59 @@ def main(argv=None) -> int:
     parser.add_argument("--write-baseline", action="store_true",
                         help="freeze current findings into the baseline "
                              "file and exit 0")
+    parser.add_argument("--check", action="store_true",
+                        help="strict CI mode: a stale baseline entry "
+                             "(no longer produced by a full run) is a "
+                             "failure — delete the tombstone")
+    parser.add_argument("--changed", metavar="REF",
+                        help="incremental mode: scan only *.py files "
+                             "changed vs the given git ref (plus "
+                             "untracked files)")
+    parser.add_argument("--expect-rules", type=int, metavar="N",
+                        help="exit 2 unless exactly N rules are "
+                             "registered (CI guard against a rule "
+                             "module silently failing to load)")
+    parser.add_argument("--sarif-out", metavar="FILE",
+                        help="additionally write the SARIF report to "
+                             "FILE (independent of --format)")
+    parser.add_argument("--validate-sarif", metavar="FILE",
+                        help="validate a SARIF file's shape and exit "
+                             "(0 = valid, 2 = malformed); no scan runs")
     parser.add_argument("--list-rules", action="store_true",
                         help="print the rule catalog and exit")
     args = parser.parse_args(argv)
+
+    if args.validate_sarif:
+        try:
+            with open(args.validate_sarif, "r", encoding="utf-8") as fd:
+                text = fd.read()
+        except OSError as e:
+            print(f"flowcheck: cannot read {args.validate_sarif!r}: {e}",
+                  file=sys.stderr)
+            return 2
+        problems = validate_sarif(text)
+        if problems:
+            for p in problems:
+                print(f"flowcheck: sarif: {p}", file=sys.stderr)
+            print(f"flowcheck: {args.validate_sarif} is malformed SARIF "
+                  f"({len(problems)} problem(s))", file=sys.stderr)
+            return 2
+        print(f"flowcheck: {args.validate_sarif} is well-formed SARIF "
+              f"{'2.1.0'}")
+        return 0
 
     if args.list_rules:
         for rule in all_rules().values():
             print(f"{rule.id}  {rule.title}")
         return 0
+
+    if args.expect_rules is not None:
+        have = len(all_rules())
+        if have != args.expect_rules:
+            print(f"flowcheck: expected {args.expect_rules} registered "
+                  f"rule(s), found {have} — a rule module failed to "
+                  f"load or the gate is out of date", file=sys.stderr)
+            return 2
 
     root = os.path.abspath(args.root)
     if not os.path.isdir(root):
@@ -57,6 +144,16 @@ def main(argv=None) -> int:
     if args.rules:
         rule_ids = [r.strip().upper() for r in args.rules.split(",")
                     if r.strip()]
+
+    only_paths = None
+    if args.changed:
+        only_paths = _changed_paths(root, args.changed)
+        if only_paths is None:
+            return 2
+        if not only_paths:
+            print("flowcheck: no python files changed vs "
+                  f"{args.changed} — nothing to scan")
+            return 0
 
     baseline_path = args.baseline or os.path.join(
         root, baseline_mod.DEFAULT_BASELINE)
@@ -73,12 +170,15 @@ def main(argv=None) -> int:
                   file=sys.stderr)
             return 2
 
+    t0 = time.perf_counter()
     try:
         result = run_check(root, rule_ids=rule_ids,
-                           baseline_keys=baseline_keys)
+                           baseline_keys=baseline_keys,
+                           only_paths=only_paths)
     except KeyError as e:
         print(f"flowcheck: {e.args[0]}", file=sys.stderr)
         return 2
+    wall = time.perf_counter() - t0
 
     if args.write_baseline:
         baseline_mod.write(baseline_path, result.findings)
@@ -86,8 +186,31 @@ def main(argv=None) -> int:
               f"{baseline_path}")
         return 0
 
+    if args.sarif_out:
+        try:
+            with open(args.sarif_out, "w", encoding="utf-8") as fd:
+                fd.write(render_sarif(result))
+                fd.write("\n")
+        except OSError as e:
+            print(f"flowcheck: cannot write {args.sarif_out!r}: {e}",
+                  file=sys.stderr)
+            return 2
+
     print(RENDERERS[args.format](result))
-    return 1 if result.findings else 0
+    # wall time on stderr so json/sarif stdout stays machine-parseable
+    print(f"flowcheck: scanned {len(result.project.modules)} file(s) in "
+          f"{wall:.2f}s", file=sys.stderr)
+
+    stale_failed = False
+    if args.check and result.stale_baseline:
+        for (rule, path, message), count in sorted(
+                result.stale_baseline.items()):
+            print(f"flowcheck: stale baseline entry ({count} leftover): "
+                  f"{rule} {path}: {message} — the finding is gone; "
+                  f"delete the tombstone from the baseline",
+                  file=sys.stderr)
+        stale_failed = True
+    return 1 if (result.findings or stale_failed) else 0
 
 
 if __name__ == "__main__":

@@ -1,0 +1,123 @@
+"""Shared module-local call-graph machinery for the cross-module
+contract rules (FC07–FC10).
+
+The concurrency/degradation rules all reason the same way: "from this
+site, following calls that resolve *module-locally* (a bare ``name(...)``
+or ``self.method(...)`` / ``obj.method(...)`` whose method name a
+function in the same file defines), what is reachable?"  That closure is
+deliberately not a real type analysis — it is the same first-definition-
+wins name resolution FC02 uses, which matches this tree's convention of
+unique helper names per module and keeps the checker pure ``ast``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, Optional, Set
+
+from .core import dotted_name
+
+
+def callable_name(node: ast.AST) -> Optional[str]:
+    """The local function name a callable expression refers to: a bare
+    Name, or the method name of ``self.method`` / ``obj.method``."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return None
+
+
+def receiver_terminal(func: ast.Attribute) -> Optional[str]:
+    """Terminal name of a call receiver: ``_events.emit`` → ``_events``;
+    ``self._sink.write`` → ``_sink``; ``mod.journal.emit`` →
+    ``journal``."""
+    value = func.value
+    if isinstance(value, ast.Name):
+        return value.id
+    if isinstance(value, ast.Attribute):
+        return value.attr
+    return None
+
+
+def build_parents(tree: ast.AST) -> Dict[ast.AST, ast.AST]:
+    """child → parent map for walking up from a found node."""
+    parents: Dict[ast.AST, ast.AST] = {}
+    for parent in ast.walk(tree):
+        for child in ast.iter_child_nodes(parent):
+            parents[child] = parent
+    return parents
+
+
+class FunctionIndex:
+    """Functions/methods of one module by name (first definition wins,
+    the FC02 convention) plus closure computation over them."""
+
+    def __init__(self, tree: ast.Module):
+        self.functions: Dict[str, ast.FunctionDef] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.functions.setdefault(node.name, node)
+
+    def resolve(self, call: ast.Call) -> Optional[str]:
+        """Module-local callee name of a call, or None."""
+        name = callable_name(call.func)
+        return name if name in self.functions else None
+
+    def closure(self, roots: Iterable[str]) -> Set[str]:
+        """Transitive module-local call closure over function names."""
+        seen: Set[str] = set()
+        queue = [r for r in roots if r in self.functions]
+        while queue:
+            name = queue.pop()
+            if name in seen:
+                continue
+            seen.add(name)
+            fn = self.functions.get(name)
+            if fn is None:
+                continue
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call):
+                    callee = self.resolve(node)
+                    if callee is not None and callee not in seen:
+                        queue.append(callee)
+        return seen
+
+    def calls_in(self, names: Iterable[str]) -> Iterable[ast.Call]:
+        """Every Call node in the bodies of the named functions."""
+        for name in names:
+            fn = self.functions.get(name)
+            if fn is None:
+                continue
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call):
+                    yield node
+
+
+def walk_pruned(node: ast.AST) -> Iterable[ast.AST]:
+    """``ast.walk`` that does not descend into nested function/lambda
+    bodies — they run later, on some other thread's clock."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        child = stack.pop()
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+            continue
+        yield child
+        stack.extend(ast.iter_child_nodes(child))
+
+
+def stmt_calls(stmts: Iterable[ast.stmt]) -> Iterable[ast.Call]:
+    """Call nodes in a statement list, nested defs excluded."""
+    for stmt in stmts:
+        if isinstance(stmt, ast.Call):
+            yield stmt
+        for node in walk_pruned(stmt):
+            if isinstance(node, ast.Call):
+                yield node
+
+
+def literal_strings(tree: ast.AST) -> Set[str]:
+    """Every string constant anywhere in a tree (docstrings included)."""
+    return {n.value for n in ast.walk(tree)
+            if isinstance(n, ast.Constant) and isinstance(n.value, str)}

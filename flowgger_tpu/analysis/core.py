@@ -168,6 +168,10 @@ def _load_rules() -> None:
         fc04_exceptions,
         fc05_configkeys,
         fc06_metrics,
+        fc07_lockdiscipline,
+        fc08_events,
+        fc09_faultsites,
+        fc10_lifecycle,
     )
 
 
@@ -224,13 +228,28 @@ class CheckResult:
     baselined: List[Finding]
     suppressed_count: int
     project: Project
+    # baseline entries (key -> leftover count) no visible finding consumed.
+    # Meaningful only on a FULL run (all rules, no path filter) — a subset
+    # run cannot tell "fixed" from "not checked"; run_check leaves this
+    # empty for partial runs.
+    stale_baseline: Dict[Tuple[str, str, str], int] = field(
+        default_factory=dict)
 
 
 def run_check(root: str, rule_ids: Optional[Sequence[str]] = None,
               baseline_keys: Optional[Dict[Tuple[str, str, str], int]] = None,
+              only_paths: Optional[Set[str]] = None,
               ) -> CheckResult:
     """Run the (selected) rules over ``root`` and partition the findings
-    into active / baselined, dropping suppressed ones."""
+    into active / baselined, dropping suppressed ones.
+
+    ``only_paths`` (rel posix paths) is the incremental pre-commit mode:
+    per-module rules run only on those files, and cross-module rules
+    still see the whole tree (their invariants are global) but report
+    only findings landing in the filtered set.  Stale-baseline detection
+    is skipped for any partial run — a rule subset or path filter cannot
+    distinguish a fixed finding from an unchecked one.
+    """
     rules = all_rules()
     if rule_ids is not None:
         unknown = [r for r in rule_ids if r not in rules]
@@ -242,9 +261,13 @@ def run_check(root: str, rule_ids: Optional[Sequence[str]] = None,
     suppress_map = {m.rel: m.suppressions for m in project.modules}
     for rule in rules.values():
         for module in project.modules:
+            if only_paths is not None and module.rel not in only_paths:
+                continue
             if rule.scope(module.rel):
                 raw.extend(rule.check(module, project))
         raw.extend(rule.check_project(project))
+    if only_paths is not None:
+        raw = [f for f in raw if f.path in only_paths]
 
     suppressed = 0
     visible: List[Finding] = []
@@ -265,8 +288,11 @@ def run_check(root: str, rule_ids: Optional[Sequence[str]] = None,
             baselined.append(f)
         else:
             active.append(f)
+    full_run = rule_ids is None and only_paths is None
+    stale = {k: n for k, n in remaining.items() if n > 0} if full_run else {}
     return CheckResult(findings=active, baselined=baselined,
-                       suppressed_count=suppressed, project=project)
+                       suppressed_count=suppressed, project=project,
+                       stale_baseline=stale)
 
 
 # -- shared AST helpers ------------------------------------------------------

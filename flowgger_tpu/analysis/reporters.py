@@ -82,4 +82,79 @@ def render_sarif(result: CheckResult) -> str:
     return json.dumps(payload, indent=2, sort_keys=True)
 
 
+def validate_sarif(text: str) -> List[str]:
+    """Shape-check a SARIF document; returns the list of problems
+    (empty = valid).  Not a full JSON-Schema validation — it asserts the
+    subset GitHub code scanning (and our own tests) depend on, so ci.sh
+    can fast-fail with exit 2 on a malformed upload instead of letting
+    the ingester reject it minutes later."""
+    problems: List[str] = []
+    try:
+        doc = json.loads(text)
+    except ValueError as e:
+        return [f"not valid JSON: {e}"]
+    if not isinstance(doc, dict):
+        return ["top level must be a JSON object"]
+    if doc.get("version") != SARIF_VERSION:
+        problems.append(f"version must be {SARIF_VERSION!r}, "
+                        f"got {doc.get('version')!r}")
+    if not isinstance(doc.get("$schema"), str):
+        problems.append("$schema must be a string URI")
+    runs = doc.get("runs")
+    if not isinstance(runs, list) or not runs:
+        return problems + ["runs must be a non-empty list"]
+    for ri, run in enumerate(runs):
+        driver = run.get("tool", {}).get("driver", {}) \
+            if isinstance(run, dict) else {}
+        if not isinstance(driver, dict) \
+                or not isinstance(driver.get("name"), str):
+            problems.append(f"runs[{ri}].tool.driver.name must be a string")
+            continue
+        rules = driver.get("rules", [])
+        if not isinstance(rules, list) or any(
+                not isinstance(r, dict) or not isinstance(r.get("id"), str)
+                for r in rules):
+            problems.append(f"runs[{ri}] rules must each carry a string id")
+        known = {r.get("id") for r in rules if isinstance(r, dict)}
+        results = run.get("results")
+        if not isinstance(results, list):
+            problems.append(f"runs[{ri}].results must be a list")
+            continue
+        for i, res in enumerate(results):
+            where = f"runs[{ri}].results[{i}]"
+            if not isinstance(res, dict):
+                problems.append(f"{where} must be an object")
+                continue
+            if not isinstance(res.get("ruleId"), str):
+                problems.append(f"{where}.ruleId must be a string")
+            elif known and res["ruleId"] not in known:
+                problems.append(f"{where}.ruleId {res['ruleId']!r} is not "
+                                f"declared in the driver rules")
+            msg = res.get("message")
+            if not isinstance(msg, dict) \
+                    or not isinstance(msg.get("text"), str):
+                problems.append(f"{where}.message.text must be a string")
+            locs = res.get("locations")
+            if not isinstance(locs, list) or not locs:
+                problems.append(f"{where}.locations must be non-empty")
+                continue
+            for li, loc in enumerate(locs):
+                phys = loc.get("physicalLocation", {}) \
+                    if isinstance(loc, dict) else {}
+                art = phys.get("artifactLocation", {}) \
+                    if isinstance(phys, dict) else {}
+                region = phys.get("region", {}) \
+                    if isinstance(phys, dict) else {}
+                if not isinstance(art, dict) \
+                        or not isinstance(art.get("uri"), str):
+                    problems.append(f"{where}.locations[{li}] needs an "
+                                    f"artifactLocation.uri string")
+                start = region.get("startLine") \
+                    if isinstance(region, dict) else None
+                if not isinstance(start, int) or start < 1:
+                    problems.append(f"{where}.locations[{li}] needs a "
+                                    f"positive integer region.startLine")
+    return problems
+
+
 RENDERERS = {"text": render_text, "json": render_json, "sarif": render_sarif}

@@ -1,0 +1,304 @@
+"""FC07 — lock discipline: no journal/sink/file I/O under a lock, and
+no lock-ordering cycles.
+
+The hardest review-round bugs of the obs/fleet/control PRs were all the
+same two shapes, hand-fixed case by case:
+
+1. **I/O while holding a lock.**  The degradation journal's ``emit``
+   may write a JSONL sink (disk), and every ``open``/``fsync``/
+   ``os.replace``/``print`` is I/O that can stall arbitrarily — doing
+   any of it inside a ``with <lock>:`` region (or between
+   ``lock.acquire()`` and ``lock.release()``) serializes every thread
+   contending on that lock behind the disk, exactly when overload makes
+   those events fire fastest.  The sanctioned pattern is
+   **stage-under-lock, emit-after-release** (``fairqueue._drain_events``,
+   ``federation._fleet_watch``); this rule makes it mechanical.  Helper
+   calls that resolve module-locally are followed (the
+   ``maybe_save → _save_locked`` shape hides the I/O one hop away), so
+   the check sees through the ``*_locked`` helper convention.  FC02
+   keeps ownership of queue/socket blocking calls; FC07 owns the
+   journal/sink/file-I/O class.
+
+2. **Lock-ordering cycles.**  Per module, every ``with A: ... with B:``
+   nesting (direct, or through a module-locally resolved helper that
+   acquires) contributes an edge A→B to the lock-acquisition graph; a
+   cycle means two threads can deadlock by acquiring the same pair in
+   opposite orders.  Taking a lock again while it is already held is
+   the one-node cycle (flagged unless the module constructs it as an
+   ``RLock``).
+
+Lock spelling: a context expression whose terminal name contains
+``lock``/``mutex``/``cond`` or is one of the ``queue.Queue`` condition
+names (``not_empty``/``not_full``/``all_tasks_done`` — they wrap the
+queue mutex) counts as a lock, as does an inline
+``threading.Lock()``/``RLock()`` construction.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from ..callgraph import FunctionIndex, receiver_terminal, stmt_calls
+from ..core import Finding, Module, Project, Rule, dotted_name, register
+
+_LOCK_HINTS = ("lock", "mutex", "cond")
+_LOCK_EXACT = frozenset({"not_empty", "not_full", "all_tasks_done"})
+
+# receivers that mean "the degradation journal" / "a JSONL sink"
+_EMIT_RECEIVERS = frozenset({"events", "_events", "journal", "_journal"})
+_SINK_RECEIVERS = frozenset({"sink", "_sink"})
+
+# direct file I/O: anything here under a lock convoys every contending
+# thread behind the disk.  ``print`` is deliberately NOT in the set —
+# stderr diagnostics on cold decline paths are pervasive and cheap; the
+# contract this rule enforces is about the journal/sink/disk class.
+_IO_NAME_CALLS = frozenset({"open"})
+_IO_DOTTED_CALLS = frozenset({"os.fsync", "os.replace", "os.rename"})
+
+
+def _lock_name(expr: ast.AST) -> Optional[str]:
+    """Normalized lock identity of a with/acquire context, or None."""
+    name = dotted_name(expr)
+    if name is not None:
+        terminal = name.split(".")[-1]
+        low = terminal.lower()
+        if terminal in _LOCK_EXACT or any(h in low for h in _LOCK_HINTS):
+            # strip a leading self./cls. so `self._lock` and `_lock`
+            # are one node in the acquisition graph
+            parts = name.split(".")
+            if parts[0] in ("self", "cls"):
+                parts = parts[1:]
+            return ".".join(parts) or terminal
+    if isinstance(expr, ast.Call):
+        inner = dotted_name(expr.func) or ""
+        if inner.split(".")[-1] in ("Lock", "RLock"):
+            return "<inline-lock>"
+    return None
+
+
+def _module_rlocks(tree: ast.Module) -> Set[str]:
+    """Attribute/variable names assigned a ``threading.RLock()`` —
+    re-acquiring those while held is legal by construction."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            callee = dotted_name(node.value.func) or ""
+            if callee.split(".")[-1] == "RLock":
+                for target in node.targets:
+                    name = dotted_name(target)
+                    if name:
+                        parts = name.split(".")
+                        if parts[0] in ("self", "cls"):
+                            parts = parts[1:]
+                        out.add(".".join(parts))
+    return out
+
+
+def _classify_io(call: ast.Call) -> Optional[str]:
+    """Human label of a journal/sink/file I/O call, or None."""
+    func = call.func
+    if isinstance(func, ast.Attribute):
+        recv = receiver_terminal(func)
+        if func.attr == "emit" and recv in _EMIT_RECEIVERS:
+            return "journal emit"
+        if func.attr == "write" and recv in _SINK_RECEIVERS:
+            return "sink write"
+    callee = dotted_name(func)
+    if callee in _IO_DOTTED_CALLS:
+        return f"{callee}() file I/O"
+    if isinstance(func, ast.Name) and func.id in _IO_NAME_CALLS:
+        return f"{func.id}() I/O"
+    return None
+
+
+@register
+class LockDiscipline(Rule):
+    id = "FC07"
+    title = ("lock discipline (no journal/sink/file I/O under locks; "
+             "acyclic lock order)")
+
+    def check(self, module: Module, project: Project) -> Iterable[Finding]:
+        index = FunctionIndex(module.tree)
+        findings: List[Finding] = []
+        reported: Set[Tuple[int, str]] = set()
+        edges: Dict[Tuple[str, str], int] = {}
+        rlocks = _module_rlocks(module.tree)
+        for fn in index.functions.values():
+            self._walk_stmts(fn.body, (), fn.name, index, module,
+                             findings, reported, edges, set())
+        self._check_order(edges, rlocks, module, findings)
+        return findings
+
+    # -- lock-region walk --------------------------------------------------
+    def _walk_stmts(self, stmts, held: Tuple[str, ...], holder: str,
+                    index: FunctionIndex, module: Module,
+                    findings: List[Finding], reported: Set,
+                    edges: Dict, visiting: Set[str]) -> None:
+        for stmt in stmts:
+            # explicit acquire(): the held set grows for the rest of
+            # this statement list (release() shrinks it)
+            acq = self._acquire_name(stmt)
+            if acq is not None:
+                if held:
+                    edges.setdefault((held[-1], acq), stmt.lineno)
+                held = held + (acq,)
+                continue
+            rel = self._release_name(stmt)
+            if rel is not None:
+                held = tuple(h for h in held if h != rel)
+                continue
+            self._visit_stmt(stmt, held, holder, index, module,
+                             findings, reported, edges, visiting)
+
+    def _visit_stmt(self, stmt, held, holder, index, module,
+                    findings, reported, edges, visiting) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return  # separate execution context
+        if isinstance(stmt, ast.With):
+            locks = [n for n in (_lock_name(item.context_expr)
+                                 for item in stmt.items) if n is not None]
+            new_held = held
+            for lock in locks:
+                if new_held:
+                    edges.setdefault((new_held[-1], lock), stmt.lineno)
+                new_held = new_held + (lock,)
+            self._walk_stmts(stmt.body, new_held, holder, index, module,
+                             findings, reported, edges, visiting)
+            return
+        if isinstance(stmt, (ast.If, ast.While, ast.For, ast.AsyncFor,
+                             ast.Try, ast.ClassDef)):
+            # compound statement: its header expression (test/iter)
+            # runs under the current held set too, then each body
+            # recurses with the same held set
+            header = [stmt.test] if isinstance(stmt, (ast.If, ast.While)) \
+                else [stmt.iter] if isinstance(stmt, (ast.For,
+                                                      ast.AsyncFor)) else []
+            if held:
+                for call in stmt_calls(header):
+                    self._check_call(call, held, holder, index, module,
+                                     findings, reported, edges, visiting)
+            for field in ("body", "orelse", "finalbody"):
+                sub = getattr(stmt, field, None)
+                if sub:
+                    self._walk_stmts(sub, held, holder, index, module,
+                                     findings, reported, edges, visiting)
+            for handler in getattr(stmt, "handlers", ()) or ():
+                self._walk_stmts(handler.body, held, holder, index,
+                                 module, findings, reported, edges,
+                                 visiting)
+            return
+        if not held:
+            return
+        # a leaf statement under a lock: classify its calls, following
+        # module-local helpers (the *_locked convention)
+        for call in stmt_calls([stmt]):
+            self._check_call(call, held, holder, index, module,
+                             findings, reported, edges, visiting)
+
+    def _check_call(self, call, held, holder, index, module,
+                    findings, reported, edges, visiting) -> None:
+        label = _classify_io(call)
+        if label is not None:
+            key = (call.lineno, label)
+            if key not in reported:
+                reported.add(key)
+                findings.append(Finding(
+                    self.id, module.rel, call.lineno, call.col_offset,
+                    f"{label} while holding lock '{held[-1]}' in "
+                    f"'{holder}' — stage under the lock, emit/write "
+                    f"after release"))
+            return
+        callee = self._resolve_strict(call, index)
+        if callee is not None and callee not in visiting:
+            fn = index.functions[callee]
+            self._scan_helper(fn, held, f"{holder} -> {callee}", index,
+                              module, findings, reported, edges,
+                              visiting | {callee})
+
+    @staticmethod
+    def _resolve_strict(call: ast.Call,
+                        index: FunctionIndex) -> Optional[str]:
+        """Module-local callee, restricted to the shapes that really
+        mean "this file's function": a bare name or ``self.method`` /
+        ``cls.method``.  Resolving ``obj.method`` by name alone would
+        conflate ``self._fd.write`` with a ``write`` method defined
+        here."""
+        func = call.func
+        if isinstance(func, ast.Name):
+            name = func.id
+        elif isinstance(func, ast.Attribute) \
+                and isinstance(func.value, ast.Name) \
+                and func.value.id in ("self", "cls"):
+            name = func.attr
+        else:
+            return None
+        return name if name in index.functions else None
+
+    def _scan_helper(self, fn, held, holder, index, module,
+                     findings, reported, edges, visiting) -> None:
+        """The caller holds ``held`` while this helper runs: every I/O
+        op and lock acquisition inside counts against the caller's
+        lock."""
+        self._walk_stmts(fn.body, held, holder, index, module,
+                         findings, reported, edges, visiting)
+
+    # -- acquire()/release() statements ------------------------------------
+    def _acquire_name(self, stmt) -> Optional[str]:
+        call = self._bare_call(stmt)
+        if call is not None and isinstance(call.func, ast.Attribute) \
+                and call.func.attr == "acquire":
+            return _lock_name(call.func.value)
+        return None
+
+    def _release_name(self, stmt) -> Optional[str]:
+        call = self._bare_call(stmt)
+        if call is not None and isinstance(call.func, ast.Attribute) \
+                and call.func.attr == "release":
+            return _lock_name(call.func.value)
+        return None
+
+    @staticmethod
+    def _bare_call(stmt) -> Optional[ast.Call]:
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+            return stmt.value
+        return None
+
+    # -- ordering graph ----------------------------------------------------
+    def _check_order(self, edges: Dict[Tuple[str, str], int],
+                     rlocks: Set[str], module: Module,
+                     findings: List[Finding]) -> None:
+        adj: Dict[str, List[str]] = {}
+        for (a, b), _line in edges.items():
+            if a == "<inline-lock>" or b == "<inline-lock>":
+                continue
+            adj.setdefault(a, []).append(b)
+        for (a, b), line in sorted(edges.items(), key=lambda kv: kv[1]):
+            if a == b:
+                if a not in rlocks and a != "<inline-lock>":
+                    findings.append(Finding(
+                        self.id, module.rel, line, 0,
+                        f"lock '{a}' is acquired while already held "
+                        f"(self-deadlock unless it is an RLock)"))
+                continue
+            # is there a path b ~> a?  then a->b closes a cycle
+            if self._reaches(adj, b, a):
+                findings.append(Finding(
+                    self.id, module.rel, line, 0,
+                    f"lock-ordering cycle: '{a}' -> '{b}' here, but "
+                    f"'{b}' -> '{a}' elsewhere in this module — two "
+                    f"threads taking these in opposite orders deadlock"))
+
+    @staticmethod
+    def _reaches(adj: Dict[str, List[str]], src: str, dst: str) -> bool:
+        seen: Set[str] = set()
+        queue = [src]
+        while queue:
+            node = queue.pop()
+            if node == dst:
+                return True
+            if node in seen:
+                continue
+            seen.add(node)
+            queue.extend(adj.get(node, ()))
+        return False

@@ -1,0 +1,419 @@
+"""FC08 — degradation-event completeness.
+
+The decline ladder's observability contract (PR 13): **every**
+decline/trip/shed control-flow site journals a typed event through
+``obs/events.py`` with a reason from the registered ``REASONS``
+vocabulary.  An unjournaled decline is a rung an operator cannot see
+fire; an unregistered reason literal would be a runtime ``ValueError``
+at the worst possible moment (``emit`` rejects unknown reasons).  This
+rule resolves both halves against the events module's AST, the way FC03
+resolves scalar oracles:
+
+1. **Reason vocabulary.**  Every literal reason passed to an emit call
+   (``events.emit`` / ``_events.emit`` / ``journal.emit``) must be a
+   member of the ``REASONS`` tuple.  A variable reason is resolved
+   through literal assignments to that name in the enclosing function
+   (the ``reason = "a" if cond else "b"`` idiom); literals that cannot
+   be resolved are out of scope.
+
+2. **Dead vocabulary.**  A ``REASONS`` entry no source file ever
+   references is a row in the operator-facing table that can never
+   fire — registered-but-unused is the same drift class as FC05's
+   declared-but-never-read config keys.
+
+3. **Decline-path completeness.**  Three mechanical site classes must
+   reach an emit:
+
+   - ``raise *Declined(...)`` / ``raise DurabilityError(...)``: the
+     innermost block holding the raise must emit (directly or through a
+     module-local helper), or some ``except`` handler for that
+     exception type anywhere in the tree must emit — a decline that
+     propagates to a journaling boundary is covered.
+   - a ``_count_drop*`` / ``_count_shed*`` helper must either emit in
+     its closure or **stage** into an attribute that an emitting
+     function of the same module drains (the WFQ
+     ``_event_buf``/``_drain_events`` stage-then-emit pattern).
+   - a degradation counter bump (``inc`` of a ``*_freezes`` /
+     ``*_trips`` / ``*_declines`` counter on a metrics registry) must
+     have an emit on its path — in its innermost block, through the
+     enclosing function's stage-then-drain buffer (the breaker holds
+     its lock across ``_transition``, so it stages and a drain
+     function emits after release), or, for a bump inside a
+     ``_count*`` helper, at every module-local call site (the helper
+     centralizes the counter; the callers own the emit).  The counter
+     says *how often*, the event says *when and why*.
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from ..callgraph import (FunctionIndex, literal_strings,
+                         receiver_terminal, stmt_calls)
+from ..core import Finding, Module, Project, Rule, literal_str, register
+
+_EMIT_RECEIVERS = frozenset({"events", "_events", "journal", "_journal"})
+_METRIC_RECEIVERS = frozenset({"_metrics", "metrics", "registry", "reg",
+                               "_reg", "_global_registry", "_registry"})
+_COUNTER_PATTERNS = ("*_freezes", "*_trips", "*_declines")
+_RAISE_NAMES_EXACT = frozenset({"DurabilityError"})
+_RAISE_SUFFIX = "Declined"
+_COUNT_PREFIXES = ("_count_drop", "_count_shed")
+
+
+def _is_emit(call: ast.Call) -> bool:
+    return (isinstance(call.func, ast.Attribute)
+            and call.func.attr == "emit"
+            and receiver_terminal(call.func) in _EMIT_RECEIVERS)
+
+
+def _reason_node(call: ast.Call) -> Optional[ast.AST]:
+    if len(call.args) >= 2:
+        return call.args[1]
+    for kw in call.keywords:
+        if kw.arg == "reason":
+            return kw.value
+    return None
+
+
+def _raise_name(stmt: ast.Raise) -> Optional[str]:
+    exc = stmt.exc
+    if isinstance(exc, ast.Call):
+        exc = exc.func
+    name = None
+    if isinstance(exc, ast.Name):
+        name = exc.id
+    elif isinstance(exc, ast.Attribute):
+        name = exc.attr
+    if name and (name in _RAISE_NAMES_EXACT
+                 or name.endswith(_RAISE_SUFFIX)):
+        return name
+    return None
+
+
+def _degradation_counter(call: ast.Call) -> Optional[str]:
+    if not (isinstance(call.func, ast.Attribute)
+            and call.func.attr == "inc"
+            and receiver_terminal(call.func) in _METRIC_RECEIVERS
+            and call.args):
+        return None
+    name = literal_str(call.args[0])
+    if name and any(fnmatch.fnmatch(name, p) for p in _COUNTER_PATTERNS):
+        return name
+    return None
+
+
+@register
+class DegradationEventCompleteness(Rule):
+    id = "FC08"
+    title = ("degradation-event completeness (every decline site "
+             "journals a registered reason)")
+
+    def check_project(self, project: Project) -> Iterable[Finding]:
+        vocab = self._vocabulary(project)
+        if vocab is None:
+            return []
+        vocab_module, vocab_line, reasons = vocab
+        findings: List[Finding] = []
+        used: Set[str] = set()
+        emitting_handlers = self._covered_exception_names(project, reasons)
+        for module in project.modules:
+            if module is vocab_module:
+                continue
+            used |= literal_strings(module.tree) & reasons
+            index = FunctionIndex(module.tree)
+            self._check_vocab(module, index, reasons, findings)
+            self._check_sites(module, index, reasons, emitting_handlers,
+                              findings)
+        for reason in sorted(reasons - used):
+            findings.append(Finding(
+                self.id, vocab_module.rel, vocab_line, 0,
+                f"registered reason '{reason}' is never emitted by any "
+                f"source file — dead vocabulary (drop it from REASONS "
+                f"or wire the decline site)"))
+        return findings
+
+    # -- vocabulary --------------------------------------------------------
+    def _vocabulary(self, project: Project
+                    ) -> Optional[Tuple[Module, int, Set[str]]]:
+        for module in project.modules:
+            if not module.rel.endswith("events.py"):
+                continue
+            for node in module.tree.body:
+                if isinstance(node, ast.Assign) and any(
+                        isinstance(t, ast.Name) and t.id == "REASONS"
+                        for t in node.targets):
+                    if isinstance(node.value, (ast.Tuple, ast.List)):
+                        reasons = {el.value for el in node.value.elts
+                                   if isinstance(el, ast.Constant)
+                                   and isinstance(el.value, str)}
+                        return module, node.lineno, reasons
+        return None
+
+    def _check_vocab(self, module: Module, index: FunctionIndex,
+                     reasons: Set[str], findings: List[Finding]) -> None:
+        for fn in index.functions.values():
+            assigns = self._literal_assigns(fn)
+            for node in ast.walk(fn):
+                if not (isinstance(node, ast.Call) and _is_emit(node)):
+                    continue
+                rnode = _reason_node(node)
+                self._check_reason_node(rnode, assigns, reasons, module,
+                                        node, findings)
+        # module-level emits (rare, but cheap to cover)
+        for stmt in module.tree.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            for call in stmt_calls([stmt]):
+                if _is_emit(call):
+                    self._check_reason_node(_reason_node(call), {},
+                                            reasons, module, call,
+                                            findings)
+
+    def _check_reason_node(self, rnode, assigns, reasons, module, call,
+                           findings) -> None:
+        lit = literal_str(rnode) if rnode is not None else None
+        if lit is not None:
+            if lit not in reasons:
+                findings.append(Finding(
+                    self.id, module.rel, call.lineno, call.col_offset,
+                    f"emit reason '{lit}' is not registered in the "
+                    f"events REASONS vocabulary — emit() raises "
+                    f"ValueError at runtime; register it (and document "
+                    f"it) or fix the spelling"))
+            return
+        if isinstance(rnode, ast.Name):
+            for value, line in assigns.get(rnode.id, ()):
+                if value not in reasons:
+                    findings.append(Finding(
+                        self.id, module.rel, line, 0,
+                        f"emit reason '{value}' (assigned to "
+                        f"'{rnode.id}') is not registered in the events "
+                        f"REASONS vocabulary"))
+
+    @staticmethod
+    def _literal_assigns(fn) -> Dict[str, List[Tuple[str, int]]]:
+        """name → [(literal, line)] for every literal (or conditional-
+        literal) assignment in the function, tuple unpacking included
+        (the ``for st, reason in transitions`` idiom stays out of
+        scope — those literals are checked as plain string usage)."""
+        out: Dict[str, List[Tuple[str, int]]] = {}
+
+        def note(target, value_node):
+            if not isinstance(target, ast.Name):
+                return
+            values: List[ast.AST] = [value_node]
+            if isinstance(value_node, ast.IfExp):
+                values = [value_node.body, value_node.orelse]
+            for v in values:
+                lit = literal_str(v)
+                if lit is not None:
+                    out.setdefault(target.id, []).append((lit, v.lineno))
+
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign):
+                for target in node.targets:
+                    note(target, node.value)
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                note(node.target, node.value)
+        return out
+
+    # -- decline-path completeness ----------------------------------------
+    def _covered_exception_names(self, project: Project,
+                                 reasons: Set[str]) -> Set[str]:
+        """Exception names some handler catches AND journals: a raise
+        of one of these reaches a typed emit at the catching boundary."""
+        covered: Set[str] = set()
+        for module in project.modules:
+            index = FunctionIndex(module.tree)
+            for node in ast.walk(module.tree):
+                if not isinstance(node, ast.ExceptHandler) \
+                        or node.type is None:
+                    continue
+                names = []
+                types = node.type.elts if isinstance(
+                    node.type, ast.Tuple) else [node.type]
+                for t in types:
+                    if isinstance(t, ast.Name):
+                        names.append(t.id)
+                    elif isinstance(t, ast.Attribute):
+                        names.append(t.attr)
+                interesting = [n for n in names
+                               if n in _RAISE_NAMES_EXACT
+                               or n.endswith(_RAISE_SUFFIX)]
+                if not interesting:
+                    continue
+                if self._block_emits(node.body, index, reasons):
+                    covered.update(interesting)
+        return covered
+
+    def _block_emits(self, stmts, index: FunctionIndex,
+                     reasons: Set[str]) -> bool:
+        """Does this statement list (following module-local helper
+        calls) contain an emit with a registered — or at least
+        plausible — reason?"""
+        for call in stmt_calls(stmts):
+            if _is_emit(call):
+                return True
+            callee = index.resolve(call)
+            if callee is not None:
+                for sub in index.calls_in(index.closure([callee])):
+                    if _is_emit(sub):
+                        return True
+        return False
+
+    def _check_sites(self, module: Module, index: FunctionIndex,
+                     reasons: Set[str], emitting_handlers: Set[str],
+                     findings: List[Finding]) -> None:
+        for fn in index.functions.values():
+            name = fn.name
+            if any(name.startswith(p) for p in _COUNT_PREFIXES):
+                if not self._counts_covered(fn, index, module):
+                    findings.append(Finding(
+                        self.id, module.rel, fn.lineno, fn.col_offset,
+                        f"shed/drop counter helper '{name}' neither "
+                        f"emits a degradation event nor stages into a "
+                        f"buffer an emitting function drains — this "
+                        f"decline path is invisible to the journal"))
+            self._check_blocks(fn.body, fn, index, reasons,
+                               emitting_handlers, module, findings)
+
+    def _check_blocks(self, stmts, fn, index, reasons, emitting_handlers,
+                      module, findings) -> None:
+        block_covered: Optional[bool] = None  # lazy per statement list
+
+        def covered() -> bool:
+            nonlocal block_covered
+            if block_covered is None:
+                block_covered = self._block_emits(stmts, index, reasons)
+            return block_covered
+
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if isinstance(stmt, ast.Raise):
+                rname = _raise_name(stmt)
+                if rname is not None and rname not in emitting_handlers \
+                        and not covered():
+                    findings.append(Finding(
+                        self.id, module.rel, stmt.lineno, stmt.col_offset,
+                        f"decline raise '{rname}' has no degradation "
+                        f"event on its path: neither this block nor any "
+                        f"'except {rname}' handler in the tree emits a "
+                        f"typed journal event"))
+            for call in stmt_calls([stmt]) \
+                    if not self._is_compound(stmt) else ():
+                cname = _degradation_counter(call)
+                if cname is not None and not covered() \
+                        and not self._bump_covered_indirectly(
+                            fn, index, module, reasons):
+                    findings.append(Finding(
+                        self.id, module.rel, call.lineno, call.col_offset,
+                        f"degradation counter '{cname}' is bumped "
+                        f"without a typed journal event on its path — "
+                        f"the counter says how often, the event must "
+                        f"say when and why"))
+            # recurse into nested blocks
+            for field in ("body", "orelse", "finalbody"):
+                sub = getattr(stmt, field, None)
+                if sub:
+                    self._check_blocks(sub, fn, index, reasons,
+                                       emitting_handlers, module,
+                                       findings)
+            for handler in getattr(stmt, "handlers", ()) or ():
+                self._check_blocks(handler.body, fn, index, reasons,
+                                   emitting_handlers, module, findings)
+
+    @staticmethod
+    def _is_compound(stmt) -> bool:
+        return bool(getattr(stmt, "body", None))
+
+    def _bump_covered_indirectly(self, fn, index: FunctionIndex,
+                                 module: Module, reasons: Set[str]) -> bool:
+        """A counter bump with no emit in its own block is still covered
+        when the *enclosing function* stages into a drained buffer (the
+        breaker ``_transition`` runs under the state lock and stages
+        into ``_event_buf``; ``_drain_events`` emits after release), or
+        when the bump lives in a ``_count*`` helper whose every
+        module-local call site emits (the helper centralizes the
+        counter; the emit belongs to the caller's context)."""
+        if fn is None:
+            return False
+        if self._counts_covered(fn, index, module):
+            return True
+        name = getattr(fn, "name", "")
+        if name.startswith("_count"):
+            return self._call_sites_emit(name, index, reasons)
+        return False
+
+    def _call_sites_emit(self, fn_name: str, index: FunctionIndex,
+                         reasons: Set[str]) -> bool:
+        """True iff the module calls ``fn_name`` at least once and every
+        call site's innermost block emits (module-local closure)."""
+        found = False
+        all_covered = True
+
+        def calls_target(call: ast.Call) -> bool:
+            f = call.func
+            if isinstance(f, ast.Name):
+                return f.id == fn_name
+            return isinstance(f, ast.Attribute) and f.attr == fn_name
+
+        def scan(stmts) -> None:
+            nonlocal found, all_covered
+            for stmt in stmts:
+                if isinstance(stmt, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    continue
+                if not self._is_compound(stmt) and any(
+                        calls_target(c) for c in stmt_calls([stmt])):
+                    found = True
+                    if not self._block_emits(stmts, index, reasons):
+                        all_covered = False
+                for field in ("body", "orelse", "finalbody"):
+                    sub = getattr(stmt, field, None)
+                    if sub:
+                        scan(sub)
+                for handler in getattr(stmt, "handlers", ()) or ():
+                    scan(handler.body)
+
+        for other in index.functions.values():
+            if other.name == fn_name:
+                continue
+            scan(other.body)
+        return found and all_covered
+
+    def _counts_covered(self, fn, index: FunctionIndex,
+                        module: Module) -> bool:
+        closure = index.closure([fn.name])
+        for call in index.calls_in(closure):
+            if _is_emit(call):
+                return True
+        # staging pattern: fn appends to self.<A>; an emitting function
+        # of the module references <A>
+        staged: Set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in ("append", "extend", "add"):
+                recv = receiver_terminal(node.func)
+                if recv is not None:
+                    staged.add(recv)
+        if not staged:
+            return False
+        for other in index.functions.values():
+            if other.name == fn.name:
+                continue
+            emits = any(_is_emit(c)
+                        for c in index.calls_in(index.closure([other.name])))
+            if not emits:
+                continue
+            for node in ast.walk(other):
+                if isinstance(node, ast.Attribute) and node.attr in staged:
+                    return True
+                if isinstance(node, ast.Name) and node.id in staged:
+                    return True
+        return False

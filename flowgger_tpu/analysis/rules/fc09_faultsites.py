@@ -1,0 +1,160 @@
+"""FC09 — fault-site & chaos coverage.
+
+The robustness family's whole value is that every choke point the
+pipeline can fail at is *drilled*: a `faultinject` site nobody arms is a
+decline rung that has never fired outside production.  Three one-way
+doors this rule closes, resolved against ``utils/faultinject.py`` the
+way FC03 resolves oracles:
+
+1. **Used ⇒ registered.**  Every literal site passed to
+   ``faultinject.fire`` / ``maybe_raise`` / ``set_site`` in source must
+   be a member of ``KNOWN_SITES`` — ``configure_from`` hard-errors on
+   unknown sites at boot, so a typo'd check site silently never fires
+   and a "robustness" test passes without injecting anything.
+2. **Registered ⇒ used.**  A ``KNOWN_SITES`` entry no source file ever
+   checks is a dead drill — the catalog promises a choke point that no
+   longer exists.
+3. **Registered ⇒ documented & drilled.**  Every site must appear in
+   the ``flowgger.toml`` fault catalog (the operator-facing `[faults]`
+   reference) and be referenced by at least one test under ``tests/``
+   or a ``tools/chaos.py`` drill — a site with no drill is untested
+   failure handling.
+
+The doc/drill halves scan raw text (a site name inside an env-style
+``"spill_io=once:1"`` literal or a TOML comment both count): the
+contract is *referenced somewhere an operator or CI will exercise it*,
+not a specific call shape.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from ..callgraph import receiver_terminal
+from ..core import (Finding, Module, Project, Rule, literal_str,
+                    register)
+
+_FIRE_FUNCS = frozenset({"fire", "maybe_raise", "set_site"})
+_FIRE_RECEIVERS = frozenset({"faultinject", "_faults", "faults",
+                             "_faultinject", "fi"})
+
+
+def _site_literal(call: ast.Call) -> Optional[str]:
+    """Literal site name of a fault-check call, else None."""
+    func = call.func
+    if isinstance(func, ast.Attribute):
+        if func.attr not in _FIRE_FUNCS \
+                or receiver_terminal(func) not in _FIRE_RECEIVERS:
+            return None
+    elif isinstance(func, ast.Name):
+        if func.id not in _FIRE_FUNCS:
+            return None
+    else:
+        return None
+    if call.args:
+        return literal_str(call.args[0])
+    return None
+
+
+def _known_sites(module: Module) -> Optional[Tuple[int, List[str]]]:
+    """(lineno, sites) of the KNOWN_SITES tuple, else None."""
+    for node in module.tree.body:
+        if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == "KNOWN_SITES"
+                for t in node.targets):
+            if isinstance(node.value, (ast.Tuple, ast.List)):
+                sites = [el.value for el in node.value.elts
+                         if isinstance(el, ast.Constant)
+                         and isinstance(el.value, str)]
+                return node.lineno, sites
+    return None
+
+
+@register
+class FaultSiteCoverage(Rule):
+    id = "FC09"
+    title = ("fault-site coverage (sites registered, documented in the "
+             "toml catalog, and drilled by a test or chaos run)")
+
+    def check_project(self, project: Project) -> Iterable[Finding]:
+        registry = None
+        for module in project.modules:
+            if module.rel.endswith("faultinject.py"):
+                found = _known_sites(module)
+                if found is not None:
+                    registry = (module, *found)
+                    break
+        if registry is None:
+            return []
+        reg_module, reg_line, sites = registry
+        known = set(sites)
+        findings: List[Finding] = []
+
+        used: Set[str] = set()
+        for module in project.modules:
+            if module is reg_module:
+                continue
+            for node in ast.walk(module.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                site = _site_literal(node)
+                if site is None:
+                    continue
+                used.add(site)
+                if site not in known:
+                    findings.append(Finding(
+                        self.id, module.rel, node.lineno, node.col_offset,
+                        f"fault site '{site}' is not registered in "
+                        f"faultinject.KNOWN_SITES — configure_from "
+                        f"rejects it, so no plan can ever arm this "
+                        f"check; register it or fix the spelling"))
+
+        toml_text = self._read(project, "flowgger.toml")
+        drill_text = self._drill_text(project)
+        for site in sites:
+            if site not in used:
+                findings.append(Finding(
+                    self.id, reg_module.rel, reg_line, 0,
+                    f"registered fault site '{site}' is never checked "
+                    f"by any source file — dead drill; drop it from "
+                    f"KNOWN_SITES or wire the choke point"))
+                continue
+            if toml_text is not None and site not in toml_text:
+                findings.append(Finding(
+                    self.id, reg_module.rel, reg_line, 0,
+                    f"fault site '{site}' is missing from the "
+                    f"flowgger.toml fault catalog — operators cannot "
+                    f"discover the drill; document it under [faults]"))
+            if drill_text and site not in drill_text:
+                findings.append(Finding(
+                    self.id, reg_module.rel, reg_line, 0,
+                    f"fault site '{site}' is referenced by no test and "
+                    f"no tools/chaos.py drill — untested failure "
+                    f"handling; add a [faults]-armed test or chaos "
+                    f"drill"))
+        return findings
+
+    @staticmethod
+    def _read(project: Project, rel: str) -> Optional[str]:
+        try:
+            with open(os.path.join(project.root, rel), "r",
+                      encoding="utf-8") as fd:
+                return fd.read()
+        except OSError:
+            return None
+
+    def _drill_text(self, project: Project) -> str:
+        """Concatenated text of every test file plus the chaos tool.
+        Empty string when the project has neither (fixture projects) —
+        the drill check is then skipped rather than all-failing."""
+        parts: List[str] = []
+        for rel in project.test_files:
+            text = self._read(project, rel)
+            if text is not None:
+                parts.append(text)
+        chaos = self._read(project, "tools/chaos.py")
+        if chaos is not None:
+            parts.append(chaos)
+        return "\n".join(parts)

@@ -1,0 +1,230 @@
+"""FC10 — thread & resource lifecycle.
+
+The PR 6 bug class: a thread (or fd/socket) created and *dropped* — no
+handle, no join, no close — so drain can't wait for it and nothing
+bounds how many pile up.  Two contracts, both resolved with the same
+parent-chain classification:
+
+1. **Threads.**  Every ``threading.Thread(...)`` construction and every
+   ``*.spawn(...)`` start site must leave a reachable stop/join path:
+
+   - stored as instance state (``self._thread = ...``): some code in
+     the module must ``join`` that attribute — the stop/drain method
+     owns the lifecycle;
+   - bound to a local: the local must be *used* beyond starting it
+     (returned to a caller who owns it, joined, stored in a container
+     or attribute, passed along) — ``t.start()`` alone is
+     fire-and-forget with extra steps;
+   - returned or passed as an argument directly: the receiver owns it —
+     covered;
+   - ``threading.Thread(...).start()`` as a bare statement: no handle
+     exists, nothing can ever join it — flagged.
+
+2. **Resources.**  Every ``open()`` / ``socket.socket()`` /
+   ``socket.create_connection()`` / ``socket.create_server()`` result
+   stored as instance state must have a ``close`` on that attribute
+   somewhere in the module (or be managed by a ``with``) — an fd held
+   on ``self`` with no close path leaks one descriptor per object for
+   the life of the process.
+
+Deliberately fire-and-forget threads (a drain-announce wave that must
+not block an HTTP reply, a compile worker that must outlive its caller)
+carry reasoned inline suppressions — the rule makes the *decision*
+visible, not impossible.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Set, Tuple
+
+from ..callgraph import build_parents, receiver_terminal
+from ..core import Finding, Module, Project, Rule, dotted_name, register
+
+# parent nodes the classification sees through: a thread inside a list/
+# tuple/comprehension/conditional is still the same thread
+_TRANSPARENT = (ast.List, ast.Tuple, ast.Set, ast.ListComp, ast.SetComp,
+                ast.GeneratorExp, ast.IfExp, ast.Starred, ast.Await,
+                ast.NamedExpr)
+
+# loads of a thread local that do NOT count as lifecycle ownership
+_NEUTRAL_ATTRS = frozenset({"start", "is_alive", "daemon", "name",
+                            "ident", "setDaemon", "setName"})
+
+_RESOURCE_DOTTED = frozenset({"socket.socket", "socket.create_connection",
+                              "socket.create_server"})
+
+
+def _is_thread_ctor(call: ast.Call) -> bool:
+    callee = dotted_name(call.func)
+    return callee is not None and callee.split(".")[-1] == "Thread"
+
+
+def _is_spawn(call: ast.Call) -> bool:
+    return isinstance(call.func, ast.Attribute) and call.func.attr == "spawn"
+
+
+def _is_resource_ctor(call: ast.Call) -> bool:
+    func = call.func
+    if isinstance(func, ast.Name) and func.id == "open":
+        return True
+    callee = dotted_name(func)
+    return callee in _RESOURCE_DOTTED
+
+
+def _self_attr(target: ast.AST) -> Optional[str]:
+    """``self.A`` / ``cls.A`` target → ``A``."""
+    if isinstance(target, ast.Attribute) \
+            and isinstance(target.value, ast.Name) \
+            and target.value.id in ("self", "cls"):
+        return target.attr
+    return None
+
+
+@register
+class ThreadResourceLifecycle(Rule):
+    id = "FC10"
+    title = ("thread/resource lifecycle (every thread start has a join "
+             "path, every instance-state fd has a close path)")
+
+    def check(self, module: Module, project: Project) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        parents = build_parents(module.tree)
+        joined = self._attrs_with(module.tree, "join")
+        closed = self._attrs_with(module.tree, "close") \
+            | self._with_managed(module.tree)
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if _is_thread_ctor(node) or _is_spawn(node):
+                self._check_thread(node, parents, joined, module, findings)
+            elif _is_resource_ctor(node):
+                self._check_resource(node, parents, closed, module,
+                                     findings)
+        return findings
+
+    # -- evidence ----------------------------------------------------------
+    @staticmethod
+    def _attrs_with(tree: ast.Module, method: str) -> Set[str]:
+        """Attribute names X for which ``<...>.X.<method>(...)`` (or a
+        bare ``X.<method>(...)``) appears anywhere in the module."""
+        out: Set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == method:
+                recv = receiver_terminal(node.func)
+                if recv is not None:
+                    out.add(recv)
+        return out
+
+    @staticmethod
+    def _with_managed(tree: ast.Module) -> Set[str]:
+        """Attribute names used as a ``with`` context — the runtime
+        closes those."""
+        out: Set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    name = dotted_name(item.context_expr)
+                    if name is not None:
+                        out.add(name.split(".")[-1])
+        return out
+
+    # -- threads -----------------------------------------------------------
+    def _check_thread(self, call: ast.Call, parents, joined: Set[str],
+                      module: Module, findings: List[Finding]) -> None:
+        node: ast.AST = call
+        parent = parents.get(node)
+        while isinstance(parent, _TRANSPARENT):
+            node, parent = parent, parents.get(parent)
+        if isinstance(parent, (ast.Return, ast.Yield, ast.YieldFrom)):
+            return  # the caller owns the handle
+        if isinstance(parent, ast.Call) and node is not parent.func:
+            return  # passed as an argument: the callee owns it
+        if isinstance(parent, (ast.Assign, ast.AnnAssign)):
+            targets = parent.targets if isinstance(parent, ast.Assign) \
+                else [parent.target]
+            for target in targets:
+                attr = _self_attr(target)
+                if attr is not None:
+                    if attr not in joined:
+                        findings.append(Finding(
+                            self.id, module.rel, call.lineno,
+                            call.col_offset,
+                            f"thread stored as 'self.{attr}' is never "
+                            f"joined anywhere in this module — the "
+                            f"stop/drain path cannot wait for it; join "
+                            f"it in stop()"))
+                    return
+                if isinstance(target, ast.Name):
+                    if not self._local_owned(target.id, parent, parents):
+                        findings.append(Finding(
+                            self.id, module.rel, call.lineno,
+                            call.col_offset,
+                            f"thread local '{target.id}' is only "
+                            f"started, never joined/stored/returned — "
+                            f"fire-and-forget with a handle nobody "
+                            f"keeps; tie it to a join path or drop the "
+                            f"variable deliberately"))
+                    return
+                # subscript / tuple-unpack target: stored in a
+                # container the enclosing code tracks — covered
+                return
+            return
+        if isinstance(parent, ast.Attribute) and parent.attr == "start":
+            grand = parents.get(parents.get(parent))
+            if isinstance(grand, ast.Expr):
+                findings.append(Finding(
+                    self.id, module.rel, call.lineno, call.col_offset,
+                    "thread is constructed and started with no handle "
+                    "kept — nothing can ever join it on the drain "
+                    "path; keep the handle (and reap finished ones) or "
+                    "suppress with the reason it may outlive drain"))
+            return
+        if isinstance(parent, ast.Expr):
+            findings.append(Finding(
+                self.id, module.rel, call.lineno, call.col_offset,
+                "thread is constructed and discarded — it is never "
+                "even started; dead code or a missing .start()"))
+
+    def _local_owned(self, name: str, assign: ast.AST, parents) -> bool:
+        """Is a thread-holding local used beyond lifecycle-neutral
+        calls inside its enclosing function?"""
+        fn = assign
+        while fn is not None and not isinstance(
+                fn, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Module)):
+            fn = parents.get(fn)
+        if fn is None:
+            return True  # can't scope it: stay silent
+        for node in ast.walk(fn):
+            if not (isinstance(node, ast.Name) and node.id == name
+                    and isinstance(node.ctx, ast.Load)):
+                continue
+            parent = parents.get(node)
+            if isinstance(parent, ast.Attribute) \
+                    and parent.attr in _NEUTRAL_ATTRS:
+                continue
+            return True  # returned, joined, stored, passed along…
+        return False
+
+    # -- resources ---------------------------------------------------------
+    def _check_resource(self, call: ast.Call, parents, closed: Set[str],
+                        module: Module, findings: List[Finding]) -> None:
+        node: ast.AST = call
+        parent = parents.get(node)
+        while isinstance(parent, _TRANSPARENT):
+            node, parent = parent, parents.get(parent)
+        if not isinstance(parent, (ast.Assign, ast.AnnAssign)):
+            return  # locals and with-statements are FC02/CPython's turf
+        targets = parent.targets if isinstance(parent, ast.Assign) \
+            else [parent.target]
+        for target in targets:
+            attr = _self_attr(target)
+            if attr is not None and attr not in closed:
+                findings.append(Finding(
+                    self.id, module.rel, call.lineno, call.col_offset,
+                    f"fd/socket stored as 'self.{attr}' has no close "
+                    f"anywhere in this module — one descriptor leaks "
+                    f"per object for the life of the process; close it "
+                    f"on the drain/stop path"))

@@ -211,6 +211,13 @@ class ControlPlane:
             # the controller-death drill: this tick never happened —
             # whatever the last live tick applied stays applied
             self._metrics.inc("control_freezes")
+            from ..obs import events as _events
+
+            _events.emit(
+                "control", "control_freeze",
+                msg="control: tick skipped (control_freeze); tenant "
+                    "rates and capacity weight stay frozen at "
+                    "last-applied")
             return False
         self._metrics.inc("control_ticks")
         burns = self._burn_source()

@@ -236,30 +236,43 @@ class DurabilityManager:
                "starts": [int(x) for x in starts],
                "lens": [int(x) for x in lens],
                "runs": [[t, int(c)] for t, c in runs] if runs else None}
+        # a require-mode hard failure journals + raises AFTER the lock
+        # releases: the journal may write a disk sink, and the watchdog
+        # and replay threads contend on this same lock
+        reject: Optional[str] = None
         with self._lock:
             if self._disk_bytes >= self.max_bytes:
-                if self.mode == "require":
-                    raise DurabilityError(
-                        "durability.max_spill_mb exhausted "
-                        f"({self._disk_bytes >> 20} MB on disk) with "
-                        "mode = require")
-                return False
-            was_empty = self._unacked == 0
-            try:
-                seq, idx, nbytes = self._writer.append(hdr, body)
-            except OSError as e:
-                _metrics.inc("spill_io_errors")
-                if self.mode == "require":
-                    raise DurabilityError(
-                        f"segment append failed with mode = require: {e}")
-                print(f"durability: segment append failed ({e}); batch "
-                      "stays on the lossy path", file=sys.stderr)
-                return False
-            self._seg_counts[seq] = idx + 1
-            self._disk_bytes += nbytes
-            self._pending.append(SpillRecord(seq, idx, fmt, body, starts,
-                                             lens, runs, n))
-            self._unacked += 1
+                if self.mode != "require":
+                    return False
+                reject = ("durability.max_spill_mb exhausted "
+                          f"({self._disk_bytes >> 20} MB on disk) with "
+                          "mode = require")
+            else:
+                was_empty = self._unacked == 0
+                try:
+                    seq, idx, nbytes = self._writer.append(hdr, body)
+                except OSError as e:
+                    _metrics.inc("spill_io_errors")
+                    if self.mode != "require":
+                        print(f"durability: segment append failed ({e}); "
+                              "batch stays on the lossy path",
+                              file=sys.stderr)
+                        return False
+                    reject = ("segment append failed with "
+                              f"mode = require: {e}")
+                else:
+                    self._seg_counts[seq] = idx + 1
+                    self._disk_bytes += nbytes
+                    self._pending.append(SpillRecord(seq, idx, fmt, body,
+                                                     starts, lens, runs, n))
+                    self._unacked += 1
+        if reject is not None:
+            from ..obs import events as _events
+
+            _events.emit("durability", "durability_reject", detail=reject,
+                         cost=n, cost_unit="lines",
+                         msg=f"durability: {reject}")
+            raise DurabilityError(reject)
         _metrics.inc("spill_records")
         self._set_gauges()
         if was_empty:

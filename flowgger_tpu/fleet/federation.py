@@ -747,6 +747,7 @@ class Fleet:
         if sync_wave:
             self._send_heartbeats()  # don't wait a tick: announce now
         else:
+            # flowcheck: disable=FC10 -- drain-announce wave is deliberately fire-and-forget: it may block one socket timeout per dead peer and must never hold the POST /drain reply (or drain itself) hostage; shutdown() departs loudly anyway
             threading.Thread(target=self._send_heartbeats, daemon=True,
                              name="fleet-drain-wave").start()
 
@@ -761,6 +762,12 @@ class Fleet:
                 self._fleet_watch()  # journal the departure durably
                 self._send_heartbeats()
         self._stop.set()
+        if self._ticker is not None \
+                and self._ticker is not threading.current_thread():
+            # bound the wait: the ticker wakes from its heartbeat sleep
+            # on _stop and exits; a wedged send still can't hold
+            # shutdown hostage past the timeout
+            self._ticker.join(timeout=2)
         if self.service is not None:
             self.service.stop()
 
@@ -1033,6 +1040,7 @@ class Fleet:
         socket timeout per dead peer."""
         self.enter_draining(sync_wave=False)
         if self._on_drain_cb is not None:
+            # flowcheck: disable=FC10 -- the drain kick IS the drain path: it runs Pipeline._drain to completion and the process exits behind it; joining it here would make the HTTP reply wait out the full queue flush
             t = threading.Thread(target=self._on_drain_cb, daemon=True,
                                  name="fleet-drain-request")
             t.start()

@@ -244,4 +244,7 @@ class HealthService:
             # a half-closed listener at teardown is not worth a crash,
             # but say so — silent shutdown bugs hide port leaks
             print(f"fleet-health: shutdown error: {e}", file=sys.stderr)
+        # shutdown() already waited for serve_forever to exit; the join
+        # closes the last gap (the thread's own teardown) boundedly
+        self._thread.join(timeout=2)
         self._thread = None

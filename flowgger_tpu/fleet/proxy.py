@@ -157,6 +157,7 @@ class SteeringProxy:
         refs = [2]
         lock = threading.Lock()
         for src, dst in ((conn, upstream), (upstream, conn)):
+            # flowcheck: disable=FC10 -- pump pair owns its own lifecycle: each exits on EOF/error and the refs+lock pair closes both sockets when the last pump leaves; a drain join would wait on idle-but-open client connections
             threading.Thread(
                 target=self._pump, args=(src, dst, refs, lock),
                 daemon=True, name="steer-pump").start()

@@ -11,6 +11,8 @@ TPU batch handler own per-connection batch arenas the same way.
 from __future__ import annotations
 
 import inspect
+import threading
+import time
 
 
 def make_handler(handler_factory, peer=None):
@@ -37,6 +39,41 @@ class Input:
         transports that know their peer build handlers through
         ``make_handler(handler_factory, peer)`` instead."""
         raise NotImplementedError
+
+    # -- per-connection handler-thread lifecycle ---------------------------
+    # Thread-per-connection transports (tcp/tls) spawn through here so
+    # every handler is *tracked*: finished ones are reaped on each
+    # accept (the set stays bounded by live connections — the PR 6
+    # unbounded-growth lesson), and drain can bounded-wait for the rest
+    # through join_handlers().  Lazy init: transports don't call
+    # super().__init__.
+
+    def _spawn_handler(self, target, args: tuple) -> None:
+        """Start a tracked daemon thread for one connection."""
+        lock = self.__dict__.setdefault("_handlers_lock", threading.Lock())
+        t = threading.Thread(target=target, args=args, daemon=True)
+        with lock:
+            live = {h for h in self.__dict__.get("_handlers", ())
+                    if h.is_alive()}
+            live.add(t)
+            self._handlers = live
+        t.start()
+
+    def join_handlers(self, timeout: float = 2.0) -> int:
+        """Drain hook: wait (boundedly, across ALL handlers) for
+        in-flight connection handlers to finish; returns how many are
+        still alive — those are abandoned daemon threads, the same
+        contract as the output-thread drain stragglers."""
+        lock = self.__dict__.setdefault("_handlers_lock", threading.Lock())
+        with lock:
+            live = [h for h in self.__dict__.get("_handlers", ())
+                    if h.is_alive()]
+        deadline = time.monotonic() + timeout
+        for t in live:
+            t.join(max(0.0, deadline - time.monotonic()))
+        with lock:
+            self._handlers = {h for h in live if h.is_alive()}
+            return len(self._handlers)
 
 
 from .stdin_input import StdinInput  # noqa: E402

@@ -11,7 +11,6 @@ loop with cooperative connection handling).
 from __future__ import annotations
 
 import socket
-import threading
 
 from . import Input
 from ..config import Config, ConfigError
@@ -80,8 +79,7 @@ class TcpInput(Input):
                 return
             client.settimeout(self.timeout)
             print(f"Connection over TCP from [{peer[0]}:{peer[1]}]")
-            threading.Thread(target=self._handle_client,
-                             args=(client, peer[0]), daemon=True).start()
+            self._spawn_handler(self._handle_client, (client, peer[0]))
 
     def _handle_client(self, client: socket.socket, peer_ip=None):
         from . import make_handler

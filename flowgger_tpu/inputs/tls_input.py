@@ -16,7 +16,6 @@ from __future__ import annotations
 import socket
 import ssl
 import sys
-import threading
 
 from . import Input
 from ..config import Config, ConfigError
@@ -122,8 +121,7 @@ class TlsInput(Input):
                 return
             client.settimeout(self.timeout)
             print(f"Connection over TLS from [{peer[0]}:{peer[1]}]")
-            threading.Thread(target=self._handle_client,
-                             args=(client, peer[0]), daemon=True).start()
+            self._spawn_handler(self._handle_client, (client, peer[0]))
 
     def _handle_client(self, client: socket.socket, peer_ip=None):
         try:

@@ -62,6 +62,14 @@ reason                     fired by
                            host's advertised fleet capacity weight
 ``share_restore``          control/plane.py — pressure cleared; the
                            advertised capacity weight recovered a step
+``control_freeze``         control/plane.py — a controller tick was
+                           skipped (the control_freeze fault drill /
+                           controller death): everything stays frozen
+                           at last-applied
+``durability_reject``      durability/manager.py — ``mode = require``
+                           hard-failed an offer (spill budget
+                           exhausted or segment append error); the
+                           batch is refused, not silently shed
 =========================  =================================================
 
 Each event carries ``(ts, site, reason)`` plus whatever context the
@@ -135,6 +143,8 @@ REASONS = (
     "admission_relax",
     "share_decay",
     "share_restore",
+    "control_freeze",
+    "durability_reject",
 )
 _REASON_SET = frozenset(REASONS)
 

@@ -238,6 +238,9 @@ class ObsServer:
             self._server.server_close()
         except OSError as e:
             print(f"obs-http: shutdown error: {e}", file=sys.stderr)
+        # shutdown() already waited for serve_forever to exit; the join
+        # closes the last gap (the thread's own teardown) boundedly
+        self._thread.join(timeout=2)
         self._thread = None
 
 

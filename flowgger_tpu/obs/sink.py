@@ -57,6 +57,7 @@ class JsonlSink:
             self._keep = max(1, int(keep))
             self._size = 0
             if path:
+                # flowcheck: disable=FC07 -- guarding the fd lifecycle is this lock's whole job: open/rotate/close must be atomic against concurrent write(); there is no "after release" for the handle swap
                 self._fd = open(path, "a")
                 try:
                     self._size = os.path.getsize(path)
@@ -76,9 +77,10 @@ class JsonlSink:
         for i in range(self._keep - 1, 0, -1):
             src = f"{self._path}.{i}"
             if os.path.exists(src):
-                os.replace(src, f"{self._path}.{i + 1}")
+                os.replace(src, f"{self._path}.{i + 1}")  # flowcheck: disable=FC07 -- rotation must be atomic against concurrent write(): the rename ladder and reopen ARE the guarded state transition; journal sink, never the decode path
+        # flowcheck: disable=FC07 -- same rotation transaction: final rename + reopen under the lock that owns the fd
         os.replace(self._path, f"{self._path}.1")
-        self._fd = open(self._path, "a")
+        self._fd = open(self._path, "a")  # flowcheck: disable=FC07 -- reopen completes the same lock-owned rotation transaction
         self._size = 0
 
     def write(self, doc: dict) -> None:

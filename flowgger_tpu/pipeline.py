@@ -436,6 +436,17 @@ class Pipeline:
         mark = getattr(self.tx, "mark_draining", None)
         if mark is not None:
             mark()
+        # bounded-wait for in-flight connection handler threads (tcp/tls
+        # thread-per-connection inputs) so their last lines land before
+        # the flush/queue barrier below; stragglers stay daemonized and
+        # are counted, same contract as the output-thread stragglers
+        join_handlers = getattr(self.input, "join_handlers", None)
+        if join_handlers is not None:
+            still_alive = join_handlers(timeout=2.0)
+            if still_alive:
+                from .utils.metrics import registry as _metrics
+
+                _metrics.inc("drain_stragglers", still_alive)
         for handler in self._handlers:
             try:
                 handler.flush()

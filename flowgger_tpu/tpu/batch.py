@@ -964,6 +964,7 @@ class BatchHandler(Handler):
         return self._breaker is None or self._breaker.allow()
 
     def _device_failed(self, e: BaseException) -> None:
+        # flowcheck: disable=FC07 -- called both under the flush decode lock AND off-lock from the lane fetcher/sequencer threads; staging would need a drain hook on every caller for one emit per failed device batch on an already-cold decline path
         _events.emit(
             "batch", "device_error", route=self.fmt,
             detail=f"{type(e).__name__}: {e}",

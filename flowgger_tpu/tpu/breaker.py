@@ -64,6 +64,11 @@ class DecodeBreaker:
         self._trip_reason: Optional[str] = None  # "errors" | "ratio"
         self._probe_ratio: Optional[float] = None
         self.transitions: list = []  # (monotonic, from, to) history
+        # journal events staged under the lock, emitted after release:
+        # the journal may write a disk sink, and every thread asking
+        # allow() would convoy behind it exactly while the device is
+        # degrading (the fairqueue _event_buf pattern)
+        self._event_buf: list = []
         # init without clobbering: another handler's breaker may already
         # be publishing a non-closed state on the shared gauge
         _metrics.init_gauge("device_breaker_state", 0)
@@ -102,8 +107,9 @@ class DecodeBreaker:
 
     # -- state machine -----------------------------------------------------
     def _transition(self, new: str, count_trip: bool = True) -> None:
-        from ..obs import events as _events
-
+        """Runs under ``self._lock``; journal events are staged into
+        ``_event_buf`` and emitted by ``_drain_events`` after the caller
+        releases the lock."""
         old, self._state = self._state, new
         self.transitions.append((self._clock(), old, new))
         _metrics.set_gauge("device_breaker_state", _STATE_GAUGE[new])
@@ -113,17 +119,28 @@ class DecodeBreaker:
             # breaker_trips counts trip events, not cooldown cycles —
             # and exactly one journal event per trip, same contract
             _metrics.inc("breaker_trips")
-            _events.emit("breaker", "breaker_trip",
-                         detail=self._trip_reason or "errors",
-                         cost=self.cooldown_ms / 1000.0,
-                         cost_unit="cooldown_s", msg=msg)
+            self._event_buf.append(("breaker_trip", dict(
+                detail=self._trip_reason or "errors",
+                cost=self.cooldown_ms / 1000.0,
+                cost_unit="cooldown_s", msg=msg)))
         elif new == CLOSED and old != CLOSED:
             _metrics.inc("breaker_recoveries")
-            _events.emit("breaker", "breaker_recover", msg=msg)
+            self._event_buf.append(("breaker_recover", dict(msg=msg)))
         else:
             print(msg, file=sys.stderr)
         if new == OPEN:
             self._opened_at = self._clock()
+
+    def _drain_events(self) -> None:
+        """Emit staged transition events outside the lock."""
+        if not self._event_buf:
+            return
+        with self._lock:
+            staged, self._event_buf = self._event_buf, []
+        from ..obs import events as _events
+
+        for reason, kwargs in staged:
+            _events.emit("breaker", reason, **kwargs)
 
     @property
     def state(self) -> str:
@@ -135,51 +152,64 @@ class DecodeBreaker:
         call after the cooldown becomes the half-open probe; everything
         else stays on the oracle."""
         with self._lock:
-            if self._state == CLOSED:
-                return True
-            if self._state == OPEN:
-                elapsed_ms = (self._clock() - self._opened_at) * 1000.0
-                if elapsed_ms >= self.cooldown_ms:
-                    self._transition(HALF_OPEN)
-                    return True  # this batch is the probe
-                return False
-            return False  # HALF_OPEN: probe already in flight
+            out = self._allow_locked()
+        self._drain_events()
+        return out
+
+    def _allow_locked(self) -> bool:
+        if self._state == CLOSED:
+            return True
+        if self._state == OPEN:
+            elapsed_ms = (self._clock() - self._opened_at) * 1000.0
+            if elapsed_ms >= self.cooldown_ms:
+                self._transition(HALF_OPEN)
+                return True  # this batch is the probe
+            return False
+        return False  # HALF_OPEN: probe already in flight
 
     def record_success(self) -> None:
         with self._lock:
-            self._consecutive = 0
-            if self._state == HALF_OPEN:
-                if (self._trip_reason == "ratio"
-                        and self.fallback_ratio is not None
-                        and self._probe_ratio is not None
-                        and self._probe_ratio > self.fallback_ratio):
-                    # the device is healthy but the stream still pushes
-                    # nearly every row through the oracle: a "success"
-                    # doesn't cure a ratio trip — stay open (one probe
-                    # per cooldown, not an open/close flap every window)
-                    self._probe_ratio = None
-                    self._transition(OPEN, count_trip=False)
-                    return
-                self._ratios.clear()
-                self._trip_reason = None
+            self._record_success_locked()
+        self._drain_events()
+
+    def _record_success_locked(self) -> None:
+        self._consecutive = 0
+        if self._state == HALF_OPEN:
+            if (self._trip_reason == "ratio"
+                    and self.fallback_ratio is not None
+                    and self._probe_ratio is not None
+                    and self._probe_ratio > self.fallback_ratio):
+                # the device is healthy but the stream still pushes
+                # nearly every row through the oracle: a "success"
+                # doesn't cure a ratio trip — stay open (one probe
+                # per cooldown, not an open/close flap every window)
                 self._probe_ratio = None
-                self._transition(CLOSED)
+                self._transition(OPEN, count_trip=False)
+                return
+            self._ratios.clear()
+            self._trip_reason = None
+            self._probe_ratio = None
+            self._transition(CLOSED)
 
     def record_failure(self, error: BaseException) -> None:
         _metrics.inc("device_decode_errors")
         with self._lock:
-            if self._state == HALF_OPEN:
-                # failed probe: back to cooldown (same logical trip)
-                self._transition(OPEN, count_trip=False)
-                return
-            self._consecutive += 1
-            if self._state == CLOSED and self._consecutive >= self.failures:
-                print(
-                    f"device-decode breaker tripping after "
-                    f"{self._consecutive} consecutive device errors "
-                    f"(last: {error})", file=sys.stderr)
-                self._trip_reason = "errors"
-                self._transition(OPEN)
+            self._record_failure_locked(error)
+        self._drain_events()
+
+    def _record_failure_locked(self, error: BaseException) -> None:
+        if self._state == HALF_OPEN:
+            # failed probe: back to cooldown (same logical trip)
+            self._transition(OPEN, count_trip=False)
+            return
+        self._consecutive += 1
+        if self._state == CLOSED and self._consecutive >= self.failures:
+            print(
+                f"device-decode breaker tripping after "
+                f"{self._consecutive} consecutive device errors "
+                f"(last: {error})", file=sys.stderr)
+            self._trip_reason = "errors"
+            self._transition(OPEN)
 
     def observe_batch(self, n_rows: int, fallback_rows: int) -> None:
         """Feed one successful device batch's oracle-fallback share; a
@@ -188,20 +218,24 @@ class DecodeBreaker:
         if self.fallback_ratio is None or n_rows <= 0:
             return
         with self._lock:
-            if self._state == HALF_OPEN:
-                # the probe batch's own ratio: record_success consults it
-                # to decide whether a ratio trip is actually cured
-                self._probe_ratio = fallback_rows / n_rows
-                return
-            if self._state != CLOSED:
-                return
-            self._ratios.append(fallback_rows / n_rows)
-            if (len(self._ratios) == self.window
-                    and min(self._ratios) > self.fallback_ratio):
-                print(
-                    f"device-decode breaker tripping: fallback ratio > "
-                    f"{self.fallback_ratio} over the last {self.window} "
-                    f"batches", file=sys.stderr)
-                self._ratios.clear()
-                self._trip_reason = "ratio"
-                self._transition(OPEN)
+            self._observe_batch_locked(n_rows, fallback_rows)
+        self._drain_events()
+
+    def _observe_batch_locked(self, n_rows: int, fallback_rows: int) -> None:
+        if self._state == HALF_OPEN:
+            # the probe batch's own ratio: record_success consults it
+            # to decide whether a ratio trip is actually cured
+            self._probe_ratio = fallback_rows / n_rows
+            return
+        if self._state != CLOSED:
+            return
+        self._ratios.append(fallback_rows / n_rows)
+        if (len(self._ratios) == self.window
+                and min(self._ratios) > self.fallback_ratio):
+            print(
+                f"device-decode breaker tripping: fallback ratio > "
+                f"{self.fallback_ratio} over the last {self.window} "
+                f"batches", file=sys.stderr)
+            self._ratios.clear()
+            self._trip_reason = "ratio"
+            self._transition(OPEN)

@@ -120,6 +120,7 @@ def guarded_compile_call(name: str, fn, *args, timeout_s=None):
     # worker marks/clears the same instances the busy check reads even
     # if a test swaps the module globals mid-flight
     sema, active = _compile_sema, _compile_active_box
+    declined = False
     with _compile_lock:
         if name in _compile_ready:
             # jit cache warm for this name+shape: call inline (also the
@@ -132,16 +133,22 @@ def guarded_compile_call(name: str, fn, *args, timeout_s=None):
             ready = False
             pending = _compile_slots.get(name)
             if pending is not None and not pending.is_set():
-                _count_decline()
-                from ..obs import events as _events
+                # journal + raise AFTER the lock: the journal may write
+                # a disk sink, and every caller probing the slot table
+                # would serialize behind it
+                declined = True
+            else:
+                # claim the slot inside this same critical section so
+                # two threads can never spawn duplicate compiles of one
+                # kernel (a finished-but-errored slot is replaced)
+                _compile_slots[name] = done
+                busy = active.get("name")
+    if declined:
+        _count_decline()
+        from ..obs import events as _events
 
-                _events.emit("compile", "watchdog_decline", detail=name)
-                raise CompileTimeout(name)
-            # claim the slot inside this same critical section so two
-            # threads can never spawn duplicate compiles of one kernel
-            # (a finished-but-errored slot is simply replaced)
-            _compile_slots[name] = done
-            busy = active.get("name")
+        _events.emit("compile", "watchdog_decline", detail=name)
+        raise CompileTimeout(name)
     if ready:
         return fn(*args)
     box: dict = {}
@@ -164,6 +171,7 @@ def guarded_compile_call(name: str, fn, *args, timeout_s=None):
         finally:
             done.set()
 
+    # flowcheck: disable=FC10 -- the compile worker must outlive its (watchdog-declined) caller so the compile lands for the next call; the done event + single-flight semaphore own its lifecycle, and joining it is exactly the stall the watchdog exists to prevent
     threading.Thread(target=run, daemon=True,
                      name=f"xla-compile:{name}").start()
     if busy is not None:

@@ -1,0 +1,32 @@
+"""FC07 clean: stage under the lock, emit after release; one order."""
+import threading
+
+from obs import events
+
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._order_lock = threading.Lock()
+        self._buf = []
+
+    def trip(self):
+        with self._lock:
+            self._buf.append(("queue", "queue_full"))
+        self._drain()
+
+    def _drain(self):
+        with self._lock:
+            staged, self._buf = self._buf, []
+        for kind, reason in staged:
+            events.emit(kind, reason)
+
+    def ordered(self):
+        with self._lock:
+            with self._order_lock:
+                return len(self._buf)
+
+    def ordered_again(self):
+        with self._lock:
+            with self._order_lock:
+                self._buf.clear()

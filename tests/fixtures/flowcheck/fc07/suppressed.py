@@ -1,0 +1,13 @@
+"""FC07 suppressed: a deliberate emit under the lock, reason inline."""
+import threading
+
+from obs import events
+
+
+class Deliberate:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def trip(self):
+        with self._lock:
+            events.emit("queue", "queue_full")  # flowcheck: disable=FC07 -- cold path: fires at most once per process; staging would need a drain hook on every caller

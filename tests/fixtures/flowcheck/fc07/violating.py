@@ -1,0 +1,38 @@
+"""FC07 violating: I/O under locks, a self-deadlock, an order cycle."""
+import os
+import threading
+
+from obs import events
+
+
+class Bad:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._a_lock = threading.Lock()
+        self._b_lock = threading.Lock()
+
+    def trip(self):
+        with self._lock:
+            events.emit("queue", "queue_full")
+
+    def save(self):
+        with self._lock:
+            self._save_locked()
+
+    def _save_locked(self):
+        os.replace("journal.tmp", "journal")
+
+    def reenter(self):
+        with self._a_lock:
+            with self._a_lock:
+                pass
+
+    def ab(self):
+        with self._a_lock:
+            with self._b_lock:
+                pass
+
+    def ba(self):
+        with self._b_lock:
+            with self._a_lock:
+                pass

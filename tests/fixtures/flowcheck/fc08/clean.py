@@ -1,0 +1,31 @@
+"""FC08 clean: every decline path reaches a registered typed event."""
+import events
+from metrics import registry as _metrics
+
+
+class QueueDeclined(Exception):
+    pass
+
+
+class Admission:
+    def __init__(self):
+        self._event_buf = []
+
+    def offer(self, ok):
+        if not ok:
+            events.emit("queue", "queue_full")
+            raise QueueDeclined("full")
+        return True
+
+    def throttle(self, hard):
+        reason = "tenant_throttle" if hard else "queue_full"
+        events.emit("tenant", reason)
+        _metrics.inc("tenant_declines")
+
+    def _count_shed(self, n):
+        self._event_buf.append(("queue", "queue_full", n))
+
+    def _drain_events(self):
+        staged, self._event_buf = self._event_buf, []
+        for kind, reason, n in staged:
+            events.emit(kind, reason, cost=n)

@@ -1,0 +1,13 @@
+"""FC08 fixture vocabulary (the obs/events.py shape)."""
+
+REASONS = (
+    "queue_full",
+    "tenant_throttle",
+    "breaker_trip",
+    "dead_reason",
+)
+
+
+def emit(kind, reason, **fields):
+    if reason not in REASONS:
+        raise ValueError(f"unknown reason: {reason}")

@@ -1,0 +1,14 @@
+"""FC08 suppressed: a deliberate silent decline, reason inline."""
+import events
+
+
+class ProbeDeclined(Exception):
+    pass
+
+
+def probe(ok):
+    events.emit("breaker", "breaker_trip")
+    if not ok:
+        # flowcheck: disable=FC08 -- probe declines are journaled by the caller; a second emit here would double-count the decline
+        raise ProbeDeclined("probe")
+    return True

@@ -1,0 +1,23 @@
+"""FC08 violating: unregistered reason, silent declines, naked counter."""
+import events
+from metrics import registry as _metrics
+
+
+class RouteDeclined(Exception):
+    pass
+
+
+class Gate:
+    def admit(self, ok):
+        if not ok:
+            raise RouteDeclined("no")
+        return True
+
+    def typo(self):
+        events.emit("queue", "queue_fulll")
+
+    def _count_drop(self, n):
+        self.dropped = n
+
+    def shed(self):
+        _metrics.inc("route_declines")

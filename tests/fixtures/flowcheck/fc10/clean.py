@@ -1,0 +1,36 @@
+"""FC10 clean: every thread has a join path, every fd a close path."""
+import socket
+import threading
+
+
+class Owner:
+    def start(self):
+        self._worker = threading.Thread(target=self._loop)
+        self._worker.start()
+
+    def supervise(self, sup):
+        self._ticker = sup.spawn(self._loop, "ticker")
+
+    def make(self):
+        return threading.Thread(target=self._loop)
+
+    def run_once(self):
+        t = threading.Thread(target=self._loop)
+        t.start()
+        t.join(timeout=2)
+
+    def register(self, tracker):
+        tracker.add(threading.Thread(target=self._loop))
+
+    def open_all(self, path):
+        self._fd = open(path, "a")
+        self._sock = socket.create_server(("127.0.0.1", 0))
+
+    def stop(self):
+        self._worker.join(timeout=2)
+        self._ticker.join(timeout=2)
+        self._fd.close()
+        self._sock.close()
+
+    def _loop(self):
+        pass

@@ -1,0 +1,7 @@
+"""FC10 suppressed: deliberate fire-and-forget, reason inline."""
+import threading
+
+
+def announce(wave):
+    # flowcheck: disable=FC10 -- the announce wave must never block shutdown; it may outlive drain by design
+    threading.Thread(target=wave, daemon=True).start()

@@ -1,0 +1,25 @@
+"""FC10 violating: dropped threads and leaked instance-state fds."""
+import socket
+import threading
+
+
+class Spawner:
+    def serve(self):
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def keep(self):
+        self._worker = threading.Thread(target=self._loop)
+        self._worker.start()
+
+    def local(self):
+        t = threading.Thread(target=self._loop)
+        t.start()
+
+    def _loop(self):
+        pass
+
+
+class Holder:
+    def __init__(self, path):
+        self._fd = open(path, "a")
+        self._sock = socket.create_connection(("127.0.0.1", 1))

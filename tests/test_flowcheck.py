@@ -113,6 +113,84 @@ def test_fc06_metric_name_discipline():
     assert result.suppressed_count == 1
 
 
+def test_fc07_lock_discipline():
+    result = _run(_fixture("fc07"), rule_ids=["FC07"])
+    got = {(f.path, f.line) for f in result.findings}
+    assert got == {("violating.py", 16),   # emit under lock
+                   ("violating.py", 23),   # os.replace via *_locked helper
+                   ("violating.py", 27),   # self-deadlock re-acquire
+                   ("violating.py", 32),   # A->B half of the cycle
+                   ("violating.py", 37)}   # B->A half of the cycle
+    msgs = " | ".join(f.message for f in result.findings)
+    assert "journal emit while holding lock '_lock' in 'trip'" in msgs
+    assert "'save -> _save_locked'" in msgs  # helper closure followed
+    assert "self-deadlock" in msgs
+    assert "lock-ordering cycle" in msgs
+    # clean.py stages under the lock and drains after release: silent
+    assert result.suppressed_count == 1
+
+
+def test_fc08_degradation_event_completeness():
+    result = _run(_fixture("fc08"), rule_ids=["FC08"])
+    got = {(f.path, f.line) for f in result.findings}
+    assert got == {("events.py", 3),       # dead_reason never emitted
+                   ("violating.py", 13),   # silent decline raise
+                   ("violating.py", 17),   # unregistered reason literal
+                   ("violating.py", 19),   # _count_drop helper, no emit
+                   ("violating.py", 23)}   # naked degradation counter
+    msgs = " | ".join(f.message for f in result.findings)
+    assert "dead vocabulary" in msgs
+    assert "decline raise 'RouteDeclined'" in msgs
+    assert "'queue_fulll' is not registered" in msgs
+    assert "shed/drop counter helper '_count_drop'" in msgs
+    assert "counter 'route_declines' is bumped" in msgs
+    # clean.py: emit-adjacent raise, conditional-literal reason, the
+    # _count_shed stage-then-drain pattern — all silent
+    assert result.suppressed_count == 1
+
+
+def test_fc08_no_vocabulary_module_is_silent():
+    result = _run(_fixture("fc01"), rule_ids=["FC08"])
+    assert result.findings == []
+
+
+def test_fc09_fault_site_coverage():
+    result = _run(_fixture("fc09"), rule_ids=["FC09"])
+    got = {(f.path, f.line) for f in result.findings}
+    assert got == {("app.py", 12),                 # unregistered site
+                   ("utils/faultinject.py", 3)}    # registry-side trio
+    msgs = " | ".join(f.message for f in result.findings)
+    assert len(result.findings) == 4
+    assert "'not_registered' is not registered" in msgs
+    assert "'dead_site' is never checked" in msgs
+    assert "'undocumented' is missing from the flowgger.toml" in msgs
+    assert "'undrilled' is referenced by no test" in msgs
+    assert result.suppressed_count == 1  # the legacy_site shim
+
+
+def test_fc09_no_registry_module_is_silent():
+    result = _run(_fixture("fc01"), rule_ids=["FC09"])
+    assert result.findings == []
+
+
+def test_fc10_thread_and_resource_lifecycle():
+    result = _run(_fixture("fc10"), rule_ids=["FC10"])
+    got = {(f.path, f.line) for f in result.findings}
+    assert got == {("violating.py", 8),    # ctor+start, no handle
+                   ("violating.py", 11),   # self._worker never joined
+                   ("violating.py", 15),   # local only started
+                   ("violating.py", 24),   # self._fd never closed
+                   ("violating.py", 25)}   # self._sock never closed
+    msgs = " | ".join(f.message for f in result.findings)
+    assert "no handle kept" in msgs
+    assert "'self._worker' is never joined" in msgs
+    assert "thread local 't' is only started" in msgs
+    assert "'self._fd' has no close" in msgs
+    # clean.py: joined attr, returned ctor, joined local, tracked
+    # container, supervisor spawn with a join — all silent
+    assert result.suppressed_count == 1
+
+
 def test_fc06_no_declaration_module_is_silent():
     # a project without a _COUNTERS-defining metrics.py has no
     # namespace to resolve against: FC06 must not fire on it
@@ -161,8 +239,56 @@ def test_cli_exit_2_on_usage_errors(tmp_path):
 def test_cli_list_rules():
     r = _cli("--list-rules")
     assert r.returncode == 0
-    for rid in ("FC01", "FC02", "FC03", "FC04", "FC05"):
+    for rid in ("FC01", "FC02", "FC03", "FC04", "FC05",
+                "FC06", "FC07", "FC08", "FC09", "FC10"):
         assert rid in r.stdout
+
+
+def test_cli_expect_rules_gate():
+    r = _cli(_fixture("fc01"), "--rules", "FC04", "--expect-rules", "10")
+    assert r.returncode == 0, r.stdout + r.stderr
+    r = _cli(_fixture("fc01"), "--expect-rules", "9")
+    assert r.returncode == 2
+    assert "expected 9" in r.stderr
+
+
+def test_cli_prints_wall_time():
+    r = _cli(_fixture("fc01"), "--rules", "FC04")
+    assert r.returncode == 0
+    assert "flowcheck: scanned" in r.stderr and "s" in r.stderr
+
+
+def test_cli_changed_mode(tmp_path):
+    env = {**os.environ,
+           "GIT_AUTHOR_NAME": "t", "GIT_AUTHOR_EMAIL": "t@example.com",
+           "GIT_COMMITTER_NAME": "t", "GIT_COMMITTER_EMAIL": "t@example.com"}
+
+    def git(*a):
+        subprocess.run(["git", *a], cwd=tmp_path, check=True,
+                       capture_output=True, env=env)
+
+    git("init", "-q")
+    bare = ("def f():\n    try:\n        pass\n"
+            "    except:\n        pass\n")
+    outputs = tmp_path / "outputs"  # FC04's scope: sink/transport code
+    outputs.mkdir()
+    (outputs / "stale.py").write_text(bare)
+    (outputs / "fresh.py").write_text("x = 1\n")
+    git("add", ".")
+    git("commit", "-qm", "seed")
+    # nothing changed vs HEAD: incremental mode exits 0 without a scan
+    r = _cli(str(tmp_path), "--changed", "HEAD", "--rules", "FC04")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "nothing to scan" in r.stdout
+    # a new violation in a changed file fails; stale.py's pre-existing
+    # one is outside the diff and stays unreported (the full run owns it)
+    (outputs / "fresh.py").write_text(bare.replace("f()", "g()"))
+    r = _cli(str(tmp_path), "--changed", "HEAD", "--rules", "FC04")
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "fresh.py" in r.stdout and "stale.py" not in r.stdout
+    # a bad ref is a usage error, not a silent full scan
+    r = _cli(str(tmp_path), "--changed", "no-such-ref")
+    assert r.returncode == 2
 
 
 def test_cli_runs_without_importing_jax():
@@ -206,6 +332,43 @@ def test_sarif_report_shape():
     loc = res["locations"][0]["physicalLocation"]
     assert loc["artifactLocation"]["uri"] == "violating.py"
     assert loc["region"]["startLine"] in (15, 17)
+
+
+def test_sarif_out_and_validation(tmp_path):
+    sarif_path = tmp_path / "report.sarif"
+    r = _cli(_fixture("fc02"), "--rules", "FC02",
+             "--sarif-out", str(sarif_path))
+    assert r.returncode == 1  # findings still gate; the file is extra
+    doc = json.loads(sarif_path.read_text())
+    assert doc["version"] == "2.1.0"
+    assert doc["runs"][0]["results"]
+    r = _cli("--validate-sarif", str(sarif_path))
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "well-formed" in r.stdout
+
+
+def test_validate_sarif_fast_fails_on_malformed(tmp_path):
+    bad = tmp_path / "bad.sarif"
+    # structurally JSON but not SARIF: no runs
+    bad.write_text(json.dumps({"version": "2.1.0", "runs": []}))
+    r = _cli("--validate-sarif", str(bad))
+    assert r.returncode == 2
+    assert "malformed" in r.stderr
+    # results referencing an undeclared rule and missing locations
+    bad.write_text(json.dumps({
+        "$schema": "x", "version": "2.1.0",
+        "runs": [{"tool": {"driver": {"name": "flowcheck",
+                                      "rules": [{"id": "FC01"}]}},
+                  "results": [{"ruleId": "FC99",
+                               "message": {"text": "m"},
+                               "locations": []}]}]}))
+    r = _cli("--validate-sarif", str(bad))
+    assert r.returncode == 2
+    assert "FC99" in r.stderr and "locations" in r.stderr
+    bad.write_text("{not json")
+    assert _cli("--validate-sarif", str(bad)).returncode == 2
+    assert _cli("--validate-sarif",
+                str(tmp_path / "missing.sarif")).returncode == 2
 
 
 # -- baseline workflow -------------------------------------------------------
@@ -268,6 +431,30 @@ def test_baseline_counts_are_a_multiset(tmp_path):
     assert len(result.findings) == 1  # the blocking-call finding remains
 
 
+def test_check_fails_on_stale_baseline(tmp_path):
+    """Satellite contract: zero unexplained baseline growth AND
+    shrinkage — a tombstone for a fixed finding must be deleted."""
+    proj = tmp_path / "proj"
+    proj.mkdir()
+    (proj / "app.py").write_text("x = 1\n")
+    baseline = tmp_path / "baseline.json"
+    baseline.write_text(json.dumps([{
+        "rule": "FC04", "path": "app.py",
+        "message": "a finding that no longer exists",
+        "count": 1, "reason": "fixed ages ago"}]))
+    # stale alone is not a failure without --check (local iteration)
+    r = _cli(str(proj), "--baseline", str(baseline))
+    assert r.returncode == 0, r.stdout + r.stderr
+    r = _cli(str(proj), "--baseline", str(baseline), "--check")
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "stale baseline" in r.stderr
+    assert "delete the tombstone" in r.stderr
+    # a partial run cannot tell fixed from not-checked: --check is quiet
+    r = _cli(str(proj), "--baseline", str(baseline), "--check",
+             "--rules", "FC04")
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
 # -- the actual gate ---------------------------------------------------------
 
 def test_repo_has_zero_non_baselined_findings():
@@ -280,8 +467,18 @@ def test_repo_has_zero_non_baselined_findings():
     assert len(result.project.modules) > 50  # the scan actually scanned
 
 
+@pytest.mark.parametrize("rid", ["FC07", "FC08", "FC09", "FC10"])
+def test_repo_is_clean_under_each_new_rule(rid):
+    """The tentpole acceptance per rule: the new contract rules hold
+    tree-wide at HEAD with real fixes (plus reasoned suppressions),
+    not baseline entries."""
+    result = _run(REPO, rule_ids=[rid])
+    assert result.findings == [], "\n".join(
+        f.render() for f in result.findings)
+
+
 def test_rule_catalog_is_complete():
     rules = all_rules()
     assert list(rules) == ["FC01", "FC02", "FC03", "FC04", "FC05",
-                           "FC06"]
+                           "FC06", "FC07", "FC08", "FC09", "FC10"]
     assert all(rule.title for rule in rules.values())
