@@ -1331,7 +1331,10 @@ def bench_fused_routes(extra, smoke):
     from flowgger_tpu.decoders.ltsv import LTSVDecoder
     from flowgger_tpu.decoders.rfc3164 import RFC3164Decoder
     from flowgger_tpu.decoders.rfc5424 import RFC5424Decoder
+    from flowgger_tpu.encoders.capnp import CapnpEncoder
     from flowgger_tpu.encoders.gelf import GelfEncoder
+    from flowgger_tpu.encoders.ltsv import LTSVEncoder
+    from flowgger_tpu.encoders.rfc5424 import RFC5424Encoder
     from flowgger_tpu.mergers import LineMerger
     from flowgger_tpu.tpu import fused_routes, gelf, ltsv, pack, rfc3164, rfc5424
     from flowgger_tpu.tpu.batch import block_fetch_encode, block_submit
@@ -1341,23 +1344,40 @@ def bench_fused_routes(extra, smoke):
     enc = GelfEncoder(cfg)
     merger = LineMerger()
     n = 512 if smoke else 1024
+    lines_5424 = [
+        f'<34>1 2015-08-05T15:53:45.8Z host{i % 3} app 42 m '
+        f'[x@9 a="v{i}" b="w{i}"] hello msg {i}'.encode()
+        for i in range(n)]
+    lines_3164 = [
+        f'<34>Aug  5 15:53:45 host{i % 3} app[42]: legacy message '
+        f'body {i}'.encode() for i in range(n)]
+    dec_5424 = RFC5424Decoder(cfg)
+    dec_3164 = RFC3164Decoder(cfg)
+    # route name -> (fmt, decoder, encoder, corpus); the output leg of
+    # each route keys on the concrete encoder type (route_for)
     corpora = {
-        "rfc5424_gelf": ("rfc5424", RFC5424Decoder(cfg), [
-            f'<34>1 2015-08-05T15:53:45.8Z host{i % 3} app 42 m '
-            f'[x@9 a="v{i}" b="w{i}"] hello msg {i}'.encode()
-            for i in range(n)]),
-        "rfc3164_gelf": ("rfc3164", RFC3164Decoder(cfg), [
-            f'<34>Aug  5 15:53:45 host{i % 3} app[42]: legacy message '
-            f'body {i}'.encode() for i in range(n)]),
-        "ltsv_gelf": ("ltsv", LTSVDecoder(cfg), [
+        "rfc5424_gelf": ("rfc5424", dec_5424, enc, lines_5424),
+        "rfc3164_gelf": ("rfc3164", dec_3164, enc, lines_3164),
+        "ltsv_gelf": ("ltsv", LTSVDecoder(cfg), enc, [
             f'host:h{i % 3}\ttime:2015-08-05T15:53:45Z\tuser:u{i % 7}\t'
             f'req:GET /idx {i}\tstatus:200\tmessage:done {i}'.encode()
             for i in range(n)]),
-        "gelf_gelf": ("gelf", GelfDecoder(cfg), [
+        "gelf_gelf": ("gelf", GelfDecoder(cfg), enc, [
             ('{"version":"1.1","host":"h%d","short_message":"request %d '
              'done","timestamp":1438790025.5,"_user":"u%d",'
              '"_status":"200"}' % (i % 3, i, i % 7)).encode()
             for i in range(n)]),
+        # PR 19 non-GELF output legs (the N×M closure): byte blobs are
+        # compared whole — capnp is binary, so re-splitting the framed
+        # stream would be framing-dependent
+        "rfc5424_rfc5424": ("rfc5424", dec_5424, RFC5424Encoder(cfg),
+                            lines_5424),
+        "rfc3164_rfc5424": ("rfc3164", dec_3164, RFC5424Encoder(cfg),
+                            lines_3164),
+        "rfc5424_ltsv": ("rfc5424", dec_5424, LTSVEncoder(cfg),
+                         lines_5424),
+        "rfc5424_capnp": ("rfc5424", dec_5424, CapnpEncoder(cfg),
+                          lines_5424),
     }
     fetchers = {"rfc5424": rfc5424.decode_rfc5424_fetch,
                 "rfc3164": rfc3164.decode_rfc3164_fetch,
@@ -1375,24 +1395,24 @@ def bench_fused_routes(extra, smoke):
     routes_out = {}
     ok = True
     try:
-        for name, (fmt, decoder, lines) in corpora.items():
+        for name, (fmt, decoder, enc_r, lines) in corpora.items():
             packed = pack.pack_lines_2d(lines, 256)
             ltsv_dec = decoder if fmt == "ltsv" else None
-            route = fused_routes.route_for(fmt, enc, merger, ltsv_dec)
+            route = fused_routes.route_for(fmt, enc_r, merger, ltsv_dec)
             # split HOST reference: block-path bytes + its span-channel
             # D2H volume (context only — it trades D2H for host CPU)
             handle = block_submit(fmt, packed)
             host_bpr = sum(np.asarray(v).nbytes for v in
                            fetchers[fmt](handle).values()) / n
             res_split, _, _ = block_fetch_encode(
-                fmt, handle, packed, enc, merger, ltsv_dec,
+                fmt, handle, packed, enc_r, merger, ltsv_dec,
                 route_state={}, allow_device=False)
             # split DEVICE reference: the two-program decode→encode
             # pipeline the fusion replaces; counter delta = exact D2H
             dev0 = reg.get("device_encode_fetch_bytes")
             with jax.disable_jit():
                 res_dev, _, _ = block_fetch_encode(
-                    fmt, block_submit(fmt, packed), packed, enc,
+                    fmt, block_submit(fmt, packed), packed, enc_r,
                     merger, ltsv_dec, route_state={}, allow_device=True)
             split_dev_bpr = (reg.get("device_encode_fetch_bytes")
                              - dev0) / n
@@ -1401,16 +1421,14 @@ def bench_fused_routes(extra, smoke):
             with jax.disable_jit():
                 fh = fused_routes.submit(route, packed)
                 res_fused, _ = fused_routes.fetch_encode(
-                    fh, packed, enc, merger, ltsv_dec, {})
+                    fh, packed, enc_r, merger, ltsv_dec, {})
             wall = time.perf_counter() - t0
             fused_bytes = reg.get("device_encode_fetch_bytes") - fus0
             identical = (
                 res_fused is not None
-                and list(res_fused.block.iter_framed())
-                == list(res_split.block.iter_framed())
+                and res_fused.block.data == res_split.block.data
                 and res_dev is not None
-                and list(res_dev.block.iter_framed())
-                == list(res_split.block.iter_framed()))
+                and res_dev.block.data == res_split.block.data)
             fetch_bpr = reg.get_gauge(f"fetch_bytes_per_row_{name}")
             emit_bpr = reg.get_gauge(f"emit_bytes_per_row_{name}")
             routes_out[name] = {

@@ -54,10 +54,11 @@ JAX_PLATFORMS=cpu timeout 900 python bench.py --smoke
 
 echo "== python test suite (virtual 8-device CPU mesh) =="
 # slow-marked tests are excluded here (pytest.ini tier-1 contract);
-# both current ones still run in CI: the lanes cold-process cache test
-# in the 2-device step below, and the fused deep fuzz via its own
-# dedicated step (running the in-suite wrapper here would execute the
-# same ~10-minute fuzz twice per CI pass)
+# all of them still run in CI via dedicated capped steps below: the
+# lanes cold-process cache test in the 2-device step, the device
+# encode-output differentials in their own step, and the fused deep
+# fuzz in its step (running the in-suite wrapper here would execute
+# the same ~10-minute fuzz twice per CI pass)
 python -m pytest tests/ -q -m "not faults and not slow"
 
 echo "== lane-dispatch suite (forced 2-device CPU) =="
@@ -167,11 +168,23 @@ timeout 900 python tools/deep_fuzz.py --routes framing 1 4
 echo "== fault-injection suite (robustness degradation paths) =="
 JAX_PLATFORMS=cpu python -m pytest tests/ -q -m "faults and not slow"
 
+echo "== device encode outputs (rfc5424/ltsv/capnp legs, differential) =="
+# the PR 19 N×M output legs: split kernels (device_rfc5424_out /
+# device_ltsv_out / device_capnp) and their fused registrations vs the
+# scalar oracles across line/nul/syslen, fallback splicing, per-route
+# gauge denominators, and 1/2-lane BatchHandler byte identity.  The
+# file is slow-marked (excluded from the tier-1 pytest step above) so
+# its eager differentials don't double the main suite's wall time;
+# measured ~2min on the 2-core container
+JAX_PLATFORMS=cpu timeout 600 python -m pytest tests/test_device_encode_out.py -q -m "not faults"
+
 echo "== fused-route deep fuzz (slow: eager route matrix vs scalar oracle) =="
-# every fused route (rfc5424/rfc3164/ltsv/gelf -> GELF) over randomized
-# framing vs its scalar oracle, run eagerly so it holds even where this
-# host's XLA cannot compile the fused programs; the larger-budget
-# version is `python tools/deep_fuzz.py --routes fused <seed> <trials>`
+# the fused route matrix — every decode leg -> GELF plus the PR 19
+# output legs (rfc5424->rfc5424/ltsv/capnp, rfc3164->rfc5424) — over
+# randomized framing vs its scalar oracle, run eagerly so it holds
+# even where this host's XLA cannot compile the fused programs; the
+# larger-budget version is
+# `python tools/deep_fuzz.py --routes fused <seed> <trials>`
 JAX_PLATFORMS=cpu timeout 900 python tools/deep_fuzz.py --routes fused 1 2
 
 echo "== native build =="

@@ -69,8 +69,12 @@ XLA_CACHE_SUBDIR = "xla-cache"
 
 DECODE_FORMATS = ("rfc5424", "rfc3164", "ltsv", "gelf", "jsonl", "dns")
 ENCODE_MODULES = ("device_gelf", "device_rfc3164", "device_ltsv",
-                  "device_gelf_gelf")
-FUSED_ROUTES = ("rfc5424_gelf", "rfc3164_gelf", "ltsv_gelf", "gelf_gelf")
+                  "device_gelf_gelf", "device_rfc5424_out",
+                  "device_rfc5424_out_3164", "device_ltsv_out",
+                  "device_capnp")
+FUSED_ROUTES = ("rfc5424_gelf", "rfc3164_gelf", "ltsv_gelf", "gelf_gelf",
+                "rfc5424_rfc5424", "rfc3164_rfc5424", "rfc5424_ltsv",
+                "rfc5424_capnp")
 # framing name -> block merger suffix; syslen shares "line"'s b"\n"
 # (block_common.merger_suffix: the syslen prefix is a host-side splice)
 FRAMINGS = {"line": b"\n", "nul": b"\x00"}
@@ -231,7 +235,8 @@ def fused_statics(route_name: str, suffix: bytes, impl: str,
 
     statics = {"suffix": suffix, "impl": impl, "extras": extras,
                "demand": DEMAND[route_name], "elide": True}
-    if route_name == "rfc5424_gelf":
+    if route_name in ("rfc5424_gelf", "rfc5424_rfc5424", "rfc5424_ltsv",
+                      "rfc5424_capnp"):
         from .rfc5424 import DEFAULT_MAX_SD
 
         statics["max_sd"] = DEFAULT_MAX_SD
@@ -260,6 +265,17 @@ def encode_statics(module: str, suffix: bytes, impl: str,
                    extras: Tuple) -> Dict:
     if module == "device_gelf_gelf":
         return {"suffix": suffix, "elide": True}
+    if module in ("device_rfc5424_out", "device_rfc5424_out_3164"):
+        # the PR 19 output-leg kernels have no impl/extras statics; the
+        # rfc5424 leg carries max_sd, the shared-core rfc3164 leg not
+        statics = {"suffix": suffix, "elide": True}
+        if module == "device_rfc5424_out":
+            from .rfc5424 import DEFAULT_MAX_SD
+
+            statics["max_sd"] = DEFAULT_MAX_SD
+        return statics
+    if module in ("device_ltsv_out", "device_capnp"):
+        return {"suffix": suffix, "extras": extras, "elide": True}
     statics = {"suffix": suffix, "impl": impl, "extras": extras,
                "elide": True}
     if module == "device_gelf":
@@ -799,32 +815,46 @@ def prewarm_covered(fmt: str, rows: int, max_len: int, encoder=None,
                 return False
         # prewarm warms the split pair too (the fused tier's decline
         # fallback), so coverage must include it — fall through
-    module = _ENCODE_MODULE_FOR_FMT.get(fmt)
-    if module is None:
-        return True  # no split device-encode tier (jsonl/dns): decode was all
-    if not _split_route_ok(module, encoder, merger, ltsv_decoder):
-        return True  # split device tier never engages: decode was all
-    statics = encode_statics(module, suffix, impl, extras)
-    dec_spec = _dec_spec_for(module, rows, max_len)
-    for assemble, ts_w in ((False, 0), (True, TS_W)):
-        if not store.covers(module, {**statics, "assemble": assemble},
-                            _shape_spec(rows, max_len, ts_w=ts_w,
-                                        dec_spec=dec_spec)):
-            return False
+    for module in _ENCODE_MODULES_FOR_FMT.get(fmt, ()):
+        # jsonl/dns have no entries (host block path is the only tier);
+        # per-encoder route gates mean at most one module engages
+        if not _split_route_ok(module, encoder, merger, ltsv_decoder):
+            continue
+        statics = encode_statics(module, suffix, impl, extras)
+        dec_spec = _dec_spec_for(module, rows, max_len)
+        for assemble, ts_w in ((False, 0), (True, TS_W)):
+            if not store.covers(module,
+                                {**statics, "assemble": assemble},
+                                _shape_spec(rows, max_len, ts_w=ts_w,
+                                            dec_spec=dec_spec)):
+                return False
+        break
     return True
 
 
-_ENCODE_MODULE_FOR_FMT = {"rfc5424": "device_gelf",
-                          "rfc3164": "device_rfc3164",
-                          "ltsv": "device_ltsv",
-                          "gelf": "device_gelf_gelf"}
+# split device-encode legs per input format: the →GELF module first
+# (the original tier), then the PR 19 output legs; batch.py engages at
+# most one per batch (the route gates key on concrete encoder type)
+_ENCODE_MODULES_FOR_FMT = {
+    "rfc5424": ("device_gelf", "device_rfc5424_out", "device_ltsv_out",
+                "device_capnp"),
+    "rfc3164": ("device_rfc3164", "device_rfc5424_out_3164"),
+    "ltsv": ("device_ltsv",),
+    "gelf": ("device_gelf_gelf",),
+}
+_MODULE_FMT = {m: f for f, ms in _ENCODE_MODULES_FOR_FMT.items()
+               for m in ms}
+# AOT module name -> python module (the rfc3164→rfc5424 leg shares the
+# SD-assembly core module under a distinct artifact family)
+_MODULE_IMPORT = {"device_rfc5424_out_3164": "device_rfc5424_out"}
 
 
 def _split_route_ok(module: str, encoder, merger,
                     ltsv_decoder=None) -> bool:
     import importlib
 
-    mod = importlib.import_module(f".{module}", __package__)
+    mod = importlib.import_module(
+        "." + _MODULE_IMPORT.get(module, module), __package__)
     if module == "device_ltsv":
         # the real dispatch gate sees the decoder: a schema'd LTSV
         # route is host work, so demanding split-encode coverage for
@@ -842,7 +872,7 @@ def _dec_spec_for(module: str, rows: int, max_len: int) -> List:
 
     b = jax.ShapeDtypeStruct((rows, max_len), jnp.uint8)
     ln = jax.ShapeDtypeStruct((rows,), jnp.int32)
-    fmt = {v: k for k, v in _ENCODE_MODULE_FOR_FMT.items()}[module]
+    fmt = _MODULE_FMT[module]
     if fmt == "rfc3164":
         yr = jax.ShapeDtypeStruct((), jnp.int32)
         dec = jax.eval_shape(_decode_fn(fmt), b, ln, yr)
@@ -902,6 +932,28 @@ def _fused_fn(route_name: str, statics: Dict):
         return lambda b, ln, ts, tl: _fr._fused_ltsv_gelf(
             b, ln, ts, tl, suffix=suffix, impl=impl,
             assemble=assemble, extras=extras, demand=demand)
+    if route_name == "rfc5424_rfc5424":
+        max_sd = statics["max_sd"]
+
+        return lambda b, ln, ts, tl: _fr._fused_rfc5424_rfc5424(
+            b, ln, ts, tl, max_sd=max_sd, suffix=suffix,
+            assemble=assemble, demand=demand)
+    if route_name == "rfc3164_rfc5424":
+        return lambda b, ln, yr, ts, tl: _fr._fused_rfc3164_rfc5424(
+            b, ln, yr, ts, tl, suffix=suffix, assemble=assemble,
+            demand=demand)
+    if route_name == "rfc5424_ltsv":
+        max_sd = statics["max_sd"]
+
+        return lambda b, ln, ts, tl: _fr._fused_rfc5424_ltsv(
+            b, ln, ts, tl, max_sd=max_sd, suffix=suffix,
+            extras=extras, assemble=assemble, demand=demand)
+    if route_name == "rfc5424_capnp":
+        max_sd = statics["max_sd"]
+
+        return lambda b, ln, ts, tl: _fr._fused_rfc5424_capnp(
+            b, ln, ts, tl, max_sd=max_sd, suffix=suffix,
+            extras=extras, assemble=assemble, demand=demand)
     return lambda b, ln, ts, tl: _fr._fused_gelf_gelf(
         b, ln, ts, tl, suffix=statics["suffix"],
         assemble=assemble, demand=demand)
@@ -910,9 +962,13 @@ def _fused_fn(route_name: str, statics: Dict):
 def _encode_fn(module: str, statics: Dict):
     import importlib
 
-    mod = importlib.import_module(f".{module}", __package__)
+    mod = importlib.import_module(
+        "." + _MODULE_IMPORT.get(module, module), __package__)
+    kernel = (mod._encode_kernel_3164
+              if module == "device_rfc5424_out_3164"
+              else mod._encode_kernel)
     kw = {k: v for k, v in statics.items() if k != "demand"}
-    return lambda b, ln, dec, ts, tl: mod._encode_kernel(
+    return lambda b, ln, dec, ts, tl: kernel(
         b, ln, dec, ts, tl, **kw)
 
 
@@ -1054,7 +1110,8 @@ def build_artifacts(out_dir: str, platforms=("cpu",),
                                                 impl, extras),
                                 "assemble": assemble}
                             args = ((b, ln, yr, ts, tl)
-                                    if route_name == "rfc3164_gelf"
+                                    if route_name in ("rfc3164_gelf",
+                                                      "rfc3164_rfc5424")
                                     else (b, ln, ts, tl))
                             add_entry(f"fused_{route_name}", platform,
                                       rows, route_name,
@@ -1083,27 +1140,29 @@ def build_artifacts(out_dir: str, platforms=("cpu",),
                           gst)
             if "encode" in families:
                 for fmt in formats:
-                    module = _ENCODE_MODULE_FOR_FMT.get(fmt)
-                    if module is None:
-                        continue  # jsonl/dns: no device-encode kernel
+                    # jsonl/dns: no device-encode kernel (empty tuple);
+                    # the decode channels are shared by every split
+                    # module of this input format
                     dec = None
-                    for suffix in suffixes:
-                        for assemble, ts in ((False, probe_ts),
-                                             (True, full_ts)):
-                            if dec is None:
-                                if fmt == "rfc3164":
-                                    dec = jax.eval_shape(
-                                        _decode_fn(fmt), b, ln, yr)
-                                else:
-                                    dec = jax.eval_shape(
-                                        _decode_fn(fmt), b, ln)
-                            statics = {
-                                **encode_statics(module, suffix, impl,
-                                                 extras),
-                                "assemble": assemble}
-                            add_entry(module, platform, rows, fmt,
-                                      _encode_fn(module, statics),
-                                      (b, ln, dec, ts, tl), statics)
+                    for module in _ENCODE_MODULES_FOR_FMT.get(fmt, ()):
+                        for suffix in suffixes:
+                            for assemble, ts in ((False, probe_ts),
+                                                 (True, full_ts)):
+                                if dec is None:
+                                    if fmt == "rfc3164":
+                                        dec = jax.eval_shape(
+                                            _decode_fn(fmt), b, ln, yr)
+                                    else:
+                                        dec = jax.eval_shape(
+                                            _decode_fn(fmt), b, ln)
+                                statics = {
+                                    **encode_statics(module, suffix,
+                                                     impl, extras),
+                                    "assemble": assemble}
+                                add_entry(module, platform, rows, fmt,
+                                          _encode_fn(module, statics),
+                                          (b, ln, dec, ts, tl),
+                                          statics)
         if platform not in manifest["platforms"]:
             manifest["platforms"].append(platform)
 

@@ -1903,6 +1903,22 @@ def block_fetch_encode(fmt, handle, packed, encoder, merger,
             _metrics.add_seconds("device_encode_declined_seconds",
                                  declined_s)
             t0 = _time.perf_counter()
+        elif allow_device and type(encoder) is RFC5424Encoder:
+            # PR 19: rfc3164→rfc5424 device leg (shared SD-assembly
+            # core with the rfc5424→rfc5424 kernel)
+            from . import device_rfc5424_out
+
+            if device_rfc5424_out.route_ok(encoder, merger):
+                res, fetch_s = device_rfc5424_out.fetch_encode_3164(
+                    handle, packed, encoder, merger, route_state)
+                if res is not None:
+                    if stats is not None:
+                        stats["path"] = "device"
+                    return res, fetch_s, 0.0
+                declined_s = _time.perf_counter() - t0
+                _metrics.add_seconds("device_encode_declined_seconds",
+                                     declined_s)
+                t0 = _time.perf_counter()
         host_out = rfc3164.decode_rfc3164_fetch(handle)
         t1 = _time.perf_counter()
         _tap_columns(column_tap, host_out)
@@ -2046,12 +2062,18 @@ def block_fetch_encode(fmt, handle, packed, encoder, merger,
                 packed[2], packed[3], packed[4], host_out, packed[5],
                 packed[0].shape[1], encoder, merger)
     else:
-        from . import device_gelf, rfc5424
+        from . import rfc5424
 
-        if allow_device and device_gelf.route_ok(encoder, merger):
-            res, fetch_s = device_gelf.fetch_encode(handle, packed,
-                                                    encoder, merger,
-                                                    route_state)
+        # the rfc5424 device-encode tier is per output leg: GELF keeps
+        # its original module; the PR 19 legs (rfc5424/ltsv/capnp out)
+        # each bring their own kernel + route gate.  One module per
+        # encoder type, so at most one device attempt per batch.
+        dev_mod = _rfc5424_device_module(encoder)
+        if (allow_device and dev_mod is not None
+                and dev_mod.route_ok(encoder, merger)):
+            res, fetch_s = dev_mod.fetch_encode(handle, packed,
+                                                encoder, merger,
+                                                route_state)
             if res is not None:
                 if stats is not None:
                     stats["path"] = "device"
@@ -2069,6 +2091,35 @@ def block_fetch_encode(fmt, handle, packed, encoder, merger,
     if stats is not None and res is not None:
         stats["path"] = "host"
     return res, t1 - t0, declined_s
+
+
+def _rfc5424_device_module(encoder):
+    """The split device-encode module for an rfc5424-input batch, keyed
+    on the concrete output encoder type — None when no device kernel
+    exists for this leg (host block path is the only tier)."""
+    from ..encoders.capnp import CapnpEncoder
+    from ..encoders.gelf import GelfEncoder
+    from ..encoders.ltsv import LTSVEncoder
+    from ..encoders.rfc5424 import RFC5424Encoder
+
+    t = type(encoder)
+    if t is GelfEncoder:
+        from . import device_gelf
+
+        return device_gelf
+    if t is RFC5424Encoder:
+        from . import device_rfc5424_out
+
+        return device_rfc5424_out
+    if t is LTSVEncoder:
+        from . import device_ltsv_out
+
+        return device_ltsv_out
+    if t is CapnpEncoder:
+        from . import device_capnp
+
+        return device_capnp
+    return None
 
 
 def _tap_columns(column_tap, host_out) -> None:

@@ -1,0 +1,404 @@
+"""Device-side rfc5424→Cap'n Proto encode (capnp_encoder.rs:36-109
+semantics, mirroring encode_capnp_block.py / capnp_wire.py
+byte-for-byte).
+
+The wire image is the same fixed-skeleton shape as the DNS block
+encoder's 13-segment assembly: framing | root ptr | root struct |
+NUL-padded texts | pairs tag+elements | per-pair texts | constant
+extra blob.  Every pointer is a self-relative word, so the whole
+layout reduces to integer word arithmetic over span lengths — all
+computed on device as int32 lanes and emitted as little-endian byte
+planes that ride the assembly gather's scratch argument (the
+computed analogue of the timestamp text plane).
+
+No escape stage: the tier excludes rows whose emitted SD values carry
+JSON escapes (host work), so text segments re-emit verbatim from the
+raw batch.  Elision drops the 32-byte framing+data-words head (its
+``nwords`` is recomputed host-side from the body length, the stamp is
+rendered host-side anyway, facility/severity ride one-byte probe
+channels) and the framing suffix.
+"""
+
+
+from __future__ import annotations
+
+# byte-identity contract (flowcheck FC03): the scalar counterpart
+# this route must stay byte-identical to, and the differential
+# test that enforces it
+SCALAR_ORACLE = "flowgger_tpu.encoders.capnp:CapnpEncoder"
+DIFF_TEST = (
+    "tests/test_device_encode_out.py::test_device_capnp_matches_scalar",
+)
+
+from functools import partial
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..capnp_wire import (
+    PAIR_DATA_WORDS,
+    PAIR_PTR_WORDS,
+    RECORD_DATA_WORDS,
+    RECORD_PTR_WORDS,
+    WORD,
+)
+from .device_common import (
+    TS_W,
+    _out_width,
+    assemble_rows,
+    build_bank,
+    encode_route_ok,
+    fetch_encode_driver,
+)
+
+_I32 = jnp.int32
+_U8 = jnp.uint8
+
+_PAIR_WORDS = PAIR_DATA_WORDS + PAIR_PTR_WORDS   # 4
+_ROOT_WORDS = RECORD_DATA_WORDS + RECORD_PTR_WORDS  # 11
+_HDR_BYTES = 8 + 8 + _ROOT_WORDS * WORD  # 104
+_PW0 = 1 + RECORD_DATA_WORDS  # word index of root pointer slot 0
+_ROOT_PTR = (RECORD_DATA_WORDS | (RECORD_PTR_WORDS << 16)) << 32
+
+_PARTS = {
+    "z16": b"\x00" * 16,
+    "us": b"_",
+    "blob": b"",  # replaced per-config by _bank
+    "tail": b"",
+}
+
+
+def _bank(suffix: bytes, extras: Tuple[Tuple[str, str], ...] = ()
+          ) -> Tuple[bytes, Dict[str, int], Dict[str, bytes]]:
+    """Constant bank; ``capnp_extra`` renders to the host tier's exact
+    row-invariant blob (_extra_blob), so the two tiers can never
+    disagree on extras bytes."""
+    from .encode_capnp_block import _extra_blob
+
+    parts = dict(_PARTS)
+    parts["blob"] = _extra_blob(list(extras))
+    bank, offs = build_bank(parts, suffix)
+    return bank, offs, parts
+
+
+def _render_le_f64(val: float) -> bytes:
+    """Stamp bytes: the raw little-endian f64 pattern the root struct's
+    second data word carries."""
+    import struct
+
+    return struct.pack("<d", float(val))
+
+
+def elide_spec(suffix: bytes, extras=()):
+    return make_elide(suffix)
+
+
+def make_elide(suffix: bytes):
+    """Callable elide: rebuild the 32-byte framing+data-words head
+    (segment count, ``nwords`` from the body length, root pointer,
+    stamp, facility/severity) and append the framing suffix."""
+
+    def splice(body, row_off, small, ts_text, ts_len, ridx):
+        from .device_common import splice_rows
+
+        R = ridx.size
+        lens = np.diff(row_off).astype(np.int64)
+        nwords = lens // WORD + (32 - 8) // WORD
+        head = np.zeros((R, 32), dtype=np.uint8)
+        head[:, 4:8] = nwords.astype("<u4").view(np.uint8).reshape(R, 4)
+        head[:, 8:16] = np.frombuffer(
+            int(_ROOT_PTR).to_bytes(8, "little"), dtype=np.uint8)
+        W = ts_text.shape[1] if ts_text.ndim == 2 else 0
+        head[:, 16:16 + min(8, W)] = np.asarray(
+            ts_text, np.uint8)[ridx][:, :8]
+        head[:, 24] = small["fac8"][ridx]
+        head[:, 25] = small["sev8"][ridx]
+        ins_src = np.concatenate(
+            [head.ravel(), np.frombuffer(suffix, dtype=np.uint8)])
+        ins_at = np.stack([np.zeros(R, dtype=np.int64), lens], axis=1)
+        ins_a = np.stack([
+            np.arange(R, dtype=np.int64) * 32,
+            np.full(R, R * 32, dtype=np.int64),
+        ], axis=1)
+        ins_l = np.stack([
+            np.full(R, 32, dtype=np.int64),
+            np.full(R, len(suffix), dtype=np.int64),
+        ], axis=1)
+        return splice_rows(body, row_off, ins_src, ins_at, ins_a, ins_l)
+
+    return splice
+
+
+def _le8(lo, hi):
+    """[N] i32 lo/hi word halves → [N, 8] little-endian bytes."""
+    cols = [((lo >> (8 * i)) & 0xFF).astype(_U8) for i in range(4)]
+    cols += [((hi >> (8 * i)) & 0xFF).astype(_U8) for i in range(4)]
+    return jnp.stack(cols, axis=1)
+
+
+def _tw(blen):
+    """Words a NUL-terminated text of blen bytes occupies."""
+    return (blen + 1 + WORD - 1) // WORD
+
+
+@partial(jax.jit, static_argnames=("suffix", "extras", "assemble",
+                                   "elide"))
+def _encode_kernel(batch, lens, dec, ts_text, ts_len, *, suffix: bytes,
+                   extras: Tuple[Tuple[str, str], ...] = (),
+                   assemble: bool = True, elide: bool = False):
+    """rfc5424→capnp: _capnp_assemble's word layout + segment plan as
+    int32 device arithmetic; pointer/tag/element words become
+    little-endian byte planes appended to the assembly scratch."""
+    N, L = batch.shape
+    bank, off, parts = _bank(suffix, extras)
+    blob = parts["blob"]
+    blob_w = len(blob) // WORD
+    P = dec["name_start"].shape[1]
+    PLANE_W = _HDR_BYTES + WORD + P * _PAIR_WORDS * WORD
+    OW = _out_width(L, L + len(bank) + PLANE_W)
+    zero = jnp.zeros((N,), dtype=_I32)
+    cbase = L
+    tbase = L + len(bank)
+
+    def span(sk, ek):
+        s = dec[sk].astype(_I32)
+        return s, jnp.maximum(dec[ek].astype(_I32) - s, 0)
+
+    host_s, host_l = span("host_start", "host_end")
+    app_s, app_l = span("app_start", "app_end")
+    proc_s, proc_l = span("proc_start", "proc_end")
+    msgid_s, msgid_l = span("msgid_start", "msgid_end")
+    msg_s = dec["msg_trim_start"].astype(_I32)
+    trim_e = dec["trim_end"].astype(_I32)
+    msg_l = jnp.maximum(trim_e - msg_s, 0)
+    has_msg = msg_l > 0
+    full_s = dec["full_start"].astype(_I32)
+    full_l = jnp.maximum(trim_e - full_s, 0)
+    sdc = dec["sd_count"].astype(_I32)
+    has_sd = sdc > 0
+    sid_s = dec["sid_start"][:, 0].astype(_I32)
+    sid_l = jnp.maximum(dec["sid_end"][:, 0].astype(_I32) - sid_s, 0)
+    pc = dec["pair_count"].astype(_I32)
+
+    # capnp carries only sd[0] (capnp_encoder.rs:78-80) — pair_sd is
+    # nondecreasing, so block-0 membership is a prefix mask and the
+    # first k0 element slots are exactly the emitted ones
+    pvalid, name_s, name_l, val_s, val_l = [], [], [], [], []
+    esc_any = jnp.zeros((N,), dtype=bool)
+    for j in range(P):
+        pv = (j < pc) & (dec["pair_sd"][:, j].astype(_I32) == 0)
+        pvalid.append(pv)
+        ns = dec["name_start"][:, j].astype(_I32)
+        vs = dec["val_start"][:, j].astype(_I32)
+        name_s.append(ns)
+        name_l.append(jnp.where(
+            pv, jnp.maximum(dec["name_end"][:, j].astype(_I32) - ns, 0), 0))
+        val_s.append(vs)
+        val_l.append(jnp.where(
+            pv, jnp.maximum(dec["val_end"][:, j].astype(_I32) - vs, 0), 0))
+        esc_any |= dec["val_has_esc"][:, j].astype(bool) & (j < pc)
+
+    # ---- word layout (encode_capnp_block.py:149-195) ----
+    texts = [
+        (host_s, host_l, None),
+        (app_s, app_l, None),
+        (proc_s, proc_l, None),
+        (msgid_s, msgid_l, None),
+        (msg_s, msg_l, has_msg),
+        (full_s, full_l, None),
+    ]
+    tw = [_tw(l) if g is None else jnp.where(g, _tw(l), 0)
+          for _, l, g in texts]
+    si_w = jnp.where(has_sd, _tw(sid_l), 0)
+    key_w = [jnp.where(pvalid[j], _tw(name_l[j] + 1), 0)
+             for j in range(P)]
+    valw = [jnp.where(pvalid[j], _tw(val_l[j]), 0) for j in range(P)]
+    k0 = zero
+    for j in range(P):
+        k0 = k0 + jnp.where(pvalid[j], 1, 0)
+    kw_sum = zero
+    for j in range(P):
+        kw_sum = kw_sum + key_w[j] + valw[j]
+    pairs_w = jnp.where(has_sd, 1 + k0 * _PAIR_WORDS + kw_sum, 0)
+
+    w_at = [zero + (1 + _ROOT_WORDS)]
+    for w in tw:
+        w_at.append(w_at[-1] + w)
+    w_sid = w_at[-1]
+    w_pairs = w_sid + si_w
+    w_extra = w_pairs + pairs_w
+    nwords = w_extra + blob_w
+
+    tier = (dec["ok"].astype(bool)
+            & ~dec["has_high"].astype(bool)
+            & ~esc_any)
+
+    def _lptr(ptr_word, target, count, elem, gate):
+        off_w = target - ptr_word - 1
+        lo = (off_w << 2) | 1
+        hi = elem | (count << 3)
+        if gate is not None:
+            lo = jnp.where(gate, lo, 0)
+            hi = jnp.where(gate, hi, 0)
+        return _le8(lo, hi)
+
+    segs = [None]  # slot 0: hdr plane segment, filled below
+    out_parts = []
+
+    def add_const(name, gate=None, ln=None):
+        l0 = len(parts[name]) if ln is None else ln
+        lv = zero + l0
+        if gate is not None:
+            lv = jnp.where(gate, lv, 0)
+        segs.append((zero + (cbase + off[name]), lv))
+        out_parts.append(lv)
+
+    def add_seg(s, lv):
+        segs.append((s, lv))
+        out_parts.append(lv)
+
+    # ---- segment plan (encode_capnp_block.py:279-307) ----
+    for (s, l, g), w in zip(texts, tw):
+        gl = l if g is None else jnp.where(g, l, 0)
+        add_seg(s, gl)
+        pad = w * WORD - gl
+        if g is not None:
+            pad = jnp.where(g, pad, 0)
+        add_seg(zero + (cbase + off["z16"]), pad)
+    add_seg(sid_s, jnp.where(has_sd, sid_l, 0))
+    add_seg(zero + (cbase + off["z16"]),
+            jnp.where(has_sd, si_w * WORD - sid_l, 0))
+    add_seg(zero + (tbase + _HDR_BYTES),
+            jnp.where(has_sd, WORD + k0 * _PAIR_WORDS * WORD, 0))
+    for j in range(P):
+        pv = pvalid[j]
+        add_const("us", pv, 1)
+        add_seg(name_s[j], name_l[j])
+        add_seg(zero + (cbase + off["z16"]),
+                jnp.where(pv, key_w[j] * WORD - (name_l[j] + 1), 0))
+        add_seg(val_s[j], val_l[j])
+        add_seg(zero + (cbase + off["z16"]),
+                jnp.where(pv, valw[j] * WORD - val_l[j], 0))
+    add_const("blob")
+    if not elide:
+        add_const("tail", ln=len(suffix))
+
+    hdr_seg_len = 72 if elide else _HDR_BYTES
+    out_len = zero + hdr_seg_len
+    for lv in out_parts:
+        out_len = out_len + lv
+    tier = tier & (out_len <= OW)
+    if not assemble:
+        return {"tier": tier,
+                "fac8": dec["facility"].astype(_U8),
+                "sev8": dec["severity"].astype(_U8)}
+
+    # ---- byte planes: root pointers, header, pairs scratch ----
+    ptr_planes = []
+    for slot, ((_, l, g), w0) in enumerate(zip(texts, w_at)):
+        ptr_planes.append(_lptr(zero + (_PW0 + slot), w0, l + 1, 2, g))
+    ptr_planes.append(_lptr(zero + (_PW0 + 6), w_sid, sid_l + 1, 2,
+                            has_sd))
+    ptr_planes.append(_lptr(zero + (_PW0 + 7), w_pairs,
+                            k0 * _PAIR_WORDS, 7, has_sd))
+    if blob_w:
+        ptr_planes.append(_lptr(zero + (_PW0 + 8), w_extra,
+                                jnp.full((N,), len(extras) * _PAIR_WORDS,
+                                         dtype=_I32), 7, None))
+    else:
+        ptr_planes.append(jnp.zeros((N, 8), dtype=_U8))
+
+    tsb = ts_text.astype(_U8)
+    if tsb.shape[1] < 8:
+        tsb = jnp.pad(tsb, ((0, 0), (0, 8 - tsb.shape[1])))
+    root8 = jnp.broadcast_to(
+        jnp.asarray(np.frombuffer(int(_ROOT_PTR).to_bytes(8, "little"),
+                                  dtype=np.uint8)), (N, 8))
+    hdr = jnp.concatenate(
+        [jnp.zeros((N, 4), dtype=_U8),
+         _le8(nwords, zero)[:, :4],
+         root8,
+         tsb[:, :8],
+         dec["facility"].astype(_U8)[:, None],
+         dec["severity"].astype(_U8)[:, None],
+         jnp.zeros((N, 6), dtype=_U8)] + ptr_planes, axis=1)
+
+    tag = _le8(jnp.where(has_sd, k0 << 2, 0),
+               jnp.where(has_sd,
+                         zero + (PAIR_DATA_WORDS | (PAIR_PTR_WORDS << 16)),
+                         0))
+    pblocks = [tag]
+    cursor = w_pairs + 1 + k0 * _PAIR_WORDS
+    for j in range(P):
+        kw0 = cursor
+        cursor = cursor + key_w[j]
+        kw1 = cursor
+        cursor = cursor + valw[j]
+        base = w_pairs + 1 + j * _PAIR_WORDS
+        pblocks.append(jnp.zeros((N, PAIR_DATA_WORDS * WORD), dtype=_U8))
+        pblocks.append(_lptr(base + PAIR_DATA_WORDS, kw0,
+                             name_l[j] + 2, 2, pvalid[j]))
+        pblocks.append(_lptr(base + PAIR_DATA_WORDS + 1, kw1,
+                             val_l[j] + 1, 2, pvalid[j]))
+    plane = jnp.concatenate([hdr] + pblocks, axis=1)
+
+    segs[0] = ((zero + (tbase + 32), zero + 72) if elide
+               else (zero + tbase, zero + _HDR_BYTES))
+    acc, out_len2 = assemble_rows(segs, batch.astype(_U8), bank, plane,
+                                  N, OW)
+    return acc, out_len2, tier
+
+
+def _small_fetch(out, fetch):
+    small = {k: fetch(out[k])
+             for k in ("ok", "days", "sod", "off", "nanos")}
+    small["fac8"] = fetch(out["fac8"])
+    small["sev8"] = fetch(out["sev8"])
+    return small
+
+
+def route_ok(encoder, merger) -> bool:
+    """Device encode applies to capnp output over line/nul/syslen
+    framing (capnp_extra always renders to one static blob)."""
+    from ..encoders.capnp import CapnpEncoder
+
+    return encode_route_ok(encoder, merger, CapnpEncoder)
+
+
+# same ladder constants as the →GELF split tier
+FALLBACK_FRAC = 0.05
+DECLINE_LIMIT = 3
+COOLDOWN = 16
+
+
+def fetch_encode(handle, packed, encoder, merger, route_state=None):
+    """rfc5424→capnp split-tier entry; returns
+    (BlockResult | None, fetch_seconds)."""
+    from .block_common import merger_suffix
+    from .materialize import _scalar_line
+
+    out, _, _, _max_sd, _impl_unused, batch_dev, lens_dev = handle
+    suffix, syslen = merger_suffix(merger)
+    extras = tuple((str(k), str(v)) for k, v in
+                   getattr(encoder, "extra", []))
+
+    def kernel(ts_text, ts_len, assemble):
+        return _encode_kernel(batch_dev, lens_dev, dict(out), ts_text,
+                              ts_len, suffix=suffix, extras=extras,
+                              assemble=assemble, elide=True)
+
+    from .aot import encode_wrap
+    from .rfc5424 import best_scan_impl
+
+    kernel = encode_wrap("device_capnp", kernel, batch_dev, lens_dev,
+                         dict(out), suffix, best_scan_impl(), extras)
+
+    return fetch_encode_driver(
+        kernel, out, batch_dev, lens_dev, packed, encoder, merger,
+        route_state, suffix, syslen, scalar_fn=_scalar_line,
+        fallback_frac=FALLBACK_FRAC, decline_limit=DECLINE_LIMIT,
+        cooldown=COOLDOWN, ts_render=_render_le_f64,
+        small_fetch_fn=_small_fetch, elide=make_elide(suffix),
+        route_label="rfc5424_capnp", fused_counters=False)

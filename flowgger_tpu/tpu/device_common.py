@@ -240,7 +240,7 @@ _cache_listener_installed = False
 # upgrade.  Bump this whenever a kernel signature, segment layout, or
 # channel contract changes; old revisions keep their own subdirectory
 # and die with ordinary cache cleanup.
-KERNEL_ABI = 7
+KERNEL_ABI = 8
 
 
 def _install_cache_listener() -> None:
@@ -603,6 +603,47 @@ def _compact_kernel(acc, out_len, tier, *, G: int = COMPACT_G):
     return x.reshape(-1)
 
 
+def splice_rows(body: np.ndarray, row_off: np.ndarray,
+                ins_src: np.ndarray, ins_at: np.ndarray,
+                ins_a: np.ndarray, ins_l: np.ndarray):
+    """Generic per-row insertion splice for constant/computed elision.
+
+    Every row gets K insertions: insertion k of row r takes
+    ``ins_l[r, k]`` bytes from ``ins_src`` at offset ``ins_a[r, k]`` and
+    lands at body-relative offset ``ins_at[r, k]`` (offsets ascending
+    per row, measured in the elided body's coordinates).  One segment
+    gather (2K+1 segments/row, native concat when available) rebuilds
+    the full rows.  ``splice_elided_rows`` is the fixed
+    head/ts-label/tail specialization; the →RFC5424/→LTSV/→capnp routes
+    use this one because their elided constants sit at row-dependent
+    offsets (mid-row gaps, per-row PRI digits, computed capnp headers).
+    Returns (full body, full row_off)."""
+    from .assemble import concat_segments, exclusive_cumsum
+
+    R = row_off.size - 1
+    K = ins_at.shape[1]
+    lens = np.diff(row_off).astype(np.int64)
+    B = int(np.asarray(body).size)
+    src = np.concatenate([np.asarray(body, dtype=np.uint8),
+                          np.asarray(ins_src, dtype=np.uint8)])
+    seg_src = np.empty((R, 2 * K + 1), dtype=np.int64)
+    seg_len = np.empty((R, 2 * K + 1), dtype=np.int64)
+    r0 = row_off[:-1].astype(np.int64)
+    prev = np.zeros(R, dtype=np.int64)
+    for k in range(K):
+        at = np.minimum(np.asarray(ins_at[:, k], dtype=np.int64), lens)
+        seg_src[:, 2 * k] = r0 + prev
+        seg_len[:, 2 * k] = np.maximum(at - prev, 0)
+        seg_src[:, 2 * k + 1] = B + np.asarray(ins_a[:, k], dtype=np.int64)
+        seg_len[:, 2 * k + 1] = np.asarray(ins_l[:, k], dtype=np.int64)
+        prev = np.maximum(at, prev)
+    seg_src[:, 2 * K] = r0 + prev
+    seg_len[:, 2 * K] = lens - prev
+    out = concat_segments(src, seg_src.ravel(), seg_len.ravel())
+    new_lens = lens + np.asarray(ins_l, dtype=np.int64).sum(axis=1)
+    return out, exclusive_cumsum(new_lens)
+
+
 def splice_elided_rows(body: np.ndarray, row_off: np.ndarray,
                        ts_lens: np.ndarray, head: bytes, ts_label: bytes,
                        tail: bytes):
@@ -642,7 +683,8 @@ def splice_elided_rows(body: np.ndarray, row_off: np.ndarray,
     return out, exclusive_cumsum(lens + h + lb + tl)
 
 
-def ts_text_block(small: Dict[str, np.ndarray], ts_vals_fn=None):
+def ts_text_block(small: Dict[str, np.ndarray], ts_vals_fn=None,
+                  render=None):
     """Format per-row timestamp digits host-side.  The native threaded
     formatter (fg_format_f64_json: to_chars shortest round-trip,
     json_f64 notation — differentially fuzzed in
@@ -652,7 +694,13 @@ def ts_text_block(small: Dict[str, np.ndarray], ts_vals_fn=None):
 
     ``ts_vals_fn(small, ok_mask) -> float64 array`` overrides the
     default days/sod/off/nanos combine for formats whose device tier
-    carries other timestamp channels (ltsv float spans)."""
+    carries other timestamp channels (ltsv float spans).
+
+    ``render(val) -> bytes`` overrides the json_f64 notation for
+    output formats whose timestamp text is not serde_json's — the
+    →RFC5424 routes' rfc3339-ms form, the →LTSV routes' Rust Display
+    form, the →capnp route's raw little-endian f64 words — via the
+    dedup path (those routes' stamps are either repetitive or cheap)."""
     from .. import native
     from ..utils.rustfmt import json_f64
 
@@ -663,14 +711,18 @@ def ts_text_block(small: Dict[str, np.ndarray], ts_vals_fn=None):
         masked = {k: np.where(okh, small[k], 0)
                   for k in ("days", "sod", "off", "nanos")}
         ts_vals = compute_ts(masked)
-    res = native.format_f64_json_native(ts_vals, TS_W)
-    if res is not None:
-        return res
+    if render is None:
+        res = native.format_f64_json_native(ts_vals, TS_W)
+        if res is not None:
+            return res
+
+        def render(val):
+            return json_f64(float(val)).encode("ascii")
     uniq, inv = np.unique(ts_vals, return_inverse=True)
     txt = np.zeros((uniq.size, TS_W), dtype=np.uint8)
     ulen = np.zeros(uniq.size, dtype=np.int32)
     for u, val in enumerate(uniq):
-        s = json_f64(float(val)).encode("ascii")[:TS_W]
+        s = render(float(val))[:TS_W]
         txt[u, :len(s)] = np.frombuffer(s, dtype=np.uint8)
         ulen[u] = len(s)
     return txt[inv], ulen[inv]
@@ -802,14 +854,34 @@ def gelf_route_ok(encoder, merger, extras_placeable) -> bool:
                                               SyslenMerger)
 
 
+def encode_route_ok(encoder, merger, enc_cls) -> bool:
+    """Applicability predicate shared by the non-GELF device encode
+    routes (→RFC5424 / →LTSV / →capnp): exact encoder type over
+    line/nul/syslen framing, honoring the same kill switch as the GELF
+    legs.  Their extras are always statically placeable (LTSV/capnp
+    extras render to one constant blob, RFC5424 has none), so unlike
+    ``gelf_route_ok`` there is no placement check."""
+    import os
+
+    from ..mergers import LineMerger, NulMerger, SyslenMerger
+
+    if os.environ.get("FLOWGGER_DEVICE_ENCODE", "1") == "0":
+        return False
+    if type(encoder) is not enc_cls:
+        return False
+    return merger is None or type(merger) in (LineMerger, NulMerger,
+                                              SyslenMerger)
+
+
 def fetch_encode_driver(kernel, out, batch_dev, lens_dev, packed, encoder,
                         merger, route_state, suffix: bytes, syslen: bool,
                         scalar_fn, fallback_frac: float,
                         decline_limit: int, cooldown: int,
                         ts_keys=("days", "sod", "off", "nanos"),
-                        ts_vals_fn=None, wide=None, elide=None,
-                        kname_prefix=None, compile_timeout_s=None,
-                        route_label=None, small_fetch_fn=None):
+                        ts_vals_fn=None, ts_render=None, wide=None,
+                        elide=None, kname_prefix=None,
+                        compile_timeout_s=None, route_label=None,
+                        small_fetch_fn=None, fused_counters=True):
     """Shared fetch flow for every device-encode format:
 
     1. phase-1 tier probe (``kernel(..., assemble=False)`` — XLA
@@ -832,10 +904,11 @@ def fetch_encode_driver(kernel, out, batch_dev, lens_dev, packed, encoder,
     fused-route closures all live in one module — without it two routes
     at the same shape would share a slot and mask each other's pending
     compiles); ``compile_timeout_s`` overrides the watchdog deadline for
-    every guarded call in this flow; ``route_label`` (fused routes)
-    exports per-route ``fetch_bytes_per_row_{label}`` /
-    ``emit_bytes_per_row_{label}`` gauges and the ``fused_rows``
-    counters.
+    every guarded call in this flow; ``route_label`` exports per-route
+    ``fetch_bytes_per_row_{label}`` / ``emit_bytes_per_row_{label}``
+    gauges, plus the ``fused_rows`` counters unless
+    ``fused_counters=False`` (split-tier callers share a logical
+    route's gauges without claiming its rows as fused).
 
     Returns (BlockResult | None, fetch_seconds); None = caller should
     use the span-fetch host path."""
@@ -973,7 +1046,7 @@ def fetch_encode_driver(kernel, out, batch_dev, lens_dev, packed, encoder,
     cand1_full = np.zeros(small["ok"].shape[0], dtype=bool)
     cand1_full[:n] = cand1
     small["ok"] = small["ok"].astype(bool) & cand1_full
-    ts_text, ts_len = ts_text_block(small, ts_vals_fn)
+    ts_text, ts_len = ts_text_block(small, ts_vals_fn, render=ts_render)
     # wide kernels get their own watchdog slot: the narrow assemble
     # being warm says nothing about the (bigger) wide compile
     asm_slot = f"{kname}:assemble-wide" if wide_adopted else \
@@ -1061,9 +1134,18 @@ def fetch_encode_driver(kernel, out, batch_dev, lens_dev, packed, encoder,
 
     if elide is not None and ridx.size:
         # restore the head / timestamp-label / tail constants the kernel
-        # left out of the transfer (byte-identical by construction)
-        body, row_off = splice_elided_rows(
-            body, row_off, np.asarray(ts_len, dtype=np.int64)[ridx], *elide)
+        # left out of the transfer (byte-identical by construction); a
+        # callable elide owns the whole splice — the →RFC5424/→LTSV/
+        # →capnp routes' elided segments carry row-dependent bytes (PRI
+        # digits, computed capnp headers) or sit at mid-row offsets
+        if callable(elide):
+            body, row_off = elide(
+                body, row_off, small, np.asarray(ts_text),
+                np.asarray(ts_len, dtype=np.int64), ridx)
+        else:
+            body, row_off = splice_elided_rows(
+                body, row_off, np.asarray(ts_len, dtype=np.int64)[ridx],
+                *elide)
 
     prefix_lens_tier = None
     if syslen and ridx.size:
@@ -1077,8 +1159,9 @@ def fetch_encode_driver(kernel, out, batch_dev, lens_dev, packed, encoder,
     _metrics.inc("device_encode_fetch_bytes", fetched[0])
     _metrics.inc("device_encode_out_bytes", len(final_buf))
     if route_label is not None:
-        _metrics.inc("fused_rows", int(ridx.size))
-        _metrics.inc(f"fused_rows_{route_label}", int(ridx.size))
+        if fused_counters:
+            _metrics.inc("fused_rows", int(ridx.size))
+            _metrics.inc(f"fused_rows_{route_label}", int(ridx.size))
         if ridx.size:
             # ONE denominator for both gauges (tier rows): dividing
             # fetch by all n rows diluted it whenever fallback rows

@@ -1,0 +1,306 @@
+"""Device-side rfc5424→LTSV encode (ltsv_encoder.rs:18-74 semantics,
+mirroring encode_ltsv_block.py's ``_ltsv_core`` segment plan
+byte-for-byte).
+
+Same no-escape-stage shape as the →RFC5424 kernels: the tier demands
+rows whose emitted spans need no LTSV value escaping (no tab/newline
+anywhere in the row, no ':' inside SD names, no JSON-escaped SD
+values), so every segment re-emits verbatim from the raw batch and the
+static table is pairs-first (name ':' value '\\t' per slot) followed by
+the fixed label columns, exactly like the host tier.
+
+Elision drops three row-positioned constants from the device body —
+``\\ttime:<stamp>`` (the stamp is rendered host-side anyway),
+``\\tfull_message:``, and the framing suffix — and exports two 2-byte
+``gap0``/``gap1`` probe channels so the host splice knows where the
+variable-width pair stream ends.  ~33 elided bytes/row against ~4
+fetched probe bytes.
+"""
+
+
+from __future__ import annotations
+
+# byte-identity contract (flowcheck FC03): the scalar counterpart
+# this route must stay byte-identical to, and the differential
+# test that enforces it
+SCALAR_ORACLE = "flowgger_tpu.encoders.ltsv:LTSVEncoder"
+DIFF_TEST = (
+    "tests/test_device_encode_out.py::test_device_ltsv_out_matches_scalar",
+)
+
+from functools import partial
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .device_common import (
+    TS_W,
+    _out_width,
+    assemble_rows,
+    build_bank,
+    encode_route_ok,
+    fetch_encode_driver,
+)
+
+_I32 = jnp.int32
+_U8 = jnp.uint8
+
+_PARTS = {
+    "col": b":",
+    "tab": b"\t",
+    "host": b"host:",
+    "time": b"\ttime:",
+    "msgl": b"\tmessage:",
+    "full": b"\tfull_message:",
+    "lvl": b"\tlevel:",
+    "fac": b"\tfacility:",
+    "app": b"\tappname:",
+    "proc": b"\tprocid:",
+    "msgid": b"\tmsgid:",
+    "dec": b"0123456789 ",
+    "extra": b"",  # replaced per-config by _bank
+    "tail": b"",
+}
+
+
+def _bank(suffix: bytes, extras: Tuple[Tuple[str, str], ...] = ()
+          ) -> Tuple[bytes, Dict[str, int], Dict[str, bytes]]:
+    """Constant bank; ``ltsv_extra`` pairs render to the same single
+    pre-escaped blob the host tier emits (ltsv_extra_blob), so the two
+    tiers can never disagree on extras bytes."""
+    from .block_common import ltsv_extra_blob
+
+    parts = dict(_PARTS)
+    parts["extra"] = ltsv_extra_blob(list(extras))
+    bank, offs = build_bank(parts, suffix)
+    return bank, offs, parts
+
+
+def _render_display(val: float) -> bytes:
+    """Stamp text: Rust ``Display``-compatible shortest float form —
+    the same display_f64 the host tier's ts_scratch uses."""
+    from ..utils.rustfmt import display_f64
+
+    return display_f64(val).encode("ascii")
+
+
+def elide_spec(suffix: bytes, extras=()):
+    return make_elide(suffix)
+
+
+def make_elide(suffix: bytes):
+    """Callable elide: restore ``\\ttime:<stamp>`` at gap0,
+    ``\\tfull_message:`` at gap1, and the framing suffix at the row
+    end, from the kernel's 2-byte gap probe channels."""
+    TIME = b"\ttime:"
+    FULL = b"\tfull_message:"
+
+    def splice(body, row_off, small, ts_text, ts_len, ridx):
+        from .device_common import splice_rows
+
+        R = ridx.size
+        W = ts_text.shape[1] if ts_text.ndim == 2 else 0
+        stride = len(TIME) + W
+        buf = np.zeros((R, stride), dtype=np.uint8)
+        buf[:, :len(TIME)] = np.frombuffer(TIME, dtype=np.uint8)
+        if W:
+            buf[:, len(TIME):] = np.asarray(ts_text, np.uint8)[ridx]
+        ins_src = np.concatenate(
+            [buf.ravel(), np.frombuffer(FULL + suffix, dtype=np.uint8)])
+        gap0 = small["gap0"][ridx].astype(np.int64)
+        gap1 = small["gap1"][ridx].astype(np.int64)
+        lens = np.diff(row_off).astype(np.int64)
+        ins_at = np.stack([gap0, gap1, lens], axis=1)
+        ins_a = np.stack([
+            np.arange(R, dtype=np.int64) * stride,
+            np.full(R, R * stride, dtype=np.int64),
+            np.full(R, R * stride + len(FULL), dtype=np.int64),
+        ], axis=1)
+        ins_l = np.stack([
+            len(TIME) + np.asarray(ts_len, dtype=np.int64)[ridx],
+            np.full(R, len(FULL), dtype=np.int64),
+            np.full(R, len(suffix), dtype=np.int64),
+        ], axis=1)
+        return splice_rows(body, row_off, ins_src, ins_at, ins_a, ins_l)
+
+    return splice
+
+
+@partial(jax.jit, static_argnames=("suffix", "extras", "assemble",
+                                   "elide"))
+def _encode_kernel(batch, lens, dec, ts_text, ts_len, *, suffix: bytes,
+                   extras: Tuple[Tuple[str, str], ...] = (),
+                   assemble: bool = True, elide: bool = False):
+    """rfc5424→LTSV: _ltsv_core's plan (pairs first, then the fixed
+    label columns) as a static device segment table."""
+    N, L = batch.shape
+    bank, off, parts = _bank(suffix, extras)
+    OW = _out_width(L, L + len(bank) + TS_W)
+    zero = jnp.zeros((N,), dtype=_I32)
+    cbase = L
+    tbase = L + len(bank)
+    segs = []
+
+    def add_const(name, gate=None):
+        ln = zero + len(parts[name]) + (len(suffix) if name == "tail"
+                                        else 0)
+        if gate is not None:
+            ln = jnp.where(gate, ln, 0)
+        segs.append((zero + (cbase + off[name]), ln))
+
+    def add_span(s, e, gate=None):
+        ln = jnp.maximum(e - s, 0)
+        if gate is not None:
+            ln = jnp.where(gate, ln, 0)
+        segs.append((s, ln))
+
+    fac = dec["facility"].astype(_I32)
+    sev = dec["severity"].astype(_I32)
+    host_s, host_e = dec["host_start"].astype(_I32), dec["host_end"].astype(_I32)
+    app_s, app_e = dec["app_start"].astype(_I32), dec["app_end"].astype(_I32)
+    proc_s, proc_e = dec["proc_start"].astype(_I32), dec["proc_end"].astype(_I32)
+    msgid_s, msgid_e = (dec["msgid_start"].astype(_I32),
+                        dec["msgid_end"].astype(_I32))
+    full_s = dec["full_start"].astype(_I32)
+    msg_s = dec["msg_trim_start"].astype(_I32)
+    trim_e = dec["trim_end"].astype(_I32)
+    msg_l = jnp.maximum(trim_e - msg_s, 0)
+    has_msg = msg_l > 0
+    pc = dec["pair_count"].astype(_I32)
+    P = dec["name_start"].shape[1]
+
+    # pairs first: name ':' value '\t' per occupied slot
+    pairs_total = zero
+    for j in range(P):
+        pv = j < pc
+        ns = dec["name_start"][:, j].astype(_I32)
+        ne = dec["name_end"][:, j].astype(_I32)
+        vs = dec["val_start"][:, j].astype(_I32)
+        ve = dec["val_end"][:, j].astype(_I32)
+        add_span(ns, ne, pv)
+        add_const("col", pv)
+        add_span(vs, ve, pv)
+        add_const("tab", pv)
+        pairs_total = pairs_total + jnp.where(
+            pv, jnp.maximum(ne - ns, 0) + jnp.maximum(ve - vs, 0) + 2, 0)
+
+    add_const("extra")
+    add_const("host")
+    add_span(host_s, host_e)
+    if not elide:
+        # constant-elision skips "\ttime:<stamp>" here (spliced back
+        # host-side at gap0), "\tfull_message:" at gap1, and the tail
+        add_const("time")
+        segs.append((zero + tbase, ts_len.astype(_I32)))
+    add_const("msgl", has_msg)
+    add_span(msg_s, trim_e)
+    if not elide:
+        add_const("full")
+    add_span(full_s, trim_e)
+    add_const("lvl")
+    segs.append((cbase + off["dec"] + sev, zero + 1))
+    add_const("fac")
+    segs.append((cbase + off["dec"] + (fac // 10) % 10,
+                 jnp.where(fac >= 10, 1, 0)))
+    segs.append((cbase + off["dec"] + fac % 10, zero + 1))
+    add_const("app")
+    add_span(app_s, app_e)
+    add_const("proc")
+    add_span(proc_s, proc_e)
+    add_const("msgid")
+    add_span(msgid_s, msgid_e)
+    if not elide:
+        add_const("tail")
+
+    out_len = segs[0][1]
+    for _, ln in segs[1:]:
+        out_len = out_len + ln
+
+    # tier screens mirror the host cand: no tab/newline anywhere in the
+    # row (LTSV value escape), no ':' inside SD names (key escape), no
+    # JSON-escaped SD values
+    iota = jax.lax.broadcasted_iota(_I32, (N, L), 1)
+    valid = iota < lens.astype(_I32)[:, None]
+    row_esc = (((batch == 9) | (batch == 10)) & valid).any(axis=1)
+    name_mask = jnp.zeros((N, L), dtype=bool)
+    val_esc_any = jnp.zeros((N,), dtype=bool)
+    for j in range(P):
+        pv = j < pc
+        ns = dec["name_start"][:, j].astype(_I32)
+        ne = dec["name_end"][:, j].astype(_I32)
+        name_mask |= ((iota >= ns[:, None]) & (iota < ne[:, None])
+                      & pv[:, None])
+        val_esc_any |= dec["val_has_esc"][:, j].astype(bool) & pv
+    colon_in_names = ((batch == ord(":")) & name_mask).any(axis=1)
+
+    tier = (dec["ok"].astype(bool)
+            & ~dec["has_high"].astype(bool)
+            & ~row_esc
+            & ~colon_in_names
+            & ~val_esc_any
+            & (out_len <= OW))
+    if not assemble:
+        gap0 = (pairs_total + len(parts["extra"]) + len(b"host:")
+                + jnp.maximum(host_e - host_s, 0))
+        gap1 = gap0 + jnp.where(has_msg, len(b"\tmessage:"), 0) + msg_l
+        gdt = jnp.uint16 if OW <= 0xFFFF else _I32
+        return {"tier": tier,
+                "gap0": gap0.astype(gdt), "gap1": gap1.astype(gdt)}
+    acc, out_len2 = assemble_rows(segs, batch.astype(_U8), bank, ts_text,
+                                  N, OW)
+    return acc, out_len2, tier
+
+
+def _small_fetch(out, fetch):
+    small = {k: fetch(out[k])
+             for k in ("ok", "days", "sod", "off", "nanos")}
+    small["gap0"] = fetch(out["gap0"])
+    small["gap1"] = fetch(out["gap1"])
+    return small
+
+
+def route_ok(encoder, merger) -> bool:
+    """Device encode applies to LTSV output over line/nul/syslen
+    framing (ltsv_extra always renders to one static blob)."""
+    from ..encoders.ltsv import LTSVEncoder
+
+    return encode_route_ok(encoder, merger, LTSVEncoder)
+
+
+# same ladder constants as the →GELF split tier
+FALLBACK_FRAC = 0.05
+DECLINE_LIMIT = 3
+COOLDOWN = 16
+
+
+def fetch_encode(handle, packed, encoder, merger, route_state=None):
+    """rfc5424→LTSV split-tier entry; returns
+    (BlockResult | None, fetch_seconds)."""
+    from .block_common import merger_suffix
+    from .materialize import _scalar_line
+
+    out, _, _, _max_sd, _impl_unused, batch_dev, lens_dev = handle
+    suffix, syslen = merger_suffix(merger)
+    extras = tuple((str(k), str(v)) for k, v in
+                   getattr(encoder, "extra", []))
+
+    def kernel(ts_text, ts_len, assemble):
+        return _encode_kernel(batch_dev, lens_dev, dict(out), ts_text,
+                              ts_len, suffix=suffix, extras=extras,
+                              assemble=assemble, elide=True)
+
+    from .aot import encode_wrap
+    from .rfc5424 import best_scan_impl
+
+    kernel = encode_wrap("device_ltsv_out", kernel, batch_dev, lens_dev,
+                         dict(out), suffix, best_scan_impl(), extras)
+
+    return fetch_encode_driver(
+        kernel, out, batch_dev, lens_dev, packed, encoder, merger,
+        route_state, suffix, syslen, scalar_fn=_scalar_line,
+        fallback_frac=FALLBACK_FRAC, decline_limit=DECLINE_LIMIT,
+        cooldown=COOLDOWN, ts_render=_render_display,
+        small_fetch_fn=_small_fetch, elide=make_elide(suffix),
+        route_label="rfc5424_ltsv", fused_counters=False)

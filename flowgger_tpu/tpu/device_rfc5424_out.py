@@ -1,0 +1,493 @@
+"""Device-side →RFC5424 encode: the shared SD-assembly core for the
+rfc5424→rfc5424 re-encode and the rfc3164→rfc5424 relay upgrade
+(rfc5424_encoder.rs:28-93 semantics, mirroring encode_rfc5424_block.py
+byte-for-byte).
+
+The output tier re-emits decoded spans verbatim from the raw batch
+(record.rs:55-62 — RFC5424 output never escapes), so unlike the →GELF
+kernels there is no escape stage at all: the source row for
+device_common.assemble_rows is ``raw line ∥ constant bank ∥ timestamp
+text`` and every segment is either a raw span, a constant, or a
+magnitude-gated PRI digit.  Multi-block structured data nests pairs
+inside their block's brackets via the decoder's ``pair_sd``
+attribution, exactly like the host block route.
+
+Constant elision goes further than the →GELF routes' fixed
+(head, ts-label, tail) triple: the elided head here carries *row-
+dependent* bytes — ``<PRI>1 `` digits and the rfc3339-ms stamp — so the
+kernel exports two one-byte probe channels (``fac8``/``sev8``, plus
+``pri1``/``hostl16`` on the 3164 leg) and a callable elide
+(device_common.splice_rows) rebuilds the exact host-tier head from
+them.  Net D2H stays under the elided bytes: ~27 fetched/row against a
+33+-byte head+tail.
+
+Rows outside the tier (kernel-flagged, non-ASCII, >6 pairs, escaped SD
+values, oversized output) keep their existing host paths, so observable
+bytes stay identical to the scalar route in every case.
+"""
+
+
+from __future__ import annotations
+
+# byte-identity contract (flowcheck FC03): the scalar counterpart
+# this route must stay byte-identical to, and the differential
+# test that enforces it
+SCALAR_ORACLE = "flowgger_tpu.encoders.rfc5424:RFC5424Encoder"
+DIFF_TEST = (
+    "tests/test_device_encode_out.py::test_device_rfc5424_out_matches_scalar",
+    "tests/test_device_encode_out.py::test_device_rfc3164_rfc5424_matches_scalar",
+)
+
+from functools import partial
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .device_common import (
+    TS_W,
+    _out_width,
+    assemble_rows,
+    encode_route_ok,
+    fetch_encode_driver,
+)
+
+_I32 = jnp.int32
+_U8 = jnp.uint8
+
+# constant bank: the same byte constants the host tier uses
+# (encode_rfc5424_block.py builds them per batch via build_source; the
+# two tiers must never diverge, since fallback rows splice host-tier
+# output into device-tier blocks)
+_PARTS = {
+    "lt": b"<",
+    "gt1": b">1 ",
+    "dflt": b"<13>1 ",       # 3164 leg: PRI-less default head
+    "sp": b" ",
+    "eqq": b'="',
+    "q": b'"',
+    "lb": b"[",
+    "rb": b"]",
+    "dash": b"-",
+    "t3164": b" - - - ",     # 3164 leg: appname/procid/msgid/sd slots
+    "dec": b"0123456789",
+    "tail": b"",
+}
+
+
+def _bank(suffix: bytes) -> Tuple[bytes, Dict[str, int], Dict[str, bytes]]:
+    from .device_common import build_bank
+
+    parts = dict(_PARTS)
+    bank, offs = build_bank(parts, suffix)
+    return bank, offs, parts
+
+
+def _render_rfc3339(val: float) -> bytes:
+    """Timestamp text for the elided-head splice and the non-elided
+    kernel upload: the exact ms-truncated rfc3339 form the scalar
+    encoder and the host block tier emit."""
+    from ..utils.timeparse import unix_to_rfc3339_ms
+
+    return unix_to_rfc3339_ms(val).encode("ascii")
+
+
+def _head_rows(pri: np.ndarray, has_pri, ts_rows: np.ndarray,
+               ts_lens: np.ndarray):
+    """Host-side reconstruction of the elided ``<PRI>1 <ts> `` head
+    (``<13>1 <ts> `` where the 3164 line carried no PRI): returns
+    (flat bytes, per-row offsets, per-row lengths).  Mirrors host cols
+    0-6 of encode_rfc5424_block.py exactly — same decimal_segments
+    digit gating, same constants."""
+    from .assemble import (
+        build_source,
+        concat_segments,
+        decimal_segments,
+        exclusive_cumsum,
+    )
+
+    R = pri.shape[0]
+    consts, offs = build_source(b"<", b">1 ", b"<13>1 ", b" ",
+                                b"0123456789")
+    o_lt, o_gt1, o_dflt, o_sp, o_dec = offs
+    W = ts_rows.shape[1] if ts_rows.ndim == 2 else 0
+    src = np.concatenate([consts, np.asarray(ts_rows, np.uint8).ravel()])
+    tbase = len(consts)
+    dsrc, dlen = decimal_segments(pri, o_dec, width=3)
+    if has_pri is None:
+        has_pri = np.ones(R, dtype=bool)
+    else:
+        has_pri = np.asarray(has_pri, dtype=bool)
+    ndig = np.where(has_pri,
+                    1 + (pri >= 10).astype(np.int64)
+                    + (pri >= 100).astype(np.int64), 0)
+    seg_src = np.stack([
+        np.where(has_pri, o_lt, 0),
+        dsrc[0::3], dsrc[1::3], dsrc[2::3],
+        np.where(has_pri, o_gt1, o_dflt),
+        tbase + np.arange(R, dtype=np.int64) * W,
+        np.full(R, o_sp, dtype=np.int64),
+    ], axis=1)
+    seg_len = np.stack([
+        np.where(has_pri, 1, 0),
+        np.where(has_pri, dlen[0::3], 0),
+        np.where(has_pri, dlen[1::3], 0),
+        np.where(has_pri, dlen[2::3], 0),
+        np.where(has_pri, len(b">1 "), len(b"<13>1 ")),
+        np.asarray(ts_lens, dtype=np.int64),
+        np.ones(R, dtype=np.int64),
+    ], axis=1)
+    head = concat_segments(src, seg_src.ravel(), seg_len.ravel())
+    head_len = (np.where(has_pri, 1 + 3, 6) + ndig
+                + np.asarray(ts_lens, dtype=np.int64) + 1)
+    return head, exclusive_cumsum(head_len)[:-1], head_len
+
+
+def elide_spec(suffix: bytes, leg: str = "rfc5424"):
+    """Single-sourced elide for both legs (split tier and fused route
+    build their splice from here)."""
+    return make_elide(suffix) if leg == "rfc5424" else make_elide_3164(suffix)
+
+
+def make_elide(suffix: bytes):
+    """Callable elide for the rfc5424→rfc5424 leg: the kernel skips the
+    ``<PRI>1 <ts> `` head and the framing tail; this splice rebuilds
+    them from the one-byte ``fac8``/``sev8`` probe channels and the
+    rendered timestamp block (single source with the kernel's segment
+    plan — the two sides cannot disagree)."""
+
+    def splice(body, row_off, small, ts_text, ts_len, ridx):
+        from .device_common import splice_rows
+
+        R = ridx.size
+        fac = small["fac8"][ridx].astype(np.int64)
+        sev = small["sev8"][ridx].astype(np.int64)
+        head, head_off, head_len = _head_rows(
+            (fac << 3) + sev, None, ts_text[ridx], ts_len[ridx])
+        ins_src = np.concatenate(
+            [head, np.frombuffer(suffix, dtype=np.uint8)])
+        lens = np.diff(row_off).astype(np.int64)
+        ins_at = np.stack([np.zeros(R, dtype=np.int64), lens], axis=1)
+        ins_a = np.stack([head_off,
+                          np.full(R, head.size, dtype=np.int64)], axis=1)
+        ins_l = np.stack([head_len,
+                          np.full(R, len(suffix), dtype=np.int64)], axis=1)
+        return splice_rows(body, row_off, ins_src, ins_at, ins_a, ins_l)
+
+    return splice
+
+
+def make_elide_3164(suffix: bytes):
+    """Callable elide for the rfc3164→rfc5424 leg: head (PRI-gated
+    ``<PRI>1 `` or the ``<13>1 `` default, stamp, space), the
+    ``" - - - "`` slot constant at the per-row host boundary
+    (``hostl16`` probe channel), and the framing tail."""
+    T3164 = b" - - - "
+
+    def splice(body, row_off, small, ts_text, ts_len, ridx):
+        from .device_common import splice_rows
+
+        R = ridx.size
+        fac = small["fac8"][ridx].astype(np.int64)
+        sev = small["sev8"][ridx].astype(np.int64)
+        has_pri = small["pri1"][ridx].astype(bool)
+        hostl = small["hostl16"][ridx].astype(np.int64)
+        head, head_off, head_len = _head_rows(
+            (fac << 3) + sev, has_pri, ts_text[ridx], ts_len[ridx])
+        ins_src = np.concatenate(
+            [head, np.frombuffer(T3164 + suffix, dtype=np.uint8)])
+        lens = np.diff(row_off).astype(np.int64)
+        ins_at = np.stack(
+            [np.zeros(R, dtype=np.int64), hostl, lens], axis=1)
+        ins_a = np.stack([
+            head_off,
+            np.full(R, head.size, dtype=np.int64),
+            np.full(R, head.size + len(T3164), dtype=np.int64),
+        ], axis=1)
+        ins_l = np.stack([
+            head_len,
+            np.full(R, len(T3164), dtype=np.int64),
+            np.full(R, len(suffix), dtype=np.int64),
+        ], axis=1)
+        return splice_rows(body, row_off, ins_src, ins_at, ins_a, ins_l)
+
+    return splice
+
+
+@partial(jax.jit, static_argnames=("suffix", "max_sd", "assemble",
+                                   "elide"))
+def _encode_kernel(batch, lens, dec, ts_text, ts_len, *, suffix: bytes,
+                   max_sd: int, assemble: bool = True,
+                   elide: bool = False):
+    """rfc5424→RFC5424: encode_rfc5424_block.py's segment plan as a
+    static device segment table.  No escape stage — spans re-emit
+    verbatim."""
+    N, L = batch.shape
+    bank, off, parts = _bank(suffix)
+    OW = _out_width(L, L + len(bank) + TS_W)
+    zero = jnp.zeros((N,), dtype=_I32)
+    cbase = L
+    tbase = L + len(bank)
+    segs = []
+
+    def add_const(name, gate=None):
+        ln = zero + len(parts[name]) + (len(suffix) if name == "tail"
+                                        else 0)
+        if gate is not None:
+            ln = jnp.where(gate, ln, 0)
+        segs.append((zero + (cbase + off[name]), ln))
+
+    def add_span(s, e, gate=None):
+        ln = jnp.maximum(e - s, 0)
+        if gate is not None:
+            ln = jnp.where(gate, ln, 0)
+        segs.append((s, ln))
+
+    fac = dec["facility"].astype(_I32)
+    sev = dec["severity"].astype(_I32)
+    host_s, host_e = dec["host_start"].astype(_I32), dec["host_end"].astype(_I32)
+    app_s, app_e = dec["app_start"].astype(_I32), dec["app_end"].astype(_I32)
+    proc_s, proc_e = dec["proc_start"].astype(_I32), dec["proc_end"].astype(_I32)
+    msgid_s, msgid_e = (dec["msgid_start"].astype(_I32),
+                        dec["msgid_end"].astype(_I32))
+    msg_s = dec["msg_trim_start"].astype(_I32)
+    trim_e = dec["trim_end"].astype(_I32)
+    sdc = dec["sd_count"].astype(_I32)
+    nsd = sdc > 0
+    pc = dec["pair_count"].astype(_I32)
+    P = dec["name_start"].shape[1]
+
+    if not elide:
+        # constant-elision mode skips the whole '<PRI>1 <ts> ' head and
+        # the tail: the host splice (make_elide) restores them from the
+        # fac8/sev8 probe channels + the rendered ts block
+        pri = (fac << 3) + sev
+        add_const("lt")
+        d2, d1, d0 = (pri // 100) % 10, (pri // 10) % 10, pri % 10
+        segs.append((cbase + off["dec"] + d2,
+                     jnp.where(pri >= 100, 1, 0)))
+        segs.append((cbase + off["dec"] + d1,
+                     jnp.where(pri >= 10, 1, 0)))
+        segs.append((cbase + off["dec"] + d0, zero + 1))
+        add_const("gt1")
+        segs.append((zero + tbase, ts_len.astype(_I32)))
+        add_const("sp")
+
+    add_span(host_s, host_e)
+    add_const("sp")
+    add_span(app_s, app_e)
+    add_const("sp")
+    add_span(proc_s, proc_e)
+    add_const("sp")
+    add_span(msgid_s, msgid_e)
+    add_const("sp")
+
+    # SD region: '-' on SD-less rows, else per block '[' sid pairs ']'
+    # with pairs attributed to their block via pair_sd (same nesting as
+    # the host route's pb_rb/p_in offsets — here the static (k, j) loop
+    # order IS the host's ascending (block, pair-ordinal) order)
+    add_const("dash", ~nsd)
+    val_esc_any = jnp.zeros((N,), dtype=bool)
+    for j in range(P):
+        val_esc_any |= (dec["val_has_esc"][:, j].astype(bool)
+                        & (j < pc))
+    for k in range(max_sd):
+        kv = k < sdc
+        add_const("lb", kv)
+        add_span(dec["sid_start"][:, k].astype(_I32),
+                 dec["sid_end"][:, k].astype(_I32), kv)
+        for j in range(P):
+            pv = (j < pc) & (dec["pair_sd"][:, j].astype(_I32) == k) & kv
+            add_const("sp", pv)
+            add_span(dec["name_start"][:, j].astype(_I32),
+                     dec["name_end"][:, j].astype(_I32), pv)
+            add_const("eqq", pv)
+            add_span(dec["val_start"][:, j].astype(_I32),
+                     dec["val_end"][:, j].astype(_I32), pv)
+            add_const("q", pv)
+        add_const("rb", kv)
+
+    add_const("sp")
+    add_span(msg_s, trim_e)
+    if not elide:
+        add_const("tail")
+
+    out_len = segs[0][1]
+    for _, ln in segs[1:]:
+        out_len = out_len + ln
+
+    tier = (dec["ok"].astype(bool)
+            & ~dec["has_high"].astype(bool)
+            & (pc <= P)
+            & (sdc <= max_sd)
+            & ~val_esc_any
+            & (out_len <= OW))
+    if not assemble:
+        return {"tier": tier,
+                "fac8": fac.astype(_U8), "sev8": sev.astype(_U8)}
+    acc, out_len2 = assemble_rows(segs, batch.astype(_U8), bank, ts_text,
+                                  N, OW)
+    return acc, out_len2, tier
+
+
+@partial(jax.jit, static_argnames=("suffix", "assemble", "elide"))
+def _encode_kernel_3164(batch, lens, dec, ts_text, ts_len, *,
+                        suffix: bytes, assemble: bool = True,
+                        elide: bool = False):
+    """rfc3164→RFC5424 relay upgrade: encode_rfc5424_block.py's 11-col
+    plan (PRI-gated digits or the <13>1 default, re-formatted stamp,
+    host + message tail, constant " - - - " slots).  With elide, the
+    device body is just ``host ∥ msg`` — two segments."""
+    N, L = batch.shape
+    bank, off, parts = _bank(suffix)
+    OW = _out_width(L, L + len(bank) + TS_W)
+    zero = jnp.zeros((N,), dtype=_I32)
+    cbase = L
+    tbase = L + len(bank)
+    segs = []
+
+    fac = dec["facility"].astype(_I32)
+    sev = dec["severity"].astype(_I32)
+    has_pri = dec["has_pri"].astype(bool)
+    host_s = dec["host_start"].astype(_I32)
+    host_e = dec["host_end"].astype(_I32)
+    host_l = jnp.maximum(host_e - host_s, 0)
+    msg_s = dec["msg_start"].astype(_I32)
+    msg_l = jnp.maximum(lens.astype(_I32) - msg_s, 0)
+
+    if not elide:
+        pri = (fac << 3) + sev
+        segs.append((zero + (cbase + off["lt"]),
+                     jnp.where(has_pri, 1, 0)))
+        d2, d1, d0 = (pri // 100) % 10, (pri // 10) % 10, pri % 10
+        segs.append((cbase + off["dec"] + d2,
+                     jnp.where(has_pri & (pri >= 100), 1, 0)))
+        segs.append((cbase + off["dec"] + d1,
+                     jnp.where(has_pri & (pri >= 10), 1, 0)))
+        segs.append((cbase + off["dec"] + d0,
+                     jnp.where(has_pri, 1, 0)))
+        segs.append((jnp.where(has_pri, cbase + off["gt1"],
+                               cbase + off["dflt"]),
+                     jnp.where(has_pri, len(b">1 "), len(b"<13>1 "))))
+        segs.append((zero + tbase, ts_len.astype(_I32)))
+        segs.append((zero + (cbase + off["sp"]), zero + 1))
+
+    segs.append((host_s, host_l))
+    if not elide:
+        segs.append((zero + (cbase + off["t3164"]),
+                     zero + len(parts["t3164"])))
+    segs.append((msg_s, msg_l))
+    if not elide:
+        segs.append((zero + (cbase + off["tail"]),
+                     zero + len(suffix)))
+
+    out_len = segs[0][1]
+    for _, ln in segs[1:]:
+        out_len = out_len + ln
+
+    tier = (dec["ok"].astype(bool)
+            & ~dec["has_high"].astype(bool)
+            & (out_len <= OW))
+    if not assemble:
+        return {"tier": tier,
+                "fac8": fac.astype(_U8), "sev8": sev.astype(_U8),
+                "pri1": has_pri.astype(_U8),
+                "hostl16": host_l.astype(jnp.uint16)}
+    acc, out_len2 = assemble_rows(segs, batch.astype(_U8), bank, ts_text,
+                                  N, OW)
+    return acc, out_len2, tier
+
+
+def _small_fetch(keys):
+    """small_fetch_fn factory: ok + calendar channels + this route's
+    one/two-byte probe extras (the elided head is row-dependent, so the
+    splice needs them — narrowed on device so the fixed per-row D2H
+    stays under the elided-constant savings)."""
+
+    def fetch_small(out, fetch):
+        small = {k: fetch(out[k])
+                 for k in ("ok", "days", "sod", "off", "nanos")}
+        for k in keys:
+            small[k] = fetch(out[k])
+        return small
+
+    return fetch_small
+
+
+def route_ok(encoder, merger) -> bool:
+    """Device encode applies to RFC5424 output over line/nul/syslen
+    framing (RFC5424Encoder carries no extras config)."""
+    from ..encoders.rfc5424 import RFC5424Encoder
+
+    return encode_route_ok(encoder, merger, RFC5424Encoder)
+
+
+# same ladder constants as the →GELF split tier
+FALLBACK_FRAC = 0.05
+DECLINE_LIMIT = 3
+COOLDOWN = 16
+
+
+def fetch_encode(handle, packed, encoder, merger, route_state=None):
+    """rfc5424→RFC5424 split-tier entry; returns
+    (BlockResult | None, fetch_seconds).  None = caller should use the
+    host block path."""
+    from .block_common import merger_suffix
+    from .materialize import _scalar_line
+
+    out, _, _, max_sd, _impl_unused, batch_dev, lens_dev = handle
+    suffix, syslen = merger_suffix(merger)
+
+    def kernel(ts_text, ts_len, assemble):
+        return _encode_kernel(batch_dev, lens_dev, dict(out), ts_text,
+                              ts_len, suffix=suffix, max_sd=max_sd,
+                              assemble=assemble, elide=True)
+
+    from .aot import encode_wrap
+    from .rfc5424 import best_scan_impl
+
+    kernel = encode_wrap("device_rfc5424_out", kernel, batch_dev,
+                         lens_dev, dict(out), suffix, best_scan_impl(),
+                         (), max_sd=max_sd)
+
+    return fetch_encode_driver(
+        kernel, out, batch_dev, lens_dev, packed, encoder, merger,
+        route_state, suffix, syslen, scalar_fn=_scalar_line,
+        fallback_frac=FALLBACK_FRAC, decline_limit=DECLINE_LIMIT,
+        cooldown=COOLDOWN, ts_render=_render_rfc3339,
+        small_fetch_fn=_small_fetch(("fac8", "sev8")),
+        elide=make_elide(suffix), route_label="rfc5424_rfc5424",
+        fused_counters=False)
+
+
+def fetch_encode_3164(handle, packed, encoder, merger, route_state=None):
+    """rfc3164→RFC5424 split-tier entry (rfc3164 decode handle shape:
+    (out, batch_dev, lens_dev))."""
+    from .block_common import merger_suffix
+    from .materialize_rfc3164 import _scalar_3164
+
+    out, batch_dev, lens_dev = handle
+    suffix, syslen = merger_suffix(merger)
+
+    def kernel(ts_text, ts_len, assemble):
+        return _encode_kernel_3164(batch_dev, lens_dev, dict(out),
+                                   ts_text, ts_len, suffix=suffix,
+                                   assemble=assemble, elide=True)
+
+    from .aot import encode_wrap
+    from .rfc5424 import best_scan_impl
+
+    kernel = encode_wrap("device_rfc5424_out_3164", kernel, batch_dev,
+                         lens_dev, dict(out), suffix, best_scan_impl(),
+                         ())
+
+    return fetch_encode_driver(
+        kernel, out, batch_dev, lens_dev, packed, encoder, merger,
+        route_state, suffix, syslen, scalar_fn=_scalar_3164,
+        fallback_frac=FALLBACK_FRAC, decline_limit=DECLINE_LIMIT,
+        cooldown=COOLDOWN, ts_render=_render_rfc3339,
+        small_fetch_fn=_small_fetch(("fac8", "sev8", "pri1",
+                                     "hostl16")),
+        elide=make_elide_3164(suffix),
+        route_label="rfc3164_rfc5424", fused_counters=False)

@@ -46,13 +46,19 @@ the DIFF_TESTs below.
 
 from __future__ import annotations
 
-# byte-identity contract (flowcheck FC03): the scalar counterpart every
-# fused route must stay byte-identical to, and the differential tests
-# that enforce it across the route matrix (all four routes are →GELF)
-SCALAR_ORACLE = "flowgger_tpu.encoders.gelf:GelfEncoder"
+# byte-identity contract (flowcheck FC03): the scalar counterparts the
+# fused route matrix must stay byte-identical to (one oracle per output
+# format), and the differential tests that enforce it across the matrix
+SCALAR_ORACLE = (
+    "flowgger_tpu.encoders.gelf:GelfEncoder",
+    "flowgger_tpu.encoders.rfc5424:RFC5424Encoder",
+    "flowgger_tpu.encoders.ltsv:LTSVEncoder",
+    "flowgger_tpu.encoders.capnp:CapnpEncoder",
+)
 DIFF_TEST = (
     "tests/test_fused_routes.py::test_fused_matches_scalar_oracle_all_routes",
     "tests/test_fused_routes.py::test_fused_route_fuzz_vs_scalar",
+    "tests/test_device_encode_out.py::test_fused_new_output_routes_match_scalar",
 )
 
 import os
@@ -101,6 +107,35 @@ DEMAND = {
         "ok", "n_fields", "key_start", "key_end", "val_start",
         "val_end", "val_type", "key_esc", "val_esc",
     )),  # the canonicalizing re-encode touches every channel
+    "rfc5424_rfc5424": frozenset((
+        "ok", "has_high", "facility", "severity", *_TS4,
+        "host_start", "host_end", "app_start", "app_end",
+        "proc_start", "proc_end", "msgid_start", "msgid_end",
+        "msg_trim_start", "trim_end", "sd_count", "sid_start", "sid_end",
+        "pair_count", "pair_sd", "name_start", "name_end",
+        "val_start", "val_end", "val_has_esc",
+    )),  # drops: bom, full_start, msg_start
+    "rfc3164_rfc5424": frozenset((
+        "ok", "has_pri", "has_high", "facility", "severity", *_TS4,
+        "host_start", "host_end", "msg_start",
+    )),  # the relay upgrade reads every rfc3164 channel
+    "rfc5424_ltsv": frozenset((
+        "ok", "has_high", "facility", "severity", *_TS4,
+        "host_start", "host_end", "app_start", "app_end",
+        "proc_start", "proc_end", "msgid_start", "msgid_end",
+        "full_start", "msg_trim_start", "trim_end",
+        "pair_count", "name_start", "name_end",
+        "val_start", "val_end", "val_has_esc",
+    )),  # drops: bom, msg_start, sd_count, sid_start/end, pair_sd
+    "rfc5424_capnp": frozenset((
+        "ok", "has_high", "facility", "severity", *_TS4,
+        "host_start", "host_end", "app_start", "app_end",
+        "proc_start", "proc_end", "msgid_start", "msgid_end",
+        "full_start", "msg_trim_start", "trim_end",
+        "sd_count", "sid_start", "sid_end",
+        "pair_count", "pair_sd", "name_start", "name_end",
+        "val_start", "val_end", "val_has_esc",
+    )),  # drops: bom, msg_start
 }
 
 
@@ -237,6 +272,76 @@ def _fused_gelf_gelf(batch, lens, ts_text, ts_len, *, suffix: bytes,
     return res
 
 
+# The non-GELF output legs (PR 19): their probes all return dicts —
+# tier plus the one/two-byte channels their callable elides splice the
+# row-dependent heads from (fac8/sev8, gap offsets).
+
+@partial(jax.jit, static_argnames=("max_sd", "suffix", "assemble",
+                                   "demand"))
+def _fused_rfc5424_rfc5424(batch, lens, ts_text, ts_len, *, max_sd: int,
+                           suffix: bytes, assemble: bool, demand):
+    from .device_rfc5424_out import _encode_kernel
+    from .rfc5424 import decode_rfc5424_jit
+
+    dec = decode_rfc5424_jit(batch, lens, max_sd=max_sd,
+                             extract_impl="sum", demand=demand)
+    res = _encode_kernel(batch, lens, dec, ts_text, ts_len,
+                         suffix=suffix, max_sd=max_sd,
+                         assemble=assemble, elide=True)
+    if not assemble:
+        return {**res, **{k: dec[k] for k in ("ok",) + _TS4}}
+    return res
+
+
+@partial(jax.jit, static_argnames=("suffix", "assemble", "demand"))
+def _fused_rfc3164_rfc5424(batch, lens, year, ts_text, ts_len, *,
+                           suffix: bytes, assemble: bool, demand):
+    from .device_rfc5424_out import _encode_kernel_3164
+    from .rfc3164 import decode_rfc3164_jit
+
+    dec = decode_rfc3164_jit(batch, lens, year, demand=demand)
+    res = _encode_kernel_3164(batch, lens, dec, ts_text, ts_len,
+                              suffix=suffix, assemble=assemble,
+                              elide=True)
+    if not assemble:
+        return {**res, **{k: dec[k] for k in ("ok",) + _TS4}}
+    return res
+
+
+@partial(jax.jit, static_argnames=("max_sd", "suffix", "extras",
+                                   "assemble", "demand"))
+def _fused_rfc5424_ltsv(batch, lens, ts_text, ts_len, *, max_sd: int,
+                        suffix: bytes, extras, assemble: bool, demand):
+    from .device_ltsv_out import _encode_kernel
+    from .rfc5424 import decode_rfc5424_jit
+
+    dec = decode_rfc5424_jit(batch, lens, max_sd=max_sd,
+                             extract_impl="sum", demand=demand)
+    res = _encode_kernel(batch, lens, dec, ts_text, ts_len,
+                         suffix=suffix, extras=extras,
+                         assemble=assemble, elide=True)
+    if not assemble:
+        return {**res, **{k: dec[k] for k in ("ok",) + _TS4}}
+    return res
+
+
+@partial(jax.jit, static_argnames=("max_sd", "suffix", "extras",
+                                   "assemble", "demand"))
+def _fused_rfc5424_capnp(batch, lens, ts_text, ts_len, *, max_sd: int,
+                         suffix: bytes, extras, assemble: bool, demand):
+    from .device_capnp import _encode_kernel
+    from .rfc5424 import decode_rfc5424_jit
+
+    dec = decode_rfc5424_jit(batch, lens, max_sd=max_sd,
+                             extract_impl="sum", demand=demand)
+    res = _encode_kernel(batch, lens, dec, ts_text, ts_len,
+                         suffix=suffix, extras=extras,
+                         assemble=assemble, elide=True)
+    if not assemble:
+        return {**res, **{k: dec[k] for k in ("ok",) + _TS4}}
+    return res
+
+
 # ---------------------------------------------------------------------------
 
 
@@ -256,20 +361,34 @@ class FusedHandle:
 
 
 class FusedRoute:
-    """One (in-format → GELF) fused program plus its driver recipe."""
+    """One (in-format → out-format) fused program plus its driver
+    recipe."""
 
-    __slots__ = ("name", "fmt")
+    __slots__ = ("name", "fmt", "out")
 
-    def __init__(self, name: str, fmt: str):
+    def __init__(self, name: str, fmt: str, out: str = "gelf"):
         self.name = name
         self.fmt = fmt
+        self.out = out
 
     # -- applicability -----------------------------------------------------
     def route_ok(self, encoder, merger, decoder=None) -> bool:
-        """Reuses the split device tier's gate (GELF output, framing
-        allowlist, extras placement, FLOWGGER_DEVICE_ENCODE kill
-        switch, ltsv schema) — a route the split tier would refuse is
-        never fused either."""
+        """Reuses the split device tier's gate (output encoder type,
+        framing allowlist, extras placement, FLOWGGER_DEVICE_ENCODE
+        kill switch, ltsv schema) — a route the split tier would refuse
+        is never fused either."""
+        if self.out == "rfc5424":
+            from . import device_rfc5424_out
+
+            return device_rfc5424_out.route_ok(encoder, merger)
+        if self.out == "ltsv":
+            from . import device_ltsv_out
+
+            return device_ltsv_out.route_ok(encoder, merger)
+        if self.out == "capnp":
+            from . import device_capnp
+
+            return device_capnp.route_ok(encoder, merger)
         if self.fmt == "rfc3164":
             from . import device_rfc3164
 
@@ -305,6 +424,9 @@ class FusedRoute:
         b, ln = handle.batch_dev, handle.lens_dev
         kw = {"suffix": suffix, "syslen": syslen}
 
+        if self.out != "gelf":
+            return self._make_kernel_out(b, ln, suffix, impl, extras,
+                                         demand, kw, fused_wrap)
         if self.fmt == "rfc3164":
             from ..utils.timeparse import current_year_utc
             from .device_rfc3164 import elide_spec
@@ -371,20 +493,122 @@ class FusedRoute:
                   elide=elide_spec(suffix, extras))
         return kernel, kw
 
+    def _make_kernel_out(self, b, ln, suffix, impl, extras, demand, kw,
+                         fused_wrap):
+        """Driver recipes for the non-GELF output legs (PR 19): each
+        reuses its split module's single-sourced callable elide, stamp
+        renderer, and narrowed small fetch."""
+        from .materialize import _scalar_line
+        from .rfc5424 import DEFAULT_MAX_SD
+
+        if self.name == "rfc5424_rfc5424":
+            from . import device_rfc5424_out as m
+
+            def kernel(ts_text, ts_len, assemble):
+                return _fused_rfc5424_rfc5424(
+                    b, ln, ts_text, ts_len, max_sd=DEFAULT_MAX_SD,
+                    suffix=suffix, assemble=assemble, demand=demand)
+
+            kernel = fused_wrap(self.name, kernel, (b, ln), suffix,
+                               impl, extras)
+            kw.update(scalar_fn=_scalar_line,
+                      ts_render=m._render_rfc3339,
+                      small_fetch_fn=m._small_fetch(("fac8", "sev8")),
+                      elide=m.elide_spec(suffix))
+            return kernel, kw
+        if self.name == "rfc3164_rfc5424":
+            from ..utils.timeparse import current_year_utc
+            from . import device_rfc5424_out as m
+            from .materialize_rfc3164 import _scalar_3164
+
+            year = jnp.int32(current_year_utc())
+
+            def kernel(ts_text, ts_len, assemble):
+                return _fused_rfc3164_rfc5424(
+                    b, ln, year, ts_text, ts_len, suffix=suffix,
+                    assemble=assemble, demand=demand)
+
+            kernel = fused_wrap(self.name, kernel, (b, ln, year),
+                               suffix, impl, extras)
+            kw.update(scalar_fn=_scalar_3164,
+                      ts_render=m._render_rfc3339,
+                      small_fetch_fn=m._small_fetch(
+                          ("fac8", "sev8", "pri1", "hostl16")),
+                      elide=m.elide_spec(suffix, leg="rfc3164"))
+            return kernel, kw
+        if self.name == "rfc5424_ltsv":
+            from . import device_ltsv_out as m
+
+            def kernel(ts_text, ts_len, assemble):
+                return _fused_rfc5424_ltsv(
+                    b, ln, ts_text, ts_len, max_sd=DEFAULT_MAX_SD,
+                    suffix=suffix, extras=extras, assemble=assemble,
+                    demand=demand)
+
+            kernel = fused_wrap(self.name, kernel, (b, ln), suffix,
+                               impl, extras)
+            kw.update(scalar_fn=_scalar_line,
+                      ts_render=m._render_display,
+                      small_fetch_fn=m._small_fetch,
+                      elide=m.elide_spec(suffix, extras))
+            return kernel, kw
+        # rfc5424_capnp
+        from . import device_capnp as m
+
+        def kernel(ts_text, ts_len, assemble):
+            return _fused_rfc5424_capnp(
+                b, ln, ts_text, ts_len, max_sd=DEFAULT_MAX_SD,
+                suffix=suffix, extras=extras, assemble=assemble,
+                demand=demand)
+
+        kernel = fused_wrap(self.name, kernel, (b, ln), suffix, impl,
+                           extras)
+        kw.update(scalar_fn=_scalar_line,
+                  ts_render=m._render_le_f64,
+                  small_fetch_fn=m._small_fetch,
+                  elide=m.elide_spec(suffix, extras))
+        return kernel, kw
+
 
 ROUTES = {
     "rfc5424": FusedRoute("rfc5424_gelf", "rfc5424"),
     "rfc3164": FusedRoute("rfc3164_gelf", "rfc3164"),
     "ltsv": FusedRoute("ltsv_gelf", "ltsv"),
     "gelf": FusedRoute("gelf_gelf", "gelf"),
+    # PR 19: the non-GELF output legs close the N×M matrix
+    "rfc5424_rfc5424": FusedRoute("rfc5424_rfc5424", "rfc5424",
+                                  out="rfc5424"),
+    "rfc3164_rfc5424": FusedRoute("rfc3164_rfc5424", "rfc3164",
+                                  out="rfc5424"),
+    "rfc5424_ltsv": FusedRoute("rfc5424_ltsv", "rfc5424", out="ltsv"),
+    "rfc5424_capnp": FusedRoute("rfc5424_capnp", "rfc5424",
+                                out="capnp"),
 }
+
+
+def _out_key(encoder) -> str:
+    """The output-format leg for this encoder type (fused routes
+    dispatch on concrete encoder classes, like the split tiers)."""
+    from ..encoders.capnp import CapnpEncoder
+    from ..encoders.gelf import GelfEncoder
+    from ..encoders.ltsv import LTSVEncoder
+    from ..encoders.rfc5424 import RFC5424Encoder
+
+    for cls, key in ((GelfEncoder, "gelf"), (RFC5424Encoder, "rfc5424"),
+                     (LTSVEncoder, "ltsv"), (CapnpEncoder, "capnp")):
+        if type(encoder) is cls:
+            return key
+    return ""
 
 
 def route_for(fmt: str, encoder, merger, decoder=None):
     """The registered fused route for this (fmt, encoder, merger)
     config, or None when no fused program applies (the split path is
-    then the route — ``input.tpu_fuse = "auto"`` semantics)."""
-    route = ROUTES.get(fmt)
+    then the route — ``input.tpu_fuse = "auto"`` semantics).  →GELF
+    legs keep their original fmt-keyed registrations; the other output
+    legs key on ``{fmt}_{out}``."""
+    okey = _out_key(encoder)
+    route = ROUTES.get(fmt if okey == "gelf" else f"{fmt}_{okey}")
     if route is None or not route.route_ok(encoder, merger, decoder):
         return None
     return route
@@ -428,7 +652,7 @@ def fetch_encode(handle: FusedHandle, packed, encoder, merger,
         state = cooldown_state(route_state, route)
     kernel, kw = route.make_kernel(handle, encoder, merger, ltsv_decoder)
     driver_kw = {k: kw[k] for k in ("ts_keys", "ts_vals_fn",
-                                    "small_fetch_fn")
+                                    "small_fetch_fn", "ts_render")
                  if k in kw}
     return fetch_encode_driver(
         kernel, {}, handle.batch_dev, handle.lens_dev, packed, encoder,

@@ -1,0 +1,397 @@
+"""Device-side non-GELF output encode (PR 19): the split kernels in
+tpu/device_rfc5424_out.py, tpu/device_ltsv_out.py and
+tpu/device_capnp.py plus their fused registrations, differential
+against the scalar oracles (decoder → encoder → merger.frame) across
+line/nul/syslen framing, with fallback splicing, per-route gauge
+denominators, and 1/2-lane BatchHandler byte identity.
+
+Every differential here runs eagerly (``jax.disable_jit()``) so the
+oracle comparison holds on any host; compiled-engagement coverage
+rides the ``requires_device_encode_compile`` marker.  The whole file
+is ``slow`` — ci.sh runs it as its own capped step, outside the tier-1
+gate."""
+
+import queue
+import random
+
+import pytest
+
+import jax
+
+from flowgger_tpu.block import EncodedBlock
+from flowgger_tpu.config import Config
+from flowgger_tpu.decoders import DecodeError
+from flowgger_tpu.decoders.rfc3164 import RFC3164Decoder
+from flowgger_tpu.decoders.rfc5424 import RFC5424Decoder
+from flowgger_tpu.encoders.capnp import CapnpEncoder
+from flowgger_tpu.encoders.ltsv import LTSVEncoder
+from flowgger_tpu.encoders.rfc5424 import RFC5424Encoder
+from flowgger_tpu.mergers import LineMerger, NulMerger, SyslenMerger
+from flowgger_tpu.tpu import (
+    device_capnp,
+    device_ltsv_out,
+    device_rfc5424_out,
+    fused_routes,
+    pack,
+    rfc3164,
+    rfc5424,
+)
+from flowgger_tpu.tpu.batch import BatchHandler
+from flowgger_tpu.utils.metrics import registry as metrics
+
+pytestmark = pytest.mark.slow
+
+CFG = Config.from_string("")
+ORACLE = RFC5424Decoder()
+ORACLE_3164 = RFC3164Decoder()
+
+CLEAN = [
+    b'<13>1 2023-09-20T12:35:45.123Z host app 123 MSGID '
+    b'[ex@32473 k="v" a="b"] hello world',
+    b'<165>1 2003-10-11T22:14:15.003Z mymachine.example.com evntslog - '
+    b'ID47 [exampleSDID@32473 iut="3" eventSource="Application" '
+    b'eventID="1011"] An application event log entry',
+    b'<34>1 2003-10-11T22:14:15.003Z mymachine.example.com su - ID47 - '
+    b'su root failed for lonvick on /dev/pts/8',
+    b'<0>1 2023-01-01T00:00:00Z - - - - - -',
+    b'<191>1 2023-06-30T23:59:59.999999Z h a p m [x@1 zz="1" aa="2" '
+    b'mm="3"] msg with "quotes" and tabs',
+]
+
+CLEAN_3164 = [
+    b'<34>Oct 11 22:14:15 mymachine su: su root failed on /dev/pts/8',
+    b'Oct 11 22:14:15 nohost nopri message here',
+    b'<13>Sep 20 12:35:45 host just a message',
+]
+
+MERGERS = [LineMerger(), NulMerger(), SyslenMerger()]
+MERGER_IDS = ["line", "nul", "syslen"]
+
+
+def scalar_frames(dec, enc, lines, merger):
+    out = []
+    for ln in lines:
+        try:
+            rec = dec.decode(ln.decode("utf-8"))
+        except (DecodeError, UnicodeDecodeError):
+            continue
+        out.append(merger.frame(enc.encode(rec)))
+    return out
+
+
+def run_split(module_fetch, lines, enc, merger, fmt="rfc5424",
+              max_len=256):
+    packed = pack.pack_lines_2d(lines, max_len)
+    if fmt == "rfc5424":
+        handle = rfc5424.decode_rfc5424_submit(packed[0], packed[1])
+    else:
+        handle = rfc3164.decode_rfc3164_submit(packed[0], packed[1])
+    return module_fetch(handle, packed, enc, merger)
+
+
+# ---- split-tier eager differentials (line/nul/syslen) ----------------------
+
+@pytest.mark.parametrize("merger", MERGERS, ids=MERGER_IDS)
+def test_device_rfc5424_out_matches_scalar(merger):
+    enc = RFC5424Encoder(CFG)
+    with jax.disable_jit():
+        res, _ = run_split(device_rfc5424_out.fetch_encode, CLEAN * 3,
+                           enc, merger)
+    assert res is not None
+    want = b"".join(scalar_frames(ORACLE, enc, CLEAN * 3, merger))
+    assert res.block.data == want
+
+
+@pytest.mark.parametrize("merger", MERGERS, ids=MERGER_IDS)
+def test_device_rfc3164_rfc5424_matches_scalar(merger):
+    enc = RFC5424Encoder(CFG)
+    with jax.disable_jit():
+        res, _ = run_split(device_rfc5424_out.fetch_encode_3164,
+                           CLEAN_3164 * 3, enc, merger, fmt="rfc3164")
+    assert res is not None
+    want = b"".join(scalar_frames(ORACLE_3164, enc, CLEAN_3164 * 3,
+                                  merger))
+    assert res.block.data == want
+
+
+@pytest.mark.parametrize("merger", MERGERS, ids=MERGER_IDS)
+def test_device_ltsv_out_matches_scalar(merger):
+    enc = LTSVEncoder(CFG)
+    with jax.disable_jit():
+        res, _ = run_split(device_ltsv_out.fetch_encode, CLEAN * 3,
+                           enc, merger)
+    assert res is not None
+    want = b"".join(scalar_frames(ORACLE, enc, CLEAN * 3, merger))
+    assert res.block.data == want
+
+
+@pytest.mark.parametrize("merger", MERGERS, ids=MERGER_IDS)
+def test_device_capnp_matches_scalar(merger):
+    enc = CapnpEncoder(CFG)
+    with jax.disable_jit():
+        res, _ = run_split(device_capnp.fetch_encode, CLEAN * 3, enc,
+                           merger)
+    assert res is not None
+    want = b"".join(scalar_frames(ORACLE, enc, CLEAN * 3, merger))
+    assert res.block.data == want
+
+
+# ---- fused registrations ---------------------------------------------------
+
+FUSED_CASES = [
+    ("rfc5424_rfc5424", "rfc5424", RFC5424Encoder, ORACLE, CLEAN),
+    ("rfc3164_rfc5424", "rfc3164", RFC5424Encoder, ORACLE_3164,
+     CLEAN_3164),
+    ("rfc5424_ltsv", "rfc5424", LTSVEncoder, ORACLE, CLEAN),
+    ("rfc5424_capnp", "rfc5424", CapnpEncoder, ORACLE, CLEAN),
+]
+
+
+def test_fused_new_output_routes_match_scalar(monkeypatch):
+    """Every PR 19 fused leg, eager, across all three framings —
+    byte-identical to the scalar oracle, per-route fused counters
+    moving."""
+    monkeypatch.setenv("FLOWGGER_COMPILE_TIMEOUT_MS", "0")
+    monkeypatch.setenv("FLOWGGER_FUSED_COMPILE_TIMEOUT_MS", "0")
+    for name, fmt, enc_cls, dec, lines in FUSED_CASES:
+        enc = enc_cls(CFG)
+        for merger in (LineMerger(), NulMerger(), SyslenMerger()):
+            route = fused_routes.route_for(fmt, enc, merger)
+            assert route is not None and route.name == name
+            packed = pack.pack_lines_2d(lines * 3, 256)
+            before = metrics.get(f"fused_rows_{name}")
+            with jax.disable_jit():
+                handle = fused_routes.submit(route, packed)
+                res, _ = fused_routes.fetch_encode(handle, packed, enc,
+                                                   merger, None, {})
+            assert res is not None, f"{name} declined"
+            want = b"".join(scalar_frames(dec, enc, lines * 3, merger))
+            assert res.block.data == want, f"{name}/{type(merger).__name__}"
+            assert metrics.get(f"fused_rows_{name}") > before
+
+
+def test_fused_routes_are_registered():
+    """route_for keys the output leg on the concrete encoder type and
+    the kill switch gates every leg."""
+    for name, fmt, enc_cls, _dec, _lines in FUSED_CASES:
+        route = fused_routes.route_for(fmt, enc_cls(CFG), LineMerger())
+        assert route is not None and route.name == name
+    # unregistered legs stay split (no ltsv-input output legs)
+    assert fused_routes.route_for("ltsv", RFC5424Encoder(CFG),
+                                  LineMerger(),
+                                  decoder=None) is None
+
+
+def test_device_disabled_by_env(monkeypatch):
+    monkeypatch.setenv("FLOWGGER_DEVICE_ENCODE", "0")
+    assert not device_rfc5424_out.route_ok(RFC5424Encoder(CFG),
+                                           LineMerger())
+    assert not device_ltsv_out.route_ok(LTSVEncoder(CFG), LineMerger())
+    assert not device_capnp.route_ok(CapnpEncoder(CFG), LineMerger())
+    for name, fmt, enc_cls, _dec, _lines in FUSED_CASES:
+        assert fused_routes.route_for(fmt, enc_cls(CFG),
+                                      LineMerger()) is None
+
+
+# ---- fallback splicing + off-tier rows -------------------------------------
+
+MIXED = [
+    CLEAN[0],
+    # escaped SD value: off-tier (device kernels re-emit verbatim only)
+    b'<13>1 2023-09-20T12:35:45.123Z h a - - [x@1 k="a\\"b"] esc val',
+    b"garbage line",
+    CLEAN[2],
+    # high byte: off-tier on every output leg
+    "<13>1 2023-09-20T12:35:45.123Z hést a - - - utf8".encode(),
+    CLEAN[4],
+]
+
+
+@pytest.mark.parametrize(
+    "module,enc_cls",
+    [(device_rfc5424_out, RFC5424Encoder),
+     (device_ltsv_out, LTSVEncoder),
+     (device_capnp, CapnpEncoder)],
+    ids=["rfc5424", "ltsv", "capnp"])
+def test_device_fallback_splicing(module, enc_cls, monkeypatch):
+    monkeypatch.setattr(module, "FALLBACK_FRAC", 1.1)
+    enc = enc_cls(CFG)
+    with jax.disable_jit():
+        res, _ = run_split(module.fetch_encode, MIXED, enc,
+                           LineMerger())
+    assert res is not None
+    want = b"".join(scalar_frames(ORACLE, enc, MIXED, LineMerger()))
+    assert res.block.data == want
+    # the unparseable row surfaced as an error, not silently dropped
+    assert len(res.errors) == 1
+
+
+def test_device_declines_on_heavy_fallback():
+    bad = [b"not a syslog line"] * 20 + [CLEAN[0]]
+    with jax.disable_jit():
+        res, _ = run_split(device_ltsv_out.fetch_encode, bad,
+                           LTSVEncoder(CFG), LineMerger())
+    assert res is None
+
+
+def test_ltsv_off_tier_grammar_rows_splice(monkeypatch):
+    """LTSV-specific off-tier conditions: a colon inside an SD name and
+    a literal tab in the message take the scalar path, byte-identical
+    after splicing."""
+    monkeypatch.setattr(device_ltsv_out, "FALLBACK_FRAC", 1.1)
+    lines = [
+        CLEAN[0],
+        b'<13>1 2023-09-20T12:35:45.123Z h a - - - msg with\ttab',
+        CLEAN[2],
+    ]
+    enc = LTSVEncoder(CFG)
+    with jax.disable_jit():
+        res, _ = run_split(device_ltsv_out.fetch_encode, lines, enc,
+                           LineMerger())
+    assert res is not None
+    want = b"".join(scalar_frames(ORACLE, enc, lines, LineMerger()))
+    assert res.block.data == want
+
+
+def test_capnp_fuzz_vs_scalar(monkeypatch):
+    """Binary-layout fuzz: random pair counts/value shapes against the
+    scalar Cap'n Proto encoder (word padding, pointer offsets, tag
+    words are all length-dependent)."""
+    monkeypatch.setattr(device_capnp, "FALLBACK_FRAC", 1.1)
+    rng = random.Random(19)
+    lines = []
+    for i in range(120):
+        nk = rng.randint(0, 4)
+        pairs = " ".join(
+            f'k{j}="{"v" * rng.randint(0, 12)}"' for j in range(nk))
+        sd = f"[sd@1 {pairs}]" if pairs else rng.choice(["-", "[sd@1]"])
+        host = rng.choice(["host", "-", "h" * 30])
+        msg = rng.choice(["hello", "", "-", "x" * rng.randint(1, 40)])
+        lines.append(
+            f'<{rng.randint(0, 191)}>1 2023-09-20T12:35:45.'
+            f'{rng.randint(0, 999)}Z {host} app {rng.randint(1, 9)} '
+            f'M{i % 7} {sd} {msg}'.encode())
+    enc = CapnpEncoder(CFG)
+    for merger in (LineMerger(), SyslenMerger()):
+        with jax.disable_jit():
+            res, _ = run_split(device_capnp.fetch_encode, lines, enc,
+                               merger)
+        assert res is not None
+        want = b"".join(scalar_frames(ORACLE, enc, lines, merger))
+        assert res.block.data == want
+
+
+# ---- per-route gauges: one-denominator contract ----------------------------
+
+def test_gauge_denominator_is_tier_rows_on_mixed_batch(monkeypatch):
+    """fetch/emit per-row gauges for a new route must divide by TIER
+    rows, not all rows: on a mixed batch with fallback rows, the emit
+    gauge equals the device body bytes over engaged rows only (a
+    whole-batch denominator would dilute both gauges)."""
+    monkeypatch.setattr(device_ltsv_out, "FALLBACK_FRAC", 1.1)
+    enc = LTSVEncoder(CFG)
+    tier_line = CLEAN[0]
+    n_tier, n_bad = 8, 4
+    lines = [tier_line] * n_tier + [b"garbage line"] * n_bad
+    with jax.disable_jit():
+        res, _ = run_split(device_ltsv_out.fetch_encode, lines, enc,
+                           LineMerger())
+    assert res is not None
+    emit = metrics.get_gauge("emit_bytes_per_row_rfc5424_ltsv")
+    fetch = metrics.get_gauge("fetch_bytes_per_row_rfc5424_ltsv")
+    assert emit > 0 and fetch > 0
+    # identical tier rows: per-tier-row emitted width == one frame
+    frame = LineMerger().frame(
+        enc.encode(ORACLE.decode(tier_line.decode())))
+    assert emit == pytest.approx(len(frame), abs=1.0)
+    # an all-rows denominator would have reported ~2/3 of that
+    assert emit > len(frame) * (n_tier / len(lines)) + 1
+
+
+def test_split_path_does_not_count_fused_rows(monkeypatch):
+    monkeypatch.setattr(device_capnp, "FALLBACK_FRAC", 1.1)
+    enc = CapnpEncoder(CFG)
+    before = metrics.get("fused_rows")
+    before_route = metrics.get("fused_rows_rfc5424_capnp")
+    with jax.disable_jit():
+        res, _ = run_split(device_capnp.fetch_encode, CLEAN * 2, enc,
+                           LineMerger())
+    assert res is not None
+    assert metrics.get("fused_rows") == before
+    assert metrics.get("fused_rows_rfc5424_capnp") == before_route
+    # ...but the per-route gauges still export
+    assert metrics.get_gauge("emit_bytes_per_row_rfc5424_capnp") > 0
+
+
+# ---- compiled engagement ---------------------------------------------------
+
+@pytest.mark.requires_device_encode_compile
+@pytest.mark.parametrize(
+    "module,enc_cls",
+    [(device_rfc5424_out, RFC5424Encoder),
+     (device_ltsv_out, LTSVEncoder),
+     (device_capnp, CapnpEncoder)],
+    ids=["rfc5424", "ltsv", "capnp"])
+def test_device_engages_compiled(module, enc_cls):
+    enc = enc_cls(CFG)
+    n0 = metrics.get("device_encode_rows")
+    res, _ = run_split(module.fetch_encode, CLEAN * 3, enc,
+                       LineMerger())
+    assert res is not None
+    assert metrics.get("device_encode_rows") - n0 == len(CLEAN) * 3
+    want = b"".join(scalar_frames(ORACLE, enc, CLEAN * 3,
+                                  LineMerger()))
+    assert res.block.data == want
+
+
+@pytest.mark.requires_device_encode_compile
+def test_device_rfc3164_leg_engages_compiled():
+    enc = RFC5424Encoder(CFG)
+    n0 = metrics.get("device_encode_rows")
+    res, _ = run_split(device_rfc5424_out.fetch_encode_3164,
+                       CLEAN_3164 * 4, enc, LineMerger(),
+                       fmt="rfc3164")
+    assert res is not None
+    assert metrics.get("device_encode_rows") - n0 == len(CLEAN_3164) * 4
+    want = b"".join(scalar_frames(ORACLE_3164, enc, CLEAN_3164 * 4,
+                                  LineMerger()))
+    assert res.block.data == want
+
+
+# ---- BatchHandler 1/2-lane byte identity -----------------------------------
+
+@pytest.mark.parametrize("lanes", [1, 2])
+@pytest.mark.parametrize(
+    "enc_cls", [RFC5424Encoder, LTSVEncoder, CapnpEncoder],
+    ids=["rfc5424", "ltsv", "capnp"])
+def test_handler_lane_dispatch_byte_identity(lanes, enc_cls,
+                                             monkeypatch):
+    """Acceptance: new-output-leg bytes through the real BatchHandler +
+    LaneSet sequencer are identical to the scalar oracle across 1/2-lane
+    dispatch (eager, fuse auto so the fused tier engages)."""
+    monkeypatch.setenv("FLOWGGER_COMPILE_TIMEOUT_MS", "0")
+    monkeypatch.setenv("FLOWGGER_FUSED_COMPILE_TIMEOUT_MS", "0")
+    cfg = Config.from_string(f'[input]\ntpu_lanes = {lanes}\n')
+    enc = enc_cls(cfg)
+    merger = LineMerger()
+    lines = CLEAN * 4
+    tx = queue.Queue()
+    with jax.disable_jit():
+        h = BatchHandler(tx, RFC5424Decoder(), enc, cfg, fmt="rfc5424",
+                         start_timer=False, merger=merger)
+        try:
+            # two batches so 2-lane dispatch actually uses both lanes
+            for ln in lines[:10]:
+                h.handle_bytes(ln)
+            h.flush()
+            for ln in lines[10:]:
+                h.handle_bytes(ln)
+            h.flush()
+        finally:
+            h.close()
+    got = []
+    while not tx.empty():
+        item = tx.get_nowait()
+        got.append(item.data if isinstance(item, EncodedBlock) else item)
+    want = b"".join(scalar_frames(ORACLE, enc, lines, merger))
+    assert b"".join(got) == want

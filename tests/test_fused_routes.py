@@ -222,16 +222,22 @@ def test_tpu_fuse_validation():
 
 def test_route_for_respects_split_gates(monkeypatch):
     """No fused program without the split tier's applicability: the
-    device-encode kill switch and non-GELF outputs stay split."""
+    device-encode kill switch gates every leg, and unregistered input
+    formats stay split."""
     from flowgger_tpu.encoders.rfc5424 import RFC5424Encoder
 
     enc = GelfEncoder(CFG)
+    enc5424 = RFC5424Encoder(CFG)
     assert fused_routes.route_for("rfc5424", enc, LineMerger()) is not None
+    # PR 19: the rfc5424→rfc5424 output leg is a fused route now
+    route = fused_routes.route_for("rfc5424", enc5424, LineMerger())
+    assert route is not None and route.name == "rfc5424_rfc5424"
     monkeypatch.setenv("FLOWGGER_DEVICE_ENCODE", "0")
     assert fused_routes.route_for("rfc5424", enc, LineMerger()) is None
+    assert fused_routes.route_for("rfc5424", enc5424,
+                                  LineMerger()) is None
     monkeypatch.delenv("FLOWGGER_DEVICE_ENCODE")
-    assert fused_routes.route_for(
-        "rfc5424", RFC5424Encoder(CFG), LineMerger()) is None
+    # capnp is an output leg, never an input format
     assert fused_routes.route_for("capnp", enc, LineMerger()) is None
 
 
