@@ -345,6 +345,45 @@ class BatchHandler(Handler):
                 "block route is disabled, auto format, or a sharded "
                 "mesh owns the format); using the host splitters",
                 file=sys.stderr)
+        # Pallas structural kernels (tpu/pallas_kernels.py): single-VMEM
+        # framing→decode passes replacing the jnp scatter ladder and the
+        # repeated [N,L] screen passes.  "auto" engages the compiled
+        # kernels whenever the block route runs on a non-CPU backend;
+        # "on" additionally engages interpret-mode kernels on the CPU
+        # backend (tests/benches — interpret Pallas is *slower* than
+        # jnp, so auto never picks it there); "off" pins the jnp tiers.
+        # Declines ride the framing ladder shape (3 strikes → cooldown)
+        # and fall back to the jnp tier — never dropping data.
+        from . import pallas_kernels as _pallas_mod
+
+        pallas_mode = cfg.lookup_str(
+            "input.tpu_pallas", "input.tpu_pallas must be a string",
+            "auto")
+        if pallas_mode not in ("auto", "on", "off"):
+            from ..config import ConfigError
+
+            raise ConfigError("input.tpu_pallas must be auto, on or off")
+        pallas_ok = (self._block_mode and self.fmt != "auto"
+                     and self._kernel_fn is not None
+                     and self._block_route_ok())
+        if pallas_mode == "off" or not pallas_ok:
+            _pallas_mod.set_mode("off")
+            if pallas_mode == "on" and self._block_mode:
+                print(
+                    'flowgger-tpu: input.tpu_pallas = "on" but this '
+                    f"config cannot run Pallas kernels for format "
+                    f"'{fmt}' (the columnar block route is disabled or "
+                    "auto format); using the jnp kernel tiers",
+                    file=sys.stderr)
+        else:
+            import jax
+
+            if jax.default_backend() == "cpu":
+                _pallas_mod.set_mode(
+                    "interpret" if pallas_mode == "on" else "off")
+            else:
+                _pallas_mod.set_mode("compiled")
+        self._pallas_mode = pallas_mode
         # background kernel prewarm: compile the configured format's
         # decode (+ engaged device-encode) kernels for the shape-bucket
         # grid now, so the first real batch of each steady-state shape

@@ -240,7 +240,7 @@ _cache_listener_installed = False
 # upgrade.  Bump this whenever a kernel signature, segment layout, or
 # channel contract changes; old revisions keep their own subdirectory
 # and die with ordinary cache cleanup.
-KERNEL_ABI = 8
+KERNEL_ABI = 9
 
 
 def _install_cache_listener() -> None:

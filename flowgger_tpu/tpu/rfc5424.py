@@ -68,9 +68,9 @@ ESC_RUN_CAP = 16
 _I32 = jnp.int32
 
 
-def _min_where(mask, packed, notfound):
+def _min_where(mask, packed, notfound, manual: bool = False):
     """Per-row min of ``packed`` where mask, else ``notfound``."""
-    return jnp.min(jnp.where(mask, packed, notfound), axis=1)
+    return _row_min(jnp.where(mask, packed, notfound), manual)
 
 
 def _at(iota, pos, values, default=0):
@@ -201,6 +201,63 @@ def _cummax(x, impl: str):
     return x
 
 
+# ---- Mosaic-safe row reductions -------------------------------------
+# Mosaic (this jax's Pallas TPU lowering) implements float but not
+# integer/bool reductions, so the manual path computes every axis-1
+# reduction as a log-shift ladder (elementwise adds/min/max over the
+# VMEM-resident plane) and reads column 0.  The XLA path keeps the
+# native reductions.
+
+def _row_sum(x, manual: bool = False):
+    if not manual:
+        return jnp.sum(x, axis=1)
+    x = x.astype(_I32)
+    L = x.shape[1]
+    k = 1
+    while k < L:
+        x = x + _shift_left(x, k, 0)
+        k <<= 1
+    return x[:, 0]
+
+
+def _row_max(x, manual: bool = False):
+    if not manual:
+        return jnp.max(x, axis=1)
+    x = x.astype(_I32)
+    L = x.shape[1]
+    k = 1
+    neg = jnp.iinfo(_I32).min
+    while k < L:
+        x = jnp.maximum(x, _shift_left(x, k, neg))
+        k <<= 1
+    return x[:, 0]
+
+
+def _row_min(x, manual: bool = False):
+    if not manual:
+        return jnp.min(x, axis=1)
+    x = x.astype(_I32)
+    L = x.shape[1]
+    k = 1
+    pos = jnp.iinfo(_I32).max
+    while k < L:
+        x = jnp.minimum(x, _shift_left(x, k, pos))
+        k <<= 1
+    return x[:, 0]
+
+
+def _row_any(x, manual: bool = False):
+    if not manual:
+        return jnp.any(x, axis=1)
+    return _row_max(x.astype(_I32), True) != 0
+
+
+def _row_all(x, manual: bool = False):
+    if not manual:
+        return jnp.all(x, axis=1)
+    return ~_row_any(~x, True)
+
+
 def _bitpack32(plane):
     """[N, L] bool -> [N, ceil(L/32)] uint32, bit j of word w = plane[:,
     32w+j].  The reshape/broadcast form beats 32 strided slices on TPU:
@@ -283,7 +340,7 @@ def _slot_geometry(L: int):
 
 
 def extract_by_ord(mask, ord_, value, K, fill, extract_impl="sum",
-                   slot_bits=None):
+                   slot_bits=None, manual: bool = False):
     """out[n, k] = ``value`` at the position with ordinal k+1 (masked),
     else ``fill``.  The ordinal channel must hit each ordinal at most
     once per row.  Shared by every format kernel.
@@ -320,14 +377,15 @@ def extract_by_ord(mask, ord_, value, K, fill, extract_impl="sum",
             if base + s < K:
                 acc = acc + (jnp.where(mask & (ord_ == base + 1 + s),
                                        v1, 0) << (slot_bits * s))
-        word = jnp.sum(acc, axis=1)
+        word = _row_sum(acc, manual)
         for slot in range(min(slots, K - base)):
             v = (word >> (slot_bits * slot)) & slot_mask
             cols.append(jnp.where(v == 0, fill, v - 1))
     return jnp.stack(cols, axis=1)
 
 
-def extract_counts_by_ord(mask, ord_, K, extract_impl="sum"):
+def extract_counts_by_ord(mask, ord_, K, extract_impl="sum",
+                          manual: bool = False):
     """out[n, k] = number of masked positions with ordinal k+1 — an
     *accumulating* variant of extract_by_ord (the mask may hit many
     positions per ordinal; each per-word slot's total is bounded by
@@ -347,7 +405,7 @@ def extract_counts_by_ord(mask, ord_, K, extract_impl="sum"):
             if base + s < K:
                 acc = acc + (jnp.where(mask & (ord_ == base + 1 + s),
                                        1, 0) << (slot_bits * s))
-        word = jnp.sum(acc, axis=1)
+        word = _row_sum(acc, manual)
         for slot in range(min(slots, K - base)):
             cols.append((word >> (slot_bits * slot)) & slot_mask)
     return jnp.stack(cols, axis=1)
@@ -375,18 +433,23 @@ def decode_rfc5424(batch: jnp.ndarray, lens: jnp.ndarray,
     Identical outputs; differential-tested against each other."""
     if scan_impl is None:
         scan_impl = best_scan_impl()
+    manual = scan_impl == "manual"
     N, L = batch.shape
 
     def _extract(mask, ord_, value, K, fill):
-        return extract_by_ord(mask, ord_, value, K, fill, extract_impl)
+        return extract_by_ord(mask, ord_, value, K, fill, extract_impl,
+                              manual=manual)
 
     def _extract_counts(mask, ord_, K):
-        return extract_counts_by_ord(mask, ord_, K, extract_impl)
+        return extract_counts_by_ord(mask, ord_, K, extract_impl,
+                                     manual=manual)
     lens = lens.astype(_I32)
     iota = jax.lax.broadcasted_iota(_I32, (N, L), 1)
     bu = batch  # uint8 view for comparisons (half the HBM traffic of i32)
     valid = iota < lens[:, None]
-    bb = jnp.where(valid, bu, jnp.uint8(0))
+    # fill follows the batch dtype: u8 on the jnp tier, i32 under the
+    # Pallas kernels (Mosaic cannot carry u8 constants)
+    bb = jnp.where(valid, bu, jnp.asarray(0, bu.dtype))
     # uint8 byte plane: every mask read touches 1 byte/position; sites
     # that need arithmetic widen inside their own fusion (free VPU work
     # vs doubled HBM traffic for a materialized int16 plane)
@@ -446,7 +509,8 @@ def decode_rfc5424(batch: jnp.ndarray, lens: jnp.ndarray,
     f_end = jnp.concatenate([sp, lens[:, None]], axis=1)          # [N,7]
 
     # ---- PRI + version (rs:74-92) ---------------------------------------
-    gt = _min_where((bb == ord(">")) & (iota > start0[:, None]) & valid, iota, L)
+    gt = _min_where((bb == ord(">")) & (iota > start0[:, None]) & valid,
+                    iota, L, manual)
     ndig = gt - start0 - 1
     ok &= (gt < f_end[:, 0]) & (ndig >= 1) & (ndig <= 3)
     # digits weighted by 10^(gt-1-iota); non-digit in range -> violation
@@ -476,7 +540,7 @@ def decode_rfc5424(batch: jnp.ndarray, lens: jnp.ndarray,
         + (jnp.where(in_ts & (r == 19) & (bb == ord(".")), 1, 0) << 28)
         + (jnp.where((iota == gt[:, None] + 1) & (bb == ord("1")), 1, 0) << 29)
     )
-    word1 = jnp.sum(w1, axis=1)
+    word1 = _row_sum(w1, manual)
     year = word1 & 0x3FFF
     month = (word1 >> 14) & 0x7F
     day = (word1 >> 21) & 0x7F
@@ -490,7 +554,7 @@ def decode_rfc5424(batch: jnp.ndarray, lens: jnp.ndarray,
         + (dz * ((r == 17) * 10 + (r == 18)) << 14)
         + (jnp.where(pri_zone, dig * w_pri, 0) << 21)
     )
-    word2 = jnp.sum(w2, axis=1)
+    word2 = _row_sum(w2, manual)
     hour = word2 & 0x7F
     minute = (word2 >> 7) & 0x7F
     sec = (word2 >> 14) & 0x7F
@@ -514,7 +578,8 @@ def decode_rfc5424(batch: jnp.ndarray, lens: jnp.ndarray,
     # fractional seconds: run of digits from r==20
     rd = r - 20
     # first non-digit offset in [0, 10) == run length (capped)
-    frac_run = _min_where(in_ts & (rd >= 0) & (rd < 10) & ~is_digit, rd, 10)
+    frac_run = _min_where(in_ts & (rd >= 0) & (rd < 10) & ~is_digit,
+                          rd, 10, manual)
     frac_run = jnp.minimum(frac_run, jnp.maximum(tlen - 20, 0))
     frac_len = jnp.where(has_frac, frac_run, 0)
     ok &= jnp.where(has_frac, (frac_len >= 1) & (frac_len <= 9), True)
@@ -524,7 +589,7 @@ def decode_rfc5424(batch: jnp.ndarray, lens: jnp.ndarray,
         + (rd == 6) * 100 + (rd == 7) * 10 + (rd == 8) * 1
     )
     in_frac = in_ts & (rd >= 0) & (rd < frac_len[:, None])
-    nanos = jnp.sum(jnp.where(in_frac, dig * w_frac, 0), axis=1)
+    nanos = _row_sum(jnp.where(in_frac, dig * w_frac, 0), manual)
 
     # offset zone at r2 = r - opos; word3 packs its digits, the
     # remaining single-position flags, and (for the common L <= 1023
@@ -547,7 +612,7 @@ def decode_rfc5424(batch: jnp.ndarray, lens: jnp.ndarray,
     )
     if pack_high:
         w3 = w3 + (jnp.where((bb >= 128) & valid, 1, 0) << 19)
-    word3 = jnp.sum(w3, axis=1)
+    word3 = _row_sum(w3, manual)
     oh = word3 & 0x7F
     om = (word3 >> 7) & 0x7F
     is_zulu = ((word3 >> 14) & 1) == 1
@@ -579,8 +644,8 @@ def decode_rfc5424(batch: jnp.ndarray, lens: jnp.ndarray,
     # quotes (header fields may legally contain '"'); subtracting the
     # running count at rest_s restores the in-rest-only ordinals the
     # grammar needs — one fused reduction instead of a second scan.
-    q_before_rest = jnp.max(
-        jnp.where(valid & (iota < rest_s[:, None]), q_incl_all, 0), axis=1)
+    q_before_rest = _row_max(
+        jnp.where(valid & (iota < rest_s[:, None]), q_incl_all, 0), manual)
     q_excl = (q_incl_all - real_q_all.astype(q_incl_all.dtype)
               - q_before_rest[:, None])
     real_q = real_q_all & in_rest
@@ -624,7 +689,7 @@ def decode_rfc5424(batch: jnp.ndarray, lens: jnp.ndarray,
     rb_sb = (((L << 3) | 7) + 1).bit_length()
     rb_word = extract_by_ord(rbrack, rb_ord, (iota << 3) | rb_payload,
                              max_sd + 1, L << 3, extract_impl,
-                             slot_bits=rb_sb)
+                             slot_bits=rb_sb, manual=manual)
     rb_pos = rb_word >> 3
     rb_flags = rb_word & 7
     rb_found = rb_pos < L
@@ -639,7 +704,7 @@ def decode_rfc5424(batch: jnp.ndarray, lens: jnp.ndarray,
     # truncated view never changes an accepted row's zone.
     term_col = rb_found & (((rb_flags & 4) != 0)
                            | (rb_pos == (lens - 1)[:, None]))
-    sd_end_zone = jnp.min(jnp.where(term_col, rb_pos, L), axis=1)
+    sd_end_zone = _row_min(jnp.where(term_col, rb_pos, L), manual)
     zone_c = in_rest & (iota <= sd_end_zone[:, None]) & is_sd[:, None]
     oq_mask = open_q & zone_c
     cq_mask = close_q & zone_c
@@ -673,7 +738,8 @@ def decode_rfc5424(batch: jnp.ndarray, lens: jnp.ndarray,
     # every block's ']' must be a legal terminator
     rb_legal = (rb_flags[:, :max_sd] & 1) != 0
     ok &= jnp.where(is_sd,
-                    jnp.where(blk_idx_valid, rb_legal, True).all(axis=1), True)
+                    _row_all(jnp.where(blk_idx_valid, rb_legal, True),
+                             manual), True)
 
     # sd_id span per block: blk_start+1 .. first space (must precede ']').
     # The first space of block k is the only structural space there not
@@ -688,8 +754,8 @@ def decode_rfc5424(batch: jnp.ndarray, lens: jnp.ndarray,
     sid_sp_mask = is_sp & outside & zone_c & ~prev_closeq & ~prev_sp
     sid_end = _extract(sid_sp_mask, rb_ord + 1, iota, max_sd, L)
     ok &= jnp.where(is_sd,
-                    jnp.where(blk_idx_valid, sid_end < blk_rb, True).all(axis=1),
-                    True)
+                    _row_all(jnp.where(blk_idx_valid, sid_end < blk_rb, True),
+                             manual), True)
 
     # pair regions: strictly between sd_id space and block ']'
     in_pair = jnp.zeros((N, L), dtype=bool)
@@ -722,7 +788,7 @@ def decode_rfc5424(batch: jnp.ndarray, lens: jnp.ndarray,
     # oq_ord is parity-derived (not a cumsum), so the pair total is the
     # max ordinal over the zone's open quotes rather than a last-column
     # read of a running count
-    pair_total = jnp.max(jnp.where(oq_mask, oq_ord, 0), axis=1)
+    pair_total = _row_max(jnp.where(oq_mask, oq_ord, 0), manual)
     pair_count = jnp.where(is_sd, pair_total, 0)
     ok &= jnp.where(is_sd, pair_count <= max_pairs, True)
 
@@ -753,9 +819,10 @@ def decode_rfc5424(batch: jnp.ndarray, lens: jnp.ndarray,
 
     # name sanity per extracted pair: a run was found and it is nonempty
     # ('=' sits at oq_pos-1, so the run spans [ns_pos, oq_pos-1)).
-    ok &= jnp.where(pair_valid, ns_pos <= oq_pos - 2, True).all(axis=1)
+    ok &= _row_all(jnp.where(pair_valid, ns_pos <= oq_pos - 2, True),
+                   manual)
 
-    ok &= jnp.where(pair_valid, cq_pos > oq_pos, True).all(axis=1)
+    ok &= _row_all(jnp.where(pair_valid, cq_pos > oq_pos, True), manual)
     name_end = oq_pos - 1  # position of '='
 
 
@@ -784,16 +851,17 @@ def decode_rfc5424(batch: jnp.ndarray, lens: jnp.ndarray,
     is_ws = ((bb >= 9) & (bb <= 13)) | ((bb >= 28) & (bb <= 32))
     non_ws = valid & ~is_ws
     trim_end = jnp.maximum(
-        jnp.max(jnp.where(non_ws, iota + 1, 0), axis=1), start0)
-    msg_a = _min_where(non_ws & (iota >= msg_start[:, None]), iota, L)
+        _row_max(jnp.where(non_ws, iota + 1, 0), manual), start0)
+    msg_a = _min_where(non_ws & (iota >= msg_start[:, None]), iota, L,
+                       manual)
     msg_trim_start = jnp.minimum(msg_a, trim_end)
     if pack_high:
         has_high = ((word3 >> 19) & 0x3FF) > 0
     else:
-        has_high = jnp.any((bb >= 128) & valid, axis=1)
+        has_high = _row_any((bb >= 128) & valid, manual)
 
     # single reduction over every accumulated 2-D violation
-    ok &= ~jnp.any(viol2d, axis=1)
+    ok &= ~_row_any(viol2d, manual)
 
     return {
         "ok": ok,
@@ -870,6 +938,14 @@ def decode_rfc5424_submit(batch, lens, max_sd: int = DEFAULT_MAX_SD,
         # (same channels, byte-identical by construction); None → jit
         out = decode_call("rfc5424", (batch_dev, lens_dev),
                           {"max_sd": max_sd, "extract_impl": impl})
+        if out is None:
+            # Pallas tier: the single-VMEM structural decode (one HBM
+            # read of the batch, one index write) — None on decline /
+            # cooldown / tier off, then the jnp jit exactly as before
+            from .pallas_kernels import decode_tier
+
+            out = decode_tier("rfc5424", batch_dev, lens_dev,
+                              max_sd=max_sd)
         if out is None:
             out = decode_rfc5424_jit(batch_dev, lens_dev,
                                      max_sd=max_sd, extract_impl=impl)
@@ -1035,6 +1111,10 @@ def decode_rfc5424_pallas(batch, lens, max_sd: int = DEFAULT_MAX_SD,
         batch = jnp.pad(batch, ((0, pad), (0, 0)))
         lens = jnp.pad(lens, (0, pad))
         N += pad
+    # widen u8 -> i32 outside the kernel: Mosaic cannot load u8 VMEM
+    # refs on this jax; one elementwise pass, and the decode body's
+    # byte compares are dtype-agnostic
+    batch = batch.astype(_I32)
     lens2 = lens.astype(_I32).reshape(N, 1)
 
     def kernel(b_ref, l_ref, *outs):

@@ -17,6 +17,14 @@ versioned manifest and a strict-validating loader:
 The legacy verbs keep working here (same artifact path, same output)
 so existing relay scripts don't break; new automation should call the
 module CLI directly.
+
+The checked-in ``pallas_rfc5424_tpu.jaxexport`` is built from the
+single-VMEM kernel (i32-widened batch, channel-dict output) — the
+earlier flat-tuple artifact from the ``_PALLAS_SHAPE`` proof era is
+superseded; ``pallas run`` compares per channel key accordingly.  For
+production boots use the full ``pallas`` artifact family
+(``aot build --families pallas``), which covers framing spans, gather,
+and both decode passes across the row-bucket grid.
 """
 
 import os
